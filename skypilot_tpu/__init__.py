@@ -1,0 +1,43 @@
+"""skypilot_tpu: TPU-native infrastructure orchestration.
+
+Declare a task (`resources: accelerators: tpu-v5e-256`), have the
+optimizer/catalog resolve it to a concrete GCP TPU pod slice, provision the
+multi-host TPU VMs, wire up the distributed JAX runtime (jax.distributed
+coordinator + ICI mesh instead of NCCL/torchrun), run/monitor jobs through a
+per-host agent, auto-recover managed jobs from preemption, and autoscale
+serving replicas.
+
+Reference parity: the public facade mirrors sky/__init__.py:85-132.
+"""
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils.status_lib import ClusterStatus, JobStatus
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'ClusterStatus',
+    'Dag',
+    'JobStatus',
+    'Resources',
+    'Task',
+    'exceptions',
+]
+
+
+def __getattr__(name):
+    """Lazy re-exports of the heavier SDK surface (launch/exec/status/...).
+
+    Deferred so `import skypilot_tpu` stays fast (mirrors the reference's
+    lazy adaptor philosophy, sky/adaptors/common.py:10).
+    """
+    _sdk_names = {
+        'launch', 'exec', 'status', 'start', 'stop', 'down', 'autostop',
+        'queue', 'cancel', 'tail_logs', 'optimize',
+    }
+    if name in _sdk_names:
+        from skypilot_tpu.client import sdk
+        return getattr(sdk, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

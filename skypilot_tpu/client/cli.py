@@ -1,0 +1,31 @@
+"""CLI entry point (mirrors sky/client/cli/command.py, argparse-based).
+
+The full command surface is built out with the execution engine; this module
+always provides `skytpu --version` and a helpful error for unbuilt commands.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    import skypilot_tpu
+    parser = argparse.ArgumentParser(
+        prog='skytpu',
+        description='TPU-native infrastructure orchestration.')
+    parser.add_argument('--version', action='version',
+                        version=f'skypilot-tpu {skypilot_tpu.__version__}')
+    sub = parser.add_subparsers(dest='command')
+    sub.add_parser('status', help='Show clusters')
+    args, _ = parser.parse_known_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 0
+    print(f'skytpu {args.command}: command not wired up yet at this build '
+          'stage.', file=sys.stderr)
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main())

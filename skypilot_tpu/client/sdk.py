@@ -1,0 +1,70 @@
+"""Client SDK (mirrors sky/client/sdk.py).
+
+Currently runs library-local (direct calls into the execution engine) — the
+REST client/server split lands with skypilot_tpu.server; the reference uses
+the same trick in tests (inline executor, tests/common_test_fixtures.py:56).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+def _not_ready(name: str):
+    raise NotImplementedError(
+        f'skypilot_tpu.{name} is not wired up yet in this build stage; '
+        'the execution engine lands next.')
+
+
+def launch(task, cluster_name: Optional[str] = None, **kwargs) -> Any:
+    from skypilot_tpu import execution
+    return execution.launch(task, cluster_name=cluster_name, **kwargs)
+
+
+def exec(task, cluster_name: str, **kwargs) -> Any:  # pylint: disable=redefined-builtin
+    from skypilot_tpu import execution
+    return execution.exec(task, cluster_name=cluster_name, **kwargs)
+
+
+def status(cluster_names: Optional[List[str]] = None, **kwargs) -> Any:
+    from skypilot_tpu import core
+    return core.status(cluster_names=cluster_names, **kwargs)
+
+
+def start(cluster_name: str, **kwargs) -> Any:
+    from skypilot_tpu import core
+    return core.start(cluster_name, **kwargs)
+
+
+def stop(cluster_name: str, **kwargs) -> Any:
+    from skypilot_tpu import core
+    return core.stop(cluster_name, **kwargs)
+
+
+def down(cluster_name: str, **kwargs) -> Any:
+    from skypilot_tpu import core
+    return core.down(cluster_name, **kwargs)
+
+
+def autostop(cluster_name: str, idle_minutes: int, down: bool = False) -> Any:
+    from skypilot_tpu import core
+    return core.autostop(cluster_name, idle_minutes, down=down)
+
+
+def queue(cluster_name: str, **kwargs) -> Any:
+    from skypilot_tpu import core
+    return core.queue(cluster_name, **kwargs)
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None, **kwargs) -> Any:
+    from skypilot_tpu import core
+    return core.cancel(cluster_name, job_ids=job_ids, **kwargs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None, **kwargs) -> Any:
+    from skypilot_tpu import core
+    return core.tail_logs(cluster_name, job_id=job_id, **kwargs)
+
+
+def optimize(dag, **kwargs) -> Any:
+    from skypilot_tpu import optimizer
+    return optimizer.Optimizer.optimize(dag, **kwargs)

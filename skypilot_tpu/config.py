@@ -1,0 +1,153 @@
+"""Layered YAML configuration.
+
+Semantics mirror sky/skypilot_config.py:1-50: values merge, later layers win:
+
+  1. framework defaults (in code)
+  2. user config        ~/.skypilot_tpu/config.yaml  (or $SKYTPU_CONFIG)
+  3. project config     ./.skytpu.yaml
+  4. task-YAML ``config:`` overrides (allow-listed keys)
+  5. ``override_config`` context (thread-safe, for tests/server requests)
+
+Access is by dotted nested key: ``config.get_nested(('gcp', 'project_id'))``.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+
+USER_CONFIG_PATH = '~/.skypilot_tpu/config.yaml'
+PROJECT_CONFIG_PATH = '.skytpu.yaml'
+ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
+
+# Keys a task YAML `config:` section may override (mirrors the reference's
+# allow-list idea in sky/skypilot_config.py).
+OVERRIDEABLE_CONFIG_KEYS: Tuple[Tuple[str, ...], ...] = (
+    ('gcp',),
+    ('jobs',),
+    ('serve',),
+    ('provision',),
+    ('logs',),
+)
+
+_DEFAULTS: Dict[str, Any] = {
+    'gcp': {
+        'project_id': None,
+        'runtime_version': None,   # None → catalog default per generation
+        'reservation': None,
+        'service_account': 'default',
+    },
+    'provision': {
+        'ssh_timeout': 600,
+        'max_retries_per_zone': 1,
+        'locked_clouds': [],
+    },
+    'jobs': {
+        'controller': {'resources': {'cpus': '4+'}},
+        'max_parallel_launches': 4,
+    },
+    'serve': {'controller': {'resources': {'cpus': '4+'}}},
+    'logs': {'store': None},
+    'api_server': {'endpoint': None},
+    'usage': {'disabled': True},
+}
+
+_local = threading.local()
+_global_config: Optional[Dict[str, Any]] = None
+_global_lock = threading.Lock()
+
+
+def _merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _load_layers() -> Dict[str, Any]:
+    config = copy.deepcopy(_DEFAULTS)
+    user_path = os.environ.get(ENV_VAR_CONFIG,
+                               os.path.expanduser(USER_CONFIG_PATH))
+    for path in (user_path, PROJECT_CONFIG_PATH):
+        if os.path.exists(path):
+            try:
+                layer = common_utils.read_yaml(path)
+            except Exception as e:  # pylint: disable=broad-except
+                raise exceptions.InvalidSkyPilotConfigError(
+                    f'Failed to parse config {path}: {e}') from e
+            if not isinstance(layer, dict):
+                raise exceptions.InvalidSkyPilotConfigError(
+                    f'Config {path} must be a YAML mapping.')
+            config = _merge(config, layer)
+    return config
+
+
+def _get_config() -> Dict[str, Any]:
+    override = getattr(_local, 'override', None)
+    global _global_config
+    with _global_lock:
+        if _global_config is None:
+            _global_config = _load_layers()
+        base = _global_config
+    if override:
+        return _merge(base, override)
+    return base
+
+
+def reload_config() -> None:
+    """Drop the cache (tests / config edits)."""
+    global _global_config
+    with _global_lock:
+        _global_config = None
+
+
+def get_nested(keys: Iterable[str], default_value: Any = None) -> Any:
+    cur: Any = _get_config()
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default_value
+        cur = cur[k]
+    return cur
+
+
+def set_nested(keys: Iterable[str], value: Any) -> None:
+    """Set in the in-memory global config (not persisted)."""
+    global _global_config
+    with _global_lock:
+        if _global_config is None:
+            _global_config = _load_layers()
+        cur = _global_config
+        keys = list(keys)
+        for k in keys[:-1]:
+            cur = cur.setdefault(k, {})
+        cur[keys[-1]] = value
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_get_config())
+
+
+@contextlib.contextmanager
+def override_config(override: Optional[Dict[str, Any]]):
+    """Thread-local config override (mirrors ConfigContext
+    sky/skypilot_config.py:138)."""
+    if override:
+        for key in override:
+            if not any(key == allowed[0] for allowed in OVERRIDEABLE_CONFIG_KEYS):
+                raise exceptions.InvalidSkyPilotConfigError(
+                    f'Config key {key!r} is not overridable from a task. '
+                    f'Allowed: {sorted(set(k[0] for k in OVERRIDEABLE_CONFIG_KEYS))}')
+    prev = getattr(_local, 'override', None)
+    _local.override = _merge(prev or {}, override or {})
+    try:
+        yield
+    finally:
+        _local.override = prev

@@ -1,0 +1,102 @@
+"""Task DAG (pipelines).
+
+Reference parity: sky/dag.py:11 (networkx DiGraph of Tasks, `is_chain` :58,
+thread-local "current dag" used by `with Dag():` blocks).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+from skypilot_tpu import exceptions
+
+_local = threading.local()
+
+
+def get_current_dag() -> Optional['Dag']:
+    stack = getattr(_local, 'stack', None)
+    return stack[-1] if stack else None
+
+
+class Dag:
+    """A DAG of Tasks.  Edges mean data/ordering dependency."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.graph = nx.DiGraph()
+        self._task_order: List = []
+
+    # -- construction ------------------------------------------------------
+    def add(self, task) -> None:
+        if task not in self.graph:
+            self.graph.add_node(task)
+            self._task_order.append(task)
+
+    def add_edge(self, op1, op2) -> None:
+        self.add(op1)
+        self.add(op2)
+        self.graph.add_edge(op1, op2)
+        if not nx.is_directed_acyclic_graph(self.graph):
+            self.graph.remove_edge(op1, op2)
+            raise exceptions.InvalidTaskError('Edge would create a cycle.')
+
+    def remove(self, task) -> None:
+        self.graph.remove_node(task)
+        self._task_order.remove(task)
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def tasks(self) -> List:
+        return list(self._task_order)
+
+    def __len__(self) -> int:
+        return len(self._task_order)
+
+    def is_chain(self) -> bool:
+        """True iff the DAG is a linear pipeline (mirrors sky/dag.py:58)."""
+        n = len(self.graph)
+        if n < 2:
+            return True
+        if self.graph.number_of_edges() != n - 1:
+            return False
+        return all(self.graph.out_degree(t) <= 1 and self.graph.in_degree(t) <= 1
+                   for t in self.graph)
+
+    def topological_order(self) -> List:
+        return list(nx.topological_sort(self.graph))
+
+    # -- context manager ---------------------------------------------------
+    def __enter__(self) -> 'Dag':
+        stack = getattr(_local, 'stack', None)
+        if stack is None:
+            stack = _local.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        _local.stack.pop()
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name}, tasks={[t.name for t in self.tasks]})'
+
+
+def load_chain_from_yaml(path: str) -> Dag:
+    """Load a multi-document YAML as a linear pipeline.  The first document
+    may be a header `name:`-only doc (mirrors sky/utils/dag_utils.py)."""
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.utils import common_utils
+    configs = common_utils.read_yaml_all(path)
+    dag = Dag()
+    if configs and set(configs[0].keys()) <= {'name'}:
+        dag.name = configs[0].get('name')
+        configs = configs[1:]
+    prev = None
+    for cfg in configs:
+        t = task_lib.Task.from_yaml_config(cfg)
+        dag.add(t)
+        if prev is not None:
+            dag.add_edge(prev, t)
+        prev = t
+    return dag

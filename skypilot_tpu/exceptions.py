@@ -1,0 +1,169 @@
+"""Typed exception hierarchy for skypilot_tpu.
+
+The failover machinery depends on these types: provisioning errors carry
+enough structure (region/zone, retriability) for the retrying provisioner to
+build blocklists and keep trying elsewhere.
+
+Reference parity: mirrors the error taxonomy of sky/exceptions.py (688 LoC) in
+the reference repo; only the TPU-relevant subset is kept and names follow the
+reference so recipes/tests translate 1:1.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidSkyPilotConfigError(SkyTpuError):
+    """Raised when a layered config file is malformed."""
+
+
+class InvalidTaskError(SkyTpuError):
+    """Raised when a task YAML / Task object is invalid."""
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources cannot be satisfied by the target cluster."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No cloud/region/zone can currently satisfy the request.
+
+    Drives failover: the retrying provisioner raises this per-zone and the
+    optimizer-level loop collects ``failover_history`` (mirrors
+    sky/exceptions.py `ResourcesUnavailableError.failover_history`).
+    """
+
+    def __init__(self, message: str,
+                 no_failover: bool = False,
+                 failover_history: Optional[List[Exception]] = None) -> None:
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ProvisionerError(SkyTpuError):
+    """Low-level provisioning failure for one (region, zone) attempt."""
+
+    def __init__(self, message: str, *,
+                 region: Optional[str] = None,
+                 zone: Optional[str] = None,
+                 errors: Optional[List[Dict[str, Any]]] = None,
+                 retriable: bool = True) -> None:
+        super().__init__(message)
+        self.region = region
+        self.zone = zone
+        self.errors = errors or []
+        self.retriable = retriable
+
+
+class QuotaExceededError(ProvisionerError):
+    """Cloud quota exhausted in a zone; blocklist the region."""
+
+
+class CapacityError(ProvisionerError):
+    """Stockout: no TPU capacity in the zone right now."""
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None) -> None:
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in local state."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster belongs to a different cloud identity."""
+
+
+class NotSupportedError(SkyTpuError):
+    """Feature intentionally unsupported (e.g. stopping a TPU pod slice)."""
+
+
+class CommandError(SkyTpuError):
+    """A remote/local command exited non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: Optional[str] = None) -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        cmd = command if len(command) < 100 else command[:100] + '...'
+        super().__init__(
+            f'Command {cmd} failed with return code {returncode}.\n{error_msg}')
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in the on-cluster job queue."""
+
+
+class JobExitCode(enum.IntEnum):
+    """Exit codes surfaced by job wait/tail (mirrors sky/exceptions.py)."""
+    SUCCEEDED = 0
+    FAILED = 100
+    NOT_FINISHED = 101
+    NOT_FOUND = 102
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job recovery gave up after max restarts."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Managed job is in an unexpected state."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service torn down by user during an operation."""
+
+
+class StorageError(SkyTpuError):
+    """Bucket create/sync/mount failure."""
+
+
+class StorageSpecError(StorageError):
+    """Invalid storage spec in task YAML."""
+
+
+class FetchClusterInfoError(SkyTpuError):
+    """Could not query instances of a cluster from the cloud."""
+
+    class Reason(enum.Enum):
+        HEAD = 'HEAD'
+        WORKER = 'WORKER'
+
+    def __init__(self, reason: 'FetchClusterInfoError.Reason') -> None:
+        super().__init__(f'Failed to fetch {reason.value} node info.')
+        self.reason = reason
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled / credentials missing."""
+
+
+class ApiServerError(SkyTpuError):
+    """Client-side error talking to the API server."""
+
+
+class RequestCancelled(SkyTpuError):
+    """An async API request was cancelled."""
+
+
+class InvalidServiceSpecError(SkyTpuError):
+    """Serve service spec invalid."""

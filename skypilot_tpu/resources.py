@@ -1,0 +1,432 @@
+"""Immutable resource specification.
+
+Reference parity: class Resources in sky/resources.py:119 (2,458 LoC).  The
+TPU-native redesign keeps the user-facing semantics — accelerator strings,
+``accelerator_args`` (runtime_version etc., docstring sky/resources.py:204-207),
+``infra://cloud/region/zone`` strings, spot flag, any_of/ordered candidate
+sets — but resolves every accelerator through :class:`TpuSpec`, and serializes
+as a versioned plain dict (JSON/YAML) instead of versioned pickle.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import tpu_utils
+
+_VERSION = 1
+
+
+def _parse_accelerators(
+    accelerators: Union[None, str, Dict[str, int]]
+) -> Optional[Tuple[str, int]]:
+    """Normalize to (canonical_name, count-of-slices)."""
+    if accelerators is None:
+        return None
+    if isinstance(accelerators, (list, tuple)):
+        raise exceptions.InvalidTaskError(
+            'A list of accelerators is only valid in a task YAML resources: '
+            'section (it expands to any_of candidates); Resources() takes one.')
+    if isinstance(accelerators, dict):
+        if len(accelerators) != 1:
+            raise exceptions.InvalidTaskError(
+                f'accelerators dict must have exactly one entry, got '
+                f'{accelerators}')
+        name, cnt = next(iter(accelerators.items()))
+        cnt = int(cnt)
+    else:
+        name, _, cnt_s = accelerators.partition(':')
+        cnt = int(cnt_s) if cnt_s else 1
+    spec = tpu_utils.parse_tpu_accelerator(name)
+    if spec is not None:
+        return (spec.name, cnt)
+    # Non-TPU accelerators are kept verbatim so the abstraction stays open
+    # to other providers (mirrors the reference's generic accelerator dict).
+    return (name.upper(), cnt)
+
+
+def _parse_cpus_or_mem(value: Union[None, str, int, float]) -> Optional[str]:
+    """Normalize '4', 4, '4+' → canonical string form."""
+    if value is None:
+        return None
+    s = str(value).strip()
+    plus = s.endswith('+')
+    num_s = s[:-1] if plus else s
+    try:
+        num = float(num_s)
+    except ValueError as e:
+        raise exceptions.InvalidTaskError(f'Invalid cpus/memory: {value!r}') from e
+    if num <= 0:
+        raise exceptions.InvalidTaskError(f'cpus/memory must be positive: {value!r}')
+    num_str = str(int(num)) if num == int(num) else str(num)
+    return num_str + ('+' if plus else '')
+
+
+def parse_infra(infra: Optional[str]) -> Tuple[Optional[str], Optional[str], Optional[str]]:
+    """Parse 'gcp/us-central2/us-central2-b' or 'gcp' → (cloud, region, zone).
+
+    Mirrors sky/utils/infra_utils.py.  '*' wildcards map to None.
+    """
+    if infra is None:
+        return (None, None, None)
+    parts = [p if p not in ('*', '') else None for p in infra.strip('/').split('/')]
+    if len(parts) > 3:
+        raise exceptions.InvalidTaskError(
+            f'Invalid infra {infra!r}: expected cloud[/region[/zone]]')
+    parts += [None] * (3 - len(parts))
+    cloud = parts[0].lower() if parts[0] else None
+    return (cloud, parts[1], parts[2])
+
+
+@dataclasses.dataclass(frozen=True)
+class AutostopConfig:
+    enabled: bool = False
+    idle_minutes: int = 5
+    down: bool = False
+
+    @classmethod
+    def from_yaml_config(cls, cfg: Union[None, bool, int, str, Dict[str, Any]]
+                         ) -> Optional['AutostopConfig']:
+        if cfg is None:
+            return None
+        if isinstance(cfg, bool):
+            return cls(enabled=cfg)
+        if isinstance(cfg, (int, str)):
+            return cls(enabled=True, idle_minutes=int(cfg))
+        return cls(enabled=bool(cfg.get('enabled', True)),
+                   idle_minutes=int(cfg.get('idle_minutes', 5)),
+                   down=bool(cfg.get('down', False)))
+
+
+class Resources:
+    """An (immutable) resource requirement or concrete launchable resource.
+
+    A Resources either expresses user intent (``accelerators='tpu-v5e-16'``,
+    any cloud/region) or — after the optimizer fills in cloud, region,
+    instance_type — a concrete launchable offering.
+    """
+
+    def __init__(self,
+                 infra: Optional[str] = None,
+                 cloud: Optional[str] = None,
+                 region: Optional[str] = None,
+                 zone: Optional[str] = None,
+                 accelerators: Union[None, str, Dict[str, int]] = None,
+                 accelerator_args: Optional[Dict[str, Any]] = None,
+                 cpus: Union[None, str, int, float] = None,
+                 memory: Union[None, str, int, float] = None,
+                 instance_type: Optional[str] = None,
+                 use_spot: bool = False,
+                 disk_size: int = 256,
+                 disk_tier: Optional[str] = None,
+                 ports: Union[None, int, str, List[Union[int, str]]] = None,
+                 image_id: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 autostop: Union[None, bool, int, Dict[str, Any]] = None,
+                 job_recovery: Union[None, str, Dict[str, Any]] = None,
+                 # Internal: filled by the optimizer.
+                 _price_per_hour: Optional[float] = None):
+        if infra is not None:
+            icloud, iregion, izone = parse_infra(infra)
+            cloud = cloud or icloud
+            region = region or iregion
+            zone = zone or izone
+        self._cloud = cloud.lower() if cloud else None
+        self._region = region
+        self._zone = zone
+        self._accelerators = _parse_accelerators(accelerators)
+        self._accelerator_args = dict(accelerator_args or {})
+        self._cpus = _parse_cpus_or_mem(cpus)
+        self._memory = _parse_cpus_or_mem(memory)
+        self._instance_type = instance_type
+        self._use_spot = bool(use_spot)
+        self._disk_size = int(disk_size)
+        self._disk_tier = disk_tier
+        self._ports = self._parse_ports(ports)
+        self._image_id = image_id
+        self._labels = dict(labels or {})
+        self._autostop = AutostopConfig.from_yaml_config(autostop)
+        self._job_recovery = self._parse_job_recovery(job_recovery)
+        self._price_per_hour = _price_per_hour
+        self._validate()
+
+    @staticmethod
+    def _parse_ports(ports) -> Tuple[str, ...]:
+        if ports is None:
+            return ()
+        if isinstance(ports, (int, str)):
+            ports = [ports]
+        return tuple(str(p) for p in ports)
+
+    @staticmethod
+    def _parse_job_recovery(jr) -> Optional[Dict[str, Any]]:
+        if jr is None:
+            return None
+        if isinstance(jr, str):
+            return {'strategy': jr.lower(), 'max_restarts_on_errors': 0}
+        out = dict(jr)
+        if 'strategy' in out and isinstance(out['strategy'], str):
+            out['strategy'] = out['strategy'].lower()
+        return out
+
+    def _validate(self) -> None:
+        spec = self.tpu_spec
+        if spec is not None:
+            args = self._accelerator_args
+            unknown = set(args) - {'runtime_version', 'topology', 'num_slices',
+                                   'spare_hosts'}
+            if unknown:
+                raise exceptions.InvalidTaskError(
+                    f'Unknown accelerator_args {sorted(unknown)} for TPU.')
+        if self._disk_size < 10:
+            raise exceptions.InvalidTaskError('disk_size must be >= 10 GB.')
+
+    # ---- read-only views -------------------------------------------------
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        if self._accelerators is None:
+            return None
+        return {self._accelerators[0]: self._accelerators[1]}
+
+    @property
+    def accelerator_name(self) -> Optional[str]:
+        return self._accelerators[0] if self._accelerators else None
+
+    @property
+    def tpu_spec(self) -> Optional[tpu_utils.TpuSpec]:
+        if self._accelerators is None:
+            return None
+        return tpu_utils.parse_tpu_accelerator(self._accelerators[0],
+                                               validate=False)
+
+    @property
+    def num_slices(self) -> int:
+        """Multislice: how many identical pod slices to gang together."""
+        return int(self._accelerator_args.get('num_slices', 1))
+
+    @property
+    def accelerator_args(self) -> Dict[str, Any]:
+        return dict(self._accelerator_args)
+
+    @property
+    def runtime_version(self) -> Optional[str]:
+        rv = self._accelerator_args.get('runtime_version')
+        if rv:
+            return rv
+        spec = self.tpu_spec
+        return spec.default_runtime_version if spec else None
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Tuple[str, ...]:
+        return self._ports
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return dict(self._labels)
+
+    @property
+    def autostop(self) -> Optional[AutostopConfig]:
+        return self._autostop
+
+    @property
+    def job_recovery(self) -> Optional[Dict[str, Any]]:
+        return dict(self._job_recovery) if self._job_recovery else None
+
+    @property
+    def price_per_hour(self) -> Optional[float]:
+        return self._price_per_hour
+
+    @property
+    def is_launchable(self) -> bool:
+        """Concrete enough to hand to the provisioner."""
+        if self._cloud is None:
+            return False
+        if self.tpu_spec is not None:
+            return self._region is not None
+        return self._instance_type is not None and self._region is not None
+
+    # ---- manipulation ----------------------------------------------------
+    def copy(self, **override) -> 'Resources':
+        kwargs: Dict[str, Any] = dict(
+            cloud=self._cloud,
+            region=self._region,
+            zone=self._zone,
+            accelerators=(dict([self._accelerators])
+                          if self._accelerators else None),
+            accelerator_args=dict(self._accelerator_args),
+            cpus=self._cpus,
+            memory=self._memory,
+            instance_type=self._instance_type,
+            use_spot=self._use_spot,
+            disk_size=self._disk_size,
+            disk_tier=self._disk_tier,
+            ports=list(self._ports) or None,
+            image_id=self._image_id,
+            labels=dict(self._labels),
+            autostop=(dataclasses.asdict(self._autostop)
+                      if self._autostop else None),
+            job_recovery=self._job_recovery,
+            _price_per_hour=self._price_per_hour,
+        )
+        kwargs.update(override)
+        return Resources(**kwargs)
+
+    # ---- (de)serialization ----------------------------------------------
+    @classmethod
+    def from_yaml_config(
+            cls, config: Union[None, Dict[str, Any]]
+    ) -> List['Resources']:
+        """Parse a resources: section.  Returns candidate list (any_of/ordered
+        produce >1 entry; plain configs produce exactly one)."""
+        if not config:
+            return [Resources()]
+        config = dict(config)
+        any_of = config.pop('any_of', None)
+        ordered = config.pop('ordered', None)
+        if any_of is not None and ordered is not None:
+            raise exceptions.InvalidTaskError(
+                'Cannot specify both any_of and ordered resources.')
+        # A list of accelerator strings is sugar for any_of candidates
+        # (mirrors the reference's set-of-accelerators support).
+        accels = config.get('accelerators')
+        if isinstance(accels, (list, tuple)):
+            if any_of is not None or ordered is not None:
+                raise exceptions.InvalidTaskError(
+                    'Cannot combine an accelerators list with any_of/ordered.')
+            config.pop('accelerators')
+            any_of = [{'accelerators': a} for a in accels]
+        base_kwargs = cls._config_to_kwargs(config)
+        variants = any_of or ordered
+        if not variants:
+            return [Resources(**base_kwargs)]
+        out = []
+        for v in variants:
+            kwargs = dict(base_kwargs)
+            kwargs.update(cls._config_to_kwargs(v))
+            out.append(Resources(**kwargs))
+        return out
+
+    @staticmethod
+    def _config_to_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+        known = {'infra', 'cloud', 'region', 'zone', 'accelerators',
+                 'accelerator_args', 'cpus', 'memory', 'instance_type',
+                 'use_spot', 'disk_size', 'disk_tier', 'ports', 'image_id',
+                 'labels', 'autostop', 'job_recovery'}
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'Unknown resources keys: {sorted(unknown)}')
+        return {k: v for k, v in config.items() if v is not None}
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {'version': _VERSION}
+        if self._cloud:
+            infra = self._cloud
+            if self._region:
+                infra += f'/{self._region}'
+                if self._zone:
+                    infra += f'/{self._zone}'
+            cfg['infra'] = infra
+        if self._accelerators:
+            name, cnt = self._accelerators
+            cfg['accelerators'] = name if cnt == 1 else f'{name}:{cnt}'
+        for key, val in (('accelerator_args', self._accelerator_args or None),
+                         ('cpus', self._cpus), ('memory', self._memory),
+                         ('instance_type', self._instance_type),
+                         ('disk_tier', self._disk_tier),
+                         ('image_id', self._image_id),
+                         ('labels', self._labels or None),
+                         ('job_recovery', self._job_recovery)):
+            if val is not None:
+                cfg[key] = val
+        if self._use_spot:
+            cfg['use_spot'] = True
+        if self._disk_size != 256:
+            cfg['disk_size'] = self._disk_size
+        if self._ports:
+            cfg['ports'] = list(self._ports)
+        if self._autostop is not None and self._autostop.enabled:
+            cfg['autostop'] = dataclasses.asdict(self._autostop)
+        return cfg
+
+    @classmethod
+    def from_dict(cls, cfg: Dict[str, Any]) -> 'Resources':
+        cfg = dict(cfg)
+        cfg.pop('version', None)
+        candidates = cls.from_yaml_config(cfg)
+        return candidates[0]
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud:
+            loc = self._cloud
+            if self._region:
+                loc += f'/{self._region}'
+            if self._zone:
+                loc += f'/{self._zone}'
+            parts.append(loc)
+        if self._instance_type:
+            parts.append(self._instance_type)
+        if self._accelerators:
+            name, cnt = self._accelerators
+            parts.append(f'{name}' + (f':{cnt}' if cnt != 1 else ''))
+        if self.num_slices > 1:
+            parts.append(f'slices={self.num_slices}')
+        if self._cpus:
+            parts.append(f'cpus={self._cpus}')
+        if self._memory:
+            parts.append(f'mem={self._memory}')
+        if self._use_spot:
+            parts.append('[spot]')
+        if self._price_per_hour is not None:
+            parts.append(f'${self._price_per_hour:.2f}/hr')
+        return 'Resources(' + ', '.join(parts) + ')'
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        return hash(repr(sorted(self.to_yaml_config().items(), key=str)))

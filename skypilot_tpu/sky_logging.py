@@ -1,0 +1,41 @@
+"""Logging setup (mirrors sky/sky_logging.py: one formatter, env-tunable level)."""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+
+_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+_root = logging.getLogger('skypilot_tpu')
+_initialized = False
+
+
+def _init() -> None:
+    global _initialized
+    if _initialized:
+        return
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+    _root.addHandler(handler)
+    level = os.environ.get('SKYTPU_DEBUG', '')
+    _root.setLevel(logging.DEBUG if level == '1' else logging.INFO)
+    _root.propagate = False
+    _initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _init()
+    return logging.getLogger(name if name.startswith('skypilot_tpu')
+                             else f'skypilot_tpu.{name}')
+
+
+@contextlib.contextmanager
+def silent():
+    prev = _root.level
+    _root.setLevel(logging.ERROR)
+    try:
+        yield
+    finally:
+        _root.setLevel(prev)

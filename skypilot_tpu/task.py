@@ -1,0 +1,242 @@
+"""Declarative unit of work.
+
+Reference parity: class Task in sky/task.py:231 (1,812 LoC): name, setup/run
+commands, envs+secrets, num_nodes, resources candidates, workdir,
+file_mounts/storage_mounts, YAML round-trip (from_yaml_config sky/task.py:562,
+to_yaml_config :1665), and run-as-callable per-rank command generation
+(sky/task.py:448-486).
+
+TPU-native difference: ``num_nodes`` counts *slices* (a TPU pod slice is one
+logical node with ``TpuSpec.num_hosts`` ranked worker hosts — the backend
+expands to hosts exactly like the reference multiplies num_nodes ×
+num_ips_per_node at sky/backends/cloud_vm_ray_backend.py:6306).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import schemas
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*$')
+
+CommandOrGen = Union[None, str, Callable[[int, List[str]], Optional[str]]]
+
+
+class Task:
+    """A coarse-grained unit of work: setup + run on N nodes with resources.
+
+    ``run`` may be a shell string or a callable ``(node_rank, node_ips) ->
+    cmd`` generated per host at execution time.
+    """
+
+    def __init__(self,
+                 name: Optional[str] = None,
+                 *,
+                 setup: Optional[str] = None,
+                 run: CommandOrGen = None,
+                 envs: Optional[Dict[str, str]] = None,
+                 secrets: Optional[Dict[str, str]] = None,
+                 workdir: Optional[str] = None,
+                 num_nodes: int = 1,
+                 file_mounts: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self._envs = {k: str(v) if v is not None else '' for k, v in
+                      (envs or {}).items()}
+        self._secrets = dict(secrets or {})
+        self.workdir = workdir
+        self.num_nodes = int(num_nodes)
+        # target path -> local path | storage dict
+        self.file_mounts: Dict[str, Any] = dict(file_mounts or {})
+        self.storage_mounts: Dict[str, Any] = {}
+        self.service: Optional[Dict[str, Any]] = None
+        self._resources: List[resources_lib.Resources] = [
+            resources_lib.Resources()
+        ]
+        self._resources_ordered = False
+        self._validate()
+        # Auto-register into an enclosing `with Dag():` block.
+        from skypilot_tpu import dag as dag_lib
+        current = dag_lib.get_current_dag()
+        if current is not None:
+            current.add(self)
+
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_RE.match(self.name):
+            raise exceptions.InvalidTaskError(f'Invalid task name {self.name!r}')
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskError('num_nodes must be >= 1')
+        if self.run is not None and not (isinstance(self.run, str)
+                                         or callable(self.run)):
+            raise exceptions.InvalidTaskError(
+                'run must be a shell string or a callable (rank, ips) -> cmd')
+        if self.workdir is not None:
+            wd = os.path.expanduser(self.workdir)
+            if not os.path.isdir(wd):
+                raise exceptions.InvalidTaskError(
+                    f'workdir {self.workdir!r} is not a directory.')
+        for k in self._envs:
+            if not re.match(r'^[A-Za-z_][A-Za-z0-9_]*$', k):
+                raise exceptions.InvalidTaskError(f'Invalid env name {k!r}')
+        overlap = set(self._envs) & set(self._secrets)
+        if overlap:
+            raise exceptions.InvalidTaskError(
+                f'envs and secrets overlap: {sorted(overlap)}')
+
+    # ---- resources -------------------------------------------------------
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               List[resources_lib.Resources]],
+        ordered: bool = False,
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = [resources]
+        if not resources:
+            raise exceptions.InvalidTaskError('resources must be non-empty')
+        self._resources = list(resources)
+        self._resources_ordered = ordered
+        return self
+
+    @property
+    def resources(self) -> List[resources_lib.Resources]:
+        return list(self._resources)
+
+    @property
+    def resources_ordered(self) -> bool:
+        """True if candidates are a strict preference order (``ordered:``)."""
+        return self._resources_ordered
+
+    @property
+    def best_resources(self) -> resources_lib.Resources:
+        return self._resources[0]
+
+    # ---- envs ------------------------------------------------------------
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        for k, v in envs.items():
+            self._envs[k] = str(v)
+        self._validate()
+        return self
+
+    def update_secrets(self, secrets: Dict[str, str]) -> 'Task':
+        self._secrets.update(secrets)
+        self._validate()
+        return self
+
+    # ---- per-rank command generation ------------------------------------
+    def generate_run_command(self, node_rank: int,
+                             node_ips: List[str]) -> Optional[str]:
+        if self.run is None:
+            return None
+        if isinstance(self.run, str):
+            return self.run
+        cmd = self.run(node_rank, node_ips)
+        if cmd is not None and not isinstance(cmd, str):
+            raise exceptions.InvalidTaskError(
+                f'run callable must return str|None, got {type(cmd)}')
+        return cmd
+
+    # ---- YAML ------------------------------------------------------------
+    @classmethod
+    def from_yaml(cls, path: str) -> 'Task':
+        configs = common_utils.read_yaml_all(path)
+        if len(configs) != 1:
+            raise exceptions.InvalidTaskError(
+                f'{path} contains {len(configs)} documents; use '
+                'dag.load_chain_from_yaml for pipelines.')
+        return cls.from_yaml_config(configs[0])
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Task':
+        schemas.validate_task_config(config)
+        config = dict(config)
+        # Expand ${VAR} in string fields using envs (reference does env
+        # substitution for task YAMLs).
+        envs = {k: str(v) if v is not None else ''
+                for k, v in (config.get('envs') or {}).items()}
+        for key in ('setup', 'run', 'workdir'):
+            val = config.get(key)
+            if isinstance(val, str):
+                for ek, ev in envs.items():
+                    val = val.replace('${' + ek + '}', ev)
+                config[key] = val
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            secrets=config.get('secrets'),
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes', 1),
+            file_mounts=config.get('file_mounts'),
+        )
+        res_config = config.get('resources')
+        override_config = config.get('config')
+        if override_config:
+            # Stashed for execution-time config.override_config(...).
+            task.config_overrides = override_config
+        task.set_resources(
+            resources_lib.Resources.from_yaml_config(res_config),
+            ordered=bool(res_config and 'ordered' in res_config))
+        if 'service' in config:
+            schemas.validate_service_config(config['service'])
+            task.service = config['service']
+        return task
+
+    config_overrides: Optional[Dict[str, Any]] = None
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.name:
+            cfg['name'] = self.name
+        if self.num_nodes != 1:
+            cfg['num_nodes'] = self.num_nodes
+        if self.workdir:
+            cfg['workdir'] = self.workdir
+        res = [r.to_yaml_config() for r in self._resources]
+        for r in res:
+            r.pop('version', None)
+        if len(res) == 1:
+            cfg['resources'] = res[0]
+        else:
+            key = 'ordered' if self._resources_ordered else 'any_of'
+            cfg['resources'] = {key: res}
+        if self.setup:
+            cfg['setup'] = self.setup
+        if isinstance(self.run, str):
+            cfg['run'] = self.run
+        if self._envs:
+            cfg['envs'] = dict(self._envs)
+        if self._secrets:
+            cfg['secrets'] = dict(self._secrets)
+        if self.file_mounts:
+            cfg['file_mounts'] = dict(self.file_mounts)
+        if self.service:
+            cfg['service'] = self.service
+        if self.config_overrides:
+            cfg['config'] = self.config_overrides
+        return cfg
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        r = self._resources[0] if len(self._resources) == 1 else self._resources
+        return f'Task({name}, nodes={self.num_nodes}, {r})'

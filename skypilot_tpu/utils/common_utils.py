@@ -1,0 +1,127 @@
+"""Small shared helpers: ids, yaml IO, name validation, retries."""
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+_CLUSTER_NAME_RE = re.compile(r'^[a-z]([-a-z0-9]{0,61}[a-z0-9])?$')
+
+
+def get_user_hash() -> str:
+    """Stable per-user id (mirrors sky/utils/common_utils.get_user_hash)."""
+    # Expand at call time so HOME overrides (tests, sudo) are honored.
+    path = os.path.expanduser('~/.skypilot_tpu/user_hash')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            h = f.read().strip()
+            if h:
+                return h
+    h = hashlib.md5(uuid.uuid4().bytes).hexdigest()[:8]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(h)
+    return h
+
+
+def make_run_id() -> str:
+    return time.strftime('%Y%m%d-%H%M%S') + '-' + uuid.uuid4().hex[:6]
+
+
+def check_cluster_name_is_valid(name: str) -> None:
+    from skypilot_tpu import exceptions
+    if not name or not _CLUSTER_NAME_RE.match(name):
+        raise exceptions.InvalidTaskError(
+            f'Cluster name {name!r} is invalid: must match '
+            f'{_CLUSTER_NAME_RE.pattern} (lowercase RFC1035, GCP requirement).')
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return yaml.safe_load(f) or {}
+
+
+def read_yaml_all(path: str) -> List[Dict[str, Any]]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return [d for d in yaml.safe_load_all(f) if d is not None]
+
+
+def dump_yaml(path: str, config: Union[Dict[str, Any], List[Dict[str, Any]]]) -> None:
+    os.makedirs(os.path.dirname(os.path.expanduser(path)) or '.', exist_ok=True)
+    with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+        if isinstance(config, list):
+            yaml.safe_dump_all(config, f, default_flow_style=False, sort_keys=False)
+        else:
+            yaml.safe_dump(config, f, default_flow_style=False, sort_keys=False)
+
+
+def dump_yaml_str(config: Dict[str, Any]) -> str:
+    return yaml.safe_dump(config, default_flow_style=False, sort_keys=False)
+
+
+def find_free_port(start: int = 10000) -> int:
+    for port in range(start, start + 1000):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            try:
+                s.bind(('', port))
+                return port
+            except OSError:
+                continue
+    raise RuntimeError('No free port found.')
+
+
+def retry(max_retries: int = 3, initial_backoff: float = 1.0,
+          exceptions_to_retry=(Exception,)) -> Callable:
+    """Exponential-backoff retry decorator."""
+    def decorator(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            backoff = initial_backoff
+            for attempt in range(max_retries):
+                try:
+                    return fn(*args, **kwargs)
+                except exceptions_to_retry:
+                    if attempt == max_retries - 1:
+                        raise
+                    time.sleep(backoff)
+                    backoff *= 2
+        return wrapper
+    return decorator
+
+
+def format_float(x: Optional[float], precision: int = 2) -> str:
+    if x is None:
+        return '-'
+    if x >= 100 or x == int(x):
+        return f'{x:.0f}'
+    return f'{x:.{precision}f}'
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    return s[:max_length - 3] + '...'
+
+
+def class_fullname(cls: type) -> str:
+    return f'{cls.__module__}.{cls.__name__}'
+
+
+def readable_time_duration(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return '-'
+    seconds = int(seconds)
+    if seconds < 60:
+        return f'{seconds}s'
+    if seconds < 3600:
+        return f'{seconds // 60}m {seconds % 60}s'
+    if seconds < 86400:
+        return f'{seconds // 3600}h {(seconds % 3600) // 60}m'
+    return f'{seconds // 86400}d {(seconds % 86400) // 3600}h'

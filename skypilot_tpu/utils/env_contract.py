@@ -1,0 +1,101 @@
+"""The distributed environment contract injected into every job rank.
+
+This replaces the reference's NCCL/torchrun contract
+(sky/backends/cloud_vm_ray_backend.py:681-753 injects SKYPILOT_NODE_IPS /
+SKYPILOT_NUM_NODES / SKYPILOT_NODE_RANK / SKYPILOT_NUM_GPUS_PER_NODE, from
+which recipes derive MASTER_ADDR etc.) with a JAX/TPU-native contract:
+
+- ``SKYPILOT_NODE_RANK`` / ``SKYPILOT_NUM_NODES`` / ``SKYPILOT_NODE_IPS`` are
+  kept verbatim for recipe compatibility.
+- ``SKYTPU_COORDINATOR_ADDRESS`` is the head host ``ip:port`` that
+  ``jax.distributed.initialize`` uses over DCN.
+- ``SKYTPU_PROCESS_ID`` / ``SKYTPU_NUM_PROCESSES`` name the JAX process grid
+  (one process per TPU host).
+- On a TPU pod slice, ICI needs no configuration: the slice is atomic and
+  libtpu discovers the mesh.  Multislice jobs additionally get
+  ``MEGASCALE_COORDINATOR_ADDRESS`` / ``MEGASCALE_NUM_SLICES`` /
+  ``MEGASCALE_SLICE_ID`` (the DCN transport is configured by libtpu from
+  these, mirroring how the reference's template exports TPU_NAME at
+  sky/templates/gcp-ray.yml.j2:271-276).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+# Kept for recipe compatibility with the reference (sky/skylet/constants.py:363-366).
+NODE_IPS = 'SKYPILOT_NODE_IPS'
+NUM_NODES = 'SKYPILOT_NUM_NODES'
+NODE_RANK = 'SKYPILOT_NODE_RANK'
+NUM_CHIPS_PER_NODE = 'SKYPILOT_NUM_CHIPS_PER_NODE'
+TASK_ID = 'SKYPILOT_TASK_ID'
+CLUSTER_INFO = 'SKYPILOT_CLUSTER_INFO'
+
+# TPU-native additions.
+COORDINATOR_ADDRESS = 'SKYTPU_COORDINATOR_ADDRESS'
+PROCESS_ID = 'SKYTPU_PROCESS_ID'
+NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'
+COORDINATOR_PORT_DEFAULT = 8476
+
+# Multislice (DCN) contract consumed by libtpu.
+MEGASCALE_COORDINATOR = 'MEGASCALE_COORDINATOR_ADDRESS'
+MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
+MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+
+
+def make_env_vars(node_rank: int,
+                  node_ips: List[str],
+                  num_chips_per_node: int,
+                  task_id: str = '',
+                  coordinator_port: int = COORDINATOR_PORT_DEFAULT,
+                  num_slices: int = 1,
+                  slice_id: int = 0) -> Dict[str, str]:
+    """Build the env dict for one rank of a gang-scheduled job.
+
+    For a multislice job, ``node_ips`` must be the GLOBAL host list across
+    all slices, ordered slice-major (slice 0's hosts first), and
+    ``node_rank`` the global rank — every slice must agree on the single
+    coordinator (slice 0's head) or DCN init hangs.  ``slice_id`` is then
+    derivable but passed explicitly for clarity.
+    """
+    if num_slices > 1 and len(node_ips) % num_slices != 0:
+        raise ValueError(
+            f'{len(node_ips)} hosts not divisible by {num_slices} slices; '
+            'node_ips must be the global slice-major host list.')
+    head_ip = node_ips[0]  # global head == slice 0's head
+    envs = {
+        NODE_IPS: '\n'.join(node_ips),
+        NUM_NODES: str(len(node_ips)),
+        NODE_RANK: str(node_rank),
+        NUM_CHIPS_PER_NODE: str(num_chips_per_node),
+        COORDINATOR_ADDRESS: f'{head_ip}:{coordinator_port}',
+        PROCESS_ID: str(node_rank),
+        NUM_PROCESSES: str(len(node_ips)),
+    }
+    if task_id:
+        envs[TASK_ID] = task_id
+    if num_slices > 1:
+        envs[MEGASCALE_COORDINATOR] = f'{head_ip}:{coordinator_port + 1}'
+        envs[MEGASCALE_NUM_SLICES] = str(num_slices)
+        envs[MEGASCALE_SLICE_ID] = str(slice_id)
+    return envs
+
+
+def initialize_from_env(timeout_s: Optional[int] = None) -> None:
+    """Call jax.distributed.initialize from the injected contract.
+
+    Run this at the top of any multi-host recipe.  No-op for single-host
+    jobs (the contract is still present, with one node).
+    """
+    num_processes = int(os.environ.get(NUM_PROCESSES, '1'))
+    if num_processes <= 1:
+        return
+    import jax  # deferred: keep orchestrator imports light
+    kwargs = {}
+    if timeout_s is not None:
+        kwargs['initialization_timeout'] = timeout_s
+    jax.distributed.initialize(
+        coordinator_address=os.environ[COORDINATOR_ADDRESS],
+        num_processes=num_processes,
+        process_id=int(os.environ[PROCESS_ID]),
+        **kwargs)

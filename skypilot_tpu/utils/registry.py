@@ -1,0 +1,44 @@
+"""Name → class registries (mirrors sky/utils/registry.py CLOUD_REGISTRY)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+
+    def __init__(self, registry_name: str) -> None:
+        self._name = registry_name
+        self._registry: Dict[str, Type[T]] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, aliases: Optional[list] = None) -> Callable[[Type[T]], Type[T]]:
+        def decorator(cls: Type[T]) -> Type[T]:
+            name = cls.__name__.lower()
+            self._registry[name] = cls
+            for alias in aliases or []:
+                self._aliases[alias.lower()] = name
+            return cls
+        return decorator
+
+    def from_str(self, name: Optional[str]) -> Optional[T]:
+        if name is None:
+            return None
+        key = name.lower()
+        key = self._aliases.get(key, key)
+        if key not in self._registry:
+            raise ValueError(
+                f'Unknown {self._name} {name!r}. '
+                f'Valid: {sorted(self._registry)}')
+        return self._registry[key]()
+
+    def keys(self):
+        return self._registry.keys()
+
+    def values(self):
+        return [cls() for cls in self._registry.values()]
+
+
+CLOUD_REGISTRY: Registry = Registry('cloud')
+JOBS_RECOVERY_STRATEGY_REGISTRY: Registry = Registry('jobs recovery strategy')

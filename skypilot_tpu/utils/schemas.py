@@ -1,0 +1,158 @@
+"""JSON-schema validation for task YAML (mirrors sky/utils/schemas.py)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+
+_RESOURCES_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'infra': {'type': 'string'},
+        'cloud': {'type': 'string'},
+        'region': {'type': 'string'},
+        'zone': {'type': 'string'},
+        'accelerators': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'object', 'additionalProperties': {'type': 'number'}},
+                {'type': 'array', 'items': {'type': 'string'}},
+                {'type': 'null'},
+            ]
+        },
+        'accelerator_args': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'runtime_version': {'type': 'string'},
+                'topology': {'type': 'string'},
+                'num_slices': {'type': 'integer', 'minimum': 1},
+                'spare_hosts': {'type': 'integer', 'minimum': 0},
+            },
+        },
+        'cpus': {'anyOf': [{'type': 'string'}, {'type': 'number'}, {'type': 'null'}]},
+        'memory': {'anyOf': [{'type': 'string'}, {'type': 'number'}, {'type': 'null'}]},
+        'instance_type': {'anyOf': [{'type': 'string'}, {'type': 'null'}]},
+        'use_spot': {'type': 'boolean'},
+        'disk_size': {'type': 'integer'},
+        'disk_tier': {'enum': ['low', 'medium', 'high', 'ultra', 'best']},
+        'ports': {
+            'anyOf': [
+                {'type': 'integer'}, {'type': 'string'},
+                {'type': 'array', 'items': {'anyOf': [{'type': 'integer'}, {'type': 'string'}]}},
+                {'type': 'null'},
+            ]
+        },
+        'image_id': {'anyOf': [{'type': 'string'}, {'type': 'null'}]},
+        'labels': {'type': 'object', 'additionalProperties': {'type': 'string'}},
+        'autostop': {
+            'anyOf': [{'type': 'boolean'}, {'type': 'integer'}, {'type': 'string'},
+                      {'type': 'object'}]
+        },
+        'job_recovery': {
+            'anyOf': [{'type': 'string'}, {'type': 'null'},
+                      {'type': 'object',
+                       'additionalProperties': False,
+                       'properties': {
+                           'strategy': {'anyOf': [{'type': 'string'}, {'type': 'null'}]},
+                           'max_restarts_on_errors': {'type': 'integer', 'minimum': 0},
+                       }}]
+        },
+        'any_of': {'type': 'array', 'items': {'type': 'object'}},
+        'ordered': {'type': 'array', 'items': {'type': 'object'}},
+    },
+}
+
+_STORAGE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'source': {'anyOf': [{'type': 'string'},
+                             {'type': 'array', 'items': {'type': 'string'}}]},
+        'store': {'enum': ['gcs', 's3']},
+        'persistent': {'type': 'boolean'},
+        'mode': {'enum': ['MOUNT', 'COPY', 'MOUNT_CACHED']},
+    },
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'workdir': {'anyOf': [{'type': 'string'}, {'type': 'null'}]},
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'resources': _RESOURCES_SCHEMA,
+        'setup': {'anyOf': [{'type': 'string'}, {'type': 'null'}]},
+        'run': {'anyOf': [{'type': 'string'}, {'type': 'null'}]},
+        'envs': {'type': 'object',
+                 'additionalProperties': {
+                     'anyOf': [{'type': 'string'}, {'type': 'number'},
+                               {'type': 'null'}]}},
+        'secrets': {'type': 'object',
+                    'additionalProperties': {
+                        'anyOf': [{'type': 'string'}, {'type': 'null'}]}},
+        'file_mounts': {'type': 'object',
+                        'additionalProperties': {
+                            'anyOf': [{'type': 'string'}, _STORAGE_SCHEMA]}},
+        'config': {'type': 'object'},
+        'service': {'type': 'object'},
+    },
+}
+
+SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'object',
+                 'additionalProperties': False,
+                 'properties': {
+                     'path': {'type': 'string'},
+                     'initial_delay_seconds': {'type': 'number'},
+                     'timeout_seconds': {'type': 'number'},
+                     'post_data': {'anyOf': [{'type': 'string'}, {'type': 'object'}]},
+                 }},
+            ]
+        },
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': 'integer', 'minimum': 0},
+                'target_qps_per_replica': {'type': 'number'},
+                'upscale_delay_seconds': {'type': 'number'},
+                'downscale_delay_seconds': {'type': 'number'},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+                'base_ondemand_fallback_replicas': {'type': 'integer'},
+            },
+        },
+        'replicas': {'type': 'integer', 'minimum': 1},
+        'load_balancing_policy': {'type': 'string'},
+    },
+    'required': ['readiness_probe'],
+}
+
+
+def validate_task_config(config: Dict[str, Any]) -> None:
+    import jsonschema  # deferred: ~1.5s import, only needed on YAML parse
+    try:
+        jsonschema.validate(config, TASK_SCHEMA)
+    except jsonschema.ValidationError as e:
+        raise exceptions.InvalidTaskError(
+            f'Invalid task YAML: {e.message} (at '
+            f'{"/".join(str(p) for p in e.absolute_path) or "<root>"})') from e
+
+
+def validate_service_config(config: Dict[str, Any]) -> None:
+    import jsonschema  # deferred (see validate_task_config)
+    try:
+        jsonschema.validate(config, SERVICE_SCHEMA)
+    except jsonschema.ValidationError as e:
+        raise exceptions.InvalidServiceSpecError(
+            f'Invalid service spec: {e.message}') from e

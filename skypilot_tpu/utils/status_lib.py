@@ -1,0 +1,58 @@
+"""Cluster- and job-level status enums.
+
+Reference parity: ClusterStatus mirrors sky/utils/status_lib.py; JobStatus
+mirrors the on-cluster state machine in sky/skylet/job_lib.py:157
+(INIT→PENDING→SETTING_UP→RUNNING→terminal).
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    INIT = 'INIT'          # provisioning or in an unknown/partial state
+    UP = 'UP'              # all hosts up, runtime healthy
+    STOPPED = 'STOPPED'    # instances stopped (not possible for TPU pods)
+
+    def colored_str(self) -> str:
+        color = {
+            ClusterStatus.INIT: '\x1b[33m',     # yellow
+            ClusterStatus.UP: '\x1b[32m',       # green
+            ClusterStatus.STOPPED: '\x1b[90m',  # gray
+        }[self]
+        return f'{color}{self.value}\x1b[0m'
+
+
+class JobStatus(enum.Enum):
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_DRIVER = 'FAILED_DRIVER'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_JOB_STATUSES
+
+    @classmethod
+    def terminal_statuses(cls):
+        return list(_TERMINAL_JOB_STATUSES)
+
+    def colored_str(self) -> str:
+        if self == JobStatus.SUCCEEDED:
+            return f'\x1b[32m{self.value}\x1b[0m'
+        if self in _TERMINAL_JOB_STATUSES:
+            return f'\x1b[31m{self.value}\x1b[0m'
+        return f'\x1b[36m{self.value}\x1b[0m'
+
+
+_TERMINAL_JOB_STATUSES = frozenset({
+    JobStatus.SUCCEEDED,
+    JobStatus.FAILED,
+    JobStatus.FAILED_SETUP,
+    JobStatus.FAILED_DRIVER,
+    JobStatus.CANCELLED,
+})

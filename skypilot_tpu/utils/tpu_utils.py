@@ -1,0 +1,135 @@
+"""TPU accelerator naming, topology, and host-count math.
+
+This is the TPU-native replacement for the reference's generic accelerator
+registry (sky/utils/accelerator_registry.py) plus the TPU grouping logic in
+sky/catalog/gcp_catalog.py:476-556 and the TPU SKU handling in
+sky/catalog/data_fetchers/fetch_gcp.py:34-67.
+
+Canonical in-framework name: ``tpu-<generation>-<count>`` (e.g.
+``tpu-v5e-256``).  Aliases accepted: ``v5e-256``, ``tpu-v5litepod-256``,
+``v5litepod-256``, ``tpu-v6e-8``/``trillium-8``.
+
+Count semantics follow GCP:
+- v2 / v3 / v4 / v5p counts are **TensorCores** (2 per chip).
+- v5e (v5litepod) / v6e (Trillium) counts are **chips**.
+
+Host math (per public TPU system architecture):
+- v2/v3: 4 chips per host.
+- v4/v5p: 4 chips per host.
+- v5e/v6e: single-host for 1/4/8-chip slices; 4 chips per host for pods.
+
+A TPU pod slice is an *atomic* gang-scheduled unit: one provisioning call
+creates all hosts, and the slice preempts as a whole.  ``num_hosts`` is what
+the backend multiplies num_nodes by (the reference does the same via
+``num_ips_per_node`` at sky/backends/cloud_vm_ray_backend.py:2917,:6306).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+# generation -> (cores_per_chip, counts_are_cores, gcp_accelerator_prefix,
+#               default_runtime_version)
+_GEN_INFO: Dict[str, Tuple[int, bool, str, str]] = {
+    'v2': (2, True, 'v2', 'tpu-vm-base'),
+    'v3': (2, True, 'v3', 'tpu-vm-base'),
+    'v4': (2, True, 'v4', 'tpu-vm-v4-base'),
+    'v5e': (1, False, 'v5litepod', 'v2-alpha-tpuv5-lite'),
+    'v5p': (2, True, 'v5p', 'v2-alpha-tpuv5'),
+    'v6e': (1, False, 'v6e', 'v2-alpha-tpuv6e'),
+}
+
+_ALIASES = {
+    'v5litepod': 'v5e',
+    'trillium': 'v6e',
+    'v5lite': 'v5e',
+}
+
+# Valid slice sizes (in the generation's own count units).
+_VALID_COUNTS: Dict[str, Tuple[int, ...]] = {
+    'v2': (8, 32, 128, 256, 512),
+    'v3': (8, 32, 64, 128, 256, 512, 1024, 2048),
+    'v4': tuple(8 * 2 ** i for i in range(10)),       # 8 .. 4096
+    'v5p': (8, 16, 32, 64, 128, 256, 384, 512, 1024, 2048, 4096, 6144, 8192,
+            12288),
+    'v5e': (1, 4, 8, 16, 32, 64, 128, 256),
+    'v6e': (1, 4, 8, 16, 32, 64, 128, 256),
+}
+
+_NAME_RE = re.compile(r'^(?:tpu-)?([a-z0-9]+)-(\d+)$')
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSpec:
+    """A resolved TPU slice request."""
+    generation: str        # 'v5e'
+    count: int             # count in the accelerator name's units
+    chips: int             # physical chips in the slice
+    num_hosts: int         # TPU-VM hosts (== JAX processes)
+    chips_per_host: int
+    cores_per_chip: int
+
+    @property
+    def name(self) -> str:
+        return f'tpu-{self.generation}-{self.count}'
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        """String for the TPU REST API `acceleratorType` field."""
+        prefix = _GEN_INFO[self.generation][2]
+        return f'{prefix}-{self.count}'
+
+    @property
+    def default_runtime_version(self) -> str:
+        return _GEN_INFO[self.generation][3]
+
+    @property
+    def is_pod(self) -> bool:
+        return self.num_hosts > 1
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def is_tpu_accelerator(name: str) -> bool:
+    return parse_tpu_accelerator(name, validate=False) is not None
+
+
+def parse_tpu_accelerator(name: str,
+                          validate: bool = True) -> Optional[TpuSpec]:
+    """Parse an accelerator string into a TpuSpec; None if not a TPU."""
+    m = _NAME_RE.match(name.strip().lower())
+    if m is None:
+        return None
+    gen, count_s = m.group(1), m.group(2)
+    gen = _ALIASES.get(gen, gen)
+    if gen not in _GEN_INFO:
+        return None
+    count = int(count_s)
+    cores_per_chip, counts_are_cores, _, _ = _GEN_INFO[gen]
+    if validate and count not in _VALID_COUNTS[gen]:
+        raise exceptions.InvalidTaskError(
+            f'Invalid TPU slice size {name!r}: {gen} supports counts '
+            f'{_VALID_COUNTS[gen]}.')
+    chips = count // cores_per_chip if counts_are_cores else count
+    chips = max(chips, 1)
+    if gen in ('v5e', 'v6e'):
+        num_hosts = 1 if chips <= 8 else chips // 4
+        chips_per_host = chips if chips <= 8 else 4
+    else:
+        num_hosts = max(chips // 4, 1)
+        chips_per_host = min(chips, 4)
+    return TpuSpec(generation=gen, count=count, chips=chips,
+                   num_hosts=num_hosts, chips_per_host=chips_per_host,
+                   cores_per_chip=cores_per_chip)
+
+
+def list_generations():
+    return sorted(_GEN_INFO)
+
+
+def valid_counts(generation: str) -> Tuple[int, ...]:
+    return _VALID_COUNTS[_ALIASES.get(generation, generation)]

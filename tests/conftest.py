@@ -1,0 +1,29 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
+so sharding/collective tests run hermetically without TPU hardware (the
+analog of the reference's `enable_all_clouds` hermetic layer,
+tests/common_test_fixtures.py:182 — everything testable with no cloud/TPU).
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_home(tmp_path, monkeypatch):
+    """Isolate ~/.skypilot_tpu state for a test."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    monkeypatch.setenv('SKYTPU_CONFIG', str(home / 'nonexistent-config.yaml'))
+    from skypilot_tpu import config
+    config.reload_config()
+    yield home
+    config.reload_config()

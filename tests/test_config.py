@@ -1,0 +1,36 @@
+import pytest
+
+from skypilot_tpu import config
+from skypilot_tpu import exceptions
+
+
+def test_defaults(tmp_home):
+    assert config.get_nested(('provision', 'ssh_timeout')) == 600
+    assert config.get_nested(('missing', 'key'), 'dflt') == 'dflt'
+
+
+def test_user_config_layer(tmp_home, monkeypatch):
+    cfg_path = tmp_home / 'cfg.yaml'
+    cfg_path.write_text('gcp:\n  project_id: my-proj\n')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(cfg_path))
+    config.reload_config()
+    assert config.get_nested(('gcp', 'project_id')) == 'my-proj'
+    # Defaults still merged in.
+    assert config.get_nested(('gcp', 'service_account')) == 'default'
+
+
+def test_override_context(tmp_home):
+    with config.override_config({'gcp': {'project_id': 'ctx-proj'}}):
+        assert config.get_nested(('gcp', 'project_id')) == 'ctx-proj'
+    assert config.get_nested(('gcp', 'project_id')) is None
+
+
+def test_override_rejects_non_allowlisted(tmp_home):
+    with pytest.raises(exceptions.InvalidSkyPilotConfigError):
+        with config.override_config({'api_server': {'endpoint': 'x'}}):
+            pass
+
+
+def test_set_nested(tmp_home):
+    config.set_nested(('gcp', 'project_id'), 'set-proj')
+    assert config.get_nested(('gcp', 'project_id')) == 'set-proj'

@@ -1,0 +1,80 @@
+import pytest
+
+from skypilot_tpu import Resources
+from skypilot_tpu import exceptions
+
+
+def test_default():
+    r = Resources()
+    assert r.cloud is None
+    assert r.accelerators is None
+    assert not r.is_launchable
+
+
+def test_tpu_accelerator_string():
+    r = Resources(accelerators='tpu-v5e-16')
+    assert r.accelerators == {'tpu-v5e-16': 1}
+    assert r.tpu_spec.num_hosts == 4
+    assert r.runtime_version == 'v2-alpha-tpuv5-lite'
+
+
+def test_accelerator_alias_and_dict():
+    r = Resources(accelerators={'v5litepod-8': 1})
+    assert r.accelerator_name == 'tpu-v5e-8'
+
+
+def test_infra_parsing():
+    r = Resources(infra='gcp/us-central2/us-central2-b')
+    assert r.cloud == 'gcp'
+    assert r.region == 'us-central2'
+    assert r.zone == 'us-central2-b'
+    r2 = Resources(infra='gcp/*/us-east5-a')
+    assert r2.region is None and r2.zone == 'us-east5-a'
+
+
+def test_cpus_plus_notation():
+    r = Resources(cpus='4+', memory=16)
+    assert r.cpus == '4+'
+    assert r.memory == '16'
+    with pytest.raises(exceptions.InvalidTaskError):
+        Resources(cpus='abc')
+
+
+def test_yaml_roundtrip():
+    r = Resources(infra='gcp/us-central2', accelerators='tpu-v5e-16:1',
+                  use_spot=True, disk_size=100,
+                  accelerator_args={'runtime_version': 'v2-alpha-tpuv5-lite'})
+    cfg = r.to_yaml_config()
+    r2 = Resources.from_dict(cfg)
+    assert r == r2
+    assert r2.use_spot and r2.disk_size == 100
+
+
+def test_any_of_candidates():
+    candidates = Resources.from_yaml_config({
+        'accelerators': 'tpu-v5e-8',
+        'any_of': [{'use_spot': True}, {'use_spot': False}],
+    })
+    assert len(candidates) == 2
+    assert candidates[0].use_spot and not candidates[1].use_spot
+    assert all(c.accelerator_name == 'tpu-v5e-8' for c in candidates)
+
+
+def test_multislice_args():
+    r = Resources(accelerators='tpu-v5e-256',
+                  accelerator_args={'num_slices': 4})
+    assert r.num_slices == 4
+
+
+def test_copy_override():
+    r = Resources(accelerators='tpu-v4-8')
+    r2 = r.copy(region='us-central2', cloud='gcp')
+    assert r2.region == 'us-central2'
+    assert r2.accelerator_name == 'tpu-v4-8'
+    assert r.region is None  # immutability
+
+
+def test_job_recovery():
+    r = Resources(job_recovery='FAILOVER')
+    assert r.job_recovery == {'strategy': 'failover',
+                              'max_restarts_on_errors': 0}

@@ -1,0 +1,83 @@
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task
+from skypilot_tpu import exceptions
+
+
+def test_minimal_task():
+    t = Task(name='t1', run='echo hello')
+    assert t.num_nodes == 1
+    assert t.generate_run_command(0, ['127.0.0.1']) == 'echo hello'
+
+
+def test_run_callable_per_rank():
+    t = Task(run=lambda rank, ips: f'echo rank {rank} of {len(ips)}')
+    assert t.generate_run_command(1, ['a', 'b']) == 'echo rank 1 of 2'
+
+
+def test_invalid_name():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task(name='bad name!')
+
+
+def test_env_overlap_with_secrets():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task(envs={'A': '1'}, secrets={'A': '2'})
+
+
+def test_from_yaml_config(tmp_path):
+    yaml_str = textwrap.dedent("""\
+        name: train
+        num_nodes: 1
+        resources:
+          accelerators: tpu-v5e-16
+          use_spot: true
+        envs:
+          MODEL: llama3-8b
+        setup: pip list
+        run: |
+          python train.py --model ${MODEL}
+    """)
+    p = tmp_path / 'task.yaml'
+    p.write_text(yaml_str)
+    t = Task.from_yaml(str(p))
+    assert t.name == 'train'
+    assert t.best_resources.accelerator_name == 'tpu-v5e-16'
+    assert t.best_resources.use_spot
+    # ${MODEL} expanded from envs
+    assert 'llama3-8b' in t.generate_run_command(0, ['x'])
+
+
+def test_yaml_roundtrip():
+    t = Task(name='rt', run='echo hi', envs={'A': '1'}, num_nodes=2)
+    t.set_resources(Resources(accelerators='tpu-v4-16'))
+    cfg = t.to_yaml_config()
+    t2 = Task.from_yaml_config(cfg)
+    assert t2.name == 'rt'
+    assert t2.num_nodes == 2
+    assert t2.best_resources.accelerator_name == 'tpu-v4-16'
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({'nme': 'typo', 'run': 'x'})
+
+
+def test_dag_auto_registration():
+    with Dag('pipeline') as dag:
+        a = Task(name='a', run='echo a')
+        b = Task(name='b', run='echo b')
+        dag.add_edge(a, b)
+    assert dag.tasks == [a, b]
+    assert dag.is_chain()
+
+
+def test_dag_cycle_rejected():
+    dag = Dag()
+    a = Task(name='a')
+    b = Task(name='b')
+    dag.add_edge(a, b)
+    with pytest.raises(exceptions.InvalidTaskError):
+        dag.add_edge(b, a)

@@ -1,0 +1,49 @@
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import tpu_utils
+
+
+def test_parse_v5e_pod():
+    spec = tpu_utils.parse_tpu_accelerator('tpu-v5e-256')
+    assert spec.generation == 'v5e'
+    assert spec.chips == 256
+    assert spec.num_hosts == 64
+    assert spec.chips_per_host == 4
+    assert spec.gcp_accelerator_type == 'v5litepod-256'
+    assert spec.is_pod
+
+
+def test_parse_v5e_single_host():
+    spec = tpu_utils.parse_tpu_accelerator('tpu-v5e-8')
+    assert spec.num_hosts == 1
+    assert spec.chips_per_host == 8
+    assert not spec.is_pod
+
+
+def test_core_counted_generations():
+    v4 = tpu_utils.parse_tpu_accelerator('tpu-v4-8')
+    assert v4.chips == 4 and v4.num_hosts == 1
+    v3 = tpu_utils.parse_tpu_accelerator('v3-32')
+    assert v3.chips == 16 and v3.num_hosts == 4
+    v5p = tpu_utils.parse_tpu_accelerator('tpu-v5p-128')
+    assert v5p.chips == 64 and v5p.num_hosts == 16
+
+
+def test_aliases():
+    a = tpu_utils.parse_tpu_accelerator('tpu-v5litepod-16')
+    b = tpu_utils.parse_tpu_accelerator('v5e-16')
+    assert a == b
+    t = tpu_utils.parse_tpu_accelerator('trillium-8')
+    assert t.generation == 'v6e'
+
+
+def test_invalid_size_raises():
+    with pytest.raises(exceptions.InvalidTaskError):
+        tpu_utils.parse_tpu_accelerator('tpu-v5e-7')
+
+
+def test_non_tpu_returns_none():
+    assert tpu_utils.parse_tpu_accelerator('A100', validate=False) is None
+    assert not tpu_utils.is_tpu_accelerator('H100-80GB')
+    assert tpu_utils.is_tpu_accelerator('tpu-v6e-4')
