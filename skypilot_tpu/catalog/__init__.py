@@ -1,0 +1,208 @@
+"""TPU/GCE offering catalog.
+
+Reference parity: sky/catalog/__init__.py + sky/catalog/gcp_catalog.py (TPU
+price handling :255-277, TPU grouping :476-556).  Instead of hosted CSVs
+pulled from GitHub (sky/skylet/constants.py:459), we ship a static snapshot
+under ``data/`` and a refresh script
+(``skypilot_tpu/catalog/data_fetchers/fetch_gcp.py``, the analog of
+sky/catalog/data_fetchers/fetch_gcp.py) that regenerates it from the GCP
+billing API when credentials/egress exist.
+
+Pricing model: GCP bills TPUs per chip-hour, linear in slice size, so the
+catalog stores per-(generation, zone) chip prices and computes slice prices
+as ``chips × chip_price`` (matches fetch_gcp.py:34-67's SKU math).
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import tpu_utils
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuOffering:
+    """One (slice type, zone) offering with hourly prices."""
+    spec: tpu_utils.TpuSpec
+    region: str
+    zone: str
+    price: float          # whole-slice on-demand $/hr
+    spot_price: float     # whole-slice spot/preemptible $/hr
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceOffering:
+    instance_type: str
+    vcpus: float
+    memory_gb: float
+    region: str
+    zone: str
+    price: float
+    spot_price: float
+
+
+@functools.lru_cache()
+def _load_tpu_rows() -> List[Dict[str, str]]:
+    with open(os.path.join(_DATA_DIR, 'gcp_tpus.csv'), encoding='utf-8') as f:
+        return list(csv.DictReader(f))
+
+
+@functools.lru_cache()
+def _load_instance_rows() -> List[Dict[str, str]]:
+    with open(os.path.join(_DATA_DIR, 'gcp_instances.csv'),
+              encoding='utf-8') as f:
+        return list(csv.DictReader(f))
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[TpuOffering]]:
+    """All TPU offerings grouped by canonical accelerator name."""
+    out: Dict[str, List[TpuOffering]] = {}
+    for gen in tpu_utils.list_generations():
+        for count in tpu_utils.valid_counts(gen):
+            name = f'tpu-{gen}-{count}'
+            if name_filter and name_filter not in name:
+                continue
+            offerings = get_tpu_offerings(
+                tpu_utils.parse_tpu_accelerator(name))
+            if offerings:
+                out[name] = offerings
+    return out
+
+
+def get_tpu_offerings(spec: tpu_utils.TpuSpec,
+                      region: Optional[str] = None,
+                      zone: Optional[str] = None,
+                      ) -> List[TpuOffering]:
+    """Zones offering this slice, cheapest first."""
+    out = []
+    for row in _load_tpu_rows():
+        if row['generation'] != spec.generation:
+            continue
+        if region and row['region'] != region:
+            continue
+        if zone and row['zone'] != zone:
+            continue
+        out.append(TpuOffering(
+            spec=spec,
+            region=row['region'],
+            zone=row['zone'],
+            price=spec.chips * float(row['chip_price']),
+            spot_price=spec.chips * float(row['spot_chip_price']),
+        ))
+    out.sort(key=lambda o: (o.price, o.zone))
+    return out
+
+
+def get_hourly_cost(spec: tpu_utils.TpuSpec, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> Optional[float]:
+    offerings = get_tpu_offerings(spec, region=region, zone=zone)
+    if not offerings:
+        return None
+    prices = [o.spot_price if use_spot else o.price for o in offerings]
+    return min(prices)
+
+
+def get_instance_offerings(instance_type: Optional[str] = None,
+                           region: Optional[str] = None,
+                           zone: Optional[str] = None
+                           ) -> List[InstanceOffering]:
+    out = []
+    for row in _load_instance_rows():
+        if instance_type and row['instance_type'] != instance_type:
+            continue
+        if region and row['region'] != region:
+            continue
+        if zone and row['zone'] != zone:
+            continue
+        out.append(InstanceOffering(
+            instance_type=row['instance_type'],
+            vcpus=float(row['vcpus']),
+            memory_gb=float(row['memory_gb']),
+            region=row['region'],
+            zone=row['zone'],
+            price=float(row['price']),
+            spot_price=float(row['spot_price']),
+        ))
+    out.sort(key=lambda o: (o.price, o.instance_type, o.zone))
+    return out
+
+
+def _parse_plus(value: Optional[str]) -> Tuple[Optional[float], bool]:
+    if value is None:
+        return None, True     # unset = anything goes (treated as lower bound 0)
+    plus = value.endswith('+')
+    return float(value[:-1] if plus else value), plus
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None,
+                              region: Optional[str] = None,
+                              zone: Optional[str] = None) -> Optional[str]:
+    """Cheapest instance satisfying cpus/memory ('4' exact, '4+' at least).
+
+    Mirrors Cloud.get_default_instance_type in sky/clouds/gcp.py.
+    """
+    cpu_val, cpu_plus = _parse_plus(cpus)
+    mem_val, mem_plus = _parse_plus(memory)
+    best: Optional[InstanceOffering] = None
+    seen = set()
+    for o in get_instance_offerings(region=region, zone=zone):
+        if o.instance_type in seen:
+            continue
+        seen.add(o.instance_type)
+        if cpu_val is not None:
+            if cpu_plus and o.vcpus < cpu_val:
+                continue
+            if not cpu_plus and o.vcpus != cpu_val:
+                continue
+        elif o.vcpus < 4:
+            continue    # default floor: 4 vCPUs (reference default 4+)
+        if mem_val is not None:
+            if mem_plus and o.memory_gb < mem_val:
+                continue
+            if not mem_plus and o.memory_gb != mem_val:
+                continue
+        if best is None or o.price < best.price:
+            best = o
+    return best.instance_type if best else None
+
+
+def get_tpu_host_vm_shape(spec: tpu_utils.TpuSpec) -> Tuple[float, float]:
+    """(vCPUs, memory GB) of each TPU-VM host, for scheduling bookkeeping.
+
+    Mirrors the TPU-VM vCPU/mem quirks table in sky/clouds/gcp.py:710-761.
+    """
+    per_host = {
+        'v2': (96, 334), 'v3': (96, 334),
+        'v4': (240, 407),
+        'v5e': {1: (24, 48), 4: (112, 192), 8: (224, 384)}.get(
+            spec.chips if not spec.is_pod else 4, (112, 192)),
+        'v5p': (208, 448),
+        'v6e': {1: (44, 176), 4: (180, 720), 8: (180, 1440)}.get(
+            spec.chips if not spec.is_pod else 4, (180, 720)),
+    }[spec.generation]
+    return per_host
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str]
+                         ) -> None:
+    if region is None and zone is None:
+        return
+    rows = _load_tpu_rows() + _load_instance_rows()
+    regions = {r['region'] for r in rows}
+    zones = {r['zone'] for r in rows}
+    if region is not None and region not in regions:
+        raise exceptions.ResourcesUnavailableError(
+            f'Region {region!r} has no known offerings. '
+            f'Known: {sorted(regions)}')
+    if zone is not None and zone not in zones:
+        raise exceptions.ResourcesUnavailableError(
+            f'Zone {zone!r} has no known offerings.')

@@ -1,0 +1,147 @@
+"""Regenerate the static catalog CSVs from the GCP APIs.
+
+Analog of sky/catalog/data_fetchers/fetch_gcp.py (TPU SKU id :38, hidden TPU
+v3 pod prices :50-60, TPU_V4_ZONES :47).  Needs network + credentials, so it
+is a maintenance script, not a runtime dependency: the shipped CSVs under
+``../data`` are a point-in-time snapshot (2026-07) of public pricing.
+
+Usage:
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp --project <id>
+
+Approach (all plain REST via requests + google-auth):
+  1. ``tpu.googleapis.com/v2/projects/{p}/locations`` → zones with TPU API.
+  2. ``.../locations/{zone}/acceleratorTypes`` → slice types per zone.
+  3. ``cloudbilling.googleapis.com/v1/services/E000-3F24-B8AA/skus`` (the
+     Cloud TPU service SKU group, same id the reference hardcodes) → per
+     chip-hour prices; preemptible SKUs carry 'Preemptible' in description.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import csv
+import os
+import re
+import sys
+from typing import Dict, Iterable
+
+TPU_BILLING_SERVICE = 'services/E000-3F24-B8AA'  # Cloud TPU (see reference :38)
+_DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), 'data')
+
+
+def _authed_session():
+    try:
+        import google.auth
+        import google.auth.transport.requests
+        creds, _ = google.auth.default(
+            scopes=['https://www.googleapis.com/auth/cloud-platform'])
+        session = google.auth.transport.requests.AuthorizedSession(creds)
+        return session
+    except Exception as e:  # pylint: disable=broad-except
+        raise SystemExit(
+            f'GCP credentials unavailable ({e}); cannot refresh catalog. '
+            'The shipped snapshot remains valid.') from e
+
+
+def _paged(session, url: str, key: str) -> Iterable[dict]:
+    page_token = None
+    while True:
+        full = url + (f'&pageToken={page_token}' if page_token else '')
+        resp = session.get(full, timeout=30)
+        resp.raise_for_status()
+        data = resp.json()
+        yield from data.get(key, [])
+        page_token = data.get('nextPageToken')
+        if not page_token:
+            return
+
+
+def fetch_tpu_zones(session, project: str) -> Dict[str, list]:
+    """zone -> [accelerator type names]."""
+    out = collections.defaultdict(list)
+    base = f'https://tpu.googleapis.com/v2/projects/{project}/locations'
+    for loc in _paged(session, base + '?pageSize=100', 'locations'):
+        zone = loc['locationId']
+        url = (f'{base}/{zone}/acceleratorTypes?pageSize=200')
+        try:
+            for at in _paged(session, url, 'acceleratorTypes'):
+                out[zone].append(at['type'])
+        except Exception:  # pylint: disable=broad-except
+            continue
+    return dict(out)
+
+
+_GEN_FROM_SKU = [
+    (re.compile(r'tpu[- ]?v5e|v5 ?lite', re.I), 'v5e'),
+    (re.compile(r'tpu[- ]?v5p', re.I), 'v5p'),
+    (re.compile(r'tpu[- ]?v6e|trillium', re.I), 'v6e'),
+    (re.compile(r'tpu[- ]?v4', re.I), 'v4'),
+    (re.compile(r'tpu[- ]?v3', re.I), 'v3'),
+    (re.compile(r'tpu[- ]?v2', re.I), 'v2'),
+]
+
+
+def fetch_tpu_prices(session) -> Dict[tuple, float]:
+    """(generation, region, is_spot) -> $/chip-hour."""
+    url = (f'https://cloudbilling.googleapis.com/v1/{TPU_BILLING_SERVICE}'
+           '/skus?pageSize=500')
+    prices: Dict[tuple, float] = {}
+    for sku in _paged(session, url, 'skus'):
+        desc = sku.get('description', '')
+        gen = next((g for pat, g in _GEN_FROM_SKU if pat.search(desc)), None)
+        if gen is None:
+            continue
+        is_spot = 'preemptible' in desc.lower() or 'spot' in desc.lower()
+        for region in sku.get('serviceRegions', []):
+            info = sku.get('pricingInfo', [])
+            if not info:
+                continue
+            expr = info[0]['pricingExpression']
+            rates = expr.get('tieredRates', [])
+            if not rates:
+                continue
+            unit = rates[-1]['unitPrice']
+            price = int(unit.get('units', 0)) + unit.get('nanos', 0) / 1e9
+            if price > 0:
+                prices[(gen, region, is_spot)] = price
+    return prices
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--project', required=True)
+    parser.add_argument('--output', default=os.path.join(_DATA_DIR, 'gcp_tpus.csv'))
+    args = parser.parse_args(argv)
+    session = _authed_session()
+    zones = fetch_tpu_zones(session, args.project)
+    prices = fetch_tpu_prices(session)
+    rows = []
+    for zone, types in sorted(zones.items()):
+        region = zone.rsplit('-', 1)[0]
+        gens = set()
+        for t in types:
+            m = re.match(r'(v\d+\w*?)(?:litepod|p)?-\d+', t)
+            if m:
+                gen = {'v5litepod': 'v5e'}.get(m.group(1), m.group(1))
+                gens.add(gen)
+        for gen in sorted(gens):
+            od = prices.get((gen, region, False))
+            spot = prices.get((gen, region, True))
+            if od is None:
+                continue
+            rows.append({'generation': gen, 'region': region, 'zone': zone,
+                         'chip_price': od, 'spot_chip_price': spot or od * 0.45})
+    if not rows:
+        print('No rows fetched; keeping existing snapshot.', file=sys.stderr)
+        return 1
+    with open(args.output, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=[
+            'generation', 'region', 'zone', 'chip_price', 'spot_chip_price'])
+        writer.writeheader()
+        writer.writerows(rows)
+    print(f'Wrote {len(rows)} rows to {args.output}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

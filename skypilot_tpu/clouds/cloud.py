@@ -1,0 +1,106 @@
+"""Abstract cloud provider.
+
+Reference parity: abstract class Cloud in sky/clouds/cloud.py:140 —
+make_deploy_resources_variables (:306), get_feasible_launchable_resources
+(:423), check_credentials (:492).  The 22-cloud zoo is collapsed to this
+interface plus GCP (the TPU provider) and Local (hermetic testing/dev), but
+the shapes are kept so more providers can register later.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@dataclasses.dataclass
+class FeasibleResources:
+    """Result of a feasibility query (mirrors sky/clouds/cloud.py's
+    per-cloud launchable lists + fuzzy candidates for error messages)."""
+    resources_list: List['resources_lib.Resources']
+    fuzzy_candidate_list: List[str] = dataclasses.field(default_factory=list)
+    hint: Optional[str] = None
+
+
+class Cloud:
+    """A provider of instances/TPU slices."""
+
+    _REPR = 'Cloud'
+    max_cluster_name_length: Optional[int] = None
+
+    # ---- identity --------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._REPR.lower()
+
+    def __repr__(self) -> str:
+        return self._REPR
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Cloud) and self._REPR == other._REPR
+
+    def __hash__(self) -> int:
+        return hash(self._REPR)
+
+    # ---- capabilities ----------------------------------------------------
+    def supports_stop(self, resources: 'resources_lib.Resources') -> bool:
+        """Whether instances can be stopped (not terminated).  TPU pod
+        slices cannot stop (reference: sky/clouds/gcp.py:217-224)."""
+        raise NotImplementedError
+
+    def supports_autostop(self) -> bool:
+        return True
+
+    # ---- feasibility / pricing ------------------------------------------
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> FeasibleResources:
+        """Map intent → concrete launchable candidates on this cloud,
+        cheapest first; empty list if infeasible."""
+        raise NotImplementedError
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        raise NotImplementedError
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0
+
+    # ---- provisioning inputs --------------------------------------------
+    def region_zones_provision_loop(
+            self, resources: 'resources_lib.Resources'
+    ) -> Iterator[Tuple[str, List[str]]]:
+        """Yield (region, [zones]) in provisioning preference order —
+        consumed by the failover provisioner (mirrors
+        RetryingVmProvisioner._yield_zones, cloud_vm_ray_backend.py:1274)."""
+        raise NotImplementedError
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        """Variables handed to the provisioner (mirrors sky/clouds/gcp.py:502-540
+        emitting tpu_vm/tpu_type/tpu_node_name)."""
+        raise NotImplementedError
+
+    # ---- credentials -----------------------------------------------------
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+
+def get_cloud(name: Optional[str]) -> Optional[Cloud]:
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    return CLOUD_REGISTRY.from_str(name)
+
+
+def enabled_clouds() -> List[Cloud]:
+    """Clouds with working credentials (mirrors sky/check.py)."""
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    out = []
+    for cloud in CLOUD_REGISTRY.values():
+        ok, _ = cloud.check_credentials()
+        if ok:
+            out.append(cloud)
+    return out

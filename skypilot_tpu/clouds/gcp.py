@@ -1,0 +1,182 @@
+"""GCP: the TPU provider.
+
+Reference parity: sky/clouds/gcp.py — TPU deploy variables :502-540 (emits
+tpu_vm/tpu_type/tpu_node_name), TPU-VM vCPU/mem quirks :710-761, TPU pods
+cannot stop :217-224.  Only TPU-VM (not the legacy TPU-Node) architecture is
+supported: every accelerator host is a first-class VM we SSH into.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@CLOUD_REGISTRY.register()
+class GCP(cloud_lib.Cloud):
+    _REPR = 'GCP'
+    # GCP instance names cap at 63 chars; TPU node names likewise (RFC1035).
+    max_cluster_name_length = 35
+
+    def supports_stop(self, resources: 'resources_lib.Resources') -> bool:
+        spec = resources.tpu_spec
+        if spec is not None and spec.is_pod:
+            # Multi-host slices can only be deleted, never stopped
+            # (reference: sky/clouds/gcp.py:217-224).
+            return False
+        return True
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> cloud_lib.FeasibleResources:
+        from skypilot_tpu import resources as resources_lib  # noqa: F811
+        if resources.cloud not in (None, 'gcp'):
+            return cloud_lib.FeasibleResources([])
+        spec = resources.tpu_spec
+        if resources.accelerator_name and spec is None:
+            # Non-TPU accelerator: not offered by this TPU-native provider.
+            fuzzy = sorted(catalog.list_accelerators().keys())[:8]
+            return cloud_lib.FeasibleResources(
+                [], fuzzy_candidate_list=fuzzy,
+                hint=f'GCP (TPU-native) does not offer '
+                     f'{resources.accelerator_name!r}.')
+        if spec is not None:
+            offerings = catalog.get_tpu_offerings(
+                spec, region=resources.region, zone=resources.zone)
+            out = []
+            seen_regions = set()
+            for o in offerings:
+                if o.region in seen_regions:
+                    continue   # one candidate per region; zones iterate later
+                seen_regions.add(o.region)
+                out.append(resources.copy(
+                    cloud='gcp', region=o.region, zone=resources.zone,
+                    _price_per_hour=(o.spot_price if resources.use_spot
+                                     else o.price)))
+            out.sort(key=lambda r: r.price_per_hour)
+            return cloud_lib.FeasibleResources(out)
+        # CPU-only VM (controllers, dev boxes).
+        if resources.instance_type is not None:
+            offerings = catalog.get_instance_offerings(
+                instance_type=resources.instance_type,
+                region=resources.region, zone=resources.zone)
+        else:
+            itype = catalog.get_default_instance_type(
+                cpus=resources.cpus, memory=resources.memory,
+                region=resources.region, zone=resources.zone)
+            if itype is None:
+                return cloud_lib.FeasibleResources(
+                    [], hint='No GCE instance type satisfies '
+                             f'cpus={resources.cpus} memory={resources.memory}.')
+            offerings = catalog.get_instance_offerings(
+                instance_type=itype, region=resources.region,
+                zone=resources.zone)
+        out = []
+        seen_regions = set()
+        for o in offerings:
+            if o.region in seen_regions:
+                continue
+            seen_regions.add(o.region)
+            out.append(resources.copy(
+                cloud='gcp', region=o.region, instance_type=o.instance_type,
+                _price_per_hour=(o.spot_price if resources.use_spot
+                                 else o.price)))
+        return cloud_lib.FeasibleResources(out)
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        if resources.price_per_hour is not None:
+            return resources.price_per_hour
+        spec = resources.tpu_spec
+        if spec is not None:
+            cost = catalog.get_hourly_cost(
+                spec, resources.use_spot, region=resources.region,
+                zone=resources.zone)
+            return (cost or 0.0) * resources.num_slices
+        offerings = catalog.get_instance_offerings(
+            instance_type=resources.instance_type, region=resources.region)
+        if not offerings:
+            return 0.0
+        o = offerings[0]
+        return o.spot_price if resources.use_spot else o.price
+
+    def region_zones_provision_loop(
+            self, resources: 'resources_lib.Resources'
+    ) -> Iterator[Tuple[str, List[str]]]:
+        spec = resources.tpu_spec
+        if spec is not None:
+            offerings = catalog.get_tpu_offerings(
+                spec, region=resources.region, zone=resources.zone)
+            key = (lambda o: o.spot_price) if resources.use_spot else (
+                lambda o: o.price)
+        else:
+            offerings = catalog.get_instance_offerings(
+                instance_type=resources.instance_type,
+                region=resources.region, zone=resources.zone)
+            key = (lambda o: o.spot_price) if resources.use_spot else (
+                lambda o: o.price)
+        by_region: Dict[str, List[str]] = {}
+        region_price: Dict[str, float] = {}
+        for o in offerings:
+            by_region.setdefault(o.region, []).append(o.zone)
+            region_price[o.region] = min(region_price.get(o.region, 1e18),
+                                         key(o))
+        for region in sorted(by_region, key=lambda r: region_price[r]):
+            yield region, sorted(set(by_region[region]))
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        project_id = config_lib.get_nested(('gcp', 'project_id'))
+        spec = resources.tpu_spec
+        variables: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'project_id': project_id,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'labels': resources.labels,
+            'ports': list(resources.ports),
+            'service_account': config_lib.get_nested(
+                ('gcp', 'service_account'), 'default'),
+        }
+        if spec is not None:
+            variables.update({
+                'tpu_vm': True,
+                'tpu_type': spec.gcp_accelerator_type,
+                'tpu_generation': spec.generation,
+                'num_hosts': spec.num_hosts,
+                'chips_per_host': spec.chips_per_host,
+                'runtime_version': resources.runtime_version,
+                'tpu_node_name': cluster_name,
+                'num_slices': resources.num_slices,
+                'reservation': config_lib.get_nested(('gcp', 'reservation')),
+                'topology': resources.accelerator_args.get('topology'),
+            })
+        else:
+            variables.update({
+                'tpu_vm': False,
+                'instance_type': resources.instance_type,
+                'image_id': resources.image_id,
+            })
+        return variables
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        # Application-default credentials or service-account key present?
+        adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.environ.get('GOOGLE_APPLICATION_CREDENTIALS') or os.path.exists(adc):
+            if config_lib.get_nested(('gcp', 'project_id')) is None:
+                return False, ('GCP credentials found but gcp.project_id is '
+                               'not set in ~/.skypilot_tpu/config.yaml.')
+            return True, None
+        return False, ('No GCP credentials: set GOOGLE_APPLICATION_CREDENTIALS '
+                       'or run `gcloud auth application-default login`.')

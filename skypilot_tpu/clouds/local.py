@@ -1,0 +1,70 @@
+"""Local "cloud": hosts are processes on this machine.
+
+This is the hermetic end-to-end layer the reference lacks (SURVEY.md §4
+implication: a fake multi-host runtime for gang-scheduling tests without
+hardware).  `resources: {cloud: local}` provisions N "hosts" as local
+working directories + background agents, so the entire launch path —
+optimizer → provisioner → runtime setup → ranked fan-out → log streaming —
+runs with no cloud and no TPU.  Also usable as a dev box runner.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_REGION = 'local'
+_ZONE = 'local-a'
+
+
+@CLOUD_REGISTRY.register()
+class Local(cloud_lib.Cloud):
+    _REPR = 'Local'
+    max_cluster_name_length = 63
+
+    def supports_stop(self, resources) -> bool:
+        return False
+
+    def supports_autostop(self) -> bool:
+        return True
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> cloud_lib.FeasibleResources:
+        # Only feasible when explicitly requested: local never competes with
+        # real clouds in the optimizer.
+        if resources.cloud != 'local':
+            return cloud_lib.FeasibleResources([])
+        out = resources.copy(cloud='local', region=_REGION, zone=_ZONE,
+                             instance_type=resources.instance_type or 'localhost',
+                             _price_per_hour=0.0)
+        return cloud_lib.FeasibleResources([out])
+
+    def get_hourly_cost(self, resources) -> float:
+        return 0.0
+
+    def region_zones_provision_loop(
+            self, resources) -> Iterator[Tuple[str, List[str]]]:
+        yield _REGION, [_ZONE]
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        spec = resources.tpu_spec
+        num_hosts = spec.num_hosts if spec is not None else 1
+        return {
+            'cluster_name': cluster_name,
+            'region': region,
+            'zone': zone or _ZONE,
+            'tpu_vm': spec is not None,
+            'num_hosts': num_hosts,
+            'chips_per_host': spec.chips_per_host if spec else 0,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return True, None

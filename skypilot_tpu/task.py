@@ -60,6 +60,7 @@ class Task:
             resources_lib.Resources()
         ]
         self._resources_ordered = False
+        self._chosen_resources: Optional[resources_lib.Resources] = None
         self._validate()
         # Auto-register into an enclosing `with Dag():` block.
         from skypilot_tpu import dag as dag_lib
@@ -112,8 +113,15 @@ class Task:
         """True if candidates are a strict preference order (``ordered:``)."""
         return self._resources_ordered
 
+    def set_resources_chosen(self, resources: resources_lib.Resources) -> None:
+        """Record the optimizer's concrete choice (mirrors the reference
+        setting task.best_resources in sky/optimizer.py)."""
+        self._chosen_resources = resources
+
     @property
     def best_resources(self) -> resources_lib.Resources:
+        if self._chosen_resources is not None:
+            return self._chosen_resources
         return self._resources[0]
 
     # ---- envs ------------------------------------------------------------

@@ -25,13 +25,16 @@ class Registry(Generic[T]):
     def from_str(self, name: Optional[str]) -> Optional[T]:
         if name is None:
             return None
+        return self.get_class(name)()
+
+    def get_class(self, name: str) -> Type[T]:
         key = name.lower()
         key = self._aliases.get(key, key)
         if key not in self._registry:
             raise ValueError(
                 f'Unknown {self._name} {name!r}. '
                 f'Valid: {sorted(self._registry)}')
-        return self._registry[key]()
+        return self._registry[key]
 
     def keys(self):
         return self._registry.keys()

@@ -1,0 +1,47 @@
+from skypilot_tpu import catalog
+from skypilot_tpu.utils import tpu_utils
+
+
+def test_tpu_offerings_sorted_by_price():
+    spec = tpu_utils.parse_tpu_accelerator('tpu-v5e-16')
+    offerings = catalog.get_tpu_offerings(spec)
+    assert offerings
+    prices = [o.price for o in offerings]
+    assert prices == sorted(prices)
+    # v5e-16 = 16 chips × $1.2 = $19.2/hr in US regions
+    assert abs(offerings[0].price - 16 * 1.2) < 1e-6
+    assert offerings[0].spot_price < offerings[0].price
+
+
+def test_tpu_offerings_region_filter():
+    spec = tpu_utils.parse_tpu_accelerator('tpu-v4-8')
+    assert catalog.get_tpu_offerings(spec, region='us-central2')
+    assert not catalog.get_tpu_offerings(spec, region='us-east1')
+
+
+def test_hourly_cost_spot_cheaper():
+    spec = tpu_utils.parse_tpu_accelerator('tpu-v5e-256')
+    od = catalog.get_hourly_cost(spec, use_spot=False)
+    spot = catalog.get_hourly_cost(spec, use_spot=True)
+    assert od and spot and spot < od
+
+
+def test_default_instance_type():
+    it = catalog.get_default_instance_type(cpus='4+')
+    assert it is not None
+    offering = catalog.get_instance_offerings(instance_type=it)[0]
+    assert offering.vcpus >= 4
+    # exact match
+    it8 = catalog.get_default_instance_type(cpus='8')
+    assert catalog.get_instance_offerings(instance_type=it8)[0].vcpus == 8
+
+
+def test_list_accelerators_filter():
+    accs = catalog.list_accelerators('v6e')
+    assert accs and all('v6e' in k for k in accs)
+
+
+def test_tpu_host_vm_shape():
+    spec = tpu_utils.parse_tpu_accelerator('tpu-v5e-256')
+    vcpus, mem = catalog.get_tpu_host_vm_shape(spec)
+    assert vcpus > 0 and mem > 0

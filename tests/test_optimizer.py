@@ -1,0 +1,112 @@
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task
+from skypilot_tpu import exceptions
+from skypilot_tpu.optimizer import Optimizer
+
+
+def _opt(task):
+    return Optimizer.optimize_task(task, quiet=True)
+
+
+def test_tpu_task_gets_cheapest_region():
+    t = Task(name='train', run='echo hi')
+    t.set_resources(Resources(accelerators='tpu-v5e-16'))
+    _opt(t)
+    r = t.best_resources
+    assert r.cloud == 'gcp'
+    assert r.region is not None
+    assert r.price_per_hour == pytest.approx(16 * 1.2)
+    assert r.is_launchable
+
+
+def test_spot_pricing_used():
+    t = Task(run='x')
+    t.set_resources(Resources(accelerators='tpu-v5e-16', use_spot=True))
+    _opt(t)
+    assert t.best_resources.price_per_hour == pytest.approx(16 * 0.54)
+
+
+def test_region_pinning_respected():
+    t = Task(run='x')
+    t.set_resources(Resources(accelerators='tpu-v6e-8',
+                              infra='gcp/europe-west4'))
+    _opt(t)
+    assert t.best_resources.region == 'europe-west4'
+
+
+def test_cpu_only_task():
+    t = Task(run='x')
+    t.set_resources(Resources(cpus='4+'))
+    _opt(t)
+    r = t.best_resources
+    assert r.cloud == 'gcp'
+    assert r.instance_type is not None
+
+
+def test_infeasible_raises():
+    t = Task(run='x')
+    t.set_resources(Resources(accelerators='tpu-v4-8', infra='gcp/us-east1'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        _opt(t)
+
+
+def test_non_tpu_accelerator_hint():
+    t = Task(run='x')
+    t.set_resources(Resources(accelerators='A100'))
+    with pytest.raises(exceptions.ResourcesUnavailableError) as exc:
+        _opt(t)
+    assert 'does not offer' in str(exc.value)
+
+
+def test_ordered_preference_wins_over_price():
+    t = Task(run='x')
+    # v5p is pricier than v5e; ordered means v5p must win anyway.
+    t.set_resources([Resources(accelerators='tpu-v5p-8'),
+                     Resources(accelerators='tpu-v5e-8')], ordered=True)
+    _opt(t)
+    assert t.best_resources.accelerator_name == 'tpu-v5p-8'
+
+
+def test_any_of_picks_cheapest():
+    t = Task(run='x')
+    t.set_resources([Resources(accelerators='tpu-v5p-8'),
+                     Resources(accelerators='tpu-v5e-8')], ordered=False)
+    _opt(t)
+    assert t.best_resources.accelerator_name == 'tpu-v5e-8'
+
+
+def test_local_cloud_only_when_requested():
+    t = Task(run='x')
+    t.set_resources(Resources(cpus='4+'))
+    _opt(t)
+    assert t.best_resources.cloud != 'local'
+    t2 = Task(run='x')
+    t2.set_resources(Resources(cloud='local'))
+    _opt(t2)
+    assert t2.best_resources.cloud == 'local'
+    assert t2.best_resources.price_per_hour == 0.0
+
+
+def test_chain_dag():
+    dag = Dag()
+    a = Task(name='a', run='x')
+    a.set_resources(Resources(cpus='4+'))
+    b = Task(name='b', run='y')
+    b.set_resources(Resources(accelerators='tpu-v5e-8'))
+    dag.add_edge(a, b)
+    Optimizer.optimize(dag, quiet=True)
+    assert a.best_resources.is_launchable
+    assert b.best_resources.is_launchable
+
+
+def test_multislice_cost_multiplies():
+    t = Task(run='x')
+    t.set_resources(Resources(accelerators='tpu-v5e-256',
+                              accelerator_args={'num_slices': 2}))
+    _opt(t)
+    # price_per_hour on the offering is per-slice; hourly cost ×2.
+    from skypilot_tpu.clouds import GCP
+    assert GCP().get_hourly_cost(
+        t.best_resources.copy(_price_per_hour=None)) == pytest.approx(
+            2 * 256 * 1.2)
