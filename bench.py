@@ -1,0 +1,74 @@
+"""Flagship benchmark: Llama train-step throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no training-throughput numbers
+(BASELINE.md: published is empty), so vs_baseline is measured against the
+north-star proxy TARGET_TOKENS_PER_SEC_PER_CHIP derived from the
+BASELINE.md goal (Llama tokens/sec/chip on v5e competitive with 8xH100 on
+tokens/sec/$): an 8B model at ~40% MFU on a 197-TFLOP/s v5e chip sustains
+~1.6k tok/s/chip; a 1B bench model scales to ~10k tok/s/chip.  value >
+target → vs_baseline > 1.
+"""
+from __future__ import annotations
+
+import json
+
+TARGET_TOKENS_PER_SEC_PER_CHIP = 10_000.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == 'tpu'
+
+    if on_tpu:
+        config = llama.LlamaConfig(
+            vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True)
+        batch_size, seq, steps = 8, 1024, 12
+    else:  # CPU smoke fallback so the bench always emits a line
+        config = llama.LLAMA_DEBUG
+        batch_size, seq, steps = 2, 64, 4
+
+    mesh = make_mesh(MeshConfig(fsdp=n_chips))
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+
+    def loss(p, batch):
+        return llama.loss_fn(p, batch, config)
+
+    trainer = Trainer(loss, params, mesh, sharding_lib.LLAMA_RULES,
+                      TrainConfig(warmup_steps=2, total_steps=steps))
+    batches = synthetic_batches(batch_size, seq, config.vocab_size)
+    summary = trainer.fit(batches, steps, log_every=0,
+                          tokens_per_batch=batch_size * seq)
+    tok_s = summary['tokens_per_sec'] / n_chips
+
+    # Model FLOPs utilization: 6 * params * tokens / time / peak.
+    n_params = config.num_params()
+    flops_per_token = 6 * n_params
+    peak = 197e12 if on_tpu else 1e12
+    mfu = tok_s * flops_per_token / peak
+
+    print(json.dumps({
+        'metric': 'llama_1b_train_tokens_per_sec_per_chip',
+        'value': round(tok_s, 1),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(tok_s / TARGET_TOKENS_PER_SEC_PER_CHIP, 3),
+        'extra': {'chips': n_chips, 'platform': jax.devices()[0].platform,
+                  'step_time_s': round(summary['step_time_s'], 4),
+                  'loss': round(summary['loss'], 4),
+                  'mfu_pct': round(100 * mfu, 1),
+                  'params_b': round(n_params / 1e9, 3)},
+    }))
+
+
+if __name__ == '__main__':
+    main()

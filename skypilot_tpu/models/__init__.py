@@ -1,0 +1,4 @@
+from skypilot_tpu.models import llama
+from skypilot_tpu.models import resnet
+
+__all__ = ['llama', 'resnet']

@@ -1,0 +1,6 @@
+from skypilot_tpu.ops.attention import flash_attention, reference_attention
+from skypilot_tpu.ops.rmsnorm import rms_norm
+from skypilot_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ['flash_attention', 'reference_attention', 'rms_norm',
+           'apply_rope', 'rope_frequencies']

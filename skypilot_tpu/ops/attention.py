@@ -1,0 +1,211 @@
+"""Flash attention for TPU (Pallas) with a reference fallback.
+
+The MXU-facing hot op of the bundled model stack.  Forward is a Pallas
+kernel using the canonical TPU online-softmax pattern: grid
+(batch, heads, q_blocks, k_blocks) with the innermost k dimension iterated
+sequentially so VMEM scratch (running max / normalizer / accumulator)
+persists across k blocks; causal blocks with j > i are predicated off
+entirely, halving FLOPs.  Backward recomputes attention in plain XLA
+(fused adequately; a Pallas backward is a later optimization).
+
+Supports GQA (fewer KV heads than Q heads) via the kernel's KV index map.
+
+No reference-repo analog: SkyPilot orchestrates frameworks and ships no
+kernels; this replaces what its recipes get from torch/cuDNN.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _pick_block(seq_len: int) -> Optional[int]:
+    for blk in (512, 256, 128):
+        if seq_len % blk == 0 and seq_len >= blk:
+            return blk
+    return None
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr,
+                      *, scale: float, block: int, causal: bool):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    compute = (j <= i) if causal else (j >= 0)
+
+    @pl.when(compute)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)          # (Bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)          # (Bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (Bq, Bk)
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+            mask = (i * block + row) >= (j * block + col)
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:]                             # (Bq, 128), cols equal
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # (Bq, 1)
+        p = jnp.exp(s - m_new[:, :1])                 # (Bq, Bk)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_new
+        v = v_ref[0, 0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bq, D)
+        acc_scr[:] = acc_scr[:] * corr + pv
+
+    last_j = i if causal else nk - 1
+
+    @pl.when(j == last_j)
+    def _finalize():
+        o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array,
+               causal: bool, block: int, interpret: bool) -> jax.Array:
+    """q: (B, H, S, D); k/v: (B, KV, S, D) → (B, H, S, D)."""
+    batch, num_heads, seq_len, head_dim = q.shape
+    num_kv = k.shape[1]
+    group = num_heads // num_kv
+    scale = head_dim ** -0.5
+    nq = seq_len // block
+    grid = (batch, num_heads, nq, nq)
+
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, block=block,
+                               causal=causal)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block, head_dim),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block, head_dim),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block, head_dim),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block, head_dim),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block, 128), jnp.float32),
+            pltpu.VMEM((block, 128), jnp.float32),
+            pltpu.VMEM((block, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True) -> jax.Array:
+    """Plain-XLA attention.  Layout (B, S, H, D); GQA-aware."""
+    batch, seq_len, num_heads, head_dim = q.shape
+    num_kv = k.shape[2]
+    if num_kv != num_heads:
+        k = jnp.repeat(k, num_heads // num_kv, axis=2)
+        v = jnp.repeat(v, num_heads // num_kv, axis=2)
+    scale = head_dim ** -0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum('bhqk,bkhd->bqhd', p, v)
+
+
+def _use_pallas(q: jax.Array, force: Optional[bool]) -> bool:
+    if force is not None:
+        return force
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        return False
+    if platform != 'tpu':
+        return False
+    seq_len, head_dim = q.shape[1], q.shape[3]
+    return _pick_block(seq_len) is not None and head_dim % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention_vjp(q, k, v, causal):
+    # (B, S, H, D) → kernel layout (B, H, S, D) and back.
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    block = _pick_block(qt.shape[2])
+    out = _flash_fwd(qt, kt, vt, causal, block, interpret=False)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _vjp_fwd(q, k, v, causal):
+    return _flash_attention_vjp(q, k, v, causal), (q, k, v)
+
+
+def _vjp_bwd(causal, residuals, g):
+    # Recompute-based backward in f32 (XLA-fused).  O(S^2) transient per
+    # (batch, head) — acceptable under per-layer remat; Pallas bwd later.
+    q, k, v = residuals
+    num_heads, num_kv = q.shape[2], k.shape[2]
+    group = num_heads // num_kv
+    if group != 1:
+        k_full = jnp.repeat(k, group, axis=2)
+        v_full = jnp.repeat(v, group, axis=2)
+    else:
+        k_full, v_full = k, v
+    seq_len, head_dim = q.shape[1], q.shape[3]
+    scale = head_dim ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k_full.astype(jnp.float32)
+    vf = v_full.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    s = jnp.einsum('bqhd,bkhd->bhqk', qf, kf) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum('bhqk,bqhd->bkhd', p, gf)
+    dp = jnp.einsum('bqhd,bkhd->bhqk', gf, vf)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum('bhqk,bkhd->bqhd', ds, kf) * scale
+    dk = jnp.einsum('bhqk,bqhd->bkhd', ds, qf) * scale
+    if group != 1:
+        batch = k.shape[0]
+        dk = dk.reshape(batch, seq_len, num_kv, group, head_dim).sum(3)
+        dv = dv.reshape(batch, seq_len, num_kv, group, head_dim).sum(3)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_flash_attention_vjp.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    use_pallas: Optional[bool] = None) -> jax.Array:
+    """Multi-head attention, layout (batch, seq, heads, head_dim).
+
+    Dispatches to the Pallas kernel on TPU when shapes tile cleanly
+    (seq % 128 == 0, head_dim % 128 == 0); reference XLA path otherwise.
+    """
+    if q.ndim != 4:
+        raise ValueError(f'Expected (B, S, H, D), got {q.shape}')
+    if _use_pallas(q, use_pallas):
+        return _flash_attention_vjp(q, k, v, causal)
+    return reference_attention(q, k, v, causal=causal)

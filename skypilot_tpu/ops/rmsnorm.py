@@ -1,0 +1,62 @@
+"""RMSNorm: Pallas kernel + XLA fallback.
+
+One VMEM-resident row-block per grid step; the mean-of-squares reduction and
+the scale multiply run on the VPU without an HBM round-trip between them.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rmsnorm_pallas(x2d: jax.Array, weight: jax.Array, eps: float,
+                    interpret: bool) -> jax.Array:
+    rows, dim = x2d.shape
+    block_rows = 256
+    while rows % block_rows != 0:
+        block_rows //= 2
+    kernel = functools.partial(_rmsnorm_kernel, eps=eps)
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((dim,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, dim), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=interpret,
+    )(x2d, weight)
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
+             use_pallas: Optional[bool] = None) -> jax.Array:
+    """y = x / rms(x) * weight over the last dim."""
+    if use_pallas is None:
+        try:
+            use_pallas = jax.devices()[0].platform == 'tpu' and (
+                x.shape[-1] % 128 == 0)
+        except RuntimeError:
+            use_pallas = False
+    if use_pallas:
+        shape = x.shape
+        y = _rmsnorm_pallas(x.reshape(-1, shape[-1]), weight, eps,
+                            interpret=False)
+        return y.reshape(shape)
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)

@@ -1,0 +1,30 @@
+"""Rotary position embeddings (pure XLA — elementwise, fuses into matmuls)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int,
+                     theta: float = 500000.0) -> tuple:
+    """(cos, sin) tables of shape (max_seq_len, head_dim // 2), f32."""
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array = None) -> jax.Array:
+    """x: (B, S, H, D); cos/sin: (max_seq, D//2); positions: (B, S) or None."""
+    seq_len = x.shape[1]
+    if positions is None:
+        c = cos[:seq_len][None, :, None, :]   # (1, S, 1, D/2)
+        s = sin[:seq_len][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]     # (B, S, 1, D/2)
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

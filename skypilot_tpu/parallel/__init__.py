@@ -1,0 +1,6 @@
+from skypilot_tpu.parallel.mesh import MeshConfig, make_mesh, auto_mesh_config
+from skypilot_tpu.parallel.sharding import (PartitionRules, shard_params,
+                                            constrain)
+
+__all__ = ['MeshConfig', 'make_mesh', 'auto_mesh_config', 'PartitionRules',
+           'shard_params', 'constrain']

@@ -1,0 +1,77 @@
+"""ICI/DCN collective micro-benchmarks.
+
+The TPU-native analog of the reference's NCCL allreduce recipe
+(examples/nccl_test.yaml, which reports algbw/busbw for torch.distributed
+all_reduce) — here the collective is `jax.lax.psum` over a mesh axis and the
+transport is ICI (in-slice) or DCN (multislice), inserted by XLA.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def psum_bench(mesh, axis_name: str = 'dp', payload_mb: float = 128.0,
+               iters: int = 10, warmup: int = 3) -> Dict[str, float]:
+    """All-reduce a payload over `axis_name`; report algbw/busbw GB/s.
+
+    busbw = algbw × 2(n-1)/n (ring all-reduce bus model, matching how
+    nccl-tests and the reference's sample output report it).
+    """
+    n = mesh.shape[axis_name]
+    num_elems = int(payload_mb * 1024 * 1024 / 4)
+    x = jnp.ones((n, num_elems), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis_name, None)))
+
+    def allreduce(arr):
+        return jax.shard_map(
+            lambda a: jax.lax.psum(a, axis_name),
+            mesh=mesh, in_specs=P(axis_name, None),
+            out_specs=P(axis_name, None))(arr)
+
+    fn = jax.jit(allreduce)
+    for _ in range(warmup):
+        fn(x).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+    payload_bytes = num_elems * 4
+    algbw = payload_bytes / elapsed / 1e9
+    busbw = algbw * 2 * (n - 1) / n
+    return {'payload_mb': payload_mb, 'ranks': n, 'time_s': elapsed,
+            'algbw_gbps': algbw, 'busbw_gbps': busbw}
+
+
+def all_gather_bench(mesh, axis_name: str = 'fsdp',
+                     payload_mb: float = 128.0, iters: int = 10,
+                     warmup: int = 3) -> Dict[str, float]:
+    n = mesh.shape[axis_name]
+    num_elems = int(payload_mb * 1024 * 1024 / 4)
+    x = jnp.ones((n, num_elems // n), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis_name, None)))
+
+    def gather(arr):
+        return jax.shard_map(
+            lambda a: jax.lax.all_gather(a, axis_name, tiled=True),
+            mesh=mesh, in_specs=P(axis_name, None), out_specs=P(None, None),
+        )(arr)
+
+    fn = jax.jit(gather)
+    for _ in range(warmup):
+        fn(x).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+    payload_bytes = num_elems * 4
+    algbw = payload_bytes / elapsed / 1e9
+    return {'payload_mb': payload_mb, 'ranks': n, 'time_s': elapsed,
+            'algbw_gbps': algbw, 'busbw_gbps': algbw * (n - 1) / n}

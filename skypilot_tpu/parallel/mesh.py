@@ -1,0 +1,93 @@
+"""Device-mesh construction for TPU slices.
+
+This is the compute-side heart of the TPU-native design (SURVEY.md §2.3):
+instead of the reference's NCCL/torchrun env contract, parallelism is a
+`jax.sharding.Mesh` over the slice's chips with named axes
+
+    ('dp', 'fsdp', 'sp', 'tp')
+
+- dp:   pure data parallel (gradients psum over ICI/DCN)
+- fsdp: data parallel with sharded params/optimizer state (ZeRO-3 analog;
+  all-gather params, reduce-scatter grads — XLA inserts these from shardings)
+- sp:   sequence/context parallel (ring attention over this axis)
+- tp:   tensor parallel (megatron-style row/col sharding; highest-bandwidth
+  innermost axis — keep within a host's ICI neighborhood)
+
+Axis order is outermost→innermost: jax orders mesh axes so the LAST axis
+maps to physically-adjacent devices, so tp (all-reduce heavy) rides the
+fastest ICI links, while dp (one psum per step) can cross DCN.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES: Tuple[str, ...] = ('dp', 'fsdp', 'sp', 'tp')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.sp * self.tp
+
+    def axis_sizes(self) -> Tuple[int, int, int, int]:
+        return (self.dp, self.fsdp, self.sp, self.tp)
+
+    def __str__(self) -> str:
+        return ('mesh(' + ', '.join(
+            f'{a}={s}' for a, s in zip(AXES, self.axis_sizes()) if s > 1)
+            + ')') if self.num_devices > 1 else 'mesh(single-device)'
+
+
+def make_mesh(config: MeshConfig,
+              devices: Optional[Sequence] = None):
+    """Build a jax.sharding.Mesh with the canonical axis names."""
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f'{config} needs {config.num_devices} devices, have '
+            f'{len(devices)}.')
+    arr = np.asarray(devices).reshape(config.axis_sizes())
+    return jax.sharding.Mesh(arr, AXES)
+
+
+def auto_mesh_config(num_devices: int,
+                     model_params_b: float = 8.0,
+                     seq_len: int = 8192) -> MeshConfig:
+    """Heuristic mesh for a given chip count and model scale.
+
+    Policy (scaling-book recipe): shard params with fsdp until per-chip
+    param+optimizer state fits comfortably; add tp for models too large for
+    pure fsdp at small batch; add sp only for long context (>32k); rest dp.
+    """
+    remaining = num_devices
+    tp = 1
+    if model_params_b >= 30:
+        tp = min(4, remaining)
+    if model_params_b >= 100:
+        tp = min(8, remaining)
+    remaining //= tp
+    sp = 1
+    if seq_len > 32768 and remaining >= 4:
+        sp = 4
+        remaining //= sp
+    # fsdp: enough shards that params fit; 8B bf16 params+fp32 adam ≈ 96GB
+    # → ≥8 shards on 16GB-HBM chips.  Cap at remaining.
+    want_fsdp = max(1, int(2 ** math.ceil(math.log2(
+        max(1.0, model_params_b * 12 / 12.0)))))  # ≈1 shard per GB @16GB HBM
+    fsdp = 1
+    while fsdp * 2 <= min(remaining, want_fsdp):
+        fsdp *= 2
+    remaining //= fsdp
+    return MeshConfig(dp=remaining, fsdp=fsdp, sp=sp, tp=tp)

@@ -1,0 +1,118 @@
+"""Ring attention: exact causal attention over a sequence-parallel mesh axis.
+
+Long-context is first-class in the TPU-native design (the reference has no
+sequence parallelism anywhere — SURVEY.md §5.7).  Each device holds a
+contiguous sequence shard of Q/K/V; K/V chunks rotate around the 'sp' ring
+via `lax.ppermute` (XLA lowers to ICI neighbor exchanges) while each device
+accumulates its partial attention with an online-softmax merge, so the full
+S×S score matrix never materializes and comms overlap compute.
+
+Used through `shard_map` (`ring_attention(...)` wraps it); the per-shard
+math is `_ring_attention_local`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_offset, k_offset, causal):
+    """Partial attention of local q against one k/v chunk.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D).  Returns (m, l, acc) partials:
+    m, l: (B, H, Sq, 1) f32; acc: (B, H, Sq, D) f32.
+    """
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = q_offset + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (seq_q, seq_k), 0)
+        kpos = k_offset + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (seq_q, seq_k), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                      # (B,H,Sq,1)
+    p = jnp.exp(s - m)
+    # Fully-masked rows: make their contribution exactly zero.
+    p = jnp.where(m <= _NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum('bhqk,bkhd->bhqd', p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def _merge(m1, l1, acc1, m2, l2, acc2):
+    """Merge two online-softmax partials."""
+    m = jnp.maximum(m1, m2)
+    # Guard exp(-inf - -inf): where a side is empty its l is 0 anyway.
+    c1 = jnp.exp(jnp.maximum(m1 - m, _NEG_INF))
+    c2 = jnp.exp(jnp.maximum(m2 - m, _NEG_INF))
+    return m, l1 * c1 + l2 * c2, acc1 * c1 + acc2 * c2
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body (inside shard_map).  q/k/v: (B, S_local, H, D)."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    seq_local = q.shape[1]
+    num_heads = q.shape[2]
+    num_kv = k.shape[2]
+    if num_kv != num_heads:
+        k = jnp.repeat(k, num_heads // num_kv, axis=2)
+        v = jnp.repeat(v, num_heads // num_kv, axis=2)
+    q_offset = my_idx * seq_local
+
+    batch, _, heads, hd = q.shape
+    m0 = jnp.full((batch, heads, seq_local, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, heads, seq_local, 1), jnp.float32)
+    a0 = jnp.zeros((batch, heads, seq_local, hd), jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(t, carry):
+        m, l, acc, kc, vc = carry
+        # At step t this device holds the chunk originating at (my_idx - t).
+        src = (my_idx - t) % axis_size
+        mp, lp, ap = _block_attend(q, kc, vc, q_offset, src * seq_local,
+                                   causal)
+        m, l, acc = _merge(m, l, acc, mp, lp, ap)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return m, l, acc, kc, vc
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, axis_size, step,
+                                        (m0, l0, a0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.einsum('bhqd->bqhd', out).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, *, axis_name: str = 'sp',
+                   causal: bool = True,
+                   batch_axes=('dp', 'fsdp'), head_axis: Optional[str] = 'tp'):
+    """Exact attention with sequence sharded over `axis_name`.
+
+    Layout (B, S, H, D).  Batch may additionally be sharded over
+    `batch_axes` and heads over `head_axis` — those shards are independent.
+    """
+    spec_q = P(batch_axes, axis_name, head_axis, None)
+    spec_kv = P(batch_axes, axis_name, None, None) if head_axis is None else \
+        P(batch_axes, axis_name, head_axis, None)
+    local = functools.partial(_ring_attention_local, axis_name=axis_name,
+                              causal=causal)
+    # KV heads may not divide across tp when using GQA; replicate KV heads
+    # over tp in that case.
+    kv_heads = k.shape[2]
+    tp_size = mesh.shape[head_axis] if head_axis else 1
+    if head_axis and kv_heads % tp_size != 0:
+        spec_kv = P(batch_axes, axis_name, None, None)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,84 @@
+"""Parameter/activation sharding rules.
+
+Regex-path → PartitionSpec rules applied over a params pytree, yielding
+NamedShardings for pjit.  The analog of the reference's per-recipe torchrun
+flags: here parallelism is declarative and XLA inserts the collectives.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]]) -> None:
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self._rules:
+            if pat.search(path):
+                return spec
+        return P()  # replicate by default
+
+    def tree_specs(self, params):
+        """Pytree of PartitionSpecs matching `params`."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        specs = []
+        for path, _ in flat:
+            path_str = '/'.join(_key_str(k) for k in path)
+            specs.append(self.spec_for(path_str))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def _key_str(key) -> str:
+    if hasattr(key, 'key'):
+        return str(key.key)
+    if hasattr(key, 'idx'):
+        return str(key.idx)
+    if hasattr(key, 'name'):
+        return str(key.name)
+    return str(key)
+
+
+def shard_params(params, mesh, rules: PartitionRules):
+    """Device-put params with NamedShardings derived from rules."""
+    specs = rules.tree_specs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def param_shardings(params, mesh, rules: PartitionRules):
+    specs = rules.tree_specs(params)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def constrain(x, mesh, spec: P):
+    """with_sharding_constraint under an explicit mesh."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+# Megatron-style rules for the bundled Llama implementation
+# (skypilot_tpu/models/llama.py param naming).  2D param sharding:
+# tp on the head/ff dimension, fsdp on the d_model dimension.
+LLAMA_RULES = PartitionRules([
+    (r'embed', P('tp', 'fsdp')),                 # (vocab, d)
+    (r'attn/wq|attn/wk|attn/wv', P(None, 'fsdp', 'tp')),   # (L, d, heads*hd)
+    (r'attn/wo', P(None, 'tp', 'fsdp')),         # (L, heads*hd, d)
+    (r'mlp/w_gate|mlp/w_up', P(None, 'fsdp', 'tp')),       # (L, d, ff)
+    (r'mlp/w_down', P(None, 'tp', 'fsdp')),      # (L, ff, d)
+    (r'norm|ln', P()),                           # replicate norms
+    (r'lm_head', P('fsdp', 'tp')),               # (d, vocab)
+])
+
+# Activation specs.  Input tokens shard on batch only (their length is
+# seq+1 for next-token targets, not divisible by sp); the model constrains
+# hidden states to seq-sharded specs internally and XLA reshards once.
+BATCH_SPEC = P(('dp', 'fsdp'))                   # tokens (B, S+1)
+HIDDEN_SPEC = P(('dp', 'fsdp'), 'sp', None)      # hidden (B, S, d)
+LOGITS_SPEC = P(('dp', 'fsdp'), 'sp', 'tp')      # logits (B, S, vocab)

@@ -1,0 +1,4 @@
+from skypilot_tpu.train.trainer import (TrainConfig, Trainer,
+                                        make_optimizer, synthetic_batches)
+
+__all__ = ['TrainConfig', 'Trainer', 'make_optimizer', 'synthetic_batches']

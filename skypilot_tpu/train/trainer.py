@@ -1,0 +1,167 @@
+"""Sharded training loop: optax + pjit + orbax checkpointing.
+
+The analog of what the reference delegates to torchtune/deepspeed in its
+recipes (llm/llama-3_1-finetuning): here it is a first-class library.  The
+whole step (fwd + bwd + optimizer) is one jitted function with explicit
+in/out shardings; XLA inserts all-gathers/reduce-scatters from the fsdp/tp
+shardings.  Checkpoint/resume uses Orbax to GCS or local disk, matching the
+reference's user-level checkpoint contract (SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.parallel import sharding as sharding_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    max_grad_norm: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.95
+
+
+def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=config.learning_rate,
+        warmup_steps=config.warmup_steps,
+        decay_steps=max(config.total_steps, config.warmup_steps + 1),
+        end_value=config.learning_rate * 0.1)
+    return optax.chain(
+        optax.clip_by_global_norm(config.max_grad_norm),
+        optax.adamw(schedule, b1=config.b1, b2=config.b2,
+                    weight_decay=config.weight_decay),
+    )
+
+
+def synthetic_batches(batch_size: int, seq_len: int, vocab_size: int,
+                      seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Deterministic synthetic token stream (benches / smoke tests)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {'tokens': rng.integers(
+            0, vocab_size, (batch_size, seq_len + 1), dtype=np.int32)}
+
+
+class Trainer:
+    """Builds and runs a fully-sharded train step over a mesh."""
+
+    def __init__(self,
+                 loss_fn: Callable[[Any, Dict[str, jax.Array]], jax.Array],
+                 params: Any,
+                 mesh,
+                 rules: sharding_lib.PartitionRules,
+                 config: TrainConfig = TrainConfig(),
+                 batch_spec: P = sharding_lib.BATCH_SPEC):
+        self.mesh = mesh
+        self.config = config
+        self.tx = make_optimizer(config)
+        param_sharding = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), rules.tree_specs(params))
+        self.params = jax.tree.map(jax.device_put, params, param_sharding)
+        # Optimizer state shards like the params it mirrors (scalars and
+        # count leaves replicate).
+        self.opt_state = jax.jit(
+            self.tx.init,
+            out_shardings=self._opt_state_shardings(param_sharding))(
+                self.params)
+        self.step = 0
+        self._loss_fn = loss_fn
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
+        self._train_step = self._build_train_step()
+
+    def _opt_state_shardings(self, param_sharding):
+        """Adam mu/nu shard like params; scalar counts replicate."""
+        opt_shape = jax.eval_shape(self.tx.init, self.params)
+        replicated = NamedSharding(self.mesh, P())
+        # optax state pytrees embed copies of the param tree (adam mu/nu);
+        # map any leaf whose shape matches a param leaf to that param's
+        # sharding, replicate the rest (step counts, scalars).
+        param_leaves = jax.tree.leaves(self.params)
+        shard_leaves = jax.tree.leaves(param_sharding)
+        by_shape = {}
+        for p, s in zip(param_leaves, shard_leaves):
+            by_shape[p.shape] = s
+
+        def leaf_sharding(leaf):
+            return by_shape.get(leaf.shape, replicated)
+
+        return jax.tree.map(leaf_sharding, opt_shape)
+
+    def _build_train_step(self):
+        tx = self.tx
+        loss_fn = self._loss_fn
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            gnorm = optax.global_norm(grads)
+            return params, opt_state, {'loss': loss, 'grad_norm': gnorm}
+
+        return train_step
+
+    def run_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        batch = {k: jax.device_put(v, self._batch_sharding)
+                 for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._train_step(
+            self.params, self.opt_state, batch)
+        self.step += 1
+        return metrics
+
+    def fit(self, batches: Iterator[Dict[str, np.ndarray]], num_steps: int,
+            log_every: int = 10,
+            tokens_per_batch: Optional[int] = None) -> Dict[str, float]:
+        """Run steps; returns summary incl. steady-state throughput."""
+        times = []
+        last_metrics: Dict[str, Any] = {}
+        for i in range(num_steps):
+            batch = next(batches)
+            start = time.perf_counter()
+            last_metrics = self.run_step(batch)
+            jax.block_until_ready(last_metrics)
+            times.append(time.perf_counter() - start)
+            if log_every and (i + 1) % log_every == 0:
+                print(f'step {self.step}: loss='
+                      f'{float(last_metrics["loss"]):.4f} '
+                      f'({times[-1]*1e3:.0f} ms)')
+        steady = times[len(times) // 2:]  # skip compile+warmup half
+        step_time = float(np.median(steady))
+        out = {'loss': float(last_metrics.get('loss', np.nan)),
+               'step_time_s': step_time}
+        if tokens_per_batch:
+            out['tokens_per_sec'] = tokens_per_batch / step_time
+        return out
+
+    # ---- checkpointing (Orbax; local path or gs:// URI) ------------------
+    def save_checkpoint(self, path: str) -> None:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(f'{path}/step_{self.step}',
+                   {'params': self.params, 'opt_state': self.opt_state},
+                   force=True)
+        ckptr.wait_until_finished()
+
+    def restore_checkpoint(self, path: str, step: int) -> None:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(
+            f'{path}/step_{step}',
+            {'params': self.params, 'opt_state': self.opt_state})
+        self.params = restored['params']
+        self.opt_state = restored['opt_state']
+        self.step = step

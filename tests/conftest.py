@@ -7,11 +7,18 @@ tests/common_test_fixtures.py:182 — everything testable with no cloud/TPU).
 """
 import os
 
+# Belt and braces: env vars work when jax is not yet imported...
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
+# ...but this sandbox's sitecustomize imports jax before conftest runs, so
+# also set the config programmatically (effective until backend init).
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', 8)
 
 import pytest  # noqa: E402
 

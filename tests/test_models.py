@@ -1,0 +1,87 @@
+"""Model forward/loss + sharded trainer tests (8-device CPU mesh)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama, resnet
+from skypilot_tpu.parallel import MeshConfig, make_mesh
+from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
+
+
+def test_llama_forward_shapes():
+    cfg = llama.LLAMA_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    logits = llama.forward(params, tokens, cfg)
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_llama_causality():
+    """Changing a future token must not affect past logits."""
+    cfg = llama.LLAMA_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    t1 = jnp.zeros((1, 16), jnp.int32)
+    t2 = t1.at[0, 10].set(7)
+    l1 = llama.forward(params, t1, cfg)
+    l2 = llama.forward(params, t2, cfg)
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-5)
+    assert not np.allclose(l1[0, 10:], l2[0, 10:])
+
+
+def test_llama_loss_decreases_under_training():
+    cfg = llama.LLAMA_DEBUG
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def loss(p, batch):
+        return llama.loss_fn(p, batch, cfg)
+
+    trainer = Trainer(loss, params, mesh, sharding_lib.LLAMA_RULES,
+                      TrainConfig(learning_rate=1e-3, warmup_steps=2,
+                                  total_steps=20))
+    batch = next(synthetic_batches(4, 32, cfg.vocab_size))
+    first = float(trainer.run_step(batch)['loss'])
+    for _ in range(8):
+        metrics = trainer.run_step(batch)  # same batch: loss must drop
+    assert float(metrics['loss']) < first
+
+
+def test_llama_param_count_matches_config():
+    cfg = llama.LLAMA_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    actual = sum(p.size for p in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_llama3_8b_config_param_count():
+    # ~8.03B params for the Llama-3-8B shape.
+    assert 7.9e9 < llama.LLAMA3_8B.num_params() < 8.1e9
+
+
+def test_resnet_forward():
+    model = resnet.ResNet18Thin(dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+
+
+def test_trainer_checkpoint_roundtrip(tmp_path):
+    cfg = llama.LLAMA_DEBUG
+    mesh = make_mesh(MeshConfig(dp=8))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def loss(p, batch):
+        return llama.loss_fn(p, batch, cfg)
+
+    trainer = Trainer(loss, params, mesh, sharding_lib.LLAMA_RULES)
+    batch = next(synthetic_batches(8, 16, cfg.vocab_size))
+    trainer.run_step(batch)
+    trainer.save_checkpoint(str(tmp_path / 'ckpt'))
+    before = jax.tree.map(np.asarray, trainer.params)
+    trainer.run_step(batch)
+    trainer.restore_checkpoint(str(tmp_path / 'ckpt'), step=1)
+    after = jax.tree.map(np.asarray, trainer.params)
+    jax.tree.map(np.testing.assert_allclose, before, after)

@@ -1,0 +1,83 @@
+"""Kernel correctness vs reference implementations (CPU interpret mode)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.ops import attention, rmsnorm, rope
+
+
+def _mha_inputs(batch=2, seq=256, heads=4, kv_heads=2, dim=64, seed=0):
+    key = jax.random.PRNGKey(seed)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (batch, seq, heads, dim), jnp.float32)
+    k = jax.random.normal(kk, (batch, seq, kv_heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (batch, seq, kv_heads, dim), jnp.float32)
+    return q, k, v
+
+
+def test_flash_fwd_matches_reference_interpret():
+    q, k, v = _mha_inputs()
+    ref = attention.reference_attention(q, k, v, causal=True)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out = attention._flash_fwd(qt, kt, vt, causal=True, block=128,
+                               interpret=True)
+    out = jnp.swapaxes(out, 1, 2)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_fwd_non_causal_interpret():
+    q, k, v = _mha_inputs(seq=128)
+    ref = attention.reference_attention(q, k, v, causal=False)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out = attention._flash_fwd(qt, kt, vt, causal=False, block=128,
+                               interpret=True)
+    out = jnp.swapaxes(out, 1, 2)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_dispatch_falls_back_on_cpu():
+    q, k, v = _mha_inputs(seq=100)  # odd seq → fallback regardless
+    out = attention.flash_attention(q, k, v)
+    ref = attention.reference_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_attention_backward_matches_reference():
+    q, k, v = _mha_inputs(batch=1, seq=64, heads=2, kv_heads=1, dim=32)
+
+    def loss_custom(q, k, v):
+        # exercise the custom_vjp path (pallas fwd in interpret not needed:
+        # use reference fwd shape contract via _flash_attention_vjp bwd)
+        return jnp.sum(attention._vjp_fwd(q, k, v, True)[0] ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention.reference_attention(q, k, v, causal=True) ** 2)
+
+    # Compare the hand-written bwd against autodiff of the reference.
+    out_ref, grads_ref = jax.value_and_grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    out = attention.reference_attention(q, k, v, causal=True)
+    g = 2 * out
+    grads_manual = attention._vjp_bwd(True, (q, k, v), g)
+    for gm, gr in zip(grads_manual, grads_ref):
+        np.testing.assert_allclose(gm, gr, atol=1e-4, rtol=1e-4)
+
+
+def test_rmsnorm_pallas_matches_reference():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 256), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (256,), jnp.float32)
+    ref = rmsnorm.rms_norm(x, w, use_pallas=False)
+    out = rmsnorm._rmsnorm_pallas(x, w, eps=1e-5, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_rope_rotation_properties():
+    cos, sin = rope.rope_frequencies(64, 128)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 2, 64))
+    y = rope.apply_rope(x, cos, sin)
+    # Norms preserved per (pos, head) vector.
+    np.testing.assert_allclose(
+        jnp.linalg.norm(y, axis=-1), jnp.linalg.norm(x, axis=-1),
+        atol=1e-4, rtol=1e-4)
+    # Position 0 is identity.
+    np.testing.assert_allclose(y[:, 0], x[:, 0], atol=1e-6)
