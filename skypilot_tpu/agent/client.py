@@ -1,0 +1,101 @@
+"""HTTP client for the head agent (reference parity: SkyletClient,
+sky/backends/cloud_vm_ray_backend.py:3071, minus the gRPC transport)."""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils.status_lib import JobStatus
+
+
+class AgentClient:
+
+    def __init__(self, base_url: str, timeout: float = 30.0) -> None:
+        self.base_url = base_url.rstrip('/')
+        self.timeout = timeout
+
+    def _url(self, path: str) -> str:
+        return f'{self.base_url}{path}'
+
+    def health(self) -> Dict[str, Any]:
+        resp = requests.get(self._url('/health'), timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        last_err: Optional[Exception] = None
+        while time.time() < deadline:
+            try:
+                if self.health().get('ok'):
+                    return
+            except requests.RequestException as e:
+                last_err = e
+            time.sleep(0.5)
+        raise exceptions.ClusterNotUpError(
+            f'Agent at {self.base_url} not ready: {last_err}')
+
+    def submit_job(self, spec: Dict[str, Any]) -> int:
+        resp = requests.post(self._url('/jobs/submit'), json=spec,
+                             timeout=self.timeout)
+        resp.raise_for_status()
+        return int(resp.json()['job_id'])
+
+    def queue(self, all_jobs: bool = False) -> List[Dict[str, Any]]:
+        resp = requests.get(self._url('/jobs/queue'),
+                            params={'all': int(all_jobs)},
+                            timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()['jobs']
+
+    def job_status(self, job_id: int) -> Optional[JobStatus]:
+        resp = requests.get(self._url('/jobs/status'),
+                            params={'job_id': job_id}, timeout=self.timeout)
+        if resp.status_code == 404:
+            return None
+        resp.raise_for_status()
+        return JobStatus(resp.json()['status'])
+
+    def cancel(self, job_ids: Optional[List[int]] = None) -> List[int]:
+        resp = requests.post(self._url('/jobs/cancel'),
+                             json={'job_ids': job_ids}, timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()['cancelled']
+
+    def tail_logs(self, job_id: Optional[int] = None, rank: int = 0,
+                  follow: bool = True) -> Iterator[str]:
+        params: Dict[str, Any] = {'rank': rank, 'follow': int(follow)}
+        if job_id is not None:
+            params['job_id'] = job_id
+        with requests.get(self._url('/jobs/tail'), params=params,
+                          stream=True, timeout=None) as resp:
+            resp.raise_for_status()
+            for line in resp.iter_lines(decode_unicode=True):
+                yield line + '\n'
+
+    def wait_job(self, job_id: int, timeout: Optional[float] = None,
+                 poll: float = 1.0) -> JobStatus:
+        deadline = time.time() + timeout if timeout else None
+        while True:
+            status = self.job_status(job_id)
+            if status is not None and status.is_terminal():
+                return status
+            if deadline and time.time() > deadline:
+                raise exceptions.JobNotFoundError(
+                    f'Job {job_id} did not finish within {timeout}s '
+                    f'(status {status}).')
+            time.sleep(poll)
+
+    def set_autostop(self, idle_minutes: int, down: bool = True) -> None:
+        resp = requests.post(self._url('/autostop'),
+                             json={'idle_minutes': idle_minutes,
+                                   'down': down}, timeout=self.timeout)
+        resp.raise_for_status()
+
+    def get_autostop(self) -> Dict[str, Any]:
+        resp = requests.get(self._url('/autostop'), timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()

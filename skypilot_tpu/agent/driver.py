@@ -1,0 +1,167 @@
+"""Gang driver: runs one ranked command per host, with all-or-nothing
+failure semantics.
+
+The no-Ray replacement for the reference's generated Ray driver program
+(RayCodeGen, sky/backends/cloud_vm_ray_backend.py:281-813).  A TPU pod slice
+is already gang-scheduled by the TPU API, so placement groups reduce to
+"spawn the command on every host with rank envs" — which is what the
+reference's driver ultimately does per bundle.  Failure semantics mirror
+get_or_fail (:377-424): first non-zero exit cancels every other rank
+(cancelled ranks report 137), and the job turns FAILED.
+
+Run on the head host: ``python -m skypilot_tpu.agent.driver <spec.json>``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.utils import env_contract
+from skypilot_tpu.utils.status_lib import JobStatus
+
+_CANCELLED_RC = 137
+
+
+def _rank_argv(host: Dict[str, Any], cmd: str,
+               env: Dict[str, str]) -> tuple:
+    """(argv, cwd, env_overlay) to start this rank's process from the head."""
+    ssh = host.get('ssh')
+    if ssh is None:
+        # Local host (the `local` cloud, or the head itself on GCP).
+        return (['/bin/bash', '-c', cmd], host.get('workdir'), env)
+    from skypilot_tpu.utils.command_runner import build_ssh_argv
+    exports = ' '.join(
+        f'export {k}={shlex.quote(v)};' for k, v in env.items())
+    # -tt: force a tty so the remote side gets SIGHUP (and dies) when the
+    # local ssh client is killed during gang-cancel.
+    argv = build_ssh_argv(
+        host['internal_ip'], user=ssh['user'],
+        key_path=ssh.get('key_path'), port=ssh.get('port', 22),
+    ) + ['-tt', 'bash', '-c', shlex.quote(exports + ' ' + cmd)]
+    return (argv, None, None)
+
+
+def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
+             job_id: int) -> int:
+    hosts: List[Dict[str, Any]] = spec['hosts']
+    commands: List[Optional[str]] = spec['commands']
+    log_dir = os.path.expanduser(spec['log_dir'])
+    os.makedirs(log_dir, exist_ok=True)
+    node_ips = [h['internal_ip'] for h in hosts]
+    num_slices = int(spec.get('num_slices', 1))
+    hosts_per_slice = max(len(hosts) // num_slices, 1)
+
+    job_table.set_status(job_id, JobStatus.RUNNING)
+    procs: List[Optional[subprocess.Popen]] = [None] * len(hosts)
+    returncodes: List[Optional[int]] = [None] * len(hosts)
+    failed_event = threading.Event()
+    lock = threading.Lock()
+
+    def _run_rank(rank: int) -> None:
+        cmd = commands[rank]
+        if cmd is None:
+            returncodes[rank] = 0
+            return
+        env = dict(spec.get('envs', {}))
+        env.update(env_contract.make_env_vars(
+            rank, node_ips,
+            num_chips_per_node=int(spec.get('num_chips_per_node', 0)),
+            task_id=spec.get('task_id', ''),
+            num_slices=num_slices,
+            slice_id=rank // hosts_per_slice))
+        argv, cwd, env_overlay = _rank_argv(hosts[rank], cmd, env)
+        full_env = dict(os.environ)
+        if env_overlay:
+            full_env.update(env_overlay)
+        log_path = os.path.join(log_dir, f'rank-{rank}.log')
+        with open(log_path, 'ab') as log_f:
+            try:
+                proc = subprocess.Popen(argv, cwd=cwd, env=full_env,
+                                        stdout=log_f,
+                                        stderr=subprocess.STDOUT,
+                                        start_new_session=True)
+            except OSError as e:
+                log_f.write(f'driver: spawn failed: {e}\n'.encode())
+                returncodes[rank] = 255
+                failed_event.set()
+                return
+            with lock:
+                procs[rank] = proc
+                _LIVE_PROCS.append(proc)
+            rc = proc.wait()
+            returncodes[rank] = rc
+            if rc != 0:
+                failed_event.set()
+
+    threads = [threading.Thread(target=_run_rank, args=(r,), daemon=True)
+               for r in range(len(hosts))]
+    for t in threads:
+        t.start()
+
+    # Monitor: first failure cancels the rest (gang semantics).
+    while any(t.is_alive() for t in threads):
+        if failed_event.is_set():
+            with lock:
+                for p in procs:
+                    if p is not None and p.poll() is None:
+                        try:
+                            os.killpg(os.getpgid(p.pid), 15)
+                        except (ProcessLookupError, OSError):
+                            pass
+            break
+        time.sleep(0.2)
+    for t in threads:
+        t.join(timeout=30)
+    final = [(_CANCELLED_RC if rc is None else rc) for rc in returncodes]
+
+    if all(rc == 0 for rc in final):
+        job_table.set_status(job_id, JobStatus.SUCCEEDED)
+        return 0
+    job_table.set_status(job_id, JobStatus.FAILED)
+    bad = {i: rc for i, rc in enumerate(final) if rc != 0}
+    print(f'driver: job {job_id} failed; per-rank returncodes {bad} '
+          f'(137 = cancelled by gang failure)', file=sys.stderr)
+    return 1
+
+
+# Rank processes currently alive, for the SIGTERM handler (the agent's
+# cancel path kills the driver's process group; ranks run in their own
+# sessions, so the driver must forward the kill).
+_LIVE_PROCS: List[subprocess.Popen] = []
+
+
+def _kill_ranks(*_args) -> None:
+    for p in list(_LIVE_PROCS):
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+
+
+def main() -> int:
+    spec_path = sys.argv[1]
+    with open(spec_path, encoding='utf-8') as f:
+        spec = json.load(f)
+    job_table = job_lib.JobTable(spec['job_db'])
+    job_id = int(spec['job_id'])
+    signal.signal(signal.SIGTERM, lambda *a: (_kill_ranks(), sys.exit(143)))
+    try:
+        return run_gang(spec, job_table, job_id)
+    except SystemExit:
+        raise
+    except BaseException:  # noqa: B036 — any driver crash must mark the job
+        job_table.set_status(job_id, JobStatus.FAILED_DRIVER)
+        raise
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""On-cluster job queue + state machine (sqlite).
+
+Reference parity: sky/skylet/job_lib.py (1,326 LoC) — job table, JobStatus
+transitions INIT→PENDING→SETTING_UP→RUNNING→terminal, cancel semantics.
+Runs on the head host; the agent server and gang driver both open the same
+sqlite file (WAL mode for cross-process safety).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils.status_lib import JobStatus
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    username TEXT,
+    submitted_at REAL,
+    status TEXT,
+    run_timestamp TEXT,
+    start_at REAL,
+    end_at REAL,
+    resources TEXT,
+    pid INTEGER DEFAULT -1,
+    log_dir TEXT,
+    spec_json TEXT
+);
+"""
+
+
+class JobTable:
+
+    def __init__(self, db_path: str) -> None:
+        self.db_path = os.path.expanduser(db_path)
+        os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.db_path, timeout=30)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    # ---- lifecycle -------------------------------------------------------
+    def add_job(self, name: Optional[str], username: str, run_timestamp: str,
+                log_dir: str, spec: Dict[str, Any],
+                resources_str: str = '') -> int:
+        with self._conn() as conn:
+            cur = conn.execute(
+                'INSERT INTO jobs (name, username, submitted_at, status, '
+                'run_timestamp, resources, log_dir, spec_json) VALUES '
+                '(?, ?, ?, ?, ?, ?, ?, ?)',
+                (name, username, time.time(), JobStatus.INIT.value,
+                 run_timestamp, resources_str, log_dir, json.dumps(spec)))
+            return int(cur.lastrowid)
+
+    def set_status(self, job_id: int, status: JobStatus) -> None:
+        updates = 'status = ?'
+        args: List[Any] = [status.value]
+        if status == JobStatus.RUNNING:
+            updates += ', start_at = ?'
+            args.append(time.time())
+        if status.is_terminal():
+            updates += ', end_at = ?'
+            args.append(time.time())
+        args.append(job_id)
+        with self._conn() as conn:
+            conn.execute(f'UPDATE jobs SET {updates} WHERE job_id = ?', args)
+
+    def set_pid(self, job_id: int, pid: int) -> None:
+        with self._conn() as conn:
+            conn.execute('UPDATE jobs SET pid = ? WHERE job_id = ?',
+                         (pid, job_id))
+
+    def set_log_dir(self, job_id: int, log_dir: str) -> None:
+        with self._conn() as conn:
+            conn.execute('UPDATE jobs SET log_dir = ? WHERE job_id = ?',
+                         (log_dir, job_id))
+
+    # ---- queries ---------------------------------------------------------
+    def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        with self._conn() as conn:
+            row = conn.execute('SELECT * FROM jobs WHERE job_id = ?',
+                               (job_id,)).fetchone()
+            return dict(row) if row else None
+
+    def get_status(self, job_id: int) -> Optional[JobStatus]:
+        job = self.get_job(job_id)
+        return JobStatus(job['status']) if job else None
+
+    def get_latest_job_id(self) -> Optional[int]:
+        with self._conn() as conn:
+            row = conn.execute(
+                'SELECT job_id FROM jobs ORDER BY job_id DESC LIMIT 1'
+            ).fetchone()
+            return int(row['job_id']) if row else None
+
+    def queue(self, all_jobs: bool = False) -> List[Dict[str, Any]]:
+        q = 'SELECT * FROM jobs'
+        if not all_jobs:
+            terminal = tuple(s.value for s in JobStatus.terminal_statuses())
+            q += (' WHERE status NOT IN (' +
+                  ','.join('?' * len(terminal)) + ')')
+            with self._conn() as conn:
+                rows = conn.execute(q + ' ORDER BY job_id DESC',
+                                    terminal).fetchall()
+        else:
+            with self._conn() as conn:
+                rows = conn.execute(q + ' ORDER BY job_id DESC').fetchall()
+        return [dict(r) for r in rows]
+
+    def last_activity_time(self) -> float:
+        """Latest job submit/end time (consulted by autostop)."""
+        with self._conn() as conn:
+            row = conn.execute(
+                'SELECT MAX(submitted_at) AS s, MAX(end_at) AS e FROM jobs'
+            ).fetchone()
+        candidates = [row['s'] or 0.0, row['e'] or 0.0]
+        return max(candidates)
+
+    def has_active_jobs(self) -> bool:
+        return bool(self.queue(all_jobs=False))
+
+    def cancel(self, job_ids: Optional[List[int]] = None) -> List[int]:
+        """Mark CANCELLED and kill driver pids.  None → all active."""
+        import signal
+        active = self.queue(all_jobs=False)
+        targets = [j for j in active
+                   if job_ids is None or j['job_id'] in job_ids]
+        cancelled = []
+        for job in targets:
+            if job['pid'] and job['pid'] > 0:
+                try:
+                    os.killpg(os.getpgid(job['pid']), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+            self.set_status(job['job_id'], JobStatus.CANCELLED)
+            cancelled.append(job['job_id'])
+        return cancelled

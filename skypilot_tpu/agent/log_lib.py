@@ -1,0 +1,66 @@
+"""Log capture and tailing.
+
+Reference parity: sky/skylet/log_lib.py (run_bash_command_with_log — used
+inside the generated driver at cloud_vm_ray_backend.py:634 — and tailing).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Dict, Iterator, Optional
+
+
+def run_bash_command_with_log(cmd: str, log_path: str, *,
+                              cwd: Optional[str] = None,
+                              env: Optional[Dict[str, str]] = None,
+                              stream_to_stdout: bool = False) -> int:
+    """Run `bash -c cmd`, teeing combined output to log_path.  Creates a new
+    process group so gang-cancel can kill the whole tree."""
+    os.makedirs(os.path.dirname(os.path.expanduser(log_path)) or '.',
+                exist_ok=True)
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    with open(os.path.expanduser(log_path), 'ab') as log_f:
+        proc = subprocess.Popen(
+            ['/bin/bash', '-c', cmd], cwd=cwd, env=full_env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            log_f.write(line)
+            log_f.flush()
+            if stream_to_stdout:
+                print(line.decode(errors='replace'), end='', flush=True)
+        return proc.wait()
+
+
+def tail_logs(log_path: str, *, follow: bool = False,
+              from_start: bool = True, stop_when: Optional[callable] = None,
+              poll_interval: float = 0.5) -> Iterator[str]:
+    """Yield log lines; with follow=True keep polling until stop_when()."""
+    path = os.path.expanduser(log_path)
+    # Wait for the file to appear (driver may not have started writing).
+    deadline = time.time() + 30
+    while not os.path.exists(path):
+        if not follow or time.time() > deadline:
+            return
+        time.sleep(poll_interval)
+    with open(path, encoding='utf-8', errors='replace') as f:
+        if not from_start:
+            f.seek(0, os.SEEK_END)
+        while True:
+            line = f.readline()
+            if line:
+                yield line
+                continue
+            if not follow:
+                return
+            if stop_when is not None and stop_when():
+                # Drain whatever appeared between the check and now.
+                rest = f.read()
+                if rest:
+                    yield rest
+                return
+            time.sleep(poll_interval)

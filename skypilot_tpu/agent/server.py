@@ -1,0 +1,211 @@
+"""Per-cluster head agent: HTTP job services + periodic events.
+
+Reference parity: the skylet daemon (sky/skylet/skylet.py:44 — gRPC server
+on port 46590 serving Autostop/Jobs services, plus the periodic EVENTS loop
+:26-41).  grpc_tools is unavailable in this build, so the transport is
+JSON-over-HTTP (aiohttp) with the same service shapes; the proto contracts
+live in skypilot_tpu/schemas/agent.md for a later grpc codegen.
+
+Endpoints:
+  GET  /health                  → {ok, agent_version, time}
+  POST /jobs/submit {spec}      → {job_id}   (spawns the gang driver)
+  GET  /jobs/queue?all=0|1      → {jobs: [...]}
+  GET  /jobs/status?job_id=     → {status}
+  POST /jobs/cancel {job_ids?}  → {cancelled: [...]}
+  GET  /jobs/tail?job_id=&rank=&follow=0|1  → text/plain stream
+  POST /autostop {idle_minutes, down}        → {ok}
+
+Periodic events (mirrors sky/skylet/events.py): autostop check.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from skypilot_tpu.agent import job_lib, log_lib
+from skypilot_tpu.utils.status_lib import JobStatus
+
+AGENT_VERSION = 1
+DEFAULT_PORT = 46590  # same port as the reference's skylet gRPC
+
+
+class AgentState:
+
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = os.path.expanduser(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.job_table = job_lib.JobTable(
+            os.path.join(self.base_dir, 'jobs.db'))
+        self.autostop_path = os.path.join(self.base_dir, 'autostop.json')
+        self.started_at = time.time()
+
+    def log_dir_for(self, job_id: int) -> str:
+        return os.path.join(self.base_dir, 'logs', f'job-{job_id}')
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({'error': message}, status=status)
+
+
+def make_app(state: AgentState) -> web.Application:
+    routes = web.RouteTableDef()
+
+    @routes.get('/health')
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({'ok': True, 'agent_version': AGENT_VERSION,
+                                  'time': time.time(),
+                                  'started_at': state.started_at})
+
+    @routes.post('/jobs/submit')
+    async def submit(request: web.Request) -> web.Response:
+        spec: Dict[str, Any] = await request.json()
+        job_id = state.job_table.add_job(
+            name=spec.get('job_name'),
+            username=spec.get('username', 'unknown'),
+            run_timestamp=spec.get('run_timestamp', ''),
+            log_dir='',
+            spec=spec)
+        log_dir = state.log_dir_for(job_id)
+        state.job_table.set_log_dir(job_id, log_dir)
+        spec['log_dir'] = log_dir
+        spec['job_id'] = job_id
+        spec['job_db'] = state.job_table.db_path
+        os.makedirs(log_dir, exist_ok=True)
+        spec_path = os.path.join(log_dir, 'spec.json')
+        with open(spec_path, 'w', encoding='utf-8') as f:
+            json.dump(spec, f)
+        state.job_table.set_status(job_id, JobStatus.PENDING)
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.agent.driver', spec_path],
+            stdout=open(os.path.join(log_dir, 'driver.log'), 'ab'),
+            stderr=subprocess.STDOUT,
+            start_new_session=True)
+        state.job_table.set_pid(job_id, proc.pid)
+        return web.json_response({'job_id': job_id})
+
+    @routes.get('/jobs/queue')
+    async def queue(request: web.Request) -> web.Response:
+        all_jobs = request.query.get('all', '0') == '1'
+        return web.json_response({'jobs': state.job_table.queue(all_jobs)})
+
+    @routes.get('/jobs/status')
+    async def status(request: web.Request) -> web.Response:
+        job_id = int(request.query['job_id'])
+        st = state.job_table.get_status(job_id)
+        if st is None:
+            return _json_error(404, f'job {job_id} not found')
+        return web.json_response({'job_id': job_id, 'status': st.value})
+
+    @routes.post('/jobs/cancel')
+    async def cancel(request: web.Request) -> web.Response:
+        body = await request.json() if request.can_read_body else {}
+        job_ids = body.get('job_ids')
+        cancelled = state.job_table.cancel(job_ids)
+        return web.json_response({'cancelled': cancelled})
+
+    @routes.get('/jobs/tail')
+    async def tail(request: web.Request) -> web.StreamResponse:
+        job_id_s = request.query.get('job_id')
+        job_id = (int(job_id_s) if job_id_s
+                  else state.job_table.get_latest_job_id())
+        if job_id is None:
+            return _json_error(404, 'no jobs')
+        rank = int(request.query.get('rank', 0))
+        follow = request.query.get('follow', '1') == '1'
+        log_path = os.path.join(state.log_dir_for(job_id),
+                                f'rank-{rank}.log')
+        resp = web.StreamResponse(
+            headers={'Content-Type': 'text/plain; charset=utf-8'})
+        await resp.prepare(request)
+
+        def _done() -> bool:
+            st = state.job_table.get_status(job_id)
+            return st is not None and st.is_terminal()
+
+        loop = asyncio.get_running_loop()
+        it = log_lib.tail_logs(log_path, follow=follow, stop_when=_done)
+        while True:
+            line = await loop.run_in_executor(None,
+                                              lambda: next(it, None))
+            if line is None:
+                break
+            await resp.write(line.encode())
+        await resp.write_eof()
+        return resp
+
+    @routes.post('/autostop')
+    async def autostop(request: web.Request) -> web.Response:
+        body = await request.json()
+        with open(state.autostop_path, 'w', encoding='utf-8') as f:
+            json.dump({'idle_minutes': body.get('idle_minutes'),
+                       'down': bool(body.get('down', True)),
+                       'set_at': time.time()}, f)
+        return web.json_response({'ok': True})
+
+    @routes.get('/autostop')
+    async def get_autostop(request: web.Request) -> web.Response:
+        if not os.path.exists(state.autostop_path):
+            return web.json_response({})
+        with open(state.autostop_path, encoding='utf-8') as f:
+            return web.json_response(json.load(f))
+
+    app = web.Application()
+    app.add_routes(routes)
+    return app
+
+
+async def _events_loop(state: AgentState, interval: float) -> None:
+    """Periodic events (mirrors skylet EVENTS sky/skylet/skylet.py:26-41).
+    The autostop event records idleness; enforcement (actual teardown) is
+    done by the client-side status refresh reading /autostop + idle time,
+    since a TPU pod cannot stop itself cleanly mid-delete."""
+    while True:
+        await asyncio.sleep(interval)
+        try:
+            if os.path.exists(state.autostop_path):
+                with open(state.autostop_path, encoding='utf-8') as f:
+                    cfg = json.load(f)
+                idle_from = max(state.job_table.last_activity_time(),
+                                cfg.get('set_at', state.started_at))
+                cfg['idle_seconds'] = (
+                    0.0 if state.job_table.has_active_jobs()
+                    else time.time() - idle_from)
+                with open(state.autostop_path, 'w', encoding='utf-8') as f:
+                    json.dump(cfg, f)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--base-dir', required=True)
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    parser.add_argument('--event-interval', type=float, default=20.0)
+    args = parser.parse_args(argv)
+    state = AgentState(args.base_dir)
+    app = make_app(state)
+
+    async def _run() -> None:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, '0.0.0.0', args.port)
+        await site.start()
+        # Readiness marker for the provisioner.
+        with open(os.path.join(state.base_dir, 'agent.ready'), 'w',
+                  encoding='utf-8') as f:
+            f.write(str(args.port))
+        await _events_loop(state, args.event_interval)
+
+    asyncio.run(_run())
+
+
+if __name__ == '__main__':
+    main()

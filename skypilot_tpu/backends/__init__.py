@@ -1,0 +1,3 @@
+from skypilot_tpu.backends.tpu_backend import TpuBackend
+
+__all__ = ['TpuBackend']

@@ -1,30 +1,233 @@
-"""CLI entry point (mirrors sky/client/cli/command.py, argparse-based).
+"""CLI (argparse-based; click is not bundled in this environment).
 
-The full command surface is built out with the execution engine; this module
-always provides `skytpu --version` and a helpful error for unbuilt commands.
+Reference parity: sky/client/cli/command.py — launch / exec / status /
+queue / logs / cancel / stop / down / autostop / check / show-tpus map 1:1.
+Jobs/serve command groups are registered by their modules.
 """
 from __future__ import annotations
 
 import argparse
 import sys
+import time
+from typing import List, Optional
 
 
-def main(argv=None) -> int:
+def _fmt_table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+    def fmt(row):
+        return '  '.join(str(c).ljust(w) for c, w in zip(row, widths))
+    lines = [fmt(headers)]
+    lines += [fmt(r) for r in rows]
+    return '\n'.join(lines)
+
+
+def _cmd_launch(args) -> int:
+    from skypilot_tpu import execution, task as task_lib
+    task = task_lib.Task.from_yaml(args.yaml)
+    if args.env:
+        task.update_envs(dict(kv.split('=', 1) for kv in args.env))
+    job_id, handle = execution.launch(
+        task, cluster_name=args.cluster, detach_run=args.detach_run,
+        down=args.down)
+    if job_id is not None and handle is not None:
+        print(f'Job {job_id} on cluster {handle.cluster_name!r}.')
+    return 0
+
+
+def _cmd_exec(args) -> int:
+    from skypilot_tpu import execution, task as task_lib
+    task = task_lib.Task.from_yaml(args.yaml)
+    job_id, handle = execution.exec_cmd(task, cluster_name=args.cluster,
+                                        detach_run=args.detach_run)
+    print(f'Job {job_id} on cluster {handle.cluster_name!r}.')
+    return 0
+
+
+def _cmd_status(args) -> int:
+    from skypilot_tpu import core
+    records = core.status(refresh=args.refresh)
+    if not records:
+        print('No existing clusters.')
+        return 0
+    rows = []
+    for r in records:
+        handle = r['handle']
+        age = time.time() - (r['launched_at'] or time.time())
+        rows.append([
+            r['name'],
+            str(handle.launched_resources),
+            str(handle.num_hosts),
+            r['status'].value,
+            f'{age/3600:.1f}h',
+        ])
+    print(_fmt_table(rows, ['NAME', 'RESOURCES', 'HOSTS', 'STATUS', 'AGE']))
+    return 0
+
+
+def _cmd_queue(args) -> int:
+    from skypilot_tpu import core
+    jobs = core.queue(args.cluster, all_jobs=args.all)
+    rows = [[j['job_id'], j.get('name') or '-', j['status'],
+             time.strftime('%m-%d %H:%M',
+                           time.localtime(j['submitted_at']))]
+            for j in jobs]
+    print(_fmt_table(rows, ['ID', 'NAME', 'STATUS', 'SUBMITTED']))
+    return 0
+
+
+def _cmd_logs(args) -> int:
+    from skypilot_tpu import core
+    return core.tail_logs(args.cluster, args.job_id, follow=not args.no_follow,
+                          rank=args.rank)
+
+
+def _cmd_cancel(args) -> int:
+    from skypilot_tpu import core
+    cancelled = core.cancel(args.cluster,
+                            args.job_ids if args.job_ids else None)
+    print(f'Cancelled jobs: {cancelled}')
+    return 0
+
+
+def _cmd_down(args) -> int:
+    from skypilot_tpu import core
+    for name in args.clusters:
+        core.down(name)
+    return 0
+
+
+def _cmd_stop(args) -> int:
+    from skypilot_tpu import core
+    core.stop(args.cluster)
+    return 0
+
+
+def _cmd_autostop(args) -> int:
+    from skypilot_tpu import core
+    core.autostop(args.cluster, args.idle_minutes, down=True)
+    print(f'Autodown set: {args.cluster} after {args.idle_minutes}m idle.')
+    return 0
+
+
+def _cmd_check(args) -> int:
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    ok_any = False
+    for cloud in CLOUD_REGISTRY.values():
+        ok, reason = cloud.check_credentials()
+        mark = '✓' if ok else '✗'
+        print(f'  {mark} {cloud}: {"enabled" if ok else reason}')
+        ok_any = ok_any or ok
+    return 0 if ok_any else 1
+
+
+def _cmd_show_tpus(args) -> int:
+    from skypilot_tpu import catalog
+    accs = catalog.list_accelerators(args.filter or None)
+    rows = []
+    for name, offerings in sorted(accs.items()):
+        cheapest = offerings[0]
+        rows.append([name, str(cheapest.spec.chips),
+                     str(cheapest.spec.num_hosts),
+                     f'${cheapest.price:.2f}', f'${cheapest.spot_price:.2f}',
+                     cheapest.zone])
+    print(_fmt_table(rows, ['TPU', 'CHIPS', 'HOSTS', '$/HR', '$/HR (SPOT)',
+                            'CHEAPEST ZONE']))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
     import skypilot_tpu
     parser = argparse.ArgumentParser(
-        prog='skytpu',
-        description='TPU-native infrastructure orchestration.')
+        prog='skytpu', description='TPU-native infra orchestration.')
     parser.add_argument('--version', action='version',
                         version=f'skypilot-tpu {skypilot_tpu.__version__}')
     sub = parser.add_subparsers(dest='command')
-    sub.add_parser('status', help='Show clusters')
-    args, _ = parser.parse_known_args(argv)
-    if args.command is None:
+
+    p = sub.add_parser('launch', help='Provision and run a task')
+    p.add_argument('yaml')
+    p.add_argument('-c', '--cluster', default=None)
+    p.add_argument('-d', '--detach-run', action='store_true')
+    p.add_argument('--down', action='store_true',
+                   help='Tear down after the job finishes')
+    p.add_argument('--env', action='append', metavar='K=V')
+    p.set_defaults(fn=_cmd_launch)
+
+    p = sub.add_parser('exec', help='Run on an existing cluster (no setup)')
+    p.add_argument('yaml')
+    p.add_argument('-c', '--cluster', required=True)
+    p.add_argument('-d', '--detach-run', action='store_true')
+    p.set_defaults(fn=_cmd_exec)
+
+    p = sub.add_parser('status', help='List clusters')
+    p.add_argument('-r', '--refresh', action='store_true')
+    p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser('queue', help='Cluster job queue')
+    p.add_argument('cluster')
+    p.add_argument('-a', '--all', action='store_true')
+    p.set_defaults(fn=_cmd_queue)
+
+    p = sub.add_parser('logs', help='Tail job logs')
+    p.add_argument('cluster')
+    p.add_argument('job_id', nargs='?', type=int, default=None)
+    p.add_argument('--rank', type=int, default=0)
+    p.add_argument('--no-follow', action='store_true')
+    p.set_defaults(fn=_cmd_logs)
+
+    p = sub.add_parser('cancel', help='Cancel jobs')
+    p.add_argument('cluster')
+    p.add_argument('job_ids', nargs='*', type=int)
+    p.set_defaults(fn=_cmd_cancel)
+
+    p = sub.add_parser('down', help='Terminate clusters')
+    p.add_argument('clusters', nargs='+')
+    p.set_defaults(fn=_cmd_down)
+
+    p = sub.add_parser('stop', help='Stop a cluster (single-host only)')
+    p.add_argument('cluster')
+    p.set_defaults(fn=_cmd_stop)
+
+    p = sub.add_parser('autostop', help='Auto-teardown after idleness')
+    p.add_argument('cluster')
+    p.add_argument('-i', '--idle-minutes', type=int, default=5)
+    p.set_defaults(fn=_cmd_autostop)
+
+    p = sub.add_parser('check', help='Check cloud credentials')
+    p.set_defaults(fn=_cmd_check)
+
+    p = sub.add_parser('show-tpus', help='List TPU offerings and prices')
+    p.add_argument('filter', nargs='?', default=None)
+    p.set_defaults(fn=_cmd_show_tpus)
+
+    # Jobs / serve groups (registered lazily to keep import light).
+    try:
+        from skypilot_tpu.jobs import cli as jobs_cli
+        jobs_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from skypilot_tpu.serve import cli as serve_cli
+        serve_cli.register(sub)
+    except ImportError:
+        pass
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, 'fn', None):
         parser.print_help()
         return 0
-    print(f'skytpu {args.command}: command not wired up yet at this build '
-          'stage.', file=sys.stderr)
-    return 2
+    from skypilot_tpu import exceptions
+    try:
+        return args.fn(args)
+    except exceptions.SkyTpuError as e:
+        print(f'Error: {e}', file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130
 
 
 if __name__ == '__main__':

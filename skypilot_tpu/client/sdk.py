@@ -9,12 +9,6 @@ from __future__ import annotations
 from typing import Any, List, Optional
 
 
-def _not_ready(name: str):
-    raise NotImplementedError(
-        f'skypilot_tpu.{name} is not wired up yet in this build stage; '
-        'the execution engine lands next.')
-
-
 def launch(task, cluster_name: Optional[str] = None, **kwargs) -> Any:
     from skypilot_tpu import execution
     return execution.launch(task, cluster_name=cluster_name, **kwargs)
@@ -22,7 +16,7 @@ def launch(task, cluster_name: Optional[str] = None, **kwargs) -> Any:
 
 def exec(task, cluster_name: str, **kwargs) -> Any:  # pylint: disable=redefined-builtin
     from skypilot_tpu import execution
-    return execution.exec(task, cluster_name=cluster_name, **kwargs)
+    return execution.exec_cmd(task, cluster_name=cluster_name, **kwargs)
 
 
 def status(cluster_names: Optional[List[str]] = None, **kwargs) -> Any:

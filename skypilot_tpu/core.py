@@ -1,0 +1,146 @@
+"""Core cluster operations: status/start/stop/down/autostop/queue/cancel/logs.
+
+Reference parity: sky/core.py (1,386 LoC).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state
+from skypilot_tpu.backends import TpuBackend
+from skypilot_tpu.utils.status_lib import ClusterStatus, JobStatus
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _get_handle(cluster_name: str) -> state.ClusterHandle:
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record['handle']
+
+
+def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Reconcile DB status against the cloud + agent (reference:
+    backend_utils status refresh + sky/server/daemons.py:93)."""
+    handle: state.ClusterHandle = record['handle']
+    name = handle.cluster_name
+    try:
+        statuses = provision_api.query_instances(
+            handle.cluster_info.cloud, name,
+            handle.cluster_info.provider_config)
+    except Exception as e:  # pylint: disable=broad-except
+        # Transient failure (network, credentials): do NOT assume the
+        # cluster is gone — removing the record would orphan live, billing
+        # instances.  Keep the record and surface INIT.
+        logger.warning(f'Status refresh for {name!r} failed ({e}); '
+                       'keeping cached record.')
+        if record['status'] != ClusterStatus.INIT:
+            state.set_cluster_status(name, ClusterStatus.INIT)
+            record = dict(record)
+            record['status'] = ClusterStatus.INIT
+        return record
+    if not statuses:
+        # Query succeeded and found nothing: genuinely gone.
+        state.remove_cluster(name)
+        record = dict(record)
+        record['status'] = None
+        return record
+    if all(s == 'running' for s in statuses.values()):
+        new_status = ClusterStatus.UP
+    elif any(s in ('stopping', 'stopped') for s in statuses.values()):
+        new_status = ClusterStatus.STOPPED
+    else:
+        new_status = ClusterStatus.INIT
+    if new_status != record['status']:
+        state.set_cluster_status(name, new_status)
+        record = dict(record)
+        record['status'] = new_status
+    # Autostop enforcement (the agent only *records* idleness; see
+    # skypilot_tpu/agent/server.py events loop).
+    autostop = record.get('autostop') or {}
+    if new_status == ClusterStatus.UP and autostop.get('idle_minutes') is not None:
+        try:
+            from skypilot_tpu.agent.client import AgentClient
+            info = AgentClient(handle.agent_url(), timeout=5).get_autostop()
+            idle = info.get('idle_seconds', 0.0)
+            if idle > float(autostop['idle_minutes']) * 60:
+                logger.info(f'Cluster {name!r} idle {idle:.0f}s ≥ autostop '
+                            f'{autostop["idle_minutes"]}m; tearing down.')
+                TpuBackend().teardown(handle, terminate=True)
+                record = dict(record)
+                record['status'] = None
+        except requests.RequestException:
+            pass
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    records = state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        records = [r for r in (_refresh_one(r) for r in records)
+                   if r['status'] is not None]
+    return records
+
+
+def start(cluster_name: str) -> None:
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    raise exceptions.NotSupportedError(
+        'Restarting stopped clusters is not supported for TPU pod slices '
+        '(they cannot stop; reference: sky/clouds/gcp.py:217-224). '
+        'Re-launch instead.')
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    TpuBackend().teardown(handle, terminate=False)
+
+
+def down(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    TpuBackend().teardown(handle, terminate=True)
+    logger.info(f'Cluster {cluster_name!r} terminated.')
+
+
+def autostop(cluster_name: str, idle_minutes: int, down: bool = True) -> None:  # pylint: disable=redefined-outer-name
+    if not down:
+        raise exceptions.NotSupportedError(
+            'autostop(down=False) is unsupported for TPU slices; only '
+            'autodown is available.')
+    handle = _get_handle(cluster_name)
+    TpuBackend().set_autostop(handle, idle_minutes, down=down)
+
+
+def queue(cluster_name: str, all_jobs: bool = False) -> List[Dict[str, Any]]:
+    handle = _get_handle(cluster_name)
+    return TpuBackend().queue(handle, all_jobs)
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None) -> List[int]:
+    handle = _get_handle(cluster_name)
+    return TpuBackend().cancel(handle, job_ids)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True, rank: int = 0) -> int:
+    handle = _get_handle(cluster_name)
+    return TpuBackend().tail_logs(handle, job_id, rank=rank, follow=follow)
+
+
+def job_status(cluster_name: str, job_id: int) -> Optional[JobStatus]:
+    handle = _get_handle(cluster_name)
+    from skypilot_tpu.agent.client import AgentClient
+    return AgentClient(handle.agent_url()).job_status(job_id)

@@ -1,0 +1,113 @@
+"""Bucket storage: GCS-first Storage abstraction.
+
+Reference parity: sky/data/storage.py (StoreType :120, StorageMode :297,
+Storage :551) + mounting_utils.py (gcsfuse commands).  GCS is the native
+store for TPU training (checkpoint buckets for managed-job recovery);
+local-path "buckets" make the mode testable hermetically.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import shlex
+import subprocess
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+class StoreType(enum.Enum):
+    GCS = 'gcs'
+    LOCAL = 'local'   # hermetic testing: a directory acts as the bucket
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+    MOUNT_CACHED = 'MOUNT_CACHED'
+
+
+class Storage:
+    """A named bucket with a source to sync and a mount mode."""
+
+    def __init__(self, name: str,
+                 source: Optional[str] = None,
+                 store: StoreType = StoreType.GCS,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 persistent: bool = True) -> None:
+        self.name = name
+        self.source = source
+        self.store = store
+        self.mode = mode
+        self.persistent = persistent
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        mode = StorageMode(config.get('mode', 'MOUNT'))
+        store = StoreType(config.get('store', 'gcs'))
+        name = config.get('name')
+        if not name:
+            raise exceptions.StorageSpecError('storage needs a name:')
+        return cls(name=name, source=config.get('source'), store=store,
+                   mode=mode, persistent=config.get('persistent', True))
+
+    def uri(self) -> str:
+        if self.store == StoreType.GCS:
+            return f'gs://{self.name}'
+        return os.path.expanduser(f'~/.skypilot_tpu/buckets/{self.name}')
+
+    # -- operations (gsutil/gcsfuse CLIs; LOCAL store is plain dirs) ------
+    def create_if_missing(self) -> None:
+        if self.store == StoreType.LOCAL:
+            os.makedirs(self.uri(), exist_ok=True)
+            return
+        subprocess.run(['gsutil', 'mb', '-b', 'on', self.uri()],
+                       check=False, capture_output=True)
+
+    def sync_source(self) -> None:
+        if not self.source:
+            return
+        src = os.path.expanduser(self.source)
+        if self.store == StoreType.LOCAL:
+            os.makedirs(self.uri(), exist_ok=True)
+            subprocess.run(['rsync', '-a', src + '/', self.uri() + '/'],
+                           check=True)
+            return
+        subprocess.run(['gsutil', '-m', 'rsync', '-r', src, self.uri()],
+                       check=True)
+
+    def mount_command(self, mount_path: str) -> str:
+        """Shell command run on each host (mirrors
+        sky/data/mounting_utils.py gcsfuse cmds)."""
+        p = shlex.quote(mount_path)
+        if self.store == StoreType.LOCAL:
+            return (f'mkdir -p {p} && rm -rf {p} && '
+                    f'ln -sfn {shlex.quote(self.uri())} {p}')
+        if self.mode == StorageMode.COPY:
+            return (f'mkdir -p {p} && '
+                    f'gsutil -m rsync -r {shlex.quote(self.uri())} {p}')
+        cache = ('--file-cache-max-size-mb 10240 '
+                 if self.mode == StorageMode.MOUNT_CACHED else '')
+        return (f'mkdir -p {p} && '
+                f'gcsfuse --implicit-dirs {cache}'
+                f'{shlex.quote(self.name)} {p}')
+
+
+def mount_storage(handle, target: str, storage_config: Dict[str, Any]
+                  ) -> None:
+    """Create/sync the bucket, then run the mount command on every host."""
+    from skypilot_tpu.provision import provisioner
+    from skypilot_tpu.utils import command_runner as runner_lib
+    storage = Storage.from_yaml_config(storage_config)
+    storage.create_if_missing()
+    storage.sync_source()
+    runners = provisioner._make_runners(handle.cluster_info)
+    cmd = storage.mount_command(target)
+    rcs = runner_lib.run_on_hosts_parallel(runners, cmd)
+    bad = [i for i, rc in enumerate(rcs) if rc != 0]
+    if bad:
+        raise exceptions.StorageError(
+            f'Mounting {storage.name} at {target} failed on hosts {bad}.')

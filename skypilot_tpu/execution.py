@@ -1,0 +1,129 @@
+"""Execution engine: drives launch/exec through ordered stages.
+
+Reference parity: sky/execution.py — Stage enum :39, _execute :103,
+launch :533, exec :723.  Stages: OPTIMIZE → PROVISION → SYNC_WORKDIR →
+SYNC_FILE_MOUNTS → SETUP → EXEC → (DOWN).  `exec_cmd` skips straight to EXEC
+against the cached handle (the reference's fast-path semantic).
+"""
+from __future__ import annotations
+
+import enum
+import uuid
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import TpuBackend
+from skypilot_tpu.utils.status_lib import JobStatus
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = 'OPTIMIZE'
+    PROVISION = 'PROVISION'
+    SYNC_WORKDIR = 'SYNC_WORKDIR'
+    SYNC_FILE_MOUNTS = 'SYNC_FILE_MOUNTS'
+    SETUP = 'SETUP'
+    EXEC = 'EXEC'
+    DOWN = 'DOWN'
+
+ALL_STAGES = [Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+              Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.EXEC]
+
+
+def _generate_cluster_name() -> str:
+    return f'sky-{uuid.uuid4().hex[:8]}'
+
+
+def _execute(task: task_lib.Task,
+             cluster_name: str,
+             stages: List[Stage],
+             detach_run: bool = False,
+             down: bool = False,
+             blocked_resources=None,
+             ) -> Tuple[Optional[int], Optional[state.ClusterHandle]]:
+    backend = TpuBackend()
+    with config_lib.override_config(task.config_overrides):
+        if Stage.OPTIMIZE in stages:
+            record = state.get_cluster(cluster_name)
+            if record is not None:
+                # Reuse: skip optimization, keep the cluster's resources.
+                task.set_resources_chosen(
+                    record['handle'].launched_resources)
+            elif not task.best_resources.is_launchable:
+                optimizer_lib.Optimizer.optimize_task(
+                    task, blocked_resources=blocked_resources)
+
+        handle: Optional[state.ClusterHandle] = None
+        if Stage.PROVISION in stages:
+            handle = backend.provision(task, cluster_name)
+        else:
+            record = state.get_cluster(cluster_name)
+            if record is None:
+                raise exceptions.ClusterDoesNotExist(
+                    f'Cluster {cluster_name!r} not found; launch it first.')
+            handle = record['handle']
+
+        if Stage.SYNC_WORKDIR in stages:
+            backend.sync_workdir(handle, task.workdir)
+        if Stage.SYNC_FILE_MOUNTS in stages:
+            backend.sync_file_mounts(handle, task.file_mounts)
+        if Stage.SETUP in stages:
+            backend.setup(handle, task)
+
+        job_id: Optional[int] = None
+        if Stage.EXEC in stages:
+            job_id = backend.execute(handle, task, detach_run=detach_run)
+            if job_id is not None and not detach_run:
+                backend.tail_logs(handle, job_id)
+
+        if down and Stage.EXEC in stages and job_id is not None:
+            status = backend.wait_job(handle, job_id)
+            logger.info(f'Job finished with {status.value}; tearing down '
+                        f'{cluster_name!r} (--down).')
+            backend.teardown(handle, terminate=True)
+            handle = None
+        return job_id, handle
+
+
+def launch(task: task_lib.Task,
+           cluster_name: Optional[str] = None,
+           *,
+           detach_run: bool = False,
+           down: bool = False,
+           no_setup: bool = False,
+           ) -> Tuple[Optional[int], Optional[state.ClusterHandle]]:
+    """Provision (if needed) + full stage pipeline (reference: sky.launch,
+    sky/execution.py:533)."""
+    if isinstance(task, dag_lib.Dag):
+        if len(task) != 1:
+            raise exceptions.NotSupportedError(
+                'launch() takes a single task; use jobs for pipelines.')
+        task = task.tasks[0]
+    cluster_name = cluster_name or _generate_cluster_name()
+    stages = list(ALL_STAGES)
+    if no_setup:
+        stages.remove(Stage.SETUP)
+    return _execute(task, cluster_name, stages, detach_run=detach_run,
+                    down=down)
+
+
+def exec_cmd(task: task_lib.Task,
+             cluster_name: str,
+             *,
+             detach_run: bool = False,
+             ) -> Tuple[Optional[int], Optional[state.ClusterHandle]]:
+    """Fast path: no provision, no setup — straight to EXEC on the cached
+    handle (reference: sky.exec, sky/execution.py:723)."""
+    return _execute(task, cluster_name, [Stage.SYNC_WORKDIR, Stage.EXEC],
+                    detach_run=detach_run)
+
+
+# Keep the reference's public name (`sky.exec`).
+exec = exec_cmd  # pylint: disable=redefined-builtin

@@ -1,0 +1,42 @@
+"""Cloud-name-dispatched provisioning API.
+
+Reference parity: sky/provision/__init__.py:44-67 — one functional interface
+(run_instances / terminate_instances / stop_instances / get_cluster_info /
+wait_instances / query_instances / open_ports), dispatched to
+``skypilot_tpu.provision.<cloud>.instance``.  Every call is wrapped in the
+timeline tracer (the reference wraps with @timeline.event at
+sky/provision/__init__.py:73).
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionRecord)
+from skypilot_tpu.utils import timeline
+
+__all__ = ['ClusterInfo', 'InstanceInfo', 'ProvisionRecord', 'run_instances',
+           'terminate_instances', 'stop_instances', 'get_cluster_info',
+           'wait_instances', 'query_instances']
+
+
+def _dispatch(fn_name: str) -> Callable:
+    @functools.wraps(_dispatch)
+    def _call(cloud: str, *args, **kwargs):
+        module = importlib.import_module(
+            f'skypilot_tpu.provision.{cloud}.instance')
+        impl = getattr(module, fn_name)
+        with timeline.Event(f'provision.{cloud}.{fn_name}'):
+            return impl(*args, **kwargs)
+    _call.__name__ = fn_name
+    return _call
+
+
+run_instances = _dispatch('run_instances')
+terminate_instances = _dispatch('terminate_instances')
+stop_instances = _dispatch('stop_instances')
+get_cluster_info = _dispatch('get_cluster_info')
+wait_instances = _dispatch('wait_instances')
+query_instances = _dispatch('query_instances')

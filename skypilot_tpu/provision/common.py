@@ -1,0 +1,81 @@
+"""Shared provisioning data model.
+
+Reference parity: the dataclasses passed through sky/provision/__init__.py's
+functional API (ProvisionConfig/ProvisionRecord/ClusterInfo/InstanceInfo in
+sky/provision/common.py).  JSON-serializable (no pickle) so handles can be
+stored in the state DB and shipped between processes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str] = None
+    ssh_port: int = 22
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # local cloud: the host's working directory
+    workdir: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'InstanceInfo':
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Everything the backend needs to reach a provisioned cluster."""
+    cluster_name: str
+    cloud: str
+    region: str
+    zone: Optional[str]
+    # One entry per host.  For a TPU pod slice: one per worker host, sorted
+    # by TPU worker id (worker 0 == head, rank 0).
+    instances: List[InstanceInfo] = dataclasses.field(default_factory=list)
+    ssh_user: str = ''
+    ssh_key_path: Optional[str] = None
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def head(self) -> InstanceInfo:
+        return self.instances[0]
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.instances)
+
+    def internal_ips(self) -> List[str]:
+        return [i.internal_ip for i in self.instances]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'ClusterInfo':
+        d = dict(d)
+        d['instances'] = [InstanceInfo.from_dict(i) for i in d['instances']]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances (mirrors sky/provision/common.py)."""
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name: str
+    head_instance_id: str
+    created_instance_ids: List[str]
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)

@@ -1,0 +1,222 @@
+"""GCP provisioner: TPU pod slices (TPU-VM architecture) + startup script.
+
+Reference parity: sky/provision/gcp/instance_utils.py — GCPTPUVMInstance
+:1205: create with acceleratorType + runtimeVersion, poll ops :1231, delete
+:1346, label quirks :1407 (labels cannot be set while PENDING → passed at
+create), no reservations for spot :1476.  TPU API quirks encoded here:
+
+- A pod slice is ONE TPU node resource with N networkEndpoints (one per
+  worker host); get_cluster_info maps each endpoint to an InstanceInfo so
+  the backend sees hosts (rank = endpoint index = TPU worker id).
+- Slices cannot stop — stop_instances raises NotSupportedError (reference:
+  sky/clouds/gcp.py:217-224).
+- Multislice: `num_slices` > 1 creates N nodes named <cluster>-slice-<k>;
+  host order is slice-major so the env contract's global ranks line up.
+- Spot: `schedulingConfig.preemptible` (TPU API has no stop/resume for
+  spot: preempted slices go to PREEMPTED state and can only be deleted —
+  detected by query_instances and surfaced for managed-job recovery).
+
+The startup script installs the agent wheel-less (pip from GCS or the
+baked image) and is idempotent (mirrors instance_setup.py's
+_parallel_ssh_with_cache approach of marker files).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import tpu_api
+
+logger = sky_logging.init_logger(__name__)
+
+_PENDING_STATES = ('CREATING', 'STARTING', 'RESTARTING')
+_RUNNING_STATES = ('READY',)
+# PREEMPTED: spot slice reclaimed; REPAIRING: maintenance event.
+_BAD_STATES = ('PREEMPTED', 'TERMINATED', 'STOPPED', 'REPAIRING')
+
+_STATE_MAP = {
+    'READY': 'running',
+    'CREATING': 'pending', 'STARTING': 'pending', 'RESTARTING': 'pending',
+    'REPAIRING': 'repairing',
+    'STOPPING': 'stopping', 'STOPPED': 'stopped',
+    'PREEMPTED': 'preempted', 'TERMINATED': 'terminated',
+}
+
+_client_factory = tpu_api.TpuApiClient  # swappable in tests
+
+
+def _client(config: Dict[str, Any]) -> tpu_api.TpuApiClient:
+    project = config.get('project_id')
+    assert project, 'gcp.project_id must be configured'
+    return _client_factory(project)
+
+
+def _slice_names(cluster_name: str, num_slices: int) -> List[str]:
+    if num_slices <= 1:
+        return [cluster_name]
+    return [f'{cluster_name}-slice-{k}' for k in range(num_slices)]
+
+
+def _node_body(cluster_name: str, config: Dict[str, Any]) -> Dict[str, Any]:
+    labels = dict(config.get('labels') or {})
+    labels['skypilot-tpu-cluster'] = cluster_name
+    body: Dict[str, Any] = {
+        'acceleratorType': config['tpu_type'],
+        'runtimeVersion': config['runtime_version'],
+        'labels': labels,   # at create time: cannot label while PENDING
+        'metadata': {
+            'startup-script': config.get('startup_script', ''),
+        },
+        'dataDisks': [],
+        'networkConfig': {
+            'enableExternalIps': True,
+        },
+    }
+    if config.get('use_spot'):
+        body['schedulingConfig'] = {'preemptible': True}
+    elif config.get('reservation'):
+        body['reservedInstance'] = True
+    if config.get('topology'):
+        body['acceleratorConfig'] = {
+            'type': config.get('tpu_generation', 'v5e').upper()
+            .replace('V5E', 'V5LITE_POD'),
+            'topology': config['topology'],
+        }
+        body.pop('acceleratorType')
+    if config.get('service_account') and \
+            config['service_account'] != 'default':
+        body['serviceAccount'] = {'email': config['service_account']}
+    return body
+
+
+def run_instances(region: str, cluster_name: str,
+                  config: Dict[str, Any]) -> common.ProvisionRecord:
+    del region  # the TPU API is zonal
+    zone = config['zone']
+    num_slices = int(config.get('num_slices', 1))
+    client = _client(config)
+    created: List[str] = []
+    resumed: List[str] = []
+    existing = {n['name'].rsplit('/', 1)[-1]: n
+                for n in client.list_nodes(zone)}
+    operations = []
+    for name in _slice_names(cluster_name, num_slices):
+        node = existing.get(name)
+        if node is not None:
+            if node.get('state') in _RUNNING_STATES:
+                resumed.append(name)
+                continue
+            if node.get('state') in _BAD_STATES:
+                # Dead slice with our name: replace it.
+                client.wait_operation(client.delete_node(zone, name))
+            elif node.get('state') in _PENDING_STATES:
+                resumed.append(name)
+                continue
+        op = client.create_node(zone, name, _node_body(cluster_name, config))
+        operations.append(op)
+        created.append(name)
+    for op in operations:
+        client.wait_operation(op)
+    return common.ProvisionRecord(
+        provider_name='gcp', region=zone.rsplit('-', 1)[0], zone=zone,
+        cluster_name=cluster_name,
+        head_instance_id=_slice_names(cluster_name, num_slices)[0],
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None) -> None:
+    # run_instances polls creation ops to completion; READY check happens in
+    # get_cluster_info.
+    del region, cluster_name, state
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    config = provider_config or {}
+    zone = config.get('zone')
+    num_slices = int(config.get('num_slices', 1))
+    client = _client(config)
+    instances: List[common.InstanceInfo] = []
+    for name in _slice_names(cluster_name, num_slices):
+        node = client.get_node(zone, name)
+        endpoints = node.get('networkEndpoints', [])
+        for worker_id, ep in enumerate(endpoints):
+            access = ep.get('accessConfig', {})
+            instances.append(common.InstanceInfo(
+                instance_id=f'{name}-w{worker_id}',
+                internal_ip=ep.get('ipAddress', ''),
+                external_ip=access.get('externalIp'),
+                tags={'slice': name, 'worker_id': str(worker_id),
+                      'state': node.get('state', '')},
+            ))
+    return common.ClusterInfo(
+        cluster_name=cluster_name, cloud='gcp',
+        region=zone.rsplit('-', 1)[0] if zone else '', zone=zone,
+        instances=instances,
+        ssh_user=config.get('ssh_user', 'skypilot'),
+        ssh_key_path=config.get('ssh_key_path',
+                                '~/.skypilot_tpu/keys/skypilot.pem'),
+        provider_config=config)
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True) -> Dict[str, str]:
+    config = provider_config or {}
+    zone = config.get('zone')
+    client = _client(config)
+    out: Dict[str, str] = {}
+    for node in client.list_nodes(zone):
+        name = node['name'].rsplit('/', 1)[-1]
+        labels = node.get('labels') or {}
+        if labels.get('skypilot-tpu-cluster') != cluster_name:
+            continue
+        status = _STATE_MAP.get(node.get('state', ''), 'unknown')
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[name] = status
+    return out
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    """Stop single-host TPU VMs.  Pod slices cannot stop
+    (reference: sky/clouds/gcp.py:217-224)."""
+    config = provider_config or {}
+    zone = config.get('zone')
+    client = _client(config)
+    operations = []
+    for node in client.list_nodes(zone):
+        name = node['name'].rsplit('/', 1)[-1]
+        labels = node.get('labels') or {}
+        if labels.get('skypilot-tpu-cluster') != cluster_name:
+            continue
+        if len(node.get('networkEndpoints', [])) > 1:
+            raise NotImplementedError(
+                'TPU pod slices cannot be stopped, only deleted '
+                '(reference: sky/clouds/gcp.py:217-224).')
+        operations.append(client.stop_node(zone, name))
+    for op in operations:
+        client.wait_operation(op)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    config = provider_config or {}
+    zone = config.get('zone')
+    client = _client(config)
+    operations = []
+    for node in client.list_nodes(zone):
+        name = node['name'].rsplit('/', 1)[-1]
+        labels = node.get('labels') or {}
+        if labels.get('skypilot-tpu-cluster') != cluster_name:
+            continue
+        operations.append(client.delete_node(zone, name))
+    for op in operations:
+        client.wait_operation(op)

@@ -1,0 +1,138 @@
+"""Thin REST client for the Cloud TPU API (tpu.googleapis.com, v2).
+
+Reference parity: GCPTPUVMInstance sky/provision/gcp/instance_utils.py:1205
+(discovery client :1219-1223, op polling :1231, stop/terminate :1338/:1346,
+labels-on-PENDING quirk :1407).  The googleapiclient discovery package is
+not bundled here, so this speaks plain REST via requests + google-auth —
+fewer moving parts and the API surface we need is 6 endpoints.
+
+All calls raise typed ProvisionerErrors that the failover loop understands:
+- 429 / RESOURCE_EXHAUSTED quota  → QuotaExceededError  (blocklist region)
+- stockout / no capacity          → CapacityError       (blocklist zone)
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+
+_API = 'https://tpu.googleapis.com/v2'
+_TIMEOUT = 60
+
+
+class TpuApiClient:
+
+    def __init__(self, project: str,
+                 session: Optional[requests.Session] = None) -> None:
+        self.project = project
+        self._session = session  # injectable for tests
+
+    def _get_session(self) -> requests.Session:
+        if self._session is None:
+            import google.auth
+            import google.auth.transport.requests
+            creds, _ = google.auth.default(
+                scopes=['https://www.googleapis.com/auth/cloud-platform'])
+            self._session = google.auth.transport.requests.AuthorizedSession(
+                creds)
+        return self._session
+
+    def _request(self, method: str, path: str,
+                 json_body: Optional[Dict[str, Any]] = None,
+                 params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f'{_API}/{path}'
+        resp = self._get_session().request(method, url, json=json_body,
+                                           params=params, timeout=_TIMEOUT)
+        if resp.status_code >= 400:
+            self._raise_typed(resp)
+        return resp.json() if resp.content else {}
+
+    @staticmethod
+    def _raise_typed(resp: requests.Response) -> None:
+        try:
+            err = resp.json().get('error', {})
+        except ValueError:
+            err = {}
+        message = err.get('message', resp.text[:500])
+        status = err.get('status', '')
+        lowered = message.lower()
+        if resp.status_code == 429 or status == 'RESOURCE_EXHAUSTED' or \
+                'quota' in lowered:
+            raise exceptions.QuotaExceededError(message)
+        if 'no more capacity' in lowered or 'stockout' in lowered or \
+                'out of capacity' in lowered or 'not enough resources' in lowered:
+            raise exceptions.CapacityError(message)
+        if resp.status_code == 404:
+            raise exceptions.ProvisionerError(message, retriable=False)
+        if resp.status_code in (401, 403):
+            raise exceptions.ProvisionerError(
+                f'Permission error from TPU API: {message}', retriable=False)
+        raise exceptions.ProvisionerError(message)
+
+    # ---- node CRUD -------------------------------------------------------
+    def _zone_path(self, zone: str) -> str:
+        return f'projects/{self.project}/locations/{zone}'
+
+    def create_node(self, zone: str, node_id: str,
+                    body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._request(
+            'POST', f'{self._zone_path(zone)}/nodes',
+            json_body=body, params={'nodeId': node_id})
+
+    def get_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return self._request('GET',
+                             f'{self._zone_path(zone)}/nodes/{node_id}')
+
+    def list_nodes(self, zone: str) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        page_token = None
+        while True:
+            params = {'pageSize': 100}
+            if page_token:
+                params['pageToken'] = page_token
+            resp = self._request('GET', f'{self._zone_path(zone)}/nodes',
+                                 params=params)
+            out.extend(resp.get('nodes', []))
+            page_token = resp.get('nextPageToken')
+            if not page_token:
+                return out
+
+    def delete_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return self._request(
+            'DELETE', f'{self._zone_path(zone)}/nodes/{node_id}')
+
+    def stop_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return self._request(
+            'POST', f'{self._zone_path(zone)}/nodes/{node_id}:stop')
+
+    def start_node(self, zone: str, node_id: str) -> Dict[str, Any]:
+        return self._request(
+            'POST', f'{self._zone_path(zone)}/nodes/{node_id}:start')
+
+    def wait_operation(self, operation: Dict[str, Any],
+                       timeout: float = 1800,
+                       poll: float = 5.0) -> Dict[str, Any]:
+        """Poll a long-running operation (mirrors instance_utils.py:1231)."""
+        name = operation.get('name')
+        if not name:
+            return operation
+        deadline = time.time() + timeout
+        while True:
+            op = self._request('GET', name)
+            if op.get('done'):
+                if 'error' in op:
+                    err = op['error']
+                    msg = err.get('message', str(err))
+                    lowered = msg.lower()
+                    if 'capacity' in lowered or 'stockout' in lowered or \
+                            'resources' in lowered and 'insufficient' in lowered:
+                        raise exceptions.CapacityError(msg)
+                    raise exceptions.ProvisionerError(msg)
+                return op
+            if time.time() > deadline:
+                raise exceptions.ProvisionerError(
+                    f'TPU operation {name} timed out after {timeout}s.')
+            time.sleep(poll)

@@ -1,0 +1,137 @@
+"""Local provisioner: "hosts" are working directories on this machine.
+
+Implements the full provision API hermetically so the entire launch path —
+failover provisioner → runtime setup → ranked gang fan-out → logs →
+teardown — runs with no cloud.  The multi-host analog of the fake layer the
+reference lacks (SURVEY.md §4: "fake multi-host runtime").
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+
+_BASE = '~/.skypilot_tpu/local_clusters'
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(os.path.expanduser(_BASE), cluster_name)
+
+
+def _meta_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), 'metadata.json')
+
+
+def run_instances(region: str, cluster_name: str,
+                  config: Dict[str, Any]) -> common.ProvisionRecord:
+    # Total hosts = hosts-per-node × num_nodes (a TPU "node" is a slice
+    # with several worker hosts; mirrors num_ips_per_node semantics).
+    num_hosts = int(config.get('num_hosts', 1)) * int(
+        config.get('num_nodes', 1))
+    cdir = _cluster_dir(cluster_name)
+    created = []
+    for i in range(num_hosts):
+        host_dir = os.path.join(cdir, f'host-{i}')
+        os.makedirs(host_dir, exist_ok=True)
+        created.append(f'{cluster_name}-host-{i}')
+    meta = {
+        'cluster_name': cluster_name,
+        'region': region,
+        'num_hosts': num_hosts,
+        'config': config,
+        'created_at': time.time(),
+        'state': 'running',
+    }
+    with open(_meta_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+    return common.ProvisionRecord(
+        provider_name='local', region=region, zone=config.get('zone'),
+        cluster_name=cluster_name, head_instance_id=created[0],
+        created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None) -> None:
+    del region, state  # local instances are instantly ready
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    with open(_meta_path(cluster_name), encoding='utf-8') as f:
+        meta = json.load(f)
+    instances = []
+    for i in range(meta['num_hosts']):
+        host_dir = os.path.join(_cluster_dir(cluster_name), f'host-{i}')
+        instances.append(common.InstanceInfo(
+            instance_id=f'{cluster_name}-host-{i}',
+            internal_ip='127.0.0.1',
+            external_ip='127.0.0.1',
+            workdir=host_dir,
+        ))
+    return common.ClusterInfo(
+        cluster_name=cluster_name, cloud='local', region=meta['region'],
+        zone=meta['config'].get('zone'), instances=instances,
+        provider_config=provider_config or {})
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True) -> Dict[str, str]:
+    path = _meta_path(cluster_name)
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding='utf-8') as f:
+        meta = json.load(f)
+    return {f'{cluster_name}-host-{i}': meta.get('state', 'running')
+            for i in range(meta['num_hosts'])}
+
+
+def simulate_preemption(cluster_name: str) -> None:
+    """Test/chaos hook: mark the cluster preempted and kill its agent, the
+    local-cloud analog of a TPU slice entering PREEMPTED (used by managed-
+    jobs recovery tests; the reference has no such hermetic layer)."""
+    path = _meta_path(cluster_name)
+    with open(path, encoding='utf-8') as f:
+        meta = json.load(f)
+    meta['state'] = 'preempted'
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+    pid_path = os.path.join(_cluster_dir(cluster_name), 'host-0', '.agent',
+                            'agent.pid')
+    if os.path.exists(pid_path):
+        try:
+            with open(pid_path, encoding='utf-8') as f:
+                pid = int(f.read().strip())
+            import signal
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+        except (ValueError, ProcessLookupError, PermissionError, OSError):
+            pass
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise NotImplementedError('local clusters cannot be stopped; use down.')
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    cdir = _cluster_dir(cluster_name)
+    # Kill the head agent (and its driver children) before removing state.
+    pid_path = os.path.join(cdir, 'host-0', '.agent', 'agent.pid')
+    if os.path.exists(pid_path):
+        try:
+            with open(pid_path, encoding='utf-8') as f:
+                pid = int(f.read().strip())
+            import signal
+            os.killpg(os.getpgid(pid), signal.SIGTERM)
+        except (ValueError, ProcessLookupError, PermissionError, OSError):
+            pass
+    if os.path.exists(cdir):
+        shutil.rmtree(cdir, ignore_errors=True)

@@ -1,0 +1,175 @@
+"""Client-side cluster/job state DB (sqlite).
+
+Reference parity: sky/global_user_state.py (clusters table, status refresh,
+handle storage).  Handles are stored as JSON (not pickle): Resources
+round-trips via to_yaml_config and ClusterInfo via dataclass dicts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+_DB_PATH = '~/.skypilot_tpu/state.db'
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at REAL,
+    handle_json TEXT,
+    status TEXT,
+    last_use TEXT,
+    autostop_json TEXT,
+    to_down INTEGER DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS cluster_history (
+    name TEXT,
+    launched_at REAL,
+    torn_down_at REAL,
+    resources TEXT,
+    duration_s REAL
+);
+"""
+
+
+class ClusterHandle:
+    """Everything needed to reuse a provisioned cluster (reference parity:
+    CloudVmRayResourceHandle, cloud_vm_ray_backend.py:2331 — cached IPs,
+    agent port instead of SSH tunnels/Ray)."""
+
+    def __init__(self,
+                 cluster_name: str,
+                 launched_resources: resources_lib.Resources,
+                 cluster_info: provision_common.ClusterInfo,
+                 num_slices: int = 1,
+                 agent_port: int = 46590,
+                 launched_at: Optional[float] = None) -> None:
+        self.cluster_name = cluster_name
+        self.launched_resources = launched_resources
+        self.cluster_info = cluster_info
+        self.num_slices = num_slices
+        self.agent_port = agent_port
+        self.launched_at = launched_at or time.time()
+
+    @property
+    def head_ip(self) -> str:
+        return self.cluster_info.head.external_ip or \
+            self.cluster_info.head.internal_ip
+
+    @property
+    def num_hosts(self) -> int:
+        """Total ranked hosts (the reference's num_nodes × num_ips_per_node,
+        cloud_vm_ray_backend.py:6306)."""
+        return self.cluster_info.num_hosts
+
+    @property
+    def num_chips_per_host(self) -> int:
+        spec = self.launched_resources.tpu_spec
+        return spec.chips_per_host if spec else 0
+
+    def agent_url(self) -> str:
+        return f'http://{self.head_ip}:{self.agent_port}'
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'cluster_name': self.cluster_name,
+            'launched_resources': self.launched_resources.to_yaml_config(),
+            'cluster_info': self.cluster_info.to_dict(),
+            'num_slices': self.num_slices,
+            'agent_port': self.agent_port,
+            'launched_at': self.launched_at,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'ClusterHandle':
+        return cls(
+            cluster_name=d['cluster_name'],
+            launched_resources=resources_lib.Resources.from_dict(
+                d['launched_resources']),
+            cluster_info=provision_common.ClusterInfo.from_dict(
+                d['cluster_info']),
+            num_slices=d.get('num_slices', 1),
+            agent_port=d.get('agent_port', 46590),
+            launched_at=d.get('launched_at'),
+        )
+
+    def __repr__(self) -> str:
+        return (f'ClusterHandle({self.cluster_name}, '
+                f'{self.launched_resources}, hosts={self.num_hosts})')
+
+
+def _conn() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def add_or_update_cluster(handle: ClusterHandle, status: ClusterStatus,
+                          autostop: Optional[Dict[str, Any]] = None) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO clusters (name, launched_at, handle_json, status, '
+            'last_use, autostop_json) VALUES (?, ?, ?, ?, ?, ?) '
+            'ON CONFLICT(name) DO UPDATE SET handle_json = excluded.'
+            'handle_json, status = excluded.status, last_use = excluded.'
+            'last_use, autostop_json = excluded.autostop_json',
+            (handle.cluster_name, handle.launched_at,
+             json.dumps(handle.to_dict()), status.value,
+             str(time.time()), json.dumps(autostop or {})))
+
+
+def set_cluster_status(name: str, status: ClusterStatus) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE clusters SET status = ? WHERE name = ?',
+                     (status.value, name))
+
+
+def get_cluster(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM clusters WHERE name = ?',
+                           (name,)).fetchone()
+    if row is None:
+        return None
+    return _row_to_record(row)
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    return {
+        'name': row['name'],
+        'launched_at': row['launched_at'],
+        'handle': ClusterHandle.from_dict(json.loads(row['handle_json'])),
+        'status': ClusterStatus(row['status']),
+        'autostop': json.loads(row['autostop_json'] or '{}'),
+    }
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def remove_cluster(name: str) -> None:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM clusters WHERE name = ?',
+                           (name,)).fetchone()
+        if row is not None:
+            handle = ClusterHandle.from_dict(json.loads(row['handle_json']))
+            conn.execute(
+                'INSERT INTO cluster_history (name, launched_at, '
+                'torn_down_at, resources, duration_s) VALUES (?, ?, ?, ?, ?)',
+                (name, row['launched_at'], time.time(),
+                 repr(handle.launched_resources),
+                 time.time() - (row['launched_at'] or time.time())))
+        conn.execute('DELETE FROM clusters WHERE name = ?', (name,))

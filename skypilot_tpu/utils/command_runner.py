@@ -1,0 +1,225 @@
+"""Unified command execution on cluster hosts: SSH or local process.
+
+Reference parity: CommandRunner sky/utils/command_runner.py:178,
+SSHCommandRunner :598 (ControlMaster connection reuse, rsync).  The local
+runner replaces the reference's k8s-exec runner for the hermetic `local`
+cloud: each "host" is a working directory and commands run as subprocesses.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+SSH_CONTROL_PATH = '~/.skypilot_tpu/ssh_control'
+
+
+def _env_prefix(env: Optional[Dict[str, str]]) -> str:
+    if not env:
+        return ''
+    return ' '.join(f'export {k}={shlex.quote(v)};' for k, v in env.items()) + ' '
+
+
+class CommandRunner:
+    """Runs commands and syncs files on one host."""
+
+    def __init__(self, node_id: str) -> None:
+        self.node_id = node_id
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            env: Optional[Dict[str, str]] = None,
+            cwd: Optional[str] = None,
+            log_path: Optional[str] = None,
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            timeout: Optional[float] = None,
+            ) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        rc = self.run('true', timeout=15)
+        return rc == 0
+
+    # -- shared subprocess plumbing ---------------------------------------
+    @staticmethod
+    def _spawn(argv: List[str], log_path: Optional[str], stream_logs: bool,
+               require_outputs: bool, timeout: Optional[float],
+               cwd: Optional[str] = None,
+               extra_env: Optional[Dict[str, str]] = None,
+               ) -> Union[int, Tuple[int, str, str]]:
+        full_env = None
+        if extra_env is not None:
+            full_env = dict(os.environ)
+            full_env.update(extra_env)
+        stdout_chunks: List[bytes] = []
+        stderr_chunks: List[bytes] = []
+        log_f = open(log_path, 'ab') if log_path else None
+        try:
+            proc = subprocess.Popen(argv, cwd=cwd, env=full_env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT
+                                    if not require_outputs
+                                    else subprocess.PIPE)
+            deadline = time.time() + timeout if timeout else None
+            assert proc.stdout is not None
+            while True:
+                if deadline and time.time() > deadline:
+                    proc.kill()
+                    raise exceptions.CommandError(
+                        255, ' '.join(argv), 'timeout')
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                stdout_chunks.append(line)
+                if log_f:
+                    log_f.write(line)
+                    log_f.flush()
+                if stream_logs:
+                    print(line.decode(errors='replace'), end='')
+            if require_outputs and proc.stderr is not None:
+                stderr_chunks.append(proc.stderr.read())
+            returncode = proc.wait()
+        finally:
+            if log_f:
+                log_f.close()
+        if require_outputs:
+            return (returncode,
+                    b''.join(stdout_chunks).decode(errors='replace'),
+                    b''.join(stderr_chunks).decode(errors='replace'))
+        return returncode
+
+
+class LocalProcessRunner(CommandRunner):
+    """Host = a working directory on this machine (the `local` cloud)."""
+
+    def __init__(self, node_id: str, workdir: str) -> None:
+        super().__init__(node_id)
+        self.workdir = os.path.expanduser(workdir)
+
+    def run(self, cmd, *, env=None, cwd=None, log_path=None,
+            stream_logs=False, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        argv = ['/bin/bash', '-c', cmd]
+        return self._spawn(argv, log_path, stream_logs, require_outputs,
+                           timeout, cwd=cwd or self.workdir, extra_env=env)
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        # Pure-Python sync: the rsync binary is not guaranteed locally.
+        import shutil
+        src = os.path.expanduser(source)
+        dst = os.path.join(self.workdir, target) if up else \
+            os.path.expanduser(target)
+        if not up:
+            src = os.path.join(self.workdir, source)
+        src = src.rstrip('/')
+        dst = dst.rstrip('/')
+        if os.path.isdir(src):
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(src, dst)
+        else:
+            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+            shutil.copy2(src, dst)
+
+
+def build_ssh_argv(ip: str, *, user: str, key_path: Optional[str] = None,
+                   port: int = 22, proxy_command: Optional[str] = None,
+                   control_master: bool = True) -> List[str]:
+    """The one place SSH options are assembled — used by SSHCommandRunner
+    and the gang driver so their behavior cannot diverge."""
+    opts = [
+        '-o', 'StrictHostKeyChecking=no',
+        '-o', 'UserKnownHostsFile=/dev/null',
+        '-o', 'IdentitiesOnly=yes',
+        '-o', 'ConnectTimeout=30',
+        '-o', 'LogLevel=ERROR',
+        '-p', str(port),
+    ]
+    if control_master:
+        control_dir = os.path.expanduser(SSH_CONTROL_PATH)
+        os.makedirs(control_dir, exist_ok=True)
+        opts += ['-o', 'ControlMaster=auto',
+                 '-o', f'ControlPath={control_dir}/%C',
+                 '-o', 'ControlPersist=300s']
+    if key_path:
+        opts += ['-i', os.path.expanduser(key_path)]
+    if proxy_command:
+        opts += ['-o', f'ProxyCommand={proxy_command}']
+    return ['ssh'] + opts + [f'{user}@{ip}']
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH with ControlMaster connection reuse (mirrors the reference's
+    SSHCommandRunner; one persistent control socket per host)."""
+
+    def __init__(self, node_id: str, ip: str, *, user: str,
+                 key_path: Optional[str] = None, port: int = 22,
+                 proxy_command: Optional[str] = None) -> None:
+        super().__init__(node_id)
+        self.ip = ip
+        self.user = user
+        self.key_path = key_path
+        self.port = port
+        self.proxy_command = proxy_command
+
+    def _ssh_base(self) -> List[str]:
+        return build_ssh_argv(self.ip, user=self.user,
+                              key_path=self.key_path, port=self.port,
+                              proxy_command=self.proxy_command)
+
+    def run(self, cmd, *, env=None, cwd=None, log_path=None,
+            stream_logs=False, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        remote = _env_prefix(env) + (f'cd {shlex.quote(cwd)} && ' if cwd
+                                     else '') + cmd
+        argv = self._ssh_base() + ['bash', '-c', shlex.quote(remote)]
+        return self._spawn(argv, log_path, stream_logs, require_outputs,
+                           timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        ssh_cmd = ' '.join(self._ssh_base()[:-1])  # drop user@host
+        remote = f'{self.user}@{self.ip}:{target if up else source}'
+        pair = ([os.path.expanduser(source), remote] if up
+                else [remote, os.path.expanduser(target)])
+        rc = self._spawn(['rsync', '-a', '--delete', '-e', ssh_cmd] + pair,
+                         None, False, False, None)
+        if rc != 0:
+            raise exceptions.CommandError(
+                int(rc), f'rsync {"up" if up else "down"} {source}',
+                'rsync failed')
+
+
+def run_on_hosts_parallel(runners: List[CommandRunner], cmd: str, *,
+                          env: Optional[Dict[str, str]] = None,
+                          log_dir: Optional[str] = None,
+                          timeout: Optional[float] = None,
+                          max_workers: int = 32) -> List[int]:
+    """Run the same command on many hosts concurrently (the 64-host fan-out
+    path; mirrors instance_setup._parallel_ssh_with_cache :153)."""
+    import concurrent.futures as cf
+    results: List[int] = [255] * len(runners)
+
+    def _one(i: int) -> None:
+        log_path = (os.path.join(log_dir, f'host-{i}.log')
+                    if log_dir else None)
+        results[i] = runners[i].run(cmd, env=env, log_path=log_path,
+                                    timeout=timeout)
+
+    with cf.ThreadPoolExecutor(max_workers=min(max_workers,
+                                               len(runners))) as ex:
+        list(ex.map(_one, range(len(runners))))
+    return results

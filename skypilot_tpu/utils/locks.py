@@ -1,0 +1,20 @@
+"""Per-cluster file locks (reference parity: sky/utils/locks.py +
+_locked_provision, cloud_vm_ray_backend.py:3474)."""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import filelock
+
+_LOCK_DIR = '~/.skypilot_tpu/locks'
+
+
+@contextlib.contextmanager
+def cluster_lock(cluster_name: str, timeout: float = 600.0):
+    lock_dir = os.path.expanduser(_LOCK_DIR)
+    os.makedirs(lock_dir, exist_ok=True)
+    lock = filelock.FileLock(os.path.join(lock_dir, f'{cluster_name}.lock'),
+                             timeout=timeout)
+    with lock:
+        yield

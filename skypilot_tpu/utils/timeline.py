@@ -1,0 +1,77 @@
+"""Chrome trace-event-format tracing.
+
+Reference parity: sky/utils/timeline.py:1-40 — Event context manager +
+@event decorator; enabled via SKYTPU_TIMELINE_FILE env var; output loads in
+chrome://tracing / Perfetto.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_EVENTS: List[Dict[str, Any]] = []
+_LOCK = threading.Lock()
+_ENV_VAR = 'SKYTPU_TIMELINE_FILE'
+
+
+def _enabled() -> bool:
+    return bool(os.environ.get(_ENV_VAR))
+
+
+class Event:
+    """Context manager recording a complete ('X') trace event."""
+
+    def __init__(self, name: str, message: Optional[str] = None) -> None:
+        self._name = name
+        self._message = message
+        self._start = 0.0
+
+    def __enter__(self) -> 'Event':
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *args) -> None:
+        if not _enabled():
+            return
+        event = {
+            'name': self._name,
+            'cat': 'skypilot_tpu',
+            'ph': 'X',
+            'ts': self._start * 1e6,
+            'dur': (time.time() - self._start) * 1e6,
+            'pid': os.getpid(),
+            'tid': threading.get_ident() % 100000,
+        }
+        if self._message:
+            event['args'] = {'message': self._message}
+        with _LOCK:
+            _EVENTS.append(event)
+
+
+def event(fn: Callable = None, name: Optional[str] = None) -> Callable:
+    """Decorator recording fn duration."""
+    def decorator(f: Callable) -> Callable:
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with Event(name or f'{f.__module__}.{f.__qualname__}'):
+                return f(*args, **kwargs)
+        return wrapper
+    if fn is not None:
+        return decorator(fn)
+    return decorator
+
+
+@atexit.register
+def save() -> None:
+    path = os.environ.get(_ENV_VAR)
+    if not path or not _EVENTS:
+        return
+    os.makedirs(os.path.dirname(os.path.expanduser(path)) or '.',
+                exist_ok=True)
+    with _LOCK, open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': _EVENTS}, f)

@@ -1,0 +1,54 @@
+"""Agent job table + cancel-kills-ranks regression tests."""
+import os
+import subprocess
+import time
+
+import pytest
+
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.utils.status_lib import JobStatus
+from tests.test_launch_e2e import iso_state, _make_task, _wait_job  # noqa: F401
+
+
+def test_job_table_lifecycle(tmp_path):
+    table = job_lib.JobTable(str(tmp_path / 'jobs.db'))
+    job_id = table.add_job('j', 'user', 'ts', '', {})
+    assert table.get_status(job_id) == JobStatus.INIT
+    table.set_status(job_id, JobStatus.RUNNING)
+    assert table.get_job(job_id)['start_at'] is not None
+    table.set_status(job_id, JobStatus.SUCCEEDED)
+    assert table.get_status(job_id).is_terminal()
+    assert table.queue(all_jobs=False) == []
+    assert len(table.queue(all_jobs=True)) == 1
+
+
+def test_log_dir_recorded(iso_state):  # noqa: F811
+    from skypilot_tpu import execution
+    from skypilot_tpu.agent.client import AgentClient
+    task = _make_task(run='echo x')
+    job_id, handle = execution.launch(task, cluster_name='ld',
+                                      detach_run=True)
+    _wait_job(handle, job_id)
+    jobs = AgentClient(handle.agent_url()).queue(all_jobs=True)
+    assert jobs[0]['log_dir'].endswith(f'job-{job_id}')
+
+
+def test_cancel_kills_rank_processes(iso_state):  # noqa: F811
+    """Regression: ranks run in their own sessions; cancel must reach them."""
+    from skypilot_tpu import core, execution
+    marker = os.path.join(str(iso_state), 'rank_alive')
+    task = _make_task(
+        name='canceltest',
+        run=f'while true; do touch {marker}; sleep 0.3; done')
+    job_id, handle = execution.launch(task, cluster_name='ck',
+                                      detach_run=True)
+    deadline = time.time() + 30
+    while not os.path.exists(marker) and time.time() < deadline:
+        time.sleep(0.2)
+    assert os.path.exists(marker), 'rank never started'
+    core.cancel('ck', [job_id])
+    time.sleep(1.5)
+    os.remove(marker)
+    time.sleep(1.5)
+    # If the rank loop survived the cancel it would have re-touched marker.
+    assert not os.path.exists(marker), 'rank process survived cancel'

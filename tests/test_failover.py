@@ -1,0 +1,62 @@
+"""Failover provisioner state machine (mirrors the reference's
+test_failover.py, but against the mocked TPU REST API)."""
+import pytest
+
+from skypilot_tpu import Resources, exceptions
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from tests.test_gcp_provisioner import FakeTpuApi
+
+
+@pytest.fixture()
+def fake_gcp(monkeypatch, tmp_home):
+    holder = {}
+
+    def factory(project, session=None):
+        if 'api' not in holder:
+            holder['api'] = FakeTpuApi(project,
+                                       fail_zones=holder.get('fail', {}))
+        return holder['api']
+
+    monkeypatch.setattr(gcp_instance, '_client_factory', factory)
+    monkeypatch.setattr(provisioner, '_setup_runtime',
+                        lambda info, port: None)
+    config_lib.set_nested(('gcp', 'project_id'), 'test-proj')
+    yield holder
+
+
+def test_failover_capacity_moves_to_next_zone(fake_gcp):
+    # v6e is offered (cheapest-first) in us-east5-b, us-east1-d,
+    # us-central2-b, then europe/asia.  Fail the first two on capacity.
+    fake_gcp['fail'] = {'us-east5-b': 'capacity', 'us-east1-d': 'capacity'}
+    res = Resources(cloud='gcp', accelerators='tpu-v6e-8')
+    outcome = provisioner.provision_with_failover(res, 'fo1')
+    assert outcome.zone == 'us-central2-b'
+    assert outcome.handle.num_hosts == 1
+
+
+def test_quota_error_blocklists_region(fake_gcp):
+    # v5e in us-central1 quota-blocked: must not try more us-central1 zones,
+    # jumps to the next region.
+    fake_gcp['fail'] = {'us-central1-a': 'quota'}
+    res = Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    outcome = provisioner.provision_with_failover(res, 'fo2')
+    assert outcome.region != 'us-central1'
+
+
+def test_exhaustion_raises_with_history(fake_gcp):
+    res = Resources(cloud='gcp', accelerators='tpu-v4-8')  # only us-central2
+    fake_gcp['fail'] = {'us-central2-b': 'capacity'}
+    with pytest.raises(exceptions.ResourcesUnavailableError) as exc:
+        provisioner.provision_with_failover(res, 'fo3')
+    assert len(exc.value.failover_history) == 1
+    assert isinstance(exc.value.failover_history[0],
+                      exceptions.CapacityError)
+
+
+def test_zone_pinning_limits_loop(fake_gcp):
+    res = Resources(cloud='gcp', accelerators='tpu-v5e-8',
+                    zone='us-west4-a')
+    outcome = provisioner.provision_with_failover(res, 'fo4')
+    assert outcome.zone == 'us-west4-a'

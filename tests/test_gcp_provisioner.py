@@ -1,0 +1,185 @@
+"""GCP TPU provisioner unit tests with a mocked TPU REST API."""
+import copy
+from typing import Any, Dict
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+
+
+class FakeTpuApi:
+    """In-memory stand-in for TpuApiClient."""
+
+    def __init__(self, project: str, fail_zones=None):
+        self.project = project
+        self.nodes: Dict[str, Dict[str, Any]] = {}   # (zone/name) -> node
+        self.fail_zones = fail_zones or {}
+        self.deleted = []
+
+    def _key(self, zone, name):
+        return f'{zone}/{name}'
+
+    def create_node(self, zone, node_id, body):
+        failure = self.fail_zones.get(zone)
+        if failure == 'capacity':
+            raise exceptions.CapacityError(f'No capacity in {zone}')
+        if failure == 'quota':
+            raise exceptions.QuotaExceededError(f'Quota exceeded in {zone}')
+        node = copy.deepcopy(body)
+        node['name'] = f'projects/{self.project}/locations/{zone}/nodes/{node_id}'
+        node['state'] = 'READY'
+        chips = int(node.get('acceleratorType', 'v5litepod-4')
+                    .rsplit('-', 1)[-1])
+        num_hosts = max(chips // 4, 1) if chips > 8 else 1
+        node['networkEndpoints'] = [
+            {'ipAddress': f'10.0.{len(self.nodes)}.{i}',
+             'accessConfig': {'externalIp': f'34.0.{len(self.nodes)}.{i}'}}
+            for i in range(num_hosts)]
+        self.nodes[self._key(zone, node_id)] = node
+        return {'name': f'op-{node_id}', 'done': True}
+
+    def get_node(self, zone, node_id):
+        return self.nodes[self._key(zone, node_id)]
+
+    def list_nodes(self, zone):
+        return [n for k, n in self.nodes.items()
+                if k.startswith(f'{zone}/')]
+
+    def delete_node(self, zone, node_id):
+        self.nodes.pop(self._key(zone, node_id), None)
+        self.deleted.append(node_id)
+        return {'name': f'op-del-{node_id}', 'done': True}
+
+    def stop_node(self, zone, node_id):
+        self.nodes[self._key(zone, node_id)]['state'] = 'STOPPED'
+        return {'name': f'op-stop-{node_id}', 'done': True}
+
+    def wait_operation(self, operation, timeout=0, poll=0):
+        return operation
+
+
+@pytest.fixture()
+def fake_api(monkeypatch):
+    holder = {}
+
+    def factory(project, session=None):
+        if 'api' not in holder:
+            holder['api'] = FakeTpuApi(project)
+        return holder['api']
+
+    monkeypatch.setattr(gcp_instance, '_client_factory', factory)
+    yield lambda: holder.get('api')
+
+
+def _config(**over):
+    cfg = {
+        'project_id': 'proj', 'zone': 'us-east5-b',
+        'tpu_type': 'v5litepod-16', 'tpu_generation': 'v5e',
+        'runtime_version': 'v2-alpha-tpuv5-lite', 'use_spot': False,
+        'num_slices': 1, 'labels': {},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_create_pod_slice_maps_workers_to_hosts(fake_api):
+    record = gcp_instance.run_instances('us-east5', 'c1', _config())
+    assert record.created_instance_ids == ['c1']
+    info = gcp_instance.get_cluster_info('us-east5', 'c1', _config())
+    assert info.num_hosts == 4          # v5litepod-16 → 4 worker hosts
+    assert info.head.instance_id == 'c1-w0'
+    assert info.instances[3].internal_ip == '10.0.0.3'
+
+
+def test_multislice_creates_n_nodes_slice_major(fake_api):
+    cfg = _config(num_slices=2)
+    record = gcp_instance.run_instances('us-east5', 'c2', cfg)
+    assert record.created_instance_ids == ['c2-slice-0', 'c2-slice-1']
+    info = gcp_instance.get_cluster_info('us-east5', 'c2', cfg)
+    assert info.num_hosts == 8
+    # Slice-major host order (slice 0 first) for global ranks.
+    assert info.instances[0].tags['slice'] == 'c2-slice-0'
+    assert info.instances[4].tags['slice'] == 'c2-slice-1'
+
+
+def test_rerun_is_idempotent(fake_api):
+    gcp_instance.run_instances('us-east5', 'c3', _config())
+    record = gcp_instance.run_instances('us-east5', 'c3', _config())
+    assert record.created_instance_ids == []
+    assert record.resumed_instance_ids == ['c3']
+
+
+def test_preempted_slice_is_replaced(fake_api):
+    gcp_instance.run_instances('us-east5', 'c4', _config())
+    api = fake_api()
+    api.nodes['us-east5-b/c4']['state'] = 'PREEMPTED'
+    record = gcp_instance.run_instances('us-east5', 'c4', _config())
+    assert record.created_instance_ids == ['c4']
+    assert 'c4' in api.deleted
+
+
+def test_query_instances_maps_states(fake_api):
+    gcp_instance.run_instances('us-east5', 'c5', _config())
+    api = fake_api()
+    api.nodes['us-east5-b/c5']['state'] = 'PREEMPTED'
+    statuses = gcp_instance.query_instances('c5', _config())
+    assert statuses == {'c5': 'preempted'}
+
+
+def test_terminate_only_own_cluster(fake_api):
+    gcp_instance.run_instances('us-east5', 'mine', _config())
+    gcp_instance.run_instances('us-east5', 'other', _config())
+    gcp_instance.terminate_instances('mine', _config())
+    api = fake_api()
+    assert 'us-east5-b/mine' not in api.nodes
+    assert 'us-east5-b/other' in api.nodes
+
+
+def test_stop_pod_raises_single_host_stops(fake_api):
+    # Pod slice (multi-host): cannot stop.
+    gcp_instance.run_instances('us-east5', 'pod', _config())
+    with pytest.raises(NotImplementedError):
+        gcp_instance.stop_instances('pod', _config())
+    # Single-host slice: stop works.
+    cfg = _config(tpu_type='v5litepod-8')
+    gcp_instance.run_instances('us-east5', 'single', cfg)
+    gcp_instance.stop_instances('single', cfg)
+    assert fake_api().nodes['us-east5-b/single']['state'] == 'STOPPED'
+
+
+def test_spot_sets_preemptible(fake_api):
+    gcp_instance.run_instances('us-east5', 'spot1', _config(use_spot=True))
+    node = fake_api().nodes['us-east5-b/spot1']
+    assert node['schedulingConfig'] == {'preemptible': True}
+
+
+def test_capacity_error_typed(monkeypatch):
+    class Resp:
+        status_code = 400
+        text = ''
+        content = b'{}'
+
+        @staticmethod
+        def json():
+            return {'error': {'message': 'There is no more capacity in the '
+                                         'zone us-east5-b', 'status': ''}}
+
+    with pytest.raises(exceptions.CapacityError):
+        tpu_api.TpuApiClient._raise_typed(Resp())
+
+
+def test_quota_error_typed():
+    class Resp:
+        status_code = 429
+        text = ''
+        content = b'{}'
+
+        @staticmethod
+        def json():
+            return {'error': {'message': 'Quota exceeded',
+                              'status': 'RESOURCE_EXHAUSTED'}}
+
+    with pytest.raises(exceptions.QuotaExceededError):
+        tpu_api.TpuApiClient._raise_typed(Resp())

@@ -1,0 +1,153 @@
+"""End-to-end launch on the hermetic `local` cloud.
+
+Covers the whole stack: optimizer → failover provisioner → agent bring-up →
+ranked gang fan-out with env contract → log streaming → queue/cancel →
+teardown.  This is the fake-multi-host layer the reference lacks
+(SURVEY.md §4).
+"""
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import Resources, Task, core, execution, state
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils.status_lib import ClusterStatus, JobStatus
+
+
+@pytest.fixture()
+def iso_state(tmp_path, monkeypatch):
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    monkeypatch.setenv('SKYTPU_CONFIG', str(home / 'no-config.yaml'))
+    from skypilot_tpu import config
+    config.reload_config()
+    yield home
+    # Teardown any clusters left behind (kills agents).
+    for record in state.get_clusters():
+        try:
+            from skypilot_tpu.backends import TpuBackend
+            TpuBackend().teardown(record['handle'])
+        except Exception:
+            pass
+    config.reload_config()
+
+
+def _make_task(**kwargs):
+    defaults = dict(name='t', run='echo hello world')
+    defaults.update(kwargs)
+    t = Task(**defaults)
+    t.set_resources(Resources(cloud='local'))
+    return t
+
+
+def _wait_job(handle, job_id, timeout=60):
+    from skypilot_tpu.backends import TpuBackend
+    return TpuBackend().wait_job(handle, job_id, timeout=timeout)
+
+
+def test_launch_single_node(iso_state):
+    task = _make_task(run='echo launched-ok-$((6*7))')
+    job_id, handle = execution.launch(task, cluster_name='c1',
+                                      detach_run=True)
+    assert job_id == 1
+    status = _wait_job(handle, job_id)
+    assert status == JobStatus.SUCCEEDED
+    log = open(os.path.join(handle.cluster_info.head.workdir, '.agent',
+                            'logs', f'job-{job_id}', 'rank-0.log')).read()
+    assert 'launched-ok-42' in log
+    # Cluster registered UP.
+    record = state.get_cluster('c1')
+    assert record['status'] == ClusterStatus.UP
+
+
+def test_gang_multihost_env_contract(iso_state):
+    task = Task(name='gang',
+                run='echo rank=$SKYPILOT_NODE_RANK of=$SKYPILOT_NUM_NODES '
+                    'coord=$SKYTPU_COORDINATOR_ADDRESS '
+                    'chips=$SKYPILOT_NUM_CHIPS_PER_NODE')
+    task.set_resources(Resources(cloud='local', accelerators='tpu-v5e-16'))
+    job_id, handle = execution.launch(task, cluster_name='gang',
+                                      detach_run=True)
+    assert handle.num_hosts == 4  # v5e-16 = 4 hosts
+    assert _wait_job(handle, job_id) == JobStatus.SUCCEEDED
+    log_dir = os.path.join(handle.cluster_info.head.workdir, '.agent',
+                           'logs', f'job-{job_id}')
+    for rank in range(4):
+        content = open(os.path.join(log_dir, f'rank-{rank}.log')).read()
+        assert f'rank={rank} of=4' in content
+        assert 'coord=127.0.0.1:8476' in content
+        assert 'chips=4' in content
+
+
+def test_gang_failure_cancels_all_ranks(iso_state):
+    task = Task(name='fail',
+                run='if [ "$SKYPILOT_NODE_RANK" = "1" ]; then exit 3; fi; '
+                    'sleep 60')
+    task.set_resources(Resources(cloud='local', accelerators='tpu-v5e-16'))
+    start = time.time()
+    job_id, handle = execution.launch(task, cluster_name='gangfail',
+                                      detach_run=True)
+    status = _wait_job(handle, job_id, timeout=45)
+    assert status == JobStatus.FAILED
+    # Gang cancel means we did NOT wait for the 60s sleeps.
+    assert time.time() - start < 45
+
+
+def test_setup_failure_marks_failed_setup(iso_state):
+    task = _make_task(setup='exit 7', run='echo never')
+    with pytest.raises(exceptions.CommandError):
+        execution.launch(task, cluster_name='badsetup', detach_run=True)
+
+
+def test_exec_fast_path_reuses_cluster(iso_state):
+    task = _make_task(run='echo first')
+    job_id, handle = execution.launch(task, cluster_name='reuse',
+                                      detach_run=True)
+    _wait_job(handle, job_id)
+    t2 = _make_task(run='echo second')
+    job2, handle2 = execution.exec_cmd(t2, cluster_name='reuse',
+                                       detach_run=True)
+    assert job2 == job_id + 1
+    assert _wait_job(handle2, job2) == JobStatus.SUCCEEDED
+
+
+def test_exec_on_missing_cluster_raises(iso_state):
+    with pytest.raises(exceptions.ClusterDoesNotExist):
+        execution.exec_cmd(_make_task(), cluster_name='nope')
+
+
+def test_queue_cancel_and_down(iso_state):
+    task = _make_task(name='sleeper', run='sleep 120')
+    job_id, handle = execution.launch(task, cluster_name='qc',
+                                      detach_run=True)
+    # Wait for RUNNING.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if core.job_status('qc', job_id) == JobStatus.RUNNING:
+            break
+        time.sleep(0.3)
+    jobs = core.queue('qc')
+    assert any(j['job_id'] == job_id for j in jobs)
+    cancelled = core.cancel('qc', [job_id])
+    assert cancelled == [job_id]
+    assert core.job_status('qc', job_id) == JobStatus.CANCELLED
+    core.down('qc')
+    assert state.get_cluster('qc') is None
+    with pytest.raises(exceptions.ClusterDoesNotExist):
+        core.queue('qc')
+
+
+def test_workdir_sync(iso_state, tmp_path):
+    wd = tmp_path / 'proj'
+    wd.mkdir()
+    (wd / 'data.txt').write_text('payload-123')
+    task = Task(name='wd', run='cat data.txt', workdir=str(wd))
+    task.set_resources(Resources(cloud='local'))
+    job_id, handle = execution.launch(task, cluster_name='wdsync',
+                                      detach_run=True)
+    assert _wait_job(handle, job_id) == JobStatus.SUCCEEDED
+    log = open(os.path.join(handle.cluster_info.head.workdir, '.agent',
+                            'logs', f'job-{job_id}', 'rank-0.log')).read()
+    assert 'payload-123' in log
