@@ -1,0 +1,3 @@
+from skypilot_tpu.jobs.state import ManagedJobStatus, ManagedJobScheduleState
+
+__all__ = ['ManagedJobStatus', 'ManagedJobScheduleState']

@@ -1,0 +1,65 @@
+"""`skytpu jobs ...` command group (reference: sky/client/cli jobs_*)."""
+from __future__ import annotations
+
+import time
+
+
+def _cmd_launch(args) -> int:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import core
+    task = task_lib.Task.from_yaml(args.yaml)
+    job_id = core.launch(task, name=args.name)
+    if not args.detach_run:
+        return core.tail_logs(job_id)
+    return 0
+
+
+def _cmd_queue(args) -> int:
+    from skypilot_tpu.jobs import core
+    jobs = core.queue(skip_finished=not args.all)
+    if not jobs:
+        print('No managed jobs.')
+        return 0
+    rows = []
+    for j in jobs:
+        rows.append(f"{j['job_id']:>4}  {j.get('name') or '-':<20} "
+                    f"{j['status'].value:<18} "
+                    f"recoveries={j['recovery_count']}  "
+                    f"{time.strftime('%m-%d %H:%M', time.localtime(j['submitted_at']))}")
+    print('\n'.join(rows))
+    return 0
+
+
+def _cmd_cancel(args) -> int:
+    from skypilot_tpu.jobs import core
+    print(f'Cancelling: {core.cancel(args.job_ids or None)}')
+    return 0
+
+
+def _cmd_logs(args) -> int:
+    from skypilot_tpu.jobs import core
+    return core.tail_logs(args.job_id, follow=not args.no_follow)
+
+
+def register(sub) -> None:
+    p = sub.add_parser('jobs', help='Managed jobs (auto-recovery)')
+    jsub = p.add_subparsers(dest='jobs_command')
+
+    pl = jsub.add_parser('launch', help='Submit a managed job')
+    pl.add_argument('yaml')
+    pl.add_argument('-n', '--name')
+    pl.add_argument('-d', '--detach-run', action='store_true')
+    pl.set_defaults(fn=_cmd_launch)
+
+    pq = jsub.add_parser('queue', help='List managed jobs')
+    pq.add_argument('-a', '--all', action='store_true')
+    pq.set_defaults(fn=_cmd_queue)
+
+    pc = jsub.add_parser('cancel', help='Cancel managed jobs')
+    pc.add_argument('job_ids', nargs='*', type=int)
+    pc.set_defaults(fn=_cmd_cancel)
+
+    plg = jsub.add_parser('logs', help='Tail managed job logs')
+    plg.add_argument('job_id', type=int)
+    plg.add_argument('--no-follow', action='store_true')
+    plg.set_defaults(fn=_cmd_logs)

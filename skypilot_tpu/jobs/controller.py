@@ -1,0 +1,231 @@
+"""Managed-jobs controller: per-job monitor loop + scheduler.
+
+Reference parity: sky/jobs/controller.py (asyncio JobController per job,
+monitor → preemption detect → StrategyExecutor.recover) and
+sky/jobs/scheduler.py (docstring :1-31 — concurrency gated by controller
+resources).  Architectural difference by design: the reference launches a
+dedicated controller VM; here the controller is a local daemon process (the
+same pattern as the head agent) — moving it onto a controller VM is just
+launching this module there, since controllers are ordinary processes that
+import the library (mirrors sky/jobs/controller.py:17-40 importing sky).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import requests
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent.client import AgentClient
+from skypilot_tpu.jobs import recovery_strategy as strategy_lib
+from skypilot_tpu.jobs.state import (JobsTable, ManagedJobScheduleState,
+                                     ManagedJobStatus)
+from skypilot_tpu.utils.status_lib import JobStatus
+
+logger = sky_logging.init_logger(__name__)
+
+JOB_POLL_SECONDS = 2.0
+
+
+class JobController:
+    """Drives one managed job to a terminal state."""
+
+    def __init__(self, job_id: int, table: JobsTable,
+                 poll_seconds: float = JOB_POLL_SECONDS) -> None:
+        self.job_id = job_id
+        self.table = table
+        self.poll_seconds = poll_seconds
+
+    def run(self) -> ManagedJobStatus:
+        record = self.table.get(self.job_id)
+        assert record is not None
+        try:
+            task = task_lib.Task.from_yaml_config(record['task_config'])
+        except exceptions.InvalidTaskError as e:
+            self.table.set_status(self.job_id,
+                                  ManagedJobStatus.FAILED_PRECHECKS, str(e))
+            return ManagedJobStatus.FAILED_PRECHECKS
+        cluster_name = f'jobs-{self.job_id}'
+        strategy = strategy_lib.StrategyExecutor.make(task, cluster_name)
+        max_restarts = record['max_restarts_on_errors'] or (
+            (task.best_resources.job_recovery or {})
+            .get('max_restarts_on_errors', 0))
+        restarts_on_errors = 0
+
+        self.table.set_status(self.job_id, ManagedJobStatus.STARTING)
+        self.table.set_schedule_state(self.job_id,
+                                      ManagedJobScheduleState.LAUNCHING)
+        try:
+            cluster_job_id, handle = strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            self.table.set_status(
+                self.job_id, ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            return ManagedJobStatus.FAILED_NO_RESOURCE
+        except exceptions.CommandError as e:
+            self.table.set_status(
+                self.job_id, ManagedJobStatus.FAILED_SETUP, str(e))
+            strategy.teardown()
+            return ManagedJobStatus.FAILED_SETUP
+        self.table.set_cluster(self.job_id, cluster_name, cluster_job_id)
+        self.table.set_status(self.job_id, ManagedJobStatus.RUNNING)
+        self.table.set_schedule_state(self.job_id,
+                                      ManagedJobScheduleState.ALIVE)
+
+        while True:
+            time.sleep(self.poll_seconds)
+            record = self.table.get(self.job_id)
+            if record['status'] == ManagedJobStatus.CANCELLING:
+                try:
+                    AgentClient(handle.agent_url()).cancel(None)
+                except requests.RequestException:
+                    pass
+                strategy.teardown()
+                self.table.set_status(self.job_id,
+                                      ManagedJobStatus.CANCELLED)
+                return ManagedJobStatus.CANCELLED
+            status = self._poll_cluster_job(handle, cluster_job_id)
+            if status == JobStatus.SUCCEEDED:
+                strategy.teardown()
+                self.table.set_status(self.job_id,
+                                      ManagedJobStatus.SUCCEEDED)
+                return ManagedJobStatus.SUCCEEDED
+            if status == JobStatus.CANCELLED:
+                # Cluster job cancelled out-of-band: the managed job follows.
+                strategy.teardown()
+                self.table.set_status(
+                    self.job_id, ManagedJobStatus.CANCELLED,
+                    'underlying cluster job was cancelled')
+                return ManagedJobStatus.CANCELLED
+            if status in (JobStatus.FAILED, JobStatus.FAILED_SETUP,
+                          JobStatus.FAILED_DRIVER):
+                # User-code failure (cluster healthy): restart only within
+                # max_restarts_on_errors (reference semantics).
+                if restarts_on_errors < max_restarts:
+                    restarts_on_errors += 1
+                    logger.info(f'Managed job {self.job_id}: user failure; '
+                                f'restart {restarts_on_errors}/'
+                                f'{max_restarts}.')
+                    cluster_job_id, handle = self._recover(strategy)
+                    if cluster_job_id is None:
+                        return ManagedJobStatus.FAILED_NO_RESOURCE
+                    continue
+                strategy.teardown()
+                self.table.set_status(
+                    self.job_id, ManagedJobStatus.FAILED,
+                    f'cluster job ended with {status.value}')
+                return ManagedJobStatus.FAILED
+            if status is None:
+                # Agent unreachable or cluster gone → preemption path.
+                if not self._cluster_healthy(handle):
+                    logger.info(f'Managed job {self.job_id}: preemption '
+                                'detected; recovering.')
+                    cluster_job_id, handle = self._recover(strategy)
+                    if cluster_job_id is None:
+                        return ManagedJobStatus.FAILED_NO_RESOURCE
+                    continue
+
+    def _poll_cluster_job(self, handle, cluster_job_id
+                          ) -> Optional[JobStatus]:
+        try:
+            return AgentClient(handle.agent_url(),
+                               timeout=10).job_status(cluster_job_id)
+        except requests.RequestException:
+            return None
+
+    @staticmethod
+    def _cluster_healthy(handle) -> bool:
+        try:
+            statuses = provision_api.query_instances(
+                handle.cluster_info.cloud, handle.cluster_name,
+                handle.cluster_info.provider_config)
+        except Exception:  # pylint: disable=broad-except
+            return False
+        return bool(statuses) and all(s == 'running'
+                                      for s in statuses.values())
+
+    def _recover(self, strategy):
+        self.table.set_status(self.job_id, ManagedJobStatus.RECOVERING)
+        self.table.bump_recovery(self.job_id)
+        try:
+            cluster_job_id, handle = strategy.recover()
+        except exceptions.ResourcesUnavailableError as e:
+            self.table.set_status(
+                self.job_id, ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
+            return None, None
+        self.table.set_cluster(self.job_id, strategy.cluster_name,
+                               cluster_job_id)
+        self.table.set_status(self.job_id, ManagedJobStatus.RUNNING)
+        return cluster_job_id, handle
+
+
+class Scheduler:
+    """Bounded-concurrency scheduler (reference: sky/jobs/scheduler.py —
+    launches gated by controller CPU; here by config
+    jobs.max_parallel_launches)."""
+
+    def __init__(self, table: Optional[JobsTable] = None,
+                 poll_seconds: float = JOB_POLL_SECONDS) -> None:
+        self.table = table or JobsTable()
+        self.poll_seconds = poll_seconds
+        self._threads: Dict[int, threading.Thread] = {}
+        self._stop = threading.Event()
+
+    def submit(self, name: Optional[str], task_config: dict,
+               recovery_strategy: str = 'failover',
+               max_restarts_on_errors: int = 0) -> int:
+        return self.table.submit(name, task_config, recovery_strategy,
+                                 max_restarts_on_errors)
+
+    def cancel(self, job_id: int) -> bool:
+        record = self.table.get(job_id)
+        if record is None or record['status'].is_terminal():
+            return False
+        self.table.set_status(job_id, ManagedJobStatus.CANCELLING)
+        return True
+
+    def step(self) -> None:
+        """One scheduling pass: start WAITING jobs within limits."""
+        limit = int(config_lib.get_nested(('jobs', 'max_parallel_launches'),
+                                          4))
+        self._threads = {jid: t for jid, t in self._threads.items()
+                         if t.is_alive()}
+        active = len(self._threads)
+        for record in reversed(self.table.list(skip_finished=True)):
+            if active >= limit:
+                break
+            if record['schedule_state'] != ManagedJobScheduleState.WAITING:
+                continue
+            job_id = record['job_id']
+            controller = JobController(job_id, self.table,
+                                       self.poll_seconds)
+            thread = threading.Thread(target=controller.run, daemon=True,
+                                      name=f'managed-job-{job_id}')
+            self.table.set_schedule_state(job_id,
+                                          ManagedJobScheduleState.LAUNCHING)
+            thread.start()
+            self._threads[job_id] = thread
+            active += 1
+
+    def run_forever(self, interval: float = 2.0) -> None:
+        while not self._stop.is_set():
+            self.step()
+            time.sleep(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_job(self, job_id: int, timeout: float = 300.0
+                 ) -> ManagedJobStatus:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            record = self.table.get(job_id)
+            if record and record['status'].is_terminal():
+                return record['status']
+            time.sleep(0.5)
+        raise TimeoutError(f'Managed job {job_id} still not terminal.')

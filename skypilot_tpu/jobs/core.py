@@ -1,0 +1,106 @@
+"""Managed-jobs user API: launch/queue/cancel/logs.
+
+Reference parity: sky/jobs/ client+server routes.  The controller daemon is
+spawned on first use (a local process standing in for the reference's
+jobs-controller VM; see skypilot_tpu/jobs/controller.py docstring).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs.state import JobsTable, ManagedJobStatus
+
+logger = sky_logging.init_logger(__name__)
+
+_DAEMON_PID = '~/.skypilot_tpu/jobs_controller.pid'
+
+
+def _daemon_running() -> bool:
+    path = os.path.expanduser(_DAEMON_PID)
+    if not os.path.exists(path):
+        return False
+    try:
+        with open(path, encoding='utf-8') as f:
+            pid = int(f.read().strip())
+        os.kill(pid, 0)
+        return True
+    except (ValueError, ProcessLookupError, PermissionError):
+        return False
+
+
+def ensure_controller() -> None:
+    """Spawn the controller daemon if not running (the analog of ensuring
+    the jobs-controller cluster exists, SURVEY.md §3.3)."""
+    if _daemon_running():
+        return
+    log_path = os.path.expanduser('~/.skypilot_tpu/jobs_controller.log')
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.jobs.daemon'],
+        stdout=open(log_path, 'ab'), stderr=subprocess.STDOUT,
+        start_new_session=True)
+    with open(os.path.expanduser(_DAEMON_PID), 'w', encoding='utf-8') as f:
+        f.write(str(proc.pid))
+    time.sleep(0.5)
+
+
+def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
+    """Submit a managed job; returns the managed job id."""
+    name = name or task.name
+    jr = task.best_resources.job_recovery or {}
+    table = JobsTable()
+    job_id = table.submit(
+        name, task.to_yaml_config(),
+        recovery_strategy=jr.get('strategy') or 'failover',
+        max_restarts_on_errors=int(jr.get('max_restarts_on_errors', 0)))
+    ensure_controller()
+    logger.info(f'Managed job {job_id} ({name!r}) submitted.')
+    return job_id
+
+
+def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    return JobsTable().list(skip_finished=skip_finished)
+
+
+def cancel(job_ids: Optional[List[int]] = None) -> List[int]:
+    table = JobsTable()
+    targets = job_ids or [j['job_id'] for j in table.list(skip_finished=True)]
+    out = []
+    for job_id in targets:
+        record = table.get(job_id)
+        if record is None or record['status'].is_terminal():
+            continue
+        table.set_status(job_id, ManagedJobStatus.CANCELLING)
+        out.append(job_id)
+    return out
+
+
+def tail_logs(job_id: int, follow: bool = True) -> int:
+    """Stream the underlying cluster job's rank-0 log."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import state as state_lib
+    table = JobsTable()
+    record = table.get(job_id)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'Managed job {job_id} not found.')
+    deadline = time.time() + 120
+    while record['cluster_name'] is None:
+        if record['status'].is_terminal() or time.time() > deadline:
+            print(f'Managed job {job_id}: {record["status"].value} '
+                  f'({record.get("failure_reason") or "no logs"})')
+            return 0
+        time.sleep(1.0)
+        record = table.get(job_id)
+    cluster = record['cluster_name']
+    if state_lib.get_cluster(cluster) is None:
+        print(f'Managed job {job_id}: cluster {cluster} already torn down.')
+        return 0
+    return core_lib.tail_logs(cluster, record['cluster_job_id'],
+                              follow=follow)

@@ -1,0 +1,12 @@
+"""Managed-jobs controller daemon entry point."""
+from __future__ import annotations
+
+from skypilot_tpu.jobs.controller import Scheduler
+
+
+def main() -> None:
+    Scheduler().run_forever()
+
+
+if __name__ == '__main__':
+    main()

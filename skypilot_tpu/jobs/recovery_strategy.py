@@ -1,0 +1,137 @@
+"""Recovery strategies: how a managed job relaunches after preemption.
+
+Reference parity: sky/jobs/recovery_strategy.py — StrategyExecutor :60
+(launch :162, recover :178), FailoverStrategyExecutor :618 (retry same
+region/zone first, then failover elsewhere), EagerFailoverStrategyExecutor
+:720 (never retry the preempted zone — jump straight to the next cheapest),
+registered in JOBS_RECOVERY_STRATEGY_REGISTRY.
+
+The user-level checkpoint contract is unchanged from the reference
+(SURVEY.md §5.4): recipes mount a GCS bucket and resume from their latest
+Orbax checkpoint after recover() brings up a fresh slice.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state as state_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_RECOVERY_STRATEGY = 'failover'
+MAX_LAUNCH_ATTEMPTS = 3
+LAUNCH_RETRY_GAP_SECONDS = 5
+
+
+class StrategyExecutor:
+    """Launch/recover one managed job's ephemeral cluster."""
+
+    def __init__(self, task: task_lib.Task, cluster_name: str) -> None:
+        self.task = task
+        self.cluster_name = cluster_name
+        self.retry_count = 0
+
+    # -- shared machinery --------------------------------------------------
+    def _launch_once(self, blocked_resources: Optional[List] = None
+                     ) -> Tuple[int, state_lib.ClusterHandle]:
+        from skypilot_tpu import execution
+        # Re-optimize each attempt: blocked resources shift the choice.
+        self.task._chosen_resources = None  # pylint: disable=protected-access
+        job_id, handle = execution._execute(  # pylint: disable=protected-access
+            self.task, self.cluster_name, execution.ALL_STAGES,
+            detach_run=True, blocked_resources=blocked_resources)
+        assert job_id is not None and handle is not None
+        return job_id, handle
+
+    def launch(self) -> Tuple[int, state_lib.ClusterHandle]:
+        """First launch: retry transient failures a few times."""
+        last: Optional[Exception] = None
+        for attempt in range(MAX_LAUNCH_ATTEMPTS):
+            try:
+                return self._launch_once()
+            except exceptions.ResourcesUnavailableError as e:
+                last = e
+                logger.warning(f'Launch attempt {attempt + 1} found no '
+                               f'resources: {e}')
+                time.sleep(LAUNCH_RETRY_GAP_SECONDS)
+        raise exceptions.ResourcesUnavailableError(
+            f'No resources after {MAX_LAUNCH_ATTEMPTS} launch attempts: '
+            f'{last}')
+
+    def teardown(self) -> None:
+        from skypilot_tpu.backends import TpuBackend
+        record = state_lib.get_cluster(self.cluster_name)
+        if record is not None:
+            try:
+                TpuBackend().teardown(record['handle'], terminate=True)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Teardown of {self.cluster_name} failed: {e}')
+
+    def recover(self) -> Tuple[int, state_lib.ClusterHandle]:
+        raise NotImplementedError
+
+    @classmethod
+    def make(cls, task: task_lib.Task, cluster_name: str
+             ) -> 'StrategyExecutor':
+        jr = task.best_resources.job_recovery or {}
+        name = jr.get('strategy') or DEFAULT_RECOVERY_STRATEGY
+        strategy_cls = JOBS_RECOVERY_STRATEGY_REGISTRY.get_class(name)
+        return strategy_cls(task, cluster_name)
+
+
+@JOBS_RECOVERY_STRATEGY_REGISTRY.register(aliases=['failover'])
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the SAME region/zone first (data/cache locality), then let the
+    optimizer pick elsewhere (reference :618)."""
+
+    def recover(self) -> Tuple[int, state_lib.ClusterHandle]:
+        self.retry_count += 1
+        self.teardown()
+        # 1) Same region/zone as the preempted cluster.
+        record_resources = self._last_launched_resources()
+        if record_resources is not None:
+            pinned = self.task.best_resources.copy(
+                region=record_resources.region, zone=None)
+            try:
+                self.task.set_resources_chosen(pinned)
+                from skypilot_tpu import execution
+                job_id, handle = execution._execute(  # pylint: disable=protected-access
+                    self.task, self.cluster_name, execution.ALL_STAGES,
+                    detach_run=True)
+                assert job_id is not None
+                return job_id, handle
+            except exceptions.ResourcesUnavailableError:
+                logger.info('Same-region recovery failed; failing over.')
+        # 2) Anywhere.
+        return self.launch()
+
+    def _last_launched_resources(self) -> Optional[resources_lib.Resources]:
+        record = state_lib.get_cluster(self.cluster_name)
+        if record is None:
+            return None
+        return record['handle'].launched_resources
+
+
+@JOBS_RECOVERY_STRATEGY_REGISTRY.register(
+    aliases=['eager_failover', 'eager_next_cloud'])
+class EagerFailoverStrategyExecutor(StrategyExecutor):
+    """Never return to the preempted zone: blocklist it and go straight to
+    the next cheapest offering (reference :720)."""
+
+    def __init__(self, task: task_lib.Task, cluster_name: str) -> None:
+        super().__init__(task, cluster_name)
+        self.blocked: List[resources_lib.Resources] = []
+
+    def recover(self) -> Tuple[int, state_lib.ClusterHandle]:
+        self.retry_count += 1
+        record = state_lib.get_cluster(self.cluster_name)
+        if record is not None:
+            self.blocked.append(record['handle'].launched_resources)
+        self.teardown()
+        return self._launch_once(blocked_resources=self.blocked)

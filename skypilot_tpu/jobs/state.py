@@ -1,0 +1,173 @@
+"""Managed-job state machine (sqlite).
+
+Reference parity: sky/jobs/state.py (2,031 LoC) — ManagedJobStatus :335
+(PENDING/STARTING/RUNNING/RECOVERING/CANCELLING/SUCCEEDED/CANCELLED/FAILED/
+FAILED_SETUP/FAILED_PRECHECKS/FAILED_NO_RESOURCE/FAILED_CONTROLLER) and
+ManagedJobScheduleState :546 (INACTIVE/WAITING/LAUNCHING/ALIVE/DONE).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    CANCELLING = 'CANCELLING'
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def failure_statuses(cls):
+        return [s for s in _TERMINAL
+                if s not in (cls.SUCCEEDED, cls.CANCELLED)]
+
+
+_TERMINAL = frozenset({
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.CANCELLED,
+    ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+})
+
+
+class ManagedJobScheduleState(enum.Enum):
+    INACTIVE = 'INACTIVE'
+    WAITING = 'WAITING'
+    LAUNCHING = 'LAUNCHING'
+    ALIVE = 'ALIVE'
+    DONE = 'DONE'
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS managed_jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    task_yaml TEXT,
+    status TEXT,
+    schedule_state TEXT,
+    cluster_name TEXT,
+    cluster_job_id INTEGER,
+    submitted_at REAL,
+    start_at REAL,
+    end_at REAL,
+    recovery_count INTEGER DEFAULT 0,
+    failure_reason TEXT,
+    recovery_strategy TEXT,
+    max_restarts_on_errors INTEGER DEFAULT 0
+);
+"""
+
+
+class JobsTable:
+
+    def __init__(self, db_path: str = '~/.skypilot_tpu/managed_jobs.db'
+                 ) -> None:
+        self.db_path = os.path.expanduser(db_path)
+        os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.db_path, timeout=30)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    def submit(self, name: Optional[str], task_config: Dict[str, Any],
+               recovery_strategy: str = 'failover',
+               max_restarts_on_errors: int = 0) -> int:
+        with self._conn() as conn:
+            cur = conn.execute(
+                'INSERT INTO managed_jobs (name, task_yaml, status, '
+                'schedule_state, submitted_at, recovery_strategy, '
+                'max_restarts_on_errors) VALUES (?, ?, ?, ?, ?, ?, ?)',
+                (name, json.dumps(task_config),
+                 ManagedJobStatus.PENDING.value,
+                 ManagedJobScheduleState.WAITING.value, time.time(),
+                 recovery_strategy, max_restarts_on_errors))
+            return int(cur.lastrowid)
+
+    def set_status(self, job_id: int, status: ManagedJobStatus,
+                   failure_reason: Optional[str] = None) -> None:
+        sets = ['status = ?']
+        args: List[Any] = [status.value]
+        if status == ManagedJobStatus.RUNNING:
+            sets.append('start_at = COALESCE(start_at, ?)')
+            args.append(time.time())
+        if status.is_terminal():
+            sets.append('end_at = ?')
+            args.append(time.time())
+            sets.append('schedule_state = ?')
+            args.append(ManagedJobScheduleState.DONE.value)
+        if failure_reason is not None:
+            sets.append('failure_reason = ?')
+            args.append(failure_reason)
+        args.append(job_id)
+        with self._conn() as conn:
+            conn.execute(
+                f'UPDATE managed_jobs SET {", ".join(sets)} WHERE job_id = ?',
+                args)
+
+    def set_schedule_state(self, job_id: int,
+                           state: ManagedJobScheduleState) -> None:
+        with self._conn() as conn:
+            conn.execute(
+                'UPDATE managed_jobs SET schedule_state = ? WHERE job_id = ?',
+                (state.value, job_id))
+
+    def set_cluster(self, job_id: int, cluster_name: Optional[str],
+                    cluster_job_id: Optional[int]) -> None:
+        with self._conn() as conn:
+            conn.execute(
+                'UPDATE managed_jobs SET cluster_name = ?, cluster_job_id = ?'
+                ' WHERE job_id = ?', (cluster_name, cluster_job_id, job_id))
+
+    def bump_recovery(self, job_id: int) -> int:
+        with self._conn() as conn:
+            conn.execute(
+                'UPDATE managed_jobs SET recovery_count = recovery_count + 1 '
+                'WHERE job_id = ?', (job_id,))
+            row = conn.execute(
+                'SELECT recovery_count FROM managed_jobs WHERE job_id = ?',
+                (job_id,)).fetchone()
+            return int(row['recovery_count'])
+
+    def get(self, job_id: int) -> Optional[Dict[str, Any]]:
+        with self._conn() as conn:
+            row = conn.execute(
+                'SELECT * FROM managed_jobs WHERE job_id = ?',
+                (job_id,)).fetchone()
+        return self._to_dict(row) if row else None
+
+    def list(self, skip_finished: bool = False) -> List[Dict[str, Any]]:
+        with self._conn() as conn:
+            rows = conn.execute(
+                'SELECT * FROM managed_jobs ORDER BY job_id DESC').fetchall()
+        out = [self._to_dict(r) for r in rows]
+        if skip_finished:
+            out = [j for j in out if not j['status'].is_terminal()]
+        return out
+
+    @staticmethod
+    def _to_dict(row) -> Dict[str, Any]:
+        d = dict(row)
+        d['status'] = ManagedJobStatus(d['status'])
+        d['schedule_state'] = ManagedJobScheduleState(d['schedule_state'])
+        d['task_config'] = json.loads(d.pop('task_yaml'))
+        return d
