@@ -1,0 +1,28 @@
+"""psum allreduce bench over the slice's ICI mesh (runs on every host)."""
+import argparse
+import json
+
+from skypilot_tpu.utils import env_contract
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--payload-mb', type=float, default=256)
+    parser.add_argument('--iters', type=int, default=20)
+    args = parser.parse_args()
+
+    env_contract.initialize_from_env()
+    import jax
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import collectives
+
+    n = jax.device_count()
+    mesh = make_mesh(MeshConfig(dp=n))
+    result = collectives.psum_bench(mesh, 'dp', payload_mb=args.payload_mb,
+                                    iters=args.iters)
+    if jax.process_index() == 0:
+        print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,106 @@
+"""Llama finetuning entry point for TPU slices (the flagship recipe).
+
+Runs identically on one host or a 64-host v5e-256 slice: the injected env
+contract boots jax.distributed, the mesh spans every chip in the slice, and
+Orbax checkpoints to --checkpoint-dir (a mounted GCS bucket) make managed-job
+recovery resume-from-step (reference contract: SURVEY.md §5.4).
+"""
+import argparse
+import os
+
+from skypilot_tpu.utils import env_contract
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model-size', default='1b',
+                        choices=['debug', '1b', '8b', '70b'])
+    parser.add_argument('--seq-len', type=int, default=4096)
+    parser.add_argument('--batch-size', type=int, default=0,
+                        help='global batch; 0 = 1 sequence per dp shard')
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--dp', type=int, default=0, help='0 = auto')
+    parser.add_argument('--fsdp', type=int, default=0)
+    parser.add_argument('--sp', type=int, default=1)
+    parser.add_argument('--tp', type=int, default=1)
+    parser.add_argument('--learning-rate', type=float, default=2e-5)
+    parser.add_argument('--checkpoint-dir', default='')
+    parser.add_argument('--checkpoint-every', type=int, default=50)
+    parser.add_argument('--resume', default='no', choices=['no', 'auto'])
+    args = parser.parse_args()
+
+    env_contract.initialize_from_env()
+    import functools
+    import jax
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import (MeshConfig, auto_mesh_config,
+                                       make_mesh)
+    from skypilot_tpu.parallel import ring_attention as ring_lib
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
+
+    config = {
+        'debug': llama.LLAMA_DEBUG,
+        '1b': llama.LLAMA_1B,
+        '8b': llama.LLAMA3_8B,
+        '70b': llama.LLAMA3_70B,
+    }[args.model_size]
+
+    n = jax.device_count()
+    if args.fsdp or args.dp or args.tp > 1 or args.sp > 1:
+        dp = args.dp or max(1, n // (max(args.fsdp, 1) * args.sp * args.tp))
+        mesh_config = MeshConfig(dp=dp, fsdp=max(args.fsdp, 1), sp=args.sp,
+                                 tp=args.tp)
+    else:
+        mesh_config = auto_mesh_config(
+            n, model_params_b=config.num_params() / 1e9,
+            seq_len=args.seq_len)
+    mesh = make_mesh(mesh_config)
+    if jax.process_index() == 0:
+        print(f'devices={n} {mesh_config} model={args.model_size} '
+              f'({config.num_params()/1e9:.2f}B params) '
+              f'seq={args.seq_len}')
+
+    attention_fn = None
+    if mesh_config.sp > 1:
+        attention_fn = functools.partial(
+            ring_lib.ring_attention, mesh=mesh, axis_name='sp',
+            head_axis='tp' if mesh_config.tp > 1 else None)
+
+    def loss(p, batch):
+        return llama.loss_fn(p, batch, config, attention_fn=attention_fn)
+
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    trainer = Trainer(loss, params, mesh, sharding_lib.LLAMA_RULES,
+                      TrainConfig(learning_rate=args.learning_rate,
+                                  warmup_steps=min(100, args.steps // 10 + 1),
+                                  total_steps=args.steps))
+
+    if args.resume == 'auto' and args.checkpoint_dir:
+        steps = []
+        if os.path.isdir(args.checkpoint_dir):
+            for d in os.listdir(args.checkpoint_dir):
+                if d.startswith('step_'):
+                    steps.append(int(d.split('_')[1]))
+        if steps:
+            trainer.restore_checkpoint(args.checkpoint_dir, max(steps))
+            if jax.process_index() == 0:
+                print(f'resumed from step {trainer.step}')
+
+    batch_size = args.batch_size or mesh_config.dp * mesh_config.fsdp
+    batches = synthetic_batches(batch_size, args.seq_len, config.vocab_size)
+    tokens_per_batch = batch_size * args.seq_len
+    while trainer.step < args.steps:
+        chunk = min(args.checkpoint_every, args.steps - trainer.step)
+        summary = trainer.fit(batches, chunk, log_every=10,
+                              tokens_per_batch=tokens_per_batch)
+        if args.checkpoint_dir:
+            trainer.save_checkpoint(args.checkpoint_dir)
+    if jax.process_index() == 0:
+        print(f"final: loss={summary['loss']:.4f} "
+              f"tokens/sec={summary.get('tokens_per_sec', 0):.0f} "
+              f"({summary.get('tokens_per_sec', 0) / n:.0f}/chip)")
+
+
+if __name__ == '__main__':
+    main()

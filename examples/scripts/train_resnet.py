@@ -1,0 +1,74 @@
+"""ResNet-50 training on synthetic data, batch-sharded over the slice."""
+import argparse
+import time
+
+from skypilot_tpu.utils import env_contract
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--batch-size', type=int, default=1024)
+    parser.add_argument('--image-size', type=int, default=224)
+    args = parser.parse_args()
+
+    env_contract.initialize_from_env()
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from skypilot_tpu.models import resnet
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+
+    n = jax.device_count()
+    mesh = make_mesh(MeshConfig(dp=n))
+    model = resnet.ResNet50()
+    x = jnp.ones((args.batch_size, args.image_size, args.image_size, 3),
+                 jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    variables = model.init(key, x[:2], train=True)
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(variables['params'])
+    batch_sharding = NamedSharding(mesh, P('dp'))
+    replicated = NamedSharding(mesh, P())
+    variables = jax.device_put(variables, replicated)
+    opt_state = jax.device_put(opt_state, replicated)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {'params': params, 'batch_stats': batch_stats}, images,
+            train=True, mutable=['batch_stats'])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, updates['batch_stats']
+
+    @jax.jit
+    def train_step(variables, opt_state, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(variables['params'],
+                                   variables['batch_stats'], images, labels)
+        updates, opt_state = tx.update(grads, opt_state)
+        params = optax.apply_updates(variables['params'], updates)
+        return {'params': params, 'batch_stats': new_stats}, opt_state, loss
+
+    images = jax.device_put(x, batch_sharding)
+    labels = jax.device_put(
+        jnp.zeros((args.batch_size,), jnp.int32), batch_sharding)
+    # Warmup/compile.
+    variables, opt_state, loss = train_step(variables, opt_state, images,
+                                            labels)
+    jax.block_until_ready(loss)
+    start = time.perf_counter()
+    for _ in range(args.steps):
+        variables, opt_state, loss = train_step(variables, opt_state,
+                                                images, labels)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    ips = args.batch_size * args.steps / elapsed
+    if jax.process_index() == 0:
+        print(f'images/sec: {ips:.1f} ({ips / n:.1f}/chip), '
+              f'final loss {float(loss):.4f}')
+
+
+if __name__ == '__main__':
+    main()
