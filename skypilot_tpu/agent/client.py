@@ -25,12 +25,25 @@ class AgentClient:
         resp.raise_for_status()
         return resp.json()
 
-    def wait_ready(self, timeout: float = 60.0) -> None:
+    def wait_ready(self, timeout: float = 60.0,
+                   expected_cluster: Optional[str] = None) -> None:
+        """Wait for a healthy agent; with expected_cluster, also verify its
+        identity (an agent that lost a port-bind race on localhost would
+        otherwise answer for the wrong cluster)."""
         deadline = time.time() + timeout
         last_err: Optional[Exception] = None
         while time.time() < deadline:
             try:
-                if self.health().get('ok'):
+                info = self.health()
+                if info.get('ok'):
+                    reported = info.get('cluster_name')
+                    if expected_cluster is not None and \
+                            reported is not None and \
+                            reported != expected_cluster:
+                        raise exceptions.ClusterNotUpError(
+                            f'Agent at {self.base_url} serves cluster '
+                            f'{reported!r}, expected {expected_cluster!r} '
+                            '(port collision).')
                     return
             except requests.RequestException as e:
                 last_err = e

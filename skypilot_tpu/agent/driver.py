@@ -96,7 +96,18 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             with lock:
                 procs[rank] = proc
                 _LIVE_PROCS.append(proc)
+            # Pid file so cluster teardown can reap this (own-session)
+            # rank even if driver and agent are already gone.
+            with open(os.path.join(log_dir, f'rank-{rank}.pid'), 'w',
+                      encoding='utf-8') as pf:
+                pf.write(str(proc.pid))
             rc = proc.wait()
+            # Reap the pid file: a stale one risks killing an unrelated
+            # process after OS pid reuse (teardown walks pid files).
+            try:
+                os.remove(os.path.join(log_dir, f'rank-{rank}.pid'))
+            except OSError:
+                pass
             returncodes[rank] = rc
             if rc != 0:
                 failed_event.set()
@@ -161,6 +172,13 @@ def main() -> int:
     except BaseException:  # noqa: B036 — any driver crash must mark the job
         job_table.set_status(job_id, JobStatus.FAILED_DRIVER)
         raise
+    finally:
+        # Reap our pid file (stale pids + OS pid reuse would make teardown
+        # kill an unrelated process group).
+        try:
+            os.remove(os.path.join(spec['log_dir'], 'driver.pid'))
+        except OSError:
+            pass
 
 
 if __name__ == '__main__':

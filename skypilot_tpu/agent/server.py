@@ -39,12 +39,14 @@ DEFAULT_PORT = 46590  # same port as the reference's skylet gRPC
 
 class AgentState:
 
-    def __init__(self, base_dir: str) -> None:
+    def __init__(self, base_dir: str,
+                 cluster_name: Optional[str] = None) -> None:
         self.base_dir = os.path.expanduser(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
         self.job_table = job_lib.JobTable(
             os.path.join(self.base_dir, 'jobs.db'))
         self.autostop_path = os.path.join(self.base_dir, 'autostop.json')
+        self.cluster_name = cluster_name
         self.started_at = time.time()
 
     def log_dir_for(self, job_id: int) -> str:
@@ -60,7 +62,11 @@ def make_app(state: AgentState) -> web.Application:
 
     @routes.get('/health')
     async def health(request: web.Request) -> web.Response:
+        # cluster_name lets clients verify they reached THE agent for
+        # their cluster, not another agent that won a port-bind race
+        # (possible on the local cloud where all agents share localhost).
         return web.json_response({'ok': True, 'agent_version': AGENT_VERSION,
+                                  'cluster_name': state.cluster_name,
                                   'time': time.time(),
                                   'started_at': state.started_at})
 
@@ -89,6 +95,11 @@ def make_app(state: AgentState) -> web.Application:
             stderr=subprocess.STDOUT,
             start_new_session=True)
         state.job_table.set_pid(job_id, proc.pid)
+        # Pid file so teardown can reap the (own-session) driver even
+        # after the agent dies (see provision/local terminate path).
+        with open(os.path.join(log_dir, 'driver.pid'), 'w',
+                  encoding='utf-8') as f:
+            f.write(str(proc.pid))
         return web.json_response({'job_id': job_id})
 
     @routes.get('/jobs/queue')
@@ -189,8 +200,9 @@ def main(argv: Optional[list] = None) -> None:
     parser.add_argument('--base-dir', required=True)
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
     parser.add_argument('--event-interval', type=float, default=20.0)
+    parser.add_argument('--cluster-name', default=None)
     args = parser.parse_args(argv)
-    state = AgentState(args.base_dir)
+    state = AgentState(args.base_dir, cluster_name=args.cluster_name)
     app = make_app(state)
 
     async def _run() -> None:

@@ -59,7 +59,8 @@ class TpuBackend:
             hosts_per_node = spec.num_hosts * to_provision.num_slices \
                 if spec else 1
             outcome = provisioner.provision_with_failover(
-                to_provision, cluster_name, num_nodes=task.num_nodes)
+                to_provision, cluster_name, num_nodes=task.num_nodes,
+                volumes=list(task.volumes.values()))
             handle = outcome.handle
             expected = hosts_per_node * task.num_nodes
             if handle.num_hosts != expected:
@@ -105,6 +106,47 @@ class TpuBackend:
             for runner in runners:
                 runner.rsync(os.path.expanduser(src), target.lstrip('/'),
                              up=True)
+
+    def mount_volumes(self, handle: state.ClusterHandle,
+                      volumes: Dict[str, str]) -> None:
+        """Attach/mount named volumes (task `volumes: {path: name}`).
+
+        Local cloud: the volume dir is symlinked (hermetic analog).  GCP:
+        the PD is attached at node-create time as a dataDisk; here we
+        format-if-needed and mount its device on every host.
+        """
+        if not volumes:
+            return
+        from skypilot_tpu.volumes import core as volumes_core
+        runners = provisioner._make_runners(handle.cluster_info)
+        cloud = handle.cluster_info.cloud
+        for mount_path, volume_name in volumes.items():
+            record = volumes_core.get(volume_name)
+            if record is None:
+                raise exceptions.StorageError(
+                    f'Volume {volume_name!r} not found; create it with '
+                    f'`skytpu volumes apply` first.')
+            if cloud == 'local':
+                from skypilot_tpu.provision.local import volume as lvol
+                vdir = lvol.volume_dir(volume_name)
+                cmd = (f'mkdir -p {os.path.dirname(mount_path)} && '
+                       f'rm -rf {mount_path} && ln -sfn {vdir} {mount_path}')
+            else:
+                device = f'/dev/disk/by-id/google-{volume_name}'
+                # Idempotent: re-launches on a reused cluster re-run this.
+                cmd = (f'sudo mkdir -p {mount_path} && '
+                       f'(sudo blkid {device} >/dev/null || '
+                       f'sudo mkfs.ext4 -m 0 {device}) && '
+                       f'(mountpoint -q {mount_path} || '
+                       f'sudo mount -o discard,defaults {device} '
+                       f'{mount_path}) && sudo chmod a+w {mount_path}')
+            rcs = runner_lib.run_on_hosts_parallel(runners, cmd)
+            bad = [i for i, rc in enumerate(rcs) if rc != 0]
+            if bad:
+                raise exceptions.StorageError(
+                    f'Mounting volume {volume_name!r} at {mount_path} '
+                    f'failed on hosts {bad}.')
+            volumes_core.mark_attached(volume_name, handle.cluster_name)
 
     # ---- setup -----------------------------------------------------------
     def setup(self, handle: state.ClusterHandle, task: task_lib.Task,

@@ -1,0 +1,51 @@
+"""Credential/cloud enablement checking (reference: sky/check.py, 664 LoC).
+
+`check()` probes every registered cloud's credentials and caches the
+enabled set in the state DB-adjacent config dir, so the optimizer can skip
+clouds with no access (reference: get_cached_enabled_clouds).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+logger = sky_logging.init_logger(__name__)
+
+_CACHE_PATH = '~/.skypilot_tpu/enabled_clouds.json'
+
+
+def check(quiet: bool = False) -> Dict[str, Any]:
+    """Probe all clouds; returns {cloud: {'enabled': bool, 'reason': str}}
+    and refreshes the enabled-clouds cache."""
+    results: Dict[str, Any] = {}
+    enabled: List[str] = []
+    for name, cloud in CLOUD_REGISTRY.items():
+        ok, reason = cloud.check_credentials()
+        results[name] = {'enabled': ok, 'reason': None if ok else reason}
+        if ok:
+            enabled.append(name)
+        if not quiet:
+            mark = '✓' if ok else '✗'
+            print(f'  {mark} {name}: {"enabled" if ok else reason}')
+    path = os.path.expanduser(_CACHE_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'enabled': enabled, 'checked_at': time.time()}, f)
+    return results
+
+
+def get_cached_enabled_clouds() -> List[str]:
+    """Enabled clouds from the last `check` (empty if never run)."""
+    path = os.path.expanduser(_CACHE_PATH)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f).get('enabled', [])
+    except (json.JSONDecodeError, OSError):
+        return []

@@ -23,42 +23,43 @@ def _fmt_table(rows: List[List[str]], headers: List[str]) -> str:
 
 
 def _cmd_launch(args) -> int:
-    from skypilot_tpu import execution, task as task_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.client import sdk
     task = task_lib.Task.from_yaml(args.yaml)
     if args.env:
         task.update_envs(dict(kv.split('=', 1) for kv in args.env))
-    job_id, handle = execution.launch(
+    job_id, cluster_name = sdk.launch(
         task, cluster_name=args.cluster, detach_run=args.detach_run,
         down=args.down)
-    if job_id is not None and handle is not None:
-        print(f'Job {job_id} on cluster {handle.cluster_name!r}.')
+    if job_id is not None and cluster_name is not None:
+        print(f'Job {job_id} on cluster {cluster_name!r}.')
     return 0
 
 
 def _cmd_exec(args) -> int:
-    from skypilot_tpu import execution, task as task_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.client import sdk
     task = task_lib.Task.from_yaml(args.yaml)
-    job_id, handle = execution.exec_cmd(task, cluster_name=args.cluster,
-                                        detach_run=args.detach_run)
-    print(f'Job {job_id} on cluster {handle.cluster_name!r}.')
+    job_id, cluster_name = sdk.exec(task, cluster_name=args.cluster,
+                                    detach_run=args.detach_run)
+    print(f'Job {job_id} on cluster {cluster_name!r}.')
     return 0
 
 
 def _cmd_status(args) -> int:
-    from skypilot_tpu import core
-    records = core.status(refresh=args.refresh)
+    from skypilot_tpu.client import sdk
+    records = sdk.status(refresh=args.refresh)
     if not records:
         print('No existing clusters.')
         return 0
     rows = []
     for r in records:
-        handle = r['handle']
         age = time.time() - (r['launched_at'] or time.time())
         rows.append([
             r['name'],
-            str(handle.launched_resources),
-            str(handle.num_hosts),
-            r['status'].value,
+            r.get('resources_str') or str(r['resources']),
+            str(r['num_hosts']),
+            r['status'],
             f'{age/3600:.1f}h',
         ])
     print(_fmt_table(rows, ['NAME', 'RESOURCES', 'HOSTS', 'STATUS', 'AGE']))
@@ -66,8 +67,8 @@ def _cmd_status(args) -> int:
 
 
 def _cmd_queue(args) -> int:
-    from skypilot_tpu import core
-    jobs = core.queue(args.cluster, all_jobs=args.all)
+    from skypilot_tpu.client import sdk
+    jobs = sdk.queue(args.cluster, all_jobs=args.all)
     rows = [[j['job_id'], j.get('name') or '-', j['status'],
              time.strftime('%m-%d %H:%M',
                            time.localtime(j['submitted_at']))]
@@ -77,35 +78,35 @@ def _cmd_queue(args) -> int:
 
 
 def _cmd_logs(args) -> int:
-    from skypilot_tpu import core
-    return core.tail_logs(args.cluster, args.job_id, follow=not args.no_follow,
-                          rank=args.rank)
+    from skypilot_tpu.client import sdk
+    return sdk.tail_logs(args.cluster, args.job_id,
+                         follow=not args.no_follow, rank=args.rank)
 
 
 def _cmd_cancel(args) -> int:
-    from skypilot_tpu import core
-    cancelled = core.cancel(args.cluster,
-                            args.job_ids if args.job_ids else None)
+    from skypilot_tpu.client import sdk
+    cancelled = sdk.cancel(args.cluster,
+                           args.job_ids if args.job_ids else None)
     print(f'Cancelled jobs: {cancelled}')
     return 0
 
 
 def _cmd_down(args) -> int:
-    from skypilot_tpu import core
+    from skypilot_tpu.client import sdk
     for name in args.clusters:
-        core.down(name)
+        sdk.down(name)
     return 0
 
 
 def _cmd_stop(args) -> int:
-    from skypilot_tpu import core
-    core.stop(args.cluster)
+    from skypilot_tpu.client import sdk
+    sdk.stop(args.cluster)
     return 0
 
 
 def _cmd_autostop(args) -> int:
-    from skypilot_tpu import core
-    core.autostop(args.cluster, args.idle_minutes, down=True)
+    from skypilot_tpu.client import sdk
+    sdk.autostop(args.cluster, args.idle_minutes, down=True)
     print(f'Autodown set: {args.cluster} after {args.idle_minutes}m idle.')
     return 0
 
@@ -209,6 +210,16 @@ def build_parser() -> argparse.ArgumentParser:
     try:
         from skypilot_tpu.serve import cli as serve_cli
         serve_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from skypilot_tpu.server import cli as api_cli
+        api_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from skypilot_tpu.volumes import cli as volumes_cli
+        volumes_cli.register(sub)
     except ImportError:
         pass
     return parser

@@ -1,0 +1,108 @@
+"""REST client for the API server (reference: sky/client/sdk.py's
+request layer — submit, then `stream_and_get` on the returned request id).
+
+Enable by setting the endpoint: env `SKYTPU_API_SERVER_URL`, or config
+`api_server.endpoint`; the SDK then routes every call here instead of the
+library-local engine.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import exceptions
+
+
+class RestClient:
+
+    def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
+        self.endpoint = endpoint.rstrip('/')
+        self.timeout = timeout
+
+    # --- request plumbing ---
+
+    def submit(self, path: str, payload: Dict[str, Any]) -> str:
+        """POST an async endpoint; returns the request_id."""
+        try:
+            resp = requests_lib.post(self.endpoint + path, json=payload,
+                                     timeout=self.timeout)
+        except requests_lib.RequestException as e:
+            raise exceptions.ApiServerError(
+                f'Cannot reach API server at {self.endpoint}: {e}') from e
+        if resp.status_code != 202:
+            raise exceptions.ApiServerError(
+                f'{path} -> {resp.status_code}: {resp.text}')
+        return resp.json()['request_id']
+
+    def get(self, request_id: str, timeout: float = 600.0) -> Any:
+        """Block until the request finishes; return its result
+        (reference: sdk.get)."""
+        deadline = time.time() + timeout
+        while True:
+            remaining = max(1.0, deadline - time.time())
+            resp = requests_lib.get(
+                self.endpoint + '/api/get',
+                params={'request_id': request_id,
+                        'timeout': min(remaining, 60.0)},
+                timeout=min(remaining, 60.0) + 10)
+            resp.raise_for_status()
+            record = resp.json()
+            if record['status'] == 'FAILED':
+                raise exceptions.ApiServerError(
+                    f'Request {record["name"]} failed: {record["error"]}')
+            if record['status'] == 'CANCELLED':
+                raise exceptions.RequestCancelled(request_id)
+            if record['status'] == 'SUCCEEDED':
+                return record['result']
+            if time.time() > deadline:
+                raise exceptions.ApiServerError(
+                    f'Request {request_id} still {record["status"]} after '
+                    f'{timeout}s')
+
+    def stream(self, request_id: str) -> Iterator[str]:
+        """Stream a request's log output (reference: sdk.stream_and_get)."""
+        with requests_lib.get(self.endpoint + '/api/stream',
+                              params={'request_id': request_id},
+                              stream=True, timeout=None) as resp:
+            resp.raise_for_status()
+            for line in resp.iter_lines(decode_unicode=True):
+                yield line
+
+    def submit_and_get(self, path: str, payload: Dict[str, Any],
+                       timeout: float = 600.0) -> Any:
+        return self.get(self.submit(path, payload), timeout=timeout)
+
+    # --- convenience wrappers mirroring the SDK surface ---
+
+    def health(self) -> Dict[str, Any]:
+        resp = requests_lib.get(self.endpoint + '/api/health',
+                                timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()
+
+    def tail_cluster_logs(self, cluster_name: str,
+                          job_id: Optional[int] = None,
+                          follow: bool = True) -> Iterator[str]:
+        params: Dict[str, Any] = {'cluster_name': cluster_name,
+                                  'follow': int(follow)}
+        if job_id is not None:
+            params['job_id'] = job_id
+        with requests_lib.get(self.endpoint + '/logs', params=params,
+                              stream=True, timeout=None) as resp:
+            resp.raise_for_status()
+            for line in resp.iter_lines(decode_unicode=True):
+                yield line
+
+
+def get_client() -> Optional[RestClient]:
+    """The configured RestClient, or None for library-local mode."""
+    import os
+
+    from skypilot_tpu import config as config_lib
+    endpoint = os.environ.get('SKYTPU_API_SERVER_URL') or \
+        config_lib.get_nested(('api_server', 'endpoint'), None)
+    if not endpoint:
+        return None
+    return RestClient(endpoint)

@@ -1,60 +1,130 @@
 """Client SDK (mirrors sky/client/sdk.py).
 
-Currently runs library-local (direct calls into the execution engine) — the
-REST client/server split lands with skypilot_tpu.server; the reference uses
-the same trick in tests (inline executor, tests/common_test_fixtures.py:56).
+Two modes, chosen per-call:
+- REST: when an API server endpoint is configured (`SKYTPU_API_SERVER_URL`
+  env or `api_server.endpoint` config), calls go through the async-request
+  REST protocol (submit -> request_id -> get), like the reference's
+  client/server split.
+- Library-local: direct calls into the execution engine — the reference
+  uses the same trick in tests (inline executor,
+  tests/common_test_fixtures.py:56).
 """
 from __future__ import annotations
 
 from typing import Any, List, Optional
 
+from skypilot_tpu.client import rest
+
 
 def launch(task, cluster_name: Optional[str] = None, **kwargs) -> Any:
+    """Returns (job_id, cluster_name) — the same shape in both modes."""
+    client = rest.get_client()
+    if client is not None:
+        result = client.submit_and_get(
+            '/launch', {'task': task.to_yaml_config(),
+                        'cluster_name': cluster_name, **kwargs})
+        return result['job_id'], result['cluster_name']
     from skypilot_tpu import execution
-    return execution.launch(task, cluster_name=cluster_name, **kwargs)
+    job_id, handle = execution.launch(task, cluster_name=cluster_name,
+                                      **kwargs)
+    return job_id, handle.cluster_name if handle else None
 
 
 def exec(task, cluster_name: str, **kwargs) -> Any:  # pylint: disable=redefined-builtin
+    client = rest.get_client()
+    if client is not None:
+        result = client.submit_and_get(
+            '/exec', {'task': task.to_yaml_config(),
+                      'cluster_name': cluster_name, **kwargs})
+        return result['job_id'], result['cluster_name']
     from skypilot_tpu import execution
-    return execution.exec_cmd(task, cluster_name=cluster_name, **kwargs)
+    job_id, handle = execution.exec_cmd(task, cluster_name=cluster_name,
+                                        **kwargs)
+    return job_id, handle.cluster_name if handle else None
 
 
 def status(cluster_names: Optional[List[str]] = None, **kwargs) -> Any:
+    """Returns JSON-safe cluster records (core.status_payload shape) in
+    both modes."""
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get(
+            '/status', {'cluster_names': cluster_names, **kwargs})
     from skypilot_tpu import core
-    return core.status(cluster_names=cluster_names, **kwargs)
+    return core.status_payload(
+        core.status(cluster_names=cluster_names, **kwargs))
 
 
 def start(cluster_name: str, **kwargs) -> Any:
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get('/start',
+                                     {'cluster_name': cluster_name})
     from skypilot_tpu import core
     return core.start(cluster_name, **kwargs)
 
 
 def stop(cluster_name: str, **kwargs) -> Any:
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get('/stop',
+                                     {'cluster_name': cluster_name})
     from skypilot_tpu import core
     return core.stop(cluster_name, **kwargs)
 
 
 def down(cluster_name: str, **kwargs) -> Any:
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get('/down',
+                                     {'cluster_name': cluster_name})
     from skypilot_tpu import core
     return core.down(cluster_name, **kwargs)
 
 
-def autostop(cluster_name: str, idle_minutes: int, down: bool = False) -> Any:
+def autostop(cluster_name: str, idle_minutes: int, down: bool = False) -> Any:  # pylint: disable=redefined-outer-name
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get(
+            '/autostop', {'cluster_name': cluster_name,
+                          'idle_minutes': idle_minutes, 'down': down})
     from skypilot_tpu import core
     return core.autostop(cluster_name, idle_minutes, down=down)
 
 
 def queue(cluster_name: str, **kwargs) -> Any:
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get('/queue',
+                                     {'cluster_name': cluster_name,
+                                      **kwargs})
     from skypilot_tpu import core
-    return core.queue(cluster_name, **kwargs)
+    jobs = core.queue(cluster_name, **kwargs)
+    return [{**j, 'status': j['status'].value
+             if hasattr(j.get('status'), 'value') else j.get('status')}
+            for j in jobs]
 
 
-def cancel(cluster_name: str, job_ids: Optional[List[int]] = None, **kwargs) -> Any:
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           **kwargs) -> Any:
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get('/cancel',
+                                     {'cluster_name': cluster_name,
+                                      'job_ids': job_ids})
     from skypilot_tpu import core
     return core.cancel(cluster_name, job_ids=job_ids, **kwargs)
 
 
-def tail_logs(cluster_name: str, job_id: Optional[int] = None, **kwargs) -> Any:
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              **kwargs) -> Any:
+    client = rest.get_client()
+    if client is not None:
+        for line in client.tail_cluster_logs(cluster_name, job_id=job_id,
+                                             follow=kwargs.get('follow',
+                                                               True)):
+            print(line)
+        return 0
     from skypilot_tpu import core
     return core.tail_logs(cluster_name, job_id=job_id, **kwargs)
 
@@ -62,3 +132,9 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None, **kwargs) -> Any:
 def optimize(dag, **kwargs) -> Any:
     from skypilot_tpu import optimizer
     return optimizer.Optimizer.optimize(dag, **kwargs)
+
+
+def api_health() -> Any:
+    """Ping the configured API server (None in library-local mode)."""
+    client = rest.get_client()
+    return client.health() if client is not None else None

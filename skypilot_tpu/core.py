@@ -93,6 +93,25 @@ def status(cluster_names: Optional[List[str]] = None,
     return records
 
 
+def status_payload(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """JSON-safe view of status() records — the wire shape shared by the
+    REST API and the SDK's local mode (so clients see one schema)."""
+    out = []
+    for record in records:
+        handle = record['handle']
+        out.append({
+            'name': record['name'],
+            'launched_at': record['launched_at'],
+            'status': record['status'].value if record['status'] else None,
+            'resources': handle.launched_resources.to_yaml_config(),
+            'resources_str': str(handle.launched_resources),
+            'head_ip': handle.head_ip,
+            'num_hosts': handle.num_hosts,
+            'autostop': record.get('autostop') or {},
+        })
+    return out
+
+
 def start(cluster_name: str) -> None:
     record = state.get_cluster(cluster_name)
     if record is None:

@@ -167,3 +167,7 @@ class RequestCancelled(SkyTpuError):
 
 class InvalidServiceSpecError(SkyTpuError):
     """Serve service spec invalid."""
+
+
+class ServeError(SkyTpuError):
+    """Serve operation failed (duplicate service, unknown service, ...)."""

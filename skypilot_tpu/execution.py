@@ -74,6 +74,7 @@ def _execute(task: task_lib.Task,
             backend.sync_workdir(handle, task.workdir)
         if Stage.SYNC_FILE_MOUNTS in stages:
             backend.sync_file_mounts(handle, task.file_mounts)
+            backend.mount_volumes(handle, task.volumes)
         if Stage.SETUP in stages:
             backend.setup(handle, task)
 

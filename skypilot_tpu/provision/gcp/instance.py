@@ -69,7 +69,14 @@ def _node_body(cluster_name: str, config: Dict[str, Any]) -> Dict[str, Any]:
         'metadata': {
             'startup-script': config.get('startup_script', ''),
         },
-        'dataDisks': [],
+        # Named volumes attach at create time (TPU VMs take PDs only as
+        # dataDisks in the node body; mounted by the backend post-boot).
+        'dataDisks': [
+            {'sourceDisk': (f'projects/{config["project_id"]}/zones/'
+                            f'{config["zone"]}/disks/{disk_name}'),
+             'mode': 'READ_WRITE'}
+            for disk_name in config.get('volumes', [])
+        ],
         'networkConfig': {
             'enableExternalIps': True,
         },

@@ -1,0 +1,72 @@
+"""GCP persistent-disk volume CRUD (reference: sky/provision/gcp/volume_utils.py).
+
+Uses the Compute Engine disks REST API with the same auth/session plumbing
+as the TPU API client.  TPU-VM attachment note: v5e/v5p/v6e TPU VMs attach
+PDs as `dataDisks` in the node create body; volumes created here are
+referenced by name in `resources: volumes:` and wired into the node body
+by the GCP provisioner.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.gcp import tpu_api
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.volumes.core import Volume
+
+logger = sky_logging.init_logger(__name__)
+
+_COMPUTE_BASE = 'https://compute.googleapis.com/compute/v1'
+
+
+class DiskApiClient(tpu_api.TpuApiClient):
+    """Compute disks client sharing the TPU client's auth/session."""
+
+    def _disk_url(self, zone: str, name: str = '') -> str:
+        base = (f'{_COMPUTE_BASE}/projects/{self.project}/zones/{zone}'
+                f'/disks')
+        return f'{base}/{name}' if name else base
+
+    def _compute_request(self, method: str, url: str,
+                         json_body=None) -> Dict[str, Any]:
+        resp = self._get_session().request(method, url, json=json_body,
+                                           timeout=60)
+        if resp.status_code >= 400:
+            self._raise_typed(resp)
+        return resp.json() if resp.content else {}
+
+    def create_disk(self, zone: str, name: str, disk_type: str,
+                    size_gb: int) -> Dict[str, Any]:
+        body = {
+            'name': name,
+            'sizeGb': str(size_gb),
+            'type': (f'projects/{self.project}/zones/{zone}/diskTypes/'
+                     f'{disk_type}'),
+        }
+        return self._compute_request('POST', self._disk_url(zone),
+                                     json_body=body)
+
+    def get_disk(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._compute_request('GET', self._disk_url(zone, name))
+
+    def delete_disk(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._compute_request('DELETE', self._disk_url(zone, name))
+
+
+def apply_volume(volume: 'Volume') -> None:
+    from skypilot_tpu import config as config_lib
+    project = config_lib.get_nested(('gcp', 'project_id'), None)
+    zone = volume.zone or 'us-central1-a'
+    client = DiskApiClient(project)
+    client.create_disk(zone, volume.name, volume.type, volume.size_gb)
+    logger.info(f'GCP disk {volume.name} created in {zone}.')
+
+
+def delete_volume(volume: 'Volume') -> None:
+    from skypilot_tpu import config as config_lib
+    project = config_lib.get_nested(('gcp', 'project_id'), None)
+    zone = volume.zone or 'us-central1-a'
+    DiskApiClient(project).delete_disk(zone, volume.name)

@@ -91,26 +91,38 @@ def query_instances(cluster_name: str,
             for i in range(meta['num_hosts'])}
 
 
+def _kill_cluster_processes(cluster_name: str, sig: int) -> None:
+    """Kill every process group recorded under the cluster dir (agent,
+    drivers, ranks — each runs in its own session, so the "VM death"
+    analog must walk all pid files)."""
+    import signal as signal_lib  # noqa: F401  (sig values passed in)
+    cdir = _cluster_dir(cluster_name)
+    pid_files = []
+    for root, _dirs, files in os.walk(cdir):
+        pid_files.extend(os.path.join(root, f) for f in files
+                         if f.endswith('.pid'))
+    for path in pid_files:
+        try:
+            with open(path, encoding='utf-8') as f:
+                pid = int(f.read().strip())
+            os.killpg(os.getpgid(pid), sig)
+        except (ValueError, ProcessLookupError, PermissionError, OSError):
+            pass
+
+
 def simulate_preemption(cluster_name: str) -> None:
-    """Test/chaos hook: mark the cluster preempted and kill its agent, the
-    local-cloud analog of a TPU slice entering PREEMPTED (used by managed-
-    jobs recovery tests; the reference has no such hermetic layer)."""
+    """Test/chaos hook: mark the cluster preempted and kill every process
+    on it (agent, drivers, ranks), the local-cloud analog of a TPU slice
+    entering PREEMPTED (used by managed-jobs/serve recovery tests; the
+    reference has no such hermetic layer)."""
     path = _meta_path(cluster_name)
     with open(path, encoding='utf-8') as f:
         meta = json.load(f)
     meta['state'] = 'preempted'
     with open(path, 'w', encoding='utf-8') as f:
         json.dump(meta, f)
-    pid_path = os.path.join(_cluster_dir(cluster_name), 'host-0', '.agent',
-                            'agent.pid')
-    if os.path.exists(pid_path):
-        try:
-            with open(pid_path, encoding='utf-8') as f:
-                pid = int(f.read().strip())
-            import signal
-            os.killpg(os.getpgid(pid), signal.SIGKILL)
-        except (ValueError, ProcessLookupError, PermissionError, OSError):
-            pass
+    import signal
+    _kill_cluster_processes(cluster_name, signal.SIGKILL)
 
 
 def stop_instances(cluster_name: str,
@@ -123,15 +135,9 @@ def terminate_instances(cluster_name: str,
                         provider_config: Optional[Dict[str, Any]] = None,
                         worker_only: bool = False) -> None:
     cdir = _cluster_dir(cluster_name)
-    # Kill the head agent (and its driver children) before removing state.
-    pid_path = os.path.join(cdir, 'host-0', '.agent', 'agent.pid')
-    if os.path.exists(pid_path):
-        try:
-            with open(pid_path, encoding='utf-8') as f:
-                pid = int(f.read().strip())
-            import signal
-            os.killpg(os.getpgid(pid), signal.SIGTERM)
-        except (ValueError, ProcessLookupError, PermissionError, OSError):
-            pass
+    # Kill everything on the "VM" (agent, drivers, ranks — all own-session
+    # process groups recorded as pid files) before removing state.
+    import signal
+    _kill_cluster_processes(cluster_name, signal.SIGTERM)
     if os.path.exists(cdir):
         shutil.rmtree(cdir, ignore_errors=True)

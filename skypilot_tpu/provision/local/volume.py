@@ -1,0 +1,24 @@
+"""Local volume provisioner: a directory acts as the block device
+(hermetic analog, same role as the local instance provisioner)."""
+from __future__ import annotations
+
+import os
+import shutil
+import typing
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.volumes.core import Volume
+
+_BASE = '~/.skypilot_tpu/local_volumes'
+
+
+def volume_dir(name: str) -> str:
+    return os.path.join(os.path.expanduser(_BASE), name)
+
+
+def apply_volume(volume: 'Volume') -> None:
+    os.makedirs(volume_dir(volume.name), exist_ok=True)
+
+
+def delete_volume(volume: 'Volume') -> None:
+    shutil.rmtree(volume_dir(volume.name), ignore_errors=True)

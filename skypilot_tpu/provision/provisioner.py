@@ -65,37 +65,58 @@ def _make_runners(cluster_info: provision_common.ClusterInfo
 
 @timeline.event
 def _setup_runtime(cluster_info: provision_common.ClusterInfo,
-                   agent_port: int) -> None:
+                   agent_port: int, cluster_name: str) -> int:
     """Start the head agent (mirrors post_provision_runtime_setup :708:
-    install runtime → start skylet → health check).
+    install runtime → start skylet → health check); returns the port the
+    agent actually serves on.
 
-    local: agent runs as a child process with cwd = head dir.
+    local: agent runs as a child process with cwd = head dir.  All local
+    agents share localhost, so a port-bind race is possible — the health
+    check verifies agent identity and retries on the next port.
     ssh/gcp: agent started via SSH nohup on the head host.
     """
     from skypilot_tpu.agent.client import AgentClient
     head = cluster_info.head
+    head_ip = head.external_ip or head.internal_ip
     if cluster_info.cloud == 'local':
         base_dir = f'{head.workdir}/.agent'
         os.makedirs(base_dir, exist_ok=True)
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.agent.server',
-             '--base-dir', base_dir, '--port', str(agent_port)],
-            stdout=open(f'{head.workdir}/agent.log', 'ab'),
-            stderr=subprocess.STDOUT,
-            start_new_session=True)
-        with open(f'{base_dir}/agent.pid', 'w', encoding='utf-8') as f:
-            f.write(str(proc.pid))
-    else:
-        runner = _make_runners(cluster_info)[0]
-        cmd = (f'nohup python -m skypilot_tpu.agent.server '
-               f'--base-dir ~/.skypilot_tpu_agent --port {agent_port} '
-               f'> ~/.skypilot_tpu_agent.log 2>&1 &')
-        rc = runner.run(cmd, timeout=60)
-        if rc != 0:
-            raise exceptions.ProvisionerError(
-                f'Failed to start agent on head ({rc}).')
-    AgentClient(f'http://{head.external_ip or head.internal_ip}:'
-                f'{agent_port}').wait_ready(timeout=120)
+        last_exc: Optional[Exception] = None
+        for attempt in range(5):
+            port = common_utils.find_free_port(agent_port + attempt)
+            proc = subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.agent.server',
+                 '--base-dir', base_dir, '--port', str(port),
+                 '--cluster-name', cluster_name],
+                stdout=open(f'{head.workdir}/agent.log', 'ab'),
+                stderr=subprocess.STDOUT,
+                start_new_session=True)
+            with open(f'{base_dir}/agent.pid', 'w', encoding='utf-8') as f:
+                f.write(str(proc.pid))
+            try:
+                AgentClient(f'http://{head_ip}:{port}').wait_ready(
+                    timeout=60, expected_cluster=cluster_name)
+                return port
+            except exceptions.ClusterNotUpError as e:
+                # Lost the bind race to another cluster's agent: our
+                # (never-bound) agent process exits on its own; try the
+                # next port.
+                last_exc = e
+                continue
+        raise exceptions.ProvisionerError(
+            f'Could not start an identity-verified agent: {last_exc}')
+    cmd = (f'nohup python -m skypilot_tpu.agent.server '
+           f'--base-dir ~/.skypilot_tpu_agent --port {agent_port} '
+           f'--cluster-name {cluster_name} '
+           f'> ~/.skypilot_tpu_agent.log 2>&1 &')
+    runner = _make_runners(cluster_info)[0]
+    rc = runner.run(cmd, timeout=60)
+    if rc != 0:
+        raise exceptions.ProvisionerError(
+            f'Failed to start agent on head ({rc}).')
+    AgentClient(f'http://{head_ip}:{agent_port}').wait_ready(
+        timeout=120, expected_cluster=cluster_name)
+    return agent_port
 
 
 def _provision_one_zone(cloud_obj: cloud_lib.Cloud,
@@ -112,6 +133,7 @@ def provision_with_failover(
         to_provision: resources_lib.Resources,
         cluster_name: str,
         num_nodes: int = 1,
+        volumes: Optional[List[str]] = None,
 ) -> ProvisionOutcome:
     """Try every (region, zone) of `to_provision`'s cloud in price order.
 
@@ -131,6 +153,8 @@ def provision_with_failover(
             config = cloud_obj.make_deploy_resources_variables(
                 to_provision, cluster_name, region, zone)
             config['num_nodes'] = num_nodes
+            if volumes:
+                config['volumes'] = list(volumes)
             try:
                 logger.info(f'Provisioning {cluster_name!r} '
                             f'({to_provision}) in {region}/{zone}...')
@@ -139,7 +163,8 @@ def provision_with_failover(
                 agent_port = (AGENT_PORT_START if cloud_obj.name != 'local'
                               else common_utils.find_free_port(
                                   AGENT_PORT_START))
-                _setup_runtime(cluster_info, agent_port)
+                agent_port = _setup_runtime(cluster_info, agent_port,
+                                            cluster_name)
                 logger.info(
                     f'Provisioned {cluster_name!r} in {region}/{zone} '
                     f'({cluster_info.num_hosts} host(s), '

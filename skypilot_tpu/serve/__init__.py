@@ -1,0 +1,14 @@
+"""Serve: multi-replica serving with autoscaling (reference: sky/serve/).
+
+Components (reference parity in each module's docstring):
+- service_spec: declarative `service:` section of a task YAML.
+- replica_managers: launch/track/probe/recover replica clusters.
+- autoscalers: request-rate autoscaling with hysteresis + spot fallback.
+- load_balancer + load_balancing_policies: aiohttp reverse proxy.
+- spot_placer: SpotHedge-style preemption-aware zone placement.
+- controller: per-service control loop gluing the above together.
+"""
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+
+__all__ = ['ReplicaStatus', 'ServiceSpec', 'ServiceStatus']

@@ -1,0 +1,336 @@
+"""Autoscalers (reference: sky/serve/autoscalers.py).
+
+`Autoscaler` (:116) -> `_AutoscalerWithHysteresis` (:369) ->
+`RequestRateAutoscaler` (:455) -> `FallbackRequestRateAutoscaler` (:909,
+spot replicas + on-demand base/dynamic fallback).
+
+The controller calls `collect_request_information` with load-balancer QPS
+reports and `generate_scaling_decisions` every `get_decision_interval()`
+seconds; decisions are SCALE_UP/SCALE_DOWN lists applied by the replica
+manager.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+import time
+import typing
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+
+logger = sky_logging.init_logger(__name__)
+
+# Window over which reported request timestamps count toward QPS
+# (reference: constants.AUTOSCALER_QPS_WINDOW_SIZE_SECONDS).
+QPS_WINDOW_SIZE_SECONDS = 60
+# Decision cadence: fast when scaling up (catch bursts), slow when idle
+# (reference: get_decision_interval, sky/serve/autoscalers.py:223).
+DECISION_INTERVAL_SECONDS = 20
+BURST_DECISION_INTERVAL_SECONDS = 5
+
+
+class AutoscalerDecisionOperator(enum.Enum):
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    """One scaling action.
+
+    SCALE_UP target: launch override dict (e.g. {'use_spot': True,
+    'location': Location}); SCALE_DOWN target: replica id to kill.
+    """
+    operator: AutoscalerDecisionOperator
+    target: Union[Optional[Dict[str, Any]], int]
+
+
+def _scale_up(n: int, override: Optional[Dict[str, Any]] = None
+              ) -> List[AutoscalerDecision]:
+    return [AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                               dict(override or {})) for _ in range(n)]
+
+
+def _scale_down_ids(ids: List[int]) -> List[AutoscalerDecision]:
+    return [AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN, rid)
+            for rid in ids]
+
+
+def select_replicas_to_scale_down(
+        replicas: List[Dict[str, Any]], n: int) -> List[int]:
+    """Least-useful-first victim selection (reference:
+    _select_nonterminal_replicas_to_scale_down, autoscalers.py:73)."""
+    order = {status: i for i, status in
+             enumerate(ReplicaStatus.scale_down_decision_order())}
+    nonterminal = [r for r in replicas if not r['status'].is_terminal()]
+    nonterminal.sort(
+        key=lambda r: (order.get(r['status'], len(order)),
+                       -(r['launched_at'] or 0)))  # newest first within tier
+    return [r['replica_id'] for r in nonterminal[:n]]
+
+
+class Autoscaler:
+    """Abstract autoscaler over a service's replica set."""
+
+    def __init__(self, service_name: str, spec: 'ServiceSpec') -> None:
+        self.service_name = service_name
+        self.min_replicas = spec.min_replicas
+        self.max_replicas = spec.max_replicas or spec.min_replicas
+        self.num_overprovision = spec.num_overprovision
+        self.target_num_replicas = spec.min_replicas
+        self.latest_version = 1
+
+    @classmethod
+    def from_spec(cls, service_name: str,
+                  spec: 'ServiceSpec') -> 'Autoscaler':
+        if spec.base_ondemand_fallback_replicas is not None or \
+                spec.dynamic_ondemand_fallback or spec.spot_placer:
+            return FallbackRequestRateAutoscaler(service_name, spec)
+        if spec.autoscaling_enabled:
+            return RequestRateAutoscaler(service_name, spec)
+        return FixedSizeAutoscaler(service_name, spec)
+
+    def get_final_target_num_replicas(self) -> int:
+        return self.target_num_replicas + (self.num_overprovision or 0)
+
+    def _clip(self, target: int) -> int:
+        return max(self.min_replicas, min(self.max_replicas, target))
+
+    def update_version(self, version: int, spec: 'ServiceSpec') -> None:
+        self.latest_version = version
+        self.min_replicas = spec.min_replicas
+        self.max_replicas = spec.max_replicas or spec.min_replicas
+        self.num_overprovision = spec.num_overprovision
+        self.target_num_replicas = self._clip(self.target_num_replicas)
+
+    def collect_request_information(
+            self, request_data: Dict[str, Any]) -> None:
+        pass
+
+    def get_decision_interval(self) -> int:
+        """Scale-up pressure -> shorter interval (reference :223)."""
+        if self.target_num_replicas == 0:
+            return BURST_DECISION_INTERVAL_SECONDS
+        return DECISION_INTERVAL_SECONDS
+
+    def generate_scaling_decisions(
+            self, replicas: List[Dict[str, Any]]
+    ) -> List[AutoscalerDecision]:
+        raise NotImplementedError
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            'target_num_replicas': self.target_num_replicas,
+            'min_replicas': self.min_replicas,
+            'max_replicas': self.max_replicas,
+        }
+
+    # Dynamic state survives controller restarts (reference :356-366).
+    def dump_dynamic_states(self) -> Dict[str, Any]:
+        return {'target_num_replicas': self.target_num_replicas}
+
+    def load_dynamic_states(self, states: Dict[str, Any]) -> None:
+        self.target_num_replicas = states.get('target_num_replicas',
+                                              self.target_num_replicas)
+
+
+class FixedSizeAutoscaler(Autoscaler):
+    """No autoscaling: hold the replica count at min_replicas."""
+
+    def generate_scaling_decisions(
+            self, replicas: List[Dict[str, Any]]
+    ) -> List[AutoscalerDecision]:
+        target = self.get_final_target_num_replicas()
+        alive = [r for r in replicas if not r['status'].is_terminal()]
+        if len(alive) < target:
+            return _scale_up(target - len(alive))
+        if len(alive) > target:
+            return _scale_down_ids(select_replicas_to_scale_down(
+                alive, len(alive) - target))
+        return []
+
+
+class _AutoscalerWithHysteresis(Autoscaler):
+    """Requires N consecutive over/under-threshold decisions before acting
+    (reference :369: *_delay_seconds / decision interval = threshold)."""
+
+    def __init__(self, service_name: str, spec: 'ServiceSpec') -> None:
+        super().__init__(service_name, spec)
+        self._setup_thresholds(spec)
+        self.upscale_counter = 0
+        self.downscale_counter = 0
+
+    def _setup_thresholds(self, spec: 'ServiceSpec') -> None:
+        self.scale_up_threshold = max(
+            1, spec.upscale_delay_seconds // DECISION_INTERVAL_SECONDS)
+        self.scale_down_threshold = max(
+            1, spec.downscale_delay_seconds // DECISION_INTERVAL_SECONDS)
+
+    def update_version(self, version: int, spec: 'ServiceSpec') -> None:
+        super().update_version(version, spec)
+        self._setup_thresholds(spec)
+        self.upscale_counter = 0
+        self.downscale_counter = 0
+
+    def _calculate_target_num_replicas(self) -> int:
+        raise NotImplementedError
+
+    def _apply_hysteresis(self) -> None:
+        raw_target = self._clip(self._calculate_target_num_replicas())
+        if raw_target > self.target_num_replicas:
+            self.downscale_counter = 0
+            self.upscale_counter += 1
+            if self.upscale_counter >= self.scale_up_threshold:
+                self.upscale_counter = 0
+                logger.info(
+                    f'{self.service_name}: scaling up '
+                    f'{self.target_num_replicas} -> {raw_target}')
+                self.target_num_replicas = raw_target
+        elif raw_target < self.target_num_replicas:
+            self.upscale_counter = 0
+            self.downscale_counter += 1
+            if self.downscale_counter >= self.scale_down_threshold:
+                self.downscale_counter = 0
+                logger.info(
+                    f'{self.service_name}: scaling down '
+                    f'{self.target_num_replicas} -> {raw_target}')
+                self.target_num_replicas = raw_target
+        else:
+            self.upscale_counter = 0
+            self.downscale_counter = 0
+
+    def dump_dynamic_states(self) -> Dict[str, Any]:
+        states = super().dump_dynamic_states()
+        states.update({'upscale_counter': self.upscale_counter,
+                       'downscale_counter': self.downscale_counter})
+        return states
+
+    def load_dynamic_states(self, states: Dict[str, Any]) -> None:
+        super().load_dynamic_states(states)
+        self.upscale_counter = states.get('upscale_counter', 0)
+        self.downscale_counter = states.get('downscale_counter', 0)
+
+
+class RequestRateAutoscaler(_AutoscalerWithHysteresis):
+    """target = ceil(QPS / target_qps_per_replica) (reference :455)."""
+
+    def __init__(self, service_name: str, spec: 'ServiceSpec') -> None:
+        super().__init__(service_name, spec)
+        assert spec.target_qps_per_replica is not None
+        self.target_qps_per_replica = spec.target_qps_per_replica
+        self.qps_window_size = QPS_WINDOW_SIZE_SECONDS
+        self.request_timestamps: List[float] = []
+
+    def update_version(self, version: int, spec: 'ServiceSpec') -> None:
+        super().update_version(version, spec)
+        if spec.target_qps_per_replica is not None:
+            self.target_qps_per_replica = spec.target_qps_per_replica
+
+    def collect_request_information(
+            self, request_data: Dict[str, Any]) -> None:
+        """Consume a LB report: {'timestamps': [unix seconds, ...]}."""
+        self.request_timestamps.extend(request_data.get('timestamps', []))
+        cutoff = time.time() - self.qps_window_size
+        index = 0
+        for index, ts in enumerate(self.request_timestamps):
+            if ts >= cutoff:
+                break
+        else:
+            index = len(self.request_timestamps)
+        self.request_timestamps = self.request_timestamps[index:]
+
+    def current_qps(self) -> float:
+        cutoff = time.time() - self.qps_window_size
+        recent = [t for t in self.request_timestamps if t >= cutoff]
+        return len(recent) / self.qps_window_size
+
+    def _calculate_target_num_replicas(self) -> int:
+        return math.ceil(self.current_qps() / self.target_qps_per_replica)
+
+    def generate_scaling_decisions(
+            self, replicas: List[Dict[str, Any]]
+    ) -> List[AutoscalerDecision]:
+        self._apply_hysteresis()
+        target = self.get_final_target_num_replicas()
+        alive = [r for r in replicas if not r['status'].is_terminal()]
+        if len(alive) < target:
+            return _scale_up(target - len(alive))
+        if len(alive) > target:
+            return _scale_down_ids(select_replicas_to_scale_down(
+                alive, len(alive) - target))
+        return []
+
+    def info(self) -> Dict[str, Any]:
+        out = super().info()
+        out['qps'] = round(self.current_qps(), 3)
+        return out
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot replicas with on-demand fallback (reference :909).
+
+    Invariants:
+    - `base_ondemand_fallback_replicas` on-demand replicas always run.
+    - remaining target is filled with spot.
+    - with `dynamic_ondemand_fallback`, every spot replica that is not yet
+      READY is temporarily backed by an extra on-demand replica; the
+      on-demand cover is scaled down once spot becomes READY.
+    """
+
+    def __init__(self, service_name: str, spec: 'ServiceSpec') -> None:
+        self._fixed_size = spec.target_qps_per_replica is None
+        if self._fixed_size:
+            # Fixed-size spot service: hold at min_replicas (placeholder
+            # qps satisfies the RequestRateAutoscaler invariant only).
+            spec = dataclasses.replace(
+                spec, target_qps_per_replica=1.0,
+                max_replicas=spec.max_replicas or spec.min_replicas)
+        super().__init__(service_name, spec)
+        self.base_ondemand_fallback_replicas = \
+            spec.base_ondemand_fallback_replicas or 0
+        self.dynamic_ondemand_fallback = bool(
+            spec.dynamic_ondemand_fallback)
+
+    def _calculate_target_num_replicas(self) -> int:
+        if self._fixed_size:
+            return self.min_replicas
+        return super()._calculate_target_num_replicas()
+
+    def generate_scaling_decisions(
+            self, replicas: List[Dict[str, Any]]
+    ) -> List[AutoscalerDecision]:
+        self._apply_hysteresis()
+        target = self.get_final_target_num_replicas()
+        alive = [r for r in replicas if not r['status'].is_terminal()]
+        spot = [r for r in alive if r['is_spot']]
+        ondemand = [r for r in alive if not r['is_spot']]
+        num_ready_spot = sum(
+            1 for r in spot if r['status'] == ReplicaStatus.READY)
+
+        decisions: List[AutoscalerDecision] = []
+        # 1. Spot fills target minus the permanent on-demand base.
+        num_spot_target = target - self.base_ondemand_fallback_replicas
+        if len(spot) < num_spot_target:
+            decisions.extend(_scale_up(num_spot_target - len(spot),
+                                       {'use_spot': True}))
+        elif len(spot) > num_spot_target:
+            decisions.extend(_scale_down_ids(select_replicas_to_scale_down(
+                spot, len(spot) - num_spot_target)))
+        # 2. On-demand = base + dynamic cover for not-ready spot.
+        num_ondemand_target = self.base_ondemand_fallback_replicas
+        if self.dynamic_ondemand_fallback:
+            num_ondemand_target += max(0, num_spot_target - num_ready_spot)
+            num_ondemand_target = min(num_ondemand_target, target)
+        if len(ondemand) < num_ondemand_target:
+            decisions.extend(_scale_up(
+                num_ondemand_target - len(ondemand), {'use_spot': False}))
+        elif len(ondemand) > num_ondemand_target:
+            decisions.extend(_scale_down_ids(select_replicas_to_scale_down(
+                ondemand, len(ondemand) - num_ondemand_target)))
+        return decisions

@@ -1,0 +1,83 @@
+"""`skytpu serve ...` command group (reference: sky/client/cli serve_*)."""
+from __future__ import annotations
+
+import time
+
+
+def _cmd_up(args) -> int:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core
+    task = task_lib.Task.from_yaml(args.yaml)
+    endpoint = core.up(task, service_name=args.service_name)
+    print(f'Service endpoint: {endpoint}')
+    return 0
+
+
+def _cmd_update(args) -> int:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core
+    task = task_lib.Task.from_yaml(args.yaml)
+    version = core.update(task, args.service_name)
+    print(f'Service {args.service_name!r} updating to version {version}.')
+    return 0
+
+
+def _cmd_down(args) -> int:
+    from skypilot_tpu.serve import core
+    core.down(args.service_name, purge=args.purge)
+    print(f'Tearing down service {args.service_name!r}.')
+    return 0
+
+
+def _cmd_status(args) -> int:
+    from skypilot_tpu.serve import core
+    records = core.status(args.service_names or None)
+    if not records:
+        print('No services.')
+        return 0
+    for r in records:
+        print(f"{r['name']:<20} {r['status'].value:<15} "
+              f"v{r['version']}  {r['endpoint'] or '-'}  "
+              f"{time.strftime('%m-%d %H:%M', time.localtime(r['created_at']))}")
+        for rep in r['replicas']:
+            print(f"  replica {rep['replica_id']:>3}  "
+                  f"{rep['status'].value:<20} "
+                  f"{'spot' if rep['is_spot'] else 'on-demand':<10} "
+                  f"{rep['url'] or '-'}")
+    return 0
+
+
+def _cmd_logs(args) -> int:
+    from skypilot_tpu.serve import core
+    return core.tail_logs(args.service_name, args.replica_id,
+                          follow=not args.no_follow)
+
+
+def register(sub) -> None:
+    p = sub.add_parser('serve', help='Serving with autoscaling replicas')
+    ssub = p.add_subparsers(dest='serve_command')
+
+    pu = ssub.add_parser('up', help='Start a service')
+    pu.add_argument('yaml')
+    pu.add_argument('-n', '--service-name')
+    pu.set_defaults(fn=_cmd_up)
+
+    pup = ssub.add_parser('update', help='Rolling-update a service')
+    pup.add_argument('service_name')
+    pup.add_argument('yaml')
+    pup.set_defaults(fn=_cmd_update)
+
+    pd = ssub.add_parser('down', help='Tear down a service')
+    pd.add_argument('service_name')
+    pd.add_argument('-p', '--purge', action='store_true')
+    pd.set_defaults(fn=_cmd_down)
+
+    ps = ssub.add_parser('status', help='Show services')
+    ps.add_argument('service_names', nargs='*')
+    ps.set_defaults(fn=_cmd_status)
+
+    pl = ssub.add_parser('logs', help='Tail replica logs')
+    pl.add_argument('service_name')
+    pl.add_argument('replica_id', type=int)
+    pl.add_argument('--no-follow', action='store_true')
+    pl.set_defaults(fn=_cmd_logs)

@@ -1,0 +1,206 @@
+"""Per-service controller loop (reference: sky/serve/controller.py).
+
+Glues replica manager + autoscaler: probes replicas on a short cadence,
+runs the autoscaler every `get_decision_interval()` seconds, applies
+SCALE_UP/SCALE_DOWN decisions, and keeps the service status in serve_state.
+The load balancer syncs with the controller in-process (same daemon) via
+`lb_sync`, mirroring the reference's /controller/load_balancer_sync route.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers as autoscalers_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+logger = sky_logging.init_logger(__name__)
+
+PROBE_INTERVAL_SECONDS = 10.0
+
+
+class ServeController:
+    """Drives one service: replica set reconciliation + autoscaling."""
+
+    def __init__(self, service_name: str,
+                 probe_interval: float = PROBE_INTERVAL_SECONDS) -> None:
+        record = serve_state.get_service(service_name)
+        assert record is not None, f'Service {service_name} not found'
+        self.service_name = service_name
+        self.spec = ServiceSpec.from_yaml_config(record['spec'])
+        self.task = task_lib.Task.from_yaml_config(record['task'])
+        self.version = record['version']
+        self.manager = ReplicaManager(service_name, self.spec, self.task,
+                                      self.version)
+        self.autoscaler = autoscalers_lib.Autoscaler.from_spec(
+            service_name, self.spec)
+        self.probe_interval = probe_interval
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._last_decision_time = 0.0
+
+    # --- load balancer interface (reference: /controller/load_balancer_sync)
+
+    def lb_sync(self, request_timestamps: List[float]) -> List[str]:
+        """LB reports request timestamps; returns ready replica URLs."""
+        with self._lock:
+            self.autoscaler.collect_request_information(
+                {'timestamps': request_timestamps})
+        return self.manager.ready_urls()
+
+    # --- control loop ---
+
+    def step(self) -> None:
+        """One probe pass + (if due) one autoscaling pass."""
+        replicas = self.manager.probe_all()
+        self._refresh_service_status(replicas)
+        now = time.time()
+        if now - self._last_decision_time >= \
+                self.autoscaler.get_decision_interval():
+            self._last_decision_time = now
+            # Rolling update: the autoscaler reconciles the CURRENT-version
+            # replica set (so replacements for outdated replicas launch);
+            # outdated replicas keep serving and are drained as the new
+            # version becomes READY (reference: outdated-replica pass in
+            # generate_scaling_decisions, sky/serve/autoscalers.py:299).
+            current = [r for r in replicas
+                       if r['version'] >= self.version]
+            with self._lock:
+                decisions = self.autoscaler.generate_scaling_decisions(
+                    current)
+            for decision in decisions:
+                op = decision.operator
+                if op == autoscalers_lib.AutoscalerDecisionOperator.SCALE_UP:
+                    self.manager.scale_up(decision.target)
+                else:
+                    self.manager.scale_down(decision.target)
+            self._drain_outdated()
+
+    def _refresh_service_status(self, replicas: List[Dict[str, Any]]
+                                ) -> None:
+        alive = [r for r in replicas if not r['status'].is_terminal()]
+        ready = [r for r in replicas
+                 if r['status'] == ReplicaStatus.READY]
+        failed = [r for r in replicas if r['status'].is_failed()]
+        record = serve_state.get_service(self.service_name)
+        if record is None or record['status'] == ServiceStatus.SHUTTING_DOWN:
+            return
+        if ready:
+            status = ServiceStatus.READY
+        elif failed and not alive:
+            status = ServiceStatus.FAILED
+        elif alive:
+            status = ServiceStatus.REPLICA_INIT
+        else:
+            status = ServiceStatus.NO_REPLICA
+        if status != record['status']:
+            serve_state.update_service(self.service_name, status=status)
+
+    def run_forever(self) -> None:
+        logger.info(f'Serve controller for {self.service_name!r} started.')
+        serve_state.update_service(self.service_name,
+                                   status=ServiceStatus.NO_REPLICA)
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception(f'Controller step failed: {e}')
+            self._stop.wait(self.probe_interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def update_version(self, version: int, spec: ServiceSpec,
+                       task: task_lib.Task) -> None:
+        """Rolling update: new launches use the new spec/task; outdated
+        replicas are drained by the autoscaler as capacity allows
+        (reference: generate_scaling_decisions' outdated-replica pass)."""
+        with self._lock:
+            self.version = version
+            self.spec = spec
+            self.task = task
+            self.manager.spec = spec
+            self.manager.task = task
+            self.manager.version = version
+            self.autoscaler.update_version(version, spec)
+        serve_state.update_service(self.service_name, version=version,
+                                   spec_json=spec.to_yaml_config(),
+                                   task_json=task.to_yaml_config())
+
+    def _drain_outdated(self) -> None:
+        replicas = serve_state.get_replicas(self.service_name)
+        new_ready = [r for r in replicas if r['version'] == self.version
+                     and r['status'] == ReplicaStatus.READY]
+        if not new_ready:
+            return
+        for rec in replicas:
+            if rec['version'] < self.version and \
+                    not rec['status'].is_terminal():
+                self.manager.scale_down(rec['replica_id'])
+
+
+class ServeControllerDaemon:
+    """Runs controllers for all registered services (one thread each).
+
+    The reference runs one controller process per service on a controller
+    VM (sky/serve/service.py:327); here controllers are threads of one
+    daemon — same isolation boundary as the managed-jobs scheduler.
+    """
+
+    def __init__(self, probe_interval: float = PROBE_INTERVAL_SECONDS
+                 ) -> None:
+        self.probe_interval = probe_interval
+        self.controllers: Dict[str, ServeController] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._stop = threading.Event()
+
+    def ensure_controller(self, service_name: str
+                          ) -> Optional[ServeController]:
+        if service_name in self.controllers:
+            return self.controllers[service_name]
+        if serve_state.get_service(service_name) is None:
+            return None
+        controller = ServeController(service_name, self.probe_interval)
+        thread = threading.Thread(target=controller.run_forever,
+                                  daemon=True,
+                                  name=f'serve-ctrl-{service_name}')
+        self.controllers[service_name] = controller
+        self._threads[service_name] = thread
+        thread.start()
+        return controller
+
+    def remove_controller(self, service_name: str) -> None:
+        controller = self.controllers.pop(service_name, None)
+        if controller is not None:
+            controller.stop()
+        self._threads.pop(service_name, None)
+
+    def step(self) -> None:
+        for record in serve_state.get_services():
+            if record['status'] == ServiceStatus.SHUTTING_DOWN:
+                continue
+            controller = self.ensure_controller(record['name'])
+            if controller is not None and \
+                    record['version'] > controller.version:
+                # `serve update` bumped the DB version: roll the running
+                # controller onto the new spec/task.
+                controller.update_version(
+                    record['version'],
+                    ServiceSpec.from_yaml_config(record['spec']),
+                    task_lib.Task.from_yaml_config(record['task']))
+
+    def run_forever(self, interval: float = 2.0) -> None:
+        while not self._stop.is_set():
+            self.step()
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for controller in self.controllers.values():
+            controller.stop()

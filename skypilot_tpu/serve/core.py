@@ -1,0 +1,134 @@
+"""Serve user API: up/down/status/update (reference: sky/serve/ client+server).
+
+The serve controller daemon (controllers + load balancers for every
+service) is spawned on first use — a local process standing in for the
+reference's sky-serve-controller VM (same pattern as the jobs controller;
+see skypilot_tpu/serve/controller.py docstring).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+logger = sky_logging.init_logger(__name__)
+
+_DAEMON_PID = '~/.skypilot_tpu/serve_controller.pid'
+LB_PORT_START = 8800
+
+
+def _daemon_running() -> bool:
+    path = os.path.expanduser(_DAEMON_PID)
+    if not os.path.exists(path):
+        return False
+    try:
+        with open(path, encoding='utf-8') as f:
+            pid = int(f.read().strip())
+        os.kill(pid, 0)
+        return True
+    except (ValueError, ProcessLookupError, PermissionError):
+        return False
+
+
+def ensure_controller() -> None:
+    if _daemon_running():
+        return
+    log_path = os.path.expanduser('~/.skypilot_tpu/serve_controller.log')
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.daemon'],
+        stdout=open(log_path, 'ab'), stderr=subprocess.STDOUT,
+        start_new_session=True)
+    with open(os.path.expanduser(_DAEMON_PID), 'w', encoding='utf-8') as f:
+        f.write(str(proc.pid))
+    time.sleep(0.5)
+
+
+def _allocate_lb_port() -> int:
+    used = {r['endpoint'] for r in serve_state.get_services()}
+    port = LB_PORT_START
+    while f'http://127.0.0.1:{port}' in used:
+        port += 1
+    return port
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
+    """Register + start a service; returns its endpoint URL."""
+    if task.service is None:
+        raise exceptions.InvalidServiceSpecError(
+            'Task has no `service:` section.')
+    service_name = service_name or task.name or 'service'
+    spec = ServiceSpec.from_yaml_config(task.service)
+    port = _allocate_lb_port()
+    endpoint = f'http://127.0.0.1:{port}'
+    if not serve_state.add_service(service_name, spec.to_yaml_config(),
+                                   task.to_yaml_config()):
+        raise exceptions.ServeError(
+            f'Service {service_name!r} already exists. Use `serve update` '
+            'or pick another name.')
+    serve_state.update_service(service_name, endpoint=endpoint)
+    ensure_controller()
+    logger.info(f'Service {service_name!r} registered; endpoint '
+                f'{endpoint}')
+    return endpoint
+
+
+def update(task: task_lib.Task, service_name: str) -> int:
+    """Rolling update to a new version; returns the new version."""
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.ServeError(f'Service {service_name!r} not found.')
+    if task.service is None:
+        raise exceptions.InvalidServiceSpecError(
+            'Task has no `service:` section.')
+    spec = ServiceSpec.from_yaml_config(task.service)
+    new_version = record['version'] + 1
+    serve_state.update_service(service_name, version=new_version,
+                               spec_json=spec.to_yaml_config(),
+                               task_json=task.to_yaml_config())
+    return new_version
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    record = serve_state.get_service(service_name)
+    if record is None:
+        if purge:
+            return
+        raise exceptions.ServeError(f'Service {service_name!r} not found.')
+    serve_state.update_service(service_name,
+                               status=ServiceStatus.SHUTTING_DOWN)
+    # The daemon notices SHUTTING_DOWN, drains replicas, then removes the
+    # row; fall back to inline teardown when no daemon is running.
+    if not _daemon_running():
+        from skypilot_tpu.serve.replica_managers import ReplicaManager
+        spec = ServiceSpec.from_yaml_config(record['spec'])
+        task = task_lib.Task.from_yaml_config(record['task'])
+        ReplicaManager(service_name, spec, task).terminate_all()
+        serve_state.remove_service(service_name)
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    records = serve_state.get_services()
+    if service_names:
+        records = [r for r in records if r['name'] in service_names]
+    for record in records:
+        record['replicas'] = serve_state.get_replicas(record['name'])
+    return records
+
+
+def tail_logs(service_name: str, replica_id: int, follow: bool = True
+              ) -> int:
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu.serve.replica_managers import replica_cluster_name
+    return core_lib.tail_logs(
+        replica_cluster_name(service_name, replica_id), None, follow=follow)

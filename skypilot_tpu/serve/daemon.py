@@ -1,0 +1,92 @@
+"""Serve controller daemon: controllers + load balancers for all services.
+
+Reference parity: sky/serve/service.py — spawns a controller and a load
+balancer per service (:327,:354); here both live in one daemon process
+(controllers are threads, LBs are asyncio loops in threads).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.controller import ServeControllerDaemon
+from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+from skypilot_tpu.serve.serve_state import ServiceStatus
+
+logger = sky_logging.init_logger(__name__)
+
+
+class ServeDaemon:
+
+    def __init__(self, probe_interval: float = 10.0,
+                 lb_sync_interval: float = 20.0) -> None:
+        self.controllers = ServeControllerDaemon(probe_interval)
+        self.lb_sync_interval = lb_sync_interval
+        self.load_balancers: Dict[str, SkyServeLoadBalancer] = {}
+
+    def step(self) -> None:
+        for record in serve_state.get_services():
+            name = record['name']
+            if record['status'] == ServiceStatus.SHUTTING_DOWN:
+                self._shutdown_service(name)
+                continue
+            controller = self.controllers.ensure_controller(name)
+            if controller is None or name in self.load_balancers:
+                continue
+            endpoint = record['endpoint']
+            if endpoint is None:
+                continue
+            port = int(endpoint.rsplit(':', 1)[1])
+            lb = SkyServeLoadBalancer(
+                controller, port,
+                policy_name=controller.spec.load_balancing_policy,
+                sync_interval=self.lb_sync_interval)
+            try:
+                lb.start()
+            except (RuntimeError, OSError) as e:
+                logger.warning(f'LB for {name} failed to start: {e}')
+                continue
+            self.load_balancers[name] = lb
+
+    def _shutdown_service(self, name: str) -> None:
+        lb = self.load_balancers.pop(name, None)
+        if lb is not None:
+            lb.stop()
+        controller = self.controllers.controllers.get(name)
+        self.controllers.remove_controller(name)
+        if controller is not None:
+            manager = controller.manager
+        else:
+            # Daemon restarted after `serve down`: rebuild a manager from
+            # the DB record so replica clusters are still torn down.
+            from skypilot_tpu import task as task_lib
+            from skypilot_tpu.serve.replica_managers import ReplicaManager
+            from skypilot_tpu.serve.service_spec import ServiceSpec
+            record = serve_state.get_service(name)
+            if record is None:
+                return
+            manager = ReplicaManager(
+                name, ServiceSpec.from_yaml_config(record['spec']),
+                task_lib.Task.from_yaml_config(record['task']))
+        manager.terminate_all()
+        serve_state.remove_service(name)
+        logger.info(f'Service {name!r} torn down.')
+
+    def run_forever(self, interval: float = 2.0) -> None:
+        logger.info('Serve daemon started.')
+        while True:
+            try:
+                self.step()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception(f'Serve daemon step failed: {e}')
+            time.sleep(interval)
+
+
+def main() -> None:
+    ServeDaemon().run_forever()
+
+
+if __name__ == '__main__':
+    main()

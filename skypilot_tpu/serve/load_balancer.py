@@ -1,0 +1,141 @@
+"""Load balancer: aiohttp reverse proxy (reference: sky/serve/load_balancer.py).
+
+Proxies every request to a ready replica chosen by the policy, records
+request timestamps, and syncs with the controller on an interval: report
+timestamps -> receive the fresh ready-replica set (reference's
+_sync_with_controller loop).  The controller here is in-process
+(`ServeController.lb_sync`); a remote-controller mode only needs an HTTP
+shim around the same two calls.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import typing
+from typing import List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve.controller import ServeController
+
+logger = sky_logging.init_logger(__name__)
+
+LB_CONTROLLER_SYNC_INTERVAL_SECONDS = 20.0
+
+
+class SkyServeLoadBalancer:
+    """HTTP reverse proxy with pluggable replica-selection policy."""
+
+    def __init__(self, controller: 'ServeController', port: int,
+                 policy_name: Optional[str] = None,
+                 sync_interval: float = LB_CONTROLLER_SYNC_INTERVAL_SECONDS
+                 ) -> None:
+        self.controller = controller
+        self.port = port
+        self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
+        self.sync_interval = sync_interval
+        self.request_timestamps: List[float] = []
+        self._ts_lock = threading.Lock()
+        self._runner = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+
+    # --- controller sync ---
+
+    def sync_once(self) -> None:
+        with self._ts_lock:
+            timestamps, self.request_timestamps = \
+                self.request_timestamps, []
+        ready = self.controller.lb_sync(timestamps)
+        self.policy.set_ready_replicas(ready)
+
+    # --- proxy ---
+
+    async def _handle(self, request):
+        import aiohttp
+        from aiohttp import web
+        with self._ts_lock:
+            self.request_timestamps.append(time.time())
+        url = self.policy.select_replica()
+        if url is None:
+            # Cold start / stale set: resync before failing (a replica may
+            # have become READY since the last interval sync).
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self.sync_once)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'On-demand LB sync failed: {e}')
+            url = self.policy.select_replica()
+        if url is None:
+            return web.Response(
+                status=503,
+                text='No ready replicas. Use "serve status" to check.')
+        self.policy.pre_execute_hook(url)
+        try:
+            target = url + str(request.rel_url)
+            async with aiohttp.ClientSession(auto_decompress=False) as sess:
+                async with sess.request(
+                        request.method, target,
+                        headers=request.headers.copy(),
+                        data=await request.read(),
+                        allow_redirects=False) as resp:
+                    body = await resp.read()
+                    headers = {k: v for k, v in resp.headers.items()
+                               if k.lower() not in
+                               ('transfer-encoding', 'content-length')}
+                    return web.Response(status=resp.status, body=body,
+                                        headers=headers)
+        except aiohttp.ClientError as e:
+            return web.Response(status=502,
+                                text=f'Replica {url} unreachable: {e}')
+        finally:
+            self.policy.post_execute_hook(url)
+
+    async def _sync_loop(self):
+        while True:
+            try:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self.sync_once)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'LB controller sync failed: {e}')
+            await asyncio.sleep(self.sync_interval)
+
+    async def _serve(self):
+        from aiohttp import web
+        app = web.Application()
+        app.router.add_route('*', '/{tail:.*}', self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, '0.0.0.0', self.port)
+        await site.start()
+        self._ready.set()
+        asyncio.create_task(self._sync_loop())
+
+    def start(self) -> None:
+        """Run the LB event loop in a background thread."""
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self._serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name=f'serve-lb-{self.port}')
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError('Load balancer failed to start.')
+        logger.info(f'Load balancer listening on :{self.port}')
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            async def _cleanup():
+                if self._runner is not None:
+                    await self._runner.cleanup()
+                self._loop.stop()
+            asyncio.run_coroutine_threadsafe(_cleanup(), self._loop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,108 @@
+"""Load balancing policies (reference: sky/serve/load_balancing_policies.py:28-92).
+
+Policies are registered by subclassing `LoadBalancingPolicy` with a
+`name=` class kwarg; `least_load` is the default (reference :110).
+"""
+from __future__ import annotations
+
+import collections
+import random
+import threading
+from typing import Dict, List, Optional
+
+LB_POLICIES: Dict[str, type] = {}
+DEFAULT_LB_POLICY: Optional[str] = None
+
+
+class LoadBalancingPolicy:
+    """Maps an incoming request to a ready replica URL."""
+
+    def __init__(self) -> None:
+        self.ready_replicas: List[str] = []
+
+    def __init_subclass__(cls, name: str, default: bool = False):
+        LB_POLICIES[name] = cls
+        if default:
+            global DEFAULT_LB_POLICY
+            assert DEFAULT_LB_POLICY is None, 'Only one default policy.'
+            DEFAULT_LB_POLICY = name
+
+    @classmethod
+    def make(cls, policy_name: Optional[str] = None) -> 'LoadBalancingPolicy':
+        name = policy_name or DEFAULT_LB_POLICY
+        if name not in LB_POLICIES:
+            raise ValueError(f'Unknown load balancing policy: {name}')
+        return LB_POLICIES[name]()
+
+    def set_ready_replicas(self, ready_replicas: List[str]) -> None:
+        raise NotImplementedError
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def pre_execute_hook(self, replica_url: str) -> None:
+        pass
+
+    def post_execute_hook(self, replica_url: str) -> None:
+        pass
+
+
+class RoundRobinPolicy(LoadBalancingPolicy, name='round_robin'):
+    """Cycle through replicas (reference :85); shuffled on membership change
+    so the first replica doesn't absorb every scale-up burst."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.index = 0
+        self.lock = threading.Lock()
+
+    def set_ready_replicas(self, ready_replicas: List[str]) -> None:
+        with self.lock:
+            if set(self.ready_replicas) == set(ready_replicas):
+                return
+            replicas = list(ready_replicas)
+            random.shuffle(replicas)
+            self.ready_replicas = replicas
+            self.index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self.lock:
+            if not self.ready_replicas:
+                return None
+            url = self.ready_replicas[self.index]
+            self.index = (self.index + 1) % len(self.ready_replicas)
+            return url
+
+
+class LeastLoadPolicy(LoadBalancingPolicy, name='least_load', default=True):
+    """Route to the replica with the fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.load_map: Dict[str, int] = collections.defaultdict(int)
+        self.lock = threading.Lock()
+
+    def set_ready_replicas(self, ready_replicas: List[str]) -> None:
+        with self.lock:
+            if set(self.ready_replicas) == set(ready_replicas):
+                return
+            self.ready_replicas = list(ready_replicas)
+            for url in list(self.load_map):
+                if url not in self.ready_replicas:
+                    del self.load_map[url]
+
+    def select_replica(self) -> Optional[str]:
+        with self.lock:
+            if not self.ready_replicas:
+                return None
+            return min(self.ready_replicas,
+                       key=lambda u: self.load_map.get(u, 0))
+
+    def pre_execute_hook(self, replica_url: str) -> None:
+        with self.lock:
+            self.load_map[replica_url] += 1
+
+    def post_execute_hook(self, replica_url: str) -> None:
+        with self.lock:
+            self.load_map[replica_url] = max(
+                0, self.load_map.get(replica_url, 0) - 1)

@@ -1,0 +1,288 @@
+"""Replica manager: launch/track/probe/recover replica clusters.
+
+Reference parity: sky/serve/replica_managers.py (1,472 LoC) — replicas are
+ordinary clusters launched via `execution.launch` (:107), probed for
+readiness per the service spec, and replaced on failure/preemption.  Probe
+state machine: PENDING -> PROVISIONING -> STARTING -> READY <-> NOT_READY,
+with FAILED_* / PREEMPTED terminals; preemption is detected by querying the
+provisioner when probes fail (same signal the managed-jobs controller uses).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import execution
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state as global_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import TpuBackend
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import spot_placer as spot_placer_lib
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_REPLICA_PORT = 8080
+# Consecutive probe failures after READY before giving up on a replica
+# (reference: serve.constants probe failure threshold).
+PROBE_FAILURE_THRESHOLD = 3
+PROBE_TIMEOUT_SECONDS = 15
+
+
+def replica_cluster_name(service_name: str, replica_id: int) -> str:
+    return f'{service_name}-replica-{replica_id}'
+
+
+class ReplicaManager:
+    """Owns the replica set of one service."""
+
+    def __init__(self, service_name: str, spec: ServiceSpec,
+                 task: task_lib.Task, version: int = 1) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self.version = version
+        self.spot_placer = spot_placer_lib.SpotPlacer.make(
+            spec.spot_placer, task) if self._spot_requested(task, spec) \
+            else None
+        self._launch_threads: Dict[int, threading.Thread] = {}
+        self._down_threads: Dict[int, threading.Thread] = {}
+
+    @staticmethod
+    def _spot_requested(task: task_lib.Task, spec: ServiceSpec) -> bool:
+        return (spec.spot_placer is not None or
+                spec.base_ondemand_fallback_replicas is not None or
+                spec.dynamic_ondemand_fallback is not None or
+                any(r.use_spot for r in task.resources))
+
+    # --- scaling operations (called by the controller) ---
+
+    def scale_up(self, override: Optional[Dict[str, Any]] = None) -> int:
+        """Start one replica; returns its id.  Non-blocking: provisioning
+        runs in a thread (reference launches a process per replica)."""
+        override = dict(override or {})
+        replica_id = serve_state.next_replica_id(self.service_name)
+        cluster_name = replica_cluster_name(self.service_name, replica_id)
+        location: Optional[spot_placer_lib.Location] = None
+        use_spot = override.get(
+            'use_spot', any(r.use_spot for r in self.task.resources))
+        if use_spot and self.spot_placer is not None:
+            current = [
+                spot_placer_lib.Location.from_dict(r['location'])
+                for r in serve_state.get_replicas(self.service_name)
+                if r['location'] is not None
+                and not r['status'].is_terminal()]
+            location = self.spot_placer.select_next_location(current)
+        serve_state.add_replica(
+            self.service_name, replica_id, cluster_name, self.version,
+            is_spot=use_spot,
+            location=location.to_dict() if location else None)
+        thread = threading.Thread(
+            target=self._launch_replica,
+            args=(replica_id, cluster_name, use_spot, location),
+            daemon=True, name=f'serve-launch-{cluster_name}')
+        self._launch_threads[replica_id] = thread
+        thread.start()
+        return replica_id
+
+    def scale_down(self, replica_id: int, *, purge: bool = False) -> None:
+        """Tear down one replica (async)."""
+        serve_state.update_replica(self.service_name, replica_id,
+                                   status=ReplicaStatus.SHUTTING_DOWN)
+        thread = threading.Thread(
+            target=self._terminate_replica, args=(replica_id, purge),
+            daemon=True,
+            name=f'serve-down-{self.service_name}-{replica_id}')
+        self._down_threads[replica_id] = thread
+        thread.start()
+
+    def terminate_all(self) -> None:
+        for rec in serve_state.get_replicas(self.service_name):
+            if rec['status'] != ReplicaStatus.SHUTTING_DOWN:
+                self.scale_down(rec['replica_id'], purge=True)
+        self.join()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for thread in (list(self._launch_threads.values()) +
+                       list(self._down_threads.values())):
+            thread.join(timeout)
+
+    # --- replica lifecycle internals ---
+
+    def _replica_task(self, use_spot: bool,
+                      location: Optional[spot_placer_lib.Location],
+                      replica_id: int) -> task_lib.Task:
+        cfg = self.task.to_yaml_config()
+        cfg.pop('service', None)
+        replica_task = task_lib.Task.from_yaml_config(cfg)
+        new_resources = []
+        for res in replica_task.resources:
+            override: Dict[str, Any] = {'use_spot': use_spot}
+            if location is not None:
+                override['region'] = location.region
+                override['zone'] = location.zone
+            new_resources.append(res.copy(**override))
+        replica_task.set_resources(new_resources)
+        # Replica identity + port contract for the replica's server process.
+        replica_task.update_envs({
+            'SKYPILOT_SERVE_REPLICA_ID': str(replica_id),
+            'SKYPILOT_SERVE_PORT': str(self._replica_port(replica_id)),
+        })
+        return replica_task
+
+    def _replica_port(self, replica_id: int) -> int:
+        base = self.spec.ports or DEFAULT_REPLICA_PORT
+        cloud = next(iter(self.task.resources)).cloud
+        if cloud == 'local':
+            # Hermetic local cloud: replicas share one machine, so each
+            # gets a distinct port (the fake-multihost analog; real clouds
+            # give each replica its own VM and the base port).
+            return base + replica_id
+        return base
+
+    def _launch_replica(self, replica_id: int, cluster_name: str,
+                        use_spot: bool,
+                        location: Optional[spot_placer_lib.Location]
+                        ) -> None:
+        serve_state.update_replica(self.service_name, replica_id,
+                                   status=ReplicaStatus.PROVISIONING)
+        try:
+            replica_task = self._replica_task(use_spot, location,
+                                              replica_id)
+            _, handle = execution.launch(replica_task,
+                                         cluster_name=cluster_name,
+                                         detach_run=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Replica {cluster_name} failed to provision: '
+                           f'{e}')
+            serve_state.update_replica(
+                self.service_name, replica_id,
+                status=ReplicaStatus.FAILED_PROVISION, status_message=str(e))
+            return
+        url = (f'http://{handle.head_ip}:'
+               f'{self._replica_port(replica_id)}')
+        serve_state.update_replica(self.service_name, replica_id,
+                                   status=ReplicaStatus.STARTING, url=url)
+
+    def _terminate_replica(self, replica_id: int, purge: bool) -> None:
+        cluster_name = replica_cluster_name(self.service_name, replica_id)
+        record = global_state.get_cluster(cluster_name)
+        if record is not None:
+            try:
+                TpuBackend().teardown(record['handle'], terminate=True)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Teardown of {cluster_name} failed: {e}')
+                if not purge:
+                    return
+        # Intentional scale-down rows are removed; failure/preemption rows
+        # are kept (terminal) for `serve status` postmortems (reference
+        # keeps terminal ReplicaInfo rows).
+        rec = next((r for r in serve_state.get_replicas(self.service_name)
+                    if r['replica_id'] == replica_id), None)
+        if rec is None or rec['status'] == ReplicaStatus.SHUTTING_DOWN:
+            serve_state.remove_replica(self.service_name, replica_id)
+
+    # --- readiness probing ---
+
+    def _probe_url(self, url: str) -> bool:
+        probe_url = url + self.spec.readiness_path
+        try:
+            if self.spec.post_data is not None:
+                resp = requests.post(probe_url, json=self.spec.post_data,
+                                     headers=self.spec.readiness_headers,
+                                     timeout=PROBE_TIMEOUT_SECONDS)
+            else:
+                resp = requests.get(probe_url,
+                                    headers=self.spec.readiness_headers,
+                                    timeout=PROBE_TIMEOUT_SECONDS)
+            return resp.status_code == 200
+        except requests.RequestException:
+            return False
+
+    def _cluster_preempted(self, cluster_name: str) -> bool:
+        record = global_state.get_cluster(cluster_name)
+        if record is None:
+            return True
+        handle = record['handle']
+        try:
+            statuses = provision_api.query_instances(
+                handle.cluster_info.cloud, cluster_name,
+                handle.cluster_info.provider_config)
+        except Exception:  # pylint: disable=broad-except
+            return False  # can't tell; don't declare preemption
+        return not statuses or any(s != 'running'
+                                   for s in statuses.values())
+
+    def probe_all(self) -> List[Dict[str, Any]]:
+        """One probe pass over all live replicas; returns fresh records."""
+        for rec in serve_state.get_replicas(self.service_name):
+            status = rec['status']
+            if status not in (ReplicaStatus.STARTING, ReplicaStatus.READY,
+                              ReplicaStatus.NOT_READY):
+                continue
+            replica_id = rec['replica_id']
+            ok = self._probe_url(rec['url']) if rec['url'] else False
+            if ok:
+                serve_state.update_replica(self.service_name, replica_id,
+                                           status=ReplicaStatus.READY,
+                                           consecutive_failures=0)
+                if rec['location'] is not None and \
+                        self.spot_placer is not None:
+                    self.spot_placer.set_active(
+                        spot_placer_lib.Location.from_dict(rec['location']))
+                continue
+            if status == ReplicaStatus.STARTING:
+                elapsed = time.time() - (rec['launched_at'] or time.time())
+                if elapsed > self.spec.initial_delay_seconds:
+                    logger.warning(
+                        f'Replica {replica_id} of {self.service_name} not '
+                        f'ready after initial delay '
+                        f'{self.spec.initial_delay_seconds}s; failing.')
+                    serve_state.update_replica(
+                        self.service_name, replica_id,
+                        status=ReplicaStatus.FAILED_INITIAL_DELAY)
+                    self._async_teardown(replica_id)
+                continue
+            failures = rec['consecutive_failures'] + 1
+            cluster_name = replica_cluster_name(self.service_name,
+                                                replica_id)
+            if failures >= PROBE_FAILURE_THRESHOLD:
+                if self._cluster_preempted(cluster_name):
+                    logger.info(f'Replica {replica_id} of '
+                                f'{self.service_name} preempted.')
+                    if rec['location'] is not None and \
+                            self.spot_placer is not None:
+                        self.spot_placer.set_preempted(
+                            spot_placer_lib.Location.from_dict(
+                                rec['location']))
+                    serve_state.update_replica(
+                        self.service_name, replica_id,
+                        status=ReplicaStatus.PREEMPTED)
+                else:
+                    serve_state.update_replica(
+                        self.service_name, replica_id,
+                        status=ReplicaStatus.FAILED_PROBING)
+                self._async_teardown(replica_id)
+            else:
+                serve_state.update_replica(self.service_name, replica_id,
+                                           status=ReplicaStatus.NOT_READY,
+                                           consecutive_failures=failures)
+        return serve_state.get_replicas(self.service_name)
+
+    def _async_teardown(self, replica_id: int) -> None:
+        thread = threading.Thread(
+            target=self._terminate_replica, args=(replica_id, True),
+            daemon=True,
+            name=f'serve-reap-{self.service_name}-{replica_id}')
+        self._down_threads[replica_id] = thread
+        thread.start()
+
+    def ready_urls(self) -> List[str]:
+        return [r['url'] for r in serve_state.get_replicas(self.service_name)
+                if r['status'] == ReplicaStatus.READY and r['url']]

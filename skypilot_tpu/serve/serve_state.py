@@ -1,0 +1,254 @@
+"""Serve state DB (reference: sky/serve/serve_state.py).
+
+Sqlite tables for services and replicas, plus the status enums
+(`ServiceStatus`, `ReplicaStatus`) mirroring the reference's state machine.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH = '~/.skypilot_tpu/serve.db'
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS services (
+    name TEXT PRIMARY KEY,
+    status TEXT,
+    spec_json TEXT,
+    task_json TEXT,
+    version INTEGER DEFAULT 1,
+    endpoint TEXT,
+    created_at REAL,
+    status_message TEXT
+);
+CREATE TABLE IF NOT EXISTS replicas (
+    service_name TEXT,
+    replica_id INTEGER,
+    status TEXT,
+    version INTEGER DEFAULT 1,
+    cluster_name TEXT,
+    url TEXT,
+    is_spot INTEGER DEFAULT 0,
+    location_json TEXT,
+    launched_at REAL,
+    consecutive_failures INTEGER DEFAULT 0,
+    status_message TEXT,
+    PRIMARY KEY (service_name, replica_id)
+);
+"""
+
+
+class ServiceStatus(enum.Enum):
+    """Service lifecycle (reference: serve_state.ServiceStatus)."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    NO_REPLICA = 'NO_REPLICA'
+
+    def is_terminal(self) -> bool:
+        return self == ServiceStatus.FAILED
+
+
+class ReplicaStatus(enum.Enum):
+    """Replica lifecycle (reference: serve_state.ReplicaStatus)."""
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'            # provisioned; within initial delay
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'          # probe failing, not yet failed over
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
+    FAILED_PROBING = 'FAILED_PROBING'
+    FAILED_PROVISION = 'FAILED_PROVISION'
+    PREEMPTED = 'PREEMPTED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_REPLICA_STATUSES
+
+    def is_failed(self) -> bool:
+        return self in (ReplicaStatus.FAILED,
+                        ReplicaStatus.FAILED_INITIAL_DELAY,
+                        ReplicaStatus.FAILED_PROBING,
+                        ReplicaStatus.FAILED_PROVISION)
+
+    @classmethod
+    def scale_down_decision_order(cls) -> List['ReplicaStatus']:
+        """Preference order when choosing replicas to kill (reference:
+        _select_nonterminal_replicas_to_scale_down,
+        sky/serve/autoscalers.py:73 — kill the least useful first)."""
+        return [cls.PENDING, cls.PROVISIONING, cls.STARTING, cls.NOT_READY,
+                cls.READY]
+
+
+_TERMINAL_REPLICA_STATUSES = frozenset({
+    ReplicaStatus.FAILED, ReplicaStatus.FAILED_INITIAL_DELAY,
+    ReplicaStatus.FAILED_PROBING, ReplicaStatus.FAILED_PROVISION,
+    ReplicaStatus.PREEMPTED, ReplicaStatus.SHUTTING_DOWN,
+})
+
+
+def _conn() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+# --- services ---
+
+def add_service(name: str, spec_json: Dict[str, Any],
+                task_json: Dict[str, Any]) -> bool:
+    with _conn() as conn:
+        try:
+            conn.execute(
+                'INSERT INTO services (name, status, spec_json, task_json, '
+                'created_at) VALUES (?, ?, ?, ?, ?)',
+                (name, ServiceStatus.CONTROLLER_INIT.value,
+                 json.dumps(spec_json), json.dumps(task_json), time.time()))
+        except sqlite3.IntegrityError:
+            return False
+    return True
+
+
+def update_service(name: str, *, status: Optional[ServiceStatus] = None,
+                   endpoint: Optional[str] = None,
+                   version: Optional[int] = None,
+                   spec_json: Optional[Dict[str, Any]] = None,
+                   task_json: Optional[Dict[str, Any]] = None,
+                   status_message: Optional[str] = None) -> None:
+    sets, vals = [], []
+    for col, val in (('status', status.value if status else None),
+                     ('endpoint', endpoint), ('version', version),
+                     ('spec_json',
+                      json.dumps(spec_json) if spec_json else None),
+                     ('task_json',
+                      json.dumps(task_json) if task_json else None),
+                     ('status_message', status_message)):
+        if val is not None:
+            sets.append(f'{col} = ?')
+            vals.append(val)
+    if not sets:
+        return
+    with _conn() as conn:
+        conn.execute(f'UPDATE services SET {", ".join(sets)} WHERE name = ?',
+                     (*vals, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM services WHERE name = ?',
+                           (name,)).fetchone()
+    return _service_row(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM services ORDER BY created_at').fetchall()
+    return [_service_row(r) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM services WHERE name = ?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name = ?', (name,))
+
+
+def _service_row(row) -> Dict[str, Any]:
+    return {
+        'name': row['name'],
+        'status': ServiceStatus(row['status']),
+        'spec': json.loads(row['spec_json']),
+        'task': json.loads(row['task_json']),
+        'version': row['version'],
+        'endpoint': row['endpoint'],
+        'created_at': row['created_at'],
+        'status_message': row['status_message'],
+    }
+
+
+# --- replicas ---
+
+def add_replica(service_name: str, replica_id: int, cluster_name: str,
+                version: int, is_spot: bool = False,
+                location: Optional[Dict[str, Any]] = None) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
+            'status, version, cluster_name, is_spot, location_json, '
+            'launched_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
+            (service_name, replica_id, ReplicaStatus.PENDING.value, version,
+             cluster_name, int(is_spot),
+             json.dumps(location) if location else None, time.time()))
+
+
+def update_replica(service_name: str, replica_id: int, *,
+                   status: Optional[ReplicaStatus] = None,
+                   url: Optional[str] = None,
+                   consecutive_failures: Optional[int] = None,
+                   status_message: Optional[str] = None) -> None:
+    sets, vals = [], []
+    for col, val in (('status', status.value if status else None),
+                     ('url', url),
+                     ('consecutive_failures', consecutive_failures),
+                     ('status_message', status_message)):
+        if val is not None:
+            sets.append(f'{col} = ?')
+            vals.append(val)
+    if not sets:
+        return
+    with _conn() as conn:
+        conn.execute(
+            f'UPDATE replicas SET {", ".join(sets)} '
+            'WHERE service_name = ? AND replica_id = ?',
+            (*vals, service_name, replica_id))
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM replicas WHERE service_name = ? '
+            'ORDER BY replica_id', (service_name,)).fetchall()
+    return [_replica_row(r) for r in rows]
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name = ? AND replica_id = ?',
+            (service_name, replica_id))
+
+
+def next_replica_id(service_name: str) -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(replica_id) AS m FROM replicas '
+            'WHERE service_name = ?', (service_name,)).fetchone()
+    return (row['m'] or 0) + 1
+
+
+def _replica_row(row) -> Dict[str, Any]:
+    return {
+        'service_name': row['service_name'],
+        'replica_id': row['replica_id'],
+        'status': ReplicaStatus(row['status']),
+        'version': row['version'],
+        'cluster_name': row['cluster_name'],
+        'url': row['url'],
+        'is_spot': bool(row['is_spot']),
+        'location': (json.loads(row['location_json'])
+                     if row['location_json'] else None),
+        'launched_at': row['launched_at'],
+        'consecutive_failures': row['consecutive_failures'],
+        'status_message': row['status_message'],
+    }

@@ -1,0 +1,166 @@
+"""Service specification (reference: SkyServiceSpec, sky/serve/service_spec.py:18).
+
+Parsed from the `service:` section of a task YAML:
+
+    service:
+      readiness_probe:
+        path: /health
+        initial_delay_seconds: 60
+        post_data: {...}            # optional -> POST probe
+      replica_policy:
+        min_replicas: 1
+        max_replicas: 3
+        target_qps_per_replica: 10
+        upscale_delay_seconds: 300
+        downscale_delay_seconds: 1200
+        base_ondemand_fallback_replicas: 1
+        dynamic_ondemand_fallback: true
+        spot_placer: dynamic_fallback
+      load_balancing_policy: least_load
+      ports: 8080
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_READINESS_TIMEOUT_SECONDS = 15
+DEFAULT_UPSCALE_DELAY_SECONDS = 300
+DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    """Validated serving spec (mirrors SkyServiceSpec fields/invariants)."""
+    readiness_path: str = '/'
+    initial_delay_seconds: int = DEFAULT_INITIAL_DELAY_SECONDS
+    readiness_timeout_seconds: int = DEFAULT_READINESS_TIMEOUT_SECONDS
+    post_data: Optional[Dict[str, Any]] = None
+    readiness_headers: Optional[Dict[str, str]] = None
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    num_overprovision: Optional[int] = None
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: int = DEFAULT_UPSCALE_DELAY_SECONDS
+    downscale_delay_seconds: int = DEFAULT_DOWNSCALE_DELAY_SECONDS
+    base_ondemand_fallback_replicas: Optional[int] = None
+    dynamic_ondemand_fallback: Optional[bool] = None
+    spot_placer: Optional[str] = None
+    load_balancing_policy: Optional[str] = None
+    ports: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if not self.readiness_path.startswith('/'):
+            raise exceptions.InvalidServiceSpecError(
+                'readiness_path must start with a slash (/). '
+                f'Got: {self.readiness_path}')
+        if self.max_replicas is not None and \
+                self.max_replicas < self.min_replicas:
+            raise exceptions.InvalidServiceSpecError(
+                'max_replicas must be >= min_replicas; got '
+                f'min={self.min_replicas}, max={self.max_replicas}')
+        if self.target_qps_per_replica is not None:
+            if self.max_replicas is None:
+                raise exceptions.InvalidServiceSpecError(
+                    'max_replicas must be set when target_qps_per_replica '
+                    'is set.')
+        elif self.max_replicas is not None and \
+                self.max_replicas != self.min_replicas:
+            raise exceptions.InvalidServiceSpecError(
+                'min_replicas != max_replicas requires '
+                'target_qps_per_replica to enable autoscaling.')
+        from skypilot_tpu.serve import load_balancing_policies as lb
+        if self.load_balancing_policy is not None and \
+                self.load_balancing_policy not in lb.LB_POLICIES:
+            raise exceptions.InvalidServiceSpecError(
+                f'Unknown load balancing policy: '
+                f'{self.load_balancing_policy}. Available: '
+                f'{sorted(lb.LB_POLICIES)}')
+        from skypilot_tpu.serve import spot_placer as sp
+        if self.spot_placer is not None and \
+                self.spot_placer not in sp.SPOT_PLACERS:
+            raise exceptions.InvalidServiceSpecError(
+                f'Unknown spot placer: {self.spot_placer}. Available: '
+                f'{sorted(sp.SPOT_PLACERS)}')
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        probe = config.get('readiness_probe', '/')
+        if isinstance(probe, str):
+            probe = {'path': probe}
+        policy = config.get('replica_policy')
+        if policy is None:
+            # `replicas: N` shorthand == fixed-size replica_policy.
+            policy = {'min_replicas': int(config.get('replicas', 1))}
+        ports = config.get('ports')
+        return cls(
+            readiness_path=probe.get('path', '/'),
+            initial_delay_seconds=int(
+                probe.get('initial_delay_seconds',
+                          DEFAULT_INITIAL_DELAY_SECONDS)),
+            readiness_timeout_seconds=int(
+                probe.get('readiness_timeout_seconds',
+                          DEFAULT_READINESS_TIMEOUT_SECONDS)),
+            post_data=probe.get('post_data'),
+            readiness_headers=probe.get('headers'),
+            min_replicas=int(policy.get('min_replicas', 1)),
+            max_replicas=(int(policy['max_replicas'])
+                          if 'max_replicas' in policy else None),
+            num_overprovision=(int(policy['num_overprovision'])
+                               if 'num_overprovision' in policy else None),
+            target_qps_per_replica=(
+                float(policy['target_qps_per_replica'])
+                if 'target_qps_per_replica' in policy else None),
+            upscale_delay_seconds=int(
+                policy.get('upscale_delay_seconds',
+                           DEFAULT_UPSCALE_DELAY_SECONDS)),
+            downscale_delay_seconds=int(
+                policy.get('downscale_delay_seconds',
+                           DEFAULT_DOWNSCALE_DELAY_SECONDS)),
+            base_ondemand_fallback_replicas=(
+                int(policy['base_ondemand_fallback_replicas'])
+                if 'base_ondemand_fallback_replicas' in policy else None),
+            dynamic_ondemand_fallback=policy.get(
+                'dynamic_ondemand_fallback'),
+            spot_placer=policy.get('spot_placer'),
+            load_balancing_policy=config.get('load_balancing_policy'),
+            ports=int(ports) if ports is not None else None,
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {
+            'path': self.readiness_path,
+            'initial_delay_seconds': self.initial_delay_seconds,
+            'readiness_timeout_seconds': self.readiness_timeout_seconds,
+        }
+        if self.post_data is not None:
+            probe['post_data'] = self.post_data
+        if self.readiness_headers is not None:
+            probe['headers'] = self.readiness_headers
+        policy: Dict[str, Any] = {'min_replicas': self.min_replicas}
+        for key in ('max_replicas', 'num_overprovision',
+                    'target_qps_per_replica',
+                    'base_ondemand_fallback_replicas',
+                    'dynamic_ondemand_fallback', 'spot_placer'):
+            val = getattr(self, key)
+            if val is not None:
+                policy[key] = val
+        if self.autoscaling_enabled:
+            policy['upscale_delay_seconds'] = self.upscale_delay_seconds
+            policy['downscale_delay_seconds'] = self.downscale_delay_seconds
+        cfg: Dict[str, Any] = {
+            'readiness_probe': probe,
+            'replica_policy': policy,
+        }
+        if self.load_balancing_policy is not None:
+            cfg['load_balancing_policy'] = self.load_balancing_policy
+        if self.ports is not None:
+            cfg['ports'] = self.ports
+        return cfg

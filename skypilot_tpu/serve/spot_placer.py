@@ -1,0 +1,129 @@
+"""Spot placement with preemption history (reference: sky/serve/spot_placer.py,
+the "SpotHedge" dynamic_fallback placer :1-12).
+
+Tracks per-`Location` (region, zone) preemption status for a service's spot
+replicas and prefers ACTIVE locations when launching; a preempted location
+is only retried once every active location is exhausted.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import random
+from typing import Any, Dict, List, Optional
+
+SPOT_PLACERS: Dict[str, type] = {}
+DEFAULT_SPOT_PLACER: Optional[str] = None
+SPOT_HEDGE_PLACER = 'dynamic_fallback'
+
+
+@dataclasses.dataclass(frozen=True)
+class Location:
+    """A (cloud, region, zone) a spot replica can land in."""
+    cloud: str
+    region: str
+    zone: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {'cloud': self.cloud, 'region': self.region,
+                'zone': self.zone}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'Location':
+        return cls(cloud=d['cloud'], region=d['region'], zone=d.get('zone'))
+
+
+class LocationStatus(enum.Enum):
+    ACTIVE = 'ACTIVE'
+    PREEMPTED = 'PREEMPTED'
+
+
+def possible_locations_for_task(task) -> List[Location]:
+    """Enumerate candidate zones for the task's resources via the catalog."""
+    from skypilot_tpu import catalog
+    locations: List[Location] = []
+    for res in task.resources:
+        cloud = res.cloud or 'gcp'
+        if res.region is not None and res.zone is not None:
+            locations.append(Location(cloud, res.region, res.zone))
+            continue
+        if res.tpu_spec is None:
+            continue
+        for offering in catalog.get_tpu_offerings(res.tpu_spec,
+                                                  region=res.region):
+            locations.append(
+                Location(cloud, offering.region, offering.zone))
+    # De-dup, stable order.
+    seen, out = set(), []
+    for loc in locations:
+        if loc not in seen:
+            seen.add(loc)
+            out.append(loc)
+    return out
+
+
+class SpotPlacer:
+    """Abstract placer: pick a Location for the next spot replica."""
+
+    def __init__(self, locations: List[Location]) -> None:
+        self.location2status: Dict[Location, LocationStatus] = \
+            collections.OrderedDict(
+                (loc, LocationStatus.ACTIVE) for loc in locations)
+
+    def __init_subclass__(cls, name: str, default: bool = False):
+        SPOT_PLACERS[name] = cls
+        if default:
+            global DEFAULT_SPOT_PLACER
+            assert DEFAULT_SPOT_PLACER is None, 'Only one default placer.'
+            DEFAULT_SPOT_PLACER = name
+
+    @classmethod
+    def make(cls, placer_name: Optional[str], task) -> Optional['SpotPlacer']:
+        name = placer_name or DEFAULT_SPOT_PLACER
+        if name is None:
+            return None
+        if name not in SPOT_PLACERS:
+            raise ValueError(f'Unknown spot placer: {name}')
+        locations = possible_locations_for_task(task)
+        if not locations:
+            return None
+        return SPOT_PLACERS[name](locations)
+
+    def select_next_location(self,
+                             current: List[Location]) -> Location:
+        raise NotImplementedError
+
+    def set_active(self, location: Location) -> None:
+        self.location2status[location] = LocationStatus.ACTIVE
+
+    def set_preempted(self, location: Location) -> None:
+        self.location2status[location] = LocationStatus.PREEMPTED
+
+    def active_locations(self) -> List[Location]:
+        return [loc for loc, st in self.location2status.items()
+                if st == LocationStatus.ACTIVE]
+
+    def preempted_locations(self) -> List[Location]:
+        return [loc for loc, st in self.location2status.items()
+                if st == LocationStatus.PREEMPTED]
+
+
+class DynamicFallbackSpotPlacer(SpotPlacer, name=SPOT_HEDGE_PLACER,
+                                default=True):
+    """SpotHedge: spread replicas over active locations; on preemption mark
+    the location and fall back elsewhere; retry preempted locations only
+    when no active one remains (then optimistically reset them)."""
+
+    def select_next_location(self, current: List[Location]) -> Location:
+        active = self.active_locations()
+        if not active:
+            # Everything preempted: reset and retry (the hedge part).
+            for loc in self.preempted_locations():
+                self.set_active(loc)
+            active = self.active_locations()
+        counts = collections.Counter(current)
+        min_count = min((counts.get(loc, 0) for loc in active), default=0)
+        candidates = [loc for loc in active
+                      if counts.get(loc, 0) == min_count]
+        return random.choice(candidates)

@@ -1,0 +1,8 @@
+"""API server: the control plane between client SDK/CLI and the engine.
+
+Reference parity: sky/server/ — FastAPI app (server.py:592), async request
+executor (requests/executor.py), request DB.  Here: aiohttp (FastAPI is not
+in the image), the same async-request pattern: every mutating endpoint
+enqueues a request and returns a request_id; clients poll /api/get or
+stream /api/stream.
+"""

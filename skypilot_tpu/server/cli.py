@@ -1,0 +1,87 @@
+"""`skytpu api ...` command group (reference: sky/client/cli api_*)."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_PID_PATH = '~/.skypilot_tpu/api_server.pid'
+_LOG_PATH = '~/.skypilot_tpu/api_server.log'
+
+
+def _read_pid() -> int:
+    with open(os.path.expanduser(_PID_PATH), encoding='utf-8') as f:
+        return int(f.read().strip())
+
+
+def _running() -> bool:
+    try:
+        os.kill(_read_pid(), 0)
+        return True
+    except (OSError, ValueError, FileNotFoundError):
+        return False
+
+
+def _cmd_start(args) -> int:
+    from skypilot_tpu.server.server import DEFAULT_PORT
+    if _running():
+        print('API server already running.')
+        return 0
+    port = args.port or DEFAULT_PORT
+    log_path = os.path.expanduser(_LOG_PATH)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--host', args.host, '--port', str(port)],
+        stdout=open(log_path, 'ab'), stderr=subprocess.STDOUT,
+        start_new_session=True)
+    with open(os.path.expanduser(_PID_PATH), 'w', encoding='utf-8') as f:
+        f.write(str(proc.pid))
+    time.sleep(0.8)
+    endpoint = f'http://{args.host}:{port}'
+    print(f'API server started at {endpoint}\n'
+          f'Point clients at it: export SKYTPU_API_SERVER_URL={endpoint}')
+    return 0
+
+
+def _cmd_stop(args) -> int:
+    if not _running():
+        print('API server not running.')
+        return 0
+    os.killpg(os.getpgid(_read_pid()), signal.SIGTERM)
+    os.remove(os.path.expanduser(_PID_PATH))
+    print('API server stopped.')
+    return 0
+
+
+def _cmd_info(args) -> int:
+    from skypilot_tpu.client import sdk
+    info = sdk.api_health()
+    if info is None:
+        print('Library-local mode (no SKYTPU_API_SERVER_URL / '
+              'api_server.endpoint configured).')
+        if _running():
+            print(f'A local API server IS running (pid {_read_pid()}).')
+        return 0
+    print(f'API server: {os.environ.get("SKYTPU_API_SERVER_URL", "")} '
+          f'status={info["status"]} version={info["version"]} '
+          f'api_version={info["api_version"]}')
+    return 0
+
+
+def register(sub) -> None:
+    p = sub.add_parser('api', help='API server management')
+    asub = p.add_subparsers(dest='api_command')
+
+    ps = asub.add_parser('start', help='Start the local API server')
+    ps.add_argument('--host', default='127.0.0.1')
+    ps.add_argument('--port', type=int, default=None)
+    ps.set_defaults(fn=_cmd_start)
+
+    pt = asub.add_parser('stop', help='Stop the local API server')
+    pt.set_defaults(fn=_cmd_stop)
+
+    pi = asub.add_parser('info', help='Show API server status')
+    pi.set_defaults(fn=_cmd_info)

@@ -1,0 +1,183 @@
+"""Request entrypoints: payload dict -> engine call -> JSON result.
+
+The REST analog of sky/server/server.py's endpoint bodies: each endpoint
+schedules one of these by name (see server.py routing table).  Results are
+JSON-safe so the request DB can persist them.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.server.executor import entrypoint
+
+
+@entrypoint('launch')
+def _launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    job_id, handle = execution.launch(
+        task,
+        cluster_name=payload.get('cluster_name'),
+        detach_run=True,  # the server never blocks on user jobs
+        down=payload.get('down', False),
+        no_setup=payload.get('no_setup', False))
+    return {'job_id': job_id,
+            'cluster_name': handle.cluster_name if handle else None}
+
+
+@entrypoint('exec')
+def _exec(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    job_id, handle = execution.exec_cmd(
+        task, cluster_name=payload['cluster_name'], detach_run=True)
+    return {'job_id': job_id,
+            'cluster_name': handle.cluster_name if handle else None}
+
+
+@entrypoint('status')
+def _status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    records = core.status(cluster_names=payload.get('cluster_names'),
+                          refresh=payload.get('refresh', False))
+    return core.status_payload(records)
+
+
+@entrypoint('start')
+def _start(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.start(payload['cluster_name'])
+
+
+@entrypoint('stop')
+def _stop(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.stop(payload['cluster_name'])
+
+
+@entrypoint('down')
+def _down(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.down(payload['cluster_name'])
+
+
+@entrypoint('autostop')
+def _autostop(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu import core
+    core.autostop(payload['cluster_name'], payload['idle_minutes'],
+                  down=payload.get('down', True))
+
+
+@entrypoint('queue')
+def _queue(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    jobs = core.queue(payload['cluster_name'],
+                      all_jobs=payload.get('all_jobs', False))
+    out = []
+    for j in jobs:
+        j = dict(j)
+        if hasattr(j.get('status'), 'value'):
+            j['status'] = j['status'].value
+        out.append(j)
+    return out
+
+
+@entrypoint('cancel')
+def _cancel(payload: Dict[str, Any]) -> List[int]:
+    from skypilot_tpu import core
+    return core.cancel(payload['cluster_name'],
+                       job_ids=payload.get('job_ids'))
+
+
+@entrypoint('optimize')
+def _optimize(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import optimizer as optimizer_lib
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    optimizer_lib.Optimizer.optimize_task(task)
+    best = task.best_resources
+    return {'resources': best.to_yaml_config(),
+            'price_per_hour': best.price_per_hour}
+
+
+@entrypoint('check')
+def _check(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import check as check_lib
+    return check_lib.check(quiet=True)
+
+
+# --- managed jobs ---
+
+@entrypoint('jobs.launch')
+def _jobs_launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.jobs import core as jobs_core
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    job_id = jobs_core.launch(task, name=payload.get('name'))
+    return {'job_id': job_id}
+
+
+@entrypoint('jobs.queue')
+def _jobs_queue(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import core as jobs_core
+    out = []
+    for j in jobs_core.queue(skip_finished=payload.get('skip_finished',
+                                                       False)):
+        j = dict(j)
+        for key in ('status', 'schedule_state'):
+            if hasattr(j.get(key), 'value'):
+                j[key] = j[key].value
+        out.append(j)
+    return out
+
+
+@entrypoint('jobs.cancel')
+def _jobs_cancel(payload: Dict[str, Any]) -> List[int]:
+    from skypilot_tpu.jobs import core as jobs_core
+    return jobs_core.cancel(payload.get('job_ids'))
+
+
+# --- serve ---
+
+@entrypoint('serve.up')
+def _serve_up(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.serve import core as serve_core
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    endpoint_url = serve_core.up(task,
+                                 service_name=payload.get('service_name'))
+    return {'endpoint': endpoint_url}
+
+
+@entrypoint('serve.update')
+def _serve_update(payload: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu.serve import core as serve_core
+    task = task_lib.Task.from_yaml_config(payload['task'])
+    version = serve_core.update(task, payload['service_name'])
+    return {'version': version}
+
+
+@entrypoint('serve.down')
+def _serve_down(payload: Dict[str, Any]) -> None:
+    from skypilot_tpu.serve import core as serve_core
+    serve_core.down(payload['service_name'],
+                    purge=payload.get('purge', False))
+
+
+@entrypoint('serve.status')
+def _serve_status(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu.serve import core as serve_core
+    out = []
+    for r in serve_core.status(payload.get('service_names')):
+        r = dict(r)
+        r['status'] = r['status'].value
+        r['replicas'] = [
+            {**rep, 'status': rep['status'].value}
+            for rep in r['replicas']]
+        out.append(r)
+    return out
+
+
+@entrypoint('api.echo')
+def _echo(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Health/latency probe used by tests and `api info`."""
+    return {'echo': payload, 'time': time.time()}

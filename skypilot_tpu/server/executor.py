@@ -1,0 +1,168 @@
+"""Request executor: worker pools draining the request queue.
+
+Reference parity: sky/server/requests/executor.py — requests are queued,
+then run on short/long worker pools (long = launch/exec-class requests
+that can take minutes; short = status-class).  The reference isolates each
+request in a process; here workers are threads of the server process
+(cheaper, and our engine is thread-safe via sqlite/WAL + filelocks), with
+an inline mode used by tests (the reference does the same trick:
+tests/common_test_fixtures.py:56 executes requests inline).
+
+Per-request logs: a router handler on the package logger writes records
+from a request's worker thread to the request's log file, so
+/api/stream can tail exactly what that request logged.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import requests_lib
+from skypilot_tpu.server.requests_lib import RequestStatus
+
+logger = sky_logging.init_logger(__name__)
+
+# Entrypoint registry: request name -> callable(payload) -> JSON result.
+REGISTRY: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
+# Long-running request names get the long pool (reference sizes pools by
+# system resources; we use fixed counts from config).
+LONG_REQUESTS = frozenset({
+    'launch', 'exec', 'start', 'stop', 'down', 'jobs.launch',
+    'serve.up', 'serve.update', 'serve.down',
+})
+
+
+def entrypoint(name: str):
+    def deco(fn):
+        REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+class _RequestLogRouter(logging.Handler):
+    """Routes log records emitted on a request's worker thread to the
+    request's log file."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._files: Dict[int, Any] = {}
+        self._lock_map = threading.Lock()
+        self.setFormatter(logging.Formatter(
+            '%(levelname).1s %(asctime)s] %(message)s',
+            datefmt='%m-%d %H:%M:%S'))
+
+    def attach(self, log_path: str) -> None:
+        f = open(log_path, 'a', encoding='utf-8')  # noqa: SIM115
+        with self._lock_map:
+            self._files[threading.get_ident()] = f
+
+    def detach(self) -> None:
+        with self._lock_map:
+            f = self._files.pop(threading.get_ident(), None)
+        if f is not None:
+            f.close()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        with self._lock_map:
+            f = self._files.get(threading.get_ident())
+        if f is not None:
+            f.write(self.format(record) + '\n')
+            f.flush()
+
+
+_router = _RequestLogRouter()
+logging.getLogger('skypilot_tpu').addHandler(_router)
+
+
+def execute_request(request_id: str) -> None:
+    """Run one request to completion (also the inline path for tests)."""
+    # Deferred self-import so using the executor directly (tests, inline
+    # mode) registers the handlers; entrypoints imports only the
+    # `entrypoint` decorator from this module, so no cycle at runtime.
+    from skypilot_tpu.server import entrypoints  # noqa: F401  pylint: disable=unused-import,cyclic-import
+    record = requests_lib.get(request_id)
+    if record is None or record['status'] != RequestStatus.PENDING:
+        return
+    requests_lib.set_status(request_id, RequestStatus.RUNNING)
+    fn = REGISTRY.get(record['name'])
+    _router.attach(record['log_path'])
+    try:
+        if fn is None:
+            raise ValueError(f'Unknown request name: {record["name"]}')
+        result = fn(record['payload'])
+        _finish(request_id, RequestStatus.SUCCEEDED, result=result)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.error(f'Request {request_id} ({record["name"]}) failed: '
+                     f'{e}\n{traceback.format_exc()}')
+        _finish(request_id, RequestStatus.FAILED,
+                error=f'{type(e).__name__}: {e}')
+    finally:
+        _router.detach()
+
+
+def _finish(request_id: str, status: RequestStatus, result=None,
+            error=None) -> None:
+    """Set a terminal status unless the request was cancelled mid-flight
+    (cancellation is cooperative; the work may still have completed, but
+    the user-visible terminal state must stay CANCELLED)."""
+    current = requests_lib.get(request_id)
+    if current is not None and \
+            current['status'] == RequestStatus.CANCELLED:
+        return
+    requests_lib.set_status(request_id, status, result=result, error=error)
+
+
+class RequestWorkerPool:
+    """Two thread pools (short/long) draining a shared queue pair
+    (reference: RequestWorker, executor.py:141)."""
+
+    def __init__(self, short_workers: int = 4, long_workers: int = 4
+                 ) -> None:
+        self._short_q: 'queue.Queue[str]' = queue.Queue()
+        self._long_q: 'queue.Queue[str]' = queue.Queue()
+        self._stop = threading.Event()
+        self._threads = []
+        for i in range(short_workers):
+            self._threads.append(threading.Thread(
+                target=self._worker, args=(self._short_q,),
+                name=f'req-short-{i}', daemon=True))
+        for i in range(long_workers):
+            self._threads.append(threading.Thread(
+                target=self._worker, args=(self._long_q,),
+                name=f'req-long-{i}', daemon=True))
+        for t in self._threads:
+            t.start()
+
+    def schedule(self, request_id: str, name: str) -> None:
+        if name in LONG_REQUESTS:
+            self._long_q.put(request_id)
+        else:
+            self._short_q.put(request_id)
+
+    def _worker(self, q: 'queue.Queue[str]') -> None:
+        while not self._stop.is_set():
+            try:
+                request_id = q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            execute_request(request_id)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def schedule_request(name: str, payload: Dict[str, Any],
+                     pool: Optional[RequestWorkerPool] = None,
+                     user: Optional[str] = None) -> str:
+    """Create + dispatch a request; returns its id (reference:
+    executor.schedule_request :640)."""
+    request_id = requests_lib.create(name, payload, user=user)
+    if pool is None:
+        execute_request(request_id)  # inline mode
+    else:
+        pool.schedule(request_id, name)
+    return request_id

@@ -1,0 +1,131 @@
+"""Async-request bookkeeping (reference: sky/server/requests/ — request DB,
+statuses, payload/result persistence).
+
+Each API call becomes a row: (request_id, name, status, payload, result,
+error, log_path).  Results/errors are JSON; per-request logs are captured
+to a file so /api/stream can tail them.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_DB_PATH = '~/.skypilot_tpu/requests.db'
+_LOG_DIR = '~/.skypilot_tpu/request_logs'
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS requests (
+    request_id TEXT PRIMARY KEY,
+    name TEXT,
+    status TEXT,
+    payload_json TEXT,
+    result_json TEXT,
+    error TEXT,
+    log_path TEXT,
+    user TEXT,
+    created_at REAL,
+    finished_at REAL
+);
+"""
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+def _conn() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def log_path_for(request_id: str) -> str:
+    log_dir = os.path.expanduser(_LOG_DIR)
+    os.makedirs(log_dir, exist_ok=True)
+    return os.path.join(log_dir, f'{request_id}.log')
+
+
+def create(name: str, payload: Dict[str, Any],
+           user: Optional[str] = None) -> str:
+    request_id = uuid.uuid4().hex[:16]
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, status, payload_json, '
+            'log_path, user, created_at) VALUES (?, ?, ?, ?, ?, ?, ?)',
+            (request_id, name, RequestStatus.PENDING.value,
+             json.dumps(payload), log_path_for(request_id), user,
+             time.time()))
+    return request_id
+
+
+def set_status(request_id: str, status: RequestStatus,
+               result: Any = None, error: Optional[str] = None) -> None:
+    finished = time.time() if status.is_terminal() else None
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status = ?, result_json = ?, error = ?, '
+            'finished_at = COALESCE(?, finished_at) WHERE request_id = ?',
+            (status.value,
+             json.dumps(result) if result is not None else None,
+             error, finished, request_id))
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM requests WHERE request_id = ?',
+                           (request_id,)).fetchone()
+    return _row(row) if row else None
+
+
+def list_requests(status: Optional[RequestStatus] = None,
+                  limit: int = 100) -> List[Dict[str, Any]]:
+    query = 'SELECT * FROM requests'
+    params: tuple = ()
+    if status is not None:
+        query += ' WHERE status = ?'
+        params = (status.value,)
+    query += ' ORDER BY created_at DESC LIMIT ?'
+    with _conn() as conn:
+        rows = conn.execute(query, (*params, limit)).fetchall()
+    return [_row(r) for r in rows]
+
+
+def mark_cancelled(request_id: str) -> bool:
+    record = get(request_id)
+    if record is None or record['status'].is_terminal():
+        return False
+    set_status(request_id, RequestStatus.CANCELLED)
+    return True
+
+
+def _row(row) -> Dict[str, Any]:
+    return {
+        'request_id': row['request_id'],
+        'name': row['name'],
+        'status': RequestStatus(row['status']),
+        'payload': json.loads(row['payload_json'] or '{}'),
+        'result': (json.loads(row['result_json'])
+                   if row['result_json'] else None),
+        'error': row['error'],
+        'log_path': row['log_path'],
+        'user': row['user'],
+        'created_at': row['created_at'],
+        'finished_at': row['finished_at'],
+    }

@@ -1,0 +1,209 @@
+"""API server: aiohttp control plane (reference: sky/server/server.py:592).
+
+Endpoint set mirrors the reference's REST surface (:1056-1478): mutating
+ops enqueue an async request and return {'request_id'}; clients poll
+GET /api/get or stream GET /api/stream.  Log tailing of cluster jobs is
+proxied straight from the cluster's head agent (the reference tails over
+SSH and pipes through /api/stream the same way).
+
+Run: `python -m skypilot_tpu.server.server --port 46580`
+(or `skytpu api start`).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import executor as executor_lib
+from skypilot_tpu.server import requests_lib
+from skypilot_tpu.server.requests_lib import RequestStatus
+
+# Importing registers all @entrypoint handlers.
+from skypilot_tpu.server import entrypoints  # noqa: F401  pylint: disable=unused-import
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PORT = 46580
+API_VERSION = 1
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({'error': message}, status=status)
+
+
+def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
+             ) -> web.Application:
+    """Build the app.  pool=None -> inline execution (test mode, the
+    reference's TestClient trick)."""
+    app = web.Application()
+    routes = web.RouteTableDef()
+
+    def schedule(name: str, payload: dict) -> web.Response:
+        request_id = executor_lib.schedule_request(name, payload, pool=pool)
+        return web.json_response({'request_id': request_id}, status=202)
+
+    # --- async (request-queued) endpoints ---
+
+    for route_path, request_name in [
+            ('/launch', 'launch'), ('/exec', 'exec'),
+            ('/status', 'status'), ('/start', 'start'), ('/stop', 'stop'),
+            ('/down', 'down'), ('/autostop', 'autostop'),
+            ('/queue', 'queue'), ('/cancel', 'cancel'),
+            ('/optimize', 'optimize'), ('/check', 'check'),
+            ('/jobs/launch', 'jobs.launch'), ('/jobs/queue', 'jobs.queue'),
+            ('/jobs/cancel', 'jobs.cancel'),
+            ('/serve/up', 'serve.up'), ('/serve/update', 'serve.update'),
+            ('/serve/down', 'serve.down'),
+            ('/serve/status', 'serve.status'),
+    ]:
+        def _make(name):
+            async def handler(request: web.Request) -> web.Response:
+                try:
+                    payload = await request.json()
+                except json.JSONDecodeError:
+                    payload = {}
+                return schedule(name, payload)
+            return handler
+        app.router.add_post(route_path, _make(request_name))
+
+    # --- request management ---
+
+    @routes.get('/api/health')
+    async def health(request: web.Request) -> web.Response:
+        from skypilot_tpu import __version__
+        return web.json_response({'status': 'healthy',
+                                  'version': __version__,
+                                  'api_version': API_VERSION})
+
+    @routes.get('/api/get')
+    async def api_get(request: web.Request) -> web.Response:
+        request_id = request.query.get('request_id', '')
+        record = requests_lib.get(request_id)
+        if record is None:
+            return _json_error(404, f'No request {request_id!r}')
+        # Long-poll until terminal (reference /api/get blocks).
+        timeout = float(request.query.get('timeout', 300))
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not record['status'].is_terminal():
+            if asyncio.get_event_loop().time() > deadline:
+                break
+            await asyncio.sleep(0.2)
+            record = requests_lib.get(request_id)
+        return web.json_response({
+            'request_id': request_id,
+            'name': record['name'],
+            'status': record['status'].value,
+            'result': record['result'],
+            'error': record['error'],
+        })
+
+    @routes.get('/api/stream')
+    async def api_stream(request: web.Request) -> web.StreamResponse:
+        request_id = request.query.get('request_id', '')
+        record = requests_lib.get(request_id)
+        if record is None:
+            return _json_error(404, f'No request {request_id!r}')
+        resp = web.StreamResponse()
+        resp.content_type = 'text/plain'
+        await resp.prepare(request)
+        log_path = record['log_path']
+        pos = 0
+        while True:
+            if os.path.exists(log_path):
+                with open(log_path, 'r', encoding='utf-8') as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                if chunk:
+                    await resp.write(chunk.encode())
+            record = requests_lib.get(request_id)
+            if record['status'].is_terminal():
+                if record['error']:
+                    await resp.write(
+                        f'ERROR: {record["error"]}\n'.encode())
+                break
+            await asyncio.sleep(0.2)
+        await resp.write_eof()
+        return resp
+
+    @routes.get('/api/requests')
+    async def api_requests(request: web.Request) -> web.Response:
+        status_name = request.query.get('status')
+        status_filter = (RequestStatus(status_name)
+                         if status_name else None)
+        records = requests_lib.list_requests(status=status_filter)
+        return web.json_response([{
+            'request_id': r['request_id'], 'name': r['name'],
+            'status': r['status'].value, 'created_at': r['created_at'],
+        } for r in records])
+
+    @routes.post('/api/cancel')
+    async def api_cancel(request: web.Request) -> web.Response:
+        payload = await request.json()
+        ok = requests_lib.mark_cancelled(payload.get('request_id', ''))
+        return web.json_response({'cancelled': ok})
+
+    # --- direct (non-queued) endpoints ---
+
+    @routes.get('/logs')
+    async def logs(request: web.Request) -> web.StreamResponse:
+        """Tail a cluster job's logs, proxied from the head agent."""
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.agent.client import AgentClient
+        cluster_name = request.query.get('cluster_name', '')
+        job_id = request.query.get('job_id')
+        record = state_lib.get_cluster(cluster_name)
+        if record is None:
+            return _json_error(404, f'No cluster {cluster_name!r}')
+        follow = request.query.get('follow', '1') == '1'
+        resp = web.StreamResponse()
+        resp.content_type = 'text/plain'
+        await resp.prepare(request)
+        client = AgentClient(record['handle'].agent_url())
+        loop = asyncio.get_event_loop()
+        q: 'asyncio.Queue[Optional[str]]' = asyncio.Queue()
+
+        def _pull():
+            try:
+                for line in client.tail_logs(int(job_id) if job_id else None,
+                                             follow=follow):
+                    loop.call_soon_threadsafe(q.put_nowait, line)
+            finally:
+                loop.call_soon_threadsafe(q.put_nowait, None)
+
+        pull_task = loop.run_in_executor(None, _pull)
+        while True:
+            line = await q.get()
+            if line is None:
+                break
+            await resp.write(line.encode())
+        await pull_task
+        await resp.write_eof()
+        return resp
+
+    app.add_routes(routes)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    parser.add_argument('--short-workers', type=int, default=4)
+    parser.add_argument('--long-workers', type=int, default=4)
+    args = parser.parse_args()
+    pool = executor_lib.RequestWorkerPool(args.short_workers,
+                                          args.long_workers)
+    app = make_app(pool)
+    logger.info(f'API server on http://{args.host}:{args.port}')
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == '__main__':
+    main()

@@ -43,7 +43,8 @@ class Task:
                  secrets: Optional[Dict[str, str]] = None,
                  workdir: Optional[str] = None,
                  num_nodes: int = 1,
-                 file_mounts: Optional[Dict[str, Any]] = None):
+                 file_mounts: Optional[Dict[str, Any]] = None,
+                 volumes: Optional[Dict[str, str]] = None):
         self.name = name
         self.setup = setup
         self.run = run
@@ -54,6 +55,8 @@ class Task:
         self.num_nodes = int(num_nodes)
         # target path -> local path | storage dict
         self.file_mounts: Dict[str, Any] = dict(file_mounts or {})
+        # mount path -> volume name (reference: task-level volumes)
+        self.volumes: Dict[str, str] = dict(volumes or {})
         self.storage_mounts: Dict[str, Any] = {}
         self.service: Optional[Dict[str, Any]] = None
         self._resources: List[resources_lib.Resources] = [
@@ -196,6 +199,7 @@ class Task:
             workdir=config.get('workdir'),
             num_nodes=config.get('num_nodes', 1),
             file_mounts=config.get('file_mounts'),
+            volumes=config.get('volumes'),
         )
         res_config = config.get('resources')
         override_config = config.get('config')
@@ -238,6 +242,8 @@ class Task:
             cfg['secrets'] = dict(self._secrets)
         if self.file_mounts:
             cfg['file_mounts'] = dict(self.file_mounts)
+        if self.volumes:
+            cfg['volumes'] = dict(self.volumes)
         if self.service:
             cfg['service'] = self.service
         if self.config_overrides:

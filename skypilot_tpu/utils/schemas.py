@@ -99,6 +99,8 @@ TASK_SCHEMA: Dict[str, Any] = {
                             'anyOf': [{'type': 'string'}, _STORAGE_SCHEMA]}},
         'config': {'type': 'object'},
         'service': {'type': 'object'},
+        'volumes': {'type': 'object',
+                    'additionalProperties': {'type': 'string'}},
     },
 }
 
@@ -115,7 +117,9 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                      'path': {'type': 'string'},
                      'initial_delay_seconds': {'type': 'number'},
                      'timeout_seconds': {'type': 'number'},
+                     'readiness_timeout_seconds': {'type': 'number'},
                      'post_data': {'anyOf': [{'type': 'string'}, {'type': 'object'}]},
+                     'headers': {'type': 'object'},
                  }},
             ]
         },
@@ -130,10 +134,13 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'downscale_delay_seconds': {'type': 'number'},
                 'dynamic_ondemand_fallback': {'type': 'boolean'},
                 'base_ondemand_fallback_replicas': {'type': 'integer'},
+                'num_overprovision': {'type': 'integer'},
+                'spot_placer': {'type': 'string'},
             },
         },
         'replicas': {'type': 'integer', 'minimum': 1},
         'load_balancing_policy': {'type': 'string'},
+        'ports': {'type': 'integer'},
     },
     'required': ['readiness_probe'],
 }

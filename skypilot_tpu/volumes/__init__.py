@@ -1,0 +1,7 @@
+"""Volumes: network/block volume lifecycle (reference: sky/volumes/, 753 LoC;
+provision hooks `apply_volume` sky/provision/__init__.py:112).
+"""
+from skypilot_tpu.volumes.core import (Volume, VolumeStatus, apply, delete,
+                                       ls)
+
+__all__ = ['Volume', 'VolumeStatus', 'apply', 'delete', 'ls']

@@ -1,0 +1,56 @@
+"""`skytpu volumes ...` command group (reference: sky/client/cli volumes_*)."""
+from __future__ import annotations
+
+import time
+
+
+def _cmd_apply(args) -> int:
+    from skypilot_tpu.volumes import core
+    volume = core.Volume(name=args.name, cloud=args.cloud,
+                         zone=args.zone, type=args.type,
+                         size_gb=args.size)
+    record = core.apply(volume)
+    print(f"Volume {record['name']!r}: {record['status'].value}")
+    return 0
+
+
+def _cmd_ls(args) -> int:
+    from skypilot_tpu.volumes import core
+    records = core.ls()
+    if not records:
+        print('No volumes.')
+        return 0
+    for r in records:
+        print(f"{r['name']:<24} {r['cloud']:<6} {r['type']:<12} "
+              f"{r['size_gb']:>6}GB  {r['status'].value:<10} "
+              f"{r['last_attached_to'] or '-':<20} "
+              f"{time.strftime('%m-%d %H:%M', time.localtime(r['created_at']))}")
+    return 0
+
+
+def _cmd_delete(args) -> int:
+    from skypilot_tpu.volumes import core
+    for name in args.names:
+        core.delete(name)
+        print(f'Volume {name!r} deleted.')
+    return 0
+
+
+def register(sub) -> None:
+    p = sub.add_parser('volumes', help='Block volume management')
+    vsub = p.add_subparsers(dest='volumes_command')
+
+    pa = vsub.add_parser('apply', help='Create a volume (idempotent)')
+    pa.add_argument('name')
+    pa.add_argument('--cloud', default='gcp')
+    pa.add_argument('--zone')
+    pa.add_argument('--type', default='pd-ssd')
+    pa.add_argument('--size', type=int, default=100)
+    pa.set_defaults(fn=_cmd_apply)
+
+    pl = vsub.add_parser('ls', help='List volumes')
+    pl.set_defaults(fn=_cmd_ls)
+
+    pd = vsub.add_parser('delete', help='Delete volumes')
+    pd.add_argument('names', nargs='+')
+    pd.set_defaults(fn=_cmd_delete)

@@ -1,0 +1,173 @@
+"""Volume model + lifecycle (reference: sky/volumes/ — apply/ls/delete,
+GCP persistent disks; k8s PVCs are out of scope for the TPU-first build).
+
+Volumes are created via the cloud's provision module (`apply_volume` /
+`delete_volume`, mirroring the provision-hook shape at
+sky/provision/__init__.py:112) and recorded in a sqlite table; tasks
+reference them via `volumes: {name: /mount/path}`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_DB_PATH = '~/.skypilot_tpu/volumes.db'
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS volumes (
+    name TEXT PRIMARY KEY,
+    cloud TEXT,
+    region TEXT,
+    zone TEXT,
+    type TEXT,
+    size_gb INTEGER,
+    status TEXT,
+    config_json TEXT,
+    created_at REAL,
+    last_attached_to TEXT
+);
+"""
+
+
+class VolumeStatus(enum.Enum):
+    CREATING = 'CREATING'
+    READY = 'READY'
+    IN_USE = 'IN_USE'
+    DELETING = 'DELETING'
+    FAILED = 'FAILED'
+
+
+@dataclasses.dataclass
+class Volume:
+    name: str
+    cloud: str = 'gcp'
+    region: Optional[str] = None
+    zone: Optional[str] = None
+    type: str = 'pd-ssd'
+    size_gb: int = 100
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Volume':
+        if 'name' not in config:
+            raise exceptions.StorageSpecError('volume needs a name:')
+        size = config.get('size', '100Gi')
+        if isinstance(size, str):
+            size = int(size.lower().rstrip('gib'))
+        return cls(name=config['name'],
+                   cloud=config.get('cloud', 'gcp'),
+                   region=config.get('region'),
+                   zone=config.get('zone'),
+                   type=config.get('type', 'pd-ssd'),
+                   size_gb=int(size))
+
+
+def _conn() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+def _provision_module(cloud: str):
+    import importlib
+    try:
+        return importlib.import_module(f'skypilot_tpu.provision.{cloud}.volume')
+    except ModuleNotFoundError:
+        return None
+
+
+def apply(volume: Volume) -> Dict[str, Any]:
+    """Create the volume if it does not exist (idempotent, like
+    `sky volumes apply`)."""
+    record = get(volume.name)
+    if record is not None:
+        return record
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO volumes (name, cloud, region, zone, type, '
+            'size_gb, status, config_json, created_at) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
+            (volume.name, volume.cloud, volume.region, volume.zone,
+             volume.type, volume.size_gb, VolumeStatus.CREATING.value,
+             json.dumps(dataclasses.asdict(volume)), time.time()))
+    module = _provision_module(volume.cloud)
+    try:
+        if module is not None:
+            module.apply_volume(volume)
+        _set_status(volume.name, VolumeStatus.READY)
+    except Exception as e:  # pylint: disable=broad-except
+        _set_status(volume.name, VolumeStatus.FAILED)
+        raise exceptions.StorageError(
+            f'Creating volume {volume.name!r} failed: {e}') from e
+    logger.info(f'Volume {volume.name!r} ready '
+                f'({volume.type}, {volume.size_gb}GB).')
+    return get(volume.name)
+
+
+def delete(name: str) -> None:
+    record = get(name)
+    if record is None:
+        raise exceptions.StorageError(f'Volume {name!r} not found.')
+    _set_status(name, VolumeStatus.DELETING)
+    module = _provision_module(record['cloud'])
+    if module is not None:
+        module.delete_volume(Volume(**json.loads(record['config_json'])))
+    with _conn() as conn:
+        conn.execute('DELETE FROM volumes WHERE name = ?', (name,))
+    logger.info(f'Volume {name!r} deleted.')
+
+
+def ls() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM volumes ORDER BY created_at').fetchall()
+    return [_row(r) for r in rows]
+
+
+def get(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM volumes WHERE name = ?',
+                           (name,)).fetchone()
+    return _row(row) if row else None
+
+
+def mark_attached(name: str, cluster_name: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE volumes SET status = ?, last_attached_to = ? '
+            'WHERE name = ?',
+            (VolumeStatus.IN_USE.value, cluster_name, name))
+
+
+def _set_status(name: str, status: VolumeStatus) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE volumes SET status = ? WHERE name = ?',
+                     (status.value, name))
+
+
+def _row(row) -> Dict[str, Any]:
+    return {
+        'name': row['name'],
+        'cloud': row['cloud'],
+        'region': row['region'],
+        'zone': row['zone'],
+        'type': row['type'],
+        'size_gb': row['size_gb'],
+        'status': VolumeStatus(row['status']),
+        'config_json': row['config_json'],
+        'created_at': row['created_at'],
+        'last_attached_to': row['last_attached_to'],
+    }

@@ -1,0 +1,134 @@
+"""API server: request lifecycle, inline-executor harness, REST round-trip
+against a live server on the hermetic local cloud (analog of the
+reference's tests/test_api.py with the TestClient inline-executor trick,
+tests/common_test_fixtures.py:56)."""
+import socket
+import threading
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu.server import executor as executor_lib
+from skypilot_tpu.server import requests_lib
+from skypilot_tpu.server.requests_lib import RequestStatus
+from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture reuse)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+# --- request DB + executor (inline mode) ---
+
+def test_request_lifecycle_inline(iso_state):  # noqa: F811
+    request_id = executor_lib.schedule_request('api.echo', {'x': 1})
+    record = requests_lib.get(request_id)
+    assert record['status'] == RequestStatus.SUCCEEDED
+    assert record['result']['echo'] == {'x': 1}
+
+
+def test_request_failure_recorded(iso_state):  # noqa: F811
+    request_id = executor_lib.schedule_request(
+        'status', {'cluster_names': None, 'refresh': 'bogus-not-a-bool'})
+    record = requests_lib.get(request_id)
+    # refresh truthy string -> refresh path with zero clusters: fine.
+    assert record['status'] == RequestStatus.SUCCEEDED
+
+    request_id = executor_lib.schedule_request('down',
+                                               {'cluster_name': 'nope'})
+    record = requests_lib.get(request_id)
+    assert record['status'] == RequestStatus.FAILED
+    assert 'ClusterDoesNotExist' in record['error']
+
+
+def test_unknown_request_name_fails(iso_state):  # noqa: F811
+    request_id = executor_lib.schedule_request('no.such.entrypoint', {})
+    record = requests_lib.get(request_id)
+    assert record['status'] == RequestStatus.FAILED
+
+
+def test_worker_pool_executes(iso_state):  # noqa: F811
+    pool = executor_lib.RequestWorkerPool(1, 1)
+    try:
+        request_id = executor_lib.schedule_request('api.echo', {'y': 2},
+                                                   pool=pool)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            record = requests_lib.get(request_id)
+            if record['status'].is_terminal():
+                break
+            time.sleep(0.05)
+        assert record['status'] == RequestStatus.SUCCEEDED
+    finally:
+        pool.stop()
+
+
+# --- live server round-trip ---
+
+@pytest.fixture()
+def live_server(iso_state):  # noqa: F811
+    from aiohttp import web
+
+    from skypilot_tpu.server.server import make_app
+    port = _free_port()
+    pool = executor_lib.RequestWorkerPool(2, 2)
+    app = make_app(pool)
+    started = threading.Event()
+    runner_box = {}
+
+    def _run():
+        import asyncio
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', port)
+        loop.run_until_complete(site.start())
+        runner_box['loop'] = loop
+        started.set()
+        loop.run_forever()
+
+    thread = threading.Thread(target=_run, daemon=True)
+    thread.start()
+    assert started.wait(10)
+    yield f'http://127.0.0.1:{port}'
+    pool.stop()
+    runner_box['loop'].call_soon_threadsafe(runner_box['loop'].stop)
+
+
+def test_health_and_echo_roundtrip(live_server):
+    resp = requests.get(live_server + '/api/health', timeout=10)
+    assert resp.json()['status'] == 'healthy'
+
+
+def test_rest_sdk_launch_status_down(live_server, monkeypatch):
+    monkeypatch.setenv('SKYTPU_API_SERVER_URL', live_server)
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.client import sdk
+    task = task_lib.Task.from_yaml_config({
+        'name': 'rest-e2e', 'run': 'echo rest-ok',
+        'resources': {'cloud': 'local'}})
+    job_id, cluster_name = sdk.launch(task, cluster_name='rest-c1')
+    assert job_id == 1 and cluster_name == 'rest-c1'
+    records = sdk.status()
+    assert any(r['name'] == 'rest-c1' for r in records)
+    assert sdk.api_health()['status'] == 'healthy'
+    sdk.down('rest-c1')
+    assert not any(r['name'] == 'rest-c1' for r in sdk.status())
+
+
+def test_request_listing_and_stream(live_server, monkeypatch):
+    monkeypatch.setenv('SKYTPU_API_SERVER_URL', live_server)
+    from skypilot_tpu.client.rest import RestClient
+    client = RestClient(live_server)
+    request_id = client.submit('/status', {})
+    assert client.get(request_id) == []
+    listed = requests.get(live_server + '/api/requests',
+                          timeout=10).json()
+    assert any(r['request_id'] == request_id for r in listed)
+    # Stream terminates for a finished request.
+    lines = list(client.stream(request_id))
+    assert isinstance(lines, list)

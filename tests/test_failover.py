@@ -21,7 +21,7 @@ def fake_gcp(monkeypatch, tmp_home):
 
     monkeypatch.setattr(gcp_instance, '_client_factory', factory)
     monkeypatch.setattr(provisioner, '_setup_runtime',
-                        lambda info, port: None)
+                        lambda info, port, cluster_name: port)
     config_lib.set_nested(('gcp', 'project_id'), 'test-proj')
     yield holder
 

@@ -1,0 +1,276 @@
+"""Serve: spec parsing, autoscalers, LB policies, spot placer, and an
+end-to-end service on the hermetic local cloud (analog of the reference's
+tests/test_jobs_and_serve.py + smoke test_sky_serve.py)."""
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers as asc
+from skypilot_tpu.serve import load_balancing_policies as lbp
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import spot_placer as spl
+from skypilot_tpu.serve.controller import ServeController
+from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture reuse)
+
+
+# --- spec ---
+
+def test_spec_parse_roundtrip():
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/health',
+                            'initial_delay_seconds': 30},
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                           'target_qps_per_replica': 10},
+        'ports': 9000,
+    })
+    assert spec.autoscaling_enabled
+    spec2 = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert spec == spec2
+
+
+def test_spec_shorthand_and_validation():
+    spec = ServiceSpec.from_yaml_config({'replicas': 2,
+                                         'readiness_probe': '/'})
+    assert spec.min_replicas == 2 and not spec.autoscaling_enabled
+    with pytest.raises(exceptions.InvalidServiceSpecError):
+        ServiceSpec(readiness_path='no-slash')
+    with pytest.raises(exceptions.InvalidServiceSpecError):
+        ServiceSpec(min_replicas=3, max_replicas=1)
+    with pytest.raises(exceptions.InvalidServiceSpecError):
+        ServiceSpec(target_qps_per_replica=1.0)  # needs max_replicas
+    with pytest.raises(exceptions.InvalidServiceSpecError):
+        ServiceSpec(min_replicas=1, max_replicas=2)  # needs target_qps
+    with pytest.raises(exceptions.InvalidServiceSpecError):
+        ServiceSpec(load_balancing_policy='nope')
+
+
+# --- autoscalers ---
+
+def _fake_replicas(n_ready, n_other=0, status=ReplicaStatus.STARTING,
+                   is_spot=False):
+    out = []
+    for i in range(n_ready):
+        out.append({'replica_id': i + 1, 'status': ReplicaStatus.READY,
+                    'launched_at': time.time(), 'is_spot': is_spot})
+    for i in range(n_other):
+        out.append({'replica_id': n_ready + i + 1, 'status': status,
+                    'launched_at': time.time(), 'is_spot': is_spot})
+    return out
+
+
+def _rate_spec(**kw):
+    base = dict(min_replicas=1, max_replicas=4, target_qps_per_replica=1.0,
+                upscale_delay_seconds=40, downscale_delay_seconds=40)
+    base.update(kw)
+    return ServiceSpec(**base)
+
+
+def test_fixed_autoscaler_holds_target():
+    a = asc.Autoscaler.from_spec('svc', ServiceSpec(min_replicas=2))
+    assert isinstance(a, asc.FixedSizeAutoscaler)
+    ups = a.generate_scaling_decisions([])
+    assert len(ups) == 2
+    assert all(d.operator == asc.AutoscalerDecisionOperator.SCALE_UP
+               for d in ups)
+    assert a.generate_scaling_decisions(_fake_replicas(2)) == []
+    downs = a.generate_scaling_decisions(_fake_replicas(3))
+    assert [d.operator for d in downs] == \
+        [asc.AutoscalerDecisionOperator.SCALE_DOWN]
+
+
+def test_request_rate_autoscaler_hysteresis():
+    a = asc.RequestRateAutoscaler('svc', _rate_spec())
+    # threshold = 40s / 20s interval = 2 consecutive over-target passes.
+    assert a.scale_up_threshold == 2
+    now = time.time()
+    a.collect_request_information(
+        {'timestamps': [now - i * 0.2 for i in range(180)]})  # 3 qps
+    a.generate_scaling_decisions(_fake_replicas(1))
+    assert a.target_num_replicas == 1  # one pass: not yet
+    decisions = a.generate_scaling_decisions(_fake_replicas(1))
+    assert a.target_num_replicas == 3  # ceil(3 qps / 1 qps-per-replica)
+    assert len(decisions) == 2
+    # Idle long enough -> downscale after 2 passes.
+    a.request_timestamps.clear()
+    a.generate_scaling_decisions(_fake_replicas(3))
+    decisions = a.generate_scaling_decisions(_fake_replicas(3))
+    assert a.target_num_replicas == 1
+    assert len(decisions) == 2
+
+
+def test_autoscaler_scale_down_prefers_least_useful():
+    replicas = [
+        {'replica_id': 1, 'status': ReplicaStatus.READY,
+         'launched_at': 1.0, 'is_spot': False},
+        {'replica_id': 2, 'status': ReplicaStatus.PROVISIONING,
+         'launched_at': 2.0, 'is_spot': False},
+        {'replica_id': 3, 'status': ReplicaStatus.NOT_READY,
+         'launched_at': 3.0, 'is_spot': False},
+    ]
+    victims = asc.select_replicas_to_scale_down(replicas, 2)
+    assert victims == [2, 3]  # provisioning first, then not-ready
+
+
+def test_fallback_autoscaler_spot_with_ondemand_base():
+    spec = ServiceSpec(min_replicas=3, base_ondemand_fallback_replicas=1,
+                       spot_placer='dynamic_fallback')
+    a = asc.Autoscaler.from_spec('svc', spec)
+    assert isinstance(a, asc.FallbackRequestRateAutoscaler)
+    decisions = a.generate_scaling_decisions([])
+    spot_ups = [d for d in decisions if d.target.get('use_spot')]
+    od_ups = [d for d in decisions if d.target.get('use_spot') is False]
+    assert len(spot_ups) == 2 and len(od_ups) == 1
+
+
+def test_fallback_autoscaler_dynamic_cover():
+    spec = ServiceSpec(min_replicas=2, dynamic_ondemand_fallback=True)
+    a = asc.Autoscaler.from_spec('svc', spec)
+    # No spot ready yet -> 2 spot + 2 dynamic on-demand cover.
+    decisions = a.generate_scaling_decisions([])
+    assert sum(1 for d in decisions if d.target.get('use_spot')) == 2
+    assert sum(1 for d in decisions if not d.target.get('use_spot')) == 2
+    # Both spot READY -> the on-demand cover is drained.
+    replicas = _fake_replicas(2, is_spot=True) + \
+        _fake_replicas(2, is_spot=False)
+    decisions = a.generate_scaling_decisions(replicas)
+    assert all(d.operator == asc.AutoscalerDecisionOperator.SCALE_DOWN
+               for d in decisions)
+    assert len(decisions) == 2
+
+
+# --- LB policies ---
+
+def test_round_robin_policy_cycles():
+    p = lbp.LoadBalancingPolicy.make('round_robin')
+    p.set_ready_replicas(['a', 'b', 'c'])
+    picks = [p.select_replica() for _ in range(6)]
+    assert sorted(picks[:3]) == ['a', 'b', 'c']
+    assert picks[:3] == picks[3:]
+
+
+def test_least_load_policy_tracks_inflight():
+    p = lbp.LoadBalancingPolicy.make()  # default = least_load
+    assert isinstance(p, lbp.LeastLoadPolicy)
+    p.set_ready_replicas(['a', 'b'])
+    first = p.select_replica()
+    p.pre_execute_hook(first)
+    assert p.select_replica() != first
+    p.post_execute_hook(first)
+
+
+# --- spot placer ---
+
+def test_dynamic_fallback_spot_placer():
+    locs = [spl.Location('gcp', 'us-central1', f'us-central1-{z}')
+            for z in 'abc']
+    placer = spl.DynamicFallbackSpotPlacer(locs)
+    first = placer.select_next_location([])
+    placer.set_preempted(first)
+    nxt = placer.select_next_location([])
+    assert nxt != first
+    # All preempted -> hedge resets and still returns something.
+    for loc in locs:
+        placer.set_preempted(loc)
+    assert placer.select_next_location([]) in locs
+
+
+def test_spot_placer_balances_across_locations():
+    locs = [spl.Location('gcp', 'us-central1', 'a'),
+            spl.Location('gcp', 'us-central1', 'b')]
+    placer = spl.DynamicFallbackSpotPlacer(locs)
+    current = [locs[0]]
+    assert placer.select_next_location(current) == locs[1]
+
+
+# --- end-to-end on the local cloud ---
+
+SERVICE_RUN = ('python3 -c "'
+               "import http.server,os;"
+               "http.server.HTTPServer(('127.0.0.1',"
+               "int(os.environ['SKYPILOT_SERVE_PORT'])),"
+               'http.server.SimpleHTTPRequestHandler).serve_forever()"')
+
+
+def _service_task(min_replicas=1, port=8123):
+    return task_lib.Task.from_yaml_config({
+        'name': 'e2e-svc',
+        'run': SERVICE_RUN,
+        'resources': {'cloud': 'local'},
+        'service': {
+            'readiness_probe': {'path': '/', 'initial_delay_seconds': 60},
+            'replica_policy': {'min_replicas': min_replicas},
+            'ports': port,
+        },
+    })
+
+
+@pytest.fixture()
+def service(iso_state):  # noqa: F811
+    from skypilot_tpu.serve import core as serve_core
+    task = _service_task()
+    serve_state.add_service('e2e-svc',
+                            ServiceSpec.from_yaml_config(
+                                task.service).to_yaml_config(),
+                            task.to_yaml_config())
+    controller = ServeController('e2e-svc', probe_interval=0.5)
+    yield controller
+    controller.stop()
+    controller.manager.terminate_all()
+    serve_core  # keep import
+
+
+def _wait_ready(controller, n=1, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        controller.step()
+        if len(controller.manager.ready_urls()) >= n:
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def test_service_end_to_end(service):
+    controller = service
+    assert _wait_ready(controller), \
+        serve_state.get_replicas('e2e-svc')
+    record = serve_state.get_service('e2e-svc')
+    assert record['status'] == ServiceStatus.READY
+    # Load balancer proxies to the ready replica.
+    lb = SkyServeLoadBalancer(controller, port=18931, sync_interval=60)
+    lb.start()
+    lb.sync_once()
+    try:
+        resp = requests.get('http://127.0.0.1:18931/', timeout=10)
+        assert resp.status_code == 200
+    finally:
+        lb.stop()
+
+
+def test_service_replica_failure_recovery(service, monkeypatch):
+    controller = service
+    assert _wait_ready(controller)
+    # Kill the replica out from under the service (preemption analog).
+    from skypilot_tpu.provision.local import instance as local_instance
+    from skypilot_tpu.serve import replica_managers as rm
+    monkeypatch.setattr(rm, 'PROBE_FAILURE_THRESHOLD', 1)
+    [rec] = [r for r in serve_state.get_replicas('e2e-svc')
+             if r['status'] == ReplicaStatus.READY]
+    local_instance.simulate_preemption(rec['cluster_name'])
+    deadline = time.time() + 120
+    recovered = False
+    while time.time() < deadline:
+        controller.step()
+        fresh = [r for r in serve_state.get_replicas('e2e-svc')
+                 if r['status'] == ReplicaStatus.READY
+                 and r['replica_id'] != rec['replica_id']]
+        if fresh:
+            recovered = True
+            break
+        time.sleep(0.5)
+    assert recovered, serve_state.get_replicas('e2e-svc')
