@@ -1,0 +1,174 @@
+#include "common.hpp"
+
+#include <cerrno>
+#include <cstdlib>
+#include <cstring>
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace fuse_proxy {
+
+namespace {
+
+int WriteAll(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = write(fd, p, len);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+int ReadAll(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = read(fd, p, len);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      if (n == 0) errno = ECONNRESET;
+      return -1;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+int WriteString(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  if (WriteAll(fd, &len, sizeof(len)) < 0) return -1;
+  return WriteAll(fd, s.data(), s.size());
+}
+
+int ReadString(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (ReadAll(fd, &len, sizeof(len)) < 0) return -1;
+  if (len > (1u << 20)) {  // sanity: 1 MiB cap on any field
+    errno = EMSGSIZE;
+    return -1;
+  }
+  out->resize(len);
+  if (len > 0 && ReadAll(fd, &(*out)[0], len) < 0) return -1;
+  return 0;
+}
+
+// Send one byte with an optional fd as SCM_RIGHTS ancillary data.
+int SendFdMsg(int sock, int fd_to_pass) {
+  char byte = fd_to_pass >= 0 ? 1 : 0;
+  struct iovec iov = {&byte, 1};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(struct cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  if (fd_to_pass >= 0) {
+    msg.msg_control = cbuf;
+    msg.msg_controllen = sizeof(cbuf);
+    struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+    cmsg->cmsg_level = SOL_SOCKET;
+    cmsg->cmsg_type = SCM_RIGHTS;
+    cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+    memcpy(CMSG_DATA(cmsg), &fd_to_pass, sizeof(int));
+  }
+  ssize_t n;
+  do {
+    n = sendmsg(sock, &msg, 0);
+  } while (n < 0 && errno == EINTR);
+  return n < 0 ? -1 : 0;
+}
+
+int RecvFdMsg(int sock, int* fd_out) {
+  char byte = 0;
+  struct iovec iov = {&byte, 1};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(struct cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  ssize_t n;
+  do {
+    n = recvmsg(sock, &msg, 0);
+  } while (n < 0 && errno == EINTR);
+  if (n <= 0) {
+    if (n == 0) errno = ECONNRESET;
+    return -1;
+  }
+  *fd_out = -1;
+  if (byte == 1) {
+    for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+         cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+      if (cmsg->cmsg_level == SOL_SOCKET &&
+          cmsg->cmsg_type == SCM_RIGHTS) {
+        memcpy(fd_out, CMSG_DATA(cmsg), sizeof(int));
+        break;
+      }
+    }
+    if (*fd_out < 0) {
+      errno = EPROTO;  // sender promised an fd but none arrived
+      return -1;
+    }
+  }
+  return 0;
+}
+
+int WriteStringVec(int sock, const std::vector<std::string>& vec) {
+  uint32_t count = static_cast<uint32_t>(vec.size());
+  if (WriteAll(sock, &count, sizeof(count)) < 0) return -1;
+  for (const auto& s : vec) {
+    if (WriteString(sock, s) < 0) return -1;
+  }
+  return 0;
+}
+
+int ReadStringVec(int sock, std::vector<std::string>* vec) {
+  uint32_t count = 0;
+  if (ReadAll(sock, &count, sizeof(count)) < 0) return -1;
+  if (count > 1024) {
+    errno = EMSGSIZE;
+    return -1;
+  }
+  vec->resize(count);
+  for (auto& s : *vec) {
+    if (ReadString(sock, &s) < 0) return -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int SendRequest(int sock, const Request& req) {
+  if (WriteStringVec(sock, req.args) < 0) return -1;
+  if (WriteStringVec(sock, req.envs) < 0) return -1;
+  return SendFdMsg(sock, req.comm_fd);
+}
+
+int RecvRequest(int sock, Request* req) {
+  if (ReadStringVec(sock, &req->args) < 0) return -1;
+  if (ReadStringVec(sock, &req->envs) < 0) return -1;
+  return RecvFdMsg(sock, &req->comm_fd);
+}
+
+int SendReply(int sock, const Reply& reply) {
+  if (WriteAll(sock, &reply.exit_status, sizeof(reply.exit_status)) < 0)
+    return -1;
+  return WriteString(sock, reply.err_output);
+}
+
+int RecvReply(int sock, Reply* reply) {
+  if (ReadAll(sock, &reply->exit_status, sizeof(reply->exit_status)) < 0)
+    return -1;
+  return ReadString(sock, &reply->err_output);
+}
+
+std::string SocketPath() {
+  const char* env = getenv(kSocketEnv);
+  return env != nullptr && env[0] != '\0' ? env : kDefaultSocketPath;
+}
+
+}  // namespace fuse_proxy

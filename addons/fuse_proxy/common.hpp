@@ -1,0 +1,53 @@
+// fuse-proxy wire protocol shared by shim and server.
+//
+// C++ equivalent of the reference's Go fuse-proxy
+// (addons/fuse-proxy/pkg/common — README.md:1-13 architecture): an
+// unprivileged container masks `fusermount` with the shim, which forwards
+// the call over a unix domain socket (shared host dir) to a privileged
+// per-node server that runs the real fusermount.  The FUSE _FUSE_COMMFD
+// file descriptor rides the socket via SCM_RIGHTS, so the unprivileged
+// libfuse still receives the /dev/fuse fd directly from the privileged
+// mount.
+//
+// Message (shim -> server):
+//   u32 argc | argc x (u32 len, bytes) | u32 n_env | n_env x (u32, bytes)
+//   ancillary: 0 or 1 fd (the shim's _FUSE_COMMFD socket)
+// Reply (server -> shim):
+//   u32 exit_status | u32 stderr_len | stderr bytes
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace fuse_proxy {
+
+constexpr const char* kDefaultSocketPath =
+    "/var/run/fusermount/fuse-proxy.sock";
+constexpr const char* kSocketEnv = "FUSE_PROXY_SOCKET";
+constexpr const char* kRealFusermountEnv = "FUSE_PROXY_REAL_FUSERMOUNT";
+constexpr const char* kCommFdEnv = "_FUSE_COMMFD";
+
+// Serialized request: fusermount argv (excluding argv[0]) plus the env
+// vars the real fusermount needs.
+struct Request {
+  std::vector<std::string> args;
+  std::vector<std::string> envs;  // "KEY=VALUE" entries to forward
+  int comm_fd = -1;               // -1 when no _FUSE_COMMFD present
+};
+
+struct Reply {
+  uint32_t exit_status = 0;
+  std::string err_output;
+};
+
+// All return 0 on success, -1 on error (errno set).
+int SendRequest(int sock, const Request& req);
+int RecvRequest(int sock, Request* req);  // received fd -> req->comm_fd
+int SendReply(int sock, const Reply& reply);
+int RecvReply(int sock, Reply* reply);
+
+// Socket path from env or default.
+std::string SocketPath();
+
+}  // namespace fuse_proxy

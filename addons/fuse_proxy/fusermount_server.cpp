@@ -1,0 +1,167 @@
+// fusermount-server: privileged per-node daemon executing real fusermount
+// calls forwarded by unprivileged shims.
+//
+// C++ equivalent of the reference's Go server
+// (addons/fuse-proxy/cmd/fusermount-server/main.go + pkg/server): accepts
+// connections on a unix socket in a host-shared directory, receives
+// (argv, env, _FUSE_COMMFD fd), runs the real fusermount with the
+// forwarded fd so the /dev/fuse descriptor flows straight back to the
+// container's libfuse, and returns (exit status, stderr).
+//
+// Usage: fusermount-server [--socket PATH] [--fusermount PATH]
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace {
+
+std::string g_real_fusermount = "/usr/bin/fusermount3";
+
+// Run the real fusermount for one request; fills the reply.
+void HandleRequest(const fuse_proxy::Request& req,
+                   fuse_proxy::Reply* reply) {
+  int err_pipe[2];
+  if (pipe(err_pipe) < 0) {
+    reply->exit_status = 1;
+    reply->err_output = std::string("server: pipe: ") + strerror(errno);
+    return;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(err_pipe[0]);
+    close(err_pipe[1]);
+    reply->exit_status = 1;
+    reply->err_output = std::string("server: fork: ") + strerror(errno);
+    return;
+  }
+  if (pid == 0) {
+    // Child: exec the real fusermount with the forwarded comm fd.
+    close(err_pipe[0]);
+    dup2(err_pipe[1], STDERR_FILENO);
+    close(err_pipe[1]);
+    std::vector<char*> argv;
+    argv.push_back(const_cast<char*>(g_real_fusermount.c_str()));
+    for (const auto& a : req.args) {
+      argv.push_back(const_cast<char*>(a.c_str()));
+    }
+    argv.push_back(nullptr);
+    for (const auto& kv : req.envs) {
+      putenv(const_cast<char*>(kv.c_str()));
+    }
+    if (req.comm_fd >= 0) {
+      // Re-expose the forwarded socket under a stable fd number.
+      char buf[16];
+      snprintf(buf, sizeof(buf), "%d", req.comm_fd);
+      setenv(fuse_proxy::kCommFdEnv, buf, 1);
+      // Clear close-on-exec so the fd survives into fusermount.
+      int flags = fcntl(req.comm_fd, F_GETFD);
+      if (flags >= 0) fcntl(req.comm_fd, F_SETFD, flags & ~FD_CLOEXEC);
+    } else {
+      unsetenv(fuse_proxy::kCommFdEnv);
+    }
+    execv(g_real_fusermount.c_str(), argv.data());
+    fprintf(stderr, "server: exec %s: %s\n", g_real_fusermount.c_str(),
+            strerror(errno));
+    _exit(127);
+  }
+  // Parent: collect stderr + status.
+  close(err_pipe[1]);
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(err_pipe[0], buf, sizeof(buf))) > 0) {
+    reply->err_output.append(buf, static_cast<size_t>(n));
+  }
+  close(err_pipe[0]);
+  int status = 0;
+  while (waitpid(pid, &status, 0) < 0 && errno == EINTR) {
+  }
+  if (WIFEXITED(status)) {
+    reply->exit_status = static_cast<uint32_t>(WEXITSTATUS(status));
+  } else {
+    reply->exit_status = 128u + static_cast<uint32_t>(WTERMSIG(status));
+  }
+}
+
+void ServeConnection(int conn) {
+  fuse_proxy::Request req;
+  if (fuse_proxy::RecvRequest(conn, &req) < 0) {
+    close(conn);
+    return;
+  }
+  fuse_proxy::Reply reply;
+  HandleRequest(req, &reply);
+  if (req.comm_fd >= 0) close(req.comm_fd);
+  fuse_proxy::SendReply(conn, reply);
+  close(conn);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path = fuse_proxy::SocketPath();
+  const char* real = getenv(fuse_proxy::kRealFusermountEnv);
+  if (real != nullptr && real[0] != '\0') g_real_fusermount = real;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "--socket") == 0) socket_path = argv[i + 1];
+    if (strcmp(argv[i], "--fusermount") == 0) g_real_fusermount = argv[i + 1];
+  }
+  signal(SIGCHLD, SIG_DFL);
+  signal(SIGPIPE, SIG_IGN);
+
+  unlink(socket_path.c_str());
+  int sock = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sock < 0) {
+    perror("socket");
+    return 1;
+  }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  if (socket_path.size() >= sizeof(addr.sun_path)) {
+    fprintf(stderr, "socket path too long: %s\n", socket_path.c_str());
+    return 1;
+  }
+  strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (bind(sock, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) < 0 ||
+      listen(sock, 64) < 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  chmod(socket_path.c_str(), 0666);  // shims run as arbitrary uids
+  fprintf(stderr, "fusermount-server: listening on %s (fusermount: %s)\n",
+          socket_path.c_str(), g_real_fusermount.c_str());
+
+  for (;;) {
+    int conn = accept(sock, nullptr, nullptr);
+    if (conn < 0) {
+      if (errno == EINTR) continue;
+      perror("accept");
+      return 1;
+    }
+    // One fork per connection: mounts are rare and isolation is simpler
+    // to reason about than a thread pool here.
+    pid_t pid = fork();
+    if (pid == 0) {
+      close(sock);
+      ServeConnection(conn);
+      _exit(0);
+    }
+    close(conn);
+    // Reap any finished children without blocking.
+    while (waitpid(-1, nullptr, WNOHANG) > 0) {
+    }
+  }
+}

@@ -1,0 +1,74 @@
+// fusermount-shim: masks `fusermount` inside unprivileged containers.
+//
+// C++ equivalent of the reference's Go shim
+// (addons/fuse-proxy/cmd/fusermount-shim/main.go): forwards argv, the
+// FUSE _FUSE_COMMFD descriptor, and relevant env to the privileged
+// fusermount-server over a unix socket, then relays the server's exit
+// status and stderr so libfuse can't tell the difference.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace {
+
+int ConnectServer(const std::string& path) {
+  int sock = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sock < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  if (path.size() >= sizeof(addr.sun_path)) {
+    close(sock);
+    errno = ENAMETOOLONG;
+    return -1;
+  }
+  strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(sock, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) < 0) {
+    close(sock);
+    return -1;
+  }
+  return sock;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  fuse_proxy::Request req;
+  for (int i = 1; i < argc; ++i) {
+    req.args.emplace_back(argv[i]);
+  }
+  const char* commfd = getenv(fuse_proxy::kCommFdEnv);
+  if (commfd != nullptr) {
+    req.comm_fd = atoi(commfd);
+  }
+
+  std::string path = fuse_proxy::SocketPath();
+  int sock = ConnectServer(path);
+  if (sock < 0) {
+    fprintf(stderr, "fusermount-shim: cannot connect to %s: %s\n",
+            path.c_str(), strerror(errno));
+    return 1;
+  }
+  if (fuse_proxy::SendRequest(sock, req) < 0) {
+    fprintf(stderr, "fusermount-shim: send failed: %s\n", strerror(errno));
+    close(sock);
+    return 1;
+  }
+  fuse_proxy::Reply reply;
+  if (fuse_proxy::RecvReply(sock, &reply) < 0) {
+    fprintf(stderr, "fusermount-shim: recv failed: %s\n", strerror(errno));
+    close(sock);
+    return 1;
+  }
+  close(sock);
+  if (!reply.err_output.empty()) {
+    fwrite(reply.err_output.data(), 1, reply.err_output.size(), stderr);
+  }
+  return static_cast<int>(reply.exit_status);
+}

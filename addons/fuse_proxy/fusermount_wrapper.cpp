@@ -1,0 +1,147 @@
+// fusermount-wrapper: runs a FUSE binary with the mount already
+// established through the proxy.
+//
+// C++ equivalent of the reference's Go wrapper
+// (addons/fuse-proxy/cmd/fusermount-wrapper/main.go): for FUSE programs
+// that insist on calling mount(2) themselves (no fusermount fallback),
+// the wrapper (1) asks the proxy server to mount the target first via the
+// fusermount protocol, (2) receives the /dev/fuse fd back over
+// _FUSE_COMMFD, and (3) execs the wrapped command with `/dev/fd/N`
+// substituted for the mountpoint argument.
+//
+// Usage: fusermount-wrapper -m MOUNTPOINT [-o OPTIONS] -- CMD [ARGS...]
+//   {} in CMD args is replaced with /dev/fd/N of the mounted device.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include "common.hpp"
+
+namespace {
+
+int ConnectServer(const std::string& path) {
+  int sock = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sock < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(sock, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) < 0) {
+    close(sock);
+    return -1;
+  }
+  return sock;
+}
+
+// Receive one fd over the _FUSE_COMMFD socketpair (fusermount protocol).
+int RecvDeviceFd(int comm_sock) {
+  char byte = 0;
+  struct iovec iov = {&byte, 1};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(struct cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  ssize_t n;
+  do {
+    n = recvmsg(comm_sock, &msg, 0);
+  } while (n < 0 && errno == EINTR);
+  if (n <= 0) return -1;
+  for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+       cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+    if (cmsg->cmsg_level == SOL_SOCKET && cmsg->cmsg_type == SCM_RIGHTS) {
+      int fd;
+      memcpy(&fd, CMSG_DATA(cmsg), sizeof(int));
+      return fd;
+    }
+  }
+  return -1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string mountpoint, options;
+  int cmd_start = -1;
+  for (int i = 1; i < argc; ++i) {
+    if (strcmp(argv[i], "-m") == 0 && i + 1 < argc) {
+      mountpoint = argv[++i];
+    } else if (strcmp(argv[i], "-o") == 0 && i + 1 < argc) {
+      options = argv[++i];
+    } else if (strcmp(argv[i], "--") == 0) {
+      cmd_start = i + 1;
+      break;
+    }
+  }
+  if (mountpoint.empty() || cmd_start < 0 || cmd_start >= argc) {
+    fprintf(stderr,
+            "usage: fusermount-wrapper -m MOUNTPOINT [-o OPTS] -- CMD...\n");
+    return 2;
+  }
+
+  // socketpair plays the role libfuse normally plays on _FUSE_COMMFD.
+  int pair[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, pair) < 0) {
+    perror("socketpair");
+    return 1;
+  }
+  fuse_proxy::Request req;
+  if (!options.empty()) {
+    req.args = {"-o", options, mountpoint};
+  } else {
+    req.args = {mountpoint};
+  }
+  req.comm_fd = pair[1];
+
+  int sock = ConnectServer(fuse_proxy::SocketPath());
+  if (sock < 0) {
+    fprintf(stderr, "fusermount-wrapper: cannot connect proxy: %s\n",
+            strerror(errno));
+    return 1;
+  }
+  if (fuse_proxy::SendRequest(sock, req) < 0) {
+    perror("fusermount-wrapper: send");
+    return 1;
+  }
+  close(pair[1]);
+  int device_fd = RecvDeviceFd(pair[0]);
+  fuse_proxy::Reply reply;
+  if (fuse_proxy::RecvReply(sock, &reply) < 0) {
+    perror("fusermount-wrapper: recv");
+    return 1;
+  }
+  close(sock);
+  if (reply.exit_status != 0 || device_fd < 0) {
+    fwrite(reply.err_output.data(), 1, reply.err_output.size(), stderr);
+    fprintf(stderr, "fusermount-wrapper: mount failed (status %u)\n",
+            reply.exit_status);
+    return reply.exit_status != 0 ? static_cast<int>(reply.exit_status) : 1;
+  }
+
+  // Exec the wrapped command with /dev/fd/N for the mountpoint.
+  char devfd[32];
+  snprintf(devfd, sizeof(devfd), "/dev/fd/%d", device_fd);
+  int flags = fcntl(device_fd, F_GETFD);
+  if (flags >= 0) fcntl(device_fd, F_SETFD, flags & ~FD_CLOEXEC);
+  std::vector<char*> cmd;
+  for (int i = cmd_start; i < argc; ++i) {
+    if (strcmp(argv[i], "{}") == 0) {
+      cmd.push_back(devfd);
+    } else {
+      cmd.push_back(argv[i]);
+    }
+  }
+  cmd.push_back(nullptr);
+  execvp(cmd[0], cmd.data());
+  fprintf(stderr, "fusermount-wrapper: exec %s: %s\n", cmd[0],
+          strerror(errno));
+  return 127;
+}

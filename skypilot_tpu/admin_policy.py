@@ -1,0 +1,103 @@
+"""Pluggable admin policy hook (reference: sky/admin_policy.py, 264 LoC).
+
+Organizations mutate/validate every user request before execution: enforce
+labels, cap resources, pin regions, inject config.  A policy is a class
+with `validate_and_mutate(UserRequest) -> MutatedUserRequest`; configured
+by dotted import path in config (`admin_policy: my_pkg.MyPolicy`) and
+applied at the top of `execution._execute` (reference applies it in
+sky/execution.py via admin_policy_utils).
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import typing
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class RequestOptions:
+    """Context of the user request (reference: admin_policy.RequestOptions)."""
+    cluster_name: Optional[str] = None
+    idle_minutes_to_autostop: Optional[int] = None
+    down: bool = False
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class UserRequest:
+    """What the policy sees: the task plus config + request options."""
+    task: 'task_lib.Task'
+    skypilot_config: Dict[str, Any]
+    request_options: Optional[RequestOptions] = None
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: 'task_lib.Task'
+    skypilot_config: Dict[str, Any]
+
+
+class AdminPolicy:
+    """Subclass and override validate_and_mutate.
+
+    Raise any exception to reject the request; its message reaches the
+    user (reference contract).
+    """
+
+    @classmethod
+    def validate_and_mutate(cls, user_request: UserRequest
+                            ) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+def load_policy(path: Optional[str] = None) -> Optional[type]:
+    """Resolve the configured policy class ('pkg.module.ClassName')."""
+    if path is None:
+        from skypilot_tpu import config as config_lib
+        path = config_lib.get_nested(('admin_policy',), None)
+    if not path:
+        return None
+    module_path, _, class_name = path.rpartition('.')
+    try:
+        module = importlib.import_module(module_path)
+        policy_cls = getattr(module, class_name)
+    except (ImportError, AttributeError, ValueError) as e:
+        raise exceptions.InvalidSkyPilotConfigError(
+            f'Cannot load admin policy {path!r}: {e}') from e
+    if not (isinstance(policy_cls, type) and
+            issubclass(policy_cls, AdminPolicy)):
+        raise exceptions.InvalidSkyPilotConfigError(
+            f'{path!r} is not an AdminPolicy subclass.')
+    return policy_cls
+
+
+def apply(task: 'task_lib.Task',
+          request_options: Optional[RequestOptions] = None
+          ) -> 'task_lib.Task':
+    """Run the configured policy on a task (no-op when unconfigured)."""
+    from skypilot_tpu import config as config_lib
+    policy_cls = load_policy()
+    if policy_cls is None:
+        return task
+    request = UserRequest(task=task,
+                          skypilot_config=config_lib.to_dict(),
+                          request_options=request_options)
+    mutated = policy_cls.validate_and_mutate(request)
+    logger.debug(f'Admin policy {policy_cls.__name__} applied to task '
+                 f'{task.name!r}.')
+    if mutated.skypilot_config != request.skypilot_config:
+        # Config mutations ride the task's per-execution overrides
+        # (execution._execute enters config.override_config with them).
+        merged = dict(mutated.task.config_overrides or {})
+        merged.update(mutated.skypilot_config)
+        mutated.task.config_overrides = merged
+    return mutated.task

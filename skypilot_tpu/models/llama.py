@@ -162,13 +162,54 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
     return (h @ params['lm_head']).astype(jnp.float32)
 
 
+def forward_pipelined(params: Params, tokens: jax.Array,
+                      config: LlamaConfig, *, mesh,
+                      num_microbatches: int,
+                      attention_fn: Optional[AttentionFn] = None
+                      ) -> jax.Array:
+    """forward() with the layer stack split into GPipe stages over the
+    mesh's 'pp' axis (embed/head replicated across stages; see
+    parallel/pipeline.py for the schedule)."""
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    if attention_fn is None:
+        attention_fn = functools.partial(attention_ops.flash_attention,
+                                         causal=True)
+    num_stages = mesh.shape['pp']
+    seq_len = tokens.shape[1]
+    cos, sin = rope_ops.rope_frequencies(config.head_dim, seq_len,
+                                         config.rope_theta)
+    h = params['embed'][tokens]
+
+    layer_fn = functools.partial(_layer, config=config, cos=cos, sin=sin,
+                                 attention_fn=attention_fn)
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def stage_fn(stage_layers, h_mb):
+        def scan_body(carry, layer_params):
+            return layer_fn(carry, layer_params), None
+        h_mb, _ = jax.lax.scan(scan_body, h_mb, stage_layers)
+        return h_mb
+
+    stage_params = pipeline_lib.stack_stages(params['layers'], num_stages)
+    h = pipeline_lib.pipeline_apply(stage_fn, stage_params, h, mesh=mesh,
+                                    num_microbatches=num_microbatches)
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    return (h @ params['lm_head']).astype(jnp.float32)
+
+
 def loss_fn(params: Params, batch: Dict[str, jax.Array],
             config: LlamaConfig,
-            attention_fn: Optional[AttentionFn] = None) -> jax.Array:
+            attention_fn: Optional[AttentionFn] = None,
+            forward_fn: Optional[Callable[..., jax.Array]] = None
+            ) -> jax.Array:
     """Next-token cross entropy.  batch: {'tokens': (B, S)}; the model
     predicts tokens[:, 1:] from tokens[:, :-1]."""
     tokens = batch['tokens']
-    logits = forward(params, tokens[:, :-1], config, attention_fn)
+    if forward_fn is None:
+        forward_fn = functools.partial(forward,
+                                       attention_fn=attention_fn)
+    logits = forward_fn(params, tokens[:, :-1], config)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

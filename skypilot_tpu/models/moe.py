@@ -1,0 +1,196 @@
+"""Mixture-of-Experts decoder (Mixtral-style) with expert parallelism.
+
+TPU-idiomatic GShard formulation (no reference analog — SkyPilot delegates
+MoE to launched frameworks, SURVEY.md §2.3): top-k routing builds dense
+dispatch/combine tensors and the expert computation is einsums with the
+expert axis sharded over the mesh's 'ep' axis — XLA lowers the dispatch
+einsums to all-to-all over ICI.  Dense dispatch keeps shapes static (no
+data-dependent gathers), which is what the TPU compiler wants.
+
+Reuses the Llama attention/norm blocks; only the MLP is replaced by the
+expert bank.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import rmsnorm as rmsnorm_ops
+from skypilot_tpu.ops import rope as rope_ops
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig(llama.LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+
+    def num_params(self) -> int:
+        d, ff, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        attn = d * self.n_heads * self.head_dim * 2 + \
+            d * self.n_kv_heads * self.head_dim * 2
+        moe = self.n_experts * 3 * d * ff + d * self.n_experts
+        return v * d * 2 + l * (attn + moe + 2 * d) + d
+
+
+MOE_DEBUG = MoeConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=256, max_seq_len=512,
+                      n_experts=4, top_k=2, dtype=jnp.float32, remat=False)
+
+
+def init_params(config: MoeConfig, key: jax.Array) -> Params:
+    params = llama.init_params(config, key)
+    keys = jax.random.split(key, 4)
+    d, ff, nl, ne = (config.d_model, config.d_ff, config.n_layers,
+                     config.n_experts)
+    dt = config.dtype
+
+    def dense_init(k, *shape, scale_dim):
+        scale = scale_dim ** -0.5
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale
+                ).astype(dt)
+
+    params['layers']['moe'] = {
+        'router': dense_init(keys[0], nl, d, ne, scale_dim=d),
+        'w_gate': dense_init(keys[1], nl, ne, d, ff, scale_dim=d),
+        'w_up': dense_init(keys[2], nl, ne, d, ff, scale_dim=d),
+        'w_down': dense_init(keys[3], nl, ne, ff, d, scale_dim=ff),
+    }
+    del params['layers']['mlp']
+    return params
+
+
+def top_k_gating(router_logits: jax.Array, top_k: int, capacity: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """router_logits (B, S, E) -> (dispatch (B,S,E,C) bool-ish, combine
+    (B,S,E,C) f32, aux_loss scalar).  GShard top-k with per-batch-row
+    expert capacity; overflowing tokens are dropped (their combine weight
+    is zero — residual connection carries them)."""
+    gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    batch, seq, n_experts = gates.shape
+
+    # Load-balancing aux loss (Switch/GShard): E * mean_e(frac_tokens_e *
+    # mean_gate_e), computed on top-1 assignments.
+    top1 = jnp.argmax(gates, axis=-1)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(top1, n_experts, dtype=jnp.float32), axis=(0, 1))
+    frac_gates = jnp.mean(gates, axis=(0, 1))
+    aux_loss = n_experts * jnp.sum(frac_tokens * frac_gates)
+
+    # Iteratively take top-k expert choices per token.
+    dispatch_parts = []
+    combine_parts = []
+    remaining = gates
+    position_in_expert = jnp.zeros((batch, n_experts), jnp.float32)
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                    # (B, S)
+        onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)
+        gate_k = jnp.sum(gates * onehot, axis=-1)               # (B, S)
+        # Position of each token within its chosen expert's capacity,
+        # counted along the sequence (prefix sum), offset by experts'
+        # fill from previous k-iterations.
+        prior = jnp.cumsum(onehot, axis=1) - onehot             # (B,S,E)
+        pos = jnp.sum(prior * onehot, axis=-1) + \
+            jnp.sum(position_in_expert[:, None, :] * onehot, axis=-1)
+        position_in_expert = position_in_expert + jnp.sum(onehot, axis=1)
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(
+            jnp.where(keep, pos, capacity).astype(jnp.int32),
+            capacity, dtype=jnp.float32)                        # (B,S,C)
+        dispatch_parts.append(onehot[..., None] * pos_oh[..., None, :])
+        combine_parts.append(gate_k[..., None, None] *
+                             dispatch_parts[-1])
+        remaining = remaining * (1.0 - onehot)
+    dispatch = sum(dispatch_parts)
+    combine = sum(combine_parts)
+    # Renormalize combine weights over the k chosen experts.
+    denom = jnp.sum(combine, axis=(-2, -1), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux_loss
+
+
+def moe_block(x: jax.Array, moe_params: Params, config: MoeConfig
+              ) -> Tuple[jax.Array, jax.Array]:
+    """x (B, S, d) -> (y (B, S, d), aux_loss).  Expert einsums carry the
+    E axis; with E sharded over 'ep' XLA inserts the token all-to-all."""
+    batch, seq, d = x.shape
+    capacity = max(1, int(config.top_k * seq * config.capacity_factor /
+                          config.n_experts))
+    router_logits = x @ moe_params['router']                    # (B,S,E)
+    dispatch, combine, aux = top_k_gating(router_logits, config.top_k,
+                                          capacity)
+    dispatch = dispatch.astype(x.dtype)
+    # Dispatch: (B,S,E,C) x (B,S,d) -> (E,B,C,d)   [all-to-all under ep]
+    expert_in = jnp.einsum('bsec,bsd->ebcd', dispatch, x)
+    gate = jax.nn.silu(jnp.einsum('ebcd,edf->ebcf', expert_in,
+                                  moe_params['w_gate']
+                                  ).astype(jnp.float32)).astype(x.dtype)
+    up = jnp.einsum('ebcd,edf->ebcf', expert_in, moe_params['w_up'])
+    expert_out = jnp.einsum('ebcf,efd->ebcd', gate * up,
+                            moe_params['w_down'])
+    # Combine: (B,S,E,C) x (E,B,C,d) -> (B,S,d)    [all-to-all back]
+    y = jnp.einsum('bsec,ebcd->bsd', combine.astype(x.dtype), expert_out)
+    return y, aux
+
+
+def _layer(carry, layer_params: Params, *, config: MoeConfig,
+           cos, sin, attention_fn) -> Tuple[Any, None]:
+    h, aux_acc = carry
+    batch, seq, d = h.shape
+    hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    attn_p = layer_params['attn']
+
+    x = rmsnorm_ops.rms_norm(h, layer_params['ln1'], eps=config.norm_eps)
+    q = (x @ attn_p['wq']).reshape(batch, seq, nh, hd)
+    k = (x @ attn_p['wk']).reshape(batch, seq, nkv, hd)
+    v = (x @ attn_p['wv']).reshape(batch, seq, nkv, hd)
+    q = rope_ops.apply_rope(q, cos, sin)
+    k = rope_ops.apply_rope(k, cos, sin)
+    o = attention_fn(q, k, v)
+    h = h + (o.reshape(batch, seq, nh * hd) @ attn_p['wo'])
+
+    x = rmsnorm_ops.rms_norm(h, layer_params['ln2'], eps=config.norm_eps)
+    y, aux = moe_block(x, layer_params['moe'], config)
+    return (h + y, aux_acc + aux), None
+
+
+def forward(params: Params, tokens: jax.Array, config: MoeConfig,
+            attention_fn=None) -> Tuple[jax.Array, jax.Array]:
+    """tokens (B, S) -> (logits (B,S,V) f32, aux_loss scalar)."""
+    if attention_fn is None:
+        attention_fn = functools.partial(attention_ops.flash_attention,
+                                         causal=True)
+    seq_len = tokens.shape[1]
+    cos, sin = rope_ops.rope_frequencies(config.head_dim, seq_len,
+                                         config.rope_theta)
+    h = params['embed'][tokens]
+
+    layer_fn = functools.partial(_layer, config=config, cos=cos, sin=sin,
+                                 attention_fn=attention_fn)
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    (h, aux), _ = jax.lax.scan(lambda c, p: layer_fn(c, p),
+                               (h, jnp.zeros((), jnp.float32)),
+                               params['layers'])
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    logits = (h @ params['lm_head']).astype(jnp.float32)
+    return logits, aux / config.n_layers
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], config: MoeConfig,
+            attention_fn=None) -> jax.Array:
+    tokens = batch['tokens']
+    logits, aux = forward(params, tokens[:, :-1], config, attention_fn)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + config.router_aux_weight * aux

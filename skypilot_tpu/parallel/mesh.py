@@ -4,18 +4,21 @@ This is the compute-side heart of the TPU-native design (SURVEY.md §2.3):
 instead of the reference's NCCL/torchrun env contract, parallelism is a
 `jax.sharding.Mesh` over the slice's chips with named axes
 
-    ('dp', 'fsdp', 'sp', 'tp')
+    ('pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
 
+- pp:   pipeline parallel (GPipe microbatching over stages; ppermute ring
+  — outermost: one activation handoff per microbatch, tolerates DCN)
 - dp:   pure data parallel (gradients psum over ICI/DCN)
 - fsdp: data parallel with sharded params/optimizer state (ZeRO-3 analog;
   all-gather params, reduce-scatter grads — XLA inserts these from shardings)
+- ep:   expert parallel (MoE experts sharded; all-to-all token dispatch)
 - sp:   sequence/context parallel (ring attention over this axis)
 - tp:   tensor parallel (megatron-style row/col sharding; highest-bandwidth
   innermost axis — keep within a host's ICI neighborhood)
 
 Axis order is outermost→innermost: jax orders mesh axes so the LAST axis
 maps to physically-adjacent devices, so tp (all-reduce heavy) rides the
-fastest ICI links, while dp (one psum per step) can cross DCN.
+fastest ICI links, while pp/dp (one handoff/psum per step) can cross DCN.
 """
 from __future__ import annotations
 
@@ -25,7 +28,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-AXES: Tuple[str, ...] = ('dp', 'fsdp', 'sp', 'tp')
+AXES: Tuple[str, ...] = ('pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,13 +37,16 @@ class MeshConfig:
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
+    ep: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return (self.pp * self.dp * self.fsdp * self.ep * self.sp *
+                self.tp)
 
-    def axis_sizes(self) -> Tuple[int, int, int, int]:
-        return (self.dp, self.fsdp, self.sp, self.tp)
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.pp, self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
     def __str__(self) -> str:
         return ('mesh(' + ', '.join(

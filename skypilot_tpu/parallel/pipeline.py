@@ -1,0 +1,115 @@
+"""GPipe-style pipeline parallelism over a 'pp' mesh axis.
+
+TPU-idiomatic design (no reference analog — SkyPilot delegates pp to the
+launched framework, SURVEY.md §2.3): stages are a leading axis of the
+stacked layer params, sharded over 'pp'; microbatch activations hop stages
+with `lax.ppermute` inside `shard_map`, and the whole schedule is a single
+`lax.scan` — one compiled program, no per-step dispatch.
+
+Schedule: plain GPipe fill-drain.  T = M + S - 1 ticks for M microbatches
+over S stages; each device computes its stage every tick (idle ticks
+compute on garbage and are masked out).  Bubble fraction (S-1)/T shrinks
+with M — callers pick num_microbatches >= 4*S for <20% bubble.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def stack_stages(layer_params, num_stages: int):
+    """(L, ...) stacked layer params -> (S, L/S, ...) stage-major stacking
+    (shard axis 0 over 'pp')."""
+    def reshape(x):
+        n_layers = x.shape[0]
+        assert n_layers % num_stages == 0, (
+            f'{n_layers} layers not divisible by {num_stages} stages')
+        return x.reshape(num_stages, n_layers // num_stages, *x.shape[1:])
+    return jax.tree.map(reshape, layer_params)
+
+
+def pipeline_apply(stage_fn: StageFn,
+                   stage_params,
+                   h: jax.Array,
+                   *,
+                   mesh,
+                   num_microbatches: int,
+                   axis_name: str = 'pp') -> jax.Array:
+    """Run h (B, ...) through S pipeline stages of stage_fn.
+
+    stage_params: pytree with leading stage axis S (stack_stages output),
+    sharded P('pp', ...).  stage_fn(params_for_stage, h_mb) -> h_mb applies
+    one stage to one microbatch.  Returns h after all stages, with the
+    input's sharding.
+    """
+    num_stages = mesh.shape[axis_name]
+    if num_stages == 1:
+        return stage_fn(jax.tree.map(lambda x: x[0], stage_params), h)
+    batch = h.shape[0]
+    assert batch % num_microbatches == 0, (batch, num_microbatches)
+    mb = batch // num_microbatches
+
+    # (M, mb, ...) microbatch-major; replicated over pp, data-sharded on
+    # the microbatch axis.
+    x_mb = h.reshape(num_microbatches, mb, *h.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+
+    # Partial manualization: only 'pp' goes manual — dp/fsdp/sp/tp stay
+    # automatic inside the stage, so GSPMD keeps sharding the stage's
+    # matmuls and ring attention's own shard_map still composes.
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        axis_names={axis_name},
+        check_vma=False)
+    def _pipelined(params_local, x_local):
+        # params_local leading dim is 1 (this device's stage).
+        params_here = jax.tree.map(lambda x: x[0], params_local)
+        stage = lax.axis_index(axis_name)
+        n_ticks = num_microbatches + num_stages - 1
+        fwd_perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 ingests microbatch t (clamped; garbage ticks are
+            # never read back).  Other stages consume the handoff.
+            mb_idx = jnp.clip(t, 0, num_microbatches - 1)
+            inp = jnp.where(stage == 0, x_local[mb_idx], state)
+            out = stage_fn(params_here, inp)
+            # Last stage emits microbatch t-(S-1).
+            out_idx = t - (num_stages - 1)
+            is_emit = jnp.logical_and(stage == num_stages - 1, out_idx >= 0)
+            outputs = jnp.where(
+                is_emit,
+                lax.dynamic_update_index_in_dim(
+                    outputs, out, jnp.clip(out_idx, 0,
+                                           num_microbatches - 1), 0),
+                outputs)
+            state = lax.ppermute(out, axis_name, fwd_perm)
+            return (state, outputs), None
+
+        init = (jnp.zeros_like(x_local[0]), jnp.zeros_like(x_local))
+        (_, outputs), _ = lax.scan(tick, init,
+                                   jnp.arange(n_ticks))
+        # Only the last stage holds real outputs; psum broadcasts them so
+        # every stage returns the full result (loss is computed
+        # replicated over pp).  f32 for the collective: XLA CPU's
+        # AllReducePromotion pass crashes on bf16 all-reduce here.
+        outputs = jnp.where(stage == num_stages - 1, outputs,
+                            jnp.zeros_like(outputs))
+        dtype = outputs.dtype
+        return lax.psum(outputs.astype(jnp.float32),
+                        axis_name).astype(dtype)
+
+    out = _pipelined(stage_params, x_mb)
+    return out.reshape(batch, *h.shape[1:])

@@ -91,13 +91,15 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     return jnp.einsum('bhqd->bqhd', out).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, *, axis_name: str = 'sp',
+def ring_attention(q, k, v, mesh=None, *, axis_name: str = 'sp',
                    causal: bool = True,
                    batch_axes=('dp', 'fsdp'), head_axis: Optional[str] = 'tp'):
     """Exact attention with sequence sharded over `axis_name`.
 
     Layout (B, S, H, D).  Batch may additionally be sharded over
     `batch_axes` and heads over `head_axis` — those shards are independent.
+    mesh=None uses the context mesh (required when composing inside
+    another partially-manual shard_map, e.g. the 'pp' pipeline).
     """
     spec_q = P(batch_axes, axis_name, head_axis, None)
     spec_kv = P(batch_axes, axis_name, None, None) if head_axis is None else \
@@ -107,12 +109,22 @@ def ring_attention(q, k, v, mesh, *, axis_name: str = 'sp',
     # KV heads may not divide across tp when using GQA; replicate KV heads
     # over tp in that case.
     kv_heads = k.shape[2]
-    tp_size = mesh.shape[head_axis] if head_axis else 1
+    shape_src = mesh if mesh is not None else \
+        jax.sharding.get_abstract_mesh()
+    tp_size = shape_src.shape[head_axis] if head_axis else 1
     if head_axis and kv_heads % tp_size != 0:
         spec_kv = P(batch_axes, axis_name, None, None)
+    # Manualize only the axes the specs mention, so this composes under
+    # an outer shard_map that already manualized other axes (pp).
+    axis_names = set(batch_axes) | {axis_name}
+    if head_axis:
+        axis_names.add(head_axis)
+    kwargs = {} if mesh is None else {'mesh': mesh}
     return jax.shard_map(
-        local, mesh=mesh,
+        local,
         in_specs=(spec_q, spec_kv, spec_kv),
         out_specs=spec_q,
+        axis_names=axis_names,
         check_vma=False,
+        **kwargs,
     )(q, k, v)

@@ -76,6 +76,19 @@ LLAMA_RULES = PartitionRules([
     (r'lm_head', P('fsdp', 'tp')),               # (d, vocab)
 ])
 
+# MoE rules (models/moe.py): expert bank shards the E axis over 'ep',
+# the d/ff axes stay megatron 2D like the dense MLP.
+MOE_RULES = PartitionRules([
+    (r'embed', P('tp', 'fsdp')),
+    (r'attn/wq|attn/wk|attn/wv', P(None, 'fsdp', 'tp')),
+    (r'attn/wo', P(None, 'tp', 'fsdp')),
+    (r'moe/router', P(None, 'fsdp', None)),       # (L, d, E)
+    (r'moe/w_gate|moe/w_up', P(None, 'ep', 'fsdp', 'tp')),  # (L, E, d, ff)
+    (r'moe/w_down', P(None, 'ep', 'tp', 'fsdp')),           # (L, E, ff, d)
+    (r'norm|ln', P()),
+    (r'lm_head', P('fsdp', 'tp')),
+])
+
 # Activation specs.  Input tokens shard on batch only (their length is
 # seq+1 for next-token targets, not divisible by sp); the model constrains
 # hidden states to seq-sharded specs internally and XLA reshards once.

@@ -1,0 +1,110 @@
+"""fuse-proxy: shim <-> server protocol over a unix socket with a fake
+`fusermount` (no real FUSE needed — validates argv/env/fd forwarding and
+exit-status relay, the analog of the reference's Go fuse-proxy tests)."""
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import time
+
+import pytest
+
+ADDON_DIR = os.path.join(os.path.dirname(__file__), '..', 'addons',
+                         'fuse_proxy')
+BIN_DIR = os.path.join(ADDON_DIR, 'bin')
+
+FAKE_FUSERMOUNT = r'''#!/bin/bash
+# Fake fusermount: records argv + env; exit status read from a file so
+# tests can change it per-call (the shim intentionally forwards no env).
+echo "argv: $@" >> "$FAKE_LOG"
+echo "commfd: ${_FUSE_COMMFD:-none}" >> "$FAKE_LOG"
+echo "some fusermount stderr" >&2
+exit $(cat "$FAKE_STATUS_FILE" 2>/dev/null || echo 0)
+'''
+
+
+@pytest.fixture(scope='module')
+def binaries():
+    if shutil.which('g++') is None:
+        pytest.skip('g++ not available')
+    subprocess.run(['make', '-C', ADDON_DIR], check=True,
+                   capture_output=True)
+    return BIN_DIR
+
+
+@pytest.fixture()
+def proxy(binaries, tmp_path):
+    sock_path = str(tmp_path / 'proxy.sock')
+    fake = tmp_path / 'fake_fusermount'
+    fake.write_text(FAKE_FUSERMOUNT)
+    fake.chmod(0o755)
+    log = tmp_path / 'fake.log'
+    status_file = tmp_path / 'status'
+    env = dict(os.environ, FAKE_LOG=str(log),
+               FAKE_STATUS_FILE=str(status_file))
+    server = subprocess.Popen(
+        [os.path.join(binaries, 'fusermount-server'),
+         '--socket', sock_path, '--fusermount', str(fake)],
+        env=env, stderr=subprocess.PIPE)
+    deadline = time.time() + 10
+    while not os.path.exists(sock_path):
+        assert time.time() < deadline, 'server never created socket'
+        time.sleep(0.05)
+    yield {'socket': sock_path, 'log': log, 'env': env,
+           'status_file': status_file}
+    server.send_signal(signal.SIGKILL)
+    server.wait()
+
+
+def _run_shim(proxy_info, args, comm_fd=None):
+    env = dict(proxy_info['env'])
+    env['FUSE_PROXY_SOCKET'] = proxy_info['socket']
+    pass_fds = ()
+    if comm_fd is not None:
+        env['_FUSE_COMMFD'] = str(comm_fd)
+        pass_fds = (comm_fd,)
+    return subprocess.run(
+        [os.path.join(BIN_DIR, 'fusermount-shim')] + args,
+        env=env, capture_output=True, pass_fds=pass_fds, check=False)
+
+
+def test_shim_forwards_argv_and_status(proxy):
+    result = _run_shim(proxy, ['-o', 'rw,nosuid', '/mnt/test'])
+    assert result.returncode == 0
+    assert b'some fusermount stderr' in result.stderr
+    log = proxy['log'].read_text()
+    assert 'argv: -o rw,nosuid /mnt/test' in log
+    assert 'commfd: none' in log
+
+
+def test_shim_relays_nonzero_exit(proxy):
+    proxy['status_file'].write_text('7')
+    result = _run_shim(proxy, ['/mnt/x'])
+    assert result.returncode == 7
+    proxy['status_file'].write_text('0')
+
+
+def test_shim_forwards_comm_fd(proxy):
+    # The _FUSE_COMMFD fd must reach the (fake) fusermount as an open fd.
+    left, right = socket.socketpair()
+    try:
+        result = _run_shim(proxy, ['/mnt/fd'], comm_fd=right.fileno())
+        assert result.returncode == 0
+        log = proxy['log'].read_text()
+        # Server re-exports the forwarded fd under some number != none.
+        last = [l for l in log.splitlines() if l.startswith('commfd:')][-1]
+        assert last != 'commfd: none'
+    finally:
+        left.close()
+        right.close()
+
+
+def test_shim_fails_cleanly_without_server(binaries, tmp_path):
+    env = dict(os.environ,
+               FUSE_PROXY_SOCKET=str(tmp_path / 'nonexistent.sock'))
+    result = subprocess.run(
+        [os.path.join(BIN_DIR, 'fusermount-shim'), '/mnt/y'],
+        env=env, capture_output=True, check=False)
+    assert result.returncode == 1
+    assert b'cannot connect' in result.stderr
