@@ -114,17 +114,22 @@ def ring_attention(q, k, v, mesh=None, *, axis_name: str = 'sp',
     tp_size = shape_src.shape[head_axis] if head_axis else 1
     if head_axis and kv_heads % tp_size != 0:
         spec_kv = P(batch_axes, axis_name, None, None)
-    # Manualize only the axes the specs mention, so this composes under
-    # an outer shard_map that already manualized other axes (pp).
-    axis_names = set(batch_axes) | {axis_name}
-    if head_axis:
-        axis_names.add(head_axis)
-    kwargs = {} if mesh is None else {'mesh': mesh}
+    if mesh is None:
+        # Composing under an outer shard_map that already manualized other
+        # axes (pp): manualize only the axes the specs mention.
+        axis_names = set(batch_axes) | {axis_name}
+        if head_axis:
+            axis_names.add(head_axis)
+        kwargs = {'axis_names': axis_names}
+    else:
+        # Top level with an explicit mesh: full-manual shard_map (jax 0.9's
+        # out_specs check rejects a subset axis_names over a concrete mesh
+        # whose remaining axes the specs never mention).
+        kwargs = {'mesh': mesh}
     return jax.shard_map(
         local,
         in_specs=(spec_q, spec_kv, spec_kv),
         out_specs=spec_q,
-        axis_names=axis_names,
         check_vma=False,
         **kwargs,
     )(q, k, v)

@@ -90,7 +90,7 @@ def test_shim_forwards_comm_fd(proxy):
     left, right = socket.socketpair()
     try:
         result = _run_shim(proxy, ['/mnt/fd'], comm_fd=right.fileno())
-        assert result.returncode == 0
+        assert result.returncode == 0, result.stderr
         log = proxy['log'].read_text()
         # Server re-exports the forwarded fd under some number != none.
         last = [l for l in log.splitlines() if l.startswith('commfd:')][-1]

@@ -14,7 +14,8 @@ from skypilot_tpu.parallel import sharding as sharding_lib
 
 def test_make_mesh_axes():
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-    assert mesh.shape == {'dp': 2, 'fsdp': 2, 'sp': 1, 'tp': 2}
+    assert dict(mesh.shape) == {'pp': 1, 'dp': 2, 'fsdp': 2, 'ep': 1,
+                                'sp': 1, 'tp': 2}
 
 
 def test_make_mesh_wrong_count():
