@@ -43,6 +43,43 @@ def _rmsnorm_pallas(x2d: jax.Array, weight: jax.Array, eps: float,
     )(x2d, weight)
 
 
+def _rms_norm_xla(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_pallas_diff(x, weight, eps):
+    shape = x.shape
+    y = _rmsnorm_pallas(x.reshape(-1, shape[-1]), weight, eps,
+                        interpret=False)
+    return y.reshape(shape)
+
+
+def _rms_norm_fwd(x, weight, eps):
+    return _rms_norm_pallas_diff(x, weight, eps), (x, weight)
+
+
+def _rms_norm_bwd(eps, residuals, g):
+    # Recompute-based backward in f32 (XLA fuses the elementwise chain; the
+    # O(d) reductions are HBM-bound either way, so no Pallas bwd needed).
+    x, weight = residuals
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = weight.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xf * rstd
+    dw = jnp.sum(gf * xhat, axis=tuple(range(x.ndim - 1)))
+    gw = gf * wf
+    dx = rstd * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dw.astype(weight.dtype)
+
+
+_rms_norm_pallas_diff.defvjp(_rms_norm_fwd, _rms_norm_bwd)
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
              use_pallas: Optional[bool] = None) -> jax.Array:
     """y = x / rms(x) * weight over the last dim."""
@@ -53,10 +90,5 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
         except RuntimeError:
             use_pallas = False
     if use_pallas:
-        shape = x.shape
-        y = _rmsnorm_pallas(x.reshape(-1, shape[-1]), weight, eps,
-                            interpret=False)
-        return y.reshape(shape)
-    xf = x.astype(jnp.float32)
-    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+        return _rms_norm_pallas_diff(x, weight, eps)
+    return _rms_norm_xla(x, weight, eps)

@@ -126,23 +126,36 @@ class Trainer:
     def fit(self, batches: Iterator[Dict[str, np.ndarray]], num_steps: int,
             log_every: int = 10,
             tokens_per_batch: Optional[int] = None) -> Dict[str, float]:
-        """Run steps; returns summary incl. steady-state throughput."""
-        times = []
+        """Run steps; returns summary incl. steady-state throughput.
+
+        Timing: warmup steps (compile + pipeline fill) are forced to
+        completion with a host fetch, then the steady block is timed
+        end-to-end with a single fetch at the end.  Per-step
+        block_until_ready is NOT trusted: remote-tunnel PJRT backends can
+        report buffers ready before execution finishes, and a per-step
+        host fetch would bill one RTT per step to the device.
+        """
+        if num_steps <= 0:
+            return {'loss': float('nan'), 'step_time_s': float('nan')}
+        warmup = min(max(1, min(num_steps // 3, 4)), num_steps - 1)
         last_metrics: Dict[str, Any] = {}
-        for i in range(num_steps):
-            batch = next(batches)
-            start = time.perf_counter()
-            last_metrics = self.run_step(batch)
-            jax.block_until_ready(last_metrics)
-            times.append(time.perf_counter() - start)
+        for i in range(warmup):
+            last_metrics = self.run_step(next(batches))
+            loss = float(last_metrics['loss'])  # host fetch = real barrier
+            if log_every:
+                print(f'warmup step {self.step}: loss={loss:.4f}')
+        timed = num_steps - warmup
+        start = time.perf_counter()
+        for i in range(timed):
+            last_metrics = self.run_step(next(batches))
             if log_every and (i + 1) % log_every == 0:
-                print(f'step {self.step}: loss='
-                      f'{float(last_metrics["loss"]):.4f} '
-                      f'({times[-1]*1e3:.0f} ms)')
-        steady = times[len(times) // 2:]  # skip compile+warmup half
-        step_time = float(np.median(steady))
-        out = {'loss': float(last_metrics.get('loss', np.nan)),
-               'step_time_s': step_time}
+                # No host fetch here: a sync fetch would stall dispatch and
+                # bill a device round-trip to the timed block.
+                print(f'step {self.step} dispatched')
+        final_loss = float(last_metrics['loss'])  # barrier for the block
+        elapsed = time.perf_counter() - start
+        step_time = elapsed / timed
+        out = {'loss': final_loss, 'step_time_s': step_time}
         if tokens_per_batch:
             out['tokens_per_sec'] = tokens_per_batch / step_time
         return out
