@@ -33,6 +33,9 @@ OVERRIDEABLE_CONFIG_KEYS: Tuple[Tuple[str, ...], ...] = (
     ('serve',),
     ('provision',),
     ('logs',),
+    # The client's active workspace rides in task config; the server
+    # permission-checks it before execution (server.py schedule()).
+    ('active_workspace',),
 )
 
 _DEFAULTS: Dict[str, Any] = {
@@ -137,7 +140,8 @@ def to_dict() -> Dict[str, Any]:
 
 @contextlib.contextmanager
 def override_config(override: Optional[Dict[str, Any]]):
-    """Thread-local config override (mirrors ConfigContext
+    """Thread-local config override for UNTRUSTED (task-YAML) input —
+    allow-listed keys only (mirrors ConfigContext
     sky/skypilot_config.py:138)."""
     if override:
         for key in override:
@@ -145,6 +149,16 @@ def override_config(override: Optional[Dict[str, Any]]):
                 raise exceptions.InvalidSkyPilotConfigError(
                     f'Config key {key!r} is not overridable from a task. '
                     f'Allowed: {sorted(set(k[0] for k in OVERRIDEABLE_CONFIG_KEYS))}')
+    with override_context(override):
+        yield
+
+
+@contextlib.contextmanager
+def override_context(override: Optional[Dict[str, Any]]):
+    """Thread-local config override for TRUSTED server-internal context
+    (e.g. the authenticated requesting_user) — no allowlist.  Never pass
+    client-supplied dicts here: task YAML must go through
+    override_config so keys like 'requesting_user' cannot be spoofed."""
     prev = getattr(_local, 'override', None)
     _local.override = _merge(prev or {}, override or {})
     try:

@@ -171,3 +171,13 @@ class InvalidServiceSpecError(SkyTpuError):
 
 class ServeError(SkyTpuError):
     """Serve operation failed (duplicate service, unknown service, ...)."""
+
+
+class PermissionDeniedError(SkyTpuError):
+    """RBAC/workspace policy denied the request (reference parity:
+    sky/exceptions.py PermissionDeniedError)."""
+
+
+class WorkspaceError(SkyTpuError):
+    """Workspace CRUD conflict (already exists / not found / has active
+    clusters)."""

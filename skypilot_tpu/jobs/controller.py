@@ -45,6 +45,17 @@ class JobController:
     def run(self) -> ManagedJobStatus:
         record = self.table.get(self.job_id)
         assert record is not None
+        # Attribute everything this controller launches to the submitting
+        # user (the API server persisted their hash at submission; the
+        # controller is a separate process so the server's per-request
+        # context does not reach here).
+        from skypilot_tpu import config as config_lib
+        user_hash = record.get('user_hash')
+        with config_lib.override_context(
+                {'requesting_user': user_hash} if user_hash else None):
+            return self._run(record)
+
+    def _run(self, record) -> ManagedJobStatus:
         try:
             task = task_lib.Task.from_yaml_config(record['task_config'])
         except exceptions.InvalidTaskError as e:

@@ -53,13 +53,17 @@ def ensure_controller() -> None:
 
 def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
     """Submit a managed job; returns the managed job id."""
+    from skypilot_tpu import config
     name = name or task.name
     jr = task.best_resources.job_recovery or {}
     table = JobsTable()
     job_id = table.submit(
         name, task.to_yaml_config(),
         recovery_strategy=jr.get('strategy') or 'failover',
-        max_restarts_on_errors=int(jr.get('max_restarts_on_errors', 0)))
+        max_restarts_on_errors=int(jr.get('max_restarts_on_errors', 0)),
+        # Persist the authenticated submitter so the (separate) controller
+        # process attributes the job's clusters to them, not to itself.
+        user_hash=config.get_nested(('requesting_user',)))
     ensure_controller()
     logger.info(f'Managed job {job_id} ({name!r}) submitted.')
     return job_id

@@ -69,7 +69,8 @@ CREATE TABLE IF NOT EXISTS managed_jobs (
     recovery_count INTEGER DEFAULT 0,
     failure_reason TEXT,
     recovery_strategy TEXT,
-    max_restarts_on_errors INTEGER DEFAULT 0
+    max_restarts_on_errors INTEGER DEFAULT 0,
+    user_hash TEXT
 );
 """
 
@@ -82,6 +83,15 @@ class JobsTable:
         os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
+            cols = {r['name'] for r in
+                    conn.execute('PRAGMA table_info(managed_jobs)')}
+            if 'user_hash' not in cols:
+                try:
+                    conn.execute(
+                        'ALTER TABLE managed_jobs ADD COLUMN user_hash TEXT')
+                except sqlite3.OperationalError as e:
+                    if 'duplicate column name' not in str(e):
+                        raise
 
     def _conn(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self.db_path, timeout=30)
@@ -91,16 +101,18 @@ class JobsTable:
 
     def submit(self, name: Optional[str], task_config: Dict[str, Any],
                recovery_strategy: str = 'failover',
-               max_restarts_on_errors: int = 0) -> int:
+               max_restarts_on_errors: int = 0,
+               user_hash: Optional[str] = None) -> int:
         with self._conn() as conn:
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_yaml, status, '
                 'schedule_state, submitted_at, recovery_strategy, '
-                'max_restarts_on_errors) VALUES (?, ?, ?, ?, ?, ?, ?)',
+                'max_restarts_on_errors, user_hash) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
                 (name, json.dumps(task_config),
                  ManagedJobStatus.PENDING.value,
                  ManagedJobScheduleState.WAITING.value, time.time(),
-                 recovery_strategy, max_restarts_on_errors))
+                 recovery_strategy, max_restarts_on_errors, user_hash))
             return int(cur.lastrowid)
 
     def set_status(self, job_id: int, status: ManagedJobStatus,

@@ -1,0 +1,110 @@
+"""API-server auth middleware: identify the caller, enforce RBAC.
+
+Reference parity: sky/server/server.py auth middlewares (basic auth,
+oauth2-proxy header auth, service-account JWT bearer auth).  Resolution
+order per request:
+
+  1. `Authorization: Bearer skytpu_sa_...` — service-account token
+     (users/token_service.py)
+  2. `Authorization: Basic ...` — name/password against the users DB
+  3. `X-SkyTPU-User: <user-hash>` — ONLY when `api_server.auth_mode` is
+     'proxy' (the reference's oauth2-proxy mode, where a trusted ingress
+     proxy is the sole path to the server and stamps the identity header)
+  4. anonymous → the server-local user hash (single-user mode)
+
+When config `api_server.auth_enabled` is true, every request MUST carry
+valid credentials (Bearer/Basic, or the proxy header in proxy mode);
+anything else is 401, and RBAC endpoint blocklists (users/rbac.py) return
+403.  When false (default), the middleware only annotates
+request['user_id'] — same behavior as a reference deployment with no auth
+proxy in front.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from aiohttp import web
+
+from skypilot_tpu import config
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+USER_HEADER = 'X-SkyTPU-User'
+
+# Paths that stay open without credentials even when auth is enforced
+# (health probes; the reference exempts /api/health the same way).
+_EXEMPT_PATHS = ('/api/health',)
+
+
+def _resolve_user(request: web.Request, enforce: bool) -> Optional[str]:
+    """Returns user_id, or None if the request cannot be authenticated."""
+    from skypilot_tpu.users import state as users_state
+    from skypilot_tpu.users import token_service
+
+    auth_header = request.headers.get('Authorization', '')
+    if auth_header.startswith('Bearer '):
+        token = auth_header[len('Bearer '):].strip()
+        return token_service.verify_token(token)
+    if auth_header.startswith('Basic '):
+        try:
+            decoded = base64.b64decode(
+                auth_header[len('Basic '):]).decode()
+            name, password = decoded.split(':', 1)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        user = users_state.get_user_by_name(name)
+        if user is None or user.password_hash is None:
+            return None
+        if not users_state.verify_password(password, user.password_hash):
+            return None
+        return user.id
+    auth_mode = config.get_nested(('api_server', 'auth_mode'),
+                                  default_value='basic')
+    header_user = request.headers.get(USER_HEADER)
+    if header_user and (auth_mode == 'proxy' or not enforce):
+        # Under enforcement the identity header is only trusted in proxy
+        # mode; otherwise it is a free impersonation vector.
+        return header_user
+    if enforce:
+        return None  # credentials are mandatory
+    return common_utils.get_user_hash()
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    from skypilot_tpu.users import permission
+
+    enforce = config.get_nested(('api_server', 'auth_enabled'),
+                                default_value=False)
+    if enforce and request.path in _EXEMPT_PATHS:
+        request['user_id'] = None
+        return await handler(request)
+    if request.headers.get('Authorization'):
+        # PBKDF2 verification + sqlite roundtrips are CPU-bound: keep them
+        # off the event loop so concurrent requests don't stall.
+        import asyncio
+        user_id = await asyncio.get_event_loop().run_in_executor(
+            None, _resolve_user, request, enforce)
+    else:
+        user_id = _resolve_user(request, enforce)
+    if user_id is None:
+        if enforce:
+            return web.json_response({'error': 'invalid credentials'},
+                                     status=401)
+        user_id = common_utils.get_user_hash()
+    request['user_id'] = user_id
+    if enforce:
+        # check_endpoint_permission self-registers unknown users (sqlite +
+        # possibly a filelock): keep it off the event loop too.
+        import asyncio
+        allowed = await asyncio.get_event_loop().run_in_executor(
+            None, permission.permission_service.check_endpoint_permission,
+            user_id, request.path, request.method)
+        if not allowed:
+            return web.json_response(
+                {'error': f'user {user_id!r} may not {request.method} '
+                          f'{request.path}'}, status=403)
+    return await handler(request)

@@ -13,16 +13,27 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.server.executor import entrypoint
 
 
+def _request_user(payload: Dict[str, Any]):
+    """Per-request user context: the server stamps '_user_hash' from the
+    authenticated caller; execution under this context attributes cluster
+    records to them (state.add_or_update_cluster reads requesting_user)."""
+    from skypilot_tpu import config
+    user_hash = payload.pop('_user_hash', None)
+    return config.override_context(
+        {'requesting_user': user_hash} if user_hash else None)
+
+
 @entrypoint('launch')
 def _launch(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu import execution
-    task = task_lib.Task.from_yaml_config(payload['task'])
-    job_id, handle = execution.launch(
-        task,
-        cluster_name=payload.get('cluster_name'),
-        detach_run=True,  # the server never blocks on user jobs
-        down=payload.get('down', False),
-        no_setup=payload.get('no_setup', False))
+    with _request_user(payload):
+        task = task_lib.Task.from_yaml_config(payload['task'])
+        job_id, handle = execution.launch(
+            task,
+            cluster_name=payload.get('cluster_name'),
+            detach_run=True,  # the server never blocks on user jobs
+            down=payload.get('down', False),
+            no_setup=payload.get('no_setup', False))
     return {'job_id': job_id,
             'cluster_name': handle.cluster_name if handle else None}
 
@@ -30,9 +41,10 @@ def _launch(payload: Dict[str, Any]) -> Dict[str, Any]:
 @entrypoint('exec')
 def _exec(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu import execution
-    task = task_lib.Task.from_yaml_config(payload['task'])
-    job_id, handle = execution.exec_cmd(
-        task, cluster_name=payload['cluster_name'], detach_run=True)
+    with _request_user(payload):
+        task = task_lib.Task.from_yaml_config(payload['task'])
+        job_id, handle = execution.exec_cmd(
+            task, cluster_name=payload['cluster_name'], detach_run=True)
     return {'job_id': job_id,
             'cluster_name': handle.cluster_name if handle else None}
 
@@ -112,8 +124,9 @@ def _check(payload: Dict[str, Any]) -> Dict[str, Any]:
 @entrypoint('jobs.launch')
 def _jobs_launch(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu.jobs import core as jobs_core
-    task = task_lib.Task.from_yaml_config(payload['task'])
-    job_id = jobs_core.launch(task, name=payload.get('name'))
+    with _request_user(payload):
+        task = task_lib.Task.from_yaml_config(payload['task'])
+        job_id = jobs_core.launch(task, name=payload.get('name'))
     return {'job_id': job_id}
 
 
@@ -142,9 +155,10 @@ def _jobs_cancel(payload: Dict[str, Any]) -> List[int]:
 @entrypoint('serve.up')
 def _serve_up(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu.serve import core as serve_core
-    task = task_lib.Task.from_yaml_config(payload['task'])
-    endpoint_url = serve_core.up(task,
-                                 service_name=payload.get('service_name'))
+    with _request_user(payload):
+        task = task_lib.Task.from_yaml_config(payload['task'])
+        endpoint_url = serve_core.up(
+            task, service_name=payload.get('service_name'))
     return {'endpoint': endpoint_url}
 
 

@@ -41,10 +41,60 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
              ) -> web.Application:
     """Build the app.  pool=None -> inline execution (test mode, the
     reference's TestClient trick)."""
-    app = web.Application()
+    from skypilot_tpu.server import auth as auth_lib
+    app = web.Application(middlewares=[auth_lib.auth_middleware])
     routes = web.RouteTableDef()
 
-    def schedule(name: str, payload: dict) -> web.Response:
+    # Request names whose execution lands resources in a workspace; these
+    # get a workspace-permission pre-check under auth enforcement
+    # (reference: workspaces/core.reject_request_for_unauthorized_workspace
+    # applied on the execution path).
+    _WORKSPACE_SCOPED = {'launch', 'exec', 'jobs.launch', 'serve.up'}
+    # Ops against an existing cluster are authorized against THAT cluster's
+    # recorded workspace — the client-claimed active_workspace only governs
+    # where NEW clusters land.
+    _CLUSTER_SCOPED = {'launch', 'exec', 'start', 'stop', 'down',
+                       'autostop', 'queue', 'cancel'}
+
+    def _authorize_workspace(name: str, payload: dict,
+                             user_id: str) -> Optional[str]:
+        """Returns an error message, or None if authorized."""
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.users import permission
+        from skypilot_tpu.workspaces import core as ws_core
+        svc = permission.permission_service
+        if name in _CLUSTER_SCOPED:
+            cluster_name = payload.get('cluster_name')
+            record = (state_lib.get_cluster(cluster_name)
+                      if cluster_name else None)
+            if record is not None:
+                ws = record.get('workspace') or 'default'
+                if not svc.check_workspace_permission(user_id, ws):
+                    return (f'user {user_id!r} has no access to cluster '
+                            f'{cluster_name!r} in workspace {ws!r}')
+                return None  # existing cluster: its workspace governs
+        if name in _WORKSPACE_SCOPED:
+            task_cfg = (payload.get('task') or {}).get('config') or {}
+            workspace = (task_cfg.get('active_workspace') or
+                         ws_core.get_active_workspace())
+            if workspace not in ws_core.get_workspaces():
+                return f'workspace {workspace!r} does not exist'
+            if not svc.check_workspace_permission(user_id, workspace):
+                return (f'user {user_id!r} has no access to workspace '
+                        f'{workspace!r}')
+        return None
+
+    def schedule(name: str, payload: dict, user_id: Optional[str] = None
+                 ) -> web.Response:
+        payload.pop('_user_hash', None)  # never trust a client-sent value
+        from skypilot_tpu import config as config_lib
+        enforce = config_lib.get_nested(('api_server', 'auth_enabled'),
+                                        default_value=False)
+        if enforce and user_id:
+            error = _authorize_workspace(name, payload, user_id)
+            if error is not None:
+                return _json_error(403, error)
+            payload['_user_hash'] = user_id
         request_id = executor_lib.schedule_request(name, payload, pool=pool)
         return web.json_response({'request_id': request_id}, status=202)
 
@@ -68,7 +118,7 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
                     payload = await request.json()
                 except json.JSONDecodeError:
                     payload = {}
-                return schedule(name, payload)
+                return schedule(name, payload, request.get('user_id'))
             return handler
         app.router.add_post(route_path, _make(request_name))
 
@@ -188,6 +238,13 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
         return resp
 
     app.add_routes(routes)
+
+    # Users / workspaces routers (reference: FastAPI sub-routers mounted on
+    # the main app, sky/users/server.py + sky/workspaces/server.py).
+    from skypilot_tpu.users import server as users_server
+    from skypilot_tpu.workspaces import server as workspaces_server
+    users_server.add_routes(app)
+    workspaces_server.add_routes(app)
     return app
 
 

@@ -111,21 +111,58 @@ def _conn() -> sqlite3.Connection:
     conn.execute('PRAGMA journal_mode=WAL')
     conn.row_factory = sqlite3.Row
     conn.executescript(_SCHEMA)
+    _migrate(conn, path)
     return conn
 
 
+_migrated_paths = set()
+
+
+def _migrate(conn: sqlite3.Connection, path: str) -> None:
+    """Additive column migrations, once per DB path per process (the
+    reference versions its DB via alembic, sky/utils/db/migration_utils.py;
+    sqlite ALTER-if-missing suffices here)."""
+    if path in _migrated_paths:
+        return
+    cols = {r['name'] for r in conn.execute('PRAGMA table_info(clusters)')}
+    for col, decl in (('workspace', "TEXT DEFAULT 'default'"),
+                      ('user_hash', 'TEXT')):
+        if col not in cols:
+            try:
+                conn.execute(f'ALTER TABLE clusters ADD COLUMN {col} {decl}')
+            except sqlite3.OperationalError as e:
+                # Lost a cross-process race to another first connection.
+                if 'duplicate column name' not in str(e):
+                    raise
+    _migrated_paths.add(path)
+
+
 def add_or_update_cluster(handle: ClusterHandle, status: ClusterStatus,
-                          autostop: Optional[Dict[str, Any]] = None) -> None:
+                          autostop: Optional[Dict[str, Any]] = None,
+                          workspace: Optional[str] = None,
+                          user_hash: Optional[str] = None) -> None:
+    if workspace is None:
+        from skypilot_tpu.workspaces import core as workspaces_core
+        workspace = workspaces_core.get_active_workspace()
+    if user_hash is None:
+        from skypilot_tpu import config
+        from skypilot_tpu.utils import common_utils
+        # Attribute to the API-server caller when one is on record
+        # (threaded via the per-request config context), else local user.
+        user_hash = (config.get_nested(('requesting_user',)) or
+                     common_utils.get_user_hash())
     with _conn() as conn:
         conn.execute(
             'INSERT INTO clusters (name, launched_at, handle_json, status, '
-            'last_use, autostop_json) VALUES (?, ?, ?, ?, ?, ?) '
+            'last_use, autostop_json, workspace, user_hash) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?) '
             'ON CONFLICT(name) DO UPDATE SET handle_json = excluded.'
             'handle_json, status = excluded.status, last_use = excluded.'
             'last_use, autostop_json = excluded.autostop_json',
             (handle.cluster_name, handle.launched_at,
              json.dumps(handle.to_dict()), status.value,
-             str(time.time()), json.dumps(autostop or {})))
+             str(time.time()), json.dumps(autostop or {}),
+             workspace, user_hash))
 
 
 def set_cluster_status(name: str, status: ClusterStatus) -> None:
@@ -144,12 +181,16 @@ def get_cluster(name: str) -> Optional[Dict[str, Any]]:
 
 
 def _row_to_record(row) -> Dict[str, Any]:
+    keys = row.keys()
     return {
         'name': row['name'],
         'launched_at': row['launched_at'],
         'handle': ClusterHandle.from_dict(json.loads(row['handle_json'])),
         'status': ClusterStatus(row['status']),
         'autostop': json.loads(row['autostop_json'] or '{}'),
+        'workspace': (row['workspace'] if 'workspace' in keys else
+                      'default') or 'default',
+        'user_hash': row['user_hash'] if 'user_hash' in keys else None,
     }
 
 

@@ -1,0 +1,12 @@
+"""User accounts, roles, and service-account tokens.
+
+Reference parity: sky/users/ (rbac.py, permission.py, token_service.py,
+server.py).  The policy engine is a small native implementation over
+sqlite (the reference uses casbin + sqlalchemy-adapter) with the same
+semantics: per-user roles, per-role endpoint blocklists, and per-workspace
+allowed-user policies.
+"""
+from skypilot_tpu.users.models import User
+from skypilot_tpu.users.rbac import RoleName
+
+__all__ = ['User', 'RoleName']

@@ -1,0 +1,35 @@
+"""User model (reference parity: sky/models.py User dataclass)."""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class User:
+    """A user known to the API server.
+
+    id is a stable opaque hash (the client-side user hash for humans, or a
+    `sa-...` id for service accounts); name is the display/login name.
+    """
+    id: str
+    name: Optional[str] = None
+    password_hash: Optional[str] = None
+    created_at: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {'id': self.id, 'name': self.name,
+                'created_at': self.created_at}
+
+    @classmethod
+    def from_row(cls, row) -> 'User':
+        return cls(id=row['id'], name=row['name'],
+                   password_hash=row['password_hash'],
+                   created_at=row['created_at'])
+
+    @classmethod
+    def new(cls, user_id: str, name: Optional[str] = None,
+            password_hash: Optional[str] = None) -> 'User':
+        return cls(id=user_id, name=name, password_hash=password_hash,
+                   created_at=time.time())

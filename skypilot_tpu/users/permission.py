@@ -1,0 +1,104 @@
+"""Permission service: role + workspace policy enforcement.
+
+Reference parity: sky/users/permission.py PermissionService (casbin
+enforcer).  This native version keeps the same surface —
+add_user_if_not_exists / update_role / get_user_roles /
+check_endpoint_permission / workspace policy CRUD — backed by the sqlite
+tables in users/state.py and a filelock for policy updates.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import List
+
+import filelock
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.users import rbac
+from skypilot_tpu.users import state as users_state
+from skypilot_tpu.users.models import User
+
+logger = sky_logging.init_logger(__name__)
+
+_POLICY_LOCK_PATH = '~/.skypilot_tpu/.policy_update.lock'
+_POLICY_LOCK_TIMEOUT = 20
+
+
+@contextlib.contextmanager
+def _policy_lock():
+    path = os.path.expanduser(_POLICY_LOCK_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with filelock.FileLock(path, timeout=_POLICY_LOCK_TIMEOUT):
+        yield
+
+
+class PermissionService:
+    """Role and workspace-policy checks for the API server."""
+
+    def add_user_if_not_exists(self, user_id: str) -> None:
+        with _policy_lock():
+            self._add_user_no_lock(user_id)
+
+    def _add_user_no_lock(self, user_id: str) -> bool:
+        if users_state.get_role(user_id) is not None:
+            return False
+        users_state.add_or_update_user(User.new(user_id))
+        users_state.set_role(user_id, rbac.get_default_role())
+        return True
+
+    def delete_user(self, user_id: str) -> None:
+        with _policy_lock():
+            users_state.delete_user(user_id)
+
+    def update_role(self, user_id: str, new_role: str) -> None:
+        if new_role not in rbac.get_supported_roles():
+            raise ValueError(f'Unsupported role {new_role!r}; expected one '
+                             f'of {rbac.get_supported_roles()}')
+        with _policy_lock():
+            self._add_user_no_lock(user_id)
+            users_state.set_role(user_id, new_role)
+
+    def get_user_roles(self, user_id: str) -> List[str]:
+        role = users_state.get_role(user_id)
+        return [role] if role else []
+
+    def get_users_for_role(self, role: str) -> List[str]:
+        return users_state.users_with_role(role)
+
+    def check_endpoint_permission(self, user_id: str, path: str,
+                                  method: str) -> bool:
+        """True if allowed.  Unknown users get the default role."""
+        roles = self.get_user_roles(user_id)
+        if not roles:
+            self.add_user_if_not_exists(user_id)
+            roles = self.get_user_roles(user_id)
+        return not any(rbac.role_blocks(r, path, method) for r in roles)
+
+    # --- workspace policies (private workspaces) ---
+
+    def add_workspace_policy(self, workspace_name: str,
+                             users: List[str]) -> None:
+        with _policy_lock():
+            users_state.set_workspace_users(workspace_name, users)
+
+    def update_workspace_policy(self, workspace_name: str,
+                                users: List[str]) -> None:
+        with _policy_lock():
+            users_state.set_workspace_users(workspace_name, users)
+
+    def remove_workspace_policy(self, workspace_name: str) -> None:
+        with _policy_lock():
+            users_state.remove_workspace(workspace_name)
+
+    def check_workspace_permission(self, user_id: str,
+                                   workspace_name: str) -> bool:
+        """Admins see everything; otherwise the workspace must be public
+        ('*' policy or no policy) or explicitly include the user."""
+        if rbac.RoleName.ADMIN.value in self.get_user_roles(user_id):
+            return True
+        allowed = users_state.workspace_users(workspace_name)
+        return (not allowed) or ('*' in allowed) or (user_id in allowed)
+
+
+permission_service = PermissionService()

@@ -1,0 +1,65 @@
+"""Role-based access control: roles and per-role endpoint blocklists.
+
+Reference parity: sky/users/rbac.py — two built-in roles (admin/user), a
+default-role config knob, and config-overridable per-role blocklists of
+(path, method) endpoint patterns.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List
+
+from skypilot_tpu import config
+
+
+class RoleName(str, enum.Enum):
+    ADMIN = 'admin'
+    USER = 'user'
+
+
+# Endpoints a plain 'user' may not hit (workspace/user CUD; mirrors the
+# reference's _DEFAULT_USER_BLOCKLIST, sky/users/rbac.py:15-39).
+_DEFAULT_USER_BLOCKLIST: List[Dict[str, str]] = [
+    {'path': '/workspaces/create', 'method': 'POST'},
+    {'path': '/workspaces/update', 'method': 'POST'},
+    {'path': '/workspaces/delete', 'method': 'POST'},
+    {'path': '/workspaces/config', 'method': 'POST'},
+    {'path': '/users/create', 'method': 'POST'},
+    {'path': '/users/delete', 'method': 'POST'},
+    {'path': '/users/update', 'method': 'POST'},
+]
+
+
+def get_supported_roles() -> List[str]:
+    return [r.value for r in RoleName]
+
+
+def get_default_role() -> str:
+    return config.get_nested(('rbac', 'default_role'),
+                             default_value=RoleName.ADMIN.value)
+
+
+def get_role_permissions() -> Dict[str, Dict[str, List[Dict[str, str]]]]:
+    """{role: {'blocklist': [{'path','method'}, ...]}} with config overrides
+    (config key rbac.roles.<role>.blocklist)."""
+    perms: Dict[str, Dict[str, List[Dict[str, str]]]] = {
+        RoleName.ADMIN.value: {'blocklist': []},
+        RoleName.USER.value: {'blocklist': list(_DEFAULT_USER_BLOCKLIST)},
+    }
+    overrides = config.get_nested(('rbac', 'roles'), default_value=None)
+    if isinstance(overrides, dict):
+        for role, spec in overrides.items():
+            if isinstance(spec, dict) and 'blocklist' in spec:
+                perms.setdefault(role, {})['blocklist'] = spec['blocklist']
+    return perms
+
+
+def role_blocks(role: str, path: str, method: str) -> bool:
+    """True if `role` is blocked from `method path`."""
+    perms = get_role_permissions()
+    blocklist = perms.get(role, {}).get('blocklist', [])
+    for entry in blocklist:
+        if (path.rstrip('/') == entry['path'].rstrip('/') and
+                method.upper() == entry['method'].upper()):
+            return True
+    return False

@@ -1,0 +1,175 @@
+"""User/token REST endpoints (reference parity: sky/users/server.py).
+
+Registered onto the main API server app by server.make_app.  These are
+synchronous (no request queue): user CRUD is cheap and the reference serves
+them directly from FastAPI routers the same way.
+"""
+from __future__ import annotations
+
+from aiohttp import web
+
+from skypilot_tpu.users import permission
+from skypilot_tpu.users import rbac
+from skypilot_tpu.users import state as users_state
+from skypilot_tpu.users import token_service
+from skypilot_tpu.users.models import User
+
+
+def _svc() -> permission.PermissionService:
+    return permission.permission_service
+
+
+async def json_body(request: web.Request):
+    """Parse the JSON body; None on malformed input (caller returns 400)."""
+    import json
+    try:
+        return await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None
+
+
+_BAD_JSON = {'error': 'request body must be valid JSON'}
+
+
+def add_routes(app: web.Application) -> None:
+    routes = web.RouteTableDef()
+
+    @routes.get('/users/list')
+    async def users_list(request: web.Request) -> web.Response:
+        out = []
+        for user in users_state.list_users():
+            roles = _svc().get_user_roles(user.id)
+            out.append({**user.to_dict(), 'role': roles[0] if roles else
+                        rbac.get_default_role()})
+        return web.json_response({'users': out})
+
+    @routes.post('/users/create')
+    async def users_create(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        name = payload.get('name')
+        if not name:
+            return web.json_response({'error': 'name required'}, status=400)
+        if users_state.get_user_by_name(name) is not None:
+            return web.json_response(
+                {'error': f'user {name!r} already exists'}, status=409)
+        password = payload.get('password')
+        role = payload.get('role', rbac.get_default_role())
+        if role not in rbac.get_supported_roles():
+            return web.json_response(
+                {'error': f'unsupported role {role!r}'}, status=400)
+        user = User.new(f'user-{name}', name=name,
+                        password_hash=(users_state.hash_password(password)
+                                       if password else None))
+        users_state.add_or_update_user(user)
+        _svc().update_role(user.id, role)
+        return web.json_response({'id': user.id, 'name': name, 'role': role})
+
+    @routes.post('/users/update')
+    async def users_update(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        user_id = payload.get('id')
+        if not user_id or users_state.get_user(user_id) is None:
+            return web.json_response({'error': f'no user {user_id!r}'},
+                                     status=404)
+        if 'role' in payload:
+            try:
+                _svc().update_role(user_id, payload['role'])
+            except ValueError as e:
+                return web.json_response({'error': str(e)}, status=400)
+        if 'password' in payload:
+            users_state.add_or_update_user(User(
+                id=user_id,
+                password_hash=users_state.hash_password(
+                    payload['password'])))
+        return web.json_response({'ok': True})
+
+    @routes.post('/users/delete')
+    async def users_delete(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        user_id = payload.get('id')
+        if not user_id:
+            return web.json_response({'error': 'id required'}, status=400)
+        _svc().delete_user(user_id)
+        return web.json_response({'ok': True})
+
+    def _caller_is_admin(request: web.Request) -> bool:
+        """Under auth enforcement: does the caller hold the admin role?
+        Without enforcement (single-user mode) everyone is the owner."""
+        from skypilot_tpu import config
+        if not config.get_nested(('api_server', 'auth_enabled'),
+                                 default_value=False):
+            return True
+        caller = request.get('user_id')
+        if not caller:
+            return False
+        _svc().add_user_if_not_exists(caller)
+        return rbac.RoleName.ADMIN.value in _svc().get_user_roles(caller)
+
+    @routes.post('/users/token/create')
+    async def token_create(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        target_user = payload.get('user_id')
+        caller = request.get('user_id')
+        is_admin = _caller_is_admin(request)
+        # Minting a token that authenticates as a DIFFERENT existing user
+        # is privilege delegation: admins only (otherwise any plain user
+        # could mint an admin bearer token and skip RBAC entirely).
+        if target_user and caller and target_user != caller and not is_admin:
+            return web.json_response(
+                {'error': 'only admins may mint tokens for other users'},
+                status=403)
+        result = token_service.create_token(
+            name=payload.get('name', 'token'),
+            user_id=target_user,
+            expires_in_days=payload.get('expires_in_days', 30),
+            created_by=caller)
+        # A fresh service-account user must not out-rank its creator: it
+        # inherits the caller's role (default-role self-registration would
+        # hand a plain user an admin bearer token).
+        if (not target_user and caller and not is_admin):
+            _svc().update_role(result['user_id'],
+                               rbac.RoleName.USER.value)
+        return web.json_response(result)
+
+    @routes.get('/users/token/list')
+    async def token_list(request: web.Request) -> web.Response:
+        user_filter = request.query.get('user_id')
+        if not _caller_is_admin(request):
+            # Plain users only see tokens they created (incl. their SAs').
+            caller = request.get('user_id')
+            tokens = [t for t in token_service.list_tokens(user_filter)
+                      if t['created_by'] == caller or
+                      t['user_id'] == caller]
+        else:
+            tokens = token_service.list_tokens(user_filter)
+        return web.json_response({'tokens': tokens})
+
+    @routes.post('/users/token/revoke')
+    async def token_revoke(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        token_id = payload.get('token_id')
+        if not token_id:
+            return web.json_response({'error': 'token_id required'},
+                                     status=400)
+        if not _caller_is_admin(request):
+            from skypilot_tpu.users import state as users_state
+            row = users_state.get_token(token_id)
+            caller = request.get('user_id')
+            if row is None or (row['created_by'] != caller and
+                               row['user_id'] != caller):
+                return web.json_response(
+                    {'error': 'not your token'}, status=403)
+        token_service.revoke_token(token_id)
+        return web.json_response({'ok': True})
+
+    app.add_routes(routes)

@@ -1,0 +1,233 @@
+"""Users/roles/tokens DB (sqlite).
+
+Reference parity: user rows live in sky/global_user_state.py's users table;
+role assignments in casbin's rule table; service-account tokens in
+sky/users/token_service.py's table.  Here all three live in one sqlite DB
+under ~/.skypilot_tpu/users.db.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import sqlite3
+import time
+from typing import List, Optional
+
+from skypilot_tpu.users.models import User
+
+_DB_PATH = '~/.skypilot_tpu/users.db'
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS users (
+    id TEXT PRIMARY KEY,
+    name TEXT,
+    password_hash TEXT,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS user_roles (
+    user_id TEXT PRIMARY KEY,
+    role TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS workspace_policies (
+    workspace TEXT NOT NULL,
+    user_id TEXT NOT NULL,
+    PRIMARY KEY (workspace, user_id)
+);
+CREATE TABLE IF NOT EXISTS tokens (
+    token_id TEXT PRIMARY KEY,
+    token_hash TEXT NOT NULL,
+    name TEXT,
+    user_id TEXT NOT NULL,
+    created_by TEXT,
+    created_at REAL,
+    expires_at REAL,
+    revoked INTEGER DEFAULT 0,
+    last_used_at REAL
+);
+"""
+
+
+def _conn() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA)
+    return conn
+
+
+_PBKDF2_ITERATIONS = 100_000
+
+
+def hash_password(password: str) -> str:
+    """pbkdf2$<iters>$<salt>$<hash> with a random per-user salt."""
+    import secrets
+    salt = secrets.token_hex(16)
+    digest = hashlib.pbkdf2_hmac('sha256', password.encode(),
+                                 bytes.fromhex(salt),
+                                 _PBKDF2_ITERATIONS).hex()
+    return f'pbkdf2${_PBKDF2_ITERATIONS}${salt}${digest}'
+
+
+def verify_password(password: str, stored: str) -> bool:
+    import hmac as hmac_lib
+    try:
+        scheme, iters, salt, digest = stored.split('$')
+    except ValueError:
+        return False
+    if scheme != 'pbkdf2':
+        return False
+    candidate = hashlib.pbkdf2_hmac('sha256', password.encode(),
+                                    bytes.fromhex(salt), int(iters)).hex()
+    return hmac_lib.compare_digest(candidate, digest)
+
+
+# --- users ---
+
+def add_or_update_user(user: User) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO users (id, name, password_hash, created_at) '
+            'VALUES (?, ?, ?, ?) ON CONFLICT(id) DO UPDATE SET '
+            'name = COALESCE(excluded.name, name), '
+            'password_hash = COALESCE(excluded.password_hash, '
+            'password_hash)',
+            (user.id, user.name, user.password_hash,
+             user.created_at or time.time()))
+
+
+def get_user(user_id: str) -> Optional[User]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM users WHERE id = ?',
+                           (user_id,)).fetchone()
+    return User.from_row(row) if row else None
+
+
+def get_user_by_name(name: str) -> Optional[User]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM users WHERE name = ?',
+                           (name,)).fetchone()
+    return User.from_row(row) if row else None
+
+
+def list_users() -> List[User]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT * FROM users ORDER BY created_at'
+                            ).fetchall()
+    return [User.from_row(r) for r in rows]
+
+
+def delete_user(user_id: str) -> None:
+    with _conn() as conn:
+        # Offboarding also kills service accounts this user created —
+        # otherwise a deleted user keeps API access via their SA tokens.
+        sa_rows = conn.execute(
+            'SELECT DISTINCT user_id FROM tokens WHERE created_by = ? '
+            'AND user_id != ?', (user_id, user_id)).fetchall()
+        doomed = [user_id] + [r['user_id'] for r in sa_rows
+                              if r['user_id'].startswith('sa-')]
+        for uid in doomed:
+            conn.execute('DELETE FROM users WHERE id = ?', (uid,))
+            conn.execute('DELETE FROM user_roles WHERE user_id = ?', (uid,))
+            conn.execute('DELETE FROM workspace_policies WHERE user_id = ?',
+                         (uid,))
+            conn.execute('DELETE FROM tokens WHERE user_id = ?', (uid,))
+        conn.execute('DELETE FROM tokens WHERE created_by = ?', (user_id,))
+
+
+# --- roles ---
+
+def get_role(user_id: str) -> Optional[str]:
+    with _conn() as conn:
+        row = conn.execute('SELECT role FROM user_roles WHERE user_id = ?',
+                           (user_id,)).fetchone()
+    return row['role'] if row else None
+
+
+def set_role(user_id: str, role: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO user_roles (user_id, role) VALUES (?, ?) '
+            'ON CONFLICT(user_id) DO UPDATE SET role = excluded.role',
+            (user_id, role))
+
+
+def users_with_role(role: str) -> List[str]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT user_id FROM user_roles WHERE role = ?',
+                            (role,)).fetchall()
+    return [r['user_id'] for r in rows]
+
+
+# --- workspace policies ---
+
+def workspace_users(workspace: str) -> List[str]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT user_id FROM workspace_policies WHERE workspace = ?',
+            (workspace,)).fetchall()
+    return [r['user_id'] for r in rows]
+
+
+def set_workspace_users(workspace: str, user_ids: List[str]) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM workspace_policies WHERE workspace = ?',
+                     (workspace,))
+        conn.executemany(
+            'INSERT OR IGNORE INTO workspace_policies (workspace, user_id) '
+            'VALUES (?, ?)', [(workspace, u) for u in user_ids])
+
+
+def remove_workspace(workspace: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM workspace_policies WHERE workspace = ?',
+                     (workspace,))
+
+
+def workspaces_for_user(user_id: str) -> List[str]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT DISTINCT workspace FROM workspace_policies '
+            'WHERE user_id = ? OR user_id = ?', (user_id, '*')).fetchall()
+    return [r['workspace'] for r in rows]
+
+
+# --- tokens ---
+
+def add_token(token_id: str, token_hash: str, name: str, user_id: str,
+              expires_at: Optional[float],
+              created_by: Optional[str] = None) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO tokens (token_id, token_hash, name, user_id, '
+            'created_by, created_at, expires_at) VALUES (?, ?, ?, ?, ?, '
+            '?, ?)',
+            (token_id, token_hash, name, user_id, created_by, time.time(),
+             expires_at))
+
+
+def get_token(token_id: str) -> Optional[sqlite3.Row]:
+    with _conn() as conn:
+        return conn.execute('SELECT * FROM tokens WHERE token_id = ?',
+                            (token_id,)).fetchone()
+
+
+def list_tokens(user_id: Optional[str] = None) -> List[sqlite3.Row]:
+    with _conn() as conn:
+        if user_id is None:
+            return conn.execute('SELECT * FROM tokens').fetchall()
+        return conn.execute('SELECT * FROM tokens WHERE user_id = ?',
+                            (user_id,)).fetchall()
+
+
+def revoke_token(token_id: str) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE tokens SET revoked = 1 WHERE token_id = ?',
+                     (token_id,))
+
+
+def touch_token(token_id: str) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE tokens SET last_used_at = ? WHERE token_id = ?',
+                     (time.time(), token_id))

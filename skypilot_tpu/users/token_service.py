@@ -1,0 +1,101 @@
+"""Service-account tokens: mint/verify HMAC-signed bearer tokens.
+
+Reference parity: sky/users/token_service.py (JWT service-account tokens
+checked by an auth middleware).  PyJWT is not a baked-in dependency, so
+tokens are HMAC-SHA256-signed with a server-local secret:
+
+    skytpu_sa_<token_id>.<signature>
+
+The signature covers token_id; the DB row (users/state.py tokens table)
+holds the salted hash of the full token plus expiry/revocation state, so
+a leaked DB cannot forge tokens and a leaked secret cannot resurrect a
+revoked one.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import secrets
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.users import state as users_state
+from skypilot_tpu.users.models import User
+
+_SECRET_PATH = '~/.skypilot_tpu/token_secret'
+TOKEN_PREFIX = 'skytpu_sa_'
+
+
+def _server_secret() -> bytes:
+    path = os.path.expanduser(_SECRET_PATH)
+    if not os.path.exists(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'wb') as f:
+            f.write(secrets.token_bytes(32))
+        os.chmod(path, 0o600)
+    with open(path, 'rb') as f:
+        return f.read()
+
+
+def _sign(token_id: str) -> str:
+    return hmac.new(_server_secret(), token_id.encode(),
+                    hashlib.sha256).hexdigest()[:32]
+
+
+def _token_hash(token: str) -> str:
+    return hashlib.sha256(('skytpu-token' + token).encode()).hexdigest()
+
+
+def create_token(name: str, user_id: Optional[str] = None,
+                 expires_in_days: Optional[float] = 30,
+                 created_by: Optional[str] = None) -> Dict[str, Any]:
+    """Mint a token.  Returns {'token', 'token_id', 'user_id'} — the full
+    token is shown once and only its hash is stored."""
+    token_id = secrets.token_hex(8)
+    sa_user_id = user_id or f'sa-{token_id}'
+    token = f'{TOKEN_PREFIX}{token_id}.{_sign(token_id)}'
+    expires_at = (time.time() + expires_in_days * 86400
+                  if expires_in_days else None)
+    if users_state.get_user(sa_user_id) is None:
+        # Only fresh service accounts get a user row; minting a token for
+        # an existing user must not clobber their display name.
+        users_state.add_or_update_user(User.new(sa_user_id, name=name))
+    users_state.add_token(token_id, _token_hash(token), name, sa_user_id,
+                          expires_at, created_by=created_by or sa_user_id)
+    return {'token': token, 'token_id': token_id, 'user_id': sa_user_id}
+
+
+def verify_token(token: str) -> Optional[str]:
+    """Token -> user_id if valid (signature, hash, unrevoked, unexpired)."""
+    if not token.startswith(TOKEN_PREFIX):
+        return None
+    body = token[len(TOKEN_PREFIX):]
+    if '.' not in body:
+        return None
+    token_id, sig = body.split('.', 1)
+    if not hmac.compare_digest(sig, _sign(token_id)):
+        return None
+    row = users_state.get_token(token_id)
+    if row is None or row['revoked']:
+        return None
+    if not hmac.compare_digest(row['token_hash'], _token_hash(token)):
+        return None
+    if row['expires_at'] is not None and time.time() > row['expires_at']:
+        return None
+    users_state.touch_token(token_id)
+    return row['user_id']
+
+
+def list_tokens(user_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    return [{
+        'token_id': r['token_id'], 'name': r['name'],
+        'user_id': r['user_id'], 'created_by': r['created_by'],
+        'created_at': r['created_at'],
+        'expires_at': r['expires_at'], 'revoked': bool(r['revoked']),
+        'last_used_at': r['last_used_at'],
+    } for r in users_state.list_tokens(user_id)]
+
+
+def revoke_token(token_id: str) -> None:
+    users_state.revoke_token(token_id)

@@ -1,0 +1,10 @@
+"""Workspaces: multi-tenant grouping of clusters/jobs/services.
+
+Reference parity: sky/workspaces/ (core.py, server.py).
+"""
+from skypilot_tpu.workspaces.core import (create_workspace, delete_workspace,
+                                          get_workspaces, update_workspace,
+                                          workspaces_for_user)
+
+__all__ = ['create_workspace', 'delete_workspace', 'get_workspaces',
+           'update_workspace', 'workspaces_for_user']

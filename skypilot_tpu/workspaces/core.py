@@ -1,0 +1,177 @@
+"""Workspace management core.
+
+Reference parity: sky/workspaces/core.py — workspaces live in the server's
+config store under the `workspaces:` key; CRUD validates under a lock;
+`default` always exists and cannot be deleted; a workspace with active
+clusters cannot be deleted; `private: true` workspaces are visible only to
+`allowed_users` (enforced via users/permission.py policies).
+
+Here the store is ~/.skypilot_tpu/workspaces.yaml guarded by a filelock
+(the reference mutates the server's config.yaml the same way).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Callable, Dict, List
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import state
+from skypilot_tpu.users import permission
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_WORKSPACE = 'default'
+_STORE_PATH = '~/.skypilot_tpu/workspaces.yaml'
+_LOCK_PATH = '~/.skypilot_tpu/.workspaces.lock'
+_LOCK_TIMEOUT = 60
+
+# Keys allowed in a workspace config (reference: workspace schema in
+# sky/utils/schemas.py — cloud filters, private, allowed_users).
+_ALLOWED_KEYS = {'private', 'allowed_users', 'gcp', 'disabled'}
+
+
+@contextlib.contextmanager
+def _lock():
+    path = os.path.expanduser(_LOCK_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with filelock.FileLock(path, timeout=_LOCK_TIMEOUT):
+        yield
+
+
+def _load() -> Dict[str, Any]:
+    path = os.path.expanduser(_STORE_PATH)
+    if os.path.exists(path):
+        workspaces = common_utils.read_yaml(path) or {}
+    else:
+        workspaces = {}
+    workspaces.setdefault(DEFAULT_WORKSPACE, {})
+    return workspaces
+
+
+def _save(workspaces: Dict[str, Any]) -> None:
+    common_utils.dump_yaml(os.path.expanduser(_STORE_PATH), workspaces)
+
+
+def get_workspaces() -> Dict[str, Any]:
+    """All workspaces ({name: config}); always includes 'default'."""
+    return _load()
+
+
+def _validate_config(name: str, workspace_config: Dict[str, Any]) -> None:
+    if not name or '/' in name:
+        raise exceptions.InvalidTaskError(
+            f'Invalid workspace name {name!r}')
+    if not isinstance(workspace_config, dict):
+        raise exceptions.InvalidTaskError(
+            f'Workspace config for {name!r} must be a mapping, got '
+            f'{type(workspace_config).__name__}')
+    unknown = set(workspace_config) - _ALLOWED_KEYS
+    if unknown:
+        raise exceptions.InvalidTaskError(
+            f'Unknown workspace config keys for {name!r}: {sorted(unknown)}'
+            f' (allowed: {sorted(_ALLOWED_KEYS)})')
+    if workspace_config.get('private') and not workspace_config.get(
+            'allowed_users'):
+        raise exceptions.InvalidTaskError(
+            f'Private workspace {name!r} needs a non-empty allowed_users')
+
+
+def _sync_policy(name: str, workspace_config: Dict[str, Any]) -> None:
+    if workspace_config.get('private'):
+        permission.permission_service.update_workspace_policy(
+            name, list(workspace_config.get('allowed_users', [])))
+    else:
+        permission.permission_service.update_workspace_policy(name, ['*'])
+
+
+def _update(name: str, fn: Callable[[Dict[str, Any]], None]) -> Dict[str, Any]:
+    with _lock():
+        workspaces = _load()
+        fn(workspaces)
+        _save(workspaces)
+        return workspaces
+
+
+def create_workspace(name: str,
+                     workspace_config: Dict[str, Any]) -> Dict[str, Any]:
+    _validate_config(name, workspace_config)
+
+    def _create(workspaces: Dict[str, Any]) -> None:
+        if name in workspaces:
+            raise exceptions.WorkspaceError(
+                f'Workspace {name!r} already exists')
+        workspaces[name] = workspace_config
+        _sync_policy(name, workspace_config)
+
+    return _update(name, _create)
+
+
+def update_workspace(name: str,
+                     workspace_config: Dict[str, Any]) -> Dict[str, Any]:
+    _validate_config(name, workspace_config)
+
+    def _do(workspaces: Dict[str, Any]) -> None:
+        workspaces[name] = workspace_config
+        _sync_policy(name, workspace_config)
+
+    return _update(name, _do)
+
+
+def active_clusters_in_workspace(name: str) -> List[str]:
+    return [r['name'] for r in state.get_clusters()
+            if r.get('workspace', DEFAULT_WORKSPACE) == name]
+
+
+def delete_workspace(name: str) -> Dict[str, Any]:
+    if name == DEFAULT_WORKSPACE:
+        raise exceptions.InvalidTaskError(
+            "The 'default' workspace cannot be deleted")
+
+    def _do(workspaces: Dict[str, Any]) -> None:
+        if name not in workspaces:
+            raise exceptions.WorkspaceError(
+                f'Workspace {name!r} does not exist')
+        # Active-cluster check runs INSIDE the lock so a concurrent launch
+        # cannot land a cluster between check and delete.
+        active = active_clusters_in_workspace(name)
+        if active:
+            raise exceptions.WorkspaceError(
+                f'Workspace {name!r} has active clusters {active}; tear '
+                'them down first')
+        del workspaces[name]
+        permission.permission_service.remove_workspace_policy(name)
+
+    return _update(name, _do)
+
+
+def workspaces_for_user(user_id: str) -> Dict[str, Any]:
+    """Workspaces this user may see (public + private-with-access)."""
+    out = {}
+    for name, ws_config in _load().items():
+        if not ws_config.get('private'):
+            out[name] = ws_config
+        elif permission.permission_service.check_workspace_permission(
+                user_id, name):
+            out[name] = ws_config
+    return out
+
+
+def get_active_workspace() -> str:
+    """The workspace new requests land in (config key active_workspace,
+    reference: skypilot_config.get_active_workspace)."""
+    from skypilot_tpu import config
+    return config.get_nested(('active_workspace',),
+                             default_value=DEFAULT_WORKSPACE)
+
+
+def reject_request_for_unauthorized_workspace(user_id: str) -> None:
+    ws = get_active_workspace()
+    if not permission.permission_service.check_workspace_permission(
+            user_id, ws):
+        raise exceptions.PermissionDeniedError(
+            f'User {user_id!r} has no access to workspace {ws!r}')

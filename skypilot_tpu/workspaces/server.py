@@ -1,0 +1,61 @@
+"""Workspace REST endpoints (reference parity: sky/workspaces/server.py)."""
+from __future__ import annotations
+
+from aiohttp import web
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.users.server import _BAD_JSON, json_body
+from skypilot_tpu.workspaces import core
+
+
+def add_routes(app: web.Application) -> None:
+    routes = web.RouteTableDef()
+
+    @routes.get('/workspaces')
+    async def workspaces_list(request: web.Request) -> web.Response:
+        from skypilot_tpu import config
+        enforce = config.get_nested(('api_server', 'auth_enabled'),
+                                    default_value=False)
+        user_id = request.get('user_id')
+        if enforce and user_id:
+            return web.json_response(core.workspaces_for_user(user_id))
+        # Single-user (no-auth) mode: the local user owns everything.
+        return web.json_response(core.get_workspaces())
+
+    @routes.post('/workspaces/create')
+    async def workspaces_create(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        return _mutate(core.create_workspace, payload)
+
+    @routes.post('/workspaces/update')
+    async def workspaces_update(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        return _mutate(core.update_workspace, payload)
+
+    @routes.post('/workspaces/delete')
+    async def workspaces_delete(request: web.Request) -> web.Response:
+        payload = await json_body(request)
+        if payload is None:
+            return web.json_response(_BAD_JSON, status=400)
+        name = payload.get('name', '')
+        try:
+            return web.json_response(core.delete_workspace(name))
+        except exceptions.SkyTpuError as e:
+            return web.json_response({'error': str(e)}, status=400)
+
+    def _mutate(fn, payload) -> web.Response:
+        name = payload.get('name', '')
+        config = payload.get('config', {})
+        try:
+            return web.json_response(fn(name, config))
+        except exceptions.WorkspaceError as e:
+            status = 409 if 'already exists' in str(e) else 400
+            return web.json_response({'error': str(e)}, status=status)
+        except exceptions.SkyTpuError as e:
+            return web.json_response({'error': str(e)}, status=400)
+
+    app.add_routes(routes)

@@ -27,7 +27,7 @@ def test_override_context(tmp_home):
 
 def test_override_rejects_non_allowlisted(tmp_home):
     with pytest.raises(exceptions.InvalidSkyPilotConfigError):
-        with config.override_config({'api_server': {'endpoint': 'x'}}):
+        with config.override_config({'usage': {'disabled': False}}):
             pass
 
 

@@ -1,0 +1,307 @@
+"""Users/RBAC/tokens + workspaces (analog of the reference's
+tests/unit_tests for sky/users and sky/workspaces)."""
+import time
+
+import pytest
+import requests
+
+from tests.test_api_server import live_server  # noqa: F401
+from tests.test_launch_e2e import iso_state  # noqa: F401
+
+
+# --- permission service / roles ---
+
+def test_user_roles_and_default(iso_state):  # noqa: F811
+    from skypilot_tpu.users import permission
+    svc = permission.PermissionService()
+    svc.add_user_if_not_exists('u1')
+    assert svc.get_user_roles('u1') == ['admin']  # default role
+    svc.update_role('u1', 'user')
+    assert svc.get_user_roles('u1') == ['user']
+    assert 'u1' in svc.get_users_for_role('user')
+    with pytest.raises(ValueError):
+        svc.update_role('u1', 'superuser')
+    svc.delete_user('u1')
+    assert svc.get_user_roles('u1') == []
+
+
+def test_rbac_endpoint_blocklist(iso_state):  # noqa: F811
+    from skypilot_tpu.users import permission
+    svc = permission.PermissionService()
+    svc.update_role('admin1', 'admin')
+    svc.update_role('plain1', 'user')
+    assert svc.check_endpoint_permission('admin1', '/users/create', 'POST')
+    assert not svc.check_endpoint_permission('plain1', '/users/create',
+                                             'POST')
+    assert not svc.check_endpoint_permission('plain1', '/workspaces/delete',
+                                             'POST')
+    # Non-blocked endpoints stay open to plain users.
+    assert svc.check_endpoint_permission('plain1', '/launch', 'POST')
+
+
+def test_default_role_configurable(iso_state, monkeypatch):  # noqa: F811
+    from skypilot_tpu import config
+    from skypilot_tpu.users import permission
+    # rbac config is server-side (not task-overridable): use the internal
+    # context, as the server would after loading its config file.
+    with config.override_context({'rbac': {'default_role': 'user'}}):
+        svc = permission.PermissionService()
+        svc.add_user_if_not_exists('u2')
+        assert svc.get_user_roles('u2') == ['user']
+
+
+def test_task_cannot_override_requesting_user(iso_state):  # noqa: F811
+    import pytest as _pytest
+    from skypilot_tpu import config
+    from skypilot_tpu import exceptions
+    with _pytest.raises(exceptions.InvalidSkyPilotConfigError):
+        with config.override_config({'requesting_user': 'victim'}):
+            pass
+
+
+# --- tokens ---
+
+def test_token_mint_verify_revoke(iso_state):  # noqa: F811
+    from skypilot_tpu.users import token_service
+    minted = token_service.create_token('ci-bot')
+    user_id = token_service.verify_token(minted['token'])
+    assert user_id == minted['user_id']
+    # Tampered token fails.
+    assert token_service.verify_token(minted['token'][:-1] + 'x') is None
+    assert token_service.verify_token('skytpu_sa_bogus.deadbeef') is None
+    listed = token_service.list_tokens()
+    assert any(t['token_id'] == minted['token_id'] and t['last_used_at']
+               for t in listed)
+    token_service.revoke_token(minted['token_id'])
+    assert token_service.verify_token(minted['token']) is None
+
+
+def test_token_expiry(iso_state):  # noqa: F811
+    from skypilot_tpu.users import state as users_state
+    from skypilot_tpu.users import token_service
+    minted = token_service.create_token('short', expires_in_days=1)
+    # Force-expire in the DB.
+    with users_state._conn() as conn:  # pylint: disable=protected-access
+        conn.execute('UPDATE tokens SET expires_at = ? WHERE token_id = ?',
+                     (time.time() - 1, minted['token_id']))
+    assert token_service.verify_token(minted['token']) is None
+
+
+# --- workspaces ---
+
+def test_workspace_crud(iso_state):  # noqa: F811
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.workspaces import core
+    assert 'default' in core.get_workspaces()
+    core.create_workspace('team-a', {})
+    assert 'team-a' in core.get_workspaces()
+    with pytest.raises(exceptions.SkyTpuError):
+        core.create_workspace('team-a', {})     # duplicate
+    with pytest.raises(exceptions.SkyTpuError):
+        core.create_workspace('bad', {'nope': 1})  # unknown key
+    with pytest.raises(exceptions.SkyTpuError):
+        core.delete_workspace('default')
+    core.delete_workspace('team-a')
+    assert 'team-a' not in core.get_workspaces()
+
+
+def test_private_workspace_visibility(iso_state):  # noqa: F811
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.users import permission
+    from skypilot_tpu.workspaces import core
+    svc = permission.permission_service
+    svc.update_role('alice', 'user')
+    svc.update_role('bob', 'user')
+    svc.update_role('root', 'admin')
+    with pytest.raises(exceptions.SkyTpuError):
+        core.create_workspace('secret', {'private': True})  # no users
+    core.create_workspace('secret',
+                          {'private': True, 'allowed_users': ['alice']})
+    assert 'secret' in core.workspaces_for_user('alice')
+    assert 'secret' not in core.workspaces_for_user('bob')
+    assert 'secret' in core.workspaces_for_user('root')  # admin sees all
+    # Flip to public: everyone sees it.
+    core.update_workspace('secret', {})
+    assert 'secret' in core.workspaces_for_user('bob')
+
+
+def test_workspace_delete_blocked_by_active_cluster(iso_state):  # noqa: F811
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import state
+    from skypilot_tpu.execution import launch
+    from skypilot_tpu.task import Task
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.workspaces import core
+    from skypilot_tpu import config
+
+    core.create_workspace('busy', {})
+    task = Task(name='t', run='echo hi')
+    task.set_resources(Resources(cloud='local'))
+    with config.override_config({'active_workspace': 'busy'}):
+        launch(task, cluster_name='ws-c1')
+    record = state.get_cluster('ws-c1')
+    assert record['workspace'] == 'busy'
+    with pytest.raises(exceptions.SkyTpuError):
+        core.delete_workspace('busy')
+    from skypilot_tpu.backends import TpuBackend
+    TpuBackend().teardown(record['handle'])
+    state.remove_cluster('ws-c1')
+    core.delete_workspace('busy')
+
+
+# --- REST + auth middleware ---
+
+def test_users_rest_roundtrip(live_server):  # noqa: F811
+    resp = requests.post(live_server + '/users/create',
+                         json={'name': 'carol', 'password': 'pw',
+                               'role': 'user'}, timeout=10)
+    assert resp.status_code == 200, resp.text
+    uid = resp.json()['id']
+    users = requests.get(live_server + '/users/list', timeout=10).json()
+    assert any(u['id'] == uid and u['role'] == 'user'
+               for u in users['users'])
+    # Duplicate name rejected.
+    assert requests.post(live_server + '/users/create',
+                         json={'name': 'carol'},
+                         timeout=10).status_code == 409
+    resp = requests.post(live_server + '/users/update',
+                         json={'id': uid, 'role': 'admin'}, timeout=10)
+    assert resp.status_code == 200
+    resp = requests.post(live_server + '/users/delete', json={'id': uid},
+                         timeout=10)
+    assert resp.status_code == 200
+
+
+def test_workspaces_rest_roundtrip(live_server):  # noqa: F811
+    resp = requests.post(live_server + '/workspaces/create',
+                         json={'name': 'ws-rest', 'config': {}}, timeout=10)
+    assert resp.status_code == 200, resp.text
+    listed = requests.get(live_server + '/workspaces', timeout=10).json()
+    assert 'ws-rest' in listed and 'default' in listed
+    resp = requests.post(live_server + '/workspaces/delete',
+                         json={'name': 'ws-rest'}, timeout=10)
+    assert resp.status_code == 200
+
+
+def test_auth_enforced_basic_and_token(live_server):  # noqa: F811
+    from skypilot_tpu.users import token_service
+    # Create a password user + a service-account token while auth is off.
+    requests.post(live_server + '/users/create',
+                  json={'name': 'dave', 'password': 's3cret',
+                        'role': 'user'}, timeout=10)
+    minted = token_service.create_token('ci')
+    # The server runs in another thread, so thread-local override_config
+    # can't reach it — write the user config file and reload (process-wide).
+    import os
+    from skypilot_tpu import config
+    cfg_path = os.environ['SKYTPU_CONFIG']
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('api_server:\n  auth_enabled: true\n')
+    config.reload_config()
+    try:
+        # Bad basic credentials -> 401.
+        resp = requests.get(live_server + '/users/list',
+                            auth=('dave', 'wrong'), timeout=10)
+        assert resp.status_code == 401
+        # Good basic credentials, but role 'user' blocked from POST
+        # /users/create -> 403.
+        resp = requests.post(live_server + '/users/create',
+                             json={'name': 'eve'}, auth=('dave', 's3cret'),
+                             timeout=10)
+        assert resp.status_code == 403
+        # user can still GET /users/list.
+        resp = requests.get(live_server + '/users/list',
+                            auth=('dave', 's3cret'), timeout=10)
+        assert resp.status_code == 200
+        # Bearer token works (sa users get the default role: admin).
+        resp = requests.get(
+            live_server + '/users/list',
+            headers={'Authorization': f'Bearer {minted["token"]}'},
+            timeout=10)
+        assert resp.status_code == 200
+        # Bogus bearer -> 401.
+        resp = requests.get(
+            live_server + '/users/list',
+            headers={'Authorization': 'Bearer skytpu_sa_x.y'}, timeout=10)
+        assert resp.status_code == 401
+        # No credentials at all -> 401 (credentials are mandatory under
+        # enforcement; the local-user fallback must not apply).
+        resp = requests.get(live_server + '/users/list', timeout=10)
+        assert resp.status_code == 401
+        # The identity header is NOT trusted outside proxy mode.
+        resp = requests.get(live_server + '/users/list',
+                            headers={'X-SkyTPU-User': 'anyone'}, timeout=10)
+        assert resp.status_code == 401
+        # Health stays open for probes.
+        resp = requests.get(live_server + '/api/health', timeout=10)
+        assert resp.status_code == 200
+        # A plain user cannot mint a token for another (admin) user.
+        resp = requests.post(
+            live_server + '/users/token/create',
+            json={'name': 'evil', 'user_id': 'user-someadmin'},
+            auth=('dave', 's3cret'), timeout=10)
+        assert resp.status_code == 403
+        # A plain user CAN mint their own SA token, but the SA inherits
+        # role 'user' — no default-admin escalation.
+        resp = requests.post(live_server + '/users/token/create',
+                             json={'name': 'dave-ci'},
+                             auth=('dave', 's3cret'), timeout=10)
+        assert resp.status_code == 200
+        sa = resp.json()
+        from skypilot_tpu.users import permission as perm
+        assert perm.permission_service.get_user_roles(
+            sa['user_id']) == ['user']
+        # dave sees only his own tokens; cannot revoke someone else's.
+        resp = requests.get(live_server + '/users/token/list',
+                            auth=('dave', 's3cret'), timeout=10)
+        listed = resp.json()['tokens']
+        assert all(t['user_id'] == sa['user_id'] for t in listed)
+        resp = requests.post(live_server + '/users/token/revoke',
+                             json={'token_id': minted['token_id']},
+                             auth=('dave', 's3cret'), timeout=10)
+        assert resp.status_code == 403
+        # ...but can revoke his own.
+        resp = requests.post(live_server + '/users/token/revoke',
+                             json={'token_id': sa['token_id']},
+                             auth=('dave', 's3cret'), timeout=10)
+        assert resp.status_code == 200
+    finally:
+        os.remove(cfg_path)
+        config.reload_config()
+
+
+def test_proxy_mode_trusts_identity_header(live_server):  # noqa: F811
+    import os
+    from skypilot_tpu import config
+    cfg_path = os.environ['SKYTPU_CONFIG']
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        f.write('api_server:\n  auth_enabled: true\n  auth_mode: proxy\n')
+    config.reload_config()
+    try:
+        resp = requests.get(live_server + '/users/list',
+                            headers={'X-SkyTPU-User': 'proxy-user'},
+                            timeout=10)
+        assert resp.status_code == 200
+    finally:
+        os.remove(cfg_path)
+        config.reload_config()
+
+
+def test_token_create_does_not_rename_user(iso_state):  # noqa: F811
+    from skypilot_tpu.users import state as users_state
+    from skypilot_tpu.users import token_service
+    from skypilot_tpu.users.models import User
+    users_state.add_or_update_user(User.new('user-carol', name='carol'))
+    token_service.create_token('ci-token', user_id='user-carol')
+    assert users_state.get_user_by_name('carol') is not None
+
+
+def test_password_hashing_pbkdf2(iso_state):  # noqa: F811
+    from skypilot_tpu.users import state as users_state
+    h1 = users_state.hash_password('pw')
+    h2 = users_state.hash_password('pw')
+    assert h1 != h2                      # per-user salt
+    assert h1.startswith('pbkdf2$')
+    assert users_state.verify_password('pw', h1)
+    assert not users_state.verify_password('wrong', h1)
+    assert not users_state.verify_password('pw', 'garbage')
