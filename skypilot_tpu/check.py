@@ -12,6 +12,7 @@ import time
 from typing import Any, Dict, List
 
 from skypilot_tpu import sky_logging
+import skypilot_tpu.clouds  # noqa: F401  (registers all clouds)
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 logger = sky_logging.init_logger(__name__)

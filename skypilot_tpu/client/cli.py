@@ -112,6 +112,7 @@ def _cmd_autostop(args) -> int:
 
 
 def _cmd_check(args) -> int:
+    import skypilot_tpu.clouds  # noqa: F401  (registers all clouds)
     from skypilot_tpu.utils.registry import CLOUD_REGISTRY
     ok_any = False
     for cloud in CLOUD_REGISTRY.values():

@@ -1,6 +1,9 @@
 from skypilot_tpu.clouds.cloud import Cloud, FeasibleResources
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.ssh import Ssh
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
-__all__ = ['Cloud', 'FeasibleResources', 'GCP', 'Local', 'CLOUD_REGISTRY']
+__all__ = ['Cloud', 'FeasibleResources', 'GCP', 'Kubernetes', 'Local',
+           'Ssh', 'CLOUD_REGISTRY']

@@ -1,0 +1,101 @@
+"""Kubernetes "cloud": pods as hosts, GKE TPU node pools for accelerators.
+
+Reference parity: sky/clouds/kubernetes.py + the ~7k-LoC
+sky/provision/kubernetes provisioner (pods-as-nodes).  Scoped TPU-first:
+plain CPU pods for controllers/dev boxes, and GKE TPU slices via
+`google.com/tpu` resource limits + gke-tpu-accelerator/topology
+nodeSelectors.  Credentials = a reachable kubectl context.
+"""
+from __future__ import annotations
+
+import functools
+import subprocess
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@functools.lru_cache(maxsize=1)
+def _kubectl_reachable() -> Tuple[bool, Optional[str]]:
+    try:
+        proc = subprocess.run(['kubectl', 'version', '--client',
+                               '-o', 'json'],
+                              capture_output=True, timeout=20, check=False)
+    except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+        return False, f'kubectl not available: {e}'
+    if proc.returncode != 0:
+        return False, f'kubectl errored: {proc.stderr.decode()[:200]}'
+    return True, None
+
+
+@CLOUD_REGISTRY.register()
+class Kubernetes(cloud_lib.Cloud):
+    _REPR = 'Kubernetes'
+    max_cluster_name_length = 45  # pod-name suffixes must fit DNS-1123
+
+    def supports_stop(self, resources) -> bool:
+        return False
+
+    def supports_autostop(self) -> bool:
+        return True
+
+    def _namespace(self) -> str:
+        return config_lib.get_nested(('kubernetes', 'namespace'),
+                                     default_value='default')
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> cloud_lib.FeasibleResources:
+        # Explicit opt-in, like local/ssh: k8s never competes on price.
+        if resources.cloud != 'kubernetes':
+            return cloud_lib.FeasibleResources([])
+        out = resources.copy(
+            cloud='kubernetes', region=resources.region or
+            self._namespace(), zone=None,
+            instance_type=resources.instance_type or 'pod',
+            _price_per_hour=0.0)
+        return cloud_lib.FeasibleResources([out])
+
+    def get_hourly_cost(self, resources) -> float:
+        return 0.0
+
+    def region_zones_provision_loop(
+            self, resources) -> Iterator[Tuple[str, List[str]]]:
+        yield (resources.region or self._namespace()), [None]
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        del zone
+        spec = resources.tpu_spec
+        out: Dict[str, Any] = {
+            'cluster_name': cluster_name,
+            'namespace': region,
+            'region': region,
+            'zone': None,
+            'context': config_lib.get_nested(('kubernetes', 'context')),
+            'image': config_lib.get_nested(
+                ('kubernetes', 'image'),
+                default_value='python:3.11-slim'),
+            'tpu_vm': spec is not None,
+            'num_hosts': spec.num_hosts if spec else 1,
+            'chips_per_host': spec.chips_per_host if spec else 0,
+        }
+        if resources.cpus:
+            out['cpus'] = str(resources.cpus).rstrip('+')
+        if resources.memory:
+            out['memory_gb'] = str(resources.memory).rstrip('+')
+        if spec is not None:
+            out['tpu_chips_per_host'] = spec.chips_per_host
+            out['tpu_accelerator'] = spec.gke_accelerator
+            out['tpu_topology'] = spec.topology
+        return out
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return _kubectl_reachable()

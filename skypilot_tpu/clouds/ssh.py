@@ -1,0 +1,88 @@
+"""SSH "cloud": bring-your-own machines from ~/.skypilot_tpu/
+ssh_node_pools.yaml.
+
+Reference parity: the `ssh` cloud backed by sky/provision/ssh +
+sky/ssh_node_pools (pools declared in ~/.sky/ssh_node_pools.yaml, each
+pool addressed as `infra: ssh/<pool>`).  Each pool is one "region"; hosts
+are claimed/released rather than created/terminated.  Good for on-prem TPU
+v4 racks or any machines reachable over SSH.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.ssh_node_pools.core import SSHNodePoolManager
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@CLOUD_REGISTRY.register()
+class Ssh(cloud_lib.Cloud):
+    _REPR = 'Ssh'
+    max_cluster_name_length = 63
+
+    def supports_stop(self, resources) -> bool:
+        return False  # BYO hosts have no stopped state
+
+    def supports_autostop(self) -> bool:
+        return True   # autostop-down releases hosts back to the pool
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> cloud_lib.FeasibleResources:
+        # Like the local cloud: only feasible when explicitly requested.
+        if resources.cloud != 'ssh':
+            return cloud_lib.FeasibleResources([])
+        pools = sorted(SSHNodePoolManager().get_all_pools())
+        if not pools:
+            return cloud_lib.FeasibleResources(
+                [], hint='No SSH node pools configured; add one to '
+                         '~/.skypilot_tpu/ssh_node_pools.yaml')
+        candidates = []
+        for pool in pools:
+            if resources.region and resources.region != pool:
+                continue
+            candidates.append(resources.copy(
+                cloud='ssh', region=pool, zone=None,
+                instance_type=resources.instance_type or 'ssh-node',
+                _price_per_hour=0.0))
+        return cloud_lib.FeasibleResources(candidates)
+
+    def get_hourly_cost(self, resources) -> float:
+        return 0.0  # you already own the machines
+
+    def region_zones_provision_loop(
+            self, resources) -> Iterator[Tuple[str, List[str]]]:
+        pools = sorted(SSHNodePoolManager().get_all_pools())
+        for pool in pools:
+            if resources.region and resources.region != pool:
+                continue
+            # One pseudo-zone per pool: the failover loop attempts each
+            # (region, zone) pair, and a pool is a single failure domain.
+            yield pool, [None]
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', cluster_name: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        spec = resources.tpu_spec
+        num_hosts = spec.num_hosts if spec is not None else 1
+        return {
+            'cluster_name': cluster_name,
+            'pool': region,
+            'region': region,
+            'zone': None,
+            'tpu_vm': spec is not None,
+            'num_hosts': num_hosts,
+            'chips_per_host': spec.chips_per_host if spec else 0,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        pools = SSHNodePoolManager().get_all_pools()
+        if not pools:
+            return False, ('No SSH node pools configured in '
+                           '~/.skypilot_tpu/ssh_node_pools.yaml')
+        return True, None

@@ -75,6 +75,9 @@ CREATE TABLE IF NOT EXISTS managed_jobs (
 """
 
 
+_MIGRATED: set = set()
+
+
 class JobsTable:
 
     def __init__(self, db_path: str = '~/.skypilot_tpu/managed_jobs.db'
@@ -83,15 +86,11 @@ class JobsTable:
         os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
-            cols = {r['name'] for r in
-                    conn.execute('PRAGMA table_info(managed_jobs)')}
-            if 'user_hash' not in cols:
-                try:
-                    conn.execute(
-                        'ALTER TABLE managed_jobs ADD COLUMN user_hash TEXT')
-                except sqlite3.OperationalError as e:
-                    if 'duplicate column name' not in str(e):
-                        raise
+            if self.db_path not in _MIGRATED:
+                from skypilot_tpu.utils import db_utils
+                db_utils.add_columns_if_missing(
+                    conn, 'managed_jobs', (('user_hash', 'TEXT'),))
+                _MIGRATED.add(self.db_path)
 
     def _conn(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self.db_path, timeout=30)

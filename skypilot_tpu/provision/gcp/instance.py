@@ -134,10 +134,11 @@ def run_instances(region: str, cluster_name: str,
 
 
 def wait_instances(region: str, cluster_name: str,
-                   state: Optional[str] = None) -> None:
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
     # run_instances polls creation ops to completion; READY check happens in
     # get_cluster_info.
-    del region, cluster_name, state
+    del region, cluster_name, state, provider_config
 
 
 def get_cluster_info(region: str, cluster_name: str,

@@ -1,0 +1,2 @@
+"""Kubernetes pods-as-hosts provisioner (reference parity:
+sky/provision/kubernetes/)."""

@@ -1,0 +1,216 @@
+"""Kubernetes instance CRUD: one pod per host, driven via kubectl.
+
+Reference parity: sky/provision/kubernetes/instance.py (pods-as-nodes,
+label-selected by cluster, head/worker roles, TPU resource requests via
+`google.com/tpu` + topology nodeSelectors on GKE).  The reference uses the
+python kubernetes SDK; this build shells out to kubectl (the SDK is not in
+the image), same as its kubectl fallbacks (instance.py
+is_high_availability_cluster_by_kubectl :69).
+
+provider config keys:
+    namespace (default 'default'), context (optional),
+    image (default python:3.11-slim), num_hosts, cpus, memory_gb,
+    tpu_chips_per_host + tpu_topology + tpu_accelerator (GKE TPU pods).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+
+logger = sky_logging.init_logger(__name__)
+
+LABEL_CLUSTER = 'skypilot-tpu/cluster'
+LABEL_ROLE = 'skypilot-tpu/role'
+_POD_READY_TIMEOUT = 600
+
+
+def _kubectl(args: List[str], *, context: Optional[str] = None,
+             namespace: Optional[str] = None,
+             stdin: Optional[str] = None) -> str:
+    argv = ['kubectl']
+    if context:
+        argv += ['--context', context]
+    if namespace:
+        argv += ['-n', namespace]
+    argv += args
+    proc = subprocess.run(argv, input=stdin, capture_output=True,
+                          text=True, timeout=120, check=False)
+    if proc.returncode != 0:
+        raise exceptions.ProvisionerError(
+            f'kubectl {" ".join(args[:2])} failed ({proc.returncode}): '
+            f'{proc.stderr.strip()[:500]}')
+    return proc.stdout
+
+
+def _pod_name(cluster_name: str, index: int) -> str:
+    return f'{cluster_name}-{"head" if index == 0 else f"worker{index}"}'
+
+
+def _pod_manifest(cluster_name: str, index: int,
+                  config: Dict[str, Any]) -> Dict[str, Any]:
+    resources: Dict[str, Any] = {}
+    limits: Dict[str, Any] = {}
+    if config.get('cpus'):
+        resources['cpu'] = str(config['cpus'])
+    if config.get('memory_gb'):
+        resources['memory'] = f'{config["memory_gb"]}Gi'
+    chips = int(config.get('tpu_chips_per_host', 0) or 0)
+    node_selector: Dict[str, str] = dict(config.get('node_selector') or {})
+    if chips:
+        # GKE TPU pods: chips are requested as google.com/tpu limits and
+        # the slice shape pinned by the topology nodeSelector.
+        limits['google.com/tpu'] = str(chips)
+        if config.get('tpu_accelerator'):
+            node_selector['cloud.google.com/gke-tpu-accelerator'] = str(
+                config['tpu_accelerator'])
+        if config.get('tpu_topology'):
+            node_selector['cloud.google.com/gke-tpu-topology'] = str(
+                config['tpu_topology'])
+    container = {
+        'name': 'skypilot-tpu',
+        'image': config.get('image', 'python:3.11-slim'),
+        'command': ['/bin/bash', '-c', 'sleep infinity'],
+        'resources': {'requests': dict(resources),
+                      'limits': {**resources, **limits}},
+    }
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': _pod_name(cluster_name, index),
+            'labels': {
+                LABEL_CLUSTER: cluster_name,
+                LABEL_ROLE: 'head' if index == 0 else 'worker',
+            },
+        },
+        'spec': {
+            'restartPolicy': 'Never',
+            'containers': [container],
+            **({'nodeSelector': node_selector} if node_selector else {}),
+        },
+    }
+
+
+def run_instances(region: str, cluster_name: str,
+                  config: Dict[str, Any]) -> common.ProvisionRecord:
+    # The k8s "region" is the namespace (each kube-context being a
+    # separate registered cloud config, as in the reference's
+    # context-per-region model).
+    namespace = config.get('namespace') or region or 'default'
+    context = config.get('context')
+    num_hosts = int(config.get('num_hosts', 1)) * int(
+        config.get('num_nodes', 1))
+    existing = _list_pods(cluster_name, namespace, context)
+    created = []
+    for i in range(num_hosts):
+        name = _pod_name(cluster_name, i)
+        if name in existing:
+            continue  # idempotent relaunch
+        manifest = _pod_manifest(cluster_name, i, config)
+        _kubectl(['apply', '-f', '-'], context=context, namespace=namespace,
+                 stdin=json.dumps(manifest))
+        created.append(name)
+    return common.ProvisionRecord(
+        provider_name='kubernetes', region=namespace, zone=None,
+        cluster_name=cluster_name,
+        head_instance_id=_pod_name(cluster_name, 0),
+        created_instance_ids=created)
+
+
+def _list_pods(cluster_name: str, namespace: str,
+               context: Optional[str]) -> Dict[str, Dict[str, Any]]:
+    out = _kubectl(['get', 'pods', '-l', f'{LABEL_CLUSTER}={cluster_name}',
+                    '-o', 'json'], context=context, namespace=namespace)
+    items = json.loads(out).get('items', [])
+    return {p['metadata']['name']: p for p in items}
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del state
+    pc = provider_config or {}
+    namespace = pc.get('namespace') or region or 'default'
+    context = pc.get('context')
+    deadline = time.time() + _POD_READY_TIMEOUT
+    while time.time() < deadline:
+        pods = _list_pods(cluster_name, namespace, context)
+        phases = {name: p.get('status', {}).get('phase', 'Pending')
+                  for name, p in pods.items()}
+        if pods and all(ph == 'Running' for ph in phases.values()):
+            return
+        bad = [n for n, ph in phases.items() if ph == 'Failed']
+        if bad:
+            raise exceptions.ProvisionerError(
+                f'Pods failed to start: {bad}')
+        time.sleep(2)
+    raise exceptions.ProvisionerError(
+        f'Pods for {cluster_name!r} not Running after '
+        f'{_POD_READY_TIMEOUT}s')
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    pc = provider_config or {}
+    namespace = pc.get('namespace') or region or 'default'
+    context = pc.get('context')
+    pods = _list_pods(cluster_name, namespace, context)
+    # Head first, then workers by index (rank order = pod creation order).
+    ordered = sorted(
+        pods.values(),
+        key=lambda p: (p['metadata']['labels'].get(LABEL_ROLE) != 'head',
+                       p['metadata']['name']))
+    instances = [common.InstanceInfo(
+        instance_id=p['metadata']['name'],
+        internal_ip=p.get('status', {}).get('podIP', ''),
+        external_ip=p.get('status', {}).get('podIP') or None,
+    ) for p in ordered]
+    return common.ClusterInfo(
+        cluster_name=cluster_name, cloud='kubernetes',
+        region=namespace, zone=None, instances=instances,
+        provider_config={'namespace': namespace, 'context': context,
+                         **pc})
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True) -> Dict[str, str]:
+    pc = provider_config or {}
+    namespace, context = pc.get('namespace', 'default'), pc.get('context')
+    phase_map = {'Running': 'running', 'Pending': 'pending',
+                 'Succeeded': 'stopped', 'Failed': 'stopped',
+                 'Unknown': 'stopped'}
+    out = {}
+    for name, p in _list_pods(cluster_name, namespace, context).items():
+        status = phase_map.get(p.get('status', {}).get('phase', 'Unknown'),
+                               'stopped')
+        if non_terminated_only and status == 'stopped':
+            continue
+        out[name] = status
+    return out
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise NotImplementedError(
+        'Kubernetes pods cannot be stopped; use down.')
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    pc = provider_config or {}
+    namespace, context = pc.get('namespace', 'default'), pc.get('context')
+    selector = f'{LABEL_CLUSTER}={cluster_name}'
+    if worker_only:
+        selector += f',{LABEL_ROLE}=worker'
+    _kubectl(['delete', 'pods', '-l', selector, '--ignore-not-found',
+              '--wait=false'], context=context, namespace=namespace)

@@ -55,8 +55,9 @@ def run_instances(region: str, cluster_name: str,
 
 
 def wait_instances(region: str, cluster_name: str,
-                   state: Optional[str] = None) -> None:
-    del region, state  # local instances are instantly ready
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del region, state, provider_config  # local instances are instantly ready
 
 
 def get_cluster_info(region: str, cluster_name: str,

@@ -54,11 +54,18 @@ def _make_runners(cluster_info: provision_common.ClusterInfo
         if cluster_info.cloud == 'local':
             runners.append(runner_lib.LocalProcessRunner(
                 inst.instance_id, inst.workdir))
+        elif cluster_info.cloud == 'kubernetes':
+            pc = cluster_info.provider_config
+            runners.append(runner_lib.KubernetesCommandRunner(
+                inst.instance_id, inst.instance_id,
+                namespace=pc.get('namespace', 'default'),
+                context=pc.get('context')))
         else:
             runners.append(runner_lib.SSHCommandRunner(
                 inst.instance_id, inst.external_ip or inst.internal_ip,
-                user=cluster_info.ssh_user,
-                key_path=cluster_info.ssh_key_path,
+                user=inst.tags.get('user') or cluster_info.ssh_user,
+                key_path=(inst.tags.get('identity_file') or
+                          cluster_info.ssh_key_path),
                 port=inst.ssh_port))
     return runners
 
@@ -124,7 +131,8 @@ def _provision_one_zone(cloud_obj: cloud_lib.Cloud,
                         config: dict) -> provision_common.ClusterInfo:
     cloud = cloud_obj.name
     provision_api.run_instances(cloud, region, cluster_name, config)
-    provision_api.wait_instances(cloud, region, cluster_name, 'running')
+    provision_api.wait_instances(cloud, region, cluster_name, 'running',
+                                 provider_config=config)
     return provision_api.get_cluster_info(cloud, region, cluster_name,
                                           config)
 

@@ -1,0 +1,1 @@
+"""BYO-node SSH provisioner (reference parity: sky/provision/ssh/)."""

@@ -1,0 +1,92 @@
+"""SSH "instance" CRUD: claiming hosts from a node pool.
+
+Reference parity: sky/provision/ssh/instance.py — BYO machines defined in
+~/.sky/ssh_node_pools.yaml; "provisioning" assigns free pool hosts to the
+cluster, "termination" releases them.  The machines themselves are never
+created or destroyed.
+
+provider config keys: {'pool': <pool name>, 'num_hosts': N}.
+"""
+from __future__ import annotations
+
+import subprocess
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.ssh_node_pools.core import SSHNodePoolManager
+
+
+def run_instances(region: str, cluster_name: str,
+                  config: Dict[str, Any]) -> common.ProvisionRecord:
+    pool = config.get('pool') or region
+    num_hosts = int(config.get('num_hosts', 1)) * int(
+        config.get('num_nodes', 1))
+    manager = SSHNodePoolManager()
+    hosts = manager.claim_hosts(pool, cluster_name, num_hosts)
+    ids = [h['ip'] for h in hosts]
+    return common.ProvisionRecord(
+        provider_name='ssh', region=pool, zone=None,
+        cluster_name=cluster_name, head_instance_id=ids[0],
+        created_instance_ids=ids)
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del region, cluster_name, state, provider_config  # BYO hosts are already up
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    manager = SSHNodePoolManager()
+    claim = manager.get_claim(cluster_name)
+    if claim is None:
+        raise RuntimeError(f'No SSH hosts claimed for {cluster_name!r}')
+    hosts = claim['hosts']
+    # Per-host credential overrides ride in tags (ClusterInfo's top-level
+    # ssh_user/key are only the pool-wide defaults — a host may declare its
+    # own user/identity_file/port in ssh_node_pools.yaml).
+    instances = [common.InstanceInfo(
+        instance_id=h['ip'], internal_ip=h['ip'], external_ip=h['ip'],
+        ssh_port=int(h.get('ssh_port', 22)),
+        tags={k: str(h[k]) for k in ('user', 'identity_file')
+              if h.get(k)}) for h in hosts]
+    head = hosts[0]
+    return common.ClusterInfo(
+        cluster_name=cluster_name, cloud='ssh', region=claim['pool'],
+        zone=None, instances=instances,
+        ssh_user=head.get('user', ''),
+        ssh_key_path=head.get('identity_file'),
+        provider_config=provider_config or {})
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True) -> Dict[str, str]:
+    """Liveness = TCP reachability of each claimed host's SSH port."""
+    manager = SSHNodePoolManager()
+    claim = manager.get_claim(cluster_name)
+    if claim is None:
+        return {}
+    out = {}
+    for h in claim['hosts']:
+        rc = subprocess.run(
+            ['timeout', '5', 'bash', '-c',
+             f'echo > /dev/tcp/{h["ip"]}/{h.get("ssh_port", 22)}'],
+            capture_output=True, check=False).returncode
+        out[h['ip']] = 'running' if rc == 0 else 'stopped'
+    return out
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise NotImplementedError('BYO SSH hosts cannot be stopped; use down '
+                              '(releases the hosts back to the pool).')
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    SSHNodePoolManager().release_hosts(cluster_name)

@@ -1,0 +1,8 @@
+"""SSH node pools: bring-your-own machines as a "cloud".
+
+Reference parity: sky/ssh_node_pools/core.py (SSHNodePoolManager over
+~/.sky/ssh_node_pools.yaml) + the sky/provision/ssh provisioner.
+"""
+from skypilot_tpu.ssh_node_pools.core import SSHNodePoolManager
+
+__all__ = ['SSHNodePoolManager']

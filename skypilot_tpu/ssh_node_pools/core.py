@@ -1,0 +1,153 @@
+"""SSH node pool management.
+
+Reference parity: sky/ssh_node_pools/core.py:11 (SSHNodePoolManager).
+Pools live in ~/.skypilot_tpu/ssh_node_pools.yaml:
+
+    my-pool:
+      user: ubuntu                  # pool-wide defaults
+      identity_file: ~/.ssh/id_rsa
+      hosts:
+        - 10.0.0.1
+        - ip: 10.0.0.2              # per-host overrides
+          user: other
+          ssh_port: 2222
+
+Each pool is exposed to the optimizer/provisioner as a "region" of the
+`ssh` cloud; host claiming (which hosts belong to which cluster) is
+tracked in ~/.skypilot_tpu/ssh_pool_state.json under a filelock.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+
+CONFIG_PATH = '~/.skypilot_tpu/ssh_node_pools.yaml'
+_STATE_PATH = '~/.skypilot_tpu/ssh_pool_state.json'
+_LOCK_PATH = '~/.skypilot_tpu/.ssh_pool.lock'
+
+
+def normalize_host(entry: Any, pool_config: Dict[str, Any]
+                   ) -> Dict[str, Any]:
+    """A host entry (str or dict) -> full dict with pool defaults."""
+    if isinstance(entry, str):
+        host: Dict[str, Any] = {'ip': entry}
+    else:
+        host = dict(entry)
+    host.setdefault('user', pool_config.get('user', os.environ.get(
+        'USER', 'root')))
+    host.setdefault('identity_file', pool_config.get('identity_file'))
+    host.setdefault('ssh_port', pool_config.get('ssh_port', 22))
+    return host
+
+
+class SSHNodePoolManager:
+    """CRUD over the pool config file + host claim bookkeeping."""
+
+    def __init__(self) -> None:
+        self.config_path = os.path.expanduser(CONFIG_PATH)
+        self.state_path = os.path.expanduser(_STATE_PATH)
+
+    # --- pool config ---
+
+    def get_all_pools(self) -> Dict[str, Any]:
+        if not os.path.exists(self.config_path):
+            return {}
+        return common_utils.read_yaml(self.config_path) or {}
+
+    def save_all_pools(self, pools: Dict[str, Any]) -> None:
+        common_utils.dump_yaml(self.config_path, pools)
+
+    def get_pool(self, name: str) -> Dict[str, Any]:
+        pools = self.get_all_pools()
+        if name not in pools:
+            raise exceptions.InvalidTaskError(
+                f'SSH node pool {name!r} not found in {CONFIG_PATH}; '
+                f'available: {sorted(pools)}')
+        return pools[name]
+
+    def update_pool(self, name: str, pool_config: Dict[str, Any]) -> None:
+        if not isinstance(pool_config.get('hosts'), list) or not \
+                pool_config['hosts']:
+            raise exceptions.InvalidTaskError(
+                f'Pool {name!r} needs a non-empty hosts list')
+        pools = self.get_all_pools()
+        pools[name] = pool_config
+        self.save_all_pools(pools)
+
+    def delete_pool(self, name: str) -> None:
+        pools = self.get_all_pools()
+        if name not in pools:
+            raise exceptions.InvalidTaskError(f'No pool {name!r}')
+        in_use = [c for c, rec in self._load_state().items()
+                  if rec['pool'] == name]
+        if in_use:
+            raise exceptions.InvalidTaskError(
+                f'Pool {name!r} has hosts claimed by clusters {in_use}')
+        del pools[name]
+        self.save_all_pools(pools)
+
+    def pool_hosts(self, name: str) -> List[Dict[str, Any]]:
+        pool = self.get_pool(name)
+        return [normalize_host(h, pool) for h in pool.get('hosts', [])]
+
+    # --- host claiming (assignment of pool hosts to clusters) ---
+
+    @contextlib.contextmanager
+    def _lock(self):
+        path = os.path.expanduser(_LOCK_PATH)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with filelock.FileLock(path, timeout=30):
+            yield
+
+    def _load_state(self) -> Dict[str, Any]:
+        if not os.path.exists(self.state_path):
+            return {}
+        with open(self.state_path, encoding='utf-8') as f:
+            return json.load(f)
+
+    def _save_state(self, claims: Dict[str, Any]) -> None:
+        os.makedirs(os.path.dirname(self.state_path), exist_ok=True)
+        with open(self.state_path, 'w', encoding='utf-8') as f:
+            json.dump(claims, f, indent=2)
+
+    def claim_hosts(self, pool_name: str, cluster_name: str,
+                    num_hosts: int) -> List[Dict[str, Any]]:
+        """Atomically assign num_hosts free hosts to cluster_name.
+
+        Idempotent: an existing claim for the cluster is returned as-is
+        (relaunch path).  Raises ResourcesUnavailableError if the pool
+        does not have enough free hosts — the failover provisioner treats
+        that exactly like cloud capacity exhaustion.
+        """
+        with self._lock():
+            claims = self._load_state()
+            if cluster_name in claims:
+                return claims[cluster_name]['hosts']
+            hosts = self.pool_hosts(pool_name)
+            taken = {h['ip'] for rec in claims.values()
+                     if rec['pool'] == pool_name for h in rec['hosts']}
+            free = [h for h in hosts if h['ip'] not in taken]
+            if len(free) < num_hosts:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Pool {pool_name!r}: need {num_hosts} hosts, only '
+                    f'{len(free)} of {len(hosts)} free')
+            assigned = free[:num_hosts]
+            claims[cluster_name] = {'pool': pool_name, 'hosts': assigned}
+            self._save_state(claims)
+            return assigned
+
+    def release_hosts(self, cluster_name: str) -> None:
+        with self._lock():
+            claims = self._load_state()
+            claims.pop(cluster_name, None)
+            self._save_state(claims)
+
+    def get_claim(self, cluster_name: str) -> Optional[Dict[str, Any]]:
+        return self._load_state().get(cluster_name)

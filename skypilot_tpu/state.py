@@ -119,21 +119,13 @@ _migrated_paths = set()
 
 
 def _migrate(conn: sqlite3.Connection, path: str) -> None:
-    """Additive column migrations, once per DB path per process (the
-    reference versions its DB via alembic, sky/utils/db/migration_utils.py;
-    sqlite ALTER-if-missing suffices here)."""
+    """Additive column migrations, once per DB path per process."""
     if path in _migrated_paths:
         return
-    cols = {r['name'] for r in conn.execute('PRAGMA table_info(clusters)')}
-    for col, decl in (('workspace', "TEXT DEFAULT 'default'"),
-                      ('user_hash', 'TEXT')):
-        if col not in cols:
-            try:
-                conn.execute(f'ALTER TABLE clusters ADD COLUMN {col} {decl}')
-            except sqlite3.OperationalError as e:
-                # Lost a cross-process race to another first connection.
-                if 'duplicate column name' not in str(e):
-                    raise
+    from skypilot_tpu.utils import db_utils
+    db_utils.add_columns_if_missing(
+        conn, 'clusters', (('workspace', "TEXT DEFAULT 'default'"),
+                           ('user_hash', 'TEXT')))
     _migrated_paths.add(path)
 
 

@@ -77,15 +77,13 @@ class PermissionService:
 
     # --- workspace policies (private workspaces) ---
 
-    def add_workspace_policy(self, workspace_name: str,
-                             users: List[str]) -> None:
-        with _policy_lock():
-            users_state.set_workspace_users(workspace_name, users)
-
     def update_workspace_policy(self, workspace_name: str,
                                 users: List[str]) -> None:
         with _policy_lock():
             users_state.set_workspace_users(workspace_name, users)
+
+    # Creation and replacement are the same set-the-allowed-users op.
+    add_workspace_policy = update_workspace_policy
 
     def remove_workspace_policy(self, workspace_name: str) -> None:
         with _policy_lock():

@@ -203,6 +203,63 @@ class SSHCommandRunner(CommandRunner):
                 'rsync failed')
 
 
+class KubernetesCommandRunner(CommandRunner):
+    """kubectl-exec runner for pods-as-hosts (mirrors the reference's
+    KubernetesCommandRunner, sky/utils/command_runner.py:906 — exec for
+    commands, `kubectl cp` via tar for file sync)."""
+
+    def __init__(self, node_id: str, pod_name: str, *,
+                 namespace: str = 'default',
+                 context: Optional[str] = None,
+                 container: Optional[str] = None) -> None:
+        super().__init__(node_id)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.context = context
+        self.container = container
+
+    def _kubectl_base(self) -> List[str]:
+        argv = ['kubectl']
+        if self.context:
+            argv += ['--context', self.context]
+        argv += ['-n', self.namespace]
+        return argv
+
+    def run(self, cmd, *, env=None, cwd=None, log_path=None,
+            stream_logs=False, require_outputs=False, timeout=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        remote = _env_prefix(env) + (f'cd {shlex.quote(cwd)} && ' if cwd
+                                     else '') + cmd
+        argv = self._kubectl_base() + ['exec', self.pod_name]
+        if self.container:
+            argv += ['-c', self.container]
+        argv += ['--', 'bash', '-c', remote]
+        return self._spawn(argv, log_path, stream_logs, require_outputs,
+                           timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool) -> None:
+        # kubectl cp is recursive-copy via tar; good enough for workdir
+        # sync (no --delete semantics, matching the reference's k8s path).
+        pod_ref = f'{self.namespace}/{self.pod_name}:'
+        if up:
+            pair = [os.path.expanduser(source).rstrip('/'),
+                    pod_ref + target]
+        else:
+            pair = [pod_ref + source, os.path.expanduser(target)]
+        argv = self._kubectl_base()[:1] + (
+            ['--context', self.context] if self.context else []) + \
+            ['cp'] + pair
+        rc = self._spawn(argv, None, False, False, None)
+        if rc != 0:
+            raise exceptions.CommandError(
+                int(rc), f'kubectl cp {"up" if up else "down"} {source}',
+                'kubectl cp failed')
+
+    def check_connection(self) -> bool:
+        return self.run('true', timeout=20) == 0
+
+
 def run_on_hosts_parallel(runners: List[CommandRunner], cmd: str, *,
                           env: Optional[Dict[str, str]] = None,
                           log_dir: Optional[str] = None,

@@ -1,0 +1,25 @@
+"""Small sqlite helpers shared by the state DBs.
+
+Reference parity: sky/utils/db/migration_utils.py (alembic-based there;
+additive ALTER-if-missing suffices for this build's append-only schemas).
+"""
+from __future__ import annotations
+
+import sqlite3
+from typing import Iterable, Tuple
+
+
+def add_columns_if_missing(conn: sqlite3.Connection, table: str,
+                           columns: Iterable[Tuple[str, str]]) -> None:
+    """Additive column migration, tolerant of cross-process races (two
+    first-connections may both see the column missing; the loser's ALTER
+    fails with 'duplicate column name' and is ignored)."""
+    existing = {r[1] for r in conn.execute(f'PRAGMA table_info({table})')}
+    for col, decl in columns:
+        if col in existing:
+            continue
+        try:
+            conn.execute(f'ALTER TABLE {table} ADD COLUMN {col} {decl}')
+        except sqlite3.OperationalError as e:
+            if 'duplicate column name' not in str(e):
+                raise

@@ -42,6 +42,9 @@ class Registry(Generic[T]):
     def values(self):
         return [cls() for cls in self._registry.values()]
 
+    def items(self):
+        return [(name, cls()) for name, cls in self._registry.items()]
+
 
 CLOUD_REGISTRY: Registry = Registry('cloud')
 JOBS_RECOVERY_STRATEGY_REGISTRY: Registry = Registry('jobs recovery strategy')

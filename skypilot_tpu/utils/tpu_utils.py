@@ -90,6 +90,41 @@ class TpuSpec:
     def is_pod(self) -> bool:
         return self.num_hosts > 1
 
+    @property
+    def gke_accelerator(self) -> str:
+        """GKE node-pool accelerator label value
+        (cloud.google.com/gke-tpu-accelerator)."""
+        return {
+            'v2': 'tpu-v2-podslice', 'v3': 'tpu-v3-podslice',
+            'v4': 'tpu-v4-podslice', 'v5e': 'tpu-v5-lite-podslice',
+            'v5p': 'tpu-v5p-slice', 'v6e': 'tpu-v6e-slice',
+        }[self.generation]
+
+    @property
+    def topology(self) -> str:
+        """GKE topology string (cloud.google.com/gke-tpu-topology).
+
+        v5e/v6e slices are 2D chip grids (NxM, N<=M, M/N in {1,2});
+        v2-v5p are (logically) 3D — emitted as AxBxC with A<=B<=C.
+        """
+        chips = self.chips
+        if self.generation in ('v5e', 'v6e'):
+            n = 1
+            while n * n < chips:
+                n *= 2
+            m = chips // n
+            lo, hi = sorted((n, m))
+            return f'{lo}x{hi}'
+        dims = [1, 1, 1]
+        i = 0
+        while dims[0] * dims[1] * dims[2] < chips:
+            dims[i % 3] *= 2
+            i += 1
+        # GKE labels order dims ascending but with 1s LAST (2x2x1, 2x2x4).
+        non_one = sorted(d for d in dims if d > 1)
+        ones = [d for d in dims if d == 1]
+        return 'x'.join(str(d) for d in (non_one + ones) or [1, 1, 1])
+
     def __str__(self) -> str:
         return self.name
 

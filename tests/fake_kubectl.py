@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Fake kubectl for hermetic Kubernetes-provisioner tests.
+
+Persists pod state as JSON files under $FAKE_KUBE_DIR.  Supports the
+subset the provisioner uses: apply -f -, get pods -l ... -o json,
+delete pods -l ..., version --client, exec POD -- bash -c CMD.
+"""
+import json
+import os
+import subprocess
+import sys
+
+
+def _dir():
+    d = os.environ['FAKE_KUBE_DIR']
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _pods():
+    out = []
+    for name in sorted(os.listdir(_dir())):
+        if name.endswith('.json'):
+            with open(os.path.join(_dir(), name)) as f:
+                out.append(json.load(f))
+    return out
+
+
+def _matches(pod, selector):
+    labels = pod['metadata'].get('labels', {})
+    for clause in selector.split(','):
+        k, _, v = clause.partition('=')
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+def main():
+    args = sys.argv[1:]
+    # Strip global flags.
+    while args and args[0] in ('-n', '--namespace', '--context'):
+        args = args[2:]
+    if not args:
+        sys.exit(2)
+    cmd = args[0]
+    if cmd == 'version':
+        print('{"clientVersion": {"gitVersion": "v1.fake"}}')
+        return
+    if cmd == 'apply':
+        manifest = json.load(sys.stdin)
+        name = manifest['metadata']['name']
+        # Fake scheduler: pod is instantly Running with a pod IP.
+        idx = len(_pods())
+        manifest['status'] = {'phase': os.environ.get(
+            'FAKE_KUBE_PHASE', 'Running'), 'podIP': f'10.244.0.{idx + 10}'}
+        with open(os.path.join(_dir(), f'{name}.json'), 'w') as f:
+            json.dump(manifest, f)
+        print(f'pod/{name} created')
+        return
+    if cmd == 'get':
+        selector = args[args.index('-l') + 1] if '-l' in args else ''
+        items = [p for p in _pods() if _matches(p, selector)]
+        print(json.dumps({'items': items}))
+        return
+    if cmd == 'delete':
+        selector = args[args.index('-l') + 1] if '-l' in args else ''
+        for pod in _pods():
+            if _matches(pod, selector):
+                os.remove(os.path.join(
+                    _dir(), f"{pod['metadata']['name']}.json"))
+        print('deleted')
+        return
+    if cmd == 'exec':
+        sep = args.index('--')
+        pod_name = args[1]
+        if not os.path.exists(os.path.join(_dir(), f'{pod_name}.json')):
+            print(f'pod {pod_name} not found', file=sys.stderr)
+            sys.exit(1)
+        # Run the command locally (the pod "is" this machine).
+        sys.exit(subprocess.run(args[sep + 1:], check=False).returncode)
+    sys.exit(2)
+
+
+if __name__ == '__main__':
+    main()

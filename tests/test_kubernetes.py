@@ -1,0 +1,122 @@
+"""Kubernetes provisioner + cloud, hermetic via a fake kubectl shim
+(tests/fake_kubectl.py) — the analog of the reference's kind/local-cluster
+tests (tests/kubernetes/) without a cluster."""
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from tests.test_launch_e2e import iso_state  # noqa: F401
+
+
+@pytest.fixture()
+def fake_kube(iso_state, tmp_path, monkeypatch):  # noqa: F811
+    """Put a fake kubectl on PATH backed by a state dir."""
+    bin_dir = tmp_path / 'bin'
+    bin_dir.mkdir()
+    shim = bin_dir / 'kubectl'
+    real = os.path.join(os.path.dirname(__file__), 'fake_kubectl.py')
+    shim.write_text(f'#!/bin/bash\nexec {sys.executable} {real} "$@"\n')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH', f'{bin_dir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('FAKE_KUBE_DIR', str(tmp_path / 'kube_state'))
+    # The credential probe caches; clear it per test.
+    from skypilot_tpu.clouds import kubernetes as k8s_cloud
+    k8s_cloud._kubectl_reachable.cache_clear()
+    yield tmp_path / 'kube_state'
+    k8s_cloud._kubectl_reachable.cache_clear()
+
+
+def test_pod_lifecycle(fake_kube):
+    from skypilot_tpu import provision as provision_api
+    record = provision_api.run_instances(
+        'kubernetes', 'default', 'kc1',
+        {'num_hosts': 2, 'cpus': '2', 'memory_gb': '4'})
+    assert record.head_instance_id == 'kc1-head'
+    assert record.created_instance_ids == ['kc1-head', 'kc1-worker1']
+    provision_api.wait_instances('kubernetes', 'default', 'kc1', 'running')
+    info = provision_api.get_cluster_info('kubernetes', 'default', 'kc1')
+    assert info.num_hosts == 2
+    assert info.head.instance_id == 'kc1-head'
+    assert info.head.internal_ip.startswith('10.244.')
+    statuses = provision_api.query_instances('kubernetes', 'kc1')
+    assert statuses == {'kc1-head': 'running', 'kc1-worker1': 'running'}
+    # Idempotent relaunch creates nothing new.
+    record2 = provision_api.run_instances(
+        'kubernetes', 'default', 'kc1', {'num_hosts': 2})
+    assert record2.created_instance_ids == []
+    provision_api.terminate_instances('kubernetes', 'kc1',
+                                      {'namespace': 'default'})
+    assert provision_api.query_instances('kubernetes', 'kc1') == {}
+
+
+def test_tpu_pod_manifest(fake_kube):
+    from skypilot_tpu.provision.kubernetes import instance as k8s
+    from skypilot_tpu.utils.tpu_utils import parse_tpu_accelerator
+    spec = parse_tpu_accelerator('tpu-v5e-16')
+    manifest = k8s._pod_manifest('t1', 0, {
+        'tpu_chips_per_host': spec.chips_per_host,
+        'tpu_accelerator': spec.gke_accelerator,
+        'tpu_topology': spec.topology,
+    })
+    limits = manifest['spec']['containers'][0]['resources']['limits']
+    assert limits['google.com/tpu'] == '4'
+    sel = manifest['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+        'tpu-v5-lite-podslice'
+    assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+
+
+def test_pod_failure_raises(fake_kube, monkeypatch):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import provision as provision_api
+    monkeypatch.setenv('FAKE_KUBE_PHASE', 'Failed')
+    provision_api.run_instances('kubernetes', 'default', 'kc2',
+                                {'num_hosts': 1})
+    with pytest.raises(exceptions.ProvisionerError):
+        provision_api.wait_instances('kubernetes', 'default', 'kc2',
+                                     'running')
+
+
+def test_kubernetes_cloud(fake_kube):
+    from skypilot_tpu.clouds import Kubernetes
+    from skypilot_tpu.resources import Resources
+    cloud = Kubernetes()
+    ok, _ = cloud.check_credentials()
+    assert ok
+    feasible = cloud.get_feasible_launchable_resources(Resources())
+    assert feasible.resources_list == []
+    feasible = cloud.get_feasible_launchable_resources(
+        Resources(cloud='kubernetes', accelerators='tpu-v5e-8'))
+    assert len(feasible.resources_list) == 1
+    choice = feasible.resources_list[0]
+    deploy = cloud.make_deploy_resources_variables(
+        choice, 'kc3', 'default', None)
+    assert deploy['tpu_chips_per_host'] == 8
+    assert deploy['tpu_accelerator'] == 'tpu-v5-lite-podslice'
+    assert deploy['num_hosts'] == 1
+
+
+def test_kubectl_exec_runner(fake_kube):
+    from skypilot_tpu import provision as provision_api
+    from skypilot_tpu.utils.command_runner import KubernetesCommandRunner
+    provision_api.run_instances('kubernetes', 'default', 'kc4',
+                                {'num_hosts': 1})
+    runner = KubernetesCommandRunner('kc4-head', 'kc4-head')
+    assert runner.run('true') == 0
+    assert runner.check_connection()
+    rc, out, _ = runner.run('echo hello-from-pod', require_outputs=True)
+    assert rc == 0 and 'hello-from-pod' in out
+    missing = KubernetesCommandRunner('nope', 'nope')
+    assert missing.run('true') != 0
+
+
+def test_no_kubectl_credentials(iso_state, monkeypatch, tmp_path):  # noqa: F811
+    from skypilot_tpu.clouds import kubernetes as k8s_cloud
+    monkeypatch.setenv('PATH', str(tmp_path))  # no kubectl anywhere
+    k8s_cloud._kubectl_reachable.cache_clear()
+    ok, reason = k8s_cloud.Kubernetes().check_credentials()
+    assert not ok and 'kubectl' in reason
+    k8s_cloud._kubectl_reachable.cache_clear()

@@ -1,0 +1,115 @@
+"""SSH node pools: pool CRUD, host claiming, the ssh provisioner, and the
+Ssh cloud (analog of the reference's BYO `ssh` cloud over
+~/.sky/ssh_node_pools.yaml)."""
+import pytest
+
+from tests.test_launch_e2e import iso_state  # noqa: F401
+
+POOL = {
+    'user': 'ubuntu',
+    'identity_file': '~/.ssh/id_rsa',
+    'hosts': ['10.0.0.1', '10.0.0.2',
+              {'ip': '10.0.0.3', 'user': 'admin', 'ssh_port': 2222}],
+}
+
+
+@pytest.fixture()
+def pool_manager(iso_state):  # noqa: F811
+    from skypilot_tpu.ssh_node_pools.core import SSHNodePoolManager
+    manager = SSHNodePoolManager()
+    manager.update_pool('rack-a', dict(POOL))
+    return manager
+
+
+def test_pool_crud(pool_manager):
+    from skypilot_tpu import exceptions
+    assert 'rack-a' in pool_manager.get_all_pools()
+    hosts = pool_manager.pool_hosts('rack-a')
+    assert [h['ip'] for h in hosts] == ['10.0.0.1', '10.0.0.2', '10.0.0.3']
+    # Pool-wide defaults + per-host overrides.
+    assert hosts[0]['user'] == 'ubuntu' and hosts[0]['ssh_port'] == 22
+    assert hosts[2]['user'] == 'admin' and hosts[2]['ssh_port'] == 2222
+    with pytest.raises(exceptions.InvalidTaskError):
+        pool_manager.get_pool('nope')
+    with pytest.raises(exceptions.InvalidTaskError):
+        pool_manager.update_pool('bad', {'hosts': []})
+    pool_manager.delete_pool('rack-a')
+    assert pool_manager.get_all_pools() == {}
+
+
+def test_claim_release_cycle(pool_manager):
+    from skypilot_tpu import exceptions
+    claimed = pool_manager.claim_hosts('rack-a', 'c1', 2)
+    assert [h['ip'] for h in claimed] == ['10.0.0.1', '10.0.0.2']
+    # Idempotent for the same cluster (relaunch path).
+    again = pool_manager.claim_hosts('rack-a', 'c1', 2)
+    assert again == claimed
+    # Remaining capacity: 1 host.
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        pool_manager.claim_hosts('rack-a', 'c2', 2)
+    pool_manager.claim_hosts('rack-a', 'c2', 1)
+    # Pool delete blocked while claims exist.
+    with pytest.raises(exceptions.InvalidTaskError):
+        pool_manager.delete_pool('rack-a')
+    pool_manager.release_hosts('c1')
+    pool_manager.release_hosts('c2')
+    pool_manager.delete_pool('rack-a')
+
+
+def test_ssh_provisioner_api(pool_manager):
+    from skypilot_tpu import provision as provision_api
+    record = provision_api.run_instances(
+        'ssh', 'rack-a', 'c1', {'pool': 'rack-a', 'num_hosts': 2})
+    assert record.head_instance_id == '10.0.0.1'
+    info = provision_api.get_cluster_info('ssh', 'rack-a', 'c1')
+    assert info.num_hosts == 2
+    assert info.ssh_user == 'ubuntu'
+    assert info.ssh_key_path == '~/.ssh/id_rsa'
+    assert info.head.external_ip == '10.0.0.1'
+    # Unreachable fake hosts report 'stopped'.
+    statuses = provision_api.query_instances('ssh', 'c1')
+    assert set(statuses) == {'10.0.0.1', '10.0.0.2'}
+    provision_api.terminate_instances('ssh', 'c1')
+    assert pool_manager.get_claim('c1') is None
+    with pytest.raises(NotImplementedError):
+        provision_api.stop_instances('ssh', 'c1')
+
+
+def test_ssh_cloud_feasibility(pool_manager):
+    from skypilot_tpu.clouds import Ssh
+    from skypilot_tpu.resources import Resources
+    cloud = Ssh()
+    ok, _ = cloud.check_credentials()
+    assert ok
+    # Not requested -> not feasible (never competes with real clouds).
+    feasible = cloud.get_feasible_launchable_resources(Resources())
+    assert feasible.resources_list == []
+    feasible = cloud.get_feasible_launchable_resources(
+        Resources(cloud='ssh'))
+    assert len(feasible.resources_list) == 1
+    choice = feasible.resources_list[0]
+    assert choice.region == 'rack-a'
+    assert cloud.get_hourly_cost(choice) == 0.0
+    regions = list(cloud.region_zones_provision_loop(Resources(cloud='ssh')))
+    assert regions == [('rack-a', [None])]
+    deploy = cloud.make_deploy_resources_variables(
+        choice, 'c1', 'rack-a', None)
+    assert deploy['pool'] == 'rack-a' and deploy['num_hosts'] == 1
+
+
+def test_ssh_cloud_no_pools(iso_state):  # noqa: F811
+    from skypilot_tpu.clouds import Ssh
+    ok, reason = Ssh().check_credentials()
+    assert not ok and 'No SSH node pools' in reason
+
+
+def test_check_probes_all_clouds(pool_manager):
+    """`skytpu check` probes every registered cloud (regression: Registry
+    lacked .items() and check crashed)."""
+    from skypilot_tpu import check as check_lib
+    results = check_lib.check(quiet=True)
+    assert {'gcp', 'kubernetes', 'local', 'ssh'} <= set(results)
+    assert results['local']['enabled']
+    assert results['ssh']['enabled']          # pool_manager configured one
+    enabled = check_lib.get_cached_enabled_clouds()
+    assert 'local' in enabled and 'ssh' in enabled

@@ -47,3 +47,20 @@ def test_non_tpu_returns_none():
     assert tpu_utils.parse_tpu_accelerator('A100', validate=False) is None
     assert not tpu_utils.is_tpu_accelerator('H100-80GB')
     assert tpu_utils.is_tpu_accelerator('tpu-v6e-4')
+
+
+def test_gke_topology_labels():
+    from skypilot_tpu.utils.tpu_utils import parse_tpu_accelerator
+    # 2D (v5e/v6e): ascending chip grid.
+    assert parse_tpu_accelerator('tpu-v5e-8').topology == '2x4'
+    assert parse_tpu_accelerator('tpu-v5e-16').topology == '4x4'
+    assert parse_tpu_accelerator('tpu-v6e-32').topology == '4x8'
+    assert parse_tpu_accelerator('tpu-v5e-1').topology == '1x1'
+    # 3D (v4/v5p): ascending with 1s LAST, matching GKE labels (2x2x1).
+    assert parse_tpu_accelerator('tpu-v4-8').topology == '2x2x1'
+    assert parse_tpu_accelerator('tpu-v4-16').topology == '2x2x2'
+    assert parse_tpu_accelerator('tpu-v4-32').topology == '2x2x4'
+    assert parse_tpu_accelerator('tpu-v5e-8').gke_accelerator == \
+        'tpu-v5-lite-podslice'
+    assert parse_tpu_accelerator('tpu-v4-8').gke_accelerator == \
+        'tpu-v4-podslice'
