@@ -178,6 +178,7 @@ async def _events_loop(state: AgentState, interval: float) -> None:
     The autostop event records idleness; enforcement (actual teardown) is
     done by the client-side status refresh reading /autostop + idle time,
     since a TPU pod cannot stop itself cleanly mid-delete."""
+    last_heartbeat = 0.0
     while True:
         await asyncio.sleep(interval)
         try:
@@ -193,6 +194,21 @@ async def _events_loop(state: AgentState, interval: float) -> None:
                     json.dump(cfg, f)
         except Exception:  # pylint: disable=broad-except
             pass
+        # Usage heartbeat (reference: UsageHeartbeatReportEvent,
+        # sky/skylet/events.py:140 — every 10 min, independent of the
+        # autostop cadence).
+        if time.time() - last_heartbeat > 600:
+            last_heartbeat = time.time()
+            try:
+                from skypilot_tpu.usage import usage_lib
+                # File spool + optional HTTP post are blocking: keep them
+                # off the event loop so /health stays responsive.
+                await asyncio.to_thread(
+                    usage_lib.send_heartbeat,
+                    cluster=state.cluster_name,
+                    active_jobs=state.job_table.has_active_jobs())
+            except Exception:  # pylint: disable=broad-except
+                pass
 
 
 def main(argv: Optional[list] = None) -> None:

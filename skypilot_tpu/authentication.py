@@ -1,0 +1,78 @@
+"""Cluster authentication: SSH keypair generation + per-cloud key injection.
+
+Reference parity: sky/authentication.py (576 LoC) — a framework-owned
+keypair under ~/.sky/ is generated once and its public half is pushed to
+each cloud's native key channel (GCP: instance metadata `ssh-keys`).  Here
+keys are generated with the `cryptography` library (ssh-keygen is not a
+baked-in dependency) as Ed25519, written in OpenSSH formats.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+KEY_DIR = '~/.skypilot_tpu/keys'
+PRIVATE_KEY_PATH = f'{KEY_DIR}/skytpu-key'
+PUBLIC_KEY_PATH = f'{KEY_DIR}/skytpu-key.pub'
+DEFAULT_SSH_USER = 'skypilot'
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Idempotently create the framework keypair; returns (priv, pub)
+    absolute paths (mirrors authentication.get_or_generate_keys)."""
+    priv = os.path.expanduser(PRIVATE_KEY_PATH)
+    pub = os.path.expanduser(PUBLIC_KEY_PATH)
+    if os.path.exists(priv) and os.path.exists(pub):
+        return priv, pub
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    os.makedirs(os.path.dirname(priv), exist_ok=True)
+    if os.path.exists(priv):
+        # Only the .pub is missing: re-derive it from the surviving
+        # private key — regenerating would silently overwrite the key
+        # that running clusters already trust and lock the user out.
+        with open(priv, 'rb') as f:
+            key = serialization.load_ssh_private_key(f.read(),
+                                                     password=None)
+        write_private = False
+    else:
+        key = ed25519.Ed25519PrivateKey.generate()
+        write_private = True
+    public_bytes = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    flags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+    if write_private:
+        private_bytes = key.private_bytes(
+            encoding=serialization.Encoding.PEM,
+            format=serialization.PrivateFormat.OpenSSH,
+            encryption_algorithm=serialization.NoEncryption())
+        with os.fdopen(os.open(priv, flags, 0o600), 'wb') as f:
+            f.write(private_bytes)
+        logger.info(f'Generated SSH keypair at {priv}')
+    with os.fdopen(os.open(pub, flags, 0o644), 'wb') as f:
+        f.write(public_bytes + b'\n')
+    return priv, pub
+
+
+def public_key_openssh() -> str:
+    _, pub = get_or_generate_keys()
+    with open(pub, encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def setup_gcp_authentication(config: Dict) -> Dict:
+    """Inject the framework key into a GCP deploy config: TPU-VM/GCE
+    metadata `ssh-keys` entry (user:key format) + runner-side paths
+    (mirrors authentication.setup_gcp_authentication)."""
+    priv, _ = get_or_generate_keys()
+    user = config.get('ssh_user', DEFAULT_SSH_USER)
+    config['ssh_user'] = user
+    config['ssh_key_path'] = priv
+    config['ssh_public_key'] = f'{user}:{public_key_openssh()}'
+    return config

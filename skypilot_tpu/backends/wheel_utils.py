@@ -1,0 +1,81 @@
+"""Build the framework wheel for shipping to clusters.
+
+Reference parity: sky/backends/wheel_utils.py (277 LoC) — the locally
+installed package is built into a wheel once per content hash and rsynced
+to every new cluster so the remote agent runs exactly the client's
+version (no PyPI dependency on the VM; the reference embeds the wheel
+hash into the cluster YAML for cache-busting the same way).
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+from typing import Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+WHEEL_DIR = '~/.skypilot_tpu/wheels'
+
+
+def _package_root() -> str:
+    import skypilot_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(skypilot_tpu.__file__)))
+
+
+def _content_hash() -> str:
+    """Hash of every .py file in the package (stable across rebuilds)."""
+    root = os.path.join(_package_root(), 'skypilot_tpu')
+    digest = hashlib.sha256()
+    for path in sorted(glob.glob(os.path.join(root, '**', '*.py'),
+                                 recursive=True)):
+        digest.update(path.encode())
+        with open(path, 'rb') as f:
+            digest.update(f.read())
+    return digest.hexdigest()[:16]
+
+
+def build_wheel() -> Tuple[str, str]:
+    """Build (or reuse) the wheel; returns (wheel_path, content_hash)."""
+    content_hash = _content_hash()
+    out_dir = os.path.join(os.path.expanduser(WHEEL_DIR), content_hash)
+    existing = glob.glob(os.path.join(out_dir, '*.whl'))
+    if existing:
+        return existing[0], content_hash
+    os.makedirs(out_dir, exist_ok=True)
+    logger.info(f'Building wheel (hash {content_hash})...')
+    proc = subprocess.run(
+        [sys.executable, '-m', 'pip', 'wheel', '--no-deps',
+         '--no-build-isolation', '--wheel-dir', out_dir, _package_root()],
+        capture_output=True, text=True, check=False)
+    if proc.returncode != 0:
+        shutil.rmtree(out_dir, ignore_errors=True)
+        raise RuntimeError(
+            f'wheel build failed ({proc.returncode}):\n'
+            f'{proc.stderr[-2000:]}')
+    wheels = glob.glob(os.path.join(out_dir, '*.whl'))
+    if not wheels:
+        raise RuntimeError('wheel build produced no .whl')
+    # Prune stale hashes so the cache doesn't grow unboundedly.
+    base = os.path.expanduser(WHEEL_DIR)
+    for entry in os.listdir(base):
+        if entry != content_hash:
+            shutil.rmtree(os.path.join(base, entry), ignore_errors=True)
+    return wheels[0], content_hash
+
+
+def ship_and_install_cmd(remote_wheel_path: str) -> str:
+    """The remote command that installs a shipped wheel idempotently.
+
+    --force-reinstall: the package version is constant (0.1.0) while the
+    content hash changes, so a plain install would no-op on any VM with a
+    preinstalled copy and leave stale code running.
+    """
+    return (f'python3 -m pip install --user --no-deps --force-reinstall '
+            f'{remote_wheel_path} && python3 -c "import skypilot_tpu"')

@@ -167,6 +167,11 @@ class GCP(cloud_lib.Cloud):
                 'instance_type': resources.instance_type,
                 'image_id': resources.image_id,
             })
+        # Framework SSH keypair -> instance metadata (reference:
+        # authentication.setup_gcp_authentication called from
+        # backend_utils.write_cluster_config).
+        from skypilot_tpu import authentication
+        authentication.setup_gcp_authentication(variables)
         return variables
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:

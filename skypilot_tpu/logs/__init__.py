@@ -1,0 +1,23 @@
+"""External log shipping (reference parity: sky/logs/).
+
+Selected via config `logs.store` ('gcp' -> Stackdriver via fluent-bit).
+"""
+from typing import Optional
+
+from skypilot_tpu import config
+from skypilot_tpu.logs.agent import FluentbitAgent, LoggingAgent
+
+
+def get_logging_agent() -> Optional[LoggingAgent]:
+    """The configured agent, or None (reference: sky/logs/__init__.py:11)."""
+    store = config.get_nested(('logs', 'store'))
+    if store is None:
+        return None
+    if store == 'gcp':
+        from skypilot_tpu.logs.gcp import GCPLoggingAgent
+        return GCPLoggingAgent(
+            config.get_nested(('logs', 'gcp'), default_value={}) or {})
+    raise ValueError(f'Unknown logs.store {store!r}; supported: gcp')
+
+
+__all__ = ['FluentbitAgent', 'LoggingAgent', 'get_logging_agent']

@@ -1,0 +1,89 @@
+"""Logging agents: ship per-job cluster logs to an external store.
+
+Reference parity: sky/logs/agent.py — LoggingAgent ABC (:12) with
+get_setup_command/get_credential_file_mounts, FluentbitAgent (:31)
+generating a fluent-bit config that tails ~/sky_logs and forwards to a
+store-specific output.
+"""
+from __future__ import annotations
+
+import abc
+import shlex
+from typing import Dict
+
+from skypilot_tpu.utils import common_utils
+
+# Where the agent/job_lib write per-job logs on cluster hosts
+# (agent/server.py log_dir_for: <base_dir>/logs/job-<id>/rank-<n>.log).
+# fluent-bit's tail plugin does not expand '~'; the __SKYTPU_HOME__ token
+# is substituted with $HOME by the setup command at render time.
+JOB_LOGS_GLOB = '__SKYTPU_HOME__/.skypilot_tpu_agent/logs/job-*/rank-*.log'
+
+
+class LoggingAgent(abc.ABC):
+    """Setup contract consumed by the provisioner's runtime setup."""
+
+    @abc.abstractmethod
+    def get_setup_command(self, cluster_name: str) -> str:
+        """Idempotent shell command installing + starting the agent."""
+
+    @abc.abstractmethod
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        """{remote_path: local_path} credentials to sync first."""
+
+
+class FluentbitAgent(LoggingAgent):
+    """Fluent-bit-based shipping: install binary, render config, run."""
+
+    def fluentbit_output_config(self, cluster_name: str) -> str:
+        """The [OUTPUT] section body (store-specific)."""
+        raise NotImplementedError
+
+    def fluentbit_config(self, cluster_name: str) -> str:
+        return '\n'.join([
+            '[SERVICE]',
+            '    Flush        5',
+            '    Daemon       off',
+            '[INPUT]',
+            '    Name         tail',
+            f'    Path         {JOB_LOGS_GLOB}',
+            '    Tag          skytpu.jobs',
+            '    Refresh_Interval 5',
+            self.fluentbit_output_config(cluster_name),
+            '',
+        ])
+
+    def get_setup_command(self, cluster_name: str) -> str:
+        cfg = shlex.quote(self.fluentbit_config(cluster_name))
+        # Install script pinned to a release tag (not master) so cluster
+        # hosts get a reproducible version and a compromised upstream
+        # master cannot push code onto user clusters.
+        install = (
+            'command -v fluent-bit >/dev/null 2>&1 || '
+            '[ -x /opt/fluent-bit/bin/fluent-bit ] || '
+            'curl -fsSL https://raw.githubusercontent.com/fluent/'
+            'fluent-bit/v3.1.9/install.sh | sh')
+        render = (f'mkdir -p ~/.skypilot_tpu_logs && printf %s {cfg} '
+                  '| sed "s|__SKYTPU_HOME__|$HOME|g" '
+                  '> ~/.skypilot_tpu_logs/fluentbit.conf')
+        # pgrep -x matches the process NAME only: `pgrep -f` would match
+        # the enclosing `bash -c '<this command>'` line (which contains
+        # 'fluent-bit') and always skip the start.
+        run = ('pgrep -x fluent-bit >/dev/null || nohup '
+               '$(command -v fluent-bit || echo '
+               '/opt/fluent-bit/bin/fluent-bit) '
+               '-c ~/.skypilot_tpu_logs/fluentbit.conf '
+               '> ~/.skypilot_tpu_logs/fluentbit.log 2>&1 &')
+        return f'({install}) && {render} && {run}'
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        return {}
+
+
+def cluster_log_labels(cluster_name: str) -> Dict[str, str]:
+    """Labels attached to every shipped record."""
+    return {
+        'cluster': cluster_name,
+        'user': common_utils.get_user_hash(),
+        'source': 'skypilot_tpu',
+    }

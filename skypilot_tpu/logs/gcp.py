@@ -1,0 +1,49 @@
+"""GCP Stackdriver (Cloud Logging) shipping via fluent-bit.
+
+Reference parity: sky/logs/gcp.py:38 (GCPLoggingAgent — fluent-bit
+stackdriver output, optional credentials file, project override,
+additional labels).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.logs.agent import FluentbitAgent, cluster_log_labels
+
+
+class GCPLoggingAgent(FluentbitAgent):
+
+    def __init__(self, agent_config: Dict[str, Any]) -> None:
+        self.project_id = (agent_config.get('project_id') or
+                           config_lib.get_nested(('gcp', 'project_id')))
+        self.credentials_file = agent_config.get('credentials_file')
+        self.additional_labels = dict(
+            agent_config.get('additional_labels') or {})
+
+    def fluentbit_output_config(self, cluster_name: str) -> str:
+        labels = {**cluster_log_labels(cluster_name),
+                  **self.additional_labels}
+        labels_str = ','.join(f'{k}={v}' for k, v in sorted(labels.items()))
+        lines = [
+            '[OUTPUT]',
+            '    Name         stackdriver',
+            '    Match        skytpu.*',
+        ]
+        if self.credentials_file:
+            lines.append(f'    google_service_credentials '
+                         f'{self.remote_credentials_path()}')
+        if self.project_id:
+            lines.append(f'    export_to_project_id {self.project_id}')
+        lines.append(f'    labels       {labels_str}')
+        return '\n'.join(lines)
+
+    def remote_credentials_path(self) -> str:
+        return '~/.skypilot_tpu_logs/gcp_credentials.json'
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        if not self.credentials_file:
+            return {}
+        return {self.remote_credentials_path():
+                os.path.expanduser(self.credentials_file)}

@@ -1,0 +1,5 @@
+"""Prometheus metrics (reference parity: sky/metrics/)."""
+from skypilot_tpu.metrics.utils import (observe_request, render_metrics,
+                                        REGISTRY)
+
+__all__ = ['observe_request', 'render_metrics', 'REGISTRY']

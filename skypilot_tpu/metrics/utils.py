@@ -1,0 +1,48 @@
+"""API-server Prometheus metrics.
+
+Reference parity: sky/metrics/utils.py + sky/server/metrics.py —
+prometheus_client counters/histograms for API requests (count, latency,
+in-flight) exposed at /metrics on the API server.
+"""
+from __future__ import annotations
+
+import prometheus_client
+from prometheus_client import CollectorRegistry
+
+REGISTRY = CollectorRegistry(auto_describe=True)
+
+REQUEST_COUNT = prometheus_client.Counter(
+    'skytpu_api_requests_total',
+    'API requests by path/method/status',
+    ['path', 'method', 'status'],
+    registry=REGISTRY)
+
+REQUEST_LATENCY = prometheus_client.Histogram(
+    'skytpu_api_request_duration_seconds',
+    'API request latency',
+    ['path', 'method'],
+    # Provisioning endpoints enqueue instantly; streaming ones run long.
+    buckets=(0.005, 0.02, 0.1, 0.5, 1, 5, 30, 120, 600),
+    registry=REGISTRY)
+
+REQUESTS_IN_FLIGHT = prometheus_client.Gauge(
+    'skytpu_api_requests_in_flight',
+    'Currently executing API requests',
+    registry=REGISTRY)
+
+QUEUED_REQUESTS = prometheus_client.Gauge(
+    'skytpu_api_queued_requests',
+    'Async requests waiting in the executor queue',
+    registry=REGISTRY)
+
+
+def observe_request(path: str, method: str, status: int,
+                    duration_s: float) -> None:
+    REQUEST_COUNT.labels(path=path, method=method,
+                         status=str(status)).inc()
+    REQUEST_LATENCY.labels(path=path, method=method).observe(duration_s)
+
+
+def render_metrics() -> bytes:
+    """Prometheus text exposition of all framework metrics."""
+    return prometheus_client.generate_latest(REGISTRY)

@@ -68,6 +68,10 @@ def _node_body(cluster_name: str, config: Dict[str, Any]) -> Dict[str, Any]:
         'labels': labels,   # at create time: cannot label while PENDING
         'metadata': {
             'startup-script': config.get('startup_script', ''),
+            # Public half of the framework keypair (authentication.py);
+            # the TPU-VM's guest agent provisions the login user from it.
+            **({'ssh-keys': config['ssh_public_key']}
+               if config.get('ssh_public_key') else {}),
         },
         # Named volumes attach at create time (TPU VMs take PDs only as
         # dataDisks in the node body; mounted by the backend post-boot).

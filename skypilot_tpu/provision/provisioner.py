@@ -112,11 +112,72 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
                 continue
         raise exceptions.ProvisionerError(
             f'Could not start an identity-verified agent: {last_exc}')
-    cmd = (f'nohup python -m skypilot_tpu.agent.server '
+    all_runners = _make_runners(cluster_info)
+    runner = all_runners[0]
+    # Ship the client's exact package version as a wheel and install it
+    # on the head before starting the agent (reference: wheel_utils build
+    # + rsync, sky/backends/wheel_utils.py — no PyPI dependency on the VM).
+    # Paths are relative so shell commands and rsync destinations resolve
+    # against the same base on both SSH (cwd=$HOME) and kubectl-exec
+    # (cwd=container workdir) runners.  Any failure here must surface as
+    # ProvisionerError so provision_with_failover tears down the
+    # just-created instances instead of leaking them.
+    try:
+        from skypilot_tpu.backends import wheel_utils
+        wheel_path, wheel_hash = wheel_utils.build_wheel()
+        remote_dir = f'.skypilot_tpu_wheels/{wheel_hash}'
+        runner.run(f'mkdir -p {remote_dir}', timeout=60)
+        runner.rsync(wheel_path, f'{remote_dir}/', up=True)
+        remote_wheel = f'{remote_dir}/{os.path.basename(wheel_path)}'
+        # Hash-gated install: a stale preinstalled version must not
+        # satisfy the guard, so the marker records the installed hash.
+        marker = '.skypilot_tpu_wheels/current'
+        rc = runner.run(
+            f'[ "$(cat {marker} 2>/dev/null)" = "{wheel_hash}" ] || '
+            f'({wheel_utils.ship_and_install_cmd(remote_wheel)} '
+            f'&& echo {wheel_hash} > {marker})', timeout=300)
+        if rc != 0:
+            raise exceptions.ProvisionerError(
+                f'Failed to install the framework wheel on head ({rc}).')
+    except exceptions.ProvisionerError:
+        raise
+    except Exception as e:  # pylint: disable=broad-except
+        raise exceptions.ProvisionerError(
+            f'Failed to ship the framework wheel to head: {e}') from e
+    # External log shipping, when configured (reference: LoggingAgent
+    # setup command run on every node, sky/logs/agent.py:12).  Strictly
+    # best-effort: a broken log shipper must not fail (or leak) the
+    # launch, so every error path lands in the warning below.
+    from skypilot_tpu import logs as logs_lib
+    try:
+        logging_agent = logs_lib.get_logging_agent()
+        if logging_agent is not None:
+            import concurrent.futures as cf
+            for remote, local in \
+                    logging_agent.get_credential_file_mounts().items():
+                runner_lib.run_on_hosts_parallel(
+                    all_runners, f'mkdir -p {os.path.dirname(remote)}',
+                    timeout=60)
+
+                def _sync(r, local=local, remote=remote):
+                    r.rsync(local, remote, up=True)
+                with cf.ThreadPoolExecutor(
+                        max_workers=min(32, len(all_runners))) as ex:
+                    list(ex.map(_sync, all_runners))
+            setup_cmd = logging_agent.get_setup_command(cluster_name)
+            rcs = runner_lib.run_on_hosts_parallel(all_runners, setup_cmd,
+                                                   timeout=600)
+            bad = [i for i, rc in enumerate(rcs) if rc != 0]
+            if bad:
+                raise exceptions.CommandError(
+                    rcs[bad[0]], setup_cmd, f'failed on hosts {bad}')
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Log-shipping agent setup failed ({e}); '
+                       f'job logs will not be exported.')
+    cmd = (f'nohup python3 -m skypilot_tpu.agent.server '
            f'--base-dir ~/.skypilot_tpu_agent --port {agent_port} '
            f'--cluster-name {cluster_name} '
            f'> ~/.skypilot_tpu_agent.log 2>&1 &')
-    runner = _make_runners(cluster_info)[0]
     rc = runner.run(cmd, timeout=60)
     if rc != 0:
         raise exceptions.ProvisionerError(

@@ -35,8 +35,9 @@ logger = sky_logging.init_logger(__name__)
 USER_HEADER = 'X-SkyTPU-User'
 
 # Paths that stay open without credentials even when auth is enforced
-# (health probes; the reference exempts /api/health the same way).
-_EXEMPT_PATHS = ('/api/health',)
+# (health probes + Prometheus scraping; the reference exempts /api/health
+# the same way).
+_EXEMPT_PATHS = ('/api/health', '/metrics')
 
 
 def _resolve_user(request: web.Request, enforce: bool) -> Optional[str]:

@@ -93,7 +93,9 @@ def execute_request(request_id: str) -> None:
     try:
         if fn is None:
             raise ValueError(f'Unknown request name: {record["name"]}')
-        result = fn(record['payload'])
+        from skypilot_tpu.usage import usage_lib
+        with usage_lib.usage_event(record['name']):
+            result = fn(record['payload'])
         _finish(request_id, RequestStatus.SUCCEEDED, result=result)
     except Exception as e:  # pylint: disable=broad-except
         logger.error(f'Request {request_id} ({record["name"]}) failed: '
@@ -138,17 +140,21 @@ class RequestWorkerPool:
             t.start()
 
     def schedule(self, request_id: str, name: str) -> None:
+        from skypilot_tpu.metrics import utils as metrics_utils
+        metrics_utils.QUEUED_REQUESTS.inc()
         if name in LONG_REQUESTS:
             self._long_q.put(request_id)
         else:
             self._short_q.put(request_id)
 
     def _worker(self, q: 'queue.Queue[str]') -> None:
+        from skypilot_tpu.metrics import utils as metrics_utils
         while not self._stop.is_set():
             try:
                 request_id = q.get(timeout=0.2)
             except queue.Empty:
                 continue
+            metrics_utils.QUEUED_REQUESTS.dec()
             execute_request(request_id)
 
     def stop(self) -> None:

@@ -42,7 +42,33 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
     """Build the app.  pool=None -> inline execution (test mode, the
     reference's TestClient trick)."""
     from skypilot_tpu.server import auth as auth_lib
-    app = web.Application(middlewares=[auth_lib.auth_middleware])
+
+    @web.middleware
+    async def metrics_middleware(request: web.Request, handler):
+        from skypilot_tpu import metrics as metrics_lib
+        import time as time_lib
+        metrics_lib.utils.REQUESTS_IN_FLIGHT.inc()
+        start = time_lib.monotonic()
+        status = 500
+        # Label by the matched route template, not the raw path: unmatched
+        # paths (port scans) otherwise grow label cardinality unboundedly.
+        resource = request.match_info.route.resource
+        path_label = resource.canonical if resource is not None else 'other'
+        try:
+            resp = await handler(request)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            metrics_lib.utils.REQUESTS_IN_FLIGHT.dec()
+            metrics_lib.observe_request(path_label, request.method,
+                                        status,
+                                        time_lib.monotonic() - start)
+
+    app = web.Application(middlewares=[metrics_middleware,
+                                       auth_lib.auth_middleware])
     routes = web.RouteTableDef()
 
     # Request names whose execution lands resources in a workspace; these
@@ -123,6 +149,12 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
         app.router.add_post(route_path, _make(request_name))
 
     # --- request management ---
+
+    @routes.get('/metrics')
+    async def metrics(request: web.Request) -> web.Response:
+        from skypilot_tpu import metrics as metrics_lib
+        return web.Response(body=metrics_lib.render_metrics(),
+                            content_type='text/plain')
 
     @routes.get('/api/health')
     async def health(request: web.Request) -> web.Response:

@@ -1,0 +1,7 @@
+"""Usage telemetry (reference parity: sky/usage/)."""
+from skypilot_tpu.usage.usage_lib import (MessageType, messages,
+                                          record_exception, send_heartbeat,
+                                          usage_event)
+
+__all__ = ['MessageType', 'messages', 'record_exception', 'send_heartbeat',
+           'usage_event']

@@ -1,0 +1,149 @@
+"""Opt-out usage telemetry: per-operation usage messages + heartbeats.
+
+Reference parity: sky/usage/usage_lib.py (MessageType USAGE/HEARTBEAT,
+message schema with user hash / operation / resources / timing / exception,
+shipped to a Grafana Loki endpoint) and the skylet heartbeat event
+(sky/skylet/events.py:140).
+
+Behavior here:
+- DISABLED by default (config `usage.disabled`, default true — this build
+  runs in zero-egress environments; the reference defaults to enabled).
+- Messages are always spooled locally to ~/.skypilot_tpu/usage/ (newline
+  JSON, last N kept) so `usage_event` timing is useful offline.
+- When `usage.endpoint` is configured and usage is enabled, messages POST
+  there (Loki push format), failures swallowed.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import config
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_SPOOL_DIR = '~/.skypilot_tpu/usage'
+_SPOOL_MAX_LINES = 1000
+
+
+class MessageType(enum.Enum):
+    USAGE = 'usage'
+    HEARTBEAT = 'heartbeat'
+
+
+def disabled() -> bool:
+    return bool(config.get_nested(('usage', 'disabled'),
+                                  default_value=True))
+
+
+def _base_message(message_type: MessageType) -> Dict[str, Any]:
+    return {
+        'type': message_type.value,
+        'user': common_utils.get_user_hash(),
+        'time': time.time(),
+        'version': _version(),
+    }
+
+
+def _version() -> str:
+    from skypilot_tpu import __version__
+    return __version__
+
+
+_spool_lock = threading.Lock()
+
+
+def _spool(message: Dict[str, Any]) -> None:
+    path = os.path.join(os.path.expanduser(_SPOOL_DIR), 'messages.jsonl')
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    line = json.dumps(message) + '\n'
+    with _spool_lock:
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(line)
+        # Truncate only when well past the cap, so the common path stays
+        # an O(1) append under the executor's concurrent workers.
+        try:
+            if os.path.getsize(path) > _SPOOL_MAX_LINES * 512:
+                with open(path, encoding='utf-8') as f:
+                    lines = f.readlines()[-_SPOOL_MAX_LINES:]
+                with open(path, 'w', encoding='utf-8') as f:
+                    f.writelines(lines)
+        except OSError:
+            pass
+
+
+def _post(message: Dict[str, Any]) -> None:
+    endpoint = config.get_nested(('usage', 'endpoint'))
+    if disabled() or not endpoint:
+        return
+    try:
+        import requests
+        payload = {'streams': [{
+            'stream': {'source': 'skypilot_tpu',
+                       'type': message['type']},
+            'values': [[str(int(message['time'] * 1e9)),
+                        json.dumps(message)]],
+        }]}
+        requests.post(endpoint, json=payload, timeout=5)
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'usage post failed: {e}')
+
+
+def _emit(message: Dict[str, Any]) -> None:
+    try:
+        _spool(message)
+    except OSError:
+        pass
+    _post(message)
+
+
+def messages(limit: int = 100) -> list:
+    """Recently spooled messages (newest last)."""
+    path = os.path.join(os.path.expanduser(_SPOOL_DIR), 'messages.jsonl')
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding='utf-8') as f:
+        return [json.loads(line) for line in f.readlines()[-limit:]]
+
+
+@contextlib.contextmanager
+def usage_event(operation: str, **fields: Any):
+    """Wrap an operation (launch/exec/jobs.launch/...) in a usage message
+    with duration + exception capture (the analog of the reference's
+    entrypoint decorator + messages.usage fields)."""
+    message = _base_message(MessageType.USAGE)
+    message['operation'] = operation
+    message.update(fields)
+    start = time.time()
+    try:
+        yield message
+    except BaseException as e:
+        message['exception'] = type(e).__name__
+        raise
+    finally:
+        message['duration_s'] = round(time.time() - start, 3)
+        _emit(message)
+
+
+def record_exception(operation: str, exc: BaseException) -> None:
+    message = _base_message(MessageType.USAGE)
+    message['operation'] = operation
+    message['exception'] = type(exc).__name__
+    message['traceback'] = traceback.format_exc()[-2000:]
+    _emit(message)
+
+
+def send_heartbeat(**fields: Any) -> None:
+    """Periodic liveness signal (agent event; reference:
+    UsageHeartbeatReportEvent, sky/skylet/events.py:140)."""
+    message = _base_message(MessageType.HEARTBEAT)
+    message.update(fields)
+    _emit(message)

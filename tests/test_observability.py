@@ -1,0 +1,136 @@
+"""Authentication, wheel shipping, usage telemetry, metrics, log shipping
+(analogs of the reference's sky/authentication.py, backends/wheel_utils.py,
+sky/usage, sky/metrics, sky/logs unit coverage)."""
+import os
+import stat
+
+import pytest
+import requests
+
+from tests.test_api_server import live_server  # noqa: F401
+from tests.test_launch_e2e import iso_state  # noqa: F401
+
+
+# --- authentication ---
+
+def test_keypair_generation_idempotent(iso_state):  # noqa: F811
+    from skypilot_tpu import authentication
+    priv, pub = authentication.get_or_generate_keys()
+    assert os.path.exists(priv) and os.path.exists(pub)
+    assert stat.S_IMODE(os.stat(priv).st_mode) == 0o600
+    with open(pub, encoding='utf-8') as f:
+        pub_content = f.read()
+    assert pub_content.startswith('ssh-ed25519 ')
+    # Second call reuses, not regenerates.
+    priv2, _ = authentication.get_or_generate_keys()
+    assert priv2 == priv
+    with open(pub, encoding='utf-8') as f:
+        assert f.read() == pub_content
+
+
+def test_gcp_auth_injection(iso_state):  # noqa: F811
+    from skypilot_tpu import authentication
+    config = {}
+    authentication.setup_gcp_authentication(config)
+    assert config['ssh_user'] == 'skypilot'
+    assert config['ssh_public_key'].startswith('skypilot:ssh-ed25519 ')
+    assert os.path.exists(config['ssh_key_path'])
+    # The TPU node body carries the key as metadata ssh-keys.
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    body = gcp_instance._node_body('c1', {
+        'tpu_type': 'v5litepod-8', 'runtime_version': 'x',
+        'project_id': 'p', 'zone': 'z', **config})
+    assert body['metadata']['ssh-keys'] == config['ssh_public_key']
+
+
+# --- wheel build/ship ---
+
+def test_wheel_build_and_cache(iso_state):  # noqa: F811
+    from skypilot_tpu.backends import wheel_utils
+    path, content_hash = wheel_utils.build_wheel()
+    assert path.endswith('.whl') and os.path.exists(path)
+    assert content_hash in path
+    # Cached on second call (same mtime).
+    mtime = os.path.getmtime(path)
+    path2, hash2 = wheel_utils.build_wheel()
+    assert (path2, hash2) == (path, content_hash)
+    assert os.path.getmtime(path2) == mtime
+    cmd = wheel_utils.ship_and_install_cmd('~/w/x.whl')
+    assert 'pip install' in cmd and '--no-deps' in cmd
+
+
+# --- usage telemetry ---
+
+def test_usage_event_spooled(iso_state):  # noqa: F811
+    from skypilot_tpu.usage import usage_lib
+    with usage_lib.usage_event('launch', cloud='local'):
+        pass
+    with pytest.raises(ValueError):
+        with usage_lib.usage_event('exec'):
+            raise ValueError('boom')
+    spooled = usage_lib.messages()
+    assert len(spooled) == 2
+    assert spooled[0]['operation'] == 'launch'
+    assert spooled[0]['cloud'] == 'local'
+    assert 'duration_s' in spooled[0]
+    assert spooled[1]['exception'] == 'ValueError'
+    usage_lib.send_heartbeat(cluster='c1')
+    assert usage_lib.messages()[-1]['type'] == 'heartbeat'
+
+
+def test_usage_post_respects_disabled(iso_state, monkeypatch):  # noqa: F811
+    from skypilot_tpu.usage import usage_lib
+    calls = []
+    monkeypatch.setattr('requests.post',
+                        lambda *a, **k: calls.append(a) or None)
+    # Disabled (default) -> no post even with an endpoint set.
+    from skypilot_tpu import config
+    with config.override_context({'usage': {'endpoint': 'http://x'}}):
+        usage_lib.send_heartbeat()
+        assert calls == []
+    with config.override_context({'usage': {'disabled': False,
+                                            'endpoint': 'http://x'}}):
+        usage_lib.send_heartbeat()
+        assert len(calls) == 1
+
+
+# --- metrics ---
+
+def test_metrics_endpoint(live_server):  # noqa: F811
+    requests.get(live_server + '/api/health', timeout=10)
+    text = requests.get(live_server + '/metrics', timeout=10).text
+    assert 'skytpu_api_requests_total' in text
+    assert 'skytpu_api_request_duration_seconds' in text
+    assert '/api/health' in text
+
+
+# --- log shipping ---
+
+def test_logging_agent_selection(iso_state):  # noqa: F811
+    from skypilot_tpu import config
+    from skypilot_tpu import logs as logs_lib
+    assert logs_lib.get_logging_agent() is None
+    with config.override_config({'logs': {'store': 'gcp',
+                                          'gcp': {'project_id': 'proj'}}}):
+        agent = logs_lib.get_logging_agent()
+        cfg = agent.fluentbit_config('c1')
+        assert '[INPUT]' in cfg and 'stackdriver' in cfg
+        assert 'cluster=c1' in cfg
+        assert 'export_to_project_id proj' in cfg
+        setup = agent.get_setup_command('c1')
+        assert 'fluent-bit' in setup
+    with config.override_config({'logs': {'store': 'nope'}}):
+        with pytest.raises(ValueError):
+            logs_lib.get_logging_agent()
+
+
+def test_logging_agent_credentials(iso_state, tmp_path):  # noqa: F811
+    from skypilot_tpu.logs.gcp import GCPLoggingAgent
+    cred = tmp_path / 'sa.json'
+    cred.write_text('{}')
+    agent = GCPLoggingAgent({'project_id': 'p',
+                             'credentials_file': str(cred)})
+    mounts = agent.get_credential_file_mounts()
+    assert mounts == {agent.remote_credentials_path(): str(cred)}
+    assert 'google_service_credentials' in \
+        agent.fluentbit_output_config('c1')
