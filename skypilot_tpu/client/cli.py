@@ -104,6 +104,31 @@ def _cmd_stop(args) -> int:
     return 0
 
 
+def _cmd_start(args) -> int:
+    from skypilot_tpu.client import sdk
+    sdk.start(args.cluster)
+    print(f'Cluster {args.cluster!r} started.')
+    return 0
+
+
+def _cmd_cost_report(args) -> int:
+    from skypilot_tpu.client import sdk
+    rows = sdk.cost_report()
+    if not rows:
+        print('No clusters (live or recently terminated).')
+        return 0
+    hdr = f'{"NAME":<20} {"STATUS":<12} {"RESOURCES":<40} ' \
+          f'{"DURATION":<10} {"COST":>10}'
+    print(hdr)
+    for r in rows:
+        hours = (r['duration_s'] or 0) / 3600
+        cost = r['total_cost'] if r['total_cost'] is not None else '-'
+        cost_str = f'${cost:.2f}' if isinstance(cost, float) else cost
+        print(f'{r["name"]:<20} {r["status"] or "-":<12} '
+              f'{r["resources_str"]:<40} {hours:>8.1f}h {cost_str:>10}')
+    return 0
+
+
 def _cmd_autostop(args) -> int:
     from skypilot_tpu.client import sdk
     sdk.autostop(args.cluster, args.idle_minutes, down=True)
@@ -190,6 +215,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument('cluster')
     p.set_defaults(fn=_cmd_stop)
 
+    p = sub.add_parser('start', help='Restart a stopped cluster')
+    p.add_argument('cluster')
+    p.set_defaults(fn=_cmd_start)
+
+    p = sub.add_parser('cost-report', help='Cost of live + past clusters')
+    p.set_defaults(fn=_cmd_cost_report)
+
     p = sub.add_parser('autostop', help='Auto-teardown after idleness')
     p.add_argument('cluster')
     p.add_argument('-i', '--idle-minutes', type=int, default=5)
@@ -221,6 +253,16 @@ def build_parser() -> argparse.ArgumentParser:
     try:
         from skypilot_tpu.volumes import cli as volumes_cli
         volumes_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from skypilot_tpu.users import cli as users_cli
+        users_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from skypilot_tpu.workspaces import cli as workspaces_cli
+        workspaces_cli.register(sub)
     except ImportError:
         pass
     return parser

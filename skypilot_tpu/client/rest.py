@@ -20,6 +20,23 @@ class RestClient:
     def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
         self.endpoint = endpoint.rstrip('/')
         self.timeout = timeout
+        self._version_checked = False
+
+    def _headers(self) -> Dict[str, str]:
+        from skypilot_tpu.server import versions
+        return versions.request_headers()
+
+    def _check_server_version(self, resp) -> None:
+        """Handshake on the first response (reference:
+        sky/server/versions.py — both sides refuse across the window)."""
+        if self._version_checked:
+            return
+        self._version_checked = True
+        from skypilot_tpu.server import versions
+        ok, msg = versions.check_server_compatible(
+            resp.headers.get(versions.API_VERSION_HEADER))
+        if not ok:
+            raise exceptions.ApiServerError(msg)
 
     # --- request plumbing ---
 
@@ -27,10 +44,12 @@ class RestClient:
         """POST an async endpoint; returns the request_id."""
         try:
             resp = requests_lib.post(self.endpoint + path, json=payload,
+                                     headers=self._headers(),
                                      timeout=self.timeout)
         except requests_lib.RequestException as e:
             raise exceptions.ApiServerError(
                 f'Cannot reach API server at {self.endpoint}: {e}') from e
+        self._check_server_version(resp)
         if resp.status_code != 202:
             raise exceptions.ApiServerError(
                 f'{path} -> {resp.status_code}: {resp.text}')

@@ -64,6 +64,14 @@ def start(cluster_name: str, **kwargs) -> Any:
     return core.start(cluster_name, **kwargs)
 
 
+def cost_report() -> Any:
+    client = rest.get_client()
+    if client is not None:
+        return client.submit_and_get('/cost_report', {})
+    from skypilot_tpu import core
+    return core.cost_report()
+
+
 def stop(cluster_name: str, **kwargs) -> Any:
     client = rest.get_client()
     if client is not None:

@@ -1,0 +1,184 @@
+"""Async SDK: the same surface as `skypilot_tpu.client.sdk`, awaitable.
+
+Reference parity: sky/client/sdk_async.py — every sync SDK call has an
+async twin.  Against a configured API server the calls are native
+aiohttp (submit → long-poll /api/get); in library-local mode they run
+the sync engine in a worker thread (`asyncio.to_thread`), which is what
+the reference's async variant does for its blocking internals.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import aiohttp
+
+from skypilot_tpu import exceptions
+
+
+class AsyncRestClient:
+    """aiohttp mirror of client.rest.RestClient."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
+        self.endpoint = endpoint.rstrip('/')
+        self.timeout = timeout
+        self._version_checked = False
+
+    def _headers(self) -> Dict[str, str]:
+        from skypilot_tpu.server import versions
+        return versions.request_headers()
+
+    def _check_server_version(self, resp: aiohttp.ClientResponse) -> None:
+        if self._version_checked:
+            return
+        self._version_checked = True
+        from skypilot_tpu.server import versions
+        ok, msg = versions.check_server_compatible(
+            resp.headers.get(versions.API_VERSION_HEADER))
+        if not ok:
+            raise exceptions.ApiServerError(msg)
+
+    async def submit(self, path: str, payload: Dict[str, Any]) -> str:
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        self.endpoint + path, json=payload,
+                        headers=self._headers(),
+                        timeout=aiohttp.ClientTimeout(
+                            total=self.timeout)) as resp:
+                    self._check_server_version(resp)
+                    if resp.status != 202:
+                        raise exceptions.ApiServerError(
+                            f'{path} -> {resp.status}: '
+                            f'{await resp.text()}')
+                    return (await resp.json())['request_id']
+        except aiohttp.ClientError as e:
+            raise exceptions.ApiServerError(
+                f'Cannot reach API server at {self.endpoint}: {e}') from e
+
+    async def get(self, request_id: str, timeout: float = 600.0) -> Any:
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        async with aiohttp.ClientSession() as session:
+            while True:
+                remaining = max(1.0, deadline - loop.time())
+                async with session.get(
+                        self.endpoint + '/api/get',
+                        params={'request_id': request_id,
+                                'timeout': min(remaining, 60.0)},
+                        timeout=aiohttp.ClientTimeout(
+                            total=min(remaining, 60.0) + 10)) as resp:
+                    resp.raise_for_status()
+                    record = await resp.json()
+                if record['status'] == 'FAILED':
+                    raise exceptions.ApiServerError(
+                        f'Request {record["name"]} failed: '
+                        f'{record["error"]}')
+                if record['status'] == 'CANCELLED':
+                    raise exceptions.RequestCancelled(request_id)
+                if record['status'] == 'SUCCEEDED':
+                    return record['result']
+                if loop.time() > deadline:
+                    raise exceptions.ApiServerError(
+                        f'Request {request_id} still {record["status"]} '
+                        f'after {timeout}s')
+
+    async def submit_and_get(self, path: str, payload: Dict[str, Any],
+                             timeout: float = 600.0) -> Any:
+        return await self.get(await self.submit(path, payload),
+                              timeout=timeout)
+
+    async def stream(self, request_id: str) -> AsyncIterator[str]:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    self.endpoint + '/api/stream',
+                    params={'request_id': request_id},
+                    timeout=aiohttp.ClientTimeout(total=None)) as resp:
+                resp.raise_for_status()
+                async for line in resp.content:
+                    yield line.decode(errors='replace')
+
+
+def _get_async_client() -> Optional[AsyncRestClient]:
+    from skypilot_tpu.client import rest
+    sync_client = rest.get_client()
+    if sync_client is None:
+        return None
+    return AsyncRestClient(sync_client.endpoint, sync_client.timeout)
+
+
+async def _call(path: str, payload: Dict[str, Any], sync_fallback) -> Any:
+    client = _get_async_client()
+    if client is not None:
+        return await client.submit_and_get(path, payload)
+    return await asyncio.to_thread(sync_fallback)
+
+
+# --- the async SDK surface (mirrors sdk.py 1:1) -------------------------
+
+async def launch(task, cluster_name: Optional[str] = None, **kwargs) -> Any:
+    from skypilot_tpu.client import sdk
+    return await asyncio.to_thread(sdk.launch, task, cluster_name, **kwargs)
+
+
+async def exec(task, cluster_name: str, **kwargs) -> Any:  # pylint: disable=redefined-builtin
+    from skypilot_tpu.client import sdk
+    return await asyncio.to_thread(sdk.exec, task, cluster_name, **kwargs)
+
+
+async def status(cluster_names: Optional[List[str]] = None) -> Any:
+    from skypilot_tpu.client import sdk
+    return await _call('/status', {'cluster_names': cluster_names},
+                       lambda: sdk.status(cluster_names))
+
+
+async def start(cluster_name: str) -> Any:
+    from skypilot_tpu.client import sdk
+    return await _call('/start', {'cluster_name': cluster_name},
+                       lambda: sdk.start(cluster_name))
+
+
+async def stop(cluster_name: str) -> Any:
+    from skypilot_tpu.client import sdk
+    return await _call('/stop', {'cluster_name': cluster_name},
+                       lambda: sdk.stop(cluster_name))
+
+
+async def down(cluster_name: str) -> Any:
+    from skypilot_tpu.client import sdk
+    return await _call('/down', {'cluster_name': cluster_name},
+                       lambda: sdk.down(cluster_name))
+
+
+async def autostop(cluster_name: str, idle_minutes: int,
+                   down: bool = False) -> Any:  # pylint: disable=redefined-outer-name
+    from skypilot_tpu.client import sdk
+    return await _call(
+        '/autostop', {'cluster_name': cluster_name,
+                      'idle_minutes': idle_minutes, 'down': down},
+        lambda: sdk.autostop(cluster_name, idle_minutes, down=down))
+
+
+async def queue(cluster_name: str, all_jobs: bool = False) -> Any:
+    from skypilot_tpu.client import sdk
+    return await _call('/queue', {'cluster_name': cluster_name,
+                                  'all_jobs': all_jobs},
+                       lambda: sdk.queue(cluster_name, all_jobs=all_jobs))
+
+
+async def cancel(cluster_name: str,
+                 job_ids: Optional[List[int]] = None) -> Any:
+    from skypilot_tpu.client import sdk
+    return await _call('/cancel', {'cluster_name': cluster_name,
+                                   'job_ids': job_ids},
+                       lambda: sdk.cancel(cluster_name, job_ids))
+
+
+async def cost_report() -> Any:
+    from skypilot_tpu.client import sdk
+    return await _call('/cost_report', {}, sdk.cost_report)
+
+
+async def api_health() -> Any:
+    from skypilot_tpu.client import sdk
+    return await asyncio.to_thread(sdk.api_health)

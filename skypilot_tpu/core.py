@@ -99,12 +99,21 @@ def status_payload(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     out = []
     for record in records:
         handle = record['handle']
+        res = handle.launched_resources
+        try:
+            from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+            cost = CLOUD_REGISTRY.from_str(res.cloud).get_hourly_cost(res)
+        except Exception:  # pylint: disable=broad-except
+            cost = None
         out.append({
             'name': record['name'],
             'launched_at': record['launched_at'],
             'status': record['status'].value if record['status'] else None,
-            'resources': handle.launched_resources.to_yaml_config(),
-            'resources_str': str(handle.launched_resources),
+            'resources': res.to_yaml_config(),
+            'resources_str': str(res),
+            'infra': '/'.join(p for p in (res.cloud, res.region, res.zone)
+                              if p),
+            'cost_per_hour': cost,
             'head_ip': handle.head_ip,
             'num_hosts': handle.num_hosts,
             'autostop': record.get('autostop') or {},
@@ -112,14 +121,54 @@ def status_payload(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return out
 
 
+def cost_report() -> List[Dict[str, Any]]:
+    """Cost of live clusters plus recently terminated ones (reference:
+    `sky cost-report` over global_user_state cluster history)."""
+    out = []
+    now = time.time()
+    for rec in status_payload(status()):
+        duration = now - (rec['launched_at'] or now)
+        hourly = rec['cost_per_hour']
+        out.append({
+            'name': rec['name'], 'status': rec['status'],
+            'resources_str': rec['resources_str'],
+            'launched_at': rec['launched_at'], 'duration_s': duration,
+            'hourly_cost': hourly,
+            'total_cost': (hourly * duration / 3600
+                           if hourly is not None else None),
+        })
+    for row in state.cluster_history():
+        hourly = row.get('hourly_cost')
+        duration = row.get('duration_s') or 0
+        out.append({
+            'name': row['name'], 'status': None,
+            'resources_str': row['resources'],
+            'launched_at': row['launched_at'], 'duration_s': duration,
+            'hourly_cost': hourly,
+            'total_cost': (hourly * duration / 3600
+                           if hourly is not None else None),
+        })
+    return out
+
+
 def start(cluster_name: str) -> None:
+    """Restart a STOPPED cluster (single-host TPU VMs / CPU VMs; pod
+    slices never stop — reference: sky/clouds/gcp.py:217-224 — so they
+    can never be started either)."""
     record = state.get_cluster(cluster_name)
     if record is None:
-        raise exceptions.ClusterDoesNotExist(cluster_name)
-    raise exceptions.NotSupportedError(
-        'Restarting stopped clusters is not supported for TPU pod slices '
-        '(they cannot stop; reference: sky/clouds/gcp.py:217-224). '
-        'Re-launch instead.')
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    if record['status'] == ClusterStatus.UP:
+        logger.info(f'Cluster {cluster_name!r} is already up.')
+        return
+    from skypilot_tpu.provision import provisioner
+    from skypilot_tpu.utils import locks
+    handle = record['handle']
+    with locks.cluster_lock(cluster_name):
+        handle = provisioner.restart(handle)
+        state.add_or_update_cluster(handle, ClusterStatus.UP,
+                                    autostop=record.get('autostop'))
 
 
 def stop(cluster_name: str) -> None:

@@ -1,0 +1,3 @@
+"""Web dashboard: a dependency-free vanilla-JS SPA served by the API
+server at /dashboard (reference: sky/dashboard — Next.js SPA served at
+/dashboard/{path} by sky/server/server.py:1873)."""

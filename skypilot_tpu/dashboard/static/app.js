@@ -1,0 +1,239 @@
+/* Dashboard SPA logic: hash-routed pages, each backed by the same REST
+ * API the CLI/SDK use (async request pattern: POST -> request_id ->
+ * GET /api/get).  Reference parity: sky/dashboard/src pages
+ * (clusters, jobs, infra, workspaces, users, volumes). */
+'use strict';
+
+const $ = (sel) => document.querySelector(sel);
+
+// --- API helpers -------------------------------------------------------
+
+async function apiCall(route, payload) {
+  // Async-request pattern: schedule, then long-poll the result.
+  const r = await fetch(route, {
+    method: 'POST',
+    headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify(payload || {}),
+  });
+  if (!r.ok) throw new Error(`${route}: HTTP ${r.status}`);
+  const {request_id: id} = await r.json();
+  const g = await fetch(`/api/get?request_id=${id}&timeout=120`);
+  const rec = await g.json();
+  if (rec.status !== 'SUCCEEDED') {
+    throw new Error(rec.error || `request ${rec.status}`);
+  }
+  return rec.result;
+}
+
+async function apiGet(route) {
+  const r = await fetch(route);
+  if (!r.ok) throw new Error(`${route}: HTTP ${r.status}`);
+  return r.json();
+}
+
+// --- rendering helpers -------------------------------------------------
+
+function esc(s) {
+  return String(s ?? '').replace(/[&<>"']/g,
+      (c) => ({'&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;',
+               "'": '&#39;'}[c]));
+}
+
+const STATUS_CLASS = {
+  UP: 'ok', RUNNING: 'ok', SUCCEEDED: 'ok', READY: 'ok', ALIVE: 'ok',
+  INIT: 'info', PENDING: 'info', STARTING: 'info', PROVISIONING: 'info',
+  SETTING_UP: 'info', RECOVERING: 'warn', STOPPED: 'warn',
+  CANCELLED: 'warn', NOT_READY: 'warn', SHUTTING_DOWN: 'warn',
+  FAILED: 'err', FAILED_SETUP: 'err', FAILED_DRIVER: 'err',
+  FAILED_CONTROLLER: 'err', FAILED_NO_RESOURCE: 'err',
+};
+
+function badge(status) {
+  const cls = STATUS_CLASS[String(status).toUpperCase()] || 'info';
+  return `<span class="status ${cls}">${esc(status)}</span>`;
+}
+
+function table(headers, rows) {
+  if (!rows.length) return '<div class="empty">Nothing here yet.</div>';
+  const head = headers.map((h) => `<th>${esc(h)}</th>`).join('');
+  const body = rows.map(
+      (r) => `<tr>${r.map((c) => `<td>${c}</td>`).join('')}</tr>`).join('');
+  return `<table><thead><tr>${head}</tr></thead>` +
+         `<tbody>${body}</tbody></table>`;
+}
+
+function cards(items) {
+  return '<div class="cards">' + items.map(([num, label]) =>
+      `<div class="card"><div class="num">${esc(num)}</div>` +
+      `<div class="label">${esc(label)}</div></div>`).join('') + '</div>';
+}
+
+function fmtTime(ts) {
+  if (!ts) return '-';
+  return new Date(ts * 1000).toLocaleString();
+}
+
+function fmtCost(c) {
+  return c == null ? '-' : `$${Number(c).toFixed(2)}/hr`;
+}
+
+// --- pages -------------------------------------------------------------
+
+const PAGES = {
+  clusters: {
+    title: 'Clusters',
+    async render() {
+      const rows = await apiCall('/status', {refresh: false});
+      const up = rows.filter((c) => c.status === 'UP').length;
+      return cards([[rows.length, 'clusters'], [up, 'up']]) +
+        table(
+          ['Name', 'Status', 'Infra', 'Resources', 'Cost', 'Launched'],
+          rows.map((c) => [
+            `<span class="mono">${esc(c.name)}</span>`,
+            badge(c.status),
+            esc(c.infra || [c.cloud, c.region].filter(Boolean).join('/')),
+            `<span class="mono">${esc(c.resources_str || '-')}</span>`,
+            fmtCost(c.cost_per_hour),
+            fmtTime(c.launched_at),
+          ]));
+    },
+  },
+  jobs: {
+    title: 'Managed Jobs',
+    async render() {
+      const rows = await apiCall('/jobs/queue', {});
+      const active = rows.filter(
+          (j) => ['RUNNING', 'RECOVERING', 'STARTING', 'PENDING']
+              .includes(j.status)).length;
+      return cards([[rows.length, 'jobs'], [active, 'active']]) +
+        table(
+          ['ID', 'Name', 'Status', 'Resources', 'Recoveries', 'Submitted'],
+          rows.map((j) => [
+            esc(j.job_id),
+            `<span class="mono">${esc(j.name || '-')}</span>`,
+            badge(j.status),
+            `<span class="mono">${esc(j.resources_str || '-')}</span>`,
+            esc(j.recovery_count ?? 0),
+            fmtTime(j.submitted_at),
+          ]));
+    },
+  },
+  services: {
+    title: 'Services',
+    async render() {
+      const rows = await apiCall('/serve/status', {});
+      return table(
+        ['Name', 'Status', 'Version', 'Endpoint', 'Replicas'],
+        rows.map((s) => [
+          `<span class="mono">${esc(s.name)}</span>`,
+          badge(s.status),
+          esc(s.version ?? '-'),
+          `<span class="mono">${esc(s.endpoint || '-')}</span>`,
+          esc(`${(s.replicas || []).filter((r) =>
+              r.status === 'READY').length}/${(s.replicas || []).length}`),
+        ]));
+    },
+  },
+  infra: {
+    title: 'Infra — TPU catalog',
+    async render() {
+      const rows = await apiGet('/api/catalog');
+      return table(
+        ['Accelerator', 'Chips', 'Hosts', 'Region', 'Zone',
+         'On-demand', 'Spot'],
+        rows.map((o) => [
+          `<span class="mono">${esc(o.accelerator)}</span>`,
+          esc(o.chips), esc(o.num_hosts),
+          esc(o.region), `<span class="mono">${esc(o.zone)}</span>`,
+          fmtCost(o.price_hourly), fmtCost(o.spot_price_hourly),
+        ]));
+    },
+  },
+  volumes: {
+    title: 'Volumes',
+    async render() {
+      const rows = await apiGet('/api/volumes');
+      return table(
+        ['Name', 'Cloud', 'Region', 'Size', 'Status', 'Attached to'],
+        rows.map((v) => [
+          `<span class="mono">${esc(v.name)}</span>`,
+          esc(v.cloud), esc(v.region || '-'),
+          esc(v.size_gb ? `${v.size_gb} GiB` : '-'),
+          badge(v.status),
+          `<span class="mono">${esc(v.attached_to || '-')}</span>`,
+        ]));
+    },
+  },
+  workspaces: {
+    title: 'Workspaces',
+    async render() {
+      const ws = await apiGet('/workspaces');
+      return table(
+        ['Name', 'Config'],
+        Object.entries(ws).map(([name, cfg]) => [
+          `<span class="mono">${esc(name)}</span>`,
+          `<span class="mono">${esc(JSON.stringify(cfg))}</span>`,
+        ]));
+    },
+  },
+  users: {
+    title: 'Users',
+    async render() {
+      const rows = (await apiGet('/users/list')).users || [];
+      return table(
+        ['ID', 'Name', 'Role', 'Created'],
+        rows.map((u) => [
+          `<span class="mono">${esc(u.id)}</span>`,
+          esc(u.name), esc(u.role || '-'), fmtTime(u.created_at),
+        ]));
+    },
+  },
+  requests: {
+    title: 'API Requests',
+    async render() {
+      const rows = await apiGet('/api/requests');
+      return table(
+        ['ID', 'Name', 'Status', 'Created'],
+        rows.slice().reverse().slice(0, 200).map((r) => [
+          `<span class="mono">${esc(r.request_id.slice(0, 8))}</span>`,
+          esc(r.name), badge(r.status), fmtTime(r.created_at),
+        ]));
+    },
+  },
+};
+
+// --- router ------------------------------------------------------------
+
+let currentPage = null;
+
+async function navigate() {
+  const page = (location.hash || '#clusters').slice(1);
+  const spec = PAGES[page] || PAGES.clusters;
+  currentPage = page;
+  document.querySelectorAll('.nav-link').forEach((a) =>
+      a.classList.toggle('active', a.dataset.page === page));
+  $('#page-title').innerHTML = `${esc(spec.title)}` +
+      '<button class="refresh" onclick="navigate()">⟳ refresh</button>';
+  $('#page-body').innerHTML = '<div class="loading">Loading…</div>';
+  try {
+    $('#page-body').innerHTML = await spec.render();
+  } catch (e) {
+    $('#page-body').innerHTML =
+        `<div class="error-box">${esc(e.message)}</div>`;
+  }
+}
+
+async function showServerInfo() {
+  try {
+    const h = await apiGet('/api/health');
+    $('#server-info').textContent =
+        `server v${h.version} · api v${h.api_version}`;
+  } catch (e) {
+    $('#server-info').textContent = 'server unreachable';
+  }
+}
+
+window.addEventListener('hashchange', navigate);
+window.navigate = navigate;
+navigate();
+showServerInfo();

@@ -113,6 +113,10 @@ class JobNotFoundError(SkyTpuError):
     """Job id not present in the on-cluster job queue."""
 
 
+class PoolNotFoundError(SkyTpuError):
+    """Named jobs worker pool does not exist."""
+
+
 class JobExitCode(enum.IntEnum):
     """Exit codes surfaced by job wait/tail (mirrors sky/exceptions.py)."""
     SUCCEEDED = 0

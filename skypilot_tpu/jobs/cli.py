@@ -8,9 +8,43 @@ def _cmd_launch(args) -> int:
     from skypilot_tpu import task as task_lib
     from skypilot_tpu.jobs import core
     task = task_lib.Task.from_yaml(args.yaml)
-    job_id = core.launch(task, name=args.name)
+    job_id = core.launch(task, name=args.name,
+                         pool=getattr(args, 'pool', None))
     if not args.detach_run:
         return core.tail_logs(job_id)
+    return 0
+
+
+def _cmd_pool_apply(args) -> int:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import pool as pool_lib
+    task = task_lib.Task.from_yaml(args.yaml)
+    pool_lib.apply(args.name, task, args.workers)
+    for p in pool_lib.status(args.name):
+        print(f"Pool {p['name']!r}: {p['idle']}/{p['num_workers']} "
+              f'workers idle.')
+    return 0
+
+
+def _cmd_pool_status(args) -> int:
+    from skypilot_tpu.jobs import pool as pool_lib
+    pools = pool_lib.status(args.name)
+    if not pools:
+        print('No pools.')
+        return 0
+    for p in pools:
+        print(f"{p['name']}: target={p['num_workers']} idle={p['idle']}")
+        for w in p['workers']:
+            job = f" job={w['job_id']}" if w['job_id'] else ''
+            print(f"  [{w['worker_id']}] {w['cluster_name']:<24} "
+                  f"{w['status']}{job}")
+    return 0
+
+
+def _cmd_pool_down(args) -> int:
+    from skypilot_tpu.jobs import pool as pool_lib
+    pool_lib.down(args.name)
+    print(f'Pool {args.name!r} torn down.')
     return 0
 
 
@@ -49,7 +83,23 @@ def register(sub) -> None:
     pl.add_argument('yaml')
     pl.add_argument('-n', '--name')
     pl.add_argument('-d', '--detach-run', action='store_true')
+    pl.add_argument('-p', '--pool', default=None,
+                    help='Run on an idle worker of this pool')
     pl.set_defaults(fn=_cmd_launch)
+
+    pp = jsub.add_parser('pool', help='Worker pools for managed jobs')
+    psub = pp.add_subparsers(dest='pool_command')
+    pa = psub.add_parser('apply', help='Create/resize a pool')
+    pa.add_argument('yaml', help='Worker spec (resources + setup)')
+    pa.add_argument('-n', '--name', required=True)
+    pa.add_argument('-w', '--workers', type=int, default=1)
+    pa.set_defaults(fn=_cmd_pool_apply)
+    ps = psub.add_parser('status', help='Show pools')
+    ps.add_argument('name', nargs='?', default=None)
+    ps.set_defaults(fn=_cmd_pool_status)
+    pd = psub.add_parser('down', help='Tear down a pool')
+    pd.add_argument('name')
+    pd.set_defaults(fn=_cmd_pool_down)
 
     pq = jsub.add_parser('queue', help='List managed jobs')
     pq.add_argument('-a', '--all', action='store_true')

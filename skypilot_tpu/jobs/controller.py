@@ -62,6 +62,8 @@ class JobController:
             self.table.set_status(self.job_id,
                                   ManagedJobStatus.FAILED_PRECHECKS, str(e))
             return ManagedJobStatus.FAILED_PRECHECKS
+        if record.get('pool'):
+            return self._run_on_pool(record, task)
         cluster_name = f'jobs-{self.job_id}'
         strategy = strategy_lib.StrategyExecutor.make(task, cluster_name)
         max_restarts = record['max_restarts_on_errors'] or (
@@ -141,6 +143,122 @@ class JobController:
                         return ManagedJobStatus.FAILED_NO_RESOURCE
                     continue
 
+    def _run_on_pool(self, record, task) -> ManagedJobStatus:
+        """Pool path: no provisioning — exec onto an idle pool worker and
+        monitor; a dead worker triggers re-acquire on another worker
+        (reference: jobs scheduled onto `sky jobs pool` workers)."""
+        from skypilot_tpu import execution
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.jobs import pool as pool_lib
+        pool_name = record['pool']
+        table = pool_lib.PoolTable()
+        self.table.set_status(self.job_id, ManagedJobStatus.STARTING)
+        self.table.set_schedule_state(self.job_id,
+                                      ManagedJobScheduleState.LAUNCHING)
+
+        def _acquire_and_exec():
+            """Claim an idle worker and submit; returns
+            (cluster, job_id, handle) or None if no worker is free."""
+            cluster = table.acquire(pool_name, self.job_id)
+            if cluster is None:
+                return None
+            cluster_record = state_lib.get_cluster(cluster)
+            if cluster_record is None:
+                table.release(pool_name, cluster, failed=True)
+                return None
+            try:
+                cluster_job_id, handle = execution.exec_cmd(
+                    task, cluster, detach_run=True)
+            except (exceptions.SkyTpuError, requests.RequestException) as e:
+                logger.warning(f'Managed job {self.job_id}: exec on pool '
+                               f'worker {cluster} failed: {e}')
+                table.release(pool_name, cluster, failed=True)
+                return None
+            return cluster, cluster_job_id, handle
+
+        def _place():
+            """Wait for + claim a worker.  Returns (cluster, job, handle)
+            or a terminal ManagedJobStatus (cancel/pool-gone/timeout are
+            honored identically for first placement and recovery)."""
+            deadline = time.time() + float(
+                config_lib.get_nested(('jobs', 'pool_wait_timeout'), 3600))
+            while True:
+                rec = self.table.get(self.job_id)
+                if rec['status'] == ManagedJobStatus.CANCELLING:
+                    self.table.set_status(self.job_id,
+                                          ManagedJobStatus.CANCELLED)
+                    return ManagedJobStatus.CANCELLED
+                if table.get_pool(pool_name) is None:
+                    self.table.set_status(
+                        self.job_id, ManagedJobStatus.FAILED_PRECHECKS,
+                        f'pool {pool_name!r} does not exist')
+                    return ManagedJobStatus.FAILED_PRECHECKS
+                placed = _acquire_and_exec()
+                if placed is not None:
+                    return placed
+                if time.time() > deadline:
+                    self.table.set_status(
+                        self.job_id, ManagedJobStatus.FAILED_NO_RESOURCE,
+                        f'no idle worker in pool {pool_name!r} within '
+                        f'timeout')
+                    return ManagedJobStatus.FAILED_NO_RESOURCE
+                time.sleep(self.poll_seconds)
+
+        placed = _place()
+        if isinstance(placed, ManagedJobStatus):
+            return placed
+        cluster, cluster_job_id, handle = placed
+        self.table.set_cluster(self.job_id, cluster, cluster_job_id)
+        self.table.set_status(self.job_id, ManagedJobStatus.RUNNING)
+        self.table.set_schedule_state(self.job_id,
+                                      ManagedJobScheduleState.ALIVE)
+        while True:
+            time.sleep(self.poll_seconds)
+            record = self.table.get(self.job_id)
+            if record['status'] == ManagedJobStatus.CANCELLING:
+                try:
+                    AgentClient(handle.agent_url()).cancel([cluster_job_id])
+                except requests.RequestException:
+                    pass
+                table.release(pool_name, cluster)
+                self.table.set_status(self.job_id,
+                                      ManagedJobStatus.CANCELLED)
+                return ManagedJobStatus.CANCELLED
+            status = self._poll_cluster_job(handle, cluster_job_id)
+            if status == JobStatus.SUCCEEDED:
+                table.release(pool_name, cluster)
+                self.table.set_status(self.job_id,
+                                      ManagedJobStatus.SUCCEEDED)
+                return ManagedJobStatus.SUCCEEDED
+            if status == JobStatus.CANCELLED:
+                table.release(pool_name, cluster)
+                self.table.set_status(
+                    self.job_id, ManagedJobStatus.CANCELLED,
+                    'underlying cluster job was cancelled')
+                return ManagedJobStatus.CANCELLED
+            if status in (JobStatus.FAILED, JobStatus.FAILED_SETUP,
+                          JobStatus.FAILED_DRIVER):
+                table.release(pool_name, cluster)
+                self.table.set_status(
+                    self.job_id, ManagedJobStatus.FAILED,
+                    f'cluster job ended with {status.value}')
+                return ManagedJobStatus.FAILED
+            if status is None and not self._cluster_healthy(handle):
+                # Worker died (e.g. preempted): fail it over to another
+                # worker; reconcile will replace the dead one.
+                logger.info(f'Managed job {self.job_id}: pool worker '
+                            f'{cluster} lost; re-acquiring.')
+                table.release(pool_name, cluster, failed=True)
+                self.table.set_status(self.job_id,
+                                      ManagedJobStatus.RECOVERING)
+                self.table.bump_recovery(self.job_id)
+                placed = _place()
+                if isinstance(placed, ManagedJobStatus):
+                    return placed
+                cluster, cluster_job_id, handle = placed
+                self.table.set_cluster(self.job_id, cluster, cluster_job_id)
+                self.table.set_status(self.job_id, ManagedJobStatus.RUNNING)
+
     def _poll_cluster_job(self, handle, cluster_job_id
                           ) -> Optional[JobStatus]:
         try:
@@ -189,9 +307,10 @@ class Scheduler:
 
     def submit(self, name: Optional[str], task_config: dict,
                recovery_strategy: str = 'failover',
-               max_restarts_on_errors: int = 0) -> int:
+               max_restarts_on_errors: int = 0,
+               pool: Optional[str] = None) -> int:
         return self.table.submit(name, task_config, recovery_strategy,
-                                 max_restarts_on_errors)
+                                 max_restarts_on_errors, pool=pool)
 
     def cancel(self, job_id: int) -> bool:
         record = self.table.get(job_id)
@@ -223,9 +342,30 @@ class Scheduler:
             self._threads[job_id] = thread
             active += 1
 
-    def run_forever(self, interval: float = 2.0) -> None:
+    def _reconcile_pools(self) -> None:
+        try:
+            from skypilot_tpu.jobs import pool as pool_lib
+            for pool in pool_lib.PoolTable().list_pools():
+                pool_lib.reconcile(pool['name'])
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Pool reconcile failed: {e}')
+
+    def run_forever(self, interval: float = 2.0,
+                    pool_reconcile_every: float = 30.0) -> None:
+        last_reconcile = 0.0
+        reconcile_thread: Optional[threading.Thread] = None
         while not self._stop.is_set():
             self.step()
+            # Reconcile runs off-thread: worker provisioning takes minutes
+            # and must not starve job scheduling.  One pass at a time.
+            if (time.time() - last_reconcile > pool_reconcile_every and
+                    (reconcile_thread is None or
+                     not reconcile_thread.is_alive())):
+                last_reconcile = time.time()
+                reconcile_thread = threading.Thread(
+                    target=self._reconcile_pools, daemon=True,
+                    name='pool-reconcile')
+                reconcile_thread.start()
             time.sleep(interval)
 
     def stop(self) -> None:

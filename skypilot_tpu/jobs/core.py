@@ -51,9 +51,18 @@ def ensure_controller() -> None:
     time.sleep(0.5)
 
 
-def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
-    """Submit a managed job; returns the managed job id."""
+def launch(task: task_lib.Task, name: Optional[str] = None,
+           pool: Optional[str] = None) -> int:
+    """Submit a managed job; returns the managed job id.  With `pool`,
+    the job execs onto an idle worker of that pool instead of
+    provisioning its own cluster (reference: `sky jobs launch --pool`)."""
     from skypilot_tpu import config
+    if pool is not None:
+        from skypilot_tpu.jobs import pool as pool_lib
+        if pool_lib.PoolTable().get_pool(pool) is None:
+            raise exceptions.PoolNotFoundError(
+                f'No pool {pool!r}; create it with `skytpu jobs pool '
+                f'apply` first.')
     name = name or task.name
     jr = task.best_resources.job_recovery or {}
     table = JobsTable()
@@ -63,9 +72,11 @@ def launch(task: task_lib.Task, name: Optional[str] = None) -> int:
         max_restarts_on_errors=int(jr.get('max_restarts_on_errors', 0)),
         # Persist the authenticated submitter so the (separate) controller
         # process attributes the job's clusters to them, not to itself.
-        user_hash=config.get_nested(('requesting_user',)))
+        user_hash=config.get_nested(('requesting_user',)),
+        pool=pool)
     ensure_controller()
-    logger.info(f'Managed job {job_id} ({name!r}) submitted.')
+    logger.info(f'Managed job {job_id} ({name!r}) submitted'
+                + (f' to pool {pool!r}.' if pool else '.'))
     return job_id
 
 

@@ -1,0 +1,273 @@
+"""Jobs worker pools: pre-provisioned clusters that managed jobs run on.
+
+Reference parity: `sky jobs pool apply/status/down` (pool logic inside
+sky/jobs/ + the CLI `pool` group) — a pool is a named set of worker
+clusters launched once from a pool spec (resources + setup); managed
+jobs submitted with `pool=<name>` skip per-job provisioning and exec
+onto an idle worker, which cuts job start latency to seconds and lets
+N short jobs share one TPU reservation.
+
+Worker state machine: PROVISIONING → IDLE ⇄ BUSY, FAILED on
+launch/health failure (the daemon's reconcile pass relaunches FAILED
+or missing workers to keep the pool at its target size).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_DB_PATH = '~/.skypilot_tpu/managed_jobs.db'
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS pools (
+    name TEXT PRIMARY KEY,
+    task_yaml TEXT,
+    num_workers INTEGER,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS pool_workers (
+    pool TEXT,
+    worker_id INTEGER,
+    cluster_name TEXT,
+    status TEXT,
+    job_id INTEGER,
+    PRIMARY KEY (pool, worker_id)
+);
+"""
+
+
+class WorkerStatus(enum.Enum):
+    PROVISIONING = 'PROVISIONING'
+    IDLE = 'IDLE'
+    BUSY = 'BUSY'
+    FAILED = 'FAILED'
+
+
+class PoolTable:
+
+    def __init__(self, db_path: str = _DB_PATH) -> None:
+        self.db_path = os.path.expanduser(db_path)
+        os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self.db_path, timeout=30)
+        conn.execute('PRAGMA journal_mode=WAL')
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    # --- pool spec ------------------------------------------------------
+
+    def upsert_pool(self, name: str, task_config: Dict[str, Any],
+                    num_workers: int) -> None:
+        with self._conn() as conn:
+            conn.execute(
+                'INSERT INTO pools (name, task_yaml, num_workers, '
+                'created_at) VALUES (?, ?, ?, ?) ON CONFLICT(name) DO '
+                'UPDATE SET task_yaml = ?, num_workers = ?',
+                (name, json.dumps(task_config), num_workers, time.time(),
+                 json.dumps(task_config), num_workers))
+
+    def get_pool(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._conn() as conn:
+            row = conn.execute('SELECT * FROM pools WHERE name = ?',
+                               (name,)).fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d['task_config'] = json.loads(d.pop('task_yaml'))
+        return d
+
+    def list_pools(self) -> List[Dict[str, Any]]:
+        with self._conn() as conn:
+            rows = conn.execute('SELECT name FROM pools').fetchall()
+        return [self.get_pool(r['name']) for r in rows]
+
+    def delete_pool(self, name: str) -> None:
+        with self._conn() as conn:
+            conn.execute('DELETE FROM pools WHERE name = ?', (name,))
+            conn.execute('DELETE FROM pool_workers WHERE pool = ?', (name,))
+
+    # --- workers --------------------------------------------------------
+
+    def workers(self, pool: str) -> List[Dict[str, Any]]:
+        with self._conn() as conn:
+            rows = conn.execute(
+                'SELECT * FROM pool_workers WHERE pool = ? '
+                'ORDER BY worker_id', (pool,)).fetchall()
+        return [{**dict(r), 'status': WorkerStatus(r['status'])}
+                for r in rows]
+
+    def set_worker(self, pool: str, worker_id: int, cluster_name: str,
+                   status: WorkerStatus) -> None:
+        with self._conn() as conn:
+            conn.execute(
+                'INSERT INTO pool_workers (pool, worker_id, cluster_name, '
+                'status) VALUES (?, ?, ?, ?) ON CONFLICT(pool, worker_id) '
+                'DO UPDATE SET cluster_name = ?, status = ?',
+                (pool, worker_id, cluster_name, status.value,
+                 cluster_name, status.value))
+
+    def remove_worker(self, pool: str, worker_id: int) -> None:
+        with self._conn() as conn:
+            conn.execute(
+                'DELETE FROM pool_workers WHERE pool = ? AND worker_id = ?',
+                (pool, worker_id))
+
+    def acquire(self, pool: str, job_id: int) -> Optional[str]:
+        """Atomically claim an IDLE worker for job_id; returns its cluster
+        name, or None if all busy (BEGIN IMMEDIATE serializes claimants)."""
+        conn = self._conn()
+        try:
+            conn.execute('BEGIN IMMEDIATE')
+            row = conn.execute(
+                'SELECT worker_id, cluster_name FROM pool_workers WHERE '
+                'pool = ? AND status = ? ORDER BY worker_id LIMIT 1',
+                (pool, WorkerStatus.IDLE.value)).fetchone()
+            if row is None:
+                conn.execute('ROLLBACK')
+                return None
+            conn.execute(
+                'UPDATE pool_workers SET status = ?, job_id = ? WHERE '
+                'pool = ? AND worker_id = ?',
+                (WorkerStatus.BUSY.value, job_id, pool, row['worker_id']))
+            conn.execute('COMMIT')
+            return row['cluster_name']
+        finally:
+            conn.close()
+
+    def release(self, pool: str, cluster_name: str,
+                failed: bool = False) -> None:
+        status = WorkerStatus.FAILED if failed else WorkerStatus.IDLE
+        with self._conn() as conn:
+            conn.execute(
+                'UPDATE pool_workers SET status = ?, job_id = NULL WHERE '
+                'pool = ? AND cluster_name = ?',
+                (status.value, pool, cluster_name))
+
+
+# --- pool operations (user API) -----------------------------------------
+
+
+def _worker_cluster(pool: str, worker_id: int) -> str:
+    return f'pool-{pool}-{worker_id}'
+
+
+def _launch_worker(table: PoolTable, pool: str, worker_id: int,
+                   task_config: Dict[str, Any]) -> bool:
+    """Launch one worker cluster (setup only, no run command)."""
+    from skypilot_tpu import execution
+    cluster = _worker_cluster(pool, worker_id)
+    worker_task = task_lib.Task.from_yaml_config(
+        {**task_config, 'run': None, 'name': f'{pool}-worker-{worker_id}'})
+    table.set_worker(pool, worker_id, cluster, WorkerStatus.PROVISIONING)
+    try:
+        execution.launch(worker_task, cluster_name=cluster)
+    except (exceptions.SkyTpuError, exceptions.CommandError) as e:
+        logger.warning(f'Pool {pool!r} worker {worker_id} failed to '
+                       f'launch: {e}')
+        table.set_worker(pool, worker_id, cluster, WorkerStatus.FAILED)
+        return False
+    table.set_worker(pool, worker_id, cluster, WorkerStatus.IDLE)
+    return True
+
+
+def apply(name: str, task: task_lib.Task, num_workers: int) -> None:
+    """Create or resize a pool (reference: `sky jobs pool apply`).
+    Synchronous: returns when the pool is reconciled once."""
+    table = PoolTable()
+    table.upsert_pool(name, task.to_yaml_config(), num_workers)
+    reconcile(name)
+
+
+def reconcile(name: str) -> None:
+    """Drive the pool toward its target size: launch missing/FAILED
+    workers, tear down extras (the daemon calls this periodically)."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import state as state_lib
+    table = PoolTable()
+    pool = table.get_pool(name)
+    if pool is None:
+        return
+    workers = {w['worker_id']: w for w in table.workers(name)}
+    # Scale down: drop the highest-numbered extras first — but never a
+    # BUSY worker (it carries a running managed job; it drains out on a
+    # later reconcile pass, after release).
+    for worker_id in sorted(workers, reverse=True):
+        if worker_id < pool['num_workers']:
+            break
+        w = workers[worker_id]
+        if w['status'] == WorkerStatus.BUSY:
+            logger.info(f'Pool {name!r}: worker {worker_id} is BUSY; '
+                        f'deferring scale-down until its job finishes.')
+            continue
+        workers.pop(worker_id)
+        if state_lib.get_cluster(w['cluster_name']) is not None:
+            try:
+                core_lib.down(w['cluster_name'])
+            except exceptions.SkyTpuError as e:
+                logger.warning(f'Pool {name!r}: teardown of extra worker '
+                               f'{worker_id} failed: {e}')
+        table.remove_worker(name, worker_id)
+    # Scale up / replace failed.
+    for worker_id in range(pool['num_workers']):
+        w = workers.get(worker_id)
+        if w is None or w['status'] == WorkerStatus.FAILED:
+            if w is not None and \
+                    state_lib.get_cluster(w['cluster_name']) is not None:
+                try:
+                    core_lib.down(w['cluster_name'])
+                except exceptions.SkyTpuError:
+                    pass
+            _launch_worker(table, name, worker_id, pool['task_config'])
+
+
+def status(name: Optional[str] = None) -> List[Dict[str, Any]]:
+    table = PoolTable()
+    pools = ([table.get_pool(name)] if name else table.list_pools())
+    out = []
+    for pool in pools:
+        if pool is None:
+            continue
+        workers = table.workers(pool['name'])
+        out.append({
+            'name': pool['name'],
+            'num_workers': pool['num_workers'],
+            'workers': [{
+                'worker_id': w['worker_id'],
+                'cluster_name': w['cluster_name'],
+                'status': w['status'].value,
+                'job_id': w['job_id'],
+            } for w in workers],
+            'idle': sum(1 for w in workers
+                        if w['status'] == WorkerStatus.IDLE),
+        })
+    return out
+
+
+def down(name: str) -> None:
+    """Tear down all workers and delete the pool."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import state as state_lib
+    table = PoolTable()
+    if table.get_pool(name) is None:
+        raise exceptions.PoolNotFoundError(f'No pool {name!r}.')
+    for w in table.workers(name):
+        if state_lib.get_cluster(w['cluster_name']) is not None:
+            try:
+                core_lib.down(w['cluster_name'])
+            except exceptions.SkyTpuError as e:
+                logger.warning(f'Pool {name!r}: teardown of worker '
+                               f'{w["worker_id"]} failed: {e}')
+    table.delete_pool(name)

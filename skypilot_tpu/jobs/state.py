@@ -89,7 +89,8 @@ class JobsTable:
             if self.db_path not in _MIGRATED:
                 from skypilot_tpu.utils import db_utils
                 db_utils.add_columns_if_missing(
-                    conn, 'managed_jobs', (('user_hash', 'TEXT'),))
+                    conn, 'managed_jobs', (('user_hash', 'TEXT'),
+                                           ('pool', 'TEXT')))
                 _MIGRATED.add(self.db_path)
 
     def _conn(self) -> sqlite3.Connection:
@@ -101,17 +102,19 @@ class JobsTable:
     def submit(self, name: Optional[str], task_config: Dict[str, Any],
                recovery_strategy: str = 'failover',
                max_restarts_on_errors: int = 0,
-               user_hash: Optional[str] = None) -> int:
+               user_hash: Optional[str] = None,
+               pool: Optional[str] = None) -> int:
         with self._conn() as conn:
             cur = conn.execute(
                 'INSERT INTO managed_jobs (name, task_yaml, status, '
                 'schedule_state, submitted_at, recovery_strategy, '
-                'max_restarts_on_errors, user_hash) '
-                'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
+                'max_restarts_on_errors, user_hash, pool) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
                 (name, json.dumps(task_config),
                  ManagedJobStatus.PENDING.value,
                  ManagedJobScheduleState.WAITING.value, time.time(),
-                 recovery_strategy, max_restarts_on_errors, user_hash))
+                 recovery_strategy, max_restarts_on_errors, user_hash,
+                 pool))
             return int(cur.lastrowid)
 
     def set_status(self, job_id: int, status: ManagedJobStatus,

@@ -18,8 +18,8 @@ from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
 from skypilot_tpu.utils import timeline
 
 __all__ = ['ClusterInfo', 'InstanceInfo', 'ProvisionRecord', 'run_instances',
-           'terminate_instances', 'stop_instances', 'get_cluster_info',
-           'wait_instances', 'query_instances']
+           'terminate_instances', 'stop_instances', 'start_instances',
+           'get_cluster_info', 'wait_instances', 'query_instances']
 
 
 def _dispatch(fn_name: str) -> Callable:
@@ -37,6 +37,7 @@ def _dispatch(fn_name: str) -> Callable:
 run_instances = _dispatch('run_instances')
 terminate_instances = _dispatch('terminate_instances')
 stop_instances = _dispatch('stop_instances')
+start_instances = _dispatch('start_instances')
 get_cluster_info = _dispatch('get_cluster_info')
 wait_instances = _dispatch('wait_instances')
 query_instances = _dispatch('query_instances')

@@ -217,6 +217,25 @@ def stop_instances(cluster_name: str,
         client.wait_operation(op)
 
 
+def start_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> None:
+    """Start previously stopped single-host TPU VMs (TPU API
+    nodes:start; pods never reach STOPPED so this is single-host only)."""
+    config = provider_config or {}
+    zone = config.get('zone')
+    client = _client(config)
+    operations = []
+    for node in client.list_nodes(zone):
+        name = node['name'].rsplit('/', 1)[-1]
+        labels = node.get('labels') or {}
+        if labels.get('skypilot-tpu-cluster') != cluster_name:
+            continue
+        operations.append(client.start_node(zone, name))
+    for op in operations:
+        client.wait_operation(op)
+
+
 def terminate_instances(cluster_name: str,
                         provider_config: Optional[Dict[str, Any]] = None,
                         worker_only: bool = False) -> None:

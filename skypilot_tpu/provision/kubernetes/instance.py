@@ -204,6 +204,13 @@ def stop_instances(cluster_name: str,
         'Kubernetes pods cannot be stopped; use down.')
 
 
+def start_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> None:
+    raise NotImplementedError(
+        'Kubernetes pods cannot be stopped/started.')
+
+
 def terminate_instances(cluster_name: str,
                         provider_config: Optional[Dict[str, Any]] = None,
                         worker_only: bool = False) -> None:

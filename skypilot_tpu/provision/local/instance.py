@@ -132,6 +132,12 @@ def stop_instances(cluster_name: str,
     raise NotImplementedError('local clusters cannot be stopped; use down.')
 
 
+def start_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> None:
+    raise NotImplementedError('local clusters cannot be stopped/started.')
+
+
 def terminate_instances(cluster_name: str,
                         provider_config: Optional[Dict[str, Any]] = None,
                         worker_only: bool = False) -> None:

@@ -281,6 +281,26 @@ def provision_with_failover(
         f'{len(history)} attempted zones.', failover_history=history)
 
 
+def restart(handle: ClusterHandle) -> ClusterHandle:
+    """Start a STOPPED cluster's instances and bring the runtime back
+    (reference: sky start → backend._provision on the cached handle).
+
+    Re-fetches ClusterInfo afterwards — a stop/start cycle can change
+    external IPs — and re-runs runtime setup since the VM rebooted."""
+    info = handle.cluster_info
+    provision_api.start_instances(info.cloud, handle.cluster_name,
+                                  info.provider_config)
+    provision_api.wait_instances(info.cloud, info.region,
+                                 handle.cluster_name, 'running',
+                                 provider_config=info.provider_config)
+    new_info = provision_api.get_cluster_info(
+        info.cloud, info.region, handle.cluster_name, info.provider_config)
+    handle.cluster_info = new_info
+    handle.agent_port = _setup_runtime(new_info, handle.agent_port,
+                                       handle.cluster_name)
+    return handle
+
+
 def teardown(handle: ClusterHandle, terminate: bool = True) -> None:
     op = (provision_api.terminate_instances if terminate
           else provision_api.stop_instances)

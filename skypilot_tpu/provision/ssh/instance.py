@@ -86,6 +86,12 @@ def stop_instances(cluster_name: str,
                               '(releases the hosts back to the pool).')
 
 
+def start_instances(cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> None:
+    raise NotImplementedError('BYO SSH hosts cannot be stopped/started.')
+
+
 def terminate_instances(cluster_name: str,
                         provider_config: Optional[Dict[str, Any]] = None,
                         worker_only: bool = False) -> None:

@@ -103,6 +103,12 @@ def _cancel(payload: Dict[str, Any]) -> List[int]:
                        job_ids=payload.get('job_ids'))
 
 
+@entrypoint('cost_report')
+def _cost_report(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    from skypilot_tpu import core
+    return core.cost_report()
+
+
 @entrypoint('optimize')
 def _optimize(payload: Dict[str, Any]) -> Dict[str, Any]:
     from skypilot_tpu import optimizer as optimizer_lib

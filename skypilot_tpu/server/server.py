@@ -30,7 +30,8 @@ from skypilot_tpu.server import entrypoints  # noqa: F401  pylint: disable=unuse
 logger = sky_logging.init_logger(__name__)
 
 DEFAULT_PORT = 46580
-API_VERSION = 1
+# Single source of truth for the wire version (negotiation in versions.py).
+from skypilot_tpu.server.versions import API_VERSION  # noqa: E402
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -42,6 +43,18 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
     """Build the app.  pool=None -> inline execution (test mode, the
     reference's TestClient trick)."""
     from skypilot_tpu.server import auth as auth_lib
+
+    @web.middleware
+    async def version_middleware(request: web.Request, handler):
+        from skypilot_tpu.server import versions
+        ok, msg = versions.check_client_compatible(
+            request.headers.get(versions.API_VERSION_HEADER))
+        if not ok:
+            resp = _json_error(400, msg)
+        else:
+            resp = await handler(request)
+        resp.headers.update(versions.response_headers())
+        return resp
 
     @web.middleware
     async def metrics_middleware(request: web.Request, handler):
@@ -68,6 +81,7 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
                                         time_lib.monotonic() - start)
 
     app = web.Application(middlewares=[metrics_middleware,
+                                       version_middleware,
                                        auth_lib.auth_middleware])
     routes = web.RouteTableDef()
 
@@ -132,6 +146,7 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
             ('/down', 'down'), ('/autostop', 'autostop'),
             ('/queue', 'queue'), ('/cancel', 'cancel'),
             ('/optimize', 'optimize'), ('/check', 'check'),
+            ('/cost_report', 'cost_report'),
             ('/jobs/launch', 'jobs.launch'), ('/jobs/queue', 'jobs.queue'),
             ('/jobs/cancel', 'jobs.cancel'),
             ('/serve/up', 'serve.up'), ('/serve/update', 'serve.update'),
@@ -233,6 +248,45 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
 
     # --- direct (non-queued) endpoints ---
 
+    @routes.get('/api/catalog')
+    async def api_catalog(request: web.Request) -> web.Response:
+        """TPU offerings for the dashboard infra page (reference: the
+        dashboard's infra view over catalog data)."""
+        from skypilot_tpu import catalog as catalog_lib
+        name_filter = request.query.get('name') or None
+        grouped = await asyncio.to_thread(catalog_lib.list_accelerators,
+                                          name_filter)
+        return web.json_response([{
+            'accelerator': name, 'chips': o.spec.chips,
+            'num_hosts': o.spec.num_hosts, 'region': o.region,
+            'zone': o.zone, 'price_hourly': o.price,
+            'spot_price_hourly': o.spot_price,
+        } for name, offerings in grouped.items() for o in offerings])
+
+    @routes.get('/api/volumes')
+    async def api_volumes(request: web.Request) -> web.Response:
+        from skypilot_tpu.volumes import core as volumes_core
+        rows = await asyncio.to_thread(volumes_core.ls)
+        return web.json_response([{
+            'name': v['name'], 'cloud': v['cloud'], 'region': v['region'],
+            'size_gb': v['size_gb'], 'status': v['status'].value,
+            'attached_to': v['last_attached_to'],
+        } for v in rows])
+
+    # --- dashboard (static SPA; reference: sky/dashboard served at
+    # /dashboard/{path}, sky/server/server.py:1873) ---
+
+    _dashboard_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'dashboard', 'static')
+
+    @routes.get('/dashboard')
+    async def dashboard_index(request: web.Request) -> web.Response:
+        return web.FileResponse(os.path.join(_dashboard_dir, 'index.html'))
+
+    @routes.get('/')
+    async def root(request: web.Request) -> web.Response:
+        raise web.HTTPFound('/dashboard')
+
     @routes.get('/logs')
     async def logs(request: web.Request) -> web.StreamResponse:
         """Tail a cluster job's logs, proxied from the head agent."""
@@ -270,6 +324,8 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
         return resp
 
     app.add_routes(routes)
+    app.router.add_static('/dashboard/static', _dashboard_dir,
+                          name='dashboard-static')
 
     # Users / workspaces routers (reference: FastAPI sub-routers mounted on
     # the main app, sky/users/server.py + sky/workspaces/server.py).

@@ -1,0 +1,74 @@
+"""Client/server API version negotiation.
+
+Reference parity: sky/server/versions.py — client and server each carry
+an integer API version; every request carries the client's version in a
+header, the server stamps its own version on every response, and each
+side refuses to talk across an incompatibility window with an
+actionable upgrade/downgrade hint.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# Bump when the wire contract changes incompatibly.  The server accepts
+# clients >= MIN_COMPATIBLE_API_VERSION; clients accept servers whose
+# version is >= their own MIN_COMPATIBLE_API_VERSION.
+API_VERSION = 1
+MIN_COMPATIBLE_API_VERSION = 1
+
+API_VERSION_HEADER = 'X-SkyTPU-API-Version'
+VERSION_HEADER = 'X-SkyTPU-Version'
+
+
+def _package_version() -> str:
+    from skypilot_tpu import __version__
+    return __version__
+
+
+def request_headers() -> dict:
+    """Headers a client attaches to every request."""
+    return {API_VERSION_HEADER: str(API_VERSION),
+            VERSION_HEADER: _package_version()}
+
+
+def response_headers() -> dict:
+    """Headers the server stamps on every response."""
+    return {API_VERSION_HEADER: str(API_VERSION),
+            VERSION_HEADER: _package_version()}
+
+
+def check_client_compatible(client_api_version: Optional[str]
+                            ) -> Tuple[bool, Optional[str]]:
+    """Server side: is this client allowed?  Absent header = legacy
+    client, allowed (the reference tolerates pre-handshake clients)."""
+    if client_api_version is None:
+        return True, None
+    try:
+        v = int(client_api_version)
+    except ValueError:
+        return False, f'Unparsable {API_VERSION_HEADER}: ' \
+                      f'{client_api_version!r}'
+    if v < MIN_COMPATIBLE_API_VERSION:
+        return False, (
+            f'Client API version {v} is older than the oldest this server '
+            f'supports ({MIN_COMPATIBLE_API_VERSION}). Upgrade the client '
+            f'(pip install -U skypilot-tpu).')
+    return True, None
+
+
+def check_server_compatible(server_api_version: Optional[str]
+                            ) -> Tuple[bool, Optional[str]]:
+    """Client side: is this server allowed?"""
+    if server_api_version is None:
+        return True, None   # pre-handshake server
+    try:
+        v = int(server_api_version)
+    except (TypeError, ValueError):
+        return False, f'Unparsable server API version: ' \
+                      f'{server_api_version!r}'
+    if v < MIN_COMPATIBLE_API_VERSION:
+        return False, (
+            f'API server version {v} is older than the oldest this client '
+            f'supports ({MIN_COMPATIBLE_API_VERSION}). Ask the operator to '
+            f'upgrade the server, or downgrade the client.')
+    return True, None

@@ -126,6 +126,8 @@ def _migrate(conn: sqlite3.Connection, path: str) -> None:
     db_utils.add_columns_if_missing(
         conn, 'clusters', (('workspace', "TEXT DEFAULT 'default'"),
                            ('user_hash', 'TEXT')))
+    db_utils.add_columns_if_missing(
+        conn, 'cluster_history', (('hourly_cost', 'REAL'),))
     _migrated_paths.add(path)
 
 
@@ -199,10 +201,27 @@ def remove_cluster(name: str) -> None:
                            (name,)).fetchone()
         if row is not None:
             handle = ClusterHandle.from_dict(json.loads(row['handle_json']))
+            res = handle.launched_resources
+            try:
+                from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+                hourly = CLOUD_REGISTRY.from_str(res.cloud).get_hourly_cost(
+                    res)
+            except Exception:  # pylint: disable=broad-except
+                hourly = None
             conn.execute(
                 'INSERT INTO cluster_history (name, launched_at, '
-                'torn_down_at, resources, duration_s) VALUES (?, ?, ?, ?, ?)',
-                (name, row['launched_at'], time.time(),
-                 repr(handle.launched_resources),
-                 time.time() - (row['launched_at'] or time.time())))
+                'torn_down_at, resources, duration_s, hourly_cost) '
+                'VALUES (?, ?, ?, ?, ?, ?)',
+                (name, row['launched_at'], time.time(), repr(res),
+                 time.time() - (row['launched_at'] or time.time()), hourly))
         conn.execute('DELETE FROM clusters WHERE name = ?', (name,))
+
+
+def cluster_history(limit: int = 100) -> List[Dict[str, Any]]:
+    """Recently terminated clusters, newest first (reference:
+    global_user_state cluster history consumed by `sky cost-report`)."""
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM cluster_history ORDER BY torn_down_at DESC '
+            'LIMIT ?', (limit,)).fetchall()
+    return [dict(r) for r in rows]

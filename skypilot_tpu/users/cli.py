@@ -1,0 +1,86 @@
+"""`skytpu users ...` — user/RBAC admin commands.
+
+Reference parity: the reference manages users via the dashboard + API
+(`sky/users/server.py`); the CLI group here gives the same CRUD against
+the local state (these are server-host admin operations).
+"""
+from __future__ import annotations
+
+import time
+
+
+def _cmd_list(args) -> int:
+    from skypilot_tpu.users import permission, rbac
+    from skypilot_tpu.users import state as users_state
+    svc = permission.permission_service
+    print(f'{"ID":<24} {"NAME":<20} {"ROLE":<10} CREATED')
+    for user in users_state.list_users():
+        roles = svc.get_user_roles(user.id)
+        role = roles[0] if roles else rbac.get_default_role()
+        created = (time.strftime('%Y-%m-%d %H:%M',
+                                 time.localtime(user.created_at))
+                   if user.created_at else '-')
+        print(f'{user.id:<24} {user.name or "-":<20} {role:<10} {created}')
+    return 0
+
+
+def _cmd_create(args) -> int:
+    from skypilot_tpu.users import permission, rbac
+    from skypilot_tpu.users import state as users_state
+    from skypilot_tpu.users.models import User
+    if users_state.get_user_by_name(args.name) is not None:
+        print(f'Error: user {args.name!r} already exists')
+        return 1
+    role = args.role or rbac.get_default_role()
+    if role not in rbac.get_supported_roles():
+        print(f'Error: unsupported role {role!r} '
+              f'(supported: {rbac.get_supported_roles()})')
+        return 1
+    user = User.new(f'user-{args.name}', name=args.name,
+                    password_hash=(users_state.hash_password(args.password)
+                                   if args.password else None))
+    users_state.add_or_update_user(user)
+    permission.permission_service.update_role(user.id, role)
+    print(f'Created user {args.name!r} (id {user.id}, role {role}).')
+    return 0
+
+
+def _cmd_delete(args) -> int:
+    from skypilot_tpu.users import permission
+    permission.permission_service.delete_user(args.id)
+    print(f'Deleted user {args.id!r}.')
+    return 0
+
+
+def _cmd_set_role(args) -> int:
+    from skypilot_tpu.users import permission
+    try:
+        permission.permission_service.update_role(args.id, args.role)
+    except ValueError as e:
+        print(f'Error: {e}')
+        return 1
+    print(f'User {args.id!r} is now {args.role!r}.')
+    return 0
+
+
+def register(sub) -> None:
+    p = sub.add_parser('users', help='User accounts and roles (RBAC)')
+    usub = p.add_subparsers(dest='users_cmd')
+
+    pl = usub.add_parser('list', help='List users')
+    pl.set_defaults(fn=_cmd_list)
+
+    pc = usub.add_parser('create', help='Create a user')
+    pc.add_argument('name')
+    pc.add_argument('--password', default=None)
+    pc.add_argument('--role', default=None)
+    pc.set_defaults(fn=_cmd_create)
+
+    pd = usub.add_parser('delete', help='Delete a user')
+    pd.add_argument('id')
+    pd.set_defaults(fn=_cmd_delete)
+
+    pr = usub.add_parser('set-role', help='Change a user role')
+    pr.add_argument('id')
+    pr.add_argument('role')
+    pr.set_defaults(fn=_cmd_set_role)

@@ -1,0 +1,49 @@
+"""`skytpu workspaces ...` — multi-tenant workspace admin commands
+(reference: workspaces managed via dashboard/API, sky/workspaces/core.py)."""
+from __future__ import annotations
+
+import json
+
+
+def _cmd_list(args) -> int:
+    from skypilot_tpu.workspaces import core
+    ws = core.get_workspaces()
+    active = core.get_active_workspace()
+    print(f'{"NAME":<24} {"ACTIVE":<8} CONFIG')
+    for name, cfg in ws.items():
+        mark = '*' if name == active else ''
+        print(f'{name:<24} {mark:<8} {json.dumps(cfg)}')
+    return 0
+
+
+def _cmd_create(args) -> int:
+    from skypilot_tpu.workspaces import core
+    cfg = json.loads(args.config) if args.config else {}
+    core.create_workspace(args.name, cfg)
+    print(f'Created workspace {args.name!r}.')
+    return 0
+
+
+def _cmd_delete(args) -> int:
+    from skypilot_tpu.workspaces import core
+    core.delete_workspace(args.name)
+    print(f'Deleted workspace {args.name!r}.')
+    return 0
+
+
+def register(sub) -> None:
+    p = sub.add_parser('workspaces', help='Multi-tenant workspaces')
+    wsub = p.add_subparsers(dest='workspaces_cmd')
+
+    pl = wsub.add_parser('list', help='List workspaces')
+    pl.set_defaults(fn=_cmd_list)
+
+    pc = wsub.add_parser('create', help='Create a workspace')
+    pc.add_argument('name')
+    pc.add_argument('--config', default=None,
+                    help='JSON workspace config (e.g. \'{"private": true}\')')
+    pc.set_defaults(fn=_cmd_create)
+
+    pd = wsub.add_parser('delete', help='Delete a workspace')
+    pd.add_argument('name')
+    pd.set_defaults(fn=_cmd_delete)

@@ -1,0 +1,74 @@
+"""CLI admin surfaces: cost-report, users, workspaces, start.
+
+Reference parity: `sky cost-report` (cluster history), `sky users`/`sky
+workspaces` admin ops (reference exposes these via dashboard/API only),
+`sky start` (core.start on cached handles).
+"""
+import pytest
+
+from skypilot_tpu.client import cli
+
+
+def test_cost_report_includes_history(tmp_home, capsys):
+    import skypilot_tpu as sky
+    task = sky.Task(run='true', name='t')
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='cr-live')
+    sky.down('cr-live')
+
+    from skypilot_tpu import core
+    rows = core.cost_report()
+    names = [r['name'] for r in rows]
+    assert 'cr-live' in names
+    row = rows[names.index('cr-live')]
+    assert row['status'] is None          # terminated -> history row
+    assert row['duration_s'] > 0
+    assert row['total_cost'] == 0.0       # local cloud is free
+
+    assert cli.main(['cost-report']) == 0
+    out = capsys.readouterr().out
+    assert 'cr-live' in out
+
+
+def test_start_noop_when_up(tmp_home):
+    import skypilot_tpu as sky
+    task = sky.Task(run='true', name='t')
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='up-cluster')
+    try:
+        from skypilot_tpu import core
+        core.start('up-cluster')   # already UP -> no-op, no raise
+    finally:
+        sky.down('up-cluster')
+
+
+def test_start_missing_cluster_raises(tmp_home):
+    from skypilot_tpu import core, exceptions
+    with pytest.raises(exceptions.ClusterDoesNotExist):
+        core.start('nope')
+
+
+def test_users_cli_crud(tmp_home, capsys):
+    assert cli.main(['users', 'create', 'alice', '--role', 'admin']) == 0
+    assert cli.main(['users', 'create', 'bob']) == 0
+    # Duplicate rejected.
+    assert cli.main(['users', 'create', 'alice']) == 1
+    assert cli.main(['users', 'list']) == 0
+    out = capsys.readouterr().out
+    assert 'alice' in out and 'bob' in out and 'admin' in out
+    assert cli.main(['users', 'set-role', 'user-bob', 'admin']) == 0
+    assert cli.main(['users', 'delete', 'user-bob']) == 0
+    capsys.readouterr()  # drop the set-role/delete echo lines
+    cli.main(['users', 'list'])
+    assert 'bob' not in capsys.readouterr().out
+
+
+def test_workspaces_cli_crud(tmp_home, capsys):
+    assert cli.main(['workspaces', 'create', 'team-a']) == 0
+    assert cli.main(['workspaces', 'list']) == 0
+    out = capsys.readouterr().out
+    assert 'team-a' in out and 'default' in out
+    assert cli.main(['workspaces', 'delete', 'team-a']) == 0
+    capsys.readouterr()  # drop the delete echo line
+    cli.main(['workspaces', 'list'])
+    assert 'team-a' not in capsys.readouterr().out

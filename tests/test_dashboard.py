@@ -1,0 +1,85 @@
+"""Dashboard SPA + its data endpoints (reference: sky/dashboard served
+by sky/server/server.py:1873; infra/volumes views over catalog/state)."""
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from skypilot_tpu.server import server as server_lib
+
+
+@pytest.fixture()
+def client(tmp_home):
+    async def _make():
+        c = TestClient(TestServer(server_lib.make_app()))
+        await c.start_server()
+        return c
+
+    loop = asyncio.new_event_loop()
+    c = loop.run_until_complete(_make())
+    yield c, loop
+    loop.run_until_complete(c.close())
+    loop.close()
+
+
+def test_dashboard_static_served(client):
+    c, loop = client
+
+    async def _run():
+        r = await c.get('/dashboard')
+        assert r.status == 200
+        html = await r.text()
+        assert 'SkyPilot-TPU' in html
+        for asset in ('app.js', 'style.css'):
+            r = await c.get(f'/dashboard/static/{asset}')
+            assert r.status == 200, asset
+        # Root redirects to the dashboard.
+        r = await c.get('/', allow_redirects=False)
+        assert r.status == 302
+        assert r.headers['Location'] == '/dashboard'
+
+    loop.run_until_complete(_run())
+
+
+def test_catalog_endpoint(client):
+    c, loop = client
+
+    async def _run():
+        r = await c.get('/api/catalog?name=v5e-16')
+        assert r.status == 200
+        rows = await r.json()
+        assert rows, 'catalog must list v5e-16 offerings'
+        row = rows[0]
+        assert row['accelerator'] == 'tpu-v5e-16'
+        assert row['chips'] == 16
+        assert row['num_hosts'] == 4
+        assert row['price_hourly'] > 0
+        assert row['spot_price_hourly'] < row['price_hourly']
+
+    loop.run_until_complete(_run())
+
+
+def test_volumes_endpoint_empty(client):
+    c, loop = client
+
+    async def _run():
+        r = await c.get('/api/volumes')
+        assert r.status == 200
+        assert await r.json() == []
+
+    loop.run_until_complete(_run())
+
+
+def test_status_payload_has_dashboard_fields(tmp_home):
+    """status_payload carries infra + cost for the clusters page."""
+    import skypilot_tpu as sky
+    task = sky.Task(run='true', name='t')
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='dash')
+    try:
+        from skypilot_tpu import core
+        payload = core.status_payload(core.status())
+        assert payload[0]['infra'].startswith('local')
+        assert payload[0]['cost_per_hour'] is not None
+    finally:
+        sky.down('dash')

@@ -56,6 +56,10 @@ class FakeTpuApi:
         self.nodes[self._key(zone, node_id)]['state'] = 'STOPPED'
         return {'name': f'op-stop-{node_id}', 'done': True}
 
+    def start_node(self, zone, node_id):
+        self.nodes[self._key(zone, node_id)]['state'] = 'READY'
+        return {'name': f'op-start-{node_id}', 'done': True}
+
     def wait_operation(self, operation, timeout=0, poll=0):
         return operation
 
@@ -147,6 +151,21 @@ def test_stop_pod_raises_single_host_stops(fake_api):
     gcp_instance.run_instances('us-east5', 'single', cfg)
     gcp_instance.stop_instances('single', cfg)
     assert fake_api().nodes['us-east5-b/single']['state'] == 'STOPPED'
+
+
+def test_start_restarts_stopped_single_host(fake_api):
+    cfg = _config(tpu_type='v5litepod-8')
+    gcp_instance.run_instances('us-east5', 'single', cfg)
+    gcp_instance.stop_instances('single', cfg)
+    assert fake_api().nodes['us-east5-b/single']['state'] == 'STOPPED'
+    gcp_instance.start_instances('single', cfg)
+    assert fake_api().nodes['us-east5-b/single']['state'] == 'READY'
+    # Only the named cluster is touched.
+    gcp_instance.run_instances('us-east5', 'other',
+                               _config(tpu_type='v5litepod-8'))
+    gcp_instance.stop_instances('other', _config(tpu_type='v5litepod-8'))
+    gcp_instance.start_instances('single', cfg)
+    assert fake_api().nodes['us-east5-b/other']['state'] == 'STOPPED'
 
 
 def test_spot_sets_preemptible(fake_api):

@@ -1,0 +1,178 @@
+"""Jobs worker pools: apply/status/down, scheduling onto idle workers,
+worker-failure failover (reference: `sky jobs pool` worker pools)."""
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import state
+from skypilot_tpu.jobs import pool as pool_lib
+from skypilot_tpu.jobs.controller import Scheduler
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.provision.local import instance as local_instance
+from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture reuse)
+
+
+@pytest.fixture()
+def scheduler(iso_state):  # noqa: F811
+    sched = Scheduler(poll_seconds=0.5)
+    thread = threading.Thread(target=sched.run_forever,
+                              kwargs={'interval': 0.5}, daemon=True)
+    thread.start()
+    yield sched
+    sched.stop()
+
+
+def _worker_task():
+    import skypilot_tpu as sky
+    task = sky.Task(name='worker', setup='echo worker-ready')
+    task.set_resources(sky.Resources(cloud='local'))
+    return task
+
+
+def _job_config(run='echo pool-job-ok'):
+    return {'name': 'pj', 'run': run, 'resources': {'cloud': 'local'}}
+
+
+def test_pool_apply_status_down(iso_state):  # noqa: F811
+    pool_lib.apply('p1', _worker_task(), num_workers=2)
+    st = pool_lib.status('p1')
+    assert len(st) == 1
+    assert st[0]['num_workers'] == 2
+    assert st[0]['idle'] == 2
+    clusters = [w['cluster_name'] for w in st[0]['workers']]
+    for c in clusters:
+        assert state.get_cluster(c) is not None
+
+    pool_lib.down('p1')
+    assert pool_lib.status('p1') == []
+    for c in clusters:
+        assert state.get_cluster(c) is None
+
+
+def test_pool_resize_up_and_down(iso_state):  # noqa: F811
+    pool_lib.apply('p2', _worker_task(), num_workers=1)
+    assert pool_lib.status('p2')[0]['idle'] == 1
+    pool_lib.apply('p2', _worker_task(), num_workers=2)
+    assert pool_lib.status('p2')[0]['idle'] == 2
+    pool_lib.apply('p2', _worker_task(), num_workers=1)
+    st = pool_lib.status('p2')[0]
+    assert len(st['workers']) == 1
+    assert state.get_cluster('pool-p2-1') is None
+    pool_lib.down('p2')
+
+
+def test_job_runs_on_pool_worker_and_releases(scheduler):
+    pool_lib.apply('run', _worker_task(), num_workers=1)
+    try:
+        job_id = scheduler.submit('pj', _job_config(), pool='run')
+        status = scheduler.wait_job(job_id, timeout=90)
+        assert status == ManagedJobStatus.SUCCEEDED
+        record = scheduler.table.get(job_id)
+        assert record['cluster_name'] == 'pool-run-0'
+        # Worker survives the job (that is the point of a pool) and is
+        # released back to IDLE.
+        assert state.get_cluster('pool-run-0') is not None
+        assert pool_lib.status('run')[0]['idle'] == 1
+    finally:
+        pool_lib.down('run')
+
+
+def test_two_jobs_share_one_worker_serially(scheduler):
+    pool_lib.apply('serial', _worker_task(), num_workers=1)
+    try:
+        j1 = scheduler.submit('a', _job_config('sleep 3'), pool='serial')
+        j2 = scheduler.submit('b', _job_config(), pool='serial')
+        assert scheduler.wait_job(j1, timeout=90) == \
+            ManagedJobStatus.SUCCEEDED
+        assert scheduler.wait_job(j2, timeout=90) == \
+            ManagedJobStatus.SUCCEEDED
+        # Both ran on the single worker.
+        assert scheduler.table.get(j1)['cluster_name'] == 'pool-serial-0'
+        assert scheduler.table.get(j2)['cluster_name'] == 'pool-serial-0'
+    finally:
+        pool_lib.down('serial')
+
+
+def test_job_fails_over_to_second_worker(scheduler):
+    pool_lib.apply('ha', _worker_task(), num_workers=2)
+    try:
+        job_id = scheduler.submit('pj', _job_config('sleep 300'),
+                                  pool='ha')
+        deadline = time.time() + 60
+        record = scheduler.table.get(job_id)
+        while time.time() < deadline:
+            record = scheduler.table.get(job_id)
+            if record['status'] == ManagedJobStatus.RUNNING:
+                break
+            time.sleep(0.5)
+        assert record['status'] == ManagedJobStatus.RUNNING
+        first = record['cluster_name']
+        local_instance.simulate_preemption(first)
+        # The controller must fail over onto the other worker.
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            record = scheduler.table.get(job_id)
+            if (record['status'] == ManagedJobStatus.RUNNING and
+                    record['cluster_name'] != first):
+                break
+            time.sleep(0.5)
+        assert record['cluster_name'] != first
+        assert record['recovery_count'] >= 1
+        # Dead worker is marked FAILED until reconcile replaces it.
+        st = pool_lib.status('ha')[0]
+        by_name = {w['cluster_name']: w['status'] for w in st['workers']}
+        assert by_name[first] == 'FAILED'
+        scheduler.cancel(job_id)
+        scheduler.wait_job(job_id, timeout=60)
+    finally:
+        pool_lib.down('ha')
+
+
+def test_scale_down_defers_busy_worker(iso_state):  # noqa: F811
+    pool_lib.apply('busy', _worker_task(), num_workers=2)
+    try:
+        table = pool_lib.PoolTable()
+        # Worker 1 is running a job: shrink must not kill it.
+        assert table.acquire('busy', job_id=99) == 'pool-busy-0'
+        table.release('busy', 'pool-busy-0')          # 0 idle again
+        assert table.acquire('busy', job_id=99) == 'pool-busy-0'
+        table.set_worker('busy', 1, 'pool-busy-1',
+                         pool_lib.WorkerStatus.BUSY)
+        pool_lib.apply('busy', _worker_task(), num_workers=1)
+        st = pool_lib.status('busy')[0]
+        names = [w['cluster_name'] for w in st['workers']]
+        assert 'pool-busy-1' in names          # deferred, not torn down
+        assert state.get_cluster('pool-busy-1') is not None
+        # Once released, the next reconcile drains it.
+        table.release('busy', 'pool-busy-1')
+        pool_lib.reconcile('busy')
+        st = pool_lib.status('busy')[0]
+        assert [w['cluster_name'] for w in st['workers']] == ['pool-busy-0']
+        assert state.get_cluster('pool-busy-1') is None
+    finally:
+        pool_lib.down('busy')
+
+
+def test_reconcile_replaces_failed_worker(iso_state):  # noqa: F811
+    pool_lib.apply('rec', _worker_task(), num_workers=1)
+    try:
+        local_instance.simulate_preemption('pool-rec-0')
+        table = pool_lib.PoolTable()
+        table.release('rec', 'pool-rec-0', failed=True)
+        pool_lib.reconcile('rec')
+        st = pool_lib.status('rec')[0]
+        assert st['idle'] == 1
+        assert st['workers'][0]['status'] == 'IDLE'
+    finally:
+        pool_lib.down('rec')
+
+
+def test_launch_into_missing_pool_rejected(iso_state):  # noqa: F811
+    import skypilot_tpu as sky
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.jobs import core as jobs_core
+    task = sky.Task(name='x', run='true')
+    task.set_resources(sky.Resources(cloud='local'))
+    with pytest.raises(exceptions.PoolNotFoundError):
+        jobs_core.launch(task, pool='nope')

@@ -1,0 +1,96 @@
+"""Async SDK + version handshake (reference: sky/client/sdk_async.py,
+sky/server/versions.py)."""
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from skypilot_tpu.server import server as server_lib
+from skypilot_tpu.server import versions
+
+
+def test_version_constants_sane():
+    assert versions.MIN_COMPATIBLE_API_VERSION <= versions.API_VERSION
+
+
+def test_client_compat_checks():
+    ok, _ = versions.check_client_compatible(None)
+    assert ok  # legacy clients tolerated
+    ok, _ = versions.check_client_compatible(str(versions.API_VERSION))
+    assert ok
+    ok, msg = versions.check_client_compatible('0')
+    assert not ok and 'Upgrade the client' in msg
+    ok, msg = versions.check_client_compatible('garbage')
+    assert not ok
+
+
+def test_server_compat_checks():
+    ok, _ = versions.check_server_compatible(str(versions.API_VERSION))
+    assert ok
+    ok, msg = versions.check_server_compatible('0')
+    assert not ok and 'server' in msg.lower()
+
+
+def test_server_stamps_headers_and_rejects_old_clients(tmp_home):
+    async def _run():
+        c = TestClient(TestServer(server_lib.make_app()))
+        await c.start_server()
+        try:
+            r = await c.get('/api/health')
+            assert r.headers[versions.API_VERSION_HEADER] == \
+                str(versions.API_VERSION)
+            assert versions.VERSION_HEADER in r.headers
+            # Incompatibly old client -> 400 with upgrade hint.
+            r = await c.get('/api/health',
+                            headers={versions.API_VERSION_HEADER: '0'})
+            assert r.status == 400
+            body = await r.json()
+            assert 'Upgrade the client' in body['error']
+        finally:
+            await c.close()
+
+    asyncio.new_event_loop().run_until_complete(_run())
+
+
+def test_async_sdk_local_mode(tmp_home):
+    """Async SDK drives a full launch→status→queue→down cycle in
+    library-local mode (no server configured)."""
+    import skypilot_tpu as sky
+    from skypilot_tpu.client import sdk_async
+
+    async def _run():
+        task = sky.Task(run='echo async-ok', name='t')
+        task.set_resources(sky.Resources(cloud='local'))
+        await sdk_async.launch(task, cluster_name='async-c')
+        try:
+            rows = await sdk_async.status()
+            assert rows[0]['name'] == 'async-c'
+            jobs = await sdk_async.queue('async-c', all_jobs=True)
+            assert jobs and jobs[0]['status'] == 'SUCCEEDED'
+            report = await sdk_async.cost_report()
+            assert any(r['name'] == 'async-c' for r in report)
+        finally:
+            await sdk_async.down('async-c')
+        rows = await sdk_async.status()
+        assert not rows
+
+    asyncio.new_event_loop().run_until_complete(_run())
+
+
+def test_async_rest_client_against_server(tmp_home):
+    """AsyncRestClient handshake + submit/get against a live app."""
+    from skypilot_tpu.client.sdk_async import AsyncRestClient
+
+    async def _run():
+        c = TestClient(TestServer(server_lib.make_app()))
+        await c.start_server()
+        try:
+            url = str(c.make_url(''))
+            client = AsyncRestClient(url)
+            result = await client.submit_and_get('/status', {})
+            assert result == []
+            assert client._version_checked
+        finally:
+            await c.close()
+
+    asyncio.new_event_loop().run_until_complete(_run())
