@@ -120,10 +120,10 @@ def main(argv=None) -> int:
         region = zone.rsplit('-', 1)[0]
         gens = set()
         for t in types:
-            m = re.match(r'(v\d+\w*?)(?:litepod|p)?-\d+', t)
-            if m:
-                gen = {'v5litepod': 'v5e'}.get(m.group(1), m.group(1))
-                gens.add(gen)
+            # API type names: 'v5litepod-16', 'v5p-8', 'v4-8', 'v6e-8'...
+            prefix = t.rsplit('-', 1)[0]
+            if re.fullmatch(r'v\d+\w*', prefix):
+                gens.add({'v5litepod': 'v5e'}.get(prefix, prefix))
         for gen in sorted(gens):
             od = prices.get((gen, region, False))
             spot = prices.get((gen, region, True))

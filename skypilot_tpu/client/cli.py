@@ -256,6 +256,11 @@ def build_parser() -> argparse.ArgumentParser:
     except ImportError:
         pass
     try:
+        from skypilot_tpu.data import cli as storage_cli
+        storage_cli.register(sub)
+    except ImportError:
+        pass
+    try:
         from skypilot_tpu.users import cli as users_cli
         users_cli.register(sub)
     except ImportError:

@@ -1,0 +1,41 @@
+"""`skytpu storage ...` command group (reference: sky storage ls/delete,
+sky/client/cli/command.py storage_* commands)."""
+from __future__ import annotations
+
+import time
+
+
+def _cmd_ls(args) -> int:
+    from skypilot_tpu.data import storage as storage_lib
+    rows = storage_lib.list_storage()
+    if not rows:
+        print('No tracked storage.')
+        return 0
+    print(f'{"NAME":<24} {"STORE":<8} {"MODE":<14} {"LAST ATTACHED":<20} '
+          f'CREATED')
+    for r in rows:
+        created = time.strftime('%Y-%m-%d %H:%M',
+                                time.localtime(r['created_at']))
+        print(f"{r['name']:<24} {r['store']:<8} {r['mode']:<14} "
+              f"{r['last_attached_cluster'] or '-':<20} {created}")
+    return 0
+
+
+def _cmd_delete(args) -> int:
+    from skypilot_tpu.data import storage as storage_lib
+    for name in args.names:
+        storage_lib.delete_storage(name)
+        print(f'Deleted storage {name!r}.')
+    return 0
+
+
+def register(sub) -> None:
+    p = sub.add_parser('storage', help='Bucket storage tracked by tasks')
+    ssub = p.add_subparsers(dest='storage_cmd')
+
+    pl = ssub.add_parser('ls', help='List tracked storage')
+    pl.set_defaults(fn=_cmd_ls)
+
+    pd = ssub.add_parser('delete', help='Delete bucket(s) + tracking')
+    pd.add_argument('names', nargs='+')
+    pd.set_defaults(fn=_cmd_delete)

@@ -1,0 +1,119 @@
+"""Mount/copy command builders for every supported object store.
+
+Reference parity: sky/data/mounting_utils.py (568 LoC — gcsfuse, goofys,
+blobfuse2, rclone command lines; MOUNT_CACHED via rclone VFS cache).
+Each builder returns a shell command executed on every cluster host by
+the backend's storage-mount step.
+"""
+from __future__ import annotations
+
+import shlex
+
+# Pinned versions (reference pins the same way so mounts are
+# reproducible across hosts).
+GCSFUSE_VERSION = '2.4.0'
+GOOFYS_VERSION = 'latest'
+BLOBFUSE2_VERSION = '2.2.0'
+RCLONE_VERSION = 'v1.68.1'
+
+_INSTALL_GCSFUSE = (
+    'command -v gcsfuse >/dev/null 2>&1 || { '
+    'curl -fsSL -o /tmp/gcsfuse.deb https://github.com/GoogleCloudPlatform/'
+    f'gcsfuse/releases/download/v{GCSFUSE_VERSION}/'
+    f'gcsfuse_{GCSFUSE_VERSION}_amd64.deb && '
+    'sudo dpkg -i /tmp/gcsfuse.deb || sudo apt-get install -fy; }')
+
+_INSTALL_GOOFYS = (
+    'command -v goofys >/dev/null 2>&1 || { '
+    'sudo curl -fsSL -o /usr/local/bin/goofys '
+    'https://github.com/kahing/goofys/releases/latest/download/goofys && '
+    'sudo chmod +x /usr/local/bin/goofys; }')
+
+_INSTALL_BLOBFUSE2 = (
+    'command -v blobfuse2 >/dev/null 2>&1 || { '
+    'sudo apt-get update -qq && sudo apt-get install -y blobfuse2; }')
+
+_INSTALL_RCLONE = (
+    'command -v rclone >/dev/null 2>&1 || { '
+    'curl -fsSL https://rclone.org/install.sh | sudo bash; }')
+
+
+def quote_path(path: str) -> str:
+    """shlex.quote that keeps a leading ~/ expandable on the REMOTE host
+    (plain quoting would freeze '~' literally; expanding client-side
+    would bake in the wrong home dir for SSH clouds)."""
+    if path == '~' or path.startswith('~/'):
+        return '"$HOME"' + shlex.quote(path[1:])
+    return shlex.quote(path)
+
+
+def gcs_mount_command(bucket: str, mount_path: str,
+                      cached: bool = False) -> str:
+    """gcsfuse mount (reference: mounting_utils gcsfuse path)."""
+    p = quote_path(mount_path)
+    cache = '--file-cache-max-size-mb 10240 ' if cached else ''
+    return (f'{_INSTALL_GCSFUSE} && mkdir -p {p} && '
+            f'mountpoint -q {p} || gcsfuse --implicit-dirs {cache}'
+            f'{shlex.quote(bucket)} {p}')
+
+
+def s3_mount_command(bucket: str, mount_path: str) -> str:
+    """goofys mount (reference: mounting_utils goofys path)."""
+    p = quote_path(mount_path)
+    return (f'{_INSTALL_GOOFYS} && mkdir -p {p} && '
+            f'mountpoint -q {p} || goofys {shlex.quote(bucket)} {p}')
+
+
+def r2_mount_command(bucket: str, mount_path: str,
+                     account_id: str) -> str:
+    """Cloudflare R2 via goofys' S3-compatible endpoint.  The account id
+    must be resolved client-side: remote hosts have no R2 env vars."""
+    p = quote_path(mount_path)
+    endpoint = f'https://{account_id}.r2.cloudflarestorage.com'
+    return (f'{_INSTALL_GOOFYS} && mkdir -p {p} && mountpoint -q {p} || '
+            f'goofys --endpoint {shlex.quote(endpoint)} '
+            f'{shlex.quote(bucket)} {p}')
+
+
+def azure_mount_command(container: str, mount_path: str,
+                        storage_account: str) -> str:
+    """blobfuse2 mount (reference: mounting_utils blobfuse2 path)."""
+    p = quote_path(mount_path)
+    return (f'{_INSTALL_BLOBFUSE2} && mkdir -p {p} && mountpoint -q {p} '
+            f'|| AZURE_STORAGE_ACCOUNT={shlex.quote(storage_account)} '
+            f'blobfuse2 mount {p} --container-name '
+            f'{shlex.quote(container)} --use-adls=false')
+
+
+def rclone_cached_mount_command(remote: str, bucket: str,
+                                mount_path: str) -> str:
+    """MOUNT_CACHED: rclone with a writable VFS cache (reference:
+    MOUNT_CACHED mode — local-disk write-back for checkpoint dirs).
+
+    `remote` is an rclone connection string (e.g. ':s3,env_auth=true'),
+    NOT a named remote — fresh hosts have no rclone.conf to name one in.
+    """
+    p = quote_path(mount_path)
+    return (f'{_INSTALL_RCLONE} && mkdir -p {p} && mountpoint -q {p} || '
+            f'rclone mount {shlex.quote(f"{remote}:{bucket}")} {p} '
+            f'--daemon --vfs-cache-mode writes --vfs-cache-max-size 10G '
+            f'--dir-cache-time 30s')
+
+
+def copy_download_command(uri: str, mount_path: str) -> str:
+    """COPY mode: one-time sync of the bucket onto host disk."""
+    p = quote_path(mount_path)
+    if uri.startswith('gs://'):
+        return f'mkdir -p {p} && gsutil -m rsync -r {shlex.quote(uri)} {p}'
+    if uri.startswith('s3://'):
+        return (f'mkdir -p {p} && aws s3 sync {shlex.quote(uri)} {p} '
+                f'--no-progress')
+    if uri.startswith('https://'):   # azure
+        return f'mkdir -p {p} && azcopy sync {shlex.quote(uri)} {p}'
+    return f'mkdir -p {p} && rsync -a {shlex.quote(uri)}/ {p}/'
+
+
+def unmount_command(mount_path: str) -> str:
+    p = quote_path(mount_path)
+    return (f'mountpoint -q {p} && '
+            f'(fusermount -u {p} || sudo umount -l {p}) || true')

@@ -1,26 +1,36 @@
-"""Bucket storage: GCS-first Storage abstraction.
+"""Bucket storage: multi-store Storage abstraction, GCS-first.
 
-Reference parity: sky/data/storage.py (StoreType :120, StorageMode :297,
-Storage :551) + mounting_utils.py (gcsfuse commands).  GCS is the native
-store for TPU training (checkpoint buckets for managed-job recovery);
-local-path "buckets" make the mode testable hermetically.
+Reference parity: sky/data/storage.py (StoreType :120-128 — S3, GCS,
+AZURE, R2, IBM, OCI, NEBIUS; StorageMode :297 — MOUNT/COPY/MOUNT_CACHED;
+Storage :551) + sky/cloud_stores.py (CLI-based transfers).  GCS is the
+native store for TPU training (checkpoint buckets for managed-job
+recovery); S3/R2/Azure ride their CLIs + FUSE adapters; a local-path
+"bucket" makes every mode testable hermetically.
+
+Named storages are tracked in the state DB so `skytpu storage ls/delete`
+mirrors `sky storage ls/delete`.
 """
 from __future__ import annotations
 
+import abc
 import enum
 import os
 import shlex
 import subprocess
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
+from skypilot_tpu.data import mounting_utils
 
 logger = sky_logging.init_logger(__name__)
 
 
 class StoreType(enum.Enum):
     GCS = 'gcs'
+    S3 = 's3'
+    R2 = 'r2'
+    AZURE = 'azure'
     LOCAL = 'local'   # hermetic testing: a directory acts as the bucket
 
 
@@ -30,6 +40,207 @@ class StorageMode(enum.Enum):
     MOUNT_CACHED = 'MOUNT_CACHED'
 
 
+class AbstractStore(abc.ABC):
+    """One object store's bucket operations (reference: the per-cloud
+    Store classes inside sky/data/storage.py + sky/cloud_stores.py)."""
+
+    def __init__(self, name: str, config: Optional[Dict[str, Any]] = None
+                 ) -> None:
+        self.name = name
+        self.config = config or {}
+
+    @abc.abstractmethod
+    def uri(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def create_if_missing(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def delete(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def sync_from(self, local_path: str) -> None:
+        """Upload a local directory into the bucket."""
+
+    @abc.abstractmethod
+    def mount_command(self, mount_path: str, mode: StorageMode) -> str:
+        """Shell command run on each cluster host."""
+
+
+class GcsStore(AbstractStore):
+
+    def uri(self) -> str:
+        return f'gs://{self.name}'
+
+    def create_if_missing(self) -> None:
+        subprocess.run(['gsutil', 'mb', '-b', 'on', self.uri()],
+                       check=False, capture_output=True)
+
+    def delete(self) -> None:
+        subprocess.run(['gsutil', '-m', 'rm', '-r', self.uri()],
+                       check=False, capture_output=True)
+
+    def sync_from(self, local_path: str) -> None:
+        subprocess.run(['gsutil', '-m', 'rsync', '-r', local_path,
+                        self.uri()], check=True)
+
+    def mount_command(self, mount_path: str, mode: StorageMode) -> str:
+        if mode == StorageMode.COPY:
+            return mounting_utils.copy_download_command(self.uri(),
+                                                        mount_path)
+        return mounting_utils.gcs_mount_command(
+            self.name, mount_path, cached=mode == StorageMode.MOUNT_CACHED)
+
+
+class S3Store(AbstractStore):
+
+    def uri(self) -> str:
+        return f's3://{self.name}'
+
+    def create_if_missing(self) -> None:
+        subprocess.run(['aws', 's3', 'mb', self.uri()], check=False,
+                       capture_output=True)
+
+    def delete(self) -> None:
+        subprocess.run(['aws', 's3', 'rb', '--force', self.uri()],
+                       check=False, capture_output=True)
+
+    def sync_from(self, local_path: str) -> None:
+        subprocess.run(['aws', 's3', 'sync', local_path, self.uri(),
+                        '--no-progress'], check=True)
+
+    def mount_command(self, mount_path: str, mode: StorageMode) -> str:
+        if mode == StorageMode.COPY:
+            return mounting_utils.copy_download_command(self.uri(),
+                                                        mount_path)
+        if mode == StorageMode.MOUNT_CACHED:
+            return mounting_utils.rclone_cached_mount_command(
+                ':s3,env_auth=true', self.name, mount_path)
+        return mounting_utils.s3_mount_command(self.name, mount_path)
+
+
+class R2Store(AbstractStore):
+
+    def uri(self) -> str:
+        return f'r2://{self.name}'
+
+    def _account_id(self) -> str:
+        account = self.config.get('account_id') or \
+            os.environ.get('R2_ACCOUNT_ID', '')
+        if not account:
+            raise exceptions.StorageSpecError(
+                'R2 storage needs config.account_id (or R2_ACCOUNT_ID '
+                'in the client environment).')
+        return account
+
+    def _endpoint_args(self) -> List[str]:
+        return ['--endpoint-url',
+                f'https://{self._account_id()}.r2.cloudflarestorage.com']
+
+    def create_if_missing(self) -> None:
+        subprocess.run(['aws', 's3', 'mb', f's3://{self.name}',
+                        *self._endpoint_args()], check=False,
+                       capture_output=True)
+
+    def delete(self) -> None:
+        subprocess.run(['aws', 's3', 'rb', '--force', f's3://{self.name}',
+                        *self._endpoint_args()], check=False,
+                       capture_output=True)
+
+    def sync_from(self, local_path: str) -> None:
+        subprocess.run(['aws', 's3', 'sync', local_path,
+                        f's3://{self.name}', '--no-progress',
+                        *self._endpoint_args()], check=True)
+
+    def mount_command(self, mount_path: str, mode: StorageMode) -> str:
+        if mode == StorageMode.COPY:
+            # R2 download must go through the R2 endpoint, not AWS.
+            p = mounting_utils.quote_path(mount_path)
+            endpoint = shlex.quote(self._endpoint_args()[1])
+            return (f'mkdir -p {p} && aws s3 sync s3://{self.name} {p} '
+                    f'--no-progress --endpoint-url {endpoint}')
+        return mounting_utils.r2_mount_command(self.name, mount_path,
+                                               self._account_id())
+
+
+class AzureBlobStore(AbstractStore):
+
+    def _account(self) -> str:
+        account = self.config.get('storage_account')
+        if not account:
+            raise exceptions.StorageSpecError(
+                'Azure storage needs config.storage_account.')
+        return account
+
+    def uri(self) -> str:
+        return (f'https://{self._account()}.blob.core.windows.net/'
+                f'{self.name}')
+
+    def create_if_missing(self) -> None:
+        subprocess.run(['az', 'storage', 'container', 'create', '--name',
+                        self.name, '--account-name', self._account()],
+                       check=False, capture_output=True)
+
+    def delete(self) -> None:
+        subprocess.run(['az', 'storage', 'container', 'delete', '--name',
+                        self.name, '--account-name', self._account()],
+                       check=False, capture_output=True)
+
+    def sync_from(self, local_path: str) -> None:
+        subprocess.run(['azcopy', 'sync', local_path, self.uri()],
+                       check=True)
+
+    def mount_command(self, mount_path: str, mode: StorageMode) -> str:
+        if mode == StorageMode.COPY:
+            return mounting_utils.copy_download_command(self.uri(),
+                                                        mount_path)
+        return mounting_utils.azure_mount_command(
+            self.name, mount_path, self._account())
+
+
+class LocalStore(AbstractStore):
+    """A directory standing in for a bucket (hermetic tests + the local
+    cloud; no analog in the reference, which always needs a real cloud)."""
+
+    def uri(self) -> str:
+        return os.path.expanduser(f'~/.skypilot_tpu/buckets/{self.name}')
+
+    def create_if_missing(self) -> None:
+        os.makedirs(self.uri(), exist_ok=True)
+
+    def delete(self) -> None:
+        import shutil
+        shutil.rmtree(self.uri(), ignore_errors=True)
+
+    def sync_from(self, local_path: str) -> None:
+        import shutil
+        shutil.copytree(local_path, self.uri(), dirs_exist_ok=True)
+
+    def mount_command(self, mount_path: str, mode: StorageMode) -> str:
+        p = mounting_utils.quote_path(mount_path)
+        src = shlex.quote(self.uri())
+        parent = mounting_utils.quote_path(
+            os.path.dirname(mount_path) or '.')
+        if mode == StorageMode.COPY:
+            return (f'rm -rf {p} && mkdir -p {p} && '
+                    f'cp -a {src}/. {p}/')
+        # rm before mkdir: a dangling symlink at the mount path (stale
+        # earlier mount) makes `mkdir -p` fail.
+        return f'rm -rf {p} && mkdir -p {parent} && ln -sfn {src} {p}'
+
+
+_STORE_CLASSES = {
+    StoreType.GCS: GcsStore,
+    StoreType.S3: S3Store,
+    StoreType.R2: R2Store,
+    StoreType.AZURE: AzureBlobStore,
+    StoreType.LOCAL: LocalStore,
+}
+
+
 class Storage:
     """A named bucket with a source to sync and a mount mode."""
 
@@ -37,12 +248,15 @@ class Storage:
                  source: Optional[str] = None,
                  store: StoreType = StoreType.GCS,
                  mode: StorageMode = StorageMode.MOUNT,
-                 persistent: bool = True) -> None:
+                 persistent: bool = True,
+                 store_config: Optional[Dict[str, Any]] = None) -> None:
         self.name = name
         self.source = source
         self.store = store
         self.mode = mode
         self.persistent = persistent
+        self.store_impl: AbstractStore = _STORE_CLASSES[store](
+            name, store_config)
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
@@ -52,48 +266,55 @@ class Storage:
         if not name:
             raise exceptions.StorageSpecError('storage needs a name:')
         return cls(name=name, source=config.get('source'), store=store,
-                   mode=mode, persistent=config.get('persistent', True))
+                   mode=mode, persistent=config.get('persistent', True),
+                   store_config=config.get('config'))
 
     def uri(self) -> str:
-        if self.store == StoreType.GCS:
-            return f'gs://{self.name}'
-        return os.path.expanduser(f'~/.skypilot_tpu/buckets/{self.name}')
+        return self.store_impl.uri()
 
-    # -- operations (gsutil/gcsfuse CLIs; LOCAL store is plain dirs) ------
     def create_if_missing(self) -> None:
-        if self.store == StoreType.LOCAL:
-            os.makedirs(self.uri(), exist_ok=True)
-            return
-        subprocess.run(['gsutil', 'mb', '-b', 'on', self.uri()],
-                       check=False, capture_output=True)
+        self.store_impl.create_if_missing()
+
+    def delete(self) -> None:
+        self.store_impl.delete()
 
     def sync_source(self) -> None:
         if not self.source:
             return
-        src = os.path.expanduser(self.source)
-        if self.store == StoreType.LOCAL:
-            os.makedirs(self.uri(), exist_ok=True)
-            subprocess.run(['rsync', '-a', src + '/', self.uri() + '/'],
-                           check=True)
-            return
-        subprocess.run(['gsutil', '-m', 'rsync', '-r', src, self.uri()],
-                       check=True)
+        self.store_impl.sync_from(os.path.expanduser(self.source))
 
     def mount_command(self, mount_path: str) -> str:
         """Shell command run on each host (mirrors
-        sky/data/mounting_utils.py gcsfuse cmds)."""
-        p = shlex.quote(mount_path)
-        if self.store == StoreType.LOCAL:
-            return (f'mkdir -p {p} && rm -rf {p} && '
-                    f'ln -sfn {shlex.quote(self.uri())} {p}')
-        if self.mode == StorageMode.COPY:
-            return (f'mkdir -p {p} && '
-                    f'gsutil -m rsync -r {shlex.quote(self.uri())} {p}')
-        cache = ('--file-cache-max-size-mb 10240 '
-                 if self.mode == StorageMode.MOUNT_CACHED else '')
-        return (f'mkdir -p {p} && '
-                f'gcsfuse --implicit-dirs {cache}'
-                f'{shlex.quote(self.name)} {p}')
+        sky/data/mounting_utils.py command builders)."""
+        return self.store_impl.mount_command(mount_path, self.mode)
+
+
+# --- storage state (for `skytpu storage ls/delete`) ---------------------
+
+
+def _record(storage: Storage, cluster: Optional[str]) -> None:
+    from skypilot_tpu import state as state_lib
+    state_lib.add_storage(storage.name, storage.store.value,
+                          storage.mode.value, cluster,
+                          config=storage.store_impl.config or None)
+
+
+def list_storage() -> List[Dict[str, Any]]:
+    from skypilot_tpu import state as state_lib
+    return state_lib.list_storage()
+
+
+def delete_storage(name: str) -> None:
+    import json
+    from skypilot_tpu import state as state_lib
+    rec = state_lib.get_storage(name)
+    if rec is None:
+        raise exceptions.StorageError(f'No storage {name!r}.')
+    store_config = (json.loads(rec['config_json'])
+                    if rec.get('config_json') else None)
+    Storage(name, store=StoreType(rec['store']),
+            store_config=store_config).delete()
+    state_lib.remove_storage(name)
 
 
 def mount_storage(handle, target: str, storage_config: Dict[str, Any]
@@ -111,3 +332,4 @@ def mount_storage(handle, target: str, storage_config: Dict[str, Any]
     if bad:
         raise exceptions.StorageError(
             f'Mounting {storage.name} at {target} failed on hosts {bad}.')
+    _record(storage, handle.cluster_name)

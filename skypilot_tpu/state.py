@@ -35,6 +35,14 @@ CREATE TABLE IF NOT EXISTS cluster_history (
     resources TEXT,
     duration_s REAL
 );
+CREATE TABLE IF NOT EXISTS storage (
+    name TEXT PRIMARY KEY,
+    store TEXT,
+    mode TEXT,
+    last_attached_cluster TEXT,
+    created_at REAL,
+    config_json TEXT
+);
 """
 
 
@@ -128,6 +136,8 @@ def _migrate(conn: sqlite3.Connection, path: str) -> None:
                            ('user_hash', 'TEXT')))
     db_utils.add_columns_if_missing(
         conn, 'cluster_history', (('hourly_cost', 'REAL'),))
+    db_utils.add_columns_if_missing(
+        conn, 'storage', (('config_json', 'TEXT'),))
     _migrated_paths.add(path)
 
 
@@ -215,6 +225,40 @@ def remove_cluster(name: str) -> None:
                 (name, row['launched_at'], time.time(), repr(res),
                  time.time() - (row['launched_at'] or time.time()), hourly))
         conn.execute('DELETE FROM clusters WHERE name = ?', (name,))
+
+
+def add_storage(name: str, store: str, mode: str,
+                cluster: Optional[str],
+                config: Optional[Dict[str, Any]] = None) -> None:
+    config_json = json.dumps(config) if config else None
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO storage (name, store, mode, '
+            'last_attached_cluster, created_at, config_json) '
+            'VALUES (?, ?, ?, ?, ?, ?) '
+            'ON CONFLICT(name) DO UPDATE SET store = ?, mode = ?, '
+            'last_attached_cluster = ?, config_json = ?',
+            (name, store, mode, cluster, time.time(), config_json,
+             store, mode, cluster, config_json))
+
+
+def get_storage(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM storage WHERE name = ?',
+                           (name,)).fetchone()
+    return dict(row) if row else None
+
+
+def list_storage() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM storage ORDER BY created_at').fetchall()
+    return [dict(r) for r in rows]
+
+
+def remove_storage(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM storage WHERE name = ?', (name,))
 
 
 def cluster_history(limit: int = 100) -> List[Dict[str, Any]]:

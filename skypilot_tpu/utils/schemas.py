@@ -71,9 +71,11 @@ _STORAGE_SCHEMA: Dict[str, Any] = {
         'name': {'type': 'string'},
         'source': {'anyOf': [{'type': 'string'},
                              {'type': 'array', 'items': {'type': 'string'}}]},
-        'store': {'enum': ['gcs', 's3']},
+        'store': {'enum': ['gcs', 's3', 'r2', 'azure', 'local']},
         'persistent': {'type': 'boolean'},
         'mode': {'enum': ['MOUNT', 'COPY', 'MOUNT_CACHED']},
+        # Store-specific settings (r2: account_id; azure: storage_account).
+        'config': {'type': 'object'},
     },
 }
 

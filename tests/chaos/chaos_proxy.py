@@ -1,0 +1,123 @@
+"""Fault-injecting TCP proxy between client and API server.
+
+Reference parity: tests/chaos/chaos_proxy.py — connection-level fault
+injection (reset, delay, truncate) so client robustness is testable
+without touching server code.
+"""
+from __future__ import annotations
+
+import random
+import socket
+import threading
+from typing import Optional
+
+
+class ChaosProxy:
+    """Forwards TCP to (target_host, target_port) with injected faults.
+
+    fault modes:
+      - reset_prob:    probability a new connection is dropped immediately
+      - truncate_prob: probability a response is cut after `truncate_bytes`
+      - delay_s:       fixed extra latency added to each connection
+    """
+
+    def __init__(self, target_host: str, target_port: int,
+                 listen_port: int = 0,
+                 reset_prob: float = 0.0,
+                 truncate_prob: float = 0.0,
+                 truncate_bytes: int = 64,
+                 delay_s: float = 0.0,
+                 seed: Optional[int] = None) -> None:
+        self.target = (target_host, target_port)
+        self.reset_prob = reset_prob
+        self.truncate_prob = truncate_prob
+        self.truncate_bytes = truncate_bytes
+        self.delay_s = delay_s
+        self.rng = random.Random(seed)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(('127.0.0.1', listen_port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self.connections = 0
+        self.faults = 0
+
+    def start(self) -> 'ChaosProxy':
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # --- internals ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._sock.accept()
+            except OSError:
+                return
+            self.connections += 1
+            threading.Thread(target=self._handle, args=(client,),
+                             daemon=True).start()
+
+    def _handle(self, client: socket.socket) -> None:
+        import time
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.rng.random() < self.reset_prob:
+            self.faults += 1
+            client.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                              b'\x01\x00\x00\x00\x00\x00\x00\x00')
+            client.close()   # RST
+            return
+        try:
+            upstream = socket.create_connection(self.target, timeout=10)
+        except OSError:
+            client.close()
+            return
+        truncate = (self.rng.random() < self.truncate_prob)
+        if truncate:
+            self.faults += 1
+        t1 = threading.Thread(target=self._pipe,
+                              args=(client, upstream, None), daemon=True)
+        t2 = threading.Thread(
+            target=self._pipe, args=(upstream, client,
+                                     self.truncate_bytes if truncate
+                                     else None), daemon=True)
+        t1.start()
+        t2.start()
+
+    @staticmethod
+    def _pipe(src: socket.socket, dst: socket.socket,
+              cut_after: Optional[int]) -> None:
+        sent = 0
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                if cut_after is not None and sent + len(data) > cut_after:
+                    dst.sendall(data[:max(0, cut_after - sent)])
+                    break
+                dst.sendall(data)
+                sent += len(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass

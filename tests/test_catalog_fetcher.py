@@ -1,0 +1,86 @@
+"""Catalog data fetcher with canned GCP API responses (reference:
+sky/catalog/data_fetchers/fetch_gcp.py, tested hermetically here since
+the environment has no egress)."""
+import csv
+
+from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+
+
+class FakeResp:
+
+    def __init__(self, payload):
+        self.payload = payload
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return self.payload
+
+
+class FakeSession:
+    """Serves canned pages for the three GCP endpoints the fetcher hits."""
+
+    def get(self, url, timeout=0):
+        if url.startswith('https://tpu.googleapis.com') and \
+                'acceleratorTypes' in url:
+            zone = url.split('/locations/')[1].split('/')[0]
+            if zone == 'us-central2-b':
+                return FakeResp({'acceleratorTypes': [
+                    {'type': 'v4-8'}, {'type': 'v4-16'}]})
+            return FakeResp({'acceleratorTypes': [
+                {'type': 'v5litepod-8'}, {'type': 'v5litepod-16'}]})
+        if url.startswith('https://tpu.googleapis.com'):
+            return FakeResp({'locations': [
+                {'locationId': 'us-east5-b'},
+                {'locationId': 'us-central2-b'}]})
+        if url.startswith('https://cloudbilling.googleapis.com'):
+            def sku(desc, regions, units, nanos):
+                return {
+                    'description': desc, 'serviceRegions': regions,
+                    'pricingInfo': [{'pricingExpression': {'tieredRates': [
+                        {'unitPrice': {'units': units, 'nanos': nanos}},
+                    ]}}],
+                }
+            return FakeResp({'skus': [
+                sku('Cloud TPU v5e chip-hour', ['us-east5'], 1, 200000000),
+                sku('Preemptible Cloud TPU v5e chip-hour', ['us-east5'],
+                    0, 540000000),
+                sku('Cloud TPU v4 pod chip-hour', ['us-central2'], 3,
+                    220000000),
+                sku('Unrelated GPU thing', ['us-east5'], 9, 0),
+            ]})
+        raise AssertionError(f'unexpected URL {url}')
+
+
+def test_fetch_tpu_zones_and_prices():
+    session = FakeSession()
+    zones = fetch_gcp.fetch_tpu_zones(session, 'proj')
+    assert zones == {
+        'us-east5-b': ['v5litepod-8', 'v5litepod-16'],
+        'us-central2-b': ['v4-8', 'v4-16'],
+    }
+    prices = fetch_gcp.fetch_tpu_prices(session)
+    assert prices[('v5e', 'us-east5', False)] == 1.2
+    assert prices[('v5e', 'us-east5', True)] == 0.54
+    assert prices[('v4', 'us-central2', False)] == 3.22
+    assert ('v4', 'us-east5', False) not in prices
+
+
+def test_main_writes_catalog_csv(tmp_path, monkeypatch):
+    monkeypatch.setattr(fetch_gcp, '_authed_session',
+                        lambda: FakeSession())
+    out = tmp_path / 'tpus.csv'
+    rc = fetch_gcp.main(['--project', 'proj', '--output', str(out)])
+    assert rc == 0
+    rows = list(csv.DictReader(open(out, encoding='utf-8')))
+    # Exactly the shipped schema, so refreshed CSVs drop in unchanged.
+    assert rows[0].keys() == {'generation', 'region', 'zone',
+                              'chip_price', 'spot_chip_price'}
+    by_key = {(r['generation'], r['zone']): r for r in rows}
+    assert float(by_key[('v5e', 'us-east5-b')]['chip_price']) == 1.2
+    assert float(by_key[('v5e', 'us-east5-b')]['spot_chip_price']) == 0.54
+    assert float(by_key[('v4', 'us-central2-b')]['chip_price']) == 3.22
+    # No spot SKU for v4 -> derived discount.
+    assert float(by_key[('v4', 'us-central2-b')]['spot_chip_price']) == \
+        3.22 * 0.45

@@ -91,9 +91,20 @@ def test_shim_forwards_comm_fd(proxy):
     try:
         result = _run_shim(proxy, ['/mnt/fd'], comm_fd=right.fileno())
         assert result.returncode == 0, result.stderr
-        log = proxy['log'].read_text()
         # Server re-exports the forwarded fd under some number != none.
-        last = [l for l in log.splitlines() if l.startswith('commfd:')][-1]
+        # The server's log write races the shim's exit on a loaded 1-core
+        # box, so poll briefly instead of reading once.
+        import time as time_lib
+        deadline = time_lib.time() + 10
+        last = 'commfd: none'
+        while time_lib.time() < deadline:
+            log = proxy['log'].read_text()
+            lines = [l for l in log.splitlines()
+                     if l.startswith('commfd:')]
+            if lines and lines[-1] != 'commfd: none':
+                last = lines[-1]
+                break
+            time_lib.sleep(0.2)
         assert last != 'commfd: none'
     finally:
         left.close()

@@ -1,0 +1,137 @@
+"""Load + chaos harness against a real API server process.
+
+Reference parity: tests/load_tests/test_load_on_server.py (concurrent
+all-request storm) and tests/chaos/chaos_proxy.py (connection-level
+fault injection between client and server).
+"""
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.client.rest import RestClient
+from tests.chaos.chaos_proxy import ChaosProxy
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def live_server(tmp_home):
+    """A real server subprocess (worker pool, not inline mode)."""
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port), '--short-workers', '2', '--long-workers',
+         '2'],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            requests.get(base + '/api/health', timeout=2)
+            break
+        except requests.RequestException:
+            time.sleep(0.3)
+    else:
+        proc.kill()
+        pytest.fail('server did not come up')
+    yield base, port
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_concurrent_request_storm(live_server):
+    """N threads × mixed endpoints: all requests complete, none drop."""
+    base, _ = live_server
+    client = RestClient(base)
+    n_threads, per_thread = 8, 5
+    errors, latencies = [], []
+    lock = threading.Lock()
+
+    def worker(i):
+        for _ in range(per_thread):
+            t0 = time.monotonic()
+            try:
+                result = client.submit_and_get('/status', {}, timeout=60)
+                assert result == []
+                requests.get(base + '/api/requests', timeout=10
+                             ).raise_for_status()
+            except Exception as e:  # pylint: disable=broad-except
+                with lock:
+                    errors.append(e)
+            finally:
+                with lock:
+                    latencies.append(time.monotonic() - t0)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+    assert len(latencies) == n_threads * per_thread
+    # The executor must drain the whole storm; every request terminal.
+    records = requests.get(base + '/api/requests', timeout=10).json()
+    assert len([r for r in records if r['status'] == 'SUCCEEDED']) >= \
+        n_threads * per_thread
+
+
+def test_chaos_connection_resets_surface_typed_errors(live_server):
+    """100% connection resets: client fails fast with ApiServerError —
+    no hangs, no raw socket exceptions."""
+    base, port = live_server
+    proxy = ChaosProxy('127.0.0.1', port, reset_prob=1.0, seed=7).start()
+    try:
+        client = RestClient(f'http://127.0.0.1:{proxy.port}', timeout=5)
+        t0 = time.monotonic()
+        with pytest.raises(exceptions.ApiServerError):
+            client.submit('/status', {})
+        assert time.monotonic() - t0 < 10
+        assert proxy.faults >= 1
+    finally:
+        proxy.stop()
+
+
+def test_chaos_partial_failures_do_not_corrupt(live_server):
+    """50% resets: successes stay correct, failures stay typed."""
+    base, port = live_server
+    proxy = ChaosProxy('127.0.0.1', port, reset_prob=0.5, seed=11).start()
+    try:
+        client = RestClient(f'http://127.0.0.1:{proxy.port}', timeout=5)
+        ok, failed = 0, 0
+        for _ in range(12):
+            try:
+                assert client.submit_and_get('/status', {},
+                                             timeout=30) == []
+                ok += 1
+            except (exceptions.ApiServerError,
+                    requests.RequestException):
+                failed += 1
+        assert ok + failed == 12
+        assert ok >= 1, 'some requests must get through'
+        assert failed >= 1, 'with reset_prob=0.5 some must fail'
+    finally:
+        proxy.stop()
+
+
+def test_chaos_delay_still_succeeds(live_server):
+    """Added latency within timeout budget: no failures."""
+    base, port = live_server
+    proxy = ChaosProxy('127.0.0.1', port, delay_s=0.3).start()
+    try:
+        client = RestClient(f'http://127.0.0.1:{proxy.port}', timeout=15)
+        assert client.submit_and_get('/status', {}, timeout=60) == []
+    finally:
+        proxy.stop()

@@ -1,0 +1,190 @@
+"""Storage subsystem: store classes, mount-command builders, modes,
+state tracking + CLI (reference: sky/data/storage.py StoreType/
+StorageMode/Storage, sky/data/mounting_utils.py)."""
+import os
+
+import pytest
+
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data.storage import (Storage, StorageMode, StoreType,
+                                       delete_storage, list_storage)
+
+
+def test_store_uris():
+    assert Storage('b', store=StoreType.GCS).uri() == 'gs://b'
+    assert Storage('b', store=StoreType.S3).uri() == 's3://b'
+    assert Storage('b', store=StoreType.R2).uri() == 'r2://b'
+    azure = Storage('b', store=StoreType.AZURE,
+                    store_config={'storage_account': 'acc'})
+    assert azure.uri() == 'https://acc.blob.core.windows.net/b'
+
+
+def test_mount_commands_per_store():
+    gcs = Storage('bkt', store=StoreType.GCS)
+    assert 'gcsfuse' in gcs.mount_command('/data')
+    s3 = Storage('bkt', store=StoreType.S3)
+    assert 'goofys' in s3.mount_command('/data')
+    r2 = Storage('bkt', store=StoreType.R2,
+                 store_config={'account_id': 'acct123'})
+    assert 'https://acct123.r2.cloudflarestorage.com' in \
+        r2.mount_command('/data')
+    az = Storage('bkt', store=StoreType.AZURE,
+                 store_config={'storage_account': 'acc'})
+    assert 'blobfuse2' in az.mount_command('/data')
+
+
+def test_mount_modes_change_command():
+    copy = Storage('bkt', store=StoreType.GCS, mode=StorageMode.COPY)
+    assert 'gsutil -m rsync' in copy.mount_command('/data')
+    cached = Storage('bkt', store=StoreType.GCS,
+                     mode=StorageMode.MOUNT_CACHED)
+    assert 'file-cache-max-size-mb' in cached.mount_command('/data')
+    s3_cached = Storage('bkt', store=StoreType.S3,
+                        mode=StorageMode.MOUNT_CACHED)
+    assert 'rclone mount' in s3_cached.mount_command('/data')
+    assert 'vfs-cache-mode writes' in s3_cached.mount_command('/data')
+
+
+def test_mount_commands_are_idempotent():
+    """Every FUSE mount guards with mountpoint -q (re-running setup on a
+    host must not double-mount)."""
+    for store, cfg in ((StoreType.GCS, None), (StoreType.S3, None),
+                      (StoreType.R2, {'account_id': 'acct'}),
+                      (StoreType.AZURE, {'storage_account': 'a'})):
+        cmd = Storage('b', store=store,
+                      store_config=cfg).mount_command('/data')
+        assert 'mountpoint -q' in cmd, store
+
+
+def test_r2_requires_account_and_copies_via_r2_endpoint():
+    from skypilot_tpu import exceptions
+    r2 = Storage('bkt', store=StoreType.R2,
+                 store_config={'account_id': 'acct'},
+                 mode=StorageMode.COPY)
+    cmd = r2.mount_command('/data')
+    assert 'acct.r2.cloudflarestorage.com' in cmd  # never plain AWS
+    with pytest.raises(exceptions.StorageSpecError):
+        Storage('bkt', store=StoreType.R2).mount_command('/data')
+
+
+def test_azure_cli_targets_configured_account():
+    az = Storage('bkt', store=StoreType.AZURE,
+                 store_config={'storage_account': 'acc'})
+    # uri + mount both resolve through the configured account; missing
+    # account is a spec error, not a silent default.
+    assert 'acc.blob.core.windows.net' in az.uri()
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.StorageSpecError):
+        Storage('bkt', store=StoreType.AZURE).uri()
+
+
+def test_s3_cached_mount_uses_connection_string():
+    cmd = Storage('bkt', store=StoreType.S3,
+                  mode=StorageMode.MOUNT_CACHED).mount_command('/d')
+    # A named remote would need a pre-seeded rclone.conf on the host.
+    assert ':s3,env_auth=true:bkt' in cmd
+
+
+def test_delete_storage_uses_persisted_config(tmp_home, monkeypatch):
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.data import storage as storage_lib
+    calls = {}
+    monkeypatch.setattr(
+        storage_lib.R2Store, 'delete',
+        lambda self: calls.setdefault('config', self.config))
+    state_lib.add_storage('r2b', 'r2', 'MOUNT', None,
+                          config={'account_id': 'acct9'})
+    storage_lib.delete_storage('r2b')
+    assert calls['config'] == {'account_id': 'acct9'}
+    assert state_lib.get_storage('r2b') is None
+
+
+def test_copy_download_command_dispatch():
+    assert 'gsutil' in mounting_utils.copy_download_command('gs://b', '/d')
+    assert 'aws s3 sync' in mounting_utils.copy_download_command(
+        's3://b', '/d')
+    assert 'azcopy' in mounting_utils.copy_download_command(
+        'https://a.blob.core.windows.net/b', '/d')
+
+
+def test_local_store_end_to_end(tmp_home, tmp_path):
+    src = tmp_path / 'src'
+    src.mkdir()
+    (src / 'weights.bin').write_text('w')
+    storage = Storage('ckpt', source=str(src), store=StoreType.LOCAL)
+    storage.create_if_missing()
+    storage.sync_source()
+    assert os.path.exists(os.path.join(storage.uri(), 'weights.bin'))
+    storage.delete()
+    assert not os.path.exists(storage.uri())
+
+
+def test_storage_mount_via_local_launch(tmp_home, tmp_path):
+    """Full path: task file_mounts dict -> bucket synced -> mounted on the
+    local cluster -> tracked in state -> delete removes both."""
+    import skypilot_tpu as sky
+    src = tmp_path / 'data'
+    src.mkdir()
+    (src / 'input.txt').write_text('payload')
+    mnt = str(tmp_path / 'mnt')
+    task = sky.Task(
+        run=f'cat {mnt}/input.txt', name='t',
+        file_mounts={mnt: {
+            'name': 'mnt-bkt', 'store': 'local', 'source': str(src)}})
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='st')
+    try:
+        rows = list_storage()
+        assert [r['name'] for r in rows] == ['mnt-bkt']
+        assert rows[0]['last_attached_cluster'] == 'st'
+    finally:
+        sky.down('st')
+    delete_storage('mnt-bkt')
+    assert list_storage() == []
+
+
+def test_tilde_mount_target_expands_on_host(tmp_home, tmp_path):
+    """`file_mounts: {~/mnt: {...}}` must expand ~ on the HOST (quoting
+    it literally broke every tilde mount)."""
+    import skypilot_tpu as sky
+    from skypilot_tpu.data import mounting_utils
+    assert mounting_utils.quote_path('~/mnt') == '"$HOME"/mnt'
+    assert mounting_utils.quote_path('/abs path') == "'/abs path'"
+    src = tmp_path / 'd'
+    src.mkdir()
+    (src / 'in.txt').write_text('tilde-ok')
+    task = sky.Task(
+        run='cat ~/mnt/in.txt', name='t',
+        file_mounts={'~/mnt': {
+            'name': 'tilde-bkt', 'store': 'local', 'source': str(src)}})
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='tl')
+    try:
+        import os as os_lib
+        assert os_lib.path.exists(
+            os_lib.path.expanduser('~/mnt/in.txt'))
+    finally:
+        sky.down('tl')
+        delete_storage('tilde-bkt')
+
+
+def test_storage_cli(tmp_home, capsys):
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.client import cli
+    state_lib.add_storage('bkt1', 'gcs', 'MOUNT', 'c1')
+    assert cli.main(['storage', 'ls']) == 0
+    out = capsys.readouterr().out
+    assert 'bkt1' in out and 'gcs' in out
+    # CLI delete of a local-store bucket removes tracking.
+    state_lib.add_storage('bkt2', 'local', 'MOUNT', None)
+    assert cli.main(['storage', 'delete', 'bkt2']) == 0
+    names = [r['name'] for r in state_lib.list_storage()]
+    assert 'bkt2' not in names
+
+
+def test_unknown_store_rejected():
+    from skypilot_tpu import exceptions
+    with pytest.raises(ValueError):
+        Storage.from_yaml_config({'name': 'b', 'store': 'floppy'})
+    with pytest.raises(exceptions.StorageSpecError):
+        Storage.from_yaml_config({'store': 'gcs'})
