@@ -1,0 +1,86 @@
+"""Minimal TPU inference server for the serving recipe.
+
+The replica process behind examples/serve_llama.yaml: aiohttp app with
+/health (readiness probe target) and /generate (greedy decode).  Analog
+of the reference's vLLM replica (llm/vllm/service.yaml) at recipe scale:
+real model, real TPU forward pass, token-by-token greedy decoding with a
+jitted step.  Production serving would add KV-cache decode and
+continuous batching; this keeps the recipe self-contained.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+from aiohttp import web
+
+
+def build_model(model_size: str):
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+
+    config = {
+        'debug': llama.LLAMA_DEBUG,
+        '1b': llama.LLAMA_1B,
+        '8b': llama.LLAMA3_8B,
+    }[model_size]
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+
+    @jax.jit
+    def next_token(params, tokens):
+        logits = llama.forward(params, tokens, config)
+        return jnp.argmax(logits[:, -1, :], axis=-1)
+
+    return params, config, next_token
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=8080)
+    parser.add_argument('--model-size', default='debug')
+    parser.add_argument('--max-new-tokens', type=int, default=16)
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+    params, config, next_token = build_model(args.model_size)
+    # Warm the compile cache so the readiness probe reflects readiness.
+    next_token(params, jnp.ones((1, 8), dtype=jnp.int32))
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({'status': 'ok',
+                                  'model': args.model_size})
+
+    async def generate(request: web.Request) -> web.Response:
+        body = await request.json()
+        prompt_ids = body.get('prompt_ids') or [1, 2, 3]
+        max_new = min(int(body.get('max_new_tokens',
+                                   args.max_new_tokens)), 256)
+        t0 = time.monotonic()
+        tokens = jnp.asarray([prompt_ids], dtype=jnp.int32)
+
+        def _decode():
+            out = tokens
+            for _ in range(max_new):
+                nxt = next_token(params, out)
+                out = jnp.concatenate([out, nxt[:, None]], axis=1)
+            return out
+        out = await asyncio.to_thread(_decode)
+        return web.json_response({
+            'output_ids': out[0].tolist(),
+            'latency_s': round(time.monotonic() - t0, 3),
+        })
+
+    app = web.Application()
+    app.router.add_get('/health', health)
+    app.router.add_post('/generate', generate)
+    print(json.dumps({'serving': args.model_size, 'port': args.port}))
+    web.run_app(app, host='0.0.0.0', port=args.port, print=None)
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

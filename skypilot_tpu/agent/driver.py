@@ -30,10 +30,50 @@ from skypilot_tpu.utils.status_lib import JobStatus
 _CANCELLED_RC = 137
 
 
-def _rank_argv(host: Dict[str, Any], cmd: str,
-               env: Dict[str, str]) -> tuple:
+def _host_shell_argv(host: Dict[str, Any], cmd: str) -> List[str]:
+    """argv that runs `cmd` in a shell ON the given host (local or ssh)."""
+    ssh = host.get('ssh')
+    if ssh is None:
+        return ['/bin/bash', '-c', cmd]
+    from skypilot_tpu.utils.command_runner import build_ssh_argv
+    return build_ssh_argv(
+        host['internal_ip'], user=ssh['user'],
+        key_path=ssh.get('key_path'), port=ssh.get('port', 22),
+    ) + ['bash', '-c', shlex.quote(cmd)]
+
+
+def _docker_wrap(cmd: str, env: Dict[str, str], container: str,
+                 tag: str, workdir: Optional[str]) -> str:
+    """Run `cmd` inside the runtime container as a session leader whose
+    pgid is recorded at /tmp/<tag>.pid, so cancel can kill the WHOLE
+    in-container group (killing the docker-exec client alone would leave
+    the workload running and holding the TPU)."""
+    exports = ' '.join(
+        f'export {k}={shlex.quote(v)};' for k, v in env.items())
+    cd = (f'cd {shlex.quote(workdir)} 2>/dev/null || true; '
+          if workdir else '')
+    inner = (f'echo $$ > /tmp/{tag}.pid; {cd}{exports} {cmd}')
+    return (f'sudo docker exec {shlex.quote(container)} setsid '
+            f'/bin/bash -c {shlex.quote(inner)}')
+
+
+def _docker_kill_cmd(container: str, tag: str) -> str:
+    return (f'sudo docker exec {shlex.quote(container)} /bin/bash -c '
+            f'"kill -TERM -- -\\$(cat /tmp/{tag}.pid) 2>/dev/null; '
+            f'rm -f /tmp/{tag}.pid" 2>/dev/null || true')
+
+
+def _rank_argv(host: Dict[str, Any], cmd: str, env: Dict[str, str],
+               docker_container: Optional[str] = None,
+               docker_tag: str = '') -> tuple:
     """(argv, cwd, env_overlay) to start this rank's process from the head."""
     ssh = host.get('ssh')
+    if docker_container is not None:
+        # Env exports must ride INSIDE the exec: the container does not
+        # inherit the host environment (docker_utils runtime container).
+        cmd = _docker_wrap(cmd, env, docker_container, docker_tag,
+                           host.get('workdir'))
+        env = {}
     if ssh is None:
         # Local host (the `local` cloud, or the head itself on GCP).
         return (['/bin/bash', '-c', cmd], host.get('workdir'), env)
@@ -77,7 +117,17 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             task_id=spec.get('task_id', ''),
             num_slices=num_slices,
             slice_id=rank // hosts_per_slice))
-        argv, cwd, env_overlay = _rank_argv(hosts[rank], cmd, env)
+        container = spec.get('docker_container')
+        if container:
+            tag = f'skytpu-job{job_id}-rank{rank}'
+            with lock:
+                _DOCKER_KILLS.append(_host_shell_argv(
+                    hosts[rank], _docker_kill_cmd(container, tag)))
+        else:
+            tag = ''
+        argv, cwd, env_overlay = _rank_argv(
+            hosts[rank], cmd, env, docker_container=container,
+            docker_tag=tag)
         full_env = dict(os.environ)
         if env_overlay:
             full_env.update(env_overlay)
@@ -127,6 +177,7 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
                             os.killpg(os.getpgid(p.pid), 15)
                         except (ProcessLookupError, OSError):
                             pass
+            _kill_in_container()
             break
         time.sleep(0.2)
     for t in threads:
@@ -147,6 +198,19 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
 # cancel path kills the driver's process group; ranks run in their own
 # sessions, so the driver must forward the kill).
 _LIVE_PROCS: List[subprocess.Popen] = []
+# Per-rank in-container kill argvs (docker runtime): killing the docker
+# exec CLIENT does not stop the exec'd process, so cancel must also kill
+# the recorded in-container process group.
+_DOCKER_KILLS: List[List[str]] = []
+
+
+def _kill_in_container() -> None:
+    for argv in list(_DOCKER_KILLS):
+        try:
+            subprocess.run(argv, timeout=30, capture_output=True,
+                           check=False)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
 
 
 def _kill_ranks(*_args) -> None:
@@ -156,6 +220,7 @@ def _kill_ranks(*_args) -> None:
                 os.killpg(os.getpgid(p.pid), signal.SIGTERM)
             except (ProcessLookupError, OSError):
                 pass
+    _kill_in_container()
 
 
 def main() -> int:

@@ -158,8 +158,19 @@ class TpuBackend:
             f'~/.skypilot_tpu/logs/{handle.cluster_name}/setup')
         os.makedirs(log_dir, exist_ok=True)
         envs = task.envs_and_secrets
+        setup_cmd = task.setup
+        if handle.launched_resources.docker_image:
+            # Setup must land in the SAME environment run executes in —
+            # pip installs on the host would be invisible in-container.
+            import shlex as shlex_lib
+            from skypilot_tpu.provision import docker_utils
+            exports = ' '.join(
+                f'export {k}={shlex_lib.quote(v)};'
+                for k, v in envs.items())
+            setup_cmd = docker_utils.wrap_command_in_container(
+                exports + ' ' + setup_cmd)
         rcs = runner_lib.run_on_hosts_parallel(
-            runners, task.setup, env=envs, log_dir=log_dir)
+            runners, setup_cmd, env=envs, log_dir=log_dir)
         bad = {i: rc for i, rc in enumerate(rcs) if rc != 0}
         if bad:
             raise exceptions.CommandError(
@@ -205,6 +216,9 @@ class TpuBackend:
             'num_chips_per_node': handle.num_chips_per_host,
             'num_slices': handle.num_slices,
         }
+        if handle.launched_resources.docker_image:
+            from skypilot_tpu.provision import docker_utils
+            spec['docker_container'] = docker_utils.CONTAINER_NAME
         client = AgentClient(handle.agent_url())
         job_id = client.submit_job(spec)
         logger.info(f'Job {job_id} submitted to {handle.cluster_name!r} '

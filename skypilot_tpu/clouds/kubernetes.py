@@ -80,7 +80,10 @@ class Kubernetes(cloud_lib.Cloud):
             'region': region,
             'zone': None,
             'context': config_lib.get_nested(('kubernetes', 'context')),
-            'image': config_lib.get_nested(
+            # docker: image_id maps to the POD image here — pods are
+            # already containers, so there is no runtime-container layer
+            # (docker_utils) on kubernetes.
+            'image': resources.docker_image or config_lib.get_nested(
                 ('kubernetes', 'image'),
                 default_value='python:3.11-slim'),
             'tpu_vm': spec is not None,

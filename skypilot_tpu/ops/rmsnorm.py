@@ -84,9 +84,13 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
              use_pallas: Optional[bool] = None) -> jax.Array:
     """y = x / rms(x) * weight over the last dim."""
     if use_pallas is None:
+        import math
+        rows = math.prod(x.shape[:-1])
         try:
+            # Mosaic needs row blocks divisible by 8 (sublane) — odd row
+            # counts (e.g. short inference prompts) take the XLA path.
             use_pallas = jax.devices()[0].platform == 'tpu' and (
-                x.shape[-1] % 128 == 0)
+                x.shape[-1] % 128 == 0) and rows % 8 == 0
         except RuntimeError:
             use_pallas = False
     if use_pallas:

@@ -1,0 +1,63 @@
+"""Docker runtime: run task commands inside a user-chosen container.
+
+Reference parity: sky/provision/docker_utils.py +
+instance_setup.initialize_docker (sky/provision/instance_setup.py:188) —
+`image_id: docker:<image>` starts a long-lived runtime container on
+every host at provision time, and all job commands exec inside it.
+
+TPU-native specifics: the container gets `--privileged --net=host` and
+`/dev` + `/run` mounts so libtpu inside the image reaches the TPU chips
+(`/dev/accel*`, same accelerator-passthrough model the reference uses
+for `--gpus all`).  $HOME is bind-mounted at the same path, so workdir
+rsync, wheels, and job logs need no docker-cp plumbing.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Optional
+
+CONTAINER_NAME = 'skytpu-runtime'
+
+DOCKER_PREFIX = 'docker:'
+
+
+def docker_image_from_image_id(image_id: Optional[str]) -> Optional[str]:
+    """'docker:pytorch/xla:r2.5' -> 'pytorch/xla:r2.5'; else None."""
+    if image_id and image_id.startswith(DOCKER_PREFIX):
+        return image_id[len(DOCKER_PREFIX):]
+    return None
+
+
+def initialize_docker_command(image: str) -> str:
+    """Idempotent per-host setup: install docker, pull the image, start
+    (or reuse) the runtime container."""
+    img = shlex.quote(image)
+    name = shlex.quote(CONTAINER_NAME)
+    install = ('command -v docker >/dev/null 2>&1 || { '
+               'curl -fsSL https://get.docker.com | sudo sh; }')
+    # Reuse a container only if it runs the requested image AND is
+    # actually running — a stop/start cycle leaves it Exited, and an
+    # image change must not silently keep the old runtime.
+    start = (
+        f'current=$(sudo docker inspect --format '
+        f'"{{{{.Config.Image}}}} {{{{.State.Running}}}}" {name} '
+        f'2>/dev/null || true); '
+        f'if [ "$current" != "{image} true" ]; then '
+        f'sudo docker rm -f {name} >/dev/null 2>&1 || true; '
+        f'sudo docker pull {img} && '
+        f'sudo docker run -d --name {name} --privileged --net=host '
+        f'--restart=always '
+        f'-v "$HOME":"$HOME" -v /dev:/dev -v /run:/run '
+        f'-w "$HOME" {img} sleep infinity; '
+        f'fi')
+    return f'({install}) && {start}'
+
+
+def wrap_command_in_container(cmd: str) -> str:
+    """Wrap a shell command so it executes inside the runtime container.
+
+    The full command (env exports included) must be inside the `docker
+    exec`: the container does not inherit the host process environment.
+    """
+    return (f'sudo docker exec {shlex.quote(CONTAINER_NAME)} '
+            f'/bin/bash -c {shlex.quote(cmd)}')

@@ -85,6 +85,21 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
     from skypilot_tpu.agent.client import AgentClient
     head = cluster_info.head
     head_ip = head.external_ip or head.internal_ip
+    # Docker runtime first (reference: initialize_docker runs before the
+    # rest of runtime setup, instance_setup.py:188): every host gets the
+    # runtime container so job commands can exec inside it.
+    all_runners = _make_runners(cluster_info)
+    docker_image = (cluster_info.provider_config or {}).get('docker_image')
+    if docker_image:
+        from skypilot_tpu.provision import docker_utils
+        init_cmd = docker_utils.initialize_docker_command(docker_image)
+        rcs = runner_lib.run_on_hosts_parallel(all_runners, init_cmd,
+                                               timeout=900)
+        bad = [i for i, rc in enumerate(rcs) if rc != 0]
+        if bad:
+            raise exceptions.ProvisionerError(
+                f'Docker runtime init ({docker_image}) failed on hosts '
+                f'{bad}.')
     if cluster_info.cloud == 'local':
         base_dir = f'{head.workdir}/.agent'
         os.makedirs(base_dir, exist_ok=True)
@@ -112,7 +127,6 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
                 continue
         raise exceptions.ProvisionerError(
             f'Could not start an identity-verified agent: {last_exc}')
-    all_runners = _make_runners(cluster_info)
     runner = all_runners[0]
     # Ship the client's exact package version as a wheel and install it
     # on the head before starting the agent (reference: wheel_utils build
@@ -224,6 +238,12 @@ def provision_with_failover(
             config['num_nodes'] = num_nodes
             if volumes:
                 config['volumes'] = list(volumes)
+            if to_provision.docker_image and \
+                    cloud_obj.name != 'kubernetes':
+                # VM clouds start a runtime container (docker_utils);
+                # kubernetes instead uses the image AS the pod image
+                # (clouds/kubernetes.py make_deploy_resources_variables).
+                config['docker_image'] = to_provision.docker_image
             try:
                 logger.info(f'Provisioning {cluster_name!r} '
                             f'({to_provision}) in {region}/{zone}...')

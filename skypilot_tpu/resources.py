@@ -262,6 +262,13 @@ class Resources:
         return self._image_id
 
     @property
+    def docker_image(self) -> Optional[str]:
+        """The container image when image_id uses the `docker:` prefix
+        (reference: Resources docker image extraction)."""
+        from skypilot_tpu.provision import docker_utils
+        return docker_utils.docker_image_from_image_id(self._image_id)
+
+    @property
     def labels(self) -> Dict[str, str]:
         return dict(self._labels)
 

@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Fake docker for hermetic docker-runtime tests.
+
+Persists container state as JSON under $FAKE_DOCKER_DIR and logs every
+invocation to invocations.log.  Supports the subset docker_utils uses:
+inspect --format, rm -f, pull, run -d ..., exec NAME /bin/bash -c CMD
+(exec actually runs the command in a plain bash so job output flows)."""
+import json
+import os
+import subprocess
+import sys
+
+
+def _dir():
+    d = os.environ['FAKE_DOCKER_DIR']
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _state_path(name):
+    return os.path.join(_dir(), f'{name}.json')
+
+
+def _log(argv):
+    with open(os.path.join(_dir(), 'invocations.log'), 'a',
+              encoding='utf-8') as f:
+        f.write(json.dumps(argv) + '\n')
+
+
+def main():
+    argv = sys.argv[1:]
+    _log(argv)
+    if not argv:
+        return 1
+    cmd = argv[0]
+    if cmd == 'inspect':
+        # inspect --format '{{.Config.Image}} {{.State.Running}}' NAME
+        name = argv[-1]
+        fmt = argv[argv.index('--format') + 1]
+        if not os.path.exists(_state_path(name)):
+            print(f'Error: No such object: {name}', file=sys.stderr)
+            return 1
+        with open(_state_path(name), encoding='utf-8') as f:
+            state = json.load(f)
+        out = state['image']
+        if 'State.Running' in fmt:
+            out += ' ' + ('true' if state.get('running', True) else 'false')
+        print(out)
+        return 0
+    if cmd == 'rm':
+        name = argv[-1]
+        try:
+            os.remove(_state_path(name))
+        except FileNotFoundError:
+            pass
+        return 0
+    if cmd == 'pull':
+        image = argv[-1]
+        if image.startswith('missing/'):
+            print(f'Error: pull access denied for {image}',
+                  file=sys.stderr)
+            return 1
+        return 0
+    if cmd == 'run':
+        name = argv[argv.index('--name') + 1]
+        image = argv[-3]   # ... IMAGE sleep infinity
+        with open(_state_path(name), 'w', encoding='utf-8') as f:
+            json.dump({'image': image, 'name': name, 'running': True}, f)
+        return 0
+    if cmd == 'exec':
+        # exec NAME /bin/bash -c CMD — run for real so job output flows.
+        name = argv[1]
+        if not os.path.exists(_state_path(name)):
+            print(f'Error: No such container: {name}', file=sys.stderr)
+            return 1
+        inner = argv[argv.index('-c') + 1]
+        env = dict(os.environ)
+        env['SKYTPU_IN_FAKE_CONTAINER'] = '1'
+        return subprocess.run(['/bin/bash', '-c', inner],
+                              env=env).returncode
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

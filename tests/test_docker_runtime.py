@@ -1,0 +1,188 @@
+"""Docker runtime: image_id docker: prefix starts a runtime container at
+provision time and job commands exec inside it (reference:
+sky/provision/docker_utils.py + instance_setup.initialize_docker)."""
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from skypilot_tpu.provision import docker_utils
+from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture reuse)
+
+
+def test_image_id_parsing():
+    assert docker_utils.docker_image_from_image_id(
+        'docker:pytorch/xla:r2.5') == 'pytorch/xla:r2.5'
+    assert docker_utils.docker_image_from_image_id('ubuntu-2204') is None
+    assert docker_utils.docker_image_from_image_id(None) is None
+
+
+def test_resources_docker_image():
+    from skypilot_tpu.resources import Resources
+    res = Resources(cloud='local', image_id='docker:img:tag')
+    assert res.docker_image == 'img:tag'
+    assert Resources(cloud='local').docker_image is None
+
+
+def test_init_command_replaces_on_image_change():
+    cmd = docker_utils.initialize_docker_command('img:v2')
+    # Reuse only when the running container matches the image.
+    assert 'docker inspect' in cmd
+    assert 'docker rm -f' in cmd
+    assert 'docker pull img:v2' in cmd
+    assert '--privileged' in cmd and '--net=host' in cmd
+    assert '-v /dev:/dev' in cmd   # TPU chips reachable inside
+
+
+def test_wrap_command_quotes_inner():
+    wrapped = docker_utils.wrap_command_in_container('echo "a b" && id')
+    assert wrapped.startswith('sudo docker exec')
+    assert 'skytpu-runtime' in wrapped
+
+
+@pytest.fixture()
+def fake_docker_path(tmp_path, monkeypatch):
+    """PATH with a fake `docker` (state under FAKE_DOCKER_DIR) and a
+    pass-through `sudo`."""
+    bindir = tmp_path / 'bin'
+    bindir.mkdir()
+    fake = os.path.join(os.path.dirname(__file__), 'fake_docker.py')
+    docker = bindir / 'docker'
+    docker.write_text(f'#!/bin/bash\nexec {sys.executable} {fake} "$@"\n')
+    sudo = bindir / 'sudo'
+    sudo.write_text('#!/bin/bash\nexec "$@"\n')
+    for f in (docker, sudo):
+        f.chmod(f.stat().st_mode | stat.S_IEXEC)
+    state_dir = tmp_path / 'docker-state'
+    monkeypatch.setenv('FAKE_DOCKER_DIR', str(state_dir))
+    monkeypatch.setenv('PATH',
+                       f'{bindir}:{os.environ["PATH"]}')
+    return state_dir
+
+
+def _invocations(state_dir):
+    log = state_dir / 'invocations.log'
+    if not log.exists():
+        return []
+    return [json.loads(l) for l in log.read_text().splitlines()]
+
+
+def test_docker_launch_end_to_end(iso_state, fake_docker_path,  # noqa: F811
+                                  capsys):
+    """local-cloud launch with image_id docker:...: container initialized
+    on the host, job command executed through docker exec."""
+    import skypilot_tpu as sky
+    task = sky.Task(run='echo in-container-$SKYTPU_IN_FAKE_CONTAINER',
+                    name='t')
+    task.set_resources(sky.Resources(cloud='local',
+                                     image_id='docker:test/img:1'))
+    job_id, _ = sky.launch(task, cluster_name='dk')
+    try:
+        from skypilot_tpu import core
+        assert core.tail_logs('dk', job_id, follow=False) == 0
+        # Job stdout flowed through the container wrapper (the fake exec
+        # sets SKYTPU_IN_FAKE_CONTAINER=1).
+        assert 'in-container-1' in capsys.readouterr().out
+        calls = _invocations(fake_docker_path)
+        assert ['pull', 'test/img:1'] in calls
+        runs = [c for c in calls if c[0] == 'run']
+        assert runs and '--privileged' in runs[0]
+        execs = [c for c in calls if c[0] == 'exec']
+        assert execs, 'job must run through docker exec'
+    finally:
+        sky.down('dk')
+
+
+def test_init_replaces_exited_container(fake_docker_path):
+    """A stop/start cycle leaves the container Exited — init must
+    replace it, not reuse it."""
+    import subprocess
+    cmd = docker_utils.initialize_docker_command('img:1')
+    assert subprocess.run(['bash', '-c', cmd]).returncode == 0
+    runs = [c for c in _invocations(fake_docker_path) if c[0] == 'run']
+    assert len(runs) == 1
+    # Re-init with a running container: no new run.
+    assert subprocess.run(['bash', '-c', cmd]).returncode == 0
+    runs = [c for c in _invocations(fake_docker_path) if c[0] == 'run']
+    assert len(runs) == 1
+    # Mark the container exited; re-init must replace it.
+    state = json.loads(
+        (fake_docker_path / 'skytpu-runtime.json').read_text())
+    state['running'] = False
+    (fake_docker_path / 'skytpu-runtime.json').write_text(
+        json.dumps(state))
+    assert subprocess.run(['bash', '-c', cmd]).returncode == 0
+    runs = [c for c in _invocations(fake_docker_path) if c[0] == 'run']
+    assert len(runs) == 2
+    assert '--restart=always' in runs[-1]
+
+
+def test_setup_runs_in_container(iso_state, fake_docker_path):  # noqa: F811
+    """Task setup must execute inside the runtime container (a host-side
+    pip install would be invisible to the run command)."""
+    import skypilot_tpu as sky
+    task = sky.Task(
+        setup='echo setup-container=$SKYTPU_IN_FAKE_CONTAINER',
+        run='true', name='t')
+    task.set_resources(sky.Resources(cloud='local',
+                                     image_id='docker:test/img:1'))
+    sky.launch(task, cluster_name='dksetup')
+    try:
+        calls = _invocations(fake_docker_path)
+        execs = [c for c in calls if c[0] == 'exec']
+        # Setup exec (1) + run exec (1).
+        assert len(execs) >= 2
+        assert any('setup-container' in json.dumps(c) for c in execs)
+    finally:
+        sky.down('dksetup')
+
+
+def test_cancel_kills_in_container_group(iso_state,  # noqa: F811
+                                         fake_docker_path):
+    """Cancelling a docker job must kill the recorded in-container
+    process group, not just the docker-exec client."""
+    import time
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import core
+    task = sky.Task(run='sleep 300', name='t')
+    task.set_resources(sky.Resources(cloud='local',
+                                     image_id='docker:test/img:1'))
+    job_id, _ = sky.launch(task, cluster_name='dkcancel',
+                           detach_run=True)
+    try:
+        deadline = time.time() + 60
+        pid = None
+        while time.time() < deadline and pid is None:
+            import glob
+            pids = glob.glob(f'/tmp/skytpu-job{job_id}-rank0.pid')
+            if pids:
+                pid = int(open(pids[0]).read().strip())
+            else:
+                time.sleep(0.5)
+        assert pid is not None, 'in-container pgid file must appear'
+        core.cancel('dkcancel', [job_id])
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                os.killpg(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail('in-container process group still alive')
+    finally:
+        sky.down('dkcancel')
+
+
+def test_docker_image_pull_failure_fails_provision(iso_state,  # noqa: F811
+                                                   fake_docker_path):
+    import skypilot_tpu as sky
+    from skypilot_tpu import exceptions
+    task = sky.Task(run='true', name='t')
+    task.set_resources(sky.Resources(cloud='local',
+                                     image_id='docker:missing/img'))
+    with pytest.raises(exceptions.SkyTpuError):
+        sky.launch(task, cluster_name='dkfail')

@@ -1,0 +1,40 @@
+"""Every shipped example YAML must parse, validate, and optimize
+(the reference's dryrun layer over examples/)."""
+import glob
+import os
+
+import pytest
+
+import skypilot_tpu as sky
+
+EXAMPLES = sorted(glob.glob(os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    'examples', '*.yaml')))
+
+
+@pytest.mark.parametrize('path', EXAMPLES, ids=os.path.basename)
+def test_example_parses_and_optimizes(path, tmp_home):
+    task = sky.Task.from_yaml(path)
+    assert task.name
+    # Service specs validate on parse (serve recipe).
+    if 'serve' in path:
+        assert task.service is not None
+    # Feasibility: every example must resolve to a priced TPU offering
+    # (local-cloud examples resolve to the free local offering).
+    from skypilot_tpu.optimizer import Optimizer
+    Optimizer.optimize_task(task, quiet=True)
+    assert task.best_resources is not None
+
+
+def test_multislice_example_requests_two_slices(tmp_home):
+    path = [p for p in EXAMPLES if 'multislice' in p][0]
+    task = sky.Task.from_yaml(path)
+    res = list(task.resources)[0]
+    assert res.num_slices == 2
+
+
+def test_docker_example_image(tmp_home):
+    path = [p for p in EXAMPLES if 'docker' in p][0]
+    task = sky.Task.from_yaml(path)
+    res = list(task.resources)[0]
+    assert res.docker_image and res.docker_image.startswith('us-docker')
