@@ -32,7 +32,7 @@ def main() -> None:
         config = llama.LlamaConfig(
             vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
             n_kv_heads=8, d_ff=5632, max_seq_len=2048,
-            dtype=jnp.bfloat16, remat=True)
+            dtype=jnp.bfloat16, remat=True, remat_policy='dots')
         batch_size, seq, steps = 8, 1024, 12
     else:  # CPU smoke fallback so the bench always emits a line
         config = llama.LLAMA_DEBUG

@@ -1,0 +1,91 @@
+"""Perf experiment harness for the bench model (not shipped in bench.py).
+
+Runs the bench llama config on the local chip with toggleable variants and
+prints one JSON line per variant so wins can be cherry-picked into the
+library defaults.
+
+Usage: python scripts/perf_sweep.py v0 fused_ce ...
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import MeshConfig, make_mesh
+from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
+
+
+def fused_ce_loss(params, batch, config):
+    """llama.loss_fn adopted the logsumexp form this variant A/B-tested;
+    keep the name so old sweep invocations still run, same code now."""
+    return llama.loss_fn(params, batch, config)
+
+
+def run(name: str, config, loss, batch_size=8, seq=1024, steps=12):
+    n_chips = len(jax.devices())
+    mesh = make_mesh(MeshConfig(fsdp=n_chips))
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    trainer = Trainer(loss, params, mesh, sharding_lib.LLAMA_RULES,
+                      TrainConfig(warmup_steps=2, total_steps=steps))
+    batches = synthetic_batches(batch_size, seq, config.vocab_size)
+    summary = trainer.fit(batches, steps, log_every=0,
+                          tokens_per_batch=batch_size * seq)
+    tok_s = summary['tokens_per_sec'] / n_chips
+    n_params = config.num_params()
+    mfu = tok_s * 6 * n_params / 197e12
+    print(json.dumps({'variant': name, 'tok_s_chip': round(tok_s, 1),
+                      'mfu_pct': round(100 * mfu, 1),
+                      'step_s': round(summary['step_time_s'], 4),
+                      'loss': round(summary['loss'], 4),
+                      'bs': batch_size}), flush=True)
+
+
+BASE = llama.LlamaConfig(
+    vocab_size=32768, d_model=2048, n_layers=16, n_heads=16,
+    n_kv_heads=8, d_ff=5632, max_seq_len=2048, dtype=jnp.bfloat16,
+    remat=True)
+
+
+def main():
+    which = set(sys.argv[1:]) or {'v0'}
+    base_loss = lambda p, b: llama.loss_fn(p, b, BASE)
+    fused = lambda p, b: fused_ce_loss(p, b, BASE)
+    if 'v0' in which:
+        run('v0_baseline', BASE, base_loss)
+    if 'fused_ce' in which:
+        run('fused_ce', BASE, fused)
+    if 'noremat' in which:
+        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat': False})
+        run('noremat_fused', cfg,
+            lambda p, b: fused_ce_loss(p, b, cfg))
+    if 'bs16' in which:
+        run('bs16_fused', BASE, fused, batch_size=16)
+    if 'bs16_noremat' in which:
+        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat': False})
+        run('bs16_noremat', cfg,
+            lambda p, b: fused_ce_loss(p, b, cfg), batch_size=16)
+    if 'seq2048' in which:
+        run('seq2048_fused', BASE, fused, batch_size=4, seq=2048)
+    if 'dots' in which:
+        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat_policy': 'dots'})
+        run('dots_fused', cfg, lambda p, b: fused_ce_loss(p, b, cfg))
+    if 'dots_bs16' in which:
+        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat_policy': 'dots'})
+        run('dots_bs16', cfg, lambda p, b: fused_ce_loss(p, b, cfg),
+            batch_size=16)
+    if 'dots_bs12' in which:
+        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat_policy': 'dots'})
+        run('dots_bs12', cfg, lambda p, b: fused_ce_loss(p, b, cfg),
+            batch_size=12)
+
+
+if __name__ == '__main__':
+    main()

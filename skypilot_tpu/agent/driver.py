@@ -47,20 +47,34 @@ def _docker_wrap(cmd: str, env: Dict[str, str], container: str,
     """Run `cmd` inside the runtime container as a session leader whose
     pgid is recorded at /tmp/<tag>.pid, so cancel can kill the WHOLE
     in-container group (killing the docker-exec client alone would leave
-    the workload running and holding the TPU)."""
-    exports = ' '.join(
-        f'export {k}={shlex.quote(v)};' for k, v in env.items())
-    cd = (f'cd {shlex.quote(workdir)} 2>/dev/null || true; '
-          if workdir else '')
-    inner = (f'echo $$ > /tmp/{tag}.pid; {cd}{exports} {cmd}')
+    the workload running and holding the TPU).  The <tag>.cancel marker
+    closes the start/cancel race: if the kill fires before the pid file
+    exists, the marker is already down and the late-starting shell exits
+    instead of running the workload unkillable."""
+    from skypilot_tpu.utils.command_runner import shell_exports
+    cd = (f'cd {shlex.quote(workdir)} || exit 254; ' if workdir else '')
+    inner = (f'echo $$ > /tmp/{tag}.pid; '
+             f'[ ! -e /tmp/{tag}.cancel ] || exit 137; '
+             f'{cd}{shell_exports(env)}{cmd}')
     return (f'sudo docker exec {shlex.quote(container)} setsid '
             f'/bin/bash -c {shlex.quote(inner)}')
 
 
 def _docker_kill_cmd(container: str, tag: str) -> str:
+    # Marker first (see _docker_wrap), then kill the recorded group.
     return (f'sudo docker exec {shlex.quote(container)} /bin/bash -c '
-            f'"kill -TERM -- -\\$(cat /tmp/{tag}.pid) 2>/dev/null; '
+            f'"touch /tmp/{tag}.cancel; '
+            f'kill -TERM -- -\\$(cat /tmp/{tag}.pid) 2>/dev/null; '
             f'rm -f /tmp/{tag}.pid" 2>/dev/null || true')
+
+
+def _docker_cleanup_cmd(container: str, tag: str) -> str:
+    """Reap the pid/cancel files after a rank exits on its own: a stale
+    pid file + in-container PID reuse would make a later gang-cancel
+    SIGTERM an unrelated process group."""
+    return (f'sudo docker exec {shlex.quote(container)} /bin/bash -c '
+            f'"rm -f /tmp/{tag}.pid /tmp/{tag}.cancel" '
+            f'2>/dev/null || true')
 
 
 def _rank_argv(host: Dict[str, Any], cmd: str, env: Dict[str, str],
@@ -77,15 +91,19 @@ def _rank_argv(host: Dict[str, Any], cmd: str, env: Dict[str, str],
     if ssh is None:
         # Local host (the `local` cloud, or the head itself on GCP).
         return (['/bin/bash', '-c', cmd], host.get('workdir'), env)
-    from skypilot_tpu.utils.command_runner import build_ssh_argv
-    exports = ' '.join(
-        f'export {k}={shlex.quote(v)};' for k, v in env.items())
+    from skypilot_tpu.utils.command_runner import (build_ssh_argv,
+                                                   shell_exports)
+    # Relative workdir resolves from the ssh login dir ($HOME), where
+    # sync_workdir rsyncs to.  Docker ranks cd inside _docker_wrap.
+    wd = host.get('workdir')
+    cd = (f'cd {shlex.quote(wd)} || exit 254; '
+          if wd and docker_container is None else '')
     # -tt: force a tty so the remote side gets SIGHUP (and dies) when the
     # local ssh client is killed during gang-cancel.
     argv = build_ssh_argv(
         host['internal_ip'], user=ssh['user'],
         key_path=ssh.get('key_path'), port=ssh.get('port', 22),
-    ) + ['-tt', 'bash', '-c', shlex.quote(exports + ' ' + cmd)]
+    ) + ['-tt', 'bash', '-c', shlex.quote(cd + shell_exports(env) + cmd)]
     return (argv, None, None)
 
 
@@ -119,12 +137,19 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             slice_id=rank // hosts_per_slice))
         container = spec.get('docker_container')
         if container:
-            tag = f'skytpu-job{job_id}-rank{rank}'
+            # Unique per submission: job ids restart at 1 per cluster
+            # agent, and stale cancel markers in the long-lived
+            # container's /tmp must never match a future job's tag.
+            uniq = ''.join(c if c.isalnum() or c in '-_' else '-'
+                           for c in str(spec.get('task_id') or job_id))
+            tag = f'skytpu-{uniq}-rank{rank}'
+            kill_argv = _host_shell_argv(
+                hosts[rank], _docker_kill_cmd(container, tag))
             with lock:
-                _DOCKER_KILLS.append(_host_shell_argv(
-                    hosts[rank], _docker_kill_cmd(container, tag)))
+                _DOCKER_KILLS.append(kill_argv)
         else:
             tag = ''
+            kill_argv = None
         argv, cwd, env_overlay = _rank_argv(
             hosts[rank], cmd, env, docker_container=container,
             docker_tag=tag)
@@ -158,6 +183,21 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
                 os.remove(os.path.join(log_dir, f'rank-{rank}.pid'))
             except OSError:
                 pass
+            if container and not _KILL_INITIATED.is_set():
+                # Rank exited on its own: reap the in-container pid file
+                # and drop this rank's kill from the cancel list.  After
+                # a driver-initiated kill this must NOT run — the client
+                # proc dies first and reaping here would race
+                # _kill_in_container out of the pid it is about to kill.
+                with lock:
+                    if kill_argv in _DOCKER_KILLS:
+                        _DOCKER_KILLS.remove(kill_argv)
+                try:
+                    subprocess.run(_host_shell_argv(
+                        hosts[rank], _docker_cleanup_cmd(container, tag)),
+                        timeout=30, capture_output=True, check=False)
+                except (subprocess.TimeoutExpired, OSError):
+                    pass
             returncodes[rank] = rc
             if rc != 0:
                 failed_event.set()
@@ -170,6 +210,7 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
     # Monitor: first failure cancels the rest (gang semantics).
     while any(t.is_alive() for t in threads):
         if failed_event.is_set():
+            _KILL_INITIATED.set()
             with lock:
                 for p in procs:
                     if p is not None and p.poll() is None:
@@ -202,18 +243,34 @@ _LIVE_PROCS: List[subprocess.Popen] = []
 # exec CLIENT does not stop the exec'd process, so cancel must also kill
 # the recorded in-container process group.
 _DOCKER_KILLS: List[List[str]] = []
+# Set the moment the driver starts killing ranks (gang failure or
+# SIGTERM): rank threads must then leave in-container pid files for the
+# kill path instead of reaping them.
+_KILL_INITIATED = threading.Event()
 
 
 def _kill_in_container() -> None:
-    for argv in list(_DOCKER_KILLS):
+    """Fan out the per-rank in-container kills: sequential 30s-timeout
+    ssh+docker execs would make a large-gang cancel O(hosts) slow while
+    surviving ranks hold TPU chips."""
+    kills = list(_DOCKER_KILLS)
+    if not kills:
+        return
+
+    def _one(argv: List[str]) -> None:
         try:
             subprocess.run(argv, timeout=30, capture_output=True,
                            check=False)
         except (subprocess.TimeoutExpired, OSError):
             pass
 
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(max_workers=min(32, len(kills))) as ex:
+        list(ex.map(_one, kills))
+
 
 def _kill_ranks(*_args) -> None:
+    _KILL_INITIATED.set()
     for p in list(_LIVE_PROCS):
         if p.poll() is None:
             try:

@@ -148,29 +148,53 @@ class TpuBackend:
                     f'failed on hosts {bad}.')
             volumes_core.mark_attached(volume_name, handle.cluster_name)
 
+    @staticmethod
+    def _uses_docker_runtime(handle: state.ClusterHandle) -> bool:
+        """docker: image → exec inside the per-host runtime container —
+        except on kubernetes, where the image IS the pod image and no
+        docker daemon exists inside the pod."""
+        return bool(handle.launched_resources.docker_image
+                    and handle.cluster_info.cloud != 'kubernetes')
+
+    def _host_workdir(self, handle: state.ClusterHandle,
+                      task: task_lib.Task, inst) -> Optional[str]:
+        """Where this host's synced workdir lives: per-host dir on the
+        local cloud, $HOME-relative elsewhere (matches sync_workdir's
+        rsync target)."""
+        if not task.workdir:
+            return None
+        if handle.cluster_info.cloud == 'local':
+            return os.path.join(inst.workdir, _WORKDIR_NAME)
+        return _WORKDIR_NAME
+
     # ---- setup -----------------------------------------------------------
     def setup(self, handle: state.ClusterHandle, task: task_lib.Task,
               ) -> None:
         if not task.setup:
             return
-        runners = provisioner._make_runners(handle.cluster_info)
+        info = handle.cluster_info
+        runners = provisioner._make_runners(info)
         log_dir = os.path.expanduser(
             f'~/.skypilot_tpu/logs/{handle.cluster_name}/setup')
         os.makedirs(log_dir, exist_ok=True)
         envs = task.envs_and_secrets
-        setup_cmd = task.setup
-        if handle.launched_resources.docker_image:
+        workdirs = [self._host_workdir(handle, task, inst)
+                    for inst in info.instances]
+        if self._uses_docker_runtime(handle):
             # Setup must land in the SAME environment run executes in —
             # pip installs on the host would be invisible in-container.
-            import shlex as shlex_lib
             from skypilot_tpu.provision import docker_utils
-            exports = ' '.join(
-                f'export {k}={shlex_lib.quote(v)};'
-                for k, v in envs.items())
-            setup_cmd = docker_utils.wrap_command_in_container(
-                exports + ' ' + setup_cmd)
+            cmds = [docker_utils.wrap_command_in_container(
+                        task.setup, workdir=wd, env=envs)
+                    for wd in workdirs]
+            cwds = [None] * len(runners)
+            env_arg = None  # exports ride inside the exec
+        else:
+            cmds = [task.setup] * len(runners)
+            cwds = workdirs
+            env_arg = envs
         rcs = runner_lib.run_on_hosts_parallel(
-            runners, setup_cmd, env=envs, log_dir=log_dir)
+            runners, cmds, env=env_arg, cwds=cwds, log_dir=log_dir)
         bad = {i: rc for i, rc in enumerate(rcs) if rc != 0}
         if bad:
             raise exceptions.CommandError(
@@ -200,6 +224,7 @@ class TpuBackend:
                                    if task.workdir else inst.workdir)
                 host['ssh'] = None
             else:
+                host['workdir'] = self._host_workdir(handle, task, inst)
                 host['ssh'] = {'user': info.ssh_user,
                                'key_path': info.ssh_key_path,
                                'port': inst.ssh_port}
@@ -216,7 +241,7 @@ class TpuBackend:
             'num_chips_per_node': handle.num_chips_per_host,
             'num_slices': handle.num_slices,
         }
-        if handle.launched_resources.docker_image:
+        if self._uses_docker_runtime(handle):
             from skypilot_tpu.provision import docker_utils
             spec['docker_container'] = docker_utils.CONTAINER_NAME
         client = AgentClient(handle.agent_url())

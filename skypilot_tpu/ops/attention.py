@@ -5,8 +5,9 @@ kernel using the canonical TPU online-softmax pattern: grid
 (batch, heads, q_blocks, k_blocks) with the innermost k dimension iterated
 sequentially so VMEM scratch (running max / normalizer / accumulator)
 persists across k blocks; causal blocks with j > i are predicated off
-entirely, halving FLOPs.  Backward recomputes attention in plain XLA
-(fused adequately; a Pallas backward is a later optimization).
+entirely, halving FLOPs.  Backward is two blocked Pallas kernels (dq, and
+dk/dv) that recompute scores from the saved logsumexp, so no (S, S)
+tensor ever touches HBM; all dots are bf16-in/f32-accumulate.
 
 Supports GQA (fewer KV heads than Q heads) via the kernel's KV index map.
 
@@ -33,9 +34,14 @@ def _pick_block(seq_len: int) -> Optional[int]:
     return None
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
-                      m_scr, l_scr, acc_scr,
-                      *, scale: float, block: int, causal: bool):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                      scale: float, block: int, causal: bool,
+                      need_lse: bool):
+    if need_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
     i = pl.program_id(2)
     j = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -77,11 +83,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
     @pl.when(j == last_j)
     def _finalize():
         o_ref[0, 0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
+        if need_lse:
+            lse_ref[0, 0] = m_scr[:] + jnp.log(l_scr[:])
 
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array,
-               causal: bool, block: int, interpret: bool) -> jax.Array:
-    """q: (B, H, S, D); k/v: (B, KV, S, D) → (B, H, S, D)."""
+               causal: bool, block: int, interpret: bool,
+               need_lse: bool = True):
+    """q: (B, H, S, D); k/v: (B, KV, S, D) → ((B, H, S, D), lse|None).
+
+    lse is (B, H, S, 128) f32 with all lanes equal (the layout the TPU
+    tiling wants for a per-row scalar: lane-broadcast, like the bundled
+    jax flash kernel's l/m residuals).  Inference callers pass
+    need_lse=False: Pallas outputs are not DCE'd, so an unused lse would
+    still cost its HBM writes every decode step."""
     batch, num_heads, seq_len, head_dim = q.shape
     num_kv = k.shape[1]
     group = num_heads // num_kv
@@ -89,9 +104,20 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array,
     nq = seq_len // block
     grid = (batch, num_heads, nq, nq)
 
+    o_spec = pl.BlockSpec((1, 1, block, head_dim),
+                          lambda b, h, i, j: (b, h, i, 0))
+    o_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    out_specs = [o_spec]
+    out_shape = [o_shape]
+    if need_lse:
+        out_specs.append(pl.BlockSpec((1, 1, block, 128),
+                                      lambda b, h, i, j: (b, h, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct(
+            (batch, num_heads, seq_len, 128), jnp.float32))
+
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, block=block,
-                               causal=causal)
-    return pl.pallas_call(
+                               causal=causal, need_lse=need_lse)
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -102,9 +128,8 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, 1, block, head_dim),
                          lambda b, h, i, j: (b, h // group, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block, head_dim),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block, 128), jnp.float32),
             pltpu.VMEM((block, 128), jnp.float32),
@@ -112,6 +137,160 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         interpret=interpret,
     )(q, k, v)
+    return (outs[0], outs[1]) if need_lse else (outs[0], None)
+
+
+def _masked_scores(q_blk, k_blk, scale, causal, i, j, block):
+    """s = scale * q k^T with the causal mask applied (f32, (Bq, Bk))."""
+    s = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        mask = (i * block + row) >= (j * block + col)
+        s = jnp.where(mask, s, _NEG_INF)
+    return s
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_scr,
+                         *, scale: float, block: int, causal: bool):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    compute = (j <= i) if causal else (j >= 0)
+
+    @pl.when(compute)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = _masked_scores(q, k, scale, causal, i, j, block)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])          # (Bq, Bk) f32
+        do = do_ref[0, 0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (Bq, Bk)
+        ds = (p * (dp - delta_ref[0, 0][:, :1])).astype(q.dtype)
+        acc_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (Bq, D)
+
+    last_j = i if causal else nk - 1
+
+    @pl.when(j == last_j)
+    def _finalize():
+        dq_ref[0, 0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr,
+                          *, scale: float, block: int, causal: bool):
+    j = pl.program_id(2)   # kv block
+    i = pl.program_id(3)   # q block (innermost, sequential)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    compute = (i >= j) if causal else (i >= 0)
+
+    @pl.when(compute)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = _masked_scores(q, k, scale, causal, i, j, block)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])          # (Bq, Bk) f32
+        do = do_ref[0, 0]
+        p_lo = p.astype(q.dtype)
+        dv_scr[:] += jax.lax.dot_general(
+            p_lo, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (Bk, D)
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (Bq, Bk)
+        ds = (p * (dp - delta_ref[0, 0][:, :1])).astype(q.dtype)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (Bk, D)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal: bool, block: int,
+               interpret: bool):
+    """All of q/o/g: (B, H, S, D); k/v: (B, KV, S, D); lse (B, H, S, 128).
+
+    Returns (dq (B,H,S,D), dk (B,KV,S,D), dv (B,KV,S,D)).  Per-q-head
+    dk/dv partials are summed over the GQA group outside the kernel."""
+    batch, num_heads, seq_len, head_dim = q.shape
+    num_kv = k.shape[1]
+    group = num_heads // num_kv
+    scale = head_dim ** -0.5
+    nq = seq_len // block
+
+    delta = jnp.broadcast_to(
+        jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1,
+                keepdims=True), lse.shape)
+
+    qspec = pl.BlockSpec((1, 1, block, head_dim),
+                         lambda b, h, i, j: (b, h, i, 0))
+    kvspec = pl.BlockSpec((1, 1, block, head_dim),
+                          lambda b, h, i, j: (b, h // group, j, 0))
+    lmspec = pl.BlockSpec((1, 1, block, 128),
+                          lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, block=block,
+                          causal=causal),
+        grid=(batch, num_heads, nq, nq),
+        in_specs=[qspec, kvspec, kvspec, qspec, lmspec, lmspec],
+        out_specs=pl.BlockSpec((1, 1, block, head_dim),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv: grid is (b, h, kv-block, q-block) — q innermost so the
+    # accumulators persist across the i sweep for a fixed kv block.
+    qspec_i = pl.BlockSpec((1, 1, block, head_dim),
+                           lambda b, h, j, i: (b, h, i, 0))
+    kvspec_j = pl.BlockSpec((1, 1, block, head_dim),
+                            lambda b, h, j, i: (b, h // group, j, 0))
+    lmspec_i = pl.BlockSpec((1, 1, block, 128),
+                            lambda b, h, j, i: (b, h, i, 0))
+    out_j = pl.BlockSpec((1, 1, block, head_dim),
+                         lambda b, h, j, i: (b, h, j, 0))
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, block=block,
+                          causal=causal),
+        grid=(batch, num_heads, nq, nq),
+        in_specs=[kvspec_j, kvspec_j, qspec_i, qspec_i, lmspec_i, lmspec_i],
+        out_specs=[out_j, out_j],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block, head_dim), jnp.float32),
+                        pltpu.VMEM((block, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(k, v, q, g, lse, delta)
+
+    if group != 1:
+        dk = dk_h.reshape(batch, num_kv, group, seq_len, head_dim).sum(2)
+        dv = dv_h.reshape(batch, num_kv, group, seq_len, head_dim).sum(2)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk, dv
 
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -144,6 +323,10 @@ def _use_pallas(q: jax.Array, force: Optional[bool]) -> bool:
     return _pick_block(seq_len) is not None and head_dim % 128 == 0
 
 
+# Set True in tests to run the kernels in interpret mode on CPU.
+_INTERPRET = False
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_attention_vjp(q, k, v, causal):
     # (B, S, H, D) → kernel layout (B, H, S, D) and back.
@@ -151,17 +334,39 @@ def _flash_attention_vjp(q, k, v, causal):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     block = _pick_block(qt.shape[2])
-    out = _flash_fwd(qt, kt, vt, causal, block, interpret=False)
+    out, _ = _flash_fwd(qt, kt, vt, causal, block, interpret=_INTERPRET,
+                        need_lse=False)
     return jnp.swapaxes(out, 1, 2)
 
 
 def _vjp_fwd(q, k, v, causal):
-    return _flash_attention_vjp(q, k, v, causal), (q, k, v)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    block = _pick_block(qt.shape[2])
+    ot, lse = _flash_fwd(qt, kt, vt, causal, block, interpret=_INTERPRET)
+    return jnp.swapaxes(ot, 1, 2), (qt, kt, vt, ot, lse)
 
 
 def _vjp_bwd(causal, residuals, g):
-    # Recompute-based backward in f32 (XLA-fused).  O(S^2) transient per
-    # (batch, head) — acceptable under per-layer remat; Pallas bwd later.
+    # Blocked Pallas backward: recomputes scores per (q-block, k-block)
+    # pair from the saved lse, so no (S, S) tensor ever reaches HBM, and
+    # all dots run bf16-in/f32-accumulate at full MXU rate.
+    qt, kt, vt, ot, lse = residuals
+    gt = jnp.swapaxes(g, 1, 2)
+    block = _pick_block(qt.shape[2])
+    dq, dk, dv = _flash_bwd(qt, kt, vt, ot, lse, gt, causal, block,
+                            interpret=_INTERPRET)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+def _xla_attention_bwd(causal, residuals, g):
+    # Plain-XLA recompute backward: the non-Pallas reference used for
+    # correctness tests of the kernel backward.  O(S^2) transient per
+    # (batch, head).  Dots keep bf16 operands with f32 accumulation
+    # (preferred_element_type): the MXU runs at full bf16 rate (4x the
+    # f32 rate on v5e) while softmax math stays f32.
     q, k, v = residuals
     num_heads, num_kv = q.shape[2], k.shape[2]
     group = num_heads // num_kv
@@ -172,20 +377,23 @@ def _vjp_bwd(causal, residuals, g):
         k_full, v_full = k, v
     seq_len, head_dim = q.shape[1], q.shape[3]
     scale = head_dim ** -0.5
-    qf = q.astype(jnp.float32)
-    kf = k_full.astype(jnp.float32)
-    vf = v_full.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    s = jnp.einsum('bqhd,bkhd->bhqk', qf, kf) * scale
+    f32 = jnp.float32
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k_full,
+                   preferred_element_type=f32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    dv = jnp.einsum('bhqk,bqhd->bkhd', p, gf)
-    dp = jnp.einsum('bqhd,bkhd->bhqk', gf, vf)
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
-    dq = jnp.einsum('bhqk,bkhd->bqhd', ds, kf) * scale
-    dk = jnp.einsum('bhqk,bqhd->bkhd', ds, qf) * scale
+    p_lo = p.astype(q.dtype)
+    dv = jnp.einsum('bhqk,bqhd->bkhd', p_lo, g, preferred_element_type=f32)
+    dp = jnp.einsum('bqhd,bkhd->bhqk', g, v_full,
+                    preferred_element_type=f32)
+    ds = (p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+          ).astype(q.dtype)
+    dq = jnp.einsum('bhqk,bkhd->bqhd', ds, k_full,
+                    preferred_element_type=f32) * scale
+    dk = jnp.einsum('bhqk,bqhd->bkhd', ds, q,
+                    preferred_element_type=f32) * scale
     if group != 1:
         batch = k.shape[0]
         dk = dk.reshape(batch, seq_len, num_kv, group, head_dim).sum(3)

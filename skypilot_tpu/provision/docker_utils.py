@@ -38,11 +38,15 @@ def initialize_docker_command(image: str) -> str:
     # Reuse a container only if it runs the requested image AND is
     # actually running — a stop/start cycle leaves it Exited, and an
     # image change must not silently keep the old runtime.
+    # `image` comes from user YAML: it must be quoted in the comparison
+    # too, not only in the pull/run lines, or metacharacters in image_id
+    # would expand inside this (sudo'd) shell command.
+    want = shlex.quote(f'{image} true')
     start = (
         f'current=$(sudo docker inspect --format '
         f'"{{{{.Config.Image}}}} {{{{.State.Running}}}}" {name} '
         f'2>/dev/null || true); '
-        f'if [ "$current" != "{image} true" ]; then '
+        f'if [ "$current" != {want} ]; then '
         f'sudo docker rm -f {name} >/dev/null 2>&1 || true; '
         f'sudo docker pull {img} && '
         f'sudo docker run -d --name {name} --privileged --net=host '
@@ -53,11 +57,19 @@ def initialize_docker_command(image: str) -> str:
     return f'({install}) && {start}'
 
 
-def wrap_command_in_container(cmd: str) -> str:
+def wrap_command_in_container(cmd: str, workdir: Optional[str] = None,
+                              env: Optional[dict] = None) -> str:
     """Wrap a shell command so it executes inside the runtime container.
 
-    The full command (env exports included) must be inside the `docker
-    exec`: the container does not inherit the host process environment.
+    `env` exports ride INSIDE the `docker exec`: the container does not
+    inherit the host process environment.  `workdir` (relative to $HOME,
+    which is bind-mounted at the same path) is cd'ed into first so
+    relative paths resolve exactly as they do for the non-docker setup
+    path, whose runner sets cwd.
     """
+    from skypilot_tpu.utils.command_runner import shell_exports
+    cmd = shell_exports(env) + cmd
+    if workdir:
+        cmd = f'cd {shlex.quote(workdir)} || exit 254; {cmd}'
     return (f'sudo docker exec {shlex.quote(CONTAINER_NAME)} '
             f'/bin/bash -c {shlex.quote(cmd)}')

@@ -260,21 +260,35 @@ class KubernetesCommandRunner(CommandRunner):
         return self.run('true', timeout=20) == 0
 
 
-def run_on_hosts_parallel(runners: List[CommandRunner], cmd: str, *,
+def shell_exports(env: Optional[Dict[str, str]]) -> str:
+    """`export K=V;` prefix for embedding env in a shell command string
+    (the in-container / over-ssh path where process env doesn't reach)."""
+    if not env:
+        return ''
+    return ' '.join(f'export {k}={shlex.quote(v)};'
+                    for k, v in env.items()) + ' '
+
+
+def run_on_hosts_parallel(runners: List[CommandRunner],
+                          cmd: Union[str, List[str]], *,
                           env: Optional[Dict[str, str]] = None,
+                          cwds: Optional[List[Optional[str]]] = None,
                           log_dir: Optional[str] = None,
                           timeout: Optional[float] = None,
                           max_workers: int = 32) -> List[int]:
-    """Run the same command on many hosts concurrently (the 64-host fan-out
-    path; mirrors instance_setup._parallel_ssh_with_cache :153)."""
+    """Run a command on many hosts concurrently (the 64-host fan-out
+    path; mirrors instance_setup._parallel_ssh_with_cache :153).  `cmd`
+    may be per-host (a list matching `runners`), as may `cwds`."""
     import concurrent.futures as cf
     results: List[int] = [255] * len(runners)
 
     def _one(i: int) -> None:
         log_path = (os.path.join(log_dir, f'host-{i}.log')
                     if log_dir else None)
-        results[i] = runners[i].run(cmd, env=env, log_path=log_path,
-                                    timeout=timeout)
+        host_cmd = cmd[i] if isinstance(cmd, list) else cmd
+        results[i] = runners[i].run(host_cmd, env=env,
+                                    cwd=cwds[i] if cwds else None,
+                                    log_path=log_path, timeout=timeout)
 
     with cf.ThreadPoolExecutor(max_workers=min(max_workers,
                                                len(runners))) as ex:

@@ -76,8 +76,12 @@ def main():
         inner = argv[argv.index('-c') + 1]
         env = dict(os.environ)
         env['SKYTPU_IN_FAKE_CONTAINER'] = '1'
-        return subprocess.run(['/bin/bash', '-c', inner],
-                              env=env).returncode
+        # Honor setsid: the real docker exec runs `setsid /bin/bash -c`
+        # so the recorded $$ is a process-GROUP id — the cancel test's
+        # killpg is meaningless unless the fake preserves that.
+        new_session = 'setsid' in argv
+        return subprocess.run(['/bin/bash', '-c', inner], env=env,
+                              start_new_session=new_session).returncode
     return 0
 
 

@@ -150,14 +150,22 @@ def test_cancel_kills_in_container_group(iso_state,  # noqa: F811
     task = sky.Task(run='sleep 300', name='t')
     task.set_resources(sky.Resources(cloud='local',
                                      image_id='docker:test/img:1'))
+    # Reap stale pid files from prior interrupted runs: the glob below
+    # scans the real shared /tmp, and a stale (dead-pid) file would make
+    # the killpg poll pass vacuously.
+    import glob
+    for stale in glob.glob('/tmp/skytpu-dkcancel-*'):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
     job_id, _ = sky.launch(task, cluster_name='dkcancel',
                            detach_run=True)
     try:
         deadline = time.time() + 60
         pid = None
         while time.time() < deadline and pid is None:
-            import glob
-            pids = glob.glob(f'/tmp/skytpu-job{job_id}-rank0.pid')
+            pids = glob.glob(f'/tmp/skytpu-dkcancel-*-rank0.pid')
             if pids:
                 pid = int(open(pids[0]).read().strip())
             else:
