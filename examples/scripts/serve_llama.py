@@ -1,11 +1,21 @@
-"""Minimal TPU inference server for the serving recipe.
+"""TPU inference server for the serving recipe.
 
 The replica process behind examples/serve_llama.yaml: aiohttp app with
-/health (readiness probe target) and /generate (greedy decode).  Analog
-of the reference's vLLM replica (llm/vllm/service.yaml) at recipe scale:
-real model, real TPU forward pass, token-by-token greedy decoding with a
-jitted step.  Production serving would add KV-cache decode and
-continuous batching; this keeps the recipe self-contained.
+/health (readiness probe target) and /generate, backed by the framework's
+KV-cache engine (skypilot_tpu.infer.Generator) — bucketed prefill, one
+compiled decode shape, in-step sampling.  Analog of the reference's vLLM
+replica (llm/vllm/service.yaml).
+
+Requests (POST /generate, JSON):
+  {"prompt_ids": [1, 2, 3], "max_new_tokens": 32, "seed": 7}
+                                      — token ids in [0, vocab)
+  {"prompt": "text", ...}             — demo byte-level tokenizer
+                                        (utf-8 bytes mod vocab; there is
+                                        no bundled trained tokenizer)
+One of prompt_ids / prompt is required; malformed requests are a 400,
+never silently defaulted.  Sampling temperature is a server flag
+(--temperature): the engine compiles it into the decode step, so it is
+per-replica, not per-request.
 """
 from __future__ import annotations
 
@@ -14,13 +24,11 @@ import asyncio
 import json
 import time
 
-from aiohttp import web
 
-
-def build_model(model_size: str):
+def build_generator(model_size: str, max_seq_len: int, temperature: float):
     import jax
-    import jax.numpy as jnp
 
+    from skypilot_tpu.infer import Generator, GeneratorConfig
     from skypilot_tpu.models import llama
 
     config = {
@@ -29,13 +37,10 @@ def build_model(model_size: str):
         '8b': llama.LLAMA3_8B,
     }[model_size]
     params = llama.init_params(config, jax.random.PRNGKey(0))
-
-    @jax.jit
-    def next_token(params, tokens):
-        logits = llama.forward(params, tokens, config)
-        return jnp.argmax(logits[:, -1, :], axis=-1)
-
-    return params, config, next_token
+    max_seq_len = min(max_seq_len, config.max_seq_len)
+    gen = Generator(params, config, GeneratorConfig(
+        max_seq_len=max_seq_len, batch_size=1, temperature=temperature))
+    return gen, config
 
 
 def main() -> int:
@@ -43,37 +48,61 @@ def main() -> int:
     parser.add_argument('--port', type=int, default=8080)
     parser.add_argument('--model-size', default='debug')
     parser.add_argument('--max-new-tokens', type=int, default=16)
+    parser.add_argument('--max-seq-len', type=int, default=1024)
+    parser.add_argument('--temperature', type=float, default=0.0)
     args = parser.parse_args()
 
-    import jax.numpy as jnp
-    params, config, next_token = build_model(args.model_size)
-    # Warm the compile cache so the readiness probe reflects readiness.
-    next_token(params, jnp.ones((1, 8), dtype=jnp.int32))
+    gen, config = build_generator(args.model_size, args.max_seq_len,
+                                  args.temperature)
+    # Compile prefill + decode now so the readiness probe reflects
+    # readiness instead of the first request eating the compiles.
+    gen.warmup()
+    # One request at a time on the chip (batch_size=1 engine).
+    chip_lock = asyncio.Lock()
 
-    async def health(request: web.Request) -> web.Response:
+    async def health(request):
+        from aiohttp import web
         return web.json_response({'status': 'ok',
                                   'model': args.model_size})
 
-    async def generate(request: web.Request) -> web.Response:
+    async def generate(request):
+        from aiohttp import web
         body = await request.json()
-        prompt_ids = body.get('prompt_ids') or [1, 2, 3]
+        try:
+            if 'prompt_ids' in body:
+                prompt_ids = [int(t) % config.vocab_size
+                              for t in body['prompt_ids']]
+            elif 'prompt' in body:
+                prompt_ids = [b % config.vocab_size
+                              for b in str(body['prompt']).encode('utf-8')]
+            else:
+                return web.json_response(
+                    {'error': "provide 'prompt_ids' (token ids) or "
+                              "'prompt' (text, demo byte tokenizer)"},
+                    status=400)
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {'error': f'malformed prompt_ids: {e}'}, status=400)
+        if not prompt_ids:
+            return web.json_response({'error': 'empty prompt'},
+                                     status=400)
         max_new = min(int(body.get('max_new_tokens',
                                    args.max_new_tokens)), 256)
+        seed = int(body.get('seed', 0))
         t0 = time.monotonic()
-        tokens = jnp.asarray([prompt_ids], dtype=jnp.int32)
-
-        def _decode():
-            out = tokens
-            for _ in range(max_new):
-                nxt = next_token(params, out)
-                out = jnp.concatenate([out, nxt[:, None]], axis=1)
-            return out
-        out = await asyncio.to_thread(_decode)
+        try:
+            async with chip_lock:
+                out = await asyncio.to_thread(
+                    gen.generate, [prompt_ids], max_new, seed)
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
         return web.json_response({
-            'output_ids': out[0].tolist(),
+            'output_ids': out[0],
+            'num_generated': len(out[0]),
             'latency_s': round(time.monotonic() - t0, 3),
         })
 
+    from aiohttp import web
     app = web.Application()
     app.router.add_get('/health', health)
     app.router.add_post('/generate', generate)

@@ -8,7 +8,7 @@ Usage: python scripts/perf_sweep.py v0 fused_ce ...
 """
 from __future__ import annotations
 
-import functools
+import dataclasses
 import json
 import sys
 import time
@@ -63,26 +63,26 @@ def main():
     if 'fused_ce' in which:
         run('fused_ce', BASE, fused)
     if 'noremat' in which:
-        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat': False})
+        cfg = dataclasses.replace(BASE, remat=False)
         run('noremat_fused', cfg,
             lambda p, b: fused_ce_loss(p, b, cfg))
     if 'bs16' in which:
         run('bs16_fused', BASE, fused, batch_size=16)
     if 'bs16_noremat' in which:
-        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat': False})
+        cfg = dataclasses.replace(BASE, remat=False)
         run('bs16_noremat', cfg,
             lambda p, b: fused_ce_loss(p, b, cfg), batch_size=16)
     if 'seq2048' in which:
         run('seq2048_fused', BASE, fused, batch_size=4, seq=2048)
     if 'dots' in which:
-        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat_policy': 'dots'})
+        cfg = dataclasses.replace(BASE, remat_policy='dots')
         run('dots_fused', cfg, lambda p, b: fused_ce_loss(p, b, cfg))
     if 'dots_bs16' in which:
-        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat_policy': 'dots'})
+        cfg = dataclasses.replace(BASE, remat_policy='dots')
         run('dots_bs16', cfg, lambda p, b: fused_ce_loss(p, b, cfg),
             batch_size=16)
     if 'dots_bs12' in which:
-        cfg = llama.LlamaConfig(**{**BASE.__dict__, 'remat_policy': 'dots'})
+        cfg = dataclasses.replace(BASE, remat_policy='dots')
         run('dots_bs12', cfg, lambda p, b: fused_ce_loss(p, b, cfg),
             batch_size=12)
 

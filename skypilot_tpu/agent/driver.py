@@ -183,6 +183,12 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
                 os.remove(os.path.join(log_dir, f'rank-{rank}.pid'))
             except OSError:
                 pass
+            # Record the result BEFORE the (up to 30s) in-container
+            # cleanup exec: a failing rank must trip the gang cancel
+            # immediately, not after a possibly-hanging ssh.
+            returncodes[rank] = rc
+            if rc != 0:
+                failed_event.set()
             if container and not _KILL_INITIATED.is_set():
                 # Rank exited on its own: reap the in-container pid file
                 # and drop this rank's kill from the cancel list.  After
@@ -198,9 +204,6 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
                         timeout=30, capture_output=True, check=False)
                 except (subprocess.TimeoutExpired, OSError):
                     pass
-            returncodes[rank] = rc
-            if rc != 0:
-                failed_event.set()
 
     threads = [threading.Thread(target=_run_rank, args=(r,), daemon=True)
                for r in range(len(hosts))]

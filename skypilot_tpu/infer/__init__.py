@@ -1,0 +1,11 @@
+"""TPU-native inference: KV-cache decode + sampling for the bundled models.
+
+The reference delegates serving compute to vLLM/SGLang/TGI recipes
+(llm/vllm/service.yaml, llm/sglang/, llm/tgi/ — SURVEY.md §2.3 "Inference
+TP"); here the engine is a first-class JAX library the serve recipes run.
+"""
+from skypilot_tpu.infer.engine import (DecodeState, Generator,
+                                       GeneratorConfig)
+from skypilot_tpu.infer.sampling import sample_logits
+
+__all__ = ['DecodeState', 'Generator', 'GeneratorConfig', 'sample_logits']

@@ -1,0 +1,155 @@
+"""KV-cache prefill/decode for the stacked-layer Llama pytree.
+
+Shares parameters and math with skypilot_tpu.models.llama (training path
+untouched) but threads a per-layer KV cache through the layer scan:
+
+- prefill: one causal forward over the (padded) prompt, writing K/V for
+  every layer into a fixed-size cache — static shapes, one compile per
+  prompt bucket.
+- decode_step: one token through all layers, attending over the valid
+  cache prefix with a length mask — a single compiled shape for the whole
+  generation, the property XLA needs (no per-step recompiles).
+
+Cache layout: k/v (L, B, max_len, KV_heads, head_dim), stacked on layers
+like the params so one lax.scan drives both.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import rmsnorm as rmsnorm_ops
+from skypilot_tpu.ops import rope as rope_ops
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(config: llama.LlamaConfig, batch: int,
+               max_len: int) -> Cache:
+    shape = (config.n_layers, batch, max_len, config.n_kv_heads,
+             config.head_dim)
+    return {'k': jnp.zeros(shape, config.dtype),
+            'v': jnp.zeros(shape, config.dtype)}
+
+
+def _qkv(x, attn_p, config):
+    batch, seq, _ = x.shape
+    hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    q = (x @ attn_p['wq']).reshape(batch, seq, nh, hd)
+    k = (x @ attn_p['wk']).reshape(batch, seq, nkv, hd)
+    v = (x @ attn_p['wv']).reshape(batch, seq, nkv, hd)
+    return q, k, v
+
+
+def _mlp(x, mlp_p):
+    gate = jax.nn.silu((x @ mlp_p['w_gate']).astype(jnp.float32)
+                       ).astype(x.dtype)
+    return (gate * (x @ mlp_p['w_up'])) @ mlp_p['w_down']
+
+
+def prefill(params: llama.Params, tokens: jax.Array,
+            config: llama.LlamaConfig, cache: Cache,
+            lengths: jax.Array) -> Tuple[jax.Array, Cache]:
+    """tokens (B, S) padded; lengths (B,) valid prefix lengths.
+
+    Returns (next-token logits (B, vocab) f32 at each row's last valid
+    position, filled cache).  S must be <= cache max_len.
+    """
+    batch, seq = tokens.shape
+    max_len = cache['k'].shape[2]
+    cos, sin = rope_ops.rope_frequencies(config.head_dim, max_len,
+                                         config.rope_theta)
+    h = params['embed'][tokens]
+
+    attention_fn = functools.partial(attention_ops.flash_attention,
+                                     causal=True)
+
+    def layer(h, layer_params):
+        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)
+        q = rope_ops.apply_rope(q, cos[:seq], sin[:seq])
+        k = rope_ops.apply_rope(k, cos[:seq], sin[:seq])
+        o = attention_fn(q, k, v)
+        h = h + (o.reshape(batch, seq, -1) @ attn_p['wo'])
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                 eps=config.norm_eps)
+        h = h + _mlp(x, mlp_p)
+        # Write this layer's K/V into the cache slot (padded region too —
+        # masked out at decode time by the length mask).
+        k_pad = jnp.zeros((batch, max_len) + k.shape[2:], k.dtype
+                          ).at[:, :seq].set(k)
+        v_pad = jnp.zeros((batch, max_len) + v.shape[2:], v.dtype
+                          ).at[:, :seq].set(v)
+        return h, (k_pad, v_pad)
+
+    h, (k_all, v_all) = jax.lax.scan(layer, h, params['layers'])
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    # Logits only at each row's last valid position: avoids the full
+    # (B, S, vocab) matmul during prefill.
+    last = jnp.take_along_axis(
+        h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = (last @ params['lm_head']).astype(jnp.float32)
+    return logits, {'k': k_all, 'v': v_all}
+
+
+def decode_step(params: llama.Params, token: jax.Array,
+                config: llama.LlamaConfig, cache: Cache,
+                positions: jax.Array) -> Tuple[jax.Array, Cache]:
+    """One-token step.  token (B,) int32; positions (B,) — the index the
+    new token occupies (== number of tokens already in the cache).
+
+    Returns (logits (B, vocab) f32, updated cache).
+    """
+    batch = token.shape[0]
+    max_len = cache['k'].shape[2]
+    cos, sin = rope_ops.rope_frequencies(config.head_dim, max_len,
+                                         config.rope_theta)
+    h = params['embed'][token][:, None]            # (B, 1, d)
+    pos = positions[:, None].astype(jnp.int32)      # (B, 1)
+    # Attention mask over cache slots: slot j visible iff j <= pos.
+    slot = jnp.arange(max_len)[None, :]             # (1, max_len)
+    visible = slot <= pos                           # (B, max_len)
+
+    # Scan over layers, threading h; each layer's cache slice rides the
+    # scan xs (stacked on the layer axis like the params) and the
+    # updated slices come back as ys.
+    def scan_body(h, xs):
+        layer_params, k_cache, v_cache = xs
+        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)           # (B, 1, H/KV, D)
+        q = rope_ops.apply_rope(q, cos, sin, positions=pos)
+        k = rope_ops.apply_rope(k, cos, sin, positions=pos)
+        # Insert the new K/V at each row's position.
+        b_idx = jnp.arange(batch)
+        k_cache = k_cache.at[b_idx, positions].set(k[:, 0])
+        v_cache = v_cache.at[b_idx, positions].set(v[:, 0])
+        # GQA attention of the single query over the cache prefix.
+        group = config.n_heads // config.n_kv_heads
+        kf = jnp.repeat(k_cache, group, axis=2)     # (B, max_len, H, D)
+        vf = jnp.repeat(v_cache, group, axis=2)
+        scale = config.head_dim ** -0.5
+        s = jnp.einsum('bqhd,bkhd->bhqk', q, kf,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(visible[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o = jnp.einsum('bhqk,bkhd->bqhd', p, vf)
+        h = h + (o.reshape(batch, 1, -1) @ attn_p['wo'])
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                 eps=config.norm_eps)
+        h = h + _mlp(x, mlp_p)
+        return h, (k_cache, v_cache)
+
+    h, (k_all, v_all) = jax.lax.scan(
+        scan_body, h, (params['layers'], cache['k'], cache['v']))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    logits = (h[:, 0] @ params['lm_head']).astype(jnp.float32)
+    return logits, {'k': k_all, 'v': v_all}

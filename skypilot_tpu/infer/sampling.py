@@ -1,0 +1,55 @@
+"""Token sampling: greedy / temperature / top-k / top-p (nucleus).
+
+Pure function on a (B, vocab) logits batch so it lives inside the jitted
+decode step — no host round-trip per token.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest logits per row, mask the rest to -inf."""
+    kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
+    return jnp.where(logits >= kth, logits, _NEG_INF)
+
+
+def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest prefix of the sorted distribution
+    whose cumulative probability reaches p (the top token always stays)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token i stays if the cumulative mass BEFORE it is < p.
+    keep_sorted = (cum - probs) < p
+    # Threshold = smallest kept logit per row.
+    thresholds = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+        keepdims=True)
+    return jnp.where(logits >= thresholds, logits, _NEG_INF)
+
+
+def sample_logits(logits: jax.Array, rng: jax.Array,
+                  temperature: float = 0.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """logits (B, vocab) f32 → token ids (B,) int32.
+
+    temperature == 0 → greedy argmax (rng unused).  top_k/top_p compose
+    (k-filter first, then nucleus), matching the usual serving semantics.
+    Static python args: each (temperature, top_k, top_p) combination is
+    its own compiled step.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        logits = _mask_top_k(logits, top_k)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        logits = _mask_top_p(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

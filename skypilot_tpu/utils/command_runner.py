@@ -21,10 +21,16 @@ logger = sky_logging.init_logger(__name__)
 SSH_CONTROL_PATH = '~/.skypilot_tpu/ssh_control'
 
 
-def _env_prefix(env: Optional[Dict[str, str]]) -> str:
+def shell_exports(env: Optional[Dict[str, str]]) -> str:
+    """`export K=V;` prefix for embedding env in a shell command string
+    (the in-container / over-ssh path where process env doesn't reach)."""
     if not env:
         return ''
-    return ' '.join(f'export {k}={shlex.quote(v)};' for k, v in env.items()) + ' '
+    return ' '.join(f'export {k}={shlex.quote(v)};'
+                    for k, v in env.items()) + ' '
+
+
+_env_prefix = shell_exports
 
 
 class CommandRunner:
@@ -258,15 +264,6 @@ class KubernetesCommandRunner(CommandRunner):
 
     def check_connection(self) -> bool:
         return self.run('true', timeout=20) == 0
-
-
-def shell_exports(env: Optional[Dict[str, str]]) -> str:
-    """`export K=V;` prefix for embedding env in a shell command string
-    (the in-container / over-ssh path where process env doesn't reach)."""
-    if not env:
-        return ''
-    return ' '.join(f'export {k}={shlex.quote(v)};'
-                    for k, v in env.items()) + ' '
 
 
 def run_on_hosts_parallel(runners: List[CommandRunner],

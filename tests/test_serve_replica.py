@@ -1,0 +1,89 @@
+"""Serving replica process end-to-end: boots the real server script on
+the debug model and drives /health + /generate over HTTP."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+SCRIPT = os.path.join(os.path.dirname(__file__), '..', 'examples',
+                      'scripts', 'serve_llama.py')
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope='module')
+def server():
+    port = _free_port()
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [sys.executable, SCRIPT, '--port', str(port),
+         '--model-size', 'debug', '--max-seq-len', '128'],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors='replace')
+            raise RuntimeError(f'server died: {out[-2000:]}')
+        try:
+            with urllib.request.urlopen(base + '/health', timeout=5) as r:
+                if r.status == 200:
+                    break
+        except (urllib.error.URLError, OSError):
+            time.sleep(1.0)
+    else:
+        proc.kill()
+        raise RuntimeError('server never became healthy')
+    yield base
+    proc.terminate()
+    proc.wait(timeout=15)
+
+
+def test_generate_with_prompt_ids(server):
+    status, body = _post(server + '/generate',
+                         {'prompt_ids': [1, 2, 3], 'max_new_tokens': 4})
+    assert status == 200
+    assert len(body['output_ids']) == 4
+    assert body['num_generated'] == 4
+
+
+def test_generate_with_text_prompt(server):
+    status, body = _post(server + '/generate',
+                         {'prompt': 'hello tpu', 'max_new_tokens': 3})
+    assert status == 200
+    assert len(body['output_ids']) == 3
+
+
+def test_generate_missing_prompt_is_400(server):
+    status, body = _post(server + '/generate', {'max_new_tokens': 3})
+    assert status == 400
+    assert 'prompt' in body['error']
+
+
+def test_generate_deterministic_greedy(server):
+    a = _post(server + '/generate', {'prompt_ids': [5, 6, 7]})[1]
+    b = _post(server + '/generate', {'prompt_ids': [5, 6, 7]})[1]
+    assert a['output_ids'] == b['output_ids']
