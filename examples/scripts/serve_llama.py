@@ -60,18 +60,25 @@ def main() -> int:
     # One request at a time on the chip (batch_size=1 engine).
     chip_lock = asyncio.Lock()
 
+    from aiohttp import web
+
     async def health(request):
-        from aiohttp import web
         return web.json_response({'status': 'ok',
                                   'model': args.model_size})
 
     async def generate(request):
-        from aiohttp import web
-        body = await request.json()
+        # Any malformed request is a 400 with a JSON error, never a 500.
         try:
+            body = await request.json()
             if 'prompt_ids' in body:
-                prompt_ids = [int(t) % config.vocab_size
-                              for t in body['prompt_ids']]
+                prompt_ids = [int(t) for t in body['prompt_ids']]
+                bad = [t for t in prompt_ids
+                       if not 0 <= t < config.vocab_size]
+                if bad:
+                    return web.json_response(
+                        {'error': f'prompt_ids out of range '
+                                  f'[0, {config.vocab_size}): {bad[:5]}'},
+                        status=400)
             elif 'prompt' in body:
                 prompt_ids = [b % config.vocab_size
                               for b in str(body['prompt']).encode('utf-8')]
@@ -80,15 +87,15 @@ def main() -> int:
                     {'error': "provide 'prompt_ids' (token ids) or "
                               "'prompt' (text, demo byte tokenizer)"},
                     status=400)
+            max_new = min(int(body.get('max_new_tokens',
+                                       args.max_new_tokens)), 256)
+            seed = int(body.get('seed', 0))
         except (TypeError, ValueError) as e:
             return web.json_response(
-                {'error': f'malformed prompt_ids: {e}'}, status=400)
+                {'error': f'malformed request: {e}'}, status=400)
         if not prompt_ids:
             return web.json_response({'error': 'empty prompt'},
                                      status=400)
-        max_new = min(int(body.get('max_new_tokens',
-                                   args.max_new_tokens)), 256)
-        seed = int(body.get('seed', 0))
         t0 = time.monotonic()
         try:
             async with chip_lock:
@@ -102,7 +109,6 @@ def main() -> int:
             'latency_s': round(time.monotonic() - t0, 3),
         })
 
-    from aiohttp import web
     app = web.Application()
     app.router.add_get('/health', health)
     app.router.add_post('/generate', generate)

@@ -1,0 +1,11 @@
+"""Lazy cloud-SDK adaptors.
+
+Reference parity: sky/adaptors/ (LazyImport, sky/adaptors/common.py:10) —
+`import skypilot_tpu` must stay fast and work with no cloud SDK
+installed; the SDK import happens at first attribute access, and a
+missing dependency surfaces as a clear error naming the extra to
+install, not an ImportError from deep inside a provision call.
+"""
+from skypilot_tpu.adaptors.common import LazyImport
+
+__all__ = ['LazyImport']

@@ -1,0 +1,51 @@
+"""LazyImport: defer a module import to first attribute access.
+
+Mirrors the reference's sky/adaptors/common.py:10 semantics: the wrapper
+is created at module import time for free; the wrapped module is imported
+once, on first use; a missing package raises ImportError with the
+install hint instead of an AttributeError maze.
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Optional
+
+
+class LazyImport:
+
+    def __init__(self, module_name: str,
+                 import_error_message: Optional[str] = None) -> None:
+        self._module_name = module_name
+        self._module: Any = None
+        self._error = import_error_message
+        self._lock = threading.Lock()
+
+    def _load(self) -> Any:
+        if self._module is None:
+            with self._lock:
+                if self._module is None:
+                    try:
+                        self._module = importlib.import_module(
+                            self._module_name)
+                    except ImportError as e:
+                        msg = self._error or (
+                            f'Failed to import {self._module_name!r}. '
+                            f'Install the matching cloud SDK extra.')
+                        raise ImportError(msg) from e
+        return self._module
+
+    def is_available(self) -> bool:
+        """True if the wrapped module can be imported (loads it)."""
+        try:
+            self._load()
+            return True
+        except ImportError:
+            return False
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._load(), name)
+
+    def __repr__(self) -> str:
+        state = 'loaded' if self._module is not None else 'lazy'
+        return f'<LazyImport {self._module_name!r} ({state})>'

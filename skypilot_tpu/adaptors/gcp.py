@@ -1,0 +1,22 @@
+"""GCP SDK adaptor (google-auth; the TPU/GCE control plane speaks plain
+REST via requests, so google-auth is the only hard SDK dependency).
+
+Reference parity: sky/adaptors/gcp.py.
+"""
+from __future__ import annotations
+
+from skypilot_tpu.adaptors.common import LazyImport
+
+_GCP_HINT = ('google-auth is required for GCP credentials: '
+             'pip install google-auth')
+
+google_auth = LazyImport('google.auth', _GCP_HINT)
+google_auth_requests = LazyImport('google.auth.transport.requests',
+                                  _GCP_HINT)
+
+
+def authorized_session(scopes=None):
+    """An AuthorizedSession from application-default credentials."""
+    creds, _ = google_auth.default(
+        scopes=scopes or ['https://www.googleapis.com/auth/cloud-platform'])
+    return google_auth_requests.AuthorizedSession(creds)

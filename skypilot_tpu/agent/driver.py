@@ -183,21 +183,26 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
                 os.remove(os.path.join(log_dir, f'rank-{rank}.pid'))
             except OSError:
                 pass
-            # Record the result BEFORE the (up to 30s) in-container
-            # cleanup exec: a failing rank must trip the gang cancel
-            # immediately, not after a possibly-hanging ssh.
-            returncodes[rank] = rc
-            if rc != 0:
-                failed_event.set()
-            if container and not _KILL_INITIATED.is_set():
-                # Rank exited on its own: reap the in-container pid file
-                # and drop this rank's kill from the cancel list.  After
-                # a driver-initiated kill this must NOT run — the client
-                # proc dies first and reaping here would race
-                # _kill_in_container out of the pid it is about to kill.
+            # Self-exit vs driver-kill must be decided BEFORE signalling
+            # failure: once failed_event is set the monitor may set
+            # _KILL_INITIATED at any moment.  Drop our kill entry now
+            # (atomically, so the monitor's _kill_in_container snapshot
+            # won't exec a kill against our already-exited pid), signal,
+            # THEN run the slow cleanup exec — a failing rank trips the
+            # gang cancel immediately instead of after a possibly
+            # hanging 30s ssh to its own (maybe dead) host.
+            self_exited = container and not _KILL_INITIATED.is_set()
+            if self_exited:
                 with lock:
                     if kill_argv in _DOCKER_KILLS:
                         _DOCKER_KILLS.remove(kill_argv)
+            returncodes[rank] = rc
+            if rc != 0:
+                failed_event.set()
+            if self_exited:
+                # Reap the in-container pid/cancel files (stale pid +
+                # in-container PID reuse would make a later gang-cancel
+                # SIGTERM an unrelated process group).
                 try:
                     subprocess.run(_host_shell_argv(
                         hosts[rank], _docker_cleanup_cmd(container, tag)),

@@ -31,12 +31,8 @@ _DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), 'data')
 
 def _authed_session():
     try:
-        import google.auth
-        import google.auth.transport.requests
-        creds, _ = google.auth.default(
-            scopes=['https://www.googleapis.com/auth/cloud-platform'])
-        session = google.auth.transport.requests.AuthorizedSession(creds)
-        return session
+        from skypilot_tpu.adaptors import gcp as gcp_adaptor
+        return gcp_adaptor.authorized_session()
     except Exception as e:  # pylint: disable=broad-except
         raise SystemExit(
             f'GCP credentials unavailable ({e}); cannot refresh catalog. '

@@ -32,12 +32,8 @@ class TpuApiClient:
 
     def _get_session(self) -> requests.Session:
         if self._session is None:
-            import google.auth
-            import google.auth.transport.requests
-            creds, _ = google.auth.default(
-                scopes=['https://www.googleapis.com/auth/cloud-platform'])
-            self._session = google.auth.transport.requests.AuthorizedSession(
-                creds)
+            from skypilot_tpu.adaptors import gcp as gcp_adaptor
+            self._session = gcp_adaptor.authorized_session()
         return self._session
 
     def _request(self, method: str, path: str,

@@ -1,0 +1,7 @@
+"""Service contracts for the per-cluster agent.
+
+Reference parity: sky/schemas/proto (skylet gRPC contracts) +
+sky/schemas/generated.  The .proto files here are the canonical
+contract; the running transport is JSON-over-HTTP (grpc_tools is not in
+this build), with the field mapping documented in agent.md.
+"""

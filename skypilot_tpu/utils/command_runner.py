@@ -30,9 +30,6 @@ def shell_exports(env: Optional[Dict[str, str]]) -> str:
                     for k, v in env.items()) + ' '
 
 
-_env_prefix = shell_exports
-
-
 class CommandRunner:
     """Runs commands and syncs files on one host."""
 
@@ -190,7 +187,7 @@ class SSHCommandRunner(CommandRunner):
             stream_logs=False, require_outputs=False, timeout=None):
         if isinstance(cmd, list):
             cmd = ' '.join(shlex.quote(c) for c in cmd)
-        remote = _env_prefix(env) + (f'cd {shlex.quote(cwd)} && ' if cwd
+        remote = shell_exports(env) + (f'cd {shlex.quote(cwd)} && ' if cwd
                                      else '') + cmd
         argv = self._ssh_base() + ['bash', '-c', shlex.quote(remote)]
         return self._spawn(argv, log_path, stream_logs, require_outputs,
@@ -235,7 +232,7 @@ class KubernetesCommandRunner(CommandRunner):
             stream_logs=False, require_outputs=False, timeout=None):
         if isinstance(cmd, list):
             cmd = ' '.join(shlex.quote(c) for c in cmd)
-        remote = _env_prefix(env) + (f'cd {shlex.quote(cwd)} && ' if cwd
+        remote = shell_exports(env) + (f'cd {shlex.quote(cwd)} && ' if cwd
                                      else '') + cmd
         argv = self._kubectl_base() + ['exec', self.pod_name]
         if self.container:

@@ -1,0 +1,52 @@
+"""Adaptors (lazy SDK imports) + agent proto contract checks."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from skypilot_tpu.adaptors import LazyImport
+
+PROTO = os.path.join(os.path.dirname(__file__), '..', 'skypilot_tpu',
+                     'schemas', 'agent.proto')
+
+
+def test_lazy_import_defers_and_loads():
+    mod = LazyImport('json')
+    assert 'lazy' in repr(mod)
+    assert mod.dumps({'a': 1}) == '{"a": 1}'
+    assert 'loaded' in repr(mod)
+    assert mod.is_available()
+
+
+def test_lazy_import_missing_module_message():
+    mod = LazyImport('no_such_module_xyz', 'install the foo extra')
+    assert not mod.is_available()
+    with pytest.raises(ImportError, match='install the foo extra'):
+        mod.anything
+
+
+def test_gcp_adaptor_importable_without_sdk_load():
+    # Importing the adaptor module must not import google.auth.
+    import sys
+    from skypilot_tpu.adaptors import gcp  # noqa: F401
+    assert 'lazy' in repr(gcp.google_auth) or 'google.auth' in sys.modules
+
+
+def test_agent_proto_compiles():
+    protoc = shutil.which('protoc')
+    if protoc is None:
+        pytest.skip('protoc not available')
+    out = subprocess.run(
+        [protoc, f'--proto_path={os.path.dirname(PROTO)}',
+         '--descriptor_set_out=/dev/null', os.path.basename(PROTO)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+
+
+def test_proto_job_statuses_match_status_lib():
+    """Every JobStatus in the library appears in the proto enum."""
+    from skypilot_tpu.utils.status_lib import JobStatus
+    text = open(PROTO, encoding='utf-8').read()
+    for status in JobStatus:
+        assert f'JOB_STATUS_{status.name}' in text, status

@@ -83,6 +83,23 @@ def test_generate_missing_prompt_is_400(server):
     assert 'prompt' in body['error']
 
 
+def test_generate_malformed_fields_are_400(server):
+    for payload in ({'prompt_ids': ['abc']},
+                    {'prompt_ids': 5},
+                    {'prompt_ids': [1], 'max_new_tokens': 'lots'},
+                    {'prompt_ids': [1], 'seed': 'x'}):
+        status, body = _post(server + '/generate', payload)
+        assert status == 400, payload
+        assert 'error' in body
+
+
+def test_generate_out_of_range_ids_are_400(server):
+    status, body = _post(server + '/generate',
+                         {'prompt_ids': [128000]})  # debug vocab is 512
+    assert status == 400
+    assert 'out of range' in body['error']
+
+
 def test_generate_deterministic_greedy(server):
     a = _post(server + '/generate', {'prompt_ids': [5, 6, 7]})[1]
     b = _post(server + '/generate', {'prompt_ids': [5, 6, 7]})[1]
