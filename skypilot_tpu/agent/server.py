@@ -130,7 +130,9 @@ def make_app(state: AgentState) -> web.Application:
         if job_id is None:
             return _json_error(404, 'no jobs')
         rank = int(request.query.get('rank', 0))
-        follow = request.query.get('follow', '1') == '1'
+        # Default matches the proto3 contract: follow=false → read the
+        # current log and EOF.  Clients wanting a stream pass follow=1.
+        follow = request.query.get('follow', '0') == '1'
         log_path = os.path.join(state.log_dir_for(job_id),
                                 f'rank-{rank}.log')
         resp = web.StreamResponse(
@@ -155,9 +157,14 @@ def make_app(state: AgentState) -> web.Application:
     @routes.post('/autostop')
     async def autostop(request: web.Request) -> web.Response:
         body = await request.json()
+        if 'down' not in body:
+            # Explicit by contract (schemas/agent.proto): the proto3
+            # default (false = stop-when-idle) is unsupported for TPU
+            # pod slices, so an implicit default would surprise.
+            return _json_error(400, "'down' must be set explicitly")
         with open(state.autostop_path, 'w', encoding='utf-8') as f:
             json.dump({'idle_minutes': body.get('idle_minutes'),
-                       'down': bool(body.get('down', True)),
+                       'down': bool(body['down']),
                        'set_at': time.time()}, f)
         return web.json_response({'ok': True})
 

@@ -14,6 +14,17 @@ EXAMPLES = sorted(glob.glob(os.path.join(
 
 @pytest.mark.parametrize('path', EXAMPLES, ids=os.path.basename)
 def test_example_parses_and_optimizes(path, tmp_home):
+    from skypilot_tpu.utils import common_utils
+    if len(common_utils.read_yaml_all(path)) > 1:
+        # Multi-document YAML = a pipeline (chained DAG).
+        from skypilot_tpu import dag as dag_lib
+        from skypilot_tpu.optimizer import Optimizer
+        dag = dag_lib.load_chain_from_yaml(path)
+        assert dag.is_chain() and len(dag.tasks) >= 2
+        for task in dag.tasks:
+            Optimizer.optimize_task(task, quiet=True)
+            assert task.best_resources is not None
+        return
     task = sky.Task.from_yaml(path)
     assert task.name
     # Service specs validate on parse (serve recipe).
@@ -38,3 +49,29 @@ def test_docker_example_image(tmp_home):
     task = sky.Task.from_yaml(path)
     res = list(task.resources)[0]
     assert res.docker_image and res.docker_image.startswith('us-docker')
+
+
+@pytest.mark.parametrize('script,args', [
+    ('train_long_context.py',
+     ['--sp', '4', '--fsdp', '2', '--seq-len', '256', '--model-size',
+      'debug', '--steps', '2', '--batch-size', '2']),
+    ('train_moe.py',
+     ['--ep', '4', '--dp', '2', '--model-size', 'debug', '--seq-len',
+      '128', '--batch-size', '4', '--steps', '2']),
+], ids=['long_context', 'moe'])
+def test_parallel_recipe_scripts_run_on_cpu_mesh(script, args):
+    """The sp-ring and ep recipes execute end-to-end on a virtual
+    8-device CPU mesh."""
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'  # the outer env may pin another platform
+    flags = env.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    path = os.path.join(os.path.dirname(EXAMPLES[0]), 'scripts', script)
+    out = subprocess.run([sys.executable, path] + args, env=env,
+                         capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    assert 'OK' in out.stdout
