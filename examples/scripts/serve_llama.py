@@ -9,9 +9,9 @@ replica (llm/vllm/service.yaml).
 Requests (POST /generate, JSON):
   {"prompt_ids": [1, 2, 3], "max_new_tokens": 32, "seed": 7}
                                       — token ids in [0, vocab)
-  {"prompt": "text", ...}             — demo byte-level tokenizer
-                                        (utf-8 bytes mod vocab; there is
-                                        no bundled trained tokenizer)
+  {"prompt": "text", ...}             — tokenized with the HF tokenizer
+                                        when --hf-model is set; demo
+                                        byte-level fallback otherwise
 One of prompt_ids / prompt is required; malformed requests are a 400,
 never silently defaulted.  Sampling temperature is a server flag
 (--temperature): the engine compiles it into the decode step, so it is
@@ -25,22 +25,37 @@ import json
 import time
 
 
-def build_generator(model_size: str, max_seq_len: int, temperature: float):
+def build_generator(model_size: str, max_seq_len: int, temperature: float,
+                    hf_model: str = ''):
     import jax
 
     from skypilot_tpu.infer import Generator, GeneratorConfig
     from skypilot_tpu.models import llama
 
-    config = {
-        'debug': llama.LLAMA_DEBUG,
-        '1b': llama.LLAMA_1B,
-        '8b': llama.LLAMA3_8B,
-    }[model_size]
-    params = llama.init_params(config, jax.random.PRNGKey(0))
+    tokenizer = None
+    eos = None
+    if hf_model:
+        from skypilot_tpu.models import convert
+        params, config = convert.load_hf_llama(hf_model)
+        try:
+            import transformers
+            tokenizer = transformers.AutoTokenizer.from_pretrained(
+                hf_model)
+            eos = tokenizer.eos_token_id
+        except Exception:  # tokenizer optional: ids-only serving works
+            tokenizer = None
+    else:
+        config = {
+            'debug': llama.LLAMA_DEBUG,
+            '1b': llama.LLAMA_1B,
+            '8b': llama.LLAMA3_8B,
+        }[model_size]
+        params = llama.init_params(config, jax.random.PRNGKey(0))
     max_seq_len = min(max_seq_len, config.max_seq_len)
     gen = Generator(params, config, GeneratorConfig(
-        max_seq_len=max_seq_len, batch_size=1, temperature=temperature))
-    return gen, config
+        max_seq_len=max_seq_len, batch_size=1, temperature=temperature,
+        eos_token=eos))
+    return gen, config, tokenizer
 
 
 def main() -> int:
@@ -50,10 +65,14 @@ def main() -> int:
     parser.add_argument('--max-new-tokens', type=int, default=16)
     parser.add_argument('--max-seq-len', type=int, default=1024)
     parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--hf-model', default='',
+                        help='serve an HF checkpoint (hub name or local '
+                             'path) instead of random weights')
     args = parser.parse_args()
 
-    gen, config = build_generator(args.model_size, args.max_seq_len,
-                                  args.temperature)
+    gen, config, tokenizer = build_generator(
+        args.model_size, args.max_seq_len, args.temperature,
+        args.hf_model)
     # Compile prefill + decode now so the readiness probe reflects
     # readiness instead of the first request eating the compiles.
     gen.warmup()
@@ -80,8 +99,13 @@ def main() -> int:
                                   f'[0, {config.vocab_size}): {bad[:5]}'},
                         status=400)
             elif 'prompt' in body:
-                prompt_ids = [b % config.vocab_size
-                              for b in str(body['prompt']).encode('utf-8')]
+                if tokenizer is not None:
+                    prompt_ids = tokenizer(str(body['prompt'])
+                                           )['input_ids']
+                else:  # demo byte-level fallback (no bundled tokenizer)
+                    prompt_ids = [b % config.vocab_size
+                                  for b in str(body['prompt']
+                                               ).encode('utf-8')]
             else:
                 return web.json_response(
                     {'error': "provide 'prompt_ids' (token ids) or "
@@ -103,11 +127,14 @@ def main() -> int:
                     gen.generate, [prompt_ids], max_new, seed)
         except ValueError as e:
             return web.json_response({'error': str(e)}, status=400)
-        return web.json_response({
+        resp = {
             'output_ids': out[0],
             'num_generated': len(out[0]),
             'latency_s': round(time.monotonic() - t0, 3),
-        })
+        }
+        if tokenizer is not None:
+            resp['output_text'] = tokenizer.decode(out[0])
+        return web.json_response(resp)
 
     app = web.Application()
     app.router.add_get('/health', health)

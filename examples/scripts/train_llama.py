@@ -27,6 +27,10 @@ def main() -> None:
     parser.add_argument('--checkpoint-dir', default='')
     parser.add_argument('--checkpoint-every', type=int, default=50)
     parser.add_argument('--resume', default='no', choices=['no', 'auto'])
+    parser.add_argument('--hf-model', default='',
+                        help='HF checkpoint (hub name or local path) to '
+                             'finetune from instead of random init; '
+                             'overrides --model-size')
     args = parser.parse_args()
 
     env_contract.initialize_from_env()
@@ -39,12 +43,17 @@ def main() -> None:
     from skypilot_tpu.parallel import sharding as sharding_lib
     from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
 
-    config = {
-        'debug': llama.LLAMA_DEBUG,
-        '1b': llama.LLAMA_1B,
-        '8b': llama.LLAMA3_8B,
-        '70b': llama.LLAMA3_70B,
-    }[args.model_size]
+    hf_params = None
+    if args.hf_model:
+        from skypilot_tpu.models import convert
+        hf_params, config = convert.load_hf_llama(args.hf_model)
+    else:
+        config = {
+            'debug': llama.LLAMA_DEBUG,
+            '1b': llama.LLAMA_1B,
+            '8b': llama.LLAMA3_8B,
+            '70b': llama.LLAMA3_70B,
+        }[args.model_size]
 
     n = jax.device_count()
     if args.fsdp or args.dp or args.tp > 1 or args.sp > 1:
@@ -70,7 +79,8 @@ def main() -> None:
     def loss(p, batch):
         return llama.loss_fn(p, batch, config, attention_fn=attention_fn)
 
-    params = llama.init_params(config, jax.random.PRNGKey(0))
+    params = (hf_params if hf_params is not None
+              else llama.init_params(config, jax.random.PRNGKey(0)))
     trainer = Trainer(loss, params, mesh, sharding_lib.LLAMA_RULES,
                       TrainConfig(learning_rate=args.learning_rate,
                                   warmup_steps=min(100, args.steps // 10 + 1),

@@ -30,18 +30,7 @@ def main() -> int:
                         choices=['debug', '1b', '8b'])
     args = parser.parse_args()
 
-    import os
-
     import jax
-    # Some sandboxes pin jax_platforms at import time; re-assert the
-    # user's JAX_PLATFORMS so the CPU smoke invocation in the module
-    # docstring works everywhere.
-    if os.environ.get('JAX_PLATFORMS'):
-        try:
-            jax.config.update('jax_platforms',
-                              os.environ['JAX_PLATFORMS'])
-        except RuntimeError:
-            pass
 
     from skypilot_tpu.models import llama
     from skypilot_tpu.parallel import (MeshConfig, make_mesh,

@@ -25,15 +25,7 @@ def main() -> int:
                         choices=['debug', 'small'])
     args = parser.parse_args()
 
-    import os
-
     import jax
-    if os.environ.get('JAX_PLATFORMS'):
-        try:
-            jax.config.update('jax_platforms',
-                              os.environ['JAX_PLATFORMS'])
-        except RuntimeError:
-            pass
 
     from skypilot_tpu.models import moe
     from skypilot_tpu.parallel import MeshConfig, make_mesh

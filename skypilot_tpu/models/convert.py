@@ -1,0 +1,138 @@
+"""HuggingFace Llama checkpoint → stacked-layer JAX pytree.
+
+The bridge from the public Llama-3 weights to this framework's training
+(models/llama.py) and inference (infer/) paths: the reference's recipes
+get weights via torchtune/vLLM downloads (llm/llama-3_1-finetuning);
+here conversion is library code.
+
+Layout notes:
+- HF `nn.Linear.weight` is (out_features, in_features); this framework
+  stores dense kernels input-major — (in, out) — so every projection is
+  transposed on the way in.
+- Layers stack on a leading axis (one lax.scan drives the whole stack),
+  so per-layer tensors are np.stack'ed.
+- HF Llama rotary uses rotate_half (split-halves) — identical to
+  ops/rope.py — so Q/K need no head-dim permutation.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+
+
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
+                   **overrides: Any) -> llama.LlamaConfig:
+    """Map a transformers LlamaConfig to this framework's LlamaConfig.
+
+    Raises on config features the model stack does not implement yet —
+    silently ignoring them would convert cleanly and produce subtly
+    wrong numerics (the worst failure mode for a weights bridge).
+    """
+    import dataclasses
+    scaling = getattr(hf_config, 'rope_scaling', None)
+    if scaling and float(scaling.get('factor', 1.0)) != 1.0:
+        raise NotImplementedError(
+            f'rope_scaling={scaling!r} is not implemented in '
+            'skypilot_tpu.ops.rope (Llama-3.1+ checkpoints need it); '
+            'converting anyway would give wrong positions.')
+    hf_head_dim = getattr(hf_config, 'head_dim', None)
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    if hf_head_dim is not None and hf_head_dim != derived:
+        raise NotImplementedError(
+            f'explicit head_dim={hf_head_dim} != hidden/heads={derived} '
+            'is not supported by the stacked Llama pytree.')
+    cfg = llama.LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, 'rope_theta', 500000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+        dtype=dtype)
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
+                            config: llama.LlamaConfig) -> Params:
+    """Convert an HF Llama state_dict (torch tensors or numpy arrays,
+    fp32/bf16) into the stacked pytree llama.init_params produces."""
+
+    def get(name: str) -> np.ndarray:
+        w = state_dict[name]
+        if hasattr(w, 'detach'):  # torch tensor
+            w = w.detach().to('cpu').float().numpy()
+        return np.asarray(w)
+
+    def cast(x: np.ndarray) -> jnp.ndarray:
+        # bf16 has no numpy dtype: round-trip through jnp.
+        return jnp.asarray(x, dtype=config.dtype)
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        ws = []
+        for i in range(config.n_layers):
+            w = get(fmt.format(i))
+            ws.append(np.asarray(w, np.float32).T if transpose
+                      else np.asarray(w, np.float32))
+        return cast(np.stack(ws))
+
+    prefix = 'model.'
+    if f'{prefix}embed_tokens.weight' not in state_dict and \
+            'embed_tokens.weight' in state_dict:
+        prefix = ''
+
+    embed = cast(get(f'{prefix}embed_tokens.weight'))
+    if 'lm_head.weight' in state_dict:
+        lm_head = cast(get('lm_head.weight').T)
+    else:  # tied embeddings
+        lm_head = cast(get(f'{prefix}embed_tokens.weight').T)
+
+    L = prefix + 'layers.{}.'
+    return {
+        'embed': embed,
+        'layers': {
+            'ln1': stack(L + 'input_layernorm.weight', transpose=False),
+            'ln2': stack(L + 'post_attention_layernorm.weight',
+                         transpose=False),
+            'attn': {
+                'wq': stack(L + 'self_attn.q_proj.weight'),
+                'wk': stack(L + 'self_attn.k_proj.weight'),
+                'wv': stack(L + 'self_attn.v_proj.weight'),
+                'wo': stack(L + 'self_attn.o_proj.weight'),
+            },
+            'mlp': {
+                'w_gate': stack(L + 'mlp.gate_proj.weight'),
+                'w_up': stack(L + 'mlp.up_proj.weight'),
+                'w_down': stack(L + 'mlp.down_proj.weight'),
+            },
+        },
+        'final_norm': cast(get(f'{prefix}norm.weight')),
+        'lm_head': lm_head,
+    }
+
+
+def load_hf_llama(model_name_or_path: str,
+                  dtype: Any = jnp.bfloat16,
+                  **config_overrides: Any
+                  ) -> Tuple[Params, llama.LlamaConfig]:
+    """Load an HF Llama checkpoint (local path or hub name) and return
+    (params, config) ready for the trainer / inference engine."""
+    import torch
+    import transformers
+    # bf16 load: fp32 would double (torch) + redouble (numpy copies)
+    # peak host RAM for a model whose target dtype is bf16 anyway.
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        model_name_or_path, torch_dtype=torch.bfloat16)
+    config = config_from_hf(model.config, dtype=dtype,
+                            **config_overrides)
+    params = hf_state_dict_to_params(model.state_dict(), config)
+    del model
+    return params, config

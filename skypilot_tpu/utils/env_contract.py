@@ -85,8 +85,17 @@ def initialize_from_env(timeout_s: Optional[int] = None) -> None:
     """Call jax.distributed.initialize from the injected contract.
 
     Run this at the top of any multi-host recipe.  No-op for single-host
-    jobs (the contract is still present, with one node).
+    jobs (the contract is still present, with one node).  Also re-asserts
+    the user's JAX_PLATFORMS first: some sandboxes pin jax_platforms from
+    sitecustomize, which would otherwise override the env var.
     """
+    if os.environ.get('JAX_PLATFORMS'):
+        import jax
+        try:
+            jax.config.update('jax_platforms',
+                              os.environ['JAX_PLATFORMS'])
+        except RuntimeError:
+            pass  # backend already initialized; trust the environment
     num_processes = int(os.environ.get(NUM_PROCESSES, '1'))
     if num_processes <= 1:
         return
