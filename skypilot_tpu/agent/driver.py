@@ -60,21 +60,54 @@ def _docker_wrap(cmd: str, env: Dict[str, str], container: str,
             f'/bin/bash -c {shlex.quote(inner)}')
 
 
+def _kill_fragment(tag: str) -> str:
+    """The in-container marker-then-kill sequence (single source for the
+    kill and cleanup paths so their semantics cannot drift).
+
+    The kill is liveness-guarded: the recorded pgid is signalled only if
+    some process in it still exists, so a kill fired after the workload
+    already exited (rank exits 255 on an ssh host → its entry stays in
+    _DOCKER_KILLS until the cleanup confirms, and the gang cancel may
+    exec first) is a no-op rather than a SIGTERM at a reused pid."""
+    return (f'touch /tmp/{tag}.cancel; '
+            f'if [ -f /tmp/{tag}.pid ] && '
+            f'kill -0 -- -\\$(cat /tmp/{tag}.pid) 2>/dev/null; then '
+            f'kill -TERM -- -\\$(cat /tmp/{tag}.pid) 2>/dev/null; fi')
+
+
 def _docker_kill_cmd(container: str, tag: str) -> str:
-    # Marker first (see _docker_wrap), then kill the recorded group.
+    # Kill the recorded group, reap the pid file.  The cancel marker is
+    # deliberately left in place: it must stay down so a late-starting
+    # shell (start/cancel race, see _docker_wrap) exits instead of
+    # running the workload unkillable.
     return (f'sudo docker exec {shlex.quote(container)} /bin/bash -c '
-            f'"touch /tmp/{tag}.cancel; '
-            f'kill -TERM -- -\\$(cat /tmp/{tag}.pid) 2>/dev/null; '
+            f'"{_kill_fragment(tag)}; '
             f'rm -f /tmp/{tag}.pid" 2>/dev/null || true')
 
 
 def _docker_cleanup_cmd(container: str, tag: str) -> str:
     """Reap the pid/cancel files after a rank exits on its own: a stale
     pid file + in-container PID reuse would make a later gang-cancel
-    SIGTERM an unrelated process group."""
+    SIGTERM an unrelated process group.
+
+    Defensive: if the recorded process group is STILL alive (the ssh or
+    docker-exec client died while the in-container workload survived —
+    the exact orphan scenario _docker_wrap exists for), the shared kill
+    fragment terminates it before the files are reaped.
+
+    NO trailing `|| true`: the caller uses the exit status as proof the
+    in-container kill/reap actually ran (docker exec failing must not
+    count as reaped, or a live orphan loses its only kill handle).
+
+    The .cancel marker is deliberately NOT removed: after a client death
+    the in-container shell may not have started yet (accepted server-side
+    but pre-pid-file), and the marker is what makes that late starter
+    exit instead of running the workload unkillable.  Tags are unique
+    per submission, so the leftover marker can never hit a future job."""
     return (f'sudo docker exec {shlex.quote(container)} /bin/bash -c '
-            f'"rm -f /tmp/{tag}.pid /tmp/{tag}.cancel" '
-            f'2>/dev/null || true')
+            f'"{_kill_fragment(tag)}; '
+            f'rm -f /tmp/{tag}.pid" '
+            f'2>/dev/null')
 
 
 def _rank_argv(host: Dict[str, Any], cmd: str, env: Dict[str, str],
@@ -186,13 +219,20 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             # Self-exit vs driver-kill must be decided BEFORE signalling
             # failure: once failed_event is set the monitor may set
             # _KILL_INITIATED at any moment.  Drop our kill entry now
-            # (atomically, so the monitor's _kill_in_container snapshot
-            # won't exec a kill against our already-exited pid), signal,
+            # (so the monitor's _kill_in_container snapshot normally
+            # skips this exited rank; the fragment's liveness guard
+            # covers the rc==255 case where the entry must stay), signal,
             # THEN run the slow cleanup exec — a failing rank trips the
             # gang cancel immediately instead of after a possibly
             # hanging 30s ssh to its own (maybe dead) host.
+            # rc 255 is AMBIGUOUS on an ssh host: it is the ssh client's
+            # transport-failure code, but a workload can also exit 255
+            # itself.  On transport failure the in-container workload may
+            # still be alive and holding TPU chips, so the kill entry must
+            # not be dropped until the host has been reached again.
+            maybe_client_died = bool(hosts[rank].get('ssh')) and rc == 255
             self_exited = container and not _KILL_INITIATED.is_set()
-            if self_exited:
+            if self_exited and not maybe_client_died:
                 with lock:
                     if kill_argv in _DOCKER_KILLS:
                         _DOCKER_KILLS.remove(kill_argv)
@@ -202,13 +242,22 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             if self_exited:
                 # Reap the in-container pid/cancel files (stale pid +
                 # in-container PID reuse would make a later gang-cancel
-                # SIGTERM an unrelated process group).
+                # SIGTERM an unrelated process group).  The cleanup cmd
+                # is defensive: it kills the recorded pgid first if it is
+                # still alive (orphaned workload after client death).
                 try:
-                    subprocess.run(_host_shell_argv(
+                    res = subprocess.run(_host_shell_argv(
                         hosts[rank], _docker_cleanup_cmd(container, tag)),
                         timeout=30, capture_output=True, check=False)
+                    reaped = res.returncode == 0
                 except (subprocess.TimeoutExpired, OSError):
-                    pass
+                    reaped = False
+                if maybe_client_died and reaped:
+                    # Host reachable again and the cleanup killed-or-
+                    # reaped the group — safe to drop the kill entry.
+                    with lock:
+                        if kill_argv in _DOCKER_KILLS:
+                            _DOCKER_KILLS.remove(kill_argv)
 
     threads = [threading.Thread(target=_run_rank, args=(r,), daemon=True)
                for r in range(len(hosts))]

@@ -132,16 +132,20 @@ def decode_step(params: llama.Params, token: jax.Array,
         b_idx = jnp.arange(batch)
         k_cache = k_cache.at[b_idx, positions].set(k[:, 0])
         v_cache = v_cache.at[b_idx, positions].set(v[:, 0])
-        # GQA attention of the single query over the cache prefix.
+        # GQA attention of the single query over the cache prefix.  The
+        # query is reshaped into (KV, group) head blocks and contracted
+        # against the UN-repeated cache: decode is bandwidth-bound, and
+        # materializing repeated K/V would multiply the dominant memory
+        # traffic by the group factor (4x for Llama-3 8B).
         group = config.n_heads // config.n_kv_heads
-        kf = jnp.repeat(k_cache, group, axis=2)     # (B, max_len, H, D)
-        vf = jnp.repeat(v_cache, group, axis=2)
+        q_g = q.reshape(batch, 1, config.n_kv_heads, group,
+                        config.head_dim)
         scale = config.head_dim ** -0.5
-        s = jnp.einsum('bqhd,bkhd->bhqk', q, kf,
+        s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_cache,
                        preferred_element_type=jnp.float32) * scale
-        s = jnp.where(visible[:, None, None, :], s, -1e30)
+        s = jnp.where(visible[:, None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        o = jnp.einsum('bhqk,bkhd->bqhd', p, vf)
+        o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_cache)
         h = h + (o.reshape(batch, 1, -1) @ attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)

@@ -239,6 +239,10 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block: int,
     scale = head_dim ** -0.5
     nq = seq_len // block
 
+    if lse.shape[-1] != 128:
+        # Residual lse is stored lane-sliced ((B, H, S, 1), see _vjp_fwd);
+        # restore the lane-broadcast layout the kernels' BlockSpecs want.
+        lse = jnp.broadcast_to(lse, lse.shape[:-1] + (128,))
     delta = jnp.broadcast_to(
         jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), axis=-1,
                 keepdims=True), lse.shape)
@@ -345,7 +349,10 @@ def _vjp_fwd(q, k, v, causal):
     vt = jnp.swapaxes(v, 1, 2)
     block = _pick_block(qt.shape[2])
     ot, lse = _flash_fwd(qt, kt, vt, causal, block, interpret=_INTERPRET)
-    return jnp.swapaxes(ot, 1, 2), (qt, kt, vt, ot, lse)
+    # lse's 128 lanes are identical per row; keep only lane 0 in the
+    # residuals held across the forward (128x less residual HBM — ~0.5GB
+    # per layer at 8B shapes otherwise) and re-broadcast in _flash_bwd.
+    return jnp.swapaxes(ot, 1, 2), (qt, kt, vt, ot, lse[..., :1])
 
 
 def _vjp_bwd(causal, residuals, g):
