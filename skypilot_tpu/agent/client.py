@@ -1,5 +1,14 @@
-"""HTTP client for the head agent (reference parity: SkyletClient,
-sky/backends/cloud_vm_ray_backend.py:3071, minus the gRPC transport)."""
+"""Client for the head agent (reference parity: SkyletClient,
+sky/backends/cloud_vm_ray_backend.py:3071 — gRPC channel with version
+gating, plus this build's JSON/HTTP as the always-available fallback).
+
+Transport selection (version-gated in the handshake): the HTTP /health
+response advertises `agent_version` and `grpc_port`; agents at version
+>= 2 serve gRPC and the client prefers it for job ops.  Any gRPC failure
+permanently falls back to HTTP for this client instance — the two
+transports serve the same AgentOps surface, so results are identical
+(tests/test_grpc_agent.py locks the parity).
+"""
 from __future__ import annotations
 
 import time
@@ -11,14 +20,67 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.utils.status_lib import JobStatus
 
 
+# Handshake results per base_url: callers construct an AgentClient per
+# operation (backend/server hot paths), and re-probing /health + building
+# a channel each time would double request count and latency.  grpc
+# channels are thread-safe and shared; a value of None means "this agent
+# serves HTTP only" and is also cached.
+_TRANSPORT_CACHE: Dict[str, Optional['object']] = {}
+
+
 class AgentClient:
 
-    def __init__(self, base_url: str, timeout: float = 30.0) -> None:
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 prefer_grpc: bool = True) -> None:
         self.base_url = base_url.rstrip('/')
         self.timeout = timeout
+        self._prefer_grpc = prefer_grpc
+        self._grpc = None          # lazily-connected GrpcAgentClient
+        self._grpc_checked = False
 
     def _url(self, path: str) -> str:
         return f'{self.base_url}{path}'
+
+    def _grpc_client(self):
+        """The gRPC transport, if the agent advertises one (None → HTTP).
+        Resolved once per base_url (process-wide cache) from the health
+        handshake."""
+        if self._grpc_checked or not self._prefer_grpc:
+            return self._grpc
+        self._grpc_checked = True
+        if self.base_url in _TRANSPORT_CACHE:
+            self._grpc = _TRANSPORT_CACHE[self.base_url]
+            return self._grpc
+        try:
+            info = self.health()
+            grpc_port = info.get('grpc_port')
+            if info.get('agent_version', 0) >= 2 and grpc_port:
+                from skypilot_tpu.agent.grpc_client import GrpcAgentClient
+                host = self.base_url.split('://', 1)[-1].rsplit(':', 1)[0]
+                self._grpc = GrpcAgentClient(host, int(grpc_port),
+                                             timeout=self.timeout)
+            _TRANSPORT_CACHE[self.base_url] = self._grpc
+        except Exception:  # pylint: disable=broad-except
+            self._grpc = None   # transient: leave the cache unset
+        return self._grpc
+
+    def _drop_grpc(self) -> None:
+        """A gRPC op failed: this client AND future clients of the same
+        agent go to HTTP (the cached channel would fail for them too)."""
+        self._grpc = None
+        _TRANSPORT_CACHE[self.base_url] = None
+
+    def _try_grpc(self, method: str, *args, **kwargs):
+        """Run an op over gRPC when available; (ok, result).  Failure
+        drops the channel so subsequent ops go straight to HTTP."""
+        client = self._grpc_client()
+        if client is None:
+            return False, None
+        try:
+            return True, getattr(client, method)(*args, **kwargs)
+        except Exception:  # pylint: disable=broad-except
+            self._drop_grpc()
+            return False, None
 
     def health(self) -> Dict[str, Any]:
         resp = requests.get(self._url('/health'), timeout=self.timeout)
@@ -52,12 +114,18 @@ class AgentClient:
             f'Agent at {self.base_url} not ready: {last_err}')
 
     def submit_job(self, spec: Dict[str, Any]) -> int:
+        ok, result = self._try_grpc('submit_job', spec)
+        if ok:
+            return result
         resp = requests.post(self._url('/jobs/submit'), json=spec,
                              timeout=self.timeout)
         resp.raise_for_status()
         return int(resp.json()['job_id'])
 
     def queue(self, all_jobs: bool = False) -> List[Dict[str, Any]]:
+        ok, result = self._try_grpc('queue', all_jobs)
+        if ok:
+            return result
         resp = requests.get(self._url('/jobs/queue'),
                             params={'all': int(all_jobs)},
                             timeout=self.timeout)
@@ -65,6 +133,9 @@ class AgentClient:
         return resp.json()['jobs']
 
     def job_status(self, job_id: int) -> Optional[JobStatus]:
+        ok, result = self._try_grpc('job_status', job_id)
+        if ok:
+            return result
         resp = requests.get(self._url('/jobs/status'),
                             params={'job_id': job_id}, timeout=self.timeout)
         if resp.status_code == 404:
@@ -73,6 +144,9 @@ class AgentClient:
         return JobStatus(resp.json()['status'])
 
     def cancel(self, job_ids: Optional[List[int]] = None) -> List[int]:
+        ok, result = self._try_grpc('cancel', job_ids)
+        if ok:
+            return result
         resp = requests.post(self._url('/jobs/cancel'),
                              json={'job_ids': job_ids}, timeout=self.timeout)
         resp.raise_for_status()
@@ -80,6 +154,23 @@ class AgentClient:
 
     def tail_logs(self, job_id: Optional[int] = None, rank: int = 0,
                   follow: bool = True) -> Iterator[str]:
+        # Streaming op: probe the transport once, then commit — swapping
+        # transports mid-stream would replay the log from byte 0 and
+        # duplicate everything already yielded.  HTTP fallback is only
+        # allowed while NOTHING has been yielded; a mid-stream failure
+        # re-raises to the consumer instead.
+        client = self._grpc_client()
+        if client is not None:
+            yielded = False
+            try:
+                for line in client.tail_logs(job_id, rank, follow):
+                    yielded = True
+                    yield line
+                return
+            except Exception:  # pylint: disable=broad-except
+                self._drop_grpc()
+                if yielded:
+                    raise
         params: Dict[str, Any] = {'rank': rank, 'follow': int(follow)}
         if job_id is not None:
             params['job_id'] = job_id

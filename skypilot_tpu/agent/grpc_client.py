@@ -1,0 +1,119 @@
+"""gRPC client half of the agent transport (reference: SkyletClient's gRPC
+channel, sky/backends/cloud_vm_ray_backend.py:2745/:3071).
+
+Used by AgentClient when the HTTP health handshake advertises
+agent_version >= 2 + a grpc_port; any gRPC failure falls back to HTTP (the
+transport that every agent always serves).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import grpc
+
+from skypilot_tpu.agent import grpc_server  # enum maps + spec conversion
+from skypilot_tpu.schemas.generated import agent_pb2 as pb
+from skypilot_tpu.utils.status_lib import JobStatus
+
+_PKG = 'skypilot_tpu.agent.v1'
+
+
+class GrpcAgentClient:
+    """Typed stubs over a plain channel (what grpc_python_plugin would
+    generate for schemas/agent.proto's three services)."""
+
+    def __init__(self, host: str, port: int,
+                 timeout: float = 30.0) -> None:
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(f'{host}:{port}')
+
+        def unary(service: str, method: str, req_cls, resp_cls):
+            return self._channel.unary_unary(
+                f'/{_PKG}.{service}/{method}',
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString)
+
+        self._get_health = unary('HealthService', 'GetHealth',
+                                 pb.HealthRequest, pb.HealthResponse)
+        self._submit = unary('JobsService', 'SubmitJob',
+                             pb.SubmitJobRequest, pb.SubmitJobResponse)
+        self._queue = unary('JobsService', 'GetJobQueue',
+                            pb.JobQueueRequest, pb.JobQueueResponse)
+        self._status = unary('JobsService', 'GetJobStatus',
+                             pb.JobStatusRequest, pb.JobStatusResponse)
+        self._cancel = unary('JobsService', 'CancelJobs',
+                             pb.CancelJobsRequest, pb.CancelJobsResponse)
+        self._tail = self._channel.unary_stream(
+            f'/{_PKG}.JobsService/TailLogs',
+            request_serializer=pb.TailLogsRequest.SerializeToString,
+            response_deserializer=pb.TailLogsResponse.FromString)
+        self._set_autostop = unary('AutostopService', 'SetAutostop',
+                                   pb.SetAutostopRequest,
+                                   pb.SetAutostopResponse)
+        self._get_autostop = unary('AutostopService', 'GetAutostop',
+                                   pb.GetAutostopRequest,
+                                   pb.GetAutostopResponse)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def health(self) -> Dict[str, Any]:
+        h = self._get_health(pb.HealthRequest(), timeout=self.timeout)
+        return {'ok': h.ok, 'agent_version': h.agent_version,
+                'cluster_name': h.cluster_name or None, 'time': h.time,
+                'started_at': h.started_at}
+
+    def submit_job(self, spec: Dict[str, Any]) -> int:
+        req = pb.SubmitJobRequest(spec=grpc_server.dict_to_spec(spec))
+        return self._submit(req, timeout=self.timeout).job_id
+
+    def queue(self, all_jobs: bool = False) -> List[Dict[str, Any]]:
+        resp = self._queue(pb.JobQueueRequest(all_jobs=all_jobs),
+                           timeout=self.timeout)
+        out = []
+        for j in resp.jobs:
+            status = grpc_server._PB_TO_STATUS.get(j.status)
+            out.append({'job_id': j.job_id, 'name': j.name or None,
+                        'username': j.username,
+                        'status': status.value if status else None,
+                        'run_timestamp': j.run_timestamp,
+                        'pid': j.pid, 'log_dir': j.log_dir,
+                        'submitted_at': j.submitted_at or None,
+                        'start_at': j.start_at or None,
+                        'end_at': j.end_at or None})
+        return out
+
+    def job_status(self, job_id: int) -> Optional[JobStatus]:
+        try:
+            resp = self._status(pb.JobStatusRequest(job_id=job_id),
+                                timeout=self.timeout)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+        return grpc_server._PB_TO_STATUS.get(resp.status)
+
+    def cancel(self, job_ids: Optional[List[int]] = None) -> List[int]:
+        req = pb.CancelJobsRequest(job_ids=job_ids or [],
+                                   all_jobs=job_ids is None)
+        return list(self._cancel(req, timeout=self.timeout).cancelled)
+
+    def tail_logs(self, job_id: Optional[int] = None, rank: int = 0,
+                  follow: bool = True) -> Iterator[str]:
+        req = pb.TailLogsRequest(job_id=job_id or 0, rank=rank,
+                                 follow=follow)
+        for chunk in self._tail(req):
+            yield chunk.line
+
+    def set_autostop(self, idle_minutes: int, down: bool = True) -> None:
+        self._set_autostop(
+            pb.SetAutostopRequest(idle_minutes=idle_minutes, down=down),
+            timeout=self.timeout)
+
+    def get_autostop(self) -> Dict[str, Any]:
+        resp = self._get_autostop(pb.GetAutostopRequest(),
+                                  timeout=self.timeout)
+        if not resp.set_at:
+            return {}
+        return {'idle_minutes': resp.idle_minutes, 'down': resp.down,
+                'set_at': resp.set_at, 'idle_seconds': resp.idle_seconds}

@@ -1,0 +1,204 @@
+"""gRPC transport for the head agent (reference: sky/skylet/skylet.py:44 —
+the skylet gRPC server; generated service stubs sky/schemas/generated/).
+
+Serves the SAME AgentOps surface as the HTTP app, over the protoc-generated
+messages from schemas/agent.proto.  The service/method wiring uses grpc's
+generic-handler API directly (grpc_python_plugin is not in this build; the
+handlers below are exactly what it would generate, minus the boilerplate).
+
+Method paths follow proto naming: /skypilot_tpu.agent.v1.JobsService/SubmitJob
+etc., so a plugin-generated client elsewhere interoperates unchanged.
+"""
+from __future__ import annotations
+
+import typing
+from typing import List, Optional
+
+import grpc
+
+from skypilot_tpu.schemas.generated import agent_pb2 as pb
+from skypilot_tpu.utils.status_lib import JobStatus
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.agent.ops import AgentOps
+
+_PKG = 'skypilot_tpu.agent.v1'
+
+# JobStatus enum mapping (proto <-> status_lib).
+_STATUS_TO_PB = {
+    JobStatus.INIT: pb.JOB_STATUS_INIT,
+    JobStatus.PENDING: pb.JOB_STATUS_PENDING,
+    JobStatus.SETTING_UP: pb.JOB_STATUS_SETTING_UP,
+    JobStatus.RUNNING: pb.JOB_STATUS_RUNNING,
+    JobStatus.SUCCEEDED: pb.JOB_STATUS_SUCCEEDED,
+    JobStatus.FAILED: pb.JOB_STATUS_FAILED,
+    JobStatus.FAILED_SETUP: pb.JOB_STATUS_FAILED_SETUP,
+    JobStatus.FAILED_DRIVER: pb.JOB_STATUS_FAILED_DRIVER,
+    JobStatus.CANCELLED: pb.JOB_STATUS_CANCELLED,
+}
+_PB_TO_STATUS = {v: k for k, v in _STATUS_TO_PB.items()}
+
+
+def spec_to_dict(spec: pb.JobSpec) -> dict:
+    """JobSpec proto -> the driver's JSON spec dict."""
+    hosts = []
+    for h in spec.hosts:
+        host = {'instance_id': h.instance_id,
+                'internal_ip': h.internal_ip,
+                'workdir': h.workdir or None}
+        if h.HasField('ssh'):
+            host['ssh'] = {'user': h.ssh.user,
+                           'key_path': h.ssh.key_path or None,
+                           'port': h.ssh.port or 22}
+        else:
+            host['ssh'] = None
+        hosts.append(host)
+    return {
+        'job_name': spec.job_name or None,
+        'username': spec.username or 'unknown',
+        'run_timestamp': spec.run_timestamp,
+        'task_id': spec.task_id,
+        'hosts': hosts,
+        # Proto3 cannot carry None in repeated string: '' means "rank is
+        # a no-op" (documented on JobSpec.commands).
+        'commands': [c or None for c in spec.commands],
+        'envs': dict(spec.envs),
+        'num_chips_per_node': spec.num_chips_per_node,
+        'num_slices': spec.num_slices or 1,
+        'docker_container': spec.docker_container or None,
+    }
+
+
+def dict_to_spec(spec: dict) -> pb.JobSpec:
+    """The driver's JSON spec dict -> JobSpec proto (client side)."""
+    out = pb.JobSpec(
+        job_name=spec.get('job_name') or '',
+        username=spec.get('username') or '',
+        run_timestamp=spec.get('run_timestamp') or '',
+        task_id=spec.get('task_id') or '',
+        commands=[c or '' for c in spec.get('commands', [])],
+        num_chips_per_node=int(spec.get('num_chips_per_node') or 0),
+        num_slices=int(spec.get('num_slices') or 1),
+        docker_container=spec.get('docker_container') or '',
+    )
+    for k, v in (spec.get('envs') or {}).items():
+        out.envs[k] = str(v)
+    for h in spec.get('hosts', []):
+        hp = out.hosts.add(instance_id=h.get('instance_id') or '',
+                           internal_ip=h.get('internal_ip') or '',
+                           workdir=h.get('workdir') or '')
+        ssh = h.get('ssh')
+        if ssh:
+            hp.ssh.user = ssh.get('user') or ''
+            hp.ssh.key_path = ssh.get('key_path') or ''
+            hp.ssh.port = int(ssh.get('port') or 22)
+    return out
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString())
+
+
+def _stream(fn, req_cls):
+    return grpc.unary_stream_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString())
+
+
+def make_server(ops: 'AgentOps', port: int,
+                max_workers: int = 8) -> grpc.Server:
+    from concurrent import futures
+
+    def get_health(req, ctx):
+        h = ops.health()
+        return pb.HealthResponse(ok=h['ok'],
+                                 agent_version=h['agent_version'],
+                                 cluster_name=h['cluster_name'] or '',
+                                 time=h['time'],
+                                 started_at=h['started_at'])
+
+    def submit_job(req, ctx):
+        return pb.SubmitJobResponse(
+            job_id=ops.submit(spec_to_dict(req.spec)))
+
+    def get_job_queue(req, ctx):
+        jobs = []
+        for j in ops.queue(req.all_jobs):
+            status = j.get('status')
+            value = (JobStatus(status) if isinstance(status, str)
+                     else status)
+            jobs.append(pb.JobRecord(
+                job_id=j.get('job_id') or 0,
+                name=j.get('name') or '',
+                username=j.get('username') or '',
+                status=_STATUS_TO_PB.get(value,
+                                         pb.JOB_STATUS_UNSPECIFIED),
+                run_timestamp=j.get('run_timestamp') or '',
+                pid=j.get('pid') or 0,
+                log_dir=j.get('log_dir') or '',
+                submitted_at=float(j.get('submitted_at') or 0.0),
+                start_at=float(j.get('start_at') or 0.0),
+                end_at=float(j.get('end_at') or 0.0)))
+        return pb.JobQueueResponse(jobs=jobs)
+
+    def get_job_status(req, ctx):
+        st = ops.job_status(req.job_id)
+        if st is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND,
+                      f'job {req.job_id} not found')
+        return pb.JobStatusResponse(job_id=req.job_id,
+                                    status=_STATUS_TO_PB[st])
+
+    def cancel_jobs(req, ctx):
+        # all_jobs carries the "None = cancel everything" intent (the
+        # HTTP contract); an explicit empty job_ids cancels nothing.
+        ids: Optional[List[int]] = (None if req.all_jobs
+                                    else list(req.job_ids))
+        return pb.CancelJobsResponse(cancelled=ops.cancel(ids))
+
+    def tail_logs(req, ctx):
+        for line in ops.tail_iter(req.job_id or None, req.rank,
+                                  req.follow):
+            yield pb.TailLogsResponse(line=line)
+
+    def set_autostop(req, ctx):
+        ops.set_autostop(req.idle_minutes, req.down)
+        return pb.SetAutostopResponse(ok=True)
+
+    def get_autostop(req, ctx):
+        cfg = ops.get_autostop()
+        return pb.GetAutostopResponse(
+            idle_minutes=int(cfg.get('idle_minutes') or 0),
+            down=bool(cfg.get('down', False)),
+            set_at=float(cfg.get('set_at') or 0.0),
+            idle_seconds=float(cfg.get('idle_seconds') or 0.0))
+
+    server = grpc.server(futures.ThreadPoolExecutor(
+        max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(f'{_PKG}.HealthService', {
+            'GetHealth': _unary(get_health, pb.HealthRequest),
+        }),
+        grpc.method_handlers_generic_handler(f'{_PKG}.JobsService', {
+            'SubmitJob': _unary(submit_job, pb.SubmitJobRequest),
+            'GetJobQueue': _unary(get_job_queue, pb.JobQueueRequest),
+            'GetJobStatus': _unary(get_job_status, pb.JobStatusRequest),
+            'CancelJobs': _unary(cancel_jobs, pb.CancelJobsRequest),
+            'TailLogs': _stream(tail_logs, pb.TailLogsRequest),
+        }),
+        grpc.method_handlers_generic_handler(f'{_PKG}.AutostopService', {
+            'SetAutostop': _unary(set_autostop, pb.SetAutostopRequest),
+            'GetAutostop': _unary(get_autostop, pb.GetAutostopRequest),
+        }),
+    ))
+    server.add_insecure_port(f'0.0.0.0:{port}')
+    return server
+
+
+def serve(ops: 'AgentOps', port: int) -> grpc.Server:
+    """Start the gRPC transport (non-blocking; grpc owns its threads)."""
+    server = make_server(ops, port)
+    server.start()
+    return server

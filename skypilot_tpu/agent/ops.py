@@ -1,0 +1,122 @@
+"""Transport-agnostic agent operations.
+
+One implementation of the skylet-equivalent service surface, shared by the
+JSON/HTTP app (agent/server.py) and the gRPC server (agent/grpc_server.py)
+so the two transports cannot drift (reference: sky/skylet/services.py — one
+service impl behind the gRPC server, sky/skylet/skylet.py:44).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from skypilot_tpu.agent import job_lib, log_lib
+from skypilot_tpu.utils.status_lib import JobStatus
+
+AGENT_VERSION = 2  # v2: gRPC transport alongside HTTP
+
+
+class AgentState:
+
+    def __init__(self, base_dir: str,
+                 cluster_name: Optional[str] = None,
+                 grpc_port: Optional[int] = None) -> None:
+        self.base_dir = os.path.expanduser(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.job_table = job_lib.JobTable(
+            os.path.join(self.base_dir, 'jobs.db'))
+        self.autostop_path = os.path.join(self.base_dir, 'autostop.json')
+        self.cluster_name = cluster_name
+        self.started_at = time.time()
+        self.grpc_port = grpc_port
+
+    def log_dir_for(self, job_id: int) -> str:
+        return os.path.join(self.base_dir, 'logs', f'job-{job_id}')
+
+
+class AgentOps:
+    """The service surface.  All methods are synchronous and blocking;
+    the HTTP app calls them from executors, gRPC from its thread pool."""
+
+    def __init__(self, state: AgentState) -> None:
+        self.state = state
+
+    def health(self) -> Dict[str, Any]:
+        return {'ok': True, 'agent_version': AGENT_VERSION,
+                'cluster_name': self.state.cluster_name,
+                'time': time.time(),
+                'started_at': self.state.started_at,
+                'grpc_port': self.state.grpc_port}
+
+    def submit(self, spec: Dict[str, Any]) -> int:
+        state = self.state
+        job_id = state.job_table.add_job(
+            name=spec.get('job_name'),
+            username=spec.get('username', 'unknown'),
+            run_timestamp=spec.get('run_timestamp', ''),
+            log_dir='',
+            spec=spec)
+        log_dir = state.log_dir_for(job_id)
+        state.job_table.set_log_dir(job_id, log_dir)
+        spec['log_dir'] = log_dir
+        spec['job_id'] = job_id
+        spec['job_db'] = state.job_table.db_path
+        os.makedirs(log_dir, exist_ok=True)
+        spec_path = os.path.join(log_dir, 'spec.json')
+        with open(spec_path, 'w', encoding='utf-8') as f:
+            json.dump(spec, f)
+        state.job_table.set_status(job_id, JobStatus.PENDING)
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.agent.driver', spec_path],
+            stdout=open(os.path.join(log_dir, 'driver.log'), 'ab'),
+            stderr=subprocess.STDOUT,
+            start_new_session=True)
+        state.job_table.set_pid(job_id, proc.pid)
+        # Pid file so teardown can reap the (own-session) driver even
+        # after the agent dies (see provision/local terminate path).
+        with open(os.path.join(log_dir, 'driver.pid'), 'w',
+                  encoding='utf-8') as f:
+            f.write(str(proc.pid))
+        return job_id
+
+    def queue(self, all_jobs: bool) -> List[Dict[str, Any]]:
+        return self.state.job_table.queue(all_jobs)
+
+    def job_status(self, job_id: int) -> Optional[JobStatus]:
+        return self.state.job_table.get_status(job_id)
+
+    def cancel(self, job_ids: Optional[List[int]]) -> List[int]:
+        return self.state.job_table.cancel(job_ids)
+
+    def latest_job_id(self) -> Optional[int]:
+        return self.state.job_table.get_latest_job_id()
+
+    def tail_iter(self, job_id: Optional[int], rank: int,
+                  follow: bool) -> Iterator[str]:
+        if job_id is None:
+            job_id = self.latest_job_id()
+        if job_id is None:
+            return iter(())
+        log_path = os.path.join(self.state.log_dir_for(job_id),
+                                f'rank-{rank}.log')
+
+        def _done() -> bool:
+            st = self.state.job_table.get_status(job_id)
+            return st is not None and st.is_terminal()
+
+        return log_lib.tail_logs(log_path, follow=follow, stop_when=_done)
+
+    def set_autostop(self, idle_minutes: int, down: bool) -> None:
+        with open(self.state.autostop_path, 'w', encoding='utf-8') as f:
+            json.dump({'idle_minutes': idle_minutes, 'down': bool(down),
+                       'set_at': time.time()}, f)
+
+    def get_autostop(self) -> Dict[str, Any]:
+        if not os.path.exists(self.state.autostop_path):
+            return {}
+        with open(self.state.autostop_path, encoding='utf-8') as f:
+            return json.load(f)

@@ -2,9 +2,11 @@
 
 Reference parity: the skylet daemon (sky/skylet/skylet.py:44 — gRPC server
 on port 46590 serving Autostop/Jobs services, plus the periodic EVENTS loop
-:26-41).  grpc_tools is unavailable in this build, so the transport is
-JSON-over-HTTP (aiohttp) with the same service shapes; the proto contracts
-live in skypilot_tpu/schemas/agent.md for a later grpc codegen.
+:26-41).  Two transports serve the SAME AgentOps surface (agent/ops.py):
+JSON-over-HTTP here (aiohttp, primary/fallback) and gRPC from the protoc-
+generated agent.proto stubs (agent/grpc_server.py, on port+1, advertised
+in /health as grpc_port).  Clients prefer gRPC when the handshake shows
+agent_version >= 2 (agent/client.py).
 
 Endpoints:
   GET  /health                  → {ok, agent_version, time}
@@ -30,27 +32,11 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
-from skypilot_tpu.agent import job_lib, log_lib
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.agent.ops import AGENT_VERSION, AgentOps, AgentState
 from skypilot_tpu.utils.status_lib import JobStatus
 
-AGENT_VERSION = 1
 DEFAULT_PORT = 46590  # same port as the reference's skylet gRPC
-
-
-class AgentState:
-
-    def __init__(self, base_dir: str,
-                 cluster_name: Optional[str] = None) -> None:
-        self.base_dir = os.path.expanduser(base_dir)
-        os.makedirs(self.base_dir, exist_ok=True)
-        self.job_table = job_lib.JobTable(
-            os.path.join(self.base_dir, 'jobs.db'))
-        self.autostop_path = os.path.join(self.base_dir, 'autostop.json')
-        self.cluster_name = cluster_name
-        self.started_at = time.time()
-
-    def log_dir_for(self, job_id: int) -> str:
-        return os.path.join(self.base_dir, 'logs', f'job-{job_id}')
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -59,58 +45,30 @@ def _json_error(status: int, message: str) -> web.Response:
 
 def make_app(state: AgentState) -> web.Application:
     routes = web.RouteTableDef()
+    ops = AgentOps(state)
 
     @routes.get('/health')
     async def health(request: web.Request) -> web.Response:
         # cluster_name lets clients verify they reached THE agent for
         # their cluster, not another agent that won a port-bind race
         # (possible on the local cloud where all agents share localhost).
-        return web.json_response({'ok': True, 'agent_version': AGENT_VERSION,
-                                  'cluster_name': state.cluster_name,
-                                  'time': time.time(),
-                                  'started_at': state.started_at})
+        return web.json_response(ops.health())
 
     @routes.post('/jobs/submit')
     async def submit(request: web.Request) -> web.Response:
         spec: Dict[str, Any] = await request.json()
-        job_id = state.job_table.add_job(
-            name=spec.get('job_name'),
-            username=spec.get('username', 'unknown'),
-            run_timestamp=spec.get('run_timestamp', ''),
-            log_dir='',
-            spec=spec)
-        log_dir = state.log_dir_for(job_id)
-        state.job_table.set_log_dir(job_id, log_dir)
-        spec['log_dir'] = log_dir
-        spec['job_id'] = job_id
-        spec['job_db'] = state.job_table.db_path
-        os.makedirs(log_dir, exist_ok=True)
-        spec_path = os.path.join(log_dir, 'spec.json')
-        with open(spec_path, 'w', encoding='utf-8') as f:
-            json.dump(spec, f)
-        state.job_table.set_status(job_id, JobStatus.PENDING)
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.agent.driver', spec_path],
-            stdout=open(os.path.join(log_dir, 'driver.log'), 'ab'),
-            stderr=subprocess.STDOUT,
-            start_new_session=True)
-        state.job_table.set_pid(job_id, proc.pid)
-        # Pid file so teardown can reap the (own-session) driver even
-        # after the agent dies (see provision/local terminate path).
-        with open(os.path.join(log_dir, 'driver.pid'), 'w',
-                  encoding='utf-8') as f:
-            f.write(str(proc.pid))
+        job_id = await asyncio.to_thread(ops.submit, spec)
         return web.json_response({'job_id': job_id})
 
     @routes.get('/jobs/queue')
     async def queue(request: web.Request) -> web.Response:
         all_jobs = request.query.get('all', '0') == '1'
-        return web.json_response({'jobs': state.job_table.queue(all_jobs)})
+        return web.json_response({'jobs': ops.queue(all_jobs)})
 
     @routes.get('/jobs/status')
     async def status(request: web.Request) -> web.Response:
         job_id = int(request.query['job_id'])
-        st = state.job_table.get_status(job_id)
+        st = ops.job_status(job_id)
         if st is None:
             return _json_error(404, f'job {job_id} not found')
         return web.json_response({'job_id': job_id, 'status': st.value})
@@ -118,33 +76,24 @@ def make_app(state: AgentState) -> web.Application:
     @routes.post('/jobs/cancel')
     async def cancel(request: web.Request) -> web.Response:
         body = await request.json() if request.can_read_body else {}
-        job_ids = body.get('job_ids')
-        cancelled = state.job_table.cancel(job_ids)
+        cancelled = ops.cancel(body.get('job_ids'))
         return web.json_response({'cancelled': cancelled})
 
     @routes.get('/jobs/tail')
     async def tail(request: web.Request) -> web.StreamResponse:
         job_id_s = request.query.get('job_id')
-        job_id = (int(job_id_s) if job_id_s
-                  else state.job_table.get_latest_job_id())
+        job_id = (int(job_id_s) if job_id_s else ops.latest_job_id())
         if job_id is None:
             return _json_error(404, 'no jobs')
         rank = int(request.query.get('rank', 0))
         # Default matches the proto3 contract: follow=false → read the
         # current log and EOF.  Clients wanting a stream pass follow=1.
         follow = request.query.get('follow', '0') == '1'
-        log_path = os.path.join(state.log_dir_for(job_id),
-                                f'rank-{rank}.log')
         resp = web.StreamResponse(
             headers={'Content-Type': 'text/plain; charset=utf-8'})
         await resp.prepare(request)
-
-        def _done() -> bool:
-            st = state.job_table.get_status(job_id)
-            return st is not None and st.is_terminal()
-
         loop = asyncio.get_running_loop()
-        it = log_lib.tail_logs(log_path, follow=follow, stop_when=_done)
+        it = ops.tail_iter(job_id, rank, follow)
         while True:
             line = await loop.run_in_executor(None,
                                               lambda: next(it, None))
@@ -162,18 +111,12 @@ def make_app(state: AgentState) -> web.Application:
             # default (false = stop-when-idle) is unsupported for TPU
             # pod slices, so an implicit default would surprise.
             return _json_error(400, "'down' must be set explicitly")
-        with open(state.autostop_path, 'w', encoding='utf-8') as f:
-            json.dump({'idle_minutes': body.get('idle_minutes'),
-                       'down': bool(body['down']),
-                       'set_at': time.time()}, f)
+        ops.set_autostop(body.get('idle_minutes'), bool(body['down']))
         return web.json_response({'ok': True})
 
     @routes.get('/autostop')
     async def get_autostop(request: web.Request) -> web.Response:
-        if not os.path.exists(state.autostop_path):
-            return web.json_response({})
-        with open(state.autostop_path, encoding='utf-8') as f:
-            return web.json_response(json.load(f))
+        return web.json_response(ops.get_autostop())
 
     app = web.Application()
     app.add_routes(routes)
@@ -224,9 +167,27 @@ def main(argv: Optional[list] = None) -> None:
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
     parser.add_argument('--event-interval', type=float, default=20.0)
     parser.add_argument('--cluster-name', default=None)
+    parser.add_argument('--grpc-port', type=int, default=None,
+                        help='gRPC transport port (default: port+1; '
+                             '0 disables)')
     args = parser.parse_args(argv)
-    state = AgentState(args.base_dir, cluster_name=args.cluster_name)
+    grpc_port = (args.port + 1 if args.grpc_port is None
+                 else (args.grpc_port or None))
+    state = AgentState(args.base_dir, cluster_name=args.cluster_name,
+                       grpc_port=grpc_port)
     app = make_app(state)
+    grpc_srv = None  # keep the reference: grpc.Server stops when GC'd
+    if grpc_port:
+        # Best-effort: a grpc bind/import failure must not take down the
+        # HTTP transport (which every client can fall back to).
+        try:
+            from skypilot_tpu.agent import grpc_server
+            grpc_srv = grpc_server.serve(AgentOps(state), grpc_port)
+        except Exception as e:  # pylint: disable=broad-except
+            state.grpc_port = None
+            print(f'agent: gRPC transport unavailable ({e}); '
+                  f'HTTP only', file=sys.stderr)
+    app['grpc_server'] = grpc_srv
 
     async def _run() -> None:
         runner = web.AppRunner(app)

@@ -90,8 +90,12 @@ class TpuBackend:
             return
         runners = provisioner._make_runners(handle.cluster_info)
         src = os.path.join(os.path.expanduser(workdir), '')
-        for runner in runners:
-            runner.rsync(src, _WORKDIR_NAME + '/', up=True)
+        errors = runner_lib.rsync_on_hosts_parallel(
+            runners, src, _WORKDIR_NAME + '/', up=True)
+        bad = {i: e for i, e in enumerate(errors) if e is not None}
+        if bad:
+            raise exceptions.CommandError(
+                255, 'sync_workdir', f'rsync failed on hosts {bad}')
 
     def sync_file_mounts(self, handle: state.ClusterHandle,
                          file_mounts: Dict[str, Any]) -> None:
@@ -103,9 +107,14 @@ class TpuBackend:
                 from skypilot_tpu.data import storage as storage_lib
                 storage_lib.mount_storage(handle, target, src)
                 continue
-            for runner in runners:
-                runner.rsync(os.path.expanduser(src), target.lstrip('/'),
-                             up=True)
+            errors = runner_lib.rsync_on_hosts_parallel(
+                runners, os.path.expanduser(src), target.lstrip('/'),
+                up=True)
+            bad = {i: e for i, e in enumerate(errors) if e is not None}
+            if bad:
+                raise exceptions.CommandError(
+                    255, f'sync_file_mounts {target}',
+                    f'rsync failed on hosts {bad}')
 
     def mount_volumes(self, handle: state.ClusterHandle,
                       volumes: Dict[str, str]) -> None:

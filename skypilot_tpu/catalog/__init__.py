@@ -25,6 +25,46 @@ from skypilot_tpu.utils import tpu_utils
 
 _DATA_DIR = os.path.join(os.path.dirname(__file__), 'data')
 
+# Schema version of the catalog CSVs.  Cached catalogs live under
+# ~/.skypilot_tpu/catalogs/<schema-version>/ (reference:
+# sky/catalog/common.py:211-212 caching under ~/.sky/catalogs/<ver>) so a
+# fetcher upgrade that changes columns invalidates old caches by path.
+CATALOG_SCHEMA_VERSION = 'v1'
+
+
+def _cache_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_CATALOG_DIR',
+                       '~/.skypilot_tpu/catalogs')) + \
+        f'/{CATALOG_SCHEMA_VERSION}'
+
+
+def _data_path(filename: str) -> str:
+    """A refreshed cache copy wins over the packaged snapshot."""
+    cached = os.path.join(_cache_dir(), filename)
+    if os.path.exists(cached):
+        return cached
+    return os.path.join(_DATA_DIR, filename)
+
+
+def refresh(fetch: bool = True) -> str:
+    """Regenerate the cached catalog under ~/.skypilot_tpu/catalogs/<ver>
+    via the billing-API fetcher (`skytpu catalog refresh`).  Returns the
+    cache directory.  With fetch=False just clears loader caches (tests)."""
+    if fetch:
+        from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+        os.makedirs(_cache_dir(), exist_ok=True)
+        rc = fetch_gcp.fetch_to(os.path.join(_cache_dir(),
+                                             'gcp_tpus.csv'))
+        if rc != 0:
+            raise exceptions.CommandError(
+                rc, 'catalog refresh',
+                'The billing-API fetch returned no rows; the existing '
+                'catalog was left untouched.')
+    _load_tpu_rows.cache_clear()
+    _load_instance_rows.cache_clear()
+    return _cache_dir()
+
 
 @dataclasses.dataclass(frozen=True)
 class TpuOffering:
@@ -49,14 +89,13 @@ class InstanceOffering:
 
 @functools.lru_cache()
 def _load_tpu_rows() -> List[Dict[str, str]]:
-    with open(os.path.join(_DATA_DIR, 'gcp_tpus.csv'), encoding='utf-8') as f:
+    with open(_data_path('gcp_tpus.csv'), encoding='utf-8') as f:
         return list(csv.DictReader(f))
 
 
 @functools.lru_cache()
 def _load_instance_rows() -> List[Dict[str, str]]:
-    with open(os.path.join(_DATA_DIR, 'gcp_instances.csv'),
-              encoding='utf-8') as f:
+    with open(_data_path('gcp_instances.csv'), encoding='utf-8') as f:
         return list(csv.DictReader(f))
 
 

@@ -163,6 +163,23 @@ def _cmd_show_tpus(args) -> int:
     return 0
 
 
+def _cmd_catalog(args) -> int:
+    from skypilot_tpu import catalog
+    if args.catalog_cmd == 'refresh':
+        path = catalog.refresh()
+        print(f'Catalog cache refreshed at {path} '
+              f'(schema {catalog.CATALOG_SCHEMA_VERSION}).')
+        return 0
+    # default: show cache status
+    import os
+    cache = catalog._cache_dir()
+    state = 'cached' if os.path.exists(
+        os.path.join(cache, 'gcp_tpus.csv')) else 'packaged snapshot'
+    print(f'Catalog schema {catalog.CATALOG_SCHEMA_VERSION}; source: '
+          f'{state} ({cache}).')
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     import skypilot_tpu
     parser = argparse.ArgumentParser(
@@ -233,6 +250,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser('show-tpus', help='List TPU offerings and prices')
     p.add_argument('filter', nargs='?', default=None)
     p.set_defaults(fn=_cmd_show_tpus)
+
+    p = sub.add_parser('catalog', help='Offering catalog cache')
+    p.add_argument('catalog_cmd', nargs='?', default='status',
+                   choices=['status', 'refresh'])
+    p.set_defaults(fn=_cmd_catalog)
 
     # Jobs / serve groups (registered lazily to keep import light).
     try:

@@ -174,6 +174,13 @@ class GCP(cloud_lib.Cloud):
         authentication.setup_gcp_authentication(variables)
         return variables
 
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # GCP internet egress, standard tier ballpark (reference:
+        # sky/clouds/gcp.py get_egress_cost — tiered ~$0.085-0.12/GB;
+        # one flat rate keeps the optimizer's chain DP honest without a
+        # tier table).
+        return 0.12 * num_gigabytes
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         # Application-default credentials or service-account key present?
         adc = os.path.expanduser(

@@ -79,6 +79,39 @@ function fmtCost(c) {
 
 // --- pages -------------------------------------------------------------
 
+// --- actions (cancel/down/logs; reference: dashboard row actions) ------
+
+async function actDown(name) {
+  if (!confirm(`Terminate cluster ${name}?`)) return;
+  try {
+    await apiCall('/down', {cluster_name: name});
+  } catch (e) {
+    alert(`down failed: ${e.message}`);
+  }
+  navigate();
+}
+
+async function actCancelJob(jobId) {
+  if (!confirm(`Cancel managed job ${jobId}?`)) return;
+  try {
+    await apiCall('/jobs/cancel', {job_ids: [Number(jobId)]});
+  } catch (e) {
+    alert(`cancel failed: ${e.message}`);
+  }
+  navigate();
+}
+
+async function actCancelClusterJob(cluster, jobId) {
+  if (!confirm(`Cancel job ${jobId} on ${cluster}?`)) return;
+  try {
+    await apiCall('/cancel', {cluster_name: cluster,
+                              job_ids: [Number(jobId)]});
+  } catch (e) {
+    alert(`cancel failed: ${e.message}`);
+  }
+  navigate();
+}
+
 const PAGES = {
   clusters: {
     title: 'Clusters',
@@ -87,15 +120,51 @@ const PAGES = {
       const up = rows.filter((c) => c.status === 'UP').length;
       return cards([[rows.length, 'clusters'], [up, 'up']]) +
         table(
-          ['Name', 'Status', 'Infra', 'Resources', 'Cost', 'Launched'],
+          ['Name', 'Status', 'Infra', 'Resources', 'Cost', 'Launched',
+           'Actions'],
           rows.map((c) => [
-            `<span class="mono">${esc(c.name)}</span>`,
+            `<a class="mono" href="#cluster/${esc(c.name)}">` +
+                `${esc(c.name)}</a>`,
             badge(c.status),
             esc(c.infra || [c.cloud, c.region].filter(Boolean).join('/')),
             `<span class="mono">${esc(c.resources_str || '-')}</span>`,
             fmtCost(c.cost_per_hour),
             fmtTime(c.launched_at),
+            `<button class="action" data-act="down" ` +
+                `data-name="${esc(c.name)}">down</button>`,
           ]));
+    },
+  },
+  cluster: {
+    title: 'Cluster',
+    async render(arg) {
+      const jobs = await apiGet(
+          `/api/cluster_jobs?cluster=${encodeURIComponent(arg)}`);
+      return `<h3 class="mono">${esc(arg)}</h3>` + table(
+        ['Job', 'Name', 'Status', 'Submitted', 'Actions'],
+        jobs.map((j) => [
+          esc(j.job_id),
+          `<span class="mono">${esc(j.name || '-')}</span>`,
+          badge(j.status),
+          fmtTime(j.submitted_at),
+          `<a href="#logs/${esc(arg)}/${esc(j.job_id)}">logs</a> ` +
+          `<button class="action" data-act="cancel-cluster-job" ` +
+              `data-name="${esc(arg)}" data-job="${Number(j.job_id)}">` +
+              'cancel</button>',
+        ]));
+    },
+  },
+  logs: {
+    title: 'Job logs',
+    async render(arg) {
+      const [cluster, jobId] = String(arg).split('/');
+      const r = await fetch(
+          `/api/cluster_logs?cluster=${encodeURIComponent(cluster)}` +
+          `&job_id=${encodeURIComponent(jobId)}`);
+      if (!r.ok) throw new Error(`logs: HTTP ${r.status}`);
+      const text = await r.text();
+      return `<h3 class="mono">${esc(cluster)} · job ${esc(jobId)}</h3>` +
+          `<pre class="logview">${esc(text) || '(empty log)'}</pre>`;
     },
   },
   jobs: {
@@ -107,7 +176,8 @@ const PAGES = {
               .includes(j.status)).length;
       return cards([[rows.length, 'jobs'], [active, 'active']]) +
         table(
-          ['ID', 'Name', 'Status', 'Resources', 'Recoveries', 'Submitted'],
+          ['ID', 'Name', 'Status', 'Resources', 'Recoveries', 'Submitted',
+           'Actions'],
           rows.map((j) => [
             esc(j.job_id),
             `<span class="mono">${esc(j.name || '-')}</span>`,
@@ -115,6 +185,8 @@ const PAGES = {
             `<span class="mono">${esc(j.resources_str || '-')}</span>`,
             esc(j.recovery_count ?? 0),
             fmtTime(j.submitted_at),
+            `<button class="action" data-act="cancel-job" ` +
+                `data-job="${Number(j.job_id)}">cancel</button>`,
           ]));
     },
   },
@@ -207,7 +279,11 @@ const PAGES = {
 let currentPage = null;
 
 async function navigate() {
-  const page = (location.hash || '#clusters').slice(1);
+  const hash = (location.hash || '#clusters').slice(1);
+  // Routes: 'page' or 'page/arg' (e.g. cluster/<name>, logs/<c>/<id>).
+  const slash = hash.indexOf('/');
+  const page = slash === -1 ? hash : hash.slice(0, slash);
+  const arg = slash === -1 ? null : hash.slice(slash + 1);
   const spec = PAGES[page] || PAGES.clusters;
   currentPage = page;
   document.querySelectorAll('.nav-link').forEach((a) =>
@@ -216,12 +292,25 @@ async function navigate() {
       '<button class="refresh" onclick="navigate()">⟳ refresh</button>';
   $('#page-body').innerHTML = '<div class="loading">Loading…</div>';
   try {
-    $('#page-body').innerHTML = await spec.render();
+    $('#page-body').innerHTML = await spec.render(arg);
   } catch (e) {
     $('#page-body').innerHTML =
         `<div class="error-box">${esc(e.message)}</div>`;
   }
 }
+// Delegated action clicks: names/ids ride data-attributes, never
+// string-built JS (a quote in a cluster name must not break out of — or
+// inject into — an inline handler).
+document.addEventListener('click', (ev) => {
+  const btn = ev.target.closest('button.action');
+  if (!btn) return;
+  const {act, name, job} = btn.dataset;
+  if (act === 'down') actDown(name);
+  else if (act === 'cancel-job') actCancelJob(Number(job));
+  else if (act === 'cancel-cluster-job') {
+    actCancelClusterJob(name, Number(job));
+  }
+});
 
 async function showServerInfo() {
   try {

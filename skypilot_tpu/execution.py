@@ -49,6 +49,14 @@ def _execute(task: task_lib.Task,
              blocked_resources=None,
              ) -> Tuple[Optional[int], Optional[state.ClusterHandle]]:
     backend = TpuBackend()
+    # Admin policy first: organizations mutate/validate every request
+    # before any stage runs (reference: admin_policy_utils application at
+    # the top of sky/execution.py's _execute).  A rejecting policy's
+    # exception propagates to the user untouched.
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(
+        task, admin_policy.RequestOptions(cluster_name=cluster_name,
+                                          down=down))
     with config_lib.override_config(task.config_overrides):
         if Stage.OPTIMIZE in stages:
             record = state.get_cluster(cluster_name)

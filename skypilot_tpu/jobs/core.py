@@ -1,15 +1,25 @@
 """Managed-jobs user API: launch/queue/cancel/logs.
 
-Reference parity: sky/jobs/ client+server routes.  The controller daemon is
-spawned on first use (a local process standing in for the reference's
-jobs-controller VM; see skypilot_tpu/jobs/controller.py docstring).
+Reference parity: sky/jobs/ client+server routes.  Two controller modes
+(mirroring the reference's jobs-controller-VM architecture, SURVEY §3.3):
+
+- default: the controller daemon is a local process spawned on first use;
+- ``jobs.controller.resources`` configured (e.g. ``{cloud: gcp, cpus: 4}``):
+  a dedicated controller CLUSTER is launched as an ordinary cluster (the
+  reference's templates/jobs-controller.yaml.j2 path), task specs are
+  shipped to it, and the managed-jobs Scheduler runs THERE — the same
+  engine in a different place (SURVEY §1 "the same engine runs in three
+  places").
 """
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
@@ -20,6 +30,8 @@ from skypilot_tpu.jobs.state import JobsTable, ManagedJobStatus
 logger = sky_logging.init_logger(__name__)
 
 _DAEMON_PID = '~/.skypilot_tpu/jobs_controller.pid'
+CONTROLLER_CLUSTER = 'skytpu-jobs-controller'
+_JSON_MARKER = 'SKYTPU_JSON:'
 
 
 def _daemon_running() -> bool:
@@ -51,11 +63,127 @@ def ensure_controller() -> None:
     time.sleep(0.5)
 
 
+# ---------------------------------------------------------------------------
+# Remote controller mode
+# ---------------------------------------------------------------------------
+
+def _controller_resources_config() -> Optional[Dict[str, Any]]:
+    from skypilot_tpu import config
+    return config.get_nested(('jobs', 'controller', 'resources'), None)
+
+
+def _ensure_remote_controller():
+    """Launch or reuse the dedicated controller cluster; returns its
+    handle.  The controller is an ordinary cluster: provisioning installs
+    the framework wheel on it, which is all the controller needs."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+    if record is not None and \
+            record['status'] == state_lib.ClusterStatus.UP:
+        return record['handle']
+    spec = dict(_controller_resources_config() or {})
+    controller_task = task_lib.Task(name='jobs-controller', run='true')
+    controller_task.set_resources(resources_lib.Resources(**spec))
+    _, handle = execution.launch(controller_task,
+                                 cluster_name=CONTROLLER_CLUSTER,
+                                 detach_run=True)
+    return handle
+
+
+def _run_on_controller(handle, cmd: str,
+                       stream: bool = False) -> tuple:
+    """Run `cmd` on the controller head; returns (rc, captured output)."""
+    from skypilot_tpu.provision.provisioner import _make_runners
+    runner = _make_runners(handle.cluster_info)[0]
+    env = None
+    if handle.cluster_info.cloud == 'local':
+        # Hermetic local-cloud controller: its state lives under the
+        # fake host's directory, not the client's ~/.skypilot_tpu.
+        env = {'HOME': handle.cluster_info.head.workdir}
+    with tempfile.NamedTemporaryFile('r', suffix='.log') as log_f:
+        rc = runner.run(cmd, env=env, log_path=log_f.name,
+                        stream_logs=stream)
+        return rc, log_f.read()
+
+
+def _parse_marker(output: str) -> Dict[str, Any]:
+    for line in reversed(output.splitlines()):
+        if line.startswith(_JSON_MARKER):
+            return json.loads(line[len(_JSON_MARKER):])
+    raise exceptions.CommandError(
+        1, 'jobs.remote', f'No controller response in output:\n{output}')
+
+
+def _remote_launch(task: task_lib.Task, name: Optional[str]) -> int:
+    handle = _ensure_remote_controller()
+    if name:
+        task.name = name
+    spec_name = f'job-{uuid.uuid4().hex[:8]}.yaml'
+    remote_dir = '.skypilot_tpu/managed_specs'
+    with tempfile.TemporaryDirectory() as tmp:
+        local_path = os.path.join(tmp, spec_name)
+        with open(local_path, 'w', encoding='utf-8') as f:
+            import yaml
+            yaml.safe_dump(task.to_yaml_config(), f)
+        rc, _ = _run_on_controller(handle, f'mkdir -p {remote_dir}')
+        from skypilot_tpu.provision.provisioner import _make_runners
+        runner = _make_runners(handle.cluster_info)[0]
+        runner.rsync(local_path, f'{remote_dir}/{spec_name}', up=True)
+    rc, out = _run_on_controller(
+        handle, f'python3 -m skypilot_tpu.jobs.remote submit '
+                f'{remote_dir}/{spec_name}')
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'jobs.remote submit', out[-2000:])
+    job_id = int(_parse_marker(out)['job_id'])
+    logger.info(f'Managed job {job_id} ({task.name!r}) submitted to '
+                f'controller cluster {CONTROLLER_CLUSTER!r}.')
+    return job_id
+
+
+def _remote_queue(skip_finished: bool) -> List[Dict[str, Any]]:
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+    if record is None:
+        return []
+    flag = '' if skip_finished else ' --all'
+    rc, out = _run_on_controller(
+        record['handle'], f'python3 -m skypilot_tpu.jobs.remote queue{flag}')
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'jobs.remote queue', out[-2000:])
+    jobs = _parse_marker(out)['jobs']
+    for j in jobs:
+        j['status'] = ManagedJobStatus(j['status'])
+    return jobs
+
+
+def _remote_cancel(job_ids: Optional[List[int]]) -> List[int]:
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+    if record is None:
+        return []
+    ids = ' '.join(str(i) for i in (job_ids or []))
+    rc, out = _run_on_controller(
+        record['handle'],
+        f'python3 -m skypilot_tpu.jobs.remote cancel {ids}'.rstrip())
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'jobs.remote cancel', out[-2000:])
+    return list(_parse_marker(out)['cancelled'])
+
+
 def launch(task: task_lib.Task, name: Optional[str] = None,
            pool: Optional[str] = None) -> int:
     """Submit a managed job; returns the managed job id.  With `pool`,
     the job execs onto an idle worker of that pool instead of
     provisioning its own cluster (reference: `sky jobs launch --pool`)."""
+    if pool is None and _controller_resources_config() is not None:
+        return _remote_launch(task, name)
+    return _local_launch(task, name=name, pool=pool)
+
+
+def _local_launch(task: task_lib.Task, name: Optional[str] = None,
+                  pool: Optional[str] = None) -> int:
     from skypilot_tpu import config
     if pool is not None:
         from skypilot_tpu.jobs import pool as pool_lib
@@ -81,10 +209,18 @@ def launch(task: task_lib.Task, name: Optional[str] = None,
 
 
 def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    if _controller_resources_config() is not None:
+        return _remote_queue(skip_finished)
     return JobsTable().list(skip_finished=skip_finished)
 
 
 def cancel(job_ids: Optional[List[int]] = None) -> List[int]:
+    if _controller_resources_config() is not None:
+        return _remote_cancel(job_ids)
+    return _local_cancel(job_ids)
+
+
+def _local_cancel(job_ids: Optional[List[int]] = None) -> List[int]:
     table = JobsTable()
     targets = job_ids or [j['job_id'] for j in table.list(skip_finished=True)]
     out = []
@@ -101,6 +237,17 @@ def tail_logs(job_id: int, follow: bool = True) -> int:
     """Stream the underlying cluster job's rank-0 log."""
     from skypilot_tpu import core as core_lib
     from skypilot_tpu import state as state_lib
+    if _controller_resources_config() is not None:
+        record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+        if record is None:
+            print(f'Managed job {job_id}: controller cluster not up.')
+            return 1
+        flag = '' if follow else ' --no-follow'
+        rc, _ = _run_on_controller(
+            record['handle'],
+            f'python3 -m skypilot_tpu.client.cli jobs logs {job_id}{flag}',
+            stream=True)
+        return rc
     table = JobsTable()
     record = table.get(job_id)
     if record is None:

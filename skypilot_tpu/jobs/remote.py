@@ -1,0 +1,60 @@
+"""Controller-side entry points for a REMOTE jobs controller.
+
+Reference parity: the jobs-controller VM architecture (SURVEY.md §1/§3.3 —
+"controllers are ordinary SkyPilot clusters that import sky and call
+execution.launch() themselves", sky/jobs/controller.py:17-40).  The client
+ships a task YAML to the controller cluster and invokes this module over
+the cluster's command runner:
+
+    python3 -m skypilot_tpu.jobs.remote submit <yaml-path>
+    python3 -m skypilot_tpu.jobs.remote queue [--all]
+    python3 -m skypilot_tpu.jobs.remote cancel [job-id ...]
+
+Each command prints exactly one result line prefixed with ``SKYTPU_JSON:``
+so the client can parse it out of mixed log output.  Everything else
+(scheduler daemon, recovery strategies, state) is the same code the local
+controller mode uses — the controller IS the library, running elsewhere.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+_MARKER = 'SKYTPU_JSON:'
+
+
+def _emit(payload) -> None:
+    # default=str: job rows carry enums (e.g. schedule_state) the client
+    # only displays; only `status` is reconstructed as an enum there.
+    print(f'{_MARKER} {json.dumps(payload, default=str)}', flush=True)
+
+
+def main(argv) -> int:
+    cmd = argv[0] if argv else ''
+    if cmd == 'submit':
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.jobs import core
+        task = task_lib.Task.from_yaml(argv[1])
+        # _local_launch: we ARE the controller — a jobs.controller config
+        # key on this host must not recurse into another remote hop.
+        job_id = core._local_launch(task, name=task.name)  # noqa: SLF001
+        _emit({'job_id': job_id})
+        return 0
+    if cmd == 'queue':
+        from skypilot_tpu.jobs.state import JobsTable
+        rows = JobsTable().list(skip_finished='--all' not in argv)
+        for r in rows:
+            r['status'] = r['status'].value
+        _emit({'jobs': rows})
+        return 0
+    if cmd == 'cancel':
+        from skypilot_tpu.jobs import core
+        ids = [int(a) for a in argv[1:]] or None
+        _emit({'cancelled': core._local_cancel(ids)})  # noqa: SLF001
+        return 0
+    print(f'unknown jobs.remote command {cmd!r}', file=sys.stderr)
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

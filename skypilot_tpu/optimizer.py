@@ -2,25 +2,43 @@
 
 Reference parity: Optimizer.optimize sky/optimizer.py:109, _optimize_dag
 :1035, _fill_in_launchable_resources :1318, _estimate_nodes_cost_or_time
-:239.  Differences by design: the candidate space is TPU offerings + GCE
-controller shapes (no 22-cloud matrix), so the DAG pass is exact dynamic
-programming over chains instead of the reference's approximate enumeration;
-egress cost between consecutive tasks uses Cloud.get_egress_cost.
+:239.  The candidate space is TPU offerings + GCE controller shapes (no
+22-cloud matrix), which keeps the chain pass EXACT: dynamic programming
+over (task, candidate) states with inter-task egress on the transitions,
+instead of the reference's per-node enumeration with the same DP shape
+(sky/optimizer.py:1035's topological pass).
+
+Cost model per candidate: hourly price × estimated runtime × num_nodes
+(runtime from Task.set_time_estimator, default 1h), plus egress between
+consecutive chain tasks placed on different clouds
+(src Cloud.get_egress_cost × Task.estimated_outputs_size_gigabytes —
+reference: Optimizer._egress_cost/:239).  TIME target: runtime + egress
+transfer time at a nominal inter-cloud bandwidth.
 """
 from __future__ import annotations
 
 import enum
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
-from skypilot_tpu.clouds import cloud as cloud_lib
+# Importing the clouds package registers every cloud plugin into
+# CLOUD_REGISTRY (side-effect import, like the reference's sky/clouds).
+import skypilot_tpu.clouds  # noqa: F401
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 logger = sky_logging.init_logger(__name__)
+
+# Candidates considered per task in the DP (cheapest-first cut; keeps the
+# chain pass O(tasks × K²) with exactness over the kept set).
+_MAX_CANDIDATES_PER_TASK = 8
+# Nominal inter-cloud transfer bandwidth for the TIME target's egress
+# term (the reference hardcodes an equivalent assumption in
+# _egress_time, sky/optimizer.py).
+_EGRESS_GBPS = 0.25 * 3600  # GB per HOUR at ~0.25 GB/s
 
 
 class OptimizeTarget(enum.Enum):
@@ -56,10 +74,65 @@ def _fill_in_launchable_resources(
     return mapping
 
 
+def _candidates_for_task(
+        task: task_lib.Task,
+        blocked_resources: Optional[List[resources_lib.Resources]],
+) -> List[resources_lib.Resources]:
+    """The DP's candidate set for one task.  `ordered:` resource lists are
+    a strict preference: only the first intent with any candidate
+    contributes; `any_of`/single contribute the cheapest K overall."""
+    mapping = _fill_in_launchable_resources(task, blocked_resources)
+    if task.resources_ordered:
+        for intent in task.resources:
+            if mapping.get(intent):
+                return mapping[intent][:_MAX_CANDIDATES_PER_TASK]
+        raise exceptions.ResourcesUnavailableError(
+            f'No launchable resources for task {task.name!r}.')
+    merged: List[resources_lib.Resources] = []
+    for cands in mapping.values():
+        merged.extend(cands)
+    merged.sort(key=lambda r: (r.price_per_hour
+                               if r.price_per_hour is not None else 1e18))
+    if not merged:
+        raise exceptions.ResourcesUnavailableError(
+            f'No launchable resources for task {task.name!r}.')
+    return merged[:_MAX_CANDIDATES_PER_TASK]
+
+
 def _estimate_cost_per_hour(task: task_lib.Task,
                             launchable: resources_lib.Resources) -> float:
     cloud = CLOUD_REGISTRY.from_str(launchable.cloud)
     return cloud.get_hourly_cost(launchable) * task.num_nodes
+
+
+def _exec_objective(task: task_lib.Task,
+                    cand: resources_lib.Resources,
+                    minimize: 'OptimizeTarget') -> float:
+    """The node cost of running `task` on `cand` (reference:
+    _estimate_nodes_cost_or_time, sky/optimizer.py:239)."""
+    hours = task.estimate_runtime_hours(cand)
+    if minimize is OptimizeTarget.TIME:
+        return hours
+    return _estimate_cost_per_hour(task, cand) * hours
+
+
+def _egress_objective(src_task: task_lib.Task,
+                      src: resources_lib.Resources,
+                      dst: resources_lib.Resources,
+                      minimize: 'OptimizeTarget') -> float:
+    """Transition cost of handing src_task's outputs from `src` to `dst`.
+
+    Reference semantics (Optimizer._egress_cost): same cloud → free;
+    cross-cloud → the SOURCE cloud's egress pricing over the declared
+    output size (Task.set_outputs).  Unknown size → 0 (nothing to
+    charge), matching the reference's optional-estimate contract."""
+    gigabytes = src_task.estimated_outputs_size_gigabytes
+    if not gigabytes or src.cloud == dst.cloud:
+        return 0.0
+    if minimize is OptimizeTarget.TIME:
+        return gigabytes / _EGRESS_GBPS
+    cloud = CLOUD_REGISTRY.from_str(src.cloud)
+    return cloud.get_egress_cost(gigabytes)
 
 
 class Optimizer:
@@ -74,33 +147,51 @@ class Optimizer:
             raise exceptions.NotSupportedError(
                 'Only chain DAGs are supported (mirrors the reference: '
                 'Dag.is_chain gating in sky/optimizer.py).')
-        for t in dag.topological_order():
-            mapping = _fill_in_launchable_resources(t, blocked_resources)
-            # `ordered:` resource lists are a strict preference: take the
-            # first intent with any candidate.  `any_of`/single: cheapest.
-            chosen: Optional[resources_lib.Resources] = None
-            if t.resources_ordered:
-                for intent in t.resources:
-                    if mapping.get(intent):
-                        chosen = mapping[intent][0]
-                        break
-            else:
-                best_cost = None
-                for intent, candidates in mapping.items():
-                    if not candidates:
-                        continue
-                    cand = candidates[0]
-                    cost = _estimate_cost_per_hour(t, cand)
-                    if best_cost is None or cost < best_cost:
-                        best_cost, chosen = cost, cand
-            if chosen is None:
-                raise exceptions.ResourcesUnavailableError(
-                    f'No launchable resources for task {t.name!r}.')
+        tasks = list(dag.topological_order())
+        cand_lists = [_candidates_for_task(t, blocked_resources)
+                      for t in tasks]
+
+        # Exact DP over the chain: state = (task index, candidate index);
+        # transition = egress from the previous task's placement.
+        # dp[j] = best objective ending with task i on candidate j.
+        dp: List[float] = [
+            _exec_objective(tasks[0], c, minimize) for c in cand_lists[0]]
+        back: List[List[int]] = []
+        for i in range(1, len(tasks)):
+            prev_task, prev_cands = tasks[i - 1], cand_lists[i - 1]
+            new_dp: List[float] = []
+            choices: List[int] = []
+            for cand in cand_lists[i]:
+                node = _exec_objective(tasks[i], cand, minimize)
+                best, best_p = None, 0
+                for p, prev_cand in enumerate(prev_cands):
+                    total = dp[p] + _egress_objective(
+                        prev_task, prev_cand, cand, minimize)
+                    if best is None or total < best:
+                        best, best_p = total, p
+                new_dp.append(best + node)
+                choices.append(best_p)
+            dp = new_dp
+            back.append(choices)
+
+        # Backtrack from the best terminal state.
+        idx = min(range(len(dp)), key=dp.__getitem__)
+        chosen_idx = [0] * len(tasks)
+        chosen_idx[-1] = idx
+        for i in range(len(tasks) - 1, 0, -1):
+            chosen_idx[i - 1] = back[i - 1][chosen_idx[i]]
+
+        unit = '$' if minimize is OptimizeTarget.COST else 'h'
+        for t, cands, j in zip(tasks, cand_lists, chosen_idx):
+            chosen = cands[j]
             t.set_resources_chosen(chosen)
             if not quiet:
                 cost = _estimate_cost_per_hour(t, chosen)
-                logger.info(f'Task {t.name or "<unnamed>"}: chose {chosen} '
-                            f'(est. ${cost:.2f}/hr × {t.num_nodes} node(s))')
+                est = _exec_objective(t, chosen, minimize)
+                logger.info(
+                    f'Task {t.name or "<unnamed>"}: chose {chosen} '
+                    f'(est. ${cost:.2f}/hr × {t.num_nodes} node(s), '
+                    f'objective {est:.2f}{unit})')
         return dag
 
     @staticmethod

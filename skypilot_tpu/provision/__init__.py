@@ -17,9 +17,10 @@ from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
                                            ProvisionRecord)
 from skypilot_tpu.utils import timeline
 
-__all__ = ['ClusterInfo', 'InstanceInfo', 'ProvisionRecord', 'run_instances',
-           'terminate_instances', 'stop_instances', 'start_instances',
-           'get_cluster_info', 'wait_instances', 'query_instances']
+__all__ = ['ClusterInfo', 'InstanceInfo', 'ProvisionRecord',
+           'bootstrap_instances', 'run_instances', 'terminate_instances',
+           'stop_instances', 'start_instances', 'get_cluster_info',
+           'wait_instances', 'query_instances']
 
 
 def _dispatch(fn_name: str) -> Callable:
@@ -32,6 +33,21 @@ def _dispatch(fn_name: str) -> Callable:
             return impl(*args, **kwargs)
     _call.__name__ = fn_name
     return _call
+
+
+def bootstrap_instances(cloud: str, region: str, cluster_name: str,
+                        config: Dict[str, Any]) -> Dict[str, Any]:
+    """Cloud-level prerequisites (network/firewall/IAM) before the first
+    run_instances.  Optional per cloud: clouds without a bootstrap hook
+    (local, ssh) pass through unchanged.  Reference:
+    sky/provision/gcp/config.py called from bulk_provision."""
+    module = importlib.import_module(
+        f'skypilot_tpu.provision.{cloud}.instance')
+    impl = getattr(module, 'bootstrap_instances', None)
+    if impl is None:
+        return config
+    with timeline.Event(f'provision.{cloud}.bootstrap_instances'):
+        return impl(region, cluster_name, config)
 
 
 run_instances = _dispatch('run_instances')

@@ -1,0 +1,179 @@
+"""Thin REST client for GCE instances (compute.googleapis.com, v1).
+
+Reference parity: GCPComputeInstance sky/provision/gcp/instance_utils.py:311
+(create/start/stop/delete/list with label filters, zonal op polling,
+stockout/quota error typing).  Like tpu_api, this speaks plain REST via
+requests + google-auth instead of the discovery client: the API surface the
+framework needs is small and the typed-error contract matters more than SDK
+coverage.
+
+GCE is the non-accelerator half of the GCP provisioner: CPU dev boxes and
+the managed-jobs / serve controller VMs (the reference's
+"controllers are ordinary clusters" architecture, SURVEY.md §1) are plain
+GCE instances; TPU slices go through tpu_api.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.gcp import tpu_api
+
+_COMPUTE = 'https://compute.googleapis.com/compute/v1'
+
+# GCE op error codes that mean "zone/region can't satisfy this right now"
+# (reference: FailoverCloudErrorHandlerV2._gcp_handler blocklist triggers,
+# sky/backends/cloud_vm_ray_backend.py:991).
+_CAPACITY_CODES = ('ZONE_RESOURCE_POOL_EXHAUSTED',
+                   'ZONE_RESOURCE_POOL_EXHAUSTED_WITH_DETAILS',
+                   'RESOURCE_POOL_EXHAUSTED', 'UNSUPPORTED_OPERATION')
+_QUOTA_CODES = ('QUOTA_EXCEEDED', 'QUOTA_LIMIT')
+
+
+class ComputeApiClient(tpu_api.TpuApiClient):
+    """GCE instances client sharing the TPU client's auth/session and
+    HTTP-level typed-error mapping (quota/capacity/permission)."""
+
+    def _url(self, zone: str, suffix: str = '') -> str:
+        base = (f'{_COMPUTE}/projects/{self.project}/zones/{zone}'
+                f'/instances')
+        return f'{base}{suffix}'
+
+    def _compute_request(self, method: str, url: str,
+                         json_body: Optional[Dict[str, Any]] = None,
+                         params: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        resp = self._get_session().request(method, url, json=json_body,
+                                           params=params, timeout=60)
+        if resp.status_code >= 400:
+            self._raise_typed(resp)
+        return resp.json() if resp.content else {}
+
+    # ---- instance CRUD ---------------------------------------------------
+    def create_instance(self, zone: str, body: Dict[str, Any]
+                        ) -> Dict[str, Any]:
+        return self._compute_request('POST', self._url(zone),
+                                     json_body=body)
+
+    def get_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._compute_request('GET', self._url(zone, f'/{name}'))
+
+    def list_instances(self, zone: str,
+                       label_filter: Optional[Dict[str, str]] = None
+                       ) -> List[Dict[str, Any]]:
+        params: Dict[str, Any] = {'maxResults': 500}
+        if label_filter:
+            params['filter'] = ' AND '.join(
+                f'labels.{k}={v}' for k, v in label_filter.items())
+        out: List[Dict[str, Any]] = []
+        while True:
+            resp = self._compute_request('GET', self._url(zone),
+                                         params=params)
+            out.extend(resp.get('items', []))
+            token = resp.get('nextPageToken')
+            if not token:
+                return out
+            params['pageToken'] = token
+
+    def delete_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._compute_request('DELETE', self._url(zone, f'/{name}'))
+
+    def stop_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._compute_request('POST', self._url(zone,
+                                                       f'/{name}/stop'))
+
+    def start_instance(self, zone: str, name: str) -> Dict[str, Any]:
+        return self._compute_request('POST', self._url(zone,
+                                                       f'/{name}/start'))
+
+    def set_labels(self, zone: str, name: str,
+                   labels: Dict[str, str]) -> Dict[str, Any]:
+        inst = self.get_instance(zone, name)
+        merged = dict(inst.get('labels') or {})
+        merged.update(labels)
+        return self._compute_request(
+            'POST', self._url(zone, f'/{name}/setLabels'),
+            json_body={'labels': merged,
+                       'labelFingerprint': inst.get('labelFingerprint', '')})
+
+    # ---- global resources (networks / firewalls, for bootstrap) ----------
+    def get_network(self, name: str) -> Dict[str, Any]:
+        return self._compute_request(
+            'GET', f'{_COMPUTE}/projects/{self.project}/global'
+                   f'/networks/{name}')
+
+    def create_network(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._compute_request(
+            'POST', f'{_COMPUTE}/projects/{self.project}/global/networks',
+            json_body=body)
+
+    def get_firewall(self, name: str) -> Dict[str, Any]:
+        return self._compute_request(
+            'GET', f'{_COMPUTE}/projects/{self.project}/global'
+                   f'/firewalls/{name}')
+
+    def create_firewall(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._compute_request(
+            'POST', f'{_COMPUTE}/projects/{self.project}/global/firewalls',
+            json_body=body)
+
+    # ---- op polling ------------------------------------------------------
+    def wait_global_operation(self, operation: Dict[str, Any],
+                              timeout: float = 300,
+                              poll: float = 2.0) -> Dict[str, Any]:
+        name = operation.get('name')
+        if not name:
+            return operation
+        url = (f'{_COMPUTE}/projects/{self.project}/global'
+               f'/operations/{name}')
+        deadline = time.time() + timeout
+        while True:
+            op = self._compute_request('GET', url)
+            if op.get('status') == 'DONE':
+                self._raise_op_error(op)
+                return op
+            if time.time() > deadline:
+                raise exceptions.ProvisionerError(
+                    f'GCE global operation {name} timed out after '
+                    f'{timeout}s.')
+            time.sleep(poll)
+
+    def wait_zone_operation(self, zone: str, operation: Dict[str, Any],
+                            timeout: float = 900,
+                            poll: float = 3.0) -> Dict[str, Any]:
+        """Poll a zonal operation; raise typed errors for op-level failures
+        (stockouts surface in op.error.errors[].code, not HTTP status)."""
+        name = operation.get('name')
+        if not name:
+            return operation
+        url = (f'{_COMPUTE}/projects/{self.project}/zones/{zone}'
+               f'/operations/{name}')
+        deadline = time.time() + timeout
+        while True:
+            op = self._compute_request('GET', url)
+            if op.get('status') == 'DONE':
+                self._raise_op_error(op)
+                return op
+            if time.time() > deadline:
+                raise exceptions.ProvisionerError(
+                    f'GCE operation {name} timed out after {timeout}s.')
+            time.sleep(poll)
+
+    @staticmethod
+    def _raise_op_error(op: Dict[str, Any]) -> None:
+        errors = (op.get('error') or {}).get('errors') or []
+        if not errors:
+            return
+        first = errors[0]
+        code = first.get('code', '')
+        message = first.get('message', str(first))
+        if code in _CAPACITY_CODES or 'exhausted' in message.lower():
+            raise exceptions.CapacityError(f'{code}: {message}')
+        if code in _QUOTA_CODES or 'quota' in message.lower():
+            raise exceptions.QuotaExceededError(f'{code}: {message}')
+        if code in ('PERMISSIONS_ERROR', 'FORBIDDEN'):
+            raise exceptions.ProvisionerError(
+                f'Permission error from GCE: {code}: {message}',
+                retriable=False)
+        raise exceptions.ProvisionerError(f'{code}: {message}')

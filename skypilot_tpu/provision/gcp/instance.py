@@ -1,9 +1,14 @@
-"""GCP provisioner: TPU pod slices (TPU-VM architecture) + startup script.
+"""GCP provisioner: TPU pod slices (TPU-VM architecture) + plain GCE VMs.
 
 Reference parity: sky/provision/gcp/instance_utils.py — GCPTPUVMInstance
 :1205: create with acceleratorType + runtimeVersion, poll ops :1231, delete
 :1346, label quirks :1407 (labels cannot be set while PENDING → passed at
-create), no reservations for spot :1476.  TPU API quirks encoded here:
+create), no reservations for spot :1476; GCPComputeInstance :311 for the
+non-accelerator path (CPU dev boxes and jobs/serve controller VMs — the
+reference's "controllers are ordinary clusters" architecture).  Dispatch is
+by the deploy config: `tpu_vm`/`tpu_type` present → TPU API, otherwise the
+GCE compute API (instance_utils.py:133-134 picks handlers by node type the
+same way).  TPU API quirks encoded here:
 
 - A pod slice is ONE TPU node resource with N networkEndpoints (one per
   worker host); get_cluster_info maps each endpoint to an InstanceInfo so
@@ -27,7 +32,11 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import compute_api
 from skypilot_tpu.provision.gcp import tpu_api
+# Re-export: the provision dispatch looks bootstrap_instances up on the
+# cloud's instance module (provision/__init__.py).
+from skypilot_tpu.provision.gcp.bootstrap import bootstrap_instances  # noqa: F401
 
 logger = sky_logging.init_logger(__name__)
 
@@ -45,12 +54,25 @@ _STATE_MAP = {
 }
 
 _client_factory = tpu_api.TpuApiClient  # swappable in tests
+_compute_client_factory = compute_api.ComputeApiClient  # swappable in tests
 
 
 def _client(config: Dict[str, Any]) -> tpu_api.TpuApiClient:
     project = config.get('project_id')
     assert project, 'gcp.project_id must be configured'
     return _client_factory(project)
+
+
+def _compute_client(config: Dict[str, Any]) -> compute_api.ComputeApiClient:
+    project = config.get('project_id')
+    assert project, 'gcp.project_id must be configured'
+    return _compute_client_factory(project)
+
+
+def _is_tpu_config(config: Dict[str, Any]) -> bool:
+    """TPU slice vs plain GCE VM, from the deploy variables emitted by
+    clouds/gcp.py make_deploy_resources_variables (tpu_vm flag)."""
+    return bool(config.get('tpu_vm', 'tpu_type' in config))
 
 
 def _slice_names(cluster_name: str, num_slices: int) -> List[str]:
@@ -102,9 +124,169 @@ def _node_body(cluster_name: str, config: Dict[str, Any]) -> Dict[str, Any]:
     return body
 
 
+# ---------------------------------------------------------------------------
+# GCE compute path (CPU VMs: controllers, dev boxes)
+# ---------------------------------------------------------------------------
+
+_GCE_DEFAULT_IMAGE = 'projects/debian-cloud/global/images/family/debian-12'
+_CLUSTER_LABEL = 'skypilot-tpu-cluster'
+
+# GCE instance states (instance_utils.py:311 GCPComputeInstance semantics):
+# TERMINATED is *stopped* (restartable), not gone — deleted instances
+# disappear from list results entirely.
+_GCE_STATE_MAP = {
+    'PROVISIONING': 'pending', 'STAGING': 'pending', 'RUNNING': 'running',
+    'STOPPING': 'stopping', 'SUSPENDING': 'stopping',
+    'TERMINATED': 'stopped', 'SUSPENDED': 'stopped',
+    'REPAIRING': 'repairing',
+}
+
+
+def _vm_names(cluster_name: str, num_nodes: int) -> List[str]:
+    if num_nodes <= 1:
+        return [f'{cluster_name}-head']
+    return [f'{cluster_name}-head'] + [
+        f'{cluster_name}-worker-{k}' for k in range(1, num_nodes)]
+
+
+def _gce_body(name: str, cluster_name: str,
+              config: Dict[str, Any]) -> Dict[str, Any]:
+    zone = config['zone']
+    project = config['project_id']
+    labels = dict(config.get('labels') or {})
+    labels[_CLUSTER_LABEL] = cluster_name
+    metadata_items = [
+        {'key': 'startup-script', 'value': config.get('startup_script', '')},
+    ]
+    if config.get('ssh_public_key'):
+        # authentication.setup_gcp_authentication formats this as
+        # '<user>:<openssh key>' — exactly GCE's ssh-keys metadata format.
+        metadata_items.append({'key': 'ssh-keys',
+                               'value': config['ssh_public_key']})
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': (f'zones/{zone}/machineTypes/'
+                        f'{config["instance_type"]}'),
+        'labels': labels,
+        'disks': [{
+            'boot': True,
+            'autoDelete': True,
+            'initializeParams': {
+                'sourceImage': config.get('image_id') or _GCE_DEFAULT_IMAGE,
+                'diskSizeGb': str(config.get('disk_size') or 100),
+            },
+        }] + [{
+            'source': (f'projects/{project}/zones/{zone}/disks/{disk}'),
+            'autoDelete': False,
+            'mode': 'READ_WRITE',
+        } for disk in config.get('volumes', [])],
+        'networkInterfaces': [{
+            'network': 'global/networks/default',
+            'accessConfigs': [{'name': 'External NAT',
+                               'type': 'ONE_TO_ONE_NAT'}],
+        }],
+        'metadata': {'items': metadata_items},
+    }
+    if config.get('use_spot'):
+        body['scheduling'] = {
+            'provisioningModel': 'SPOT',
+            # Spot VMs terminate (restartable) rather than delete, so a
+            # preempted controller can be `start`ed again with its disk.
+            'instanceTerminationAction': 'STOP',
+        }
+    if config.get('service_account') and \
+            config['service_account'] != 'default':
+        body['serviceAccounts'] = [{
+            'email': config['service_account'],
+            'scopes': ['https://www.googleapis.com/auth/cloud-platform'],
+        }]
+    return body
+
+
+def _gce_list_cluster(client: compute_api.ComputeApiClient, zone: str,
+                      cluster_name: str) -> Dict[str, Dict[str, Any]]:
+    return {inst['name']: inst
+            for inst in client.list_instances(
+                zone, label_filter={_CLUSTER_LABEL: cluster_name})}
+
+
+def _gce_run_instances(cluster_name: str,
+                       config: Dict[str, Any]) -> common.ProvisionRecord:
+    zone = config['zone']
+    num_nodes = int(config.get('num_nodes', 1))
+    client = _compute_client(config)
+    existing = _gce_list_cluster(client, zone, cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    operations = []
+    for name in _vm_names(cluster_name, num_nodes):
+        inst = existing.get(name)
+        if inst is not None:
+            state = inst.get('status', '')
+            if state in ('RUNNING', 'PROVISIONING', 'STAGING'):
+                resumed.append(name)
+                continue
+            if state in ('TERMINATED', 'SUSPENDED'):
+                # Stopped VM with our name: restart it (sky start path).
+                operations.append(client.start_instance(zone, name))
+                resumed.append(name)
+                continue
+            # STOPPING/REPAIRING etc.: replace.
+            client.wait_zone_operation(
+                zone, client.delete_instance(zone, name))
+        operations.append(
+            client.create_instance(zone, _gce_body(name, cluster_name,
+                                                   config)))
+        created.append(name)
+    for op in operations:
+        client.wait_zone_operation(zone, op)
+    return common.ProvisionRecord(
+        provider_name='gcp', region=zone.rsplit('-', 1)[0], zone=zone,
+        cluster_name=cluster_name,
+        head_instance_id=f'{cluster_name}-head',
+        created_instance_ids=created, resumed_instance_ids=resumed)
+
+
+def _gce_get_cluster_info(cluster_name: str,
+                          config: Dict[str, Any]) -> common.ClusterInfo:
+    zone = config.get('zone')
+    client = _compute_client(config)
+    existing = _gce_list_cluster(client, zone, cluster_name)
+    instances: List[common.InstanceInfo] = []
+    # Head first, then workers in rank order (deterministic ranks — the
+    # analog of the reference's stable cluster-IP sort,
+    # cloud_vm_ray_backend.py:596-615).  The expected-name list is sized
+    # by the CONFIGURED node count, not len(existing): with a missing
+    # intermediate worker (preempted/deleted), sizing by the listing
+    # would silently drop every later worker from the cluster view.
+    num_nodes = max(int(config.get('num_nodes', 0)), len(existing))
+    for name in _vm_names(cluster_name, num_nodes):
+        inst = existing.get(name)
+        if inst is None:
+            continue
+        nic = (inst.get('networkInterfaces') or [{}])[0]
+        access = (nic.get('accessConfigs') or [{}])[0]
+        instances.append(common.InstanceInfo(
+            instance_id=name,
+            internal_ip=nic.get('networkIP', ''),
+            external_ip=access.get('natIP'),
+            tags={'state': inst.get('status', '')},
+        ))
+    return common.ClusterInfo(
+        cluster_name=cluster_name, cloud='gcp',
+        region=zone.rsplit('-', 1)[0] if zone else '', zone=zone,
+        instances=instances,
+        ssh_user=config.get('ssh_user', 'skypilot'),
+        ssh_key_path=config.get('ssh_key_path',
+                                '~/.skypilot_tpu/keys/skypilot.pem'),
+        provider_config=config)
+
+
 def run_instances(region: str, cluster_name: str,
                   config: Dict[str, Any]) -> common.ProvisionRecord:
-    del region  # the TPU API is zonal
+    del region  # both GCP APIs are zonal
+    if not _is_tpu_config(config):
+        return _gce_run_instances(cluster_name, config)
     zone = config['zone']
     num_slices = int(config.get('num_slices', 1))
     client = _client(config)
@@ -149,6 +331,8 @@ def get_cluster_info(region: str, cluster_name: str,
                      provider_config: Optional[Dict[str, Any]] = None
                      ) -> common.ClusterInfo:
     config = provider_config or {}
+    if not _is_tpu_config(config):
+        return _gce_get_cluster_info(cluster_name, config)
     zone = config.get('zone')
     num_slices = int(config.get('num_slices', 1))
     client = _client(config)
@@ -180,8 +364,16 @@ def query_instances(cluster_name: str,
                     non_terminated_only: bool = True) -> Dict[str, str]:
     config = provider_config or {}
     zone = config.get('zone')
+    if not _is_tpu_config(config):
+        client = _compute_client(config)
+        out: Dict[str, str] = {}
+        for name, inst in _gce_list_cluster(client, zone,
+                                            cluster_name).items():
+            out[name] = _GCE_STATE_MAP.get(inst.get('status', ''),
+                                           'unknown')
+        return out
     client = _client(config)
-    out: Dict[str, str] = {}
+    out = {}
     for node in client.list_nodes(zone):
         name = node['name'].rsplit('/', 1)[-1]
         labels = node.get('labels') or {}
@@ -197,10 +389,17 @@ def query_instances(cluster_name: str,
 def stop_instances(cluster_name: str,
                    provider_config: Optional[Dict[str, Any]] = None,
                    worker_only: bool = False) -> None:
-    """Stop single-host TPU VMs.  Pod slices cannot stop
+    """Stop single-host TPU VMs and GCE VMs.  Pod slices cannot stop
     (reference: sky/clouds/gcp.py:217-224)."""
     config = provider_config or {}
     zone = config.get('zone')
+    if not _is_tpu_config(config):
+        client = _compute_client(config)
+        ops = [client.stop_instance(zone, name)
+               for name in _gce_list_cluster(client, zone, cluster_name)]
+        for op in ops:
+            client.wait_zone_operation(zone, op)
+        return
     client = _client(config)
     operations = []
     for node in client.list_nodes(zone):
@@ -220,10 +419,19 @@ def stop_instances(cluster_name: str,
 def start_instances(cluster_name: str,
                     provider_config: Optional[Dict[str, Any]] = None
                     ) -> None:
-    """Start previously stopped single-host TPU VMs (TPU API
+    """Start previously stopped single-host TPU VMs / GCE VMs (TPU API
     nodes:start; pods never reach STOPPED so this is single-host only)."""
     config = provider_config or {}
     zone = config.get('zone')
+    if not _is_tpu_config(config):
+        client = _compute_client(config)
+        ops = [client.start_instance(zone, name)
+               for name, inst in _gce_list_cluster(client, zone,
+                                                   cluster_name).items()
+               if inst.get('status') in ('TERMINATED', 'SUSPENDED')]
+        for op in ops:
+            client.wait_zone_operation(zone, op)
+        return
     client = _client(config)
     operations = []
     for node in client.list_nodes(zone):
@@ -241,6 +449,14 @@ def terminate_instances(cluster_name: str,
                         worker_only: bool = False) -> None:
     config = provider_config or {}
     zone = config.get('zone')
+    if not _is_tpu_config(config):
+        client = _compute_client(config)
+        ops = [client.delete_instance(zone, name)
+               for name in _gce_list_cluster(client, zone, cluster_name)
+               if not (worker_only and name == f'{cluster_name}-head')]
+        for op in ops:
+            client.wait_zone_operation(zone, op)
+        return
     client = _client(config)
     operations = []
     for node in client.list_nodes(zone):

@@ -129,35 +129,55 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
             f'Could not start an identity-verified agent: {last_exc}')
     runner = all_runners[0]
     # Ship the client's exact package version as a wheel and install it
-    # on the head before starting the agent (reference: wheel_utils build
-    # + rsync, sky/backends/wheel_utils.py — no PyPI dependency on the VM).
-    # Paths are relative so shell commands and rsync destinations resolve
-    # against the same base on both SSH (cwd=$HOME) and kubectl-exec
-    # (cwd=container workdir) runners.  Any failure here must surface as
-    # ProvisionerError so provision_with_failover tears down the
-    # just-created instances instead of leaking them.
+    # on EVERY host before starting the agent (reference: wheel_utils
+    # build + rsync, sky/backends/wheel_utils.py; per-node parallel
+    # install with caching, instance_setup.py:153/:220 — no PyPI
+    # dependency on the VMs).  A v5e-256 job whose `run:` imports
+    # skypilot_tpu on rank>0 needs the runtime on workers too, and the
+    # fan-out must be parallel: 64 sequential installs would dominate
+    # launch latency.  Paths are relative so shell commands and rsync
+    # destinations resolve against the same base on both SSH (cwd=$HOME)
+    # and kubectl-exec (cwd=container workdir) runners.  Any failure here
+    # must surface as ProvisionerError so provision_with_failover tears
+    # down the just-created instances instead of leaking them.
     try:
         from skypilot_tpu.backends import wheel_utils
         wheel_path, wheel_hash = wheel_utils.build_wheel()
         remote_dir = f'.skypilot_tpu_wheels/{wheel_hash}'
-        runner.run(f'mkdir -p {remote_dir}', timeout=60)
-        runner.rsync(wheel_path, f'{remote_dir}/', up=True)
+        rcs = runner_lib.run_on_hosts_parallel(
+            all_runners, f'mkdir -p {remote_dir}', timeout=60)
+        bad = [i for i, rc in enumerate(rcs) if rc != 0]
+        if bad:
+            raise exceptions.ProvisionerError(
+                f'Failed to create wheel dir on hosts {bad}.')
+        errors = runner_lib.rsync_on_hosts_parallel(
+            all_runners, wheel_path, f'{remote_dir}/', up=True)
+        bad = [i for i, e in enumerate(errors) if e is not None]
+        if bad:
+            raise exceptions.ProvisionerError(
+                f'Failed to ship the framework wheel to hosts {bad}: '
+                f'{errors[bad[0]]}')
         remote_wheel = f'{remote_dir}/{os.path.basename(wheel_path)}'
         # Hash-gated install: a stale preinstalled version must not
-        # satisfy the guard, so the marker records the installed hash.
+        # satisfy the guard, so the marker records the installed hash —
+        # an unchanged wheel re-launch costs one `cat` per host.
         marker = '.skypilot_tpu_wheels/current'
-        rc = runner.run(
+        install_cmd = (
             f'[ "$(cat {marker} 2>/dev/null)" = "{wheel_hash}" ] || '
             f'({wheel_utils.ship_and_install_cmd(remote_wheel)} '
-            f'&& echo {wheel_hash} > {marker})', timeout=300)
-        if rc != 0:
+            f'&& echo {wheel_hash} > {marker})')
+        rcs = runner_lib.run_on_hosts_parallel(all_runners, install_cmd,
+                                               timeout=300)
+        bad = [i for i, rc in enumerate(rcs) if rc != 0]
+        if bad:
             raise exceptions.ProvisionerError(
-                f'Failed to install the framework wheel on head ({rc}).')
+                f'Failed to install the framework wheel on hosts {bad} '
+                f'(rc={rcs[bad[0]]}).')
     except exceptions.ProvisionerError:
         raise
     except Exception as e:  # pylint: disable=broad-except
         raise exceptions.ProvisionerError(
-            f'Failed to ship the framework wheel to head: {e}') from e
+            f'Failed to ship the framework wheel to hosts: {e}') from e
     # External log shipping, when configured (reference: LoggingAgent
     # setup command run on every node, sky/logs/agent.py:12).  Strictly
     # best-effort: a broken log shipper must not fail (or leak) the
@@ -205,6 +225,8 @@ def _provision_one_zone(cloud_obj: cloud_lib.Cloud,
                         cluster_name: str, region: str,
                         config: dict) -> provision_common.ClusterInfo:
     cloud = cloud_obj.name
+    config = provision_api.bootstrap_instances(cloud, region, cluster_name,
+                                               config)
     provision_api.run_instances(cloud, region, cluster_name, config)
     provision_api.wait_instances(cloud, region, cluster_name, 'running',
                                  provider_config=config)

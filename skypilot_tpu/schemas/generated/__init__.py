@@ -1,0 +1,12 @@
+"""protoc-generated message classes (reference: sky/schemas/generated/).
+
+Regenerate with:
+    protoc --python_out=skypilot_tpu/schemas/generated \
+           --proto_path=skypilot_tpu/schemas skypilot_tpu/schemas/agent.proto
+
+The gRPC service/stub wiring is hand-rolled over these messages
+(agent/grpc_server.py, agent/client.py): grpc_python_plugin is not in this
+build, but grpc's generic-handler API serves the same contract the plugin
+would generate.
+"""
+from skypilot_tpu.schemas.generated import agent_pb2  # noqa: F401

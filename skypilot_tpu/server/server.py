@@ -263,6 +263,48 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
             'spot_price_hourly': o.spot_price,
         } for name, offerings in grouped.items() for o in offerings])
 
+    @routes.get('/api/cluster_jobs')
+    async def api_cluster_jobs(request: web.Request) -> web.Response:
+        """Job queue of one cluster, for the dashboard's cluster detail
+        page (reference: dashboard cluster jobs view)."""
+        from skypilot_tpu import core as core_lib
+        cluster = request.query.get('cluster', '')
+        try:
+            rows = await asyncio.to_thread(core_lib.queue, cluster, True)
+        except Exception as e:  # pylint: disable=broad-except
+            return _json_error(404, str(e))
+        return web.json_response([{
+            'job_id': j.get('job_id'), 'name': j.get('name'),
+            'status': (j['status'].value
+                       if hasattr(j.get('status'), 'value')
+                       else j.get('status')),
+            'submitted_at': j.get('submitted_at'),
+        } for j in rows])
+
+    @routes.get('/api/cluster_logs')
+    async def api_cluster_logs(request: web.Request) -> web.Response:
+        """One job's rank-0 log (non-follow), for the dashboard log view."""
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.agent.client import AgentClient
+        cluster = request.query.get('cluster', '')
+        job_id = request.query.get('job_id')
+        rank = int(request.query.get('rank', 0))
+        record = state_lib.get_cluster(cluster)
+        if record is None:
+            return _json_error(404, f'No cluster {cluster!r}')
+        handle = record['handle']
+
+        def _read() -> str:
+            client = AgentClient(
+                f'http://{handle.head_ip}:{handle.agent_port}')
+            return ''.join(client.tail_logs(
+                int(job_id) if job_id else None, rank=rank, follow=False))
+        try:
+            text = await asyncio.to_thread(_read)
+        except Exception as e:  # pylint: disable=broad-except
+            return _json_error(502, f'Log fetch failed: {e}')
+        return web.Response(text=text, content_type='text/plain')
+
     @routes.get('/api/volumes')
     async def api_volumes(request: web.Request) -> web.Response:
         from skypilot_tpu.volumes import core as volumes_core

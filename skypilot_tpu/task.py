@@ -64,6 +64,11 @@ class Task:
         ]
         self._resources_ordered = False
         self._chosen_resources: Optional[resources_lib.Resources] = None
+        # Optimizer inputs (reference: sky/task.py set_time_estimator /
+        # set_outputs): per-candidate runtime estimate and the size of the
+        # data this task hands to its chain successor (drives egress cost).
+        self._time_estimator = None
+        self.estimated_outputs_size_gigabytes: Optional[float] = None
         self._validate()
         # Auto-register into an enclosing `with Dag():` block.
         from skypilot_tpu import dag as dag_lib
@@ -92,6 +97,31 @@ class Task:
         if overlap:
             raise exceptions.InvalidTaskError(
                 f'envs and secrets overlap: {sorted(overlap)}')
+
+    # ---- optimizer estimates --------------------------------------------
+    def set_time_estimator(self, func) -> 'Task':
+        """func(resources) -> estimated runtime in HOURS on that candidate
+        (reference: Task.set_time_estimator, sky/task.py)."""
+        self._time_estimator = func
+        return self
+
+    def estimate_runtime_hours(self,
+                               resources: resources_lib.Resources) -> float:
+        """Estimated runtime on `resources`; 1 hour when no estimator is
+        set (the reference's default assumption in
+        _estimate_nodes_cost_or_time, sky/optimizer.py:239)."""
+        if self._time_estimator is None:
+            return 1.0
+        return float(self._time_estimator(resources))
+
+    def set_outputs(self, outputs: str,
+                    estimated_size_gigabytes: float) -> 'Task':
+        """Declare this task's output size for chain egress costing
+        (reference: Task.set_outputs)."""
+        del outputs  # path is informational; size drives the cost model
+        self.estimated_outputs_size_gigabytes = float(
+            estimated_size_gigabytes)
+        return self
 
     # ---- resources -------------------------------------------------------
     def set_resources(

@@ -23,6 +23,32 @@ jax.config.update('jax_num_cpu_devices', 8)
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    """Smoke-suite gating (reference: tests/conftest.py:50-60 --gcp etc.):
+    tests marked `smoke` hit a REAL GCP project and only run when one is
+    named explicitly."""
+    parser.addoption('--gcp-project', default=None,
+                     help='Run tests/smoke/ against this real GCP project '
+                          '(creates and deletes real resources).')
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption('--gcp-project') is None:
+        skip_smoke = pytest.mark.skip(
+            reason='smoke test: pass --gcp-project to run against a real '
+                   'GCP project')
+        for item in items:
+            if 'smoke' in item.keywords:
+                item.add_marker(skip_smoke)
+
+
+@pytest.fixture()
+def gcp_project(request):
+    project = request.config.getoption('--gcp-project')
+    assert project, 'smoke tests require --gcp-project'
+    return project
+
+
 @pytest.fixture()
 def tmp_home(tmp_path, monkeypatch):
     """Isolate ~/.skypilot_tpu state for a test."""

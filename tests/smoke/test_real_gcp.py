@@ -1,0 +1,78 @@
+"""Opt-in smoke tests against a REAL GCP project (VERDICT r1 missing #8).
+
+Run with:  pytest tests/smoke/ --gcp-project=<project-id>
+
+These create and delete REAL billable resources (a small GCE VM, and —
+for the TPU test — a v5e-8 single host).  They validate the real
+`tpu.googleapis.com` / `compute.googleapis.com` paths end-to-end, the
+part the hermetic suite cannot reach (reference: tests/smoke_tests/
+test_basic.py gating via tests/conftest.py:50-60).
+"""
+import uuid
+
+import pytest
+
+pytestmark = [pytest.mark.smoke, pytest.mark.slow]
+
+
+@pytest.fixture()
+def real_gcp(gcp_project, tmp_home):
+    from skypilot_tpu import config as config_lib
+    config_lib.set_nested(('gcp', 'project_id'), gcp_project)
+    yield gcp_project
+
+
+def _unique(prefix: str) -> str:
+    return f'{prefix}-{uuid.uuid4().hex[:6]}'
+
+
+def test_bootstrap_real_project(real_gcp):
+    """Idempotent bootstrap against the real project: both calls succeed."""
+    from skypilot_tpu.provision.gcp import bootstrap
+    bootstrap._bootstrapped.clear()
+    bootstrap.bootstrap_instances('us-central1', 'smoke', {
+        'project_id': real_gcp})
+    bootstrap._bootstrapped.clear()
+    bootstrap.bootstrap_instances('us-central1', 'smoke', {
+        'project_id': real_gcp})
+
+
+def test_gce_vm_lifecycle(real_gcp):
+    """Create → query → stop → start → delete a real e2-small VM."""
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    cluster = _unique('skytpu-smoke')
+    cfg = {'project_id': real_gcp, 'zone': 'us-central1-a',
+           'tpu_vm': False, 'instance_type': 'e2-small',
+           'use_spot': False, 'num_nodes': 1, 'labels': {},
+           'disk_size': 20}
+    try:
+        record = gcp_instance.run_instances('us-central1', cluster, cfg)
+        assert record.created_instance_ids == [f'{cluster}-head']
+        info = gcp_instance.get_cluster_info('us-central1', cluster, cfg)
+        assert info.head.internal_ip
+        statuses = gcp_instance.query_instances(cluster, cfg)
+        assert statuses[f'{cluster}-head'] == 'running'
+    finally:
+        gcp_instance.terminate_instances(cluster, cfg)
+    assert gcp_instance.query_instances(cluster, cfg) == {}
+
+
+def test_tpu_v5e_lifecycle(real_gcp):
+    """Create → query → delete a real single-host v5e-8 slice (requires
+    TPU quota in us-east5; skipped cleanly on quota errors)."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    cluster = _unique('skytpu-smoke-tpu')
+    cfg = {'project_id': real_gcp, 'zone': 'us-east5-b',
+           'tpu_type': 'v5litepod-8', 'tpu_generation': 'v5e',
+           'runtime_version': 'v2-alpha-tpuv5-lite', 'use_spot': True,
+           'num_slices': 1, 'labels': {}}
+    try:
+        gcp_instance.run_instances('us-east5', cluster, cfg)
+    except (exceptions.QuotaExceededError, exceptions.CapacityError) as e:
+        pytest.skip(f'no TPU quota/capacity for smoke test: {e}')
+    try:
+        statuses = gcp_instance.query_instances(cluster, cfg)
+        assert statuses.get(cluster) in ('running', 'pending')
+    finally:
+        gcp_instance.terminate_instances(cluster, cfg)

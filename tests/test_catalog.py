@@ -45,3 +45,75 @@ def test_tpu_host_vm_shape():
     spec = tpu_utils.parse_tpu_accelerator('tpu-v5e-256')
     vcpus, mem = catalog.get_tpu_host_vm_shape(spec)
     assert vcpus > 0 and mem > 0
+
+
+# ---------------------------------------------------------------------------
+# Coverage breadth, cache layer, fetcher schema lock (VERDICT r1 #6/#9)
+# ---------------------------------------------------------------------------
+
+def test_zone_coverage_breadth():
+    from skypilot_tpu import catalog
+    tpu_zones = {r['zone'] for r in catalog._load_tpu_rows()}
+    inst_zones = {r['zone'] for r in catalog._load_instance_rows()}
+    # Round-1 snapshot covered ~21 unique zones combined (20 TPU rows +
+    # us-central1-only instances); the committed catalog must be >=3x.
+    assert len(tpu_zones) >= 20
+    assert len(inst_zones) >= 60
+    assert len(tpu_zones | inst_zones) >= 3 * 21
+    # Every current TPU generation has multiple zones.
+    by_gen = {}
+    for r in catalog._load_tpu_rows():
+        by_gen.setdefault(r['generation'], set()).add(r['zone'])
+    for gen in ('v5e', 'v5p', 'v6e'):
+        assert len(by_gen[gen]) >= 3, (gen, by_gen[gen])
+
+
+def test_fetcher_schema_locked_to_csv():
+    """The fetcher's output columns must equal the committed CSV header."""
+    import csv as csv_mod
+    from skypilot_tpu import catalog
+    from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+    with open(catalog._data_path('gcp_tpus.csv'), encoding='utf-8') as f:
+        header = next(csv_mod.reader(f))
+    assert header == fetch_gcp.TPU_CSV_FIELDS
+    # build_rows emits exactly those keys.
+    rows = fetch_gcp.build_rows(
+        {'us-east5-b': ['v5litepod-16']},
+        {('v5e', 'us-east5', False): 1.2, ('v5e', 'us-east5', True): 0.54})
+    assert rows and set(rows[0].keys()) == set(fetch_gcp.TPU_CSV_FIELDS)
+
+
+def test_cache_overrides_packaged_snapshot(tmp_path, monkeypatch):
+    from skypilot_tpu import catalog
+    cache_root = tmp_path / 'catalogs'
+    monkeypatch.setenv('SKYTPU_CATALOG_DIR', str(cache_root))
+    ver_dir = cache_root / catalog.CATALOG_SCHEMA_VERSION
+    ver_dir.mkdir(parents=True)
+    (ver_dir / 'gcp_tpus.csv').write_text(
+        'generation,region,zone,chip_price,spot_chip_price\n'
+        'v6e,mars-central1,mars-central1-a,0.01,0.001\n')
+    catalog.refresh(fetch=False)   # clear loader caches
+    try:
+        rows = catalog._load_tpu_rows()
+        assert len(rows) == 1
+        assert rows[0]['zone'] == 'mars-central1-a'
+    finally:
+        monkeypatch.delenv('SKYTPU_CATALOG_DIR')
+        catalog.refresh(fetch=False)
+
+
+def test_schema_version_invalidates_by_path(tmp_path, monkeypatch):
+    from skypilot_tpu import catalog
+    cache_root = tmp_path / 'catalogs'
+    monkeypatch.setenv('SKYTPU_CATALOG_DIR', str(cache_root))
+    # An OLD-schema cache dir is simply not consulted.
+    old_dir = cache_root / 'v0'
+    old_dir.mkdir(parents=True)
+    (old_dir / 'gcp_tpus.csv').write_text('garbage\n')
+    catalog.refresh(fetch=False)
+    try:
+        rows = catalog._load_tpu_rows()
+        assert len(rows) > 20   # packaged snapshot, not the v0 garbage
+    finally:
+        monkeypatch.delenv('SKYTPU_CATALOG_DIR')
+        catalog.refresh(fetch=False)

@@ -10,6 +10,9 @@ transformers = pytest.importorskip('transformers')
 from skypilot_tpu.models import convert, llama  # noqa: E402
 
 
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture(scope='module')
 def hf_model():
     cfg = transformers.LlamaConfig(

@@ -12,6 +12,8 @@ from skypilot_tpu.provision import docker_utils
 from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture reuse)
 
 
+
+pytestmark = pytest.mark.slow
 def test_image_id_parsing():
     assert docker_utils.docker_image_from_image_id(
         'docker:pytorch/xla:r2.5') == 'pytorch/xla:r2.5'

@@ -12,6 +12,9 @@ EXAMPLES = sorted(glob.glob(os.path.join(
     'examples', '*.yaml')))
 
 
+pytestmark = pytest.mark.slow
+
+
 @pytest.mark.parametrize('path', EXAMPLES, ids=os.path.basename)
 def test_example_parses_and_optimizes(path, tmp_home):
     from skypilot_tpu.utils import common_utils

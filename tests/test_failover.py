@@ -5,7 +5,9 @@ import pytest
 from skypilot_tpu import Resources, exceptions
 from skypilot_tpu import config as config_lib
 from skypilot_tpu.provision import provisioner
+from skypilot_tpu.provision.gcp import bootstrap as gcp_bootstrap
 from skypilot_tpu.provision.gcp import instance as gcp_instance
+from tests.test_gce_provisioner import FakeComputeApi
 from tests.test_gcp_provisioner import FakeTpuApi
 
 
@@ -20,6 +22,8 @@ def fake_gcp(monkeypatch, tmp_home):
         return holder['api']
 
     monkeypatch.setattr(gcp_instance, '_client_factory', factory)
+    monkeypatch.setattr(gcp_bootstrap, '_client_factory', FakeComputeApi)
+    monkeypatch.setattr(gcp_bootstrap, '_bootstrapped', set())
     monkeypatch.setattr(provisioner, '_setup_runtime',
                         lambda info, port, cluster_name: port)
     config_lib.set_nested(('gcp', 'project_id'), 'test-proj')
@@ -27,12 +31,14 @@ def fake_gcp(monkeypatch, tmp_home):
 
 
 def test_failover_capacity_moves_to_next_zone(fake_gcp):
-    # v6e is offered (cheapest-first) in us-east5-b, us-east1-d,
-    # us-central2-b, then europe/asia.  Fail the first two on capacity.
-    fake_gcp['fail'] = {'us-east5-b': 'capacity', 'us-east1-d': 'capacity'}
+    # v6e US zones share a price; cheapest-first iteration is region-
+    # alphabetical: us-central1-b, us-central2-b, us-east1-d, ...
+    # Fail the first two on capacity.
+    fake_gcp['fail'] = {'us-central1-b': 'capacity',
+                        'us-central2-b': 'capacity'}
     res = Resources(cloud='gcp', accelerators='tpu-v6e-8')
     outcome = provisioner.provision_with_failover(res, 'fo1')
-    assert outcome.zone == 'us-central2-b'
+    assert outcome.zone == 'us-east1-d'
     assert outcome.handle.num_hosts == 1
 
 

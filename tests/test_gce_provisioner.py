@@ -1,0 +1,318 @@
+"""GCE compute-path provisioner + project bootstrap unit tests (fake
+compute REST API).  Covers VERDICT r1 missing #1/#2: plain CPU VMs must be
+provisionable (controllers, dev boxes) and a fresh project must be
+bootstrapped idempotently with typed permission errors."""
+import copy
+from typing import Any, Dict
+
+import pytest
+
+from skypilot_tpu import Resources, exceptions
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.provision.gcp import bootstrap as gcp_bootstrap
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+
+class FakeComputeApi:
+    """In-memory stand-in for ComputeApiClient (instances + global
+    network/firewall surface)."""
+
+    def __init__(self, project: str, fail_zones=None,
+                 deny_permissions=()):
+        self.project = project
+        self.instances: Dict[str, Dict[str, Any]] = {}  # zone/name -> body
+        self.networks: Dict[str, Dict[str, Any]] = {}
+        self.firewalls: Dict[str, Dict[str, Any]] = {}
+        self.fail_zones = fail_zones or {}
+        self.deny_permissions = set(deny_permissions)
+        self.deleted = []
+        self.created_firewalls = []
+        self.created_networks = []
+
+    # -- instances --------------------------------------------------------
+    def _key(self, zone, name):
+        return f'{zone}/{name}'
+
+    def create_instance(self, zone, body):
+        failure = self.fail_zones.get(zone)
+        if failure == 'capacity':
+            raise exceptions.CapacityError(
+                f'ZONE_RESOURCE_POOL_EXHAUSTED in {zone}')
+        if failure == 'quota':
+            raise exceptions.QuotaExceededError(f'Quota exceeded in {zone}')
+        inst = copy.deepcopy(body)
+        inst['status'] = 'RUNNING'
+        idx = len(self.instances)
+        inst['networkInterfaces'] = [{
+            'networkIP': f'10.128.0.{idx}',
+            'accessConfigs': [{'natIP': f'35.0.0.{idx}'}],
+        }]
+        self.instances[self._key(zone, body['name'])] = inst
+        return {'name': f'op-{body["name"]}', 'status': 'DONE'}
+
+    def get_instance(self, zone, name):
+        return self.instances[self._key(zone, name)]
+
+    def list_instances(self, zone, label_filter=None):
+        out = []
+        for key, inst in self.instances.items():
+            if not key.startswith(f'{zone}/'):
+                continue
+            labels = inst.get('labels') or {}
+            if label_filter and any(labels.get(k) != v
+                                    for k, v in label_filter.items()):
+                continue
+            out.append(inst)
+        return out
+
+    def delete_instance(self, zone, name):
+        self.instances.pop(self._key(zone, name), None)
+        self.deleted.append(name)
+        return {'name': f'op-del-{name}', 'status': 'DONE'}
+
+    def stop_instance(self, zone, name):
+        self.instances[self._key(zone, name)]['status'] = 'TERMINATED'
+        return {'name': f'op-stop-{name}', 'status': 'DONE'}
+
+    def start_instance(self, zone, name):
+        self.instances[self._key(zone, name)]['status'] = 'RUNNING'
+        return {'name': f'op-start-{name}', 'status': 'DONE'}
+
+    def wait_zone_operation(self, zone, operation, timeout=0, poll=0):
+        return operation
+
+    # -- global (bootstrap) ----------------------------------------------
+    def _check_permission(self, permission):
+        if permission in self.deny_permissions:
+            raise exceptions.ProvisionerError(
+                f'Permission denied: required permission {permission}',
+                retriable=False)
+
+    def get_network(self, name):
+        self._check_permission('compute.networks.get')
+        if name not in self.networks:
+            raise exceptions.ProvisionerError(
+                f'The resource network {name!r} was not found',
+                retriable=False)
+        return self.networks[name]
+
+    def create_network(self, body):
+        self._check_permission('compute.networks.create')
+        self.networks[body['name']] = body
+        self.created_networks.append(body['name'])
+        return {'name': f'op-net-{body["name"]}', 'status': 'DONE'}
+
+    def get_firewall(self, name):
+        self._check_permission('compute.firewalls.get')
+        if name not in self.firewalls:
+            raise exceptions.ProvisionerError(
+                f'The resource firewall {name!r} was not found',
+                retriable=False)
+        return self.firewalls[name]
+
+    def create_firewall(self, body):
+        self._check_permission('compute.firewalls.create')
+        self.firewalls[body['name']] = body
+        self.created_firewalls.append(body['name'])
+        return {'name': f'op-fw-{body["name"]}', 'status': 'DONE'}
+
+    def wait_global_operation(self, operation, timeout=0, poll=0):
+        return operation
+
+
+@pytest.fixture()
+def fake_compute(monkeypatch):
+    holder = {}
+
+    def factory(project, session=None):
+        if 'api' not in holder:
+            holder['api'] = FakeComputeApi(
+                project, fail_zones=holder.get('fail', {}),
+                deny_permissions=holder.get('deny', ()))
+        return holder['api']
+
+    monkeypatch.setattr(gcp_instance, '_compute_client_factory', factory)
+    monkeypatch.setattr(gcp_bootstrap, '_client_factory', factory)
+    monkeypatch.setattr(gcp_bootstrap, '_bootstrapped', set())
+    yield holder
+
+
+def _config(**over):
+    cfg = {
+        'project_id': 'proj', 'zone': 'us-central1-a', 'tpu_vm': False,
+        'instance_type': 'n2-standard-4', 'use_spot': False,
+        'num_nodes': 1, 'labels': {}, 'disk_size': 100,
+        'ssh_public_key': 'skypilot:ssh-ed25519 AAAA test',
+    }
+    cfg.update(over)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# compute instance CRUD
+# ---------------------------------------------------------------------------
+
+def test_create_single_vm(fake_compute):
+    record = gcp_instance.run_instances('us-central1', 'dev', _config())
+    assert record.created_instance_ids == ['dev-head']
+    info = gcp_instance.get_cluster_info('us-central1', 'dev', _config())
+    assert info.num_hosts == 1
+    assert info.head.instance_id == 'dev-head'
+    assert info.head.internal_ip == '10.128.0.0'
+    assert info.head.external_ip == '35.0.0.0'
+
+
+def test_multinode_names_head_first(fake_compute):
+    cfg = _config(num_nodes=3)
+    record = gcp_instance.run_instances('us-central1', 'c', cfg)
+    assert record.created_instance_ids == [
+        'c-head', 'c-worker-1', 'c-worker-2']
+    info = gcp_instance.get_cluster_info('us-central1', 'c', cfg)
+    assert [i.instance_id for i in info.instances] == [
+        'c-head', 'c-worker-1', 'c-worker-2']
+
+
+def test_gce_body_machine_type_and_keys(fake_compute):
+    gcp_instance.run_instances('us-central1', 'dev', _config())
+    inst = fake_compute['api'].instances['us-central1-a/dev-head']
+    assert inst['machineType'].endswith('machineTypes/n2-standard-4')
+    md = {i['key']: i['value'] for i in inst['metadata']['items']}
+    assert md['ssh-keys'].startswith('skypilot:')
+    assert inst['labels']['skypilot-tpu-cluster'] == 'dev'
+    assert inst['disks'][0]['boot'] is True
+
+
+def test_spot_sets_provisioning_model(fake_compute):
+    gcp_instance.run_instances('us-central1', 's', _config(use_spot=True))
+    inst = fake_compute['api'].instances['us-central1-a/s-head']
+    assert inst['scheduling']['provisioningModel'] == 'SPOT'
+
+
+def test_rerun_is_idempotent(fake_compute):
+    gcp_instance.run_instances('us-central1', 'c3', _config())
+    record = gcp_instance.run_instances('us-central1', 'c3', _config())
+    assert record.created_instance_ids == []
+    assert record.resumed_instance_ids == ['c3-head']
+
+
+def test_stop_start_cycle(fake_compute):
+    cfg = _config()
+    gcp_instance.run_instances('us-central1', 'c4', cfg)
+    gcp_instance.stop_instances('c4', cfg)
+    api = fake_compute['api']
+    assert api.instances['us-central1-a/c4-head']['status'] == 'TERMINATED'
+    assert gcp_instance.query_instances('c4', cfg) == {
+        'c4-head': 'stopped'}
+    gcp_instance.start_instances('c4', cfg)
+    assert api.instances['us-central1-a/c4-head']['status'] == 'RUNNING'
+
+
+def test_run_instances_restarts_stopped_vm(fake_compute):
+    cfg = _config()
+    gcp_instance.run_instances('us-central1', 'c5', cfg)
+    gcp_instance.stop_instances('c5', cfg)
+    record = gcp_instance.run_instances('us-central1', 'c5', cfg)
+    assert record.created_instance_ids == []
+    assert record.resumed_instance_ids == ['c5-head']
+    assert fake_compute['api'].instances[
+        'us-central1-a/c5-head']['status'] == 'RUNNING'
+
+
+def test_terminate_only_own_cluster(fake_compute):
+    gcp_instance.run_instances('us-central1', 'mine', _config())
+    gcp_instance.run_instances('us-central1', 'other', _config())
+    gcp_instance.terminate_instances('mine', _config())
+    api = fake_compute['api']
+    assert 'us-central1-a/mine-head' not in api.instances
+    assert 'us-central1-a/other-head' in api.instances
+
+
+def test_terminate_worker_only(fake_compute):
+    cfg = _config(num_nodes=2)
+    gcp_instance.run_instances('us-central1', 'c6', cfg)
+    gcp_instance.terminate_instances('c6', cfg, worker_only=True)
+    api = fake_compute['api']
+    assert 'us-central1-a/c6-head' in api.instances
+    assert 'us-central1-a/c6-worker-1' not in api.instances
+
+
+# ---------------------------------------------------------------------------
+# project bootstrap
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_fresh_project_creates_all(fake_compute):
+    cfg = gcp_bootstrap.bootstrap_instances('us-central1', 'c', _config())
+    api = fake_compute['api']
+    assert api.created_networks == ['default']
+    assert sorted(api.created_firewalls) == [
+        'skypilot-tpu-allow-internal', 'skypilot-tpu-allow-ssh']
+    assert cfg['project_id'] == 'proj'
+    ssh_rule = api.firewalls['skypilot-tpu-allow-ssh']
+    assert ssh_rule['allowed'][0]['ports'] == ['22']
+
+
+def test_bootstrap_partial_project_fills_gaps(fake_compute, monkeypatch):
+    holder = fake_compute
+    api = FakeComputeApi('proj')
+    api.networks['default'] = {'name': 'default'}
+    api.firewalls['skypilot-tpu-allow-ssh'] = {'name': 'x'}
+    holder['api'] = api
+    gcp_bootstrap.bootstrap_instances('us-central1', 'c', _config())
+    assert api.created_networks == []
+    assert api.created_firewalls == ['skypilot-tpu-allow-internal']
+
+
+def test_bootstrap_idempotent_second_call_cached(fake_compute):
+    gcp_bootstrap.bootstrap_instances('us-central1', 'c', _config())
+    api = fake_compute['api']
+    n_fw = len(api.created_firewalls)
+    gcp_bootstrap.bootstrap_instances('us-central1', 'c2', _config())
+    assert len(api.created_firewalls) == n_fw
+
+
+def test_bootstrap_no_permission_names_permission(fake_compute):
+    fake_compute['deny'] = {'compute.firewalls.create'}
+    with pytest.raises(exceptions.ProvisionerError) as exc:
+        gcp_bootstrap.bootstrap_instances('us-central1', 'c', _config())
+    assert 'compute.firewalls.create' in str(exc.value)
+    assert not exc.value.retriable
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: cpus-only GCP resources provision through the failover loop
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def gcp_configured(fake_compute, monkeypatch, tmp_home):
+    monkeypatch.setattr(provisioner, '_setup_runtime',
+                        lambda info, port, cluster_name: port)
+    config_lib.set_nested(('gcp', 'project_id'), 'test-proj')
+    yield fake_compute
+
+
+def test_cpu_vm_provisions_via_failover(gcp_configured):
+    res = Resources(cloud='gcp', cpus=4)
+    outcome = provisioner.provision_with_failover(res, 'ctrl')
+    assert outcome.handle.num_hosts == 1
+    head = outcome.handle.cluster_info.head
+    assert head.instance_id == 'ctrl-head'
+    assert head.internal_ip
+    # Bootstrap ran before run_instances.
+    api = gcp_configured['api']
+    assert 'skypilot-tpu-allow-ssh' in api.firewalls
+
+
+def test_cpu_vm_capacity_failover_next_zone(gcp_configured):
+    gcp_configured['fail'] = {'us-central1-a': 'capacity'}
+    res = Resources(cloud='gcp', cpus=4)
+    outcome = provisioner.provision_with_failover(res, 'ctrl2')
+    assert outcome.zone != 'us-central1-a'
+
+
+def test_instance_type_resources_provision(gcp_configured):
+    res = Resources(cloud='gcp', instance_type='e2-standard-8')
+    outcome = provisioner.provision_with_failover(res, 'ctrl3')
+    inst = gcp_configured['api'].instances[
+        f'{outcome.zone}/ctrl3-head']
+    assert inst['machineType'].endswith('machineTypes/e2-standard-8')

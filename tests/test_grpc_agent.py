@@ -1,0 +1,225 @@
+"""gRPC agent transport: parity with HTTP over the same AgentOps, version
+gating, and client fallback (VERDICT r1 missing #4; reference:
+sky/skylet/skylet.py:44 gRPC server + SkyletClient channel
+cloud_vm_ray_backend.py:2745)."""
+import threading
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from skypilot_tpu.agent import grpc_server, server as agent_server
+from skypilot_tpu.agent.grpc_client import GrpcAgentClient
+from skypilot_tpu.agent.ops import AgentOps, AgentState
+from skypilot_tpu.schemas.generated import agent_pb2 as pb
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils.status_lib import JobStatus
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    """AgentOps + live gRPC server on a free port."""
+    port = common_utils.find_free_port(47000)
+    state = AgentState(str(tmp_path / 'agent'), cluster_name='g1',
+                       grpc_port=port)
+    ops = AgentOps(state)
+    server = grpc_server.serve(ops, port)
+    yield ops, port
+    server.stop(grace=None)
+
+
+def _spec(run_cmd='echo grpc-ok'):
+    return {
+        'job_name': 'gj', 'username': 'u', 'run_timestamp': 'ts',
+        'task_id': 't1',
+        'hosts': [{'instance_id': 'h0', 'internal_ip': '127.0.0.1',
+                   'ssh': None, 'workdir': None}],
+        'commands': [run_cmd], 'envs': {'FOO': 'bar'},
+        'num_chips_per_node': 0, 'num_slices': 1,
+        'docker_container': None,
+    }
+
+
+def _wait_terminal(ops, job_id, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = ops.job_status(job_id)
+        if st is not None and st.is_terminal():
+            return st
+        time.sleep(0.3)
+    raise AssertionError('job did not finish')
+
+
+def test_spec_roundtrip_through_proto():
+    spec = _spec()
+    spec['hosts'].append({'instance_id': 'h1', 'internal_ip': '10.0.0.2',
+                          'ssh': {'user': 'sky', 'key_path': '/k',
+                                  'port': 2222}, 'workdir': 'wd'})
+    spec['commands'].append(None)     # rank no-op must survive
+    spec['docker_container'] = 'runtime'
+    back = grpc_server.spec_to_dict(grpc_server.dict_to_spec(spec))
+    assert back['hosts'][1]['ssh'] == {'user': 'sky', 'key_path': '/k',
+                                      'port': 2222}
+    assert back['hosts'][0]['ssh'] is None
+    assert back['commands'] == ['echo grpc-ok', None]
+    assert back['envs'] == {'FOO': 'bar'}
+    assert back['docker_container'] == 'runtime'
+    assert back['num_slices'] == 1
+
+
+def test_grpc_full_job_lifecycle(agent):
+    ops, port = agent
+    client = GrpcAgentClient('127.0.0.1', port)
+    health = client.health()
+    assert health['ok'] and health['cluster_name'] == 'g1'
+    assert health['agent_version'] >= 2
+    job_id = client.submit_job(_spec())
+    st = _wait_terminal(ops, job_id)
+    assert st == JobStatus.SUCCEEDED
+    assert client.job_status(job_id) == JobStatus.SUCCEEDED
+    jobs = client.queue(all_jobs=True)
+    assert jobs[0]['job_id'] == job_id
+    assert jobs[0]['status'] == 'SUCCEEDED'
+    # Log streaming carries the actual output.
+    text = ''.join(client.tail_logs(job_id, follow=False))
+    assert 'grpc-ok' in text
+    # Autostop round-trip.
+    client.set_autostop(7, down=True)
+    cfg = client.get_autostop()
+    assert cfg['idle_minutes'] == 7 and cfg['down'] is True
+    assert client.job_status(9999) is None
+    client.close()
+
+
+def test_transport_parity(agent):
+    """The same ops over gRPC and (simulated) HTTP return the same data."""
+    import asyncio
+    ops, port = agent
+    gclient = GrpcAgentClient('127.0.0.1', port)
+    job_id = gclient.submit_job(_spec('echo parity'))
+    _wait_terminal(ops, job_id)
+
+    app = agent_server.make_app(ops.state)
+
+    async def _http():
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        try:
+            q = await (await c.get('/jobs/queue?all=1')).json()
+            s = await (await c.get(f'/jobs/status?job_id={job_id}')).json()
+            h = await (await c.get('/health')).json()
+            return q['jobs'], s, h
+        finally:
+            await c.close()
+
+    http_jobs, http_status, http_health = asyncio.new_event_loop() \
+        .run_until_complete(_http())
+    grpc_jobs = gclient.queue(all_jobs=True)
+    assert [(j['job_id'], j['status'], j['name']) for j in http_jobs] == \
+        [(j['job_id'], j['status'], j['name']) for j in grpc_jobs]
+    assert http_status['status'] == gclient.job_status(job_id).value
+    assert http_health['agent_version'] >= 2
+    assert http_health['grpc_port'] == port
+    gclient.close()
+
+
+@pytest.fixture()
+def live_agent(tmp_path):
+    """A real agent process serving BOTH transports (main() path)."""
+    import subprocess
+    import sys
+    port = common_utils.find_free_port(47100)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.agent.server',
+         '--base-dir', str(tmp_path / 'live'), '--port', str(port),
+         '--cluster-name', 'glive'],
+        stdout=open(tmp_path / 'agent.log', 'wb'),
+        stderr=open(tmp_path / 'agent.log', 'ab'))
+    from skypilot_tpu.agent.client import AgentClient
+    client = AgentClient(f'http://127.0.0.1:{port}')
+    try:
+        client.wait_ready(timeout=30, expected_cluster='glive')
+        yield client, port
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_client_prefers_grpc_and_falls_back(live_agent):
+    """AgentClient uses gRPC when advertised, HTTP when the channel dies."""
+    client, port = live_agent
+    assert client.health().get('grpc_port') == port + 1
+    job_id = client.submit_job(_spec('echo via-grpc'))
+    assert client._grpc is not None, 'should have used gRPC'
+    assert client.wait_job(job_id, timeout=60) == JobStatus.SUCCEEDED
+    # Kill the channel: next op silently falls back to HTTP.
+    client._grpc.close()
+
+    class Dead:
+        def queue(self, all_jobs):
+            raise RuntimeError('channel down')
+    client._grpc = Dead()
+    jobs = client.queue(all_jobs=True)
+    assert any(j['job_id'] == job_id for j in jobs)
+    assert client._grpc is None   # dropped to HTTP permanently
+    # Streamed logs work over the (now-HTTP) transport too.
+    text = ''.join(client.tail_logs(job_id, follow=False))
+    assert 'via-grpc' in text
+
+
+def test_version_gate_no_grpc_advertised(tmp_path):
+    """--grpc-port 0 → health advertises no gRPC; client stays on HTTP."""
+    import subprocess
+    import sys
+    from skypilot_tpu.agent.client import AgentClient
+    port = common_utils.find_free_port(47300)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.agent.server',
+         '--base-dir', str(tmp_path / 'nogrpc'), '--port', str(port),
+         '--grpc-port', '0', '--cluster-name', 'g2'],
+        stdout=open(tmp_path / 'agent2.log', 'wb'),
+        stderr=subprocess.STDOUT)
+    client = AgentClient(f'http://127.0.0.1:{port}')
+    try:
+        client.wait_ready(timeout=30, expected_cluster='g2')
+        assert client.health().get('grpc_port') is None
+        assert client._grpc_client() is None
+        job_id = client.submit_job(_spec('echo http-only'))
+        assert client.wait_job(job_id, timeout=60) == JobStatus.SUCCEEDED
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_cancel_empty_vs_all_parity(agent):
+    """HTTP contract: job_ids=[] cancels NOTHING, None cancels ALL —
+    proto3 needs the explicit all_jobs flag to preserve that."""
+    ops, port = agent
+    client = GrpcAgentClient('127.0.0.1', port)
+    job_id = client.submit_job(_spec('sleep 60'))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = ops.job_status(job_id)
+        if st == JobStatus.RUNNING:
+            break
+        time.sleep(0.2)
+    assert client.cancel([]) == []           # empty list: no-op
+    assert ops.job_status(job_id) in (JobStatus.RUNNING,
+                                      JobStatus.PENDING)
+    cancelled = client.cancel(None)          # None: cancel all
+    assert job_id in cancelled
+    _wait_terminal(ops, job_id)
+    client.close()
+
+
+def test_queue_carries_timestamps(agent):
+    """CLI job tables need submitted_at over BOTH transports."""
+    ops, port = agent
+    client = GrpcAgentClient('127.0.0.1', port)
+    job_id = client.submit_job(_spec('echo ts'))
+    _wait_terminal(ops, job_id)
+    row = next(j for j in client.queue(all_jobs=True)
+               if j['job_id'] == job_id)
+    assert row['submitted_at'] and row['submitted_at'] > 1e9
+    assert row['end_at'] and row['end_at'] >= row['submitted_at']
+    client.close()

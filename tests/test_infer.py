@@ -11,6 +11,9 @@ from skypilot_tpu.models import llama
 CFG = llama.LLAMA_DEBUG
 
 
+pytestmark = pytest.mark.slow
+
+
 @pytest.fixture(scope='module')
 def params():
     return llama.init_params(CFG, jax.random.PRNGKey(0))

@@ -11,6 +11,8 @@ import pytest
 from tests.test_launch_e2e import iso_state  # noqa: F401
 
 
+
+pytestmark = pytest.mark.slow
 @pytest.fixture()
 def fake_kube(iso_state, tmp_path, monkeypatch):  # noqa: F811
     """Put a fake kubectl on PATH backed by a state dir."""

@@ -15,6 +15,8 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.utils.status_lib import ClusterStatus, JobStatus
 
 
+
+pytestmark = pytest.mark.slow
 @pytest.fixture()
 def iso_state(tmp_path, monkeypatch):
     home = tmp_path / 'home'

@@ -18,6 +18,8 @@ from skypilot_tpu.client.rest import RestClient
 from tests.chaos.chaos_proxy import ChaosProxy
 
 
+
+pytestmark = pytest.mark.slow
 def _free_port() -> int:
     s = socket.socket()
     s.bind(('127.0.0.1', 0))

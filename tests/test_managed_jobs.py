@@ -16,6 +16,8 @@ from skypilot_tpu.provision.local import instance as local_instance
 from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture reuse)
 
 
+
+pytestmark = pytest.mark.slow
 @pytest.fixture()
 def scheduler(iso_state):  # noqa: F811
     sched = Scheduler(poll_seconds=0.5)

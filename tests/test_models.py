@@ -2,6 +2,9 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
 
 from skypilot_tpu.models import llama, resnet
 from skypilot_tpu.parallel import MeshConfig, make_mesh

@@ -7,6 +7,9 @@ import pytest
 from skypilot_tpu.ops import attention, rmsnorm, rope
 
 
+pytestmark = pytest.mark.slow
+
+
 def _mha_inputs(batch=2, seq=256, heads=4, kv_heads=2, dim=64, seed=0):
     key = jax.random.PRNGKey(seed)
     kq, kk, kv = jax.random.split(key, 3)

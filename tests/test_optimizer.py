@@ -110,3 +110,98 @@ def test_multislice_cost_multiplies():
     assert GCP().get_hourly_cost(
         t.best_resources.copy(_price_per_hour=None)) == pytest.approx(
             2 * 256 * 1.2)
+
+
+# ---------------------------------------------------------------------------
+# Chain DP: egress + TIME target (VERDICT r1 weak #1)
+# ---------------------------------------------------------------------------
+
+def _fake_cloud(name, price, egress_per_gb):
+    """Register a throwaway cloud offering one instance at `price`/hr."""
+    from skypilot_tpu.clouds import cloud as cloud_lib
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+    class _Fake(cloud_lib.Cloud):
+        _REPR = name
+
+        def get_feasible_launchable_resources(self, resources):
+            if resources.cloud not in (None, name) or \
+                    resources.accelerator_name or resources.tpu_spec:
+                return cloud_lib.FeasibleResources([])
+            return cloud_lib.FeasibleResources([resources.copy(
+                cloud=name, region=f'{name}-r1',
+                instance_type=f'{name}-box', _price_per_hour=price)])
+
+        def get_hourly_cost(self, resources):
+            return resources.price_per_hour or price
+
+        def get_egress_cost(self, num_gigabytes):
+            return egress_per_gb * num_gigabytes
+
+    _Fake.__name__ = f'Fake{name.title()}'
+    CLOUD_REGISTRY._registry[name] = _Fake  # direct: avoid alias checks
+    return name
+
+
+@pytest.fixture()
+def two_fake_clouds():
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    saved = dict(CLOUD_REGISTRY._registry)
+    CLOUD_REGISTRY._registry.clear()   # only the fakes: deterministic DP
+    _fake_cloud('cheapsrc', price=1.0, egress_per_gb=0.5)
+    _fake_cloud('stickydst', price=2.0, egress_per_gb=0.0)
+    yield
+    CLOUD_REGISTRY._registry.clear()
+    CLOUD_REGISTRY._registry.update(saved)
+
+
+def _chain(two_sizes_gb):
+    dag = Dag()
+    a = Task(name='producer', run='x')
+    a.set_resources(Resources())          # feasible on both fakes
+    if two_sizes_gb is not None:
+        a.set_outputs('gs://out', estimated_size_gigabytes=two_sizes_gb)
+    b = Task(name='consumer', run='y')
+    b.set_resources(Resources(cloud='stickydst'))   # pinned
+    dag.add_edge(a, b)
+    return dag, a, b
+
+
+def test_chain_placement_flips_when_egress_dominates(two_fake_clouds):
+    # No declared outputs: producer goes to the cheap cloud.
+    dag, a, b = _chain(None)
+    Optimizer.optimize(dag, quiet=True)
+    assert a.best_resources.cloud == 'cheapsrc'
+    # 10 GB × $0.5/GB = $5 egress > $1/hr price gap: co-locate instead.
+    dag, a, b = _chain(10.0)
+    Optimizer.optimize(dag, quiet=True)
+    assert a.best_resources.cloud == 'stickydst'
+    # Tiny outputs: egress ($0.05) < price gap ($1): cheap cloud again.
+    dag, a, b = _chain(0.1)
+    Optimizer.optimize(dag, quiet=True)
+    assert a.best_resources.cloud == 'cheapsrc'
+
+
+def test_time_target_uses_runtime_estimator(two_fake_clouds):
+    from skypilot_tpu.optimizer import OptimizeTarget
+    t = Task(name='t', run='x')
+    t.set_resources(Resources())
+    # cheapsrc is cheaper but slower; stickydst faster.
+    t.set_time_estimator(
+        lambda res: 4.0 if res.cloud == 'cheapsrc' else 1.0)
+    dag = Dag()
+    dag.add(t)
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert t.best_resources.cloud == 'stickydst'
+    # COST target flips it back: 4h × $1 = $4 > 1h × $2.... no: $4 > $2,
+    # so COST also picks stickydst here; use a longer-but-cheap case.
+    t2 = Task(name='t2', run='x')
+    t2.set_resources(Resources())
+    t2.set_time_estimator(
+        lambda res: 1.5 if res.cloud == 'cheapsrc' else 1.0)
+    dag2 = Dag()
+    dag2.add(t2)
+    Optimizer.optimize(dag2, quiet=True)          # COST: 1.5×$1 < 1×$2
+    assert t2.best_resources.cloud == 'cheapsrc'
+    Optimizer.optimize(dag2, minimize=OptimizeTarget.TIME, quiet=True)
+    assert t2.best_resources.cloud == 'stickydst'  # TIME: 1h < 1.5h

@@ -9,6 +9,8 @@ from skypilot_tpu.models import llama
 from skypilot_tpu.ops import attention
 from skypilot_tpu.parallel import (MeshConfig, auto_mesh_config, make_mesh,
                                    collectives, ring_attention)
+
+pytestmark = pytest.mark.slow
 from skypilot_tpu.parallel import sharding as sharding_lib
 
 

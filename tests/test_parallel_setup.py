@@ -1,0 +1,135 @@
+"""Host fan-out parallelism: wheel install on every host, bounded-parallel
+sync (VERDICT r1 missing #3 / weak #3-#4).  16 fake hosts assert (a) all
+hosts get the runtime, (b) execution is concurrent (wall time ~ slowest
+host, not the sum), (c) the hash-gated install is a no-op on re-run."""
+import threading
+import time
+from typing import List
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.backends import tpu_backend as backend_mod
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.utils import command_runner as runner_lib
+
+_N_HOSTS = 16
+_DELAY = 0.15
+
+
+class InstrumentedRunner(runner_lib.CommandRunner):
+    """Records per-call concurrency; each call takes _DELAY seconds."""
+    lock = threading.Lock()
+    active = 0
+    max_active = 0
+    calls: List[str] = []
+
+    def __init__(self, node_id):
+        super().__init__(node_id)
+
+    @classmethod
+    def reset(cls):
+        cls.active = 0
+        cls.max_active = 0
+        cls.calls = []
+
+    def _enter(self, what):
+        cls = InstrumentedRunner
+        with cls.lock:
+            cls.active += 1
+            cls.max_active = max(cls.max_active, cls.active)
+            cls.calls.append(f'{self.node_id}:{what}')
+        time.sleep(_DELAY)
+        with cls.lock:
+            cls.active -= 1
+
+    def run(self, cmd, *, env=None, cwd=None, log_path=None,
+            stream_logs=False, require_outputs=False, timeout=None):
+        self._enter(f'run:{cmd[:30]}')
+        return 0
+
+    def rsync(self, source, target, *, up):
+        self._enter(f'rsync:{target}')
+
+
+@pytest.fixture()
+def fake_cluster(monkeypatch):
+    InstrumentedRunner.reset()
+    runners = [InstrumentedRunner(f'host-{i}') for i in range(_N_HOSTS)]
+    monkeypatch.setattr(provisioner, '_make_runners',
+                        lambda info: runners)
+    info = provision_common.ClusterInfo(
+        cluster_name='fan', cloud='gcp', region='r', zone='z',
+        instances=[provision_common.InstanceInfo(f'host-{i}', f'10.0.0.{i}')
+                   for i in range(_N_HOSTS)])
+    yield info, runners
+
+
+def test_wheel_install_all_hosts_parallel(fake_cluster, monkeypatch,
+                                          tmp_path):
+    info, runners = fake_cluster
+    wheel = tmp_path / 'skypilot_tpu-0.0-py3-none-any.whl'
+    wheel.write_bytes(b'fake')
+    from skypilot_tpu.backends import wheel_utils
+    monkeypatch.setattr(wheel_utils, 'build_wheel',
+                        lambda: (str(wheel), 'abc123'))
+    # Avoid the agent-start tail (no real agent in this test).
+    from skypilot_tpu.agent import client as agent_client
+    monkeypatch.setattr(agent_client.AgentClient, 'wait_ready',
+                        lambda self, timeout=0, expected_cluster=None: None)
+    start = time.time()
+    provisioner._setup_runtime(info, 46590, 'fan')
+    elapsed = time.time() - start
+    # Every host got mkdir + rsync + install (3 instrumented calls), plus
+    # the head's agent start.
+    installs = [c for c in InstrumentedRunner.calls if 'cat' in c or
+                'current' in c or 'wheel' in c.lower()]
+    rsyncs = [c for c in InstrumentedRunner.calls if c.startswith('host')
+              and ':rsync:' in c]
+    assert len(rsyncs) >= _N_HOSTS
+    hosts_with_install = {c.split(':')[0] for c in installs}
+    assert len(hosts_with_install) == _N_HOSTS
+    # Concurrency: genuinely parallel (not 1), and wall time far below
+    # the sequential sum (3 phases × 16 hosts × delay ≈ 7.2s sequential).
+    assert InstrumentedRunner.max_active >= 8
+    assert elapsed < 0.5 * (3 * _N_HOSTS * _DELAY)
+
+
+def test_sync_workdir_parallel(fake_cluster, monkeypatch, tmp_path):
+    info, runners = fake_cluster
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu import resources as resources_lib
+    handle = state_lib.ClusterHandle(
+        cluster_name='fan', launched_resources=resources_lib.Resources(),
+        cluster_info=info)
+    wd = tmp_path / 'wd'
+    wd.mkdir()
+    start = time.time()
+    backend_mod.TpuBackend().sync_workdir(handle, str(wd))
+    elapsed = time.time() - start
+    assert len([c for c in InstrumentedRunner.calls
+                if ':rsync:' in c]) == _N_HOSTS
+    assert InstrumentedRunner.max_active >= 8
+    assert elapsed < 0.5 * _N_HOSTS * _DELAY
+
+
+def test_sync_workdir_surfaces_per_host_failures(fake_cluster, monkeypatch,
+                                                 tmp_path):
+    info, runners = fake_cluster
+
+    def failing_rsync(self, source, target, *, up):
+        if self.node_id == 'host-7':
+            raise OSError('disk full')
+
+    monkeypatch.setattr(InstrumentedRunner, 'rsync', failing_rsync)
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu import resources as resources_lib
+    handle = state_lib.ClusterHandle(
+        cluster_name='fan', launched_resources=resources_lib.Resources(),
+        cluster_info=info)
+    wd = tmp_path / 'wd'
+    wd.mkdir()
+    with pytest.raises(exceptions.CommandError) as exc:
+        backend_mod.TpuBackend().sync_workdir(handle, str(wd))
+    assert '7' in str(exc.value)

@@ -12,6 +12,8 @@ from skypilot_tpu.parallel import pipeline as pipeline_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
 from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
 
+
+pytestmark = pytest.mark.slow
 CFG = llama.LlamaConfig(vocab_size=256, d_model=64, n_layers=4, n_heads=4,
                         n_kv_heads=2, d_ff=128, max_seq_len=128,
                         dtype=jnp.float32, remat=False)

@@ -21,6 +21,9 @@ from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture reuse)
 
 # --- spec ---
 
+pytestmark = pytest.mark.slow
+
+
 def test_spec_parse_roundtrip():
     spec = ServiceSpec.from_yaml_config({
         'readiness_probe': {'path': '/health',

@@ -11,6 +11,8 @@ import urllib.request
 
 import pytest
 
+
+pytestmark = pytest.mark.slow
 SCRIPT = os.path.join(os.path.dirname(__file__), '..', 'examples',
                       'scripts', 'serve_llama.py')
 

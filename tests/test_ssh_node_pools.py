@@ -5,6 +5,8 @@ import pytest
 
 from tests.test_launch_e2e import iso_state  # noqa: F401
 
+
+pytestmark = pytest.mark.slow
 POOL = {
     'user': 'ubuntu',
     'identity_file': '~/.ssh/id_rsa',
