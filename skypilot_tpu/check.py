@@ -20,9 +20,12 @@ logger = sky_logging.init_logger(__name__)
 _CACHE_PATH = '~/.skypilot_tpu/enabled_clouds.json'
 
 
-def check(quiet: bool = False) -> Dict[str, Any]:
+def check(quiet: bool = False, verbose: bool = False) -> Dict[str, Any]:
     """Probe all clouds; returns {cloud: {'enabled': bool, 'reason': str}}
-    and refreshes the enabled-clouds cache."""
+    and refreshes the enabled-clouds cache.  verbose runs each cloud's
+    deep diagnostics (API enablement, quota visibility — reference:
+    sky/check.py's per-cloud verbose probes) and attaches them under
+    'diagnostics'."""
     results: Dict[str, Any] = {}
     enabled: List[str] = []
     for name, cloud in CLOUD_REGISTRY.items():
@@ -33,6 +36,15 @@ def check(quiet: bool = False) -> Dict[str, Any]:
         if not quiet:
             mark = '✓' if ok else '✗'
             print(f'  {mark} {name}: {"enabled" if ok else reason}')
+        if verbose:
+            probes = cloud.check_diagnostics(credentials=(ok, reason))
+            results[name]['diagnostics'] = [
+                {'probe': p, 'ok': pok, 'detail': detail}
+                for p, pok, detail in probes]
+            if not quiet:
+                for p, pok, detail in probes:
+                    mark = '✓' if pok else '✗'
+                    print(f'      {mark} {p}: {detail}')
     path = os.path.expanduser(_CACHE_PATH)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, 'w', encoding='utf-8') as f:

@@ -137,15 +137,9 @@ def _cmd_autostop(args) -> int:
 
 
 def _cmd_check(args) -> int:
-    import skypilot_tpu.clouds  # noqa: F401  (registers all clouds)
-    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
-    ok_any = False
-    for cloud in CLOUD_REGISTRY.values():
-        ok, reason = cloud.check_credentials()
-        mark = '✓' if ok else '✗'
-        print(f'  {mark} {cloud}: {"enabled" if ok else reason}')
-        ok_any = ok_any or ok
-    return 0 if ok_any else 1
+    from skypilot_tpu import check as check_lib
+    results = check_lib.check(verbose=getattr(args, 'verbose', False))
+    return 0 if any(r['enabled'] for r in results.values()) else 1
 
 
 def _cmd_show_tpus(args) -> int:
@@ -161,6 +155,40 @@ def _cmd_show_tpus(args) -> int:
     print(_fmt_table(rows, ['TPU', 'CHIPS', 'HOSTS', '$/HR', '$/HR (SPOT)',
                             'CHEAPEST ZONE']))
     return 0
+
+
+def _cmd_ssh(args) -> int:
+    """Interactive shell on the cluster head (reference: `ssh <cluster>`
+    via the cluster entry in ~/.ssh/config + the API server's websocket
+    SSH proxy, sky/server/server.py:1712).  Direct path: exec ssh with
+    the cluster's key and head IP; local cloud: a bash in the host dir."""
+    import os
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(args.cluster)
+    if record is None:
+        print(f'Cluster {args.cluster!r} not found.', file=sys.stderr)
+        return 1
+    handle = record['handle']
+    info = handle.cluster_info
+    remote_cmd = ' '.join(args.cmd) if args.cmd else ''
+    if info.cloud == 'local':
+        wd = info.head.workdir
+        argv = ['/bin/bash'] + (['-c', remote_cmd] if remote_cmd
+                                else ['-i'])
+        os.chdir(wd)
+        os.execvp(argv[0], argv)
+    from skypilot_tpu.utils.command_runner import build_ssh_argv
+    argv = build_ssh_argv(
+        info.head.external_ip or info.head.internal_ip,
+        user=info.ssh_user, key_path=info.ssh_key_path,
+        port=info.head.ssh_port)
+    # Options must precede the user@host destination (OpenSSH stops
+    # option parsing there; a trailing -tt would run as the remote cmd).
+    argv.insert(-1, '-tt')
+    if remote_cmd:
+        argv.append(remote_cmd)
+    os.execvp(argv[0], argv)
+    return 0  # unreachable
 
 
 def _cmd_catalog(args) -> int:
@@ -245,11 +273,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=_cmd_autostop)
 
     p = sub.add_parser('check', help='Check cloud credentials')
+    p.add_argument('-v', '--verbose', action='store_true',
+                   help='Run deep diagnostics (API enablement, quotas)')
     p.set_defaults(fn=_cmd_check)
 
     p = sub.add_parser('show-tpus', help='List TPU offerings and prices')
     p.add_argument('filter', nargs='?', default=None)
     p.set_defaults(fn=_cmd_show_tpus)
+
+    p = sub.add_parser('ssh', help='Open a shell on the cluster head')
+    p.add_argument('cluster')
+    p.add_argument('cmd', nargs='*', help='Run this instead of a shell')
+    p.set_defaults(fn=_cmd_ssh)
 
     p = sub.add_parser('catalog', help='Offering catalog cache')
     p.add_argument('catalog_cmd', nargs='?', default='status',

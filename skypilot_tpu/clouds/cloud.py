@@ -85,6 +85,14 @@ class Cloud:
         raise NotImplementedError
 
     # ---- credentials -----------------------------------------------------
+    def check_diagnostics(self, credentials=None) -> list:
+        """Deep `check -v` probes beyond credential presence: API
+        enablement, quota visibility, etc.  Returns
+        [(probe_name, ok, detail)] — empty when the cloud has nothing
+        beyond check_credentials (reference: per-cloud diagnostics in
+        sky/check.py's verbose output)."""
+        return []
+
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         """(ok, reason-if-not)."""
         raise NotImplementedError

@@ -20,6 +20,17 @@ if typing.TYPE_CHECKING:
     from skypilot_tpu import resources as resources_lib
 
 
+# Diagnostics client factories (swappable in tests).
+def _diagnostics_compute_client(project):
+    from skypilot_tpu.provision.gcp import compute_api
+    return compute_api.ComputeApiClient(project)
+
+
+def _diagnostics_tpu_client(project):
+    from skypilot_tpu.provision.gcp import tpu_api
+    return tpu_api.TpuApiClient(project)
+
+
 @CLOUD_REGISTRY.register()
 class GCP(cloud_lib.Cloud):
     _REPR = 'GCP'
@@ -180,6 +191,51 @@ class GCP(cloud_lib.Cloud):
         # one flat rate keeps the optimizer's chain DP honest without a
         # tier table).
         return 0.12 * num_gigabytes
+
+    def check_diagnostics(self, credentials=None) -> list:
+        """`skytpu check -v` probes: credentials → project visibility +
+        CPU quota (compute API enabled) → TPU API enablement (locations
+        list).  Each failure names the API/permission to fix, turning the
+        reference's fresh-project SSH-timeout mystery into an actionable
+        message (reference: sky/check.py per-cloud diagnostics).
+        `credentials`: a precomputed check_credentials() result, so
+        check(verbose=True) does not probe ADC twice per cloud."""
+        out = []
+        ok, reason = (credentials if credentials is not None
+                      else self.check_credentials())
+        out.append(('credentials', ok, reason or 'application-default '
+                    'credentials found'))
+        if not ok:
+            return out
+        project = config_lib.get_nested(('gcp', 'project_id'))
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision.gcp import compute_api
+        client = _diagnostics_compute_client(project)
+        try:
+            info = client._compute_request(
+                'GET', f'{compute_api._COMPUTE}/projects/{project}')
+            cpus = next((q for q in info.get('quotas', [])
+                         if q.get('metric') == 'CPUS_ALL_REGIONS'), None)
+            detail = (f'project {project!r} visible'
+                      + (f'; global CPU quota '
+                         f'{cpus["usage"]:.0f}/{cpus["limit"]:.0f} used'
+                         if cpus else ''))
+            out.append(('compute-api', True, detail))
+        except exceptions.ProvisionerError as e:
+            out.append(('compute-api', False,
+                        f'compute.googleapis.com probe failed — enable '
+                        f'the Compute Engine API on {project!r}: {e}'))
+        tclient = _diagnostics_tpu_client(project)
+        try:
+            tclient._request(
+                'GET', f'projects/{project}/locations',
+                params={'pageSize': 1})
+            out.append(('tpu-api', True, 'tpu.googleapis.com enabled'))
+        except exceptions.ProvisionerError as e:
+            out.append(('tpu-api', False,
+                        f'tpu.googleapis.com probe failed — enable the '
+                        f'Cloud TPU API on {project!r}: {e}'))
+        return out
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         # Application-default credentials or service-account key present?

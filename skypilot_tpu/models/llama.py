@@ -136,7 +136,11 @@ def _remat_policy(config: LlamaConfig):
 
 def _layer(h: jax.Array, layer_params: Params, *, config: LlamaConfig,
            cos: jax.Array, sin: jax.Array,
-           attention_fn: AttentionFn) -> jax.Array:
+           attention_fn: AttentionFn,
+           positions: Optional[jax.Array] = None) -> jax.Array:
+    # positions (B, S) global token positions — needed when h is a
+    # sequence SHARD inside a manual region (pp×sp pipeline), where local
+    # row i is global position shard_start + i.
     batch, seq, d = h.shape
     hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
     attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
@@ -145,8 +149,8 @@ def _layer(h: jax.Array, layer_params: Params, *, config: LlamaConfig,
     q = (x @ attn_p['wq']).reshape(batch, seq, nh, hd)
     k = (x @ attn_p['wk']).reshape(batch, seq, nkv, hd)
     v = (x @ attn_p['wv']).reshape(batch, seq, nkv, hd)
-    q = rope_ops.apply_rope(q, cos, sin)
-    k = rope_ops.apply_rope(k, cos, sin)
+    q = rope_ops.apply_rope(q, cos, sin, positions=positions)
+    k = rope_ops.apply_rope(k, cos, sin, positions=positions)
     o = attention_fn(q, k, v)
     h = h + (o.reshape(batch, seq, nh * hd) @ attn_p['wo'])
 
@@ -184,13 +188,24 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
 def forward_pipelined(params: Params, tokens: jax.Array,
                       config: LlamaConfig, *, mesh,
                       num_microbatches: int,
-                      attention_fn: Optional[AttentionFn] = None
+                      attention_fn: Optional[AttentionFn] = None,
+                      sequence_axis: Optional[str] = None
                       ) -> jax.Array:
     """forward() with the layer stack split into GPipe stages over the
     mesh's 'pp' axis (embed/head replicated across stages; see
-    parallel/pipeline.py for the schedule)."""
+    parallel/pipeline.py for the schedule).
+
+    sequence_axis: long-context pp×sp composition — activations are also
+    sequence-sharded over that axis inside the pipeline's manual region
+    and attention runs as a manual ring (ring_attention_manual).  RoPE
+    uses global positions derived from the sequence shard index."""
     from skypilot_tpu.parallel import pipeline as pipeline_lib
-    if attention_fn is None:
+    if sequence_axis is not None:
+        from skypilot_tpu.parallel import ring_attention as ring_lib
+        attention_fn = functools.partial(
+            ring_lib.ring_attention_manual, axis_name=sequence_axis,
+            causal=True)
+    elif attention_fn is None:
         attention_fn = functools.partial(attention_ops.flash_attention,
                                          causal=True)
     num_stages = mesh.shape['pp']
@@ -205,14 +220,28 @@ def forward_pipelined(params: Params, tokens: jax.Array,
         layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config))
 
     def stage_fn(stage_layers, h_mb):
+        if sequence_axis is not None:
+            # h_mb is a sequence SHARD: global position of local row i is
+            # shard_index * S_local + i (drives RoPE and the ring's
+            # causal masking).
+            s_local = h_mb.shape[1]
+            start = jax.lax.axis_index(sequence_axis) * s_local
+            positions = jnp.broadcast_to(
+                (start + jnp.arange(s_local, dtype=jnp.int32))[None],
+                h_mb.shape[:2])
+        else:
+            positions = None
+
         def scan_body(carry, layer_params):
-            return layer_fn(carry, layer_params), None
+            return layer_fn(carry, layer_params,
+                            positions=positions), None
         h_mb, _ = jax.lax.scan(scan_body, h_mb, stage_layers)
         return h_mb
 
     stage_params = pipeline_lib.stack_stages(params['layers'], num_stages)
     h = pipeline_lib.pipeline_apply(stage_fn, stage_params, h, mesh=mesh,
-                                    num_microbatches=num_microbatches)
+                                    num_microbatches=num_microbatches,
+                                    seq_axis=sequence_axis)
     h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
     return (h @ params['lm_head']).astype(jnp.float32)
 

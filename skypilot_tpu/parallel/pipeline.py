@@ -42,17 +42,28 @@ def pipeline_apply(stage_fn: StageFn,
                    *,
                    mesh,
                    num_microbatches: int,
-                   axis_name: str = 'pp') -> jax.Array:
+                   axis_name: str = 'pp',
+                   seq_axis: str = None,
+                   seq_dim: int = 1) -> jax.Array:
     """Run h (B, ...) through S pipeline stages of stage_fn.
 
     stage_params: pytree with leading stage axis S (stack_stages output),
     sharded P('pp', ...).  stage_fn(params_for_stage, h_mb) -> h_mb applies
     one stage to one microbatch.  Returns h after all stages, with the
     input's sharding.
+
+    seq_axis: composes sequence parallelism INSIDE the pipeline's manual
+    region: h's seq_dim is sharded over that mesh axis and stage_fn runs
+    on sequence SHARDS — it must use a manual-collective attention
+    (ring_attention_manual) rather than a nested shard_map, which Shardy
+    rejects ('axis already bound by a parent manual computation').
     """
     num_stages = mesh.shape[axis_name]
-    if num_stages == 1:
+    if num_stages == 1 and seq_axis is None:
         return stage_fn(jax.tree.map(lambda x: x[0], stage_params), h)
+    # num_stages == 1 WITH a seq_axis still runs the general path: the
+    # stage_fn's ring collectives need the manual region (a 1-member
+    # ppermute/psum over pp is free).
     batch = h.shape[0]
     assert batch % num_microbatches == 0, (batch, num_microbatches)
     mb = batch // num_microbatches
@@ -62,17 +73,30 @@ def pipeline_apply(stage_fn: StageFn,
     x_mb = h.reshape(num_microbatches, mb, *h.shape[1:])
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    manual_axes = {axis_name}
+    if seq_axis is not None:
+        manual_axes.add(seq_axis)
 
-    # Partial manualization: only 'pp' goes manual — dp/fsdp/sp/tp stay
-    # automatic inside the stage, so GSPMD keeps sharding the stage's
-    # matmuls and ring attention's own shard_map still composes.
+    # Partial manualization: only pp (and optionally the sequence axis)
+    # go manual — dp/fsdp/tp stay automatic inside the stage, so GSPMD
+    # keeps sharding the stage's matmuls.  Activation specs stay P()
+    # (jax's partial-manual spec check accepts nothing else); the
+    # sequence split/reassembly happens INSIDE the manual region via
+    # dynamic_slice + all_gather, so layers still run on seq shards and
+    # the replication cost is boundary-only.
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
-        axis_names={axis_name},
+        axis_names=manual_axes,
         check_vma=False)
     def _pipelined(params_local, x_local):
+        if seq_axis is not None:
+            sp_size = mesh.shape[seq_axis]
+            s_local = x_local.shape[seq_dim + 1] // sp_size
+            x_local = lax.dynamic_slice_in_dim(
+                x_local, lax.axis_index(seq_axis) * s_local, s_local,
+                axis=seq_dim + 1)
         # params_local leading dim is 1 (this device's stage).
         params_here = jax.tree.map(lambda x: x[0], params_local)
         stage = lax.axis_index(axis_name)
@@ -108,8 +132,13 @@ def pipeline_apply(stage_fn: StageFn,
         outputs = jnp.where(stage == num_stages - 1, outputs,
                             jnp.zeros_like(outputs))
         dtype = outputs.dtype
-        return lax.psum(outputs.astype(jnp.float32),
-                        axis_name).astype(dtype)
+        outputs = outputs.astype(jnp.float32)
+        if seq_axis is not None:
+            # Reassemble the sequence shards (out spec is P(): every
+            # device returns the full activation).
+            outputs = lax.all_gather(outputs, seq_axis,
+                                     axis=seq_dim + 1, tiled=True)
+        return lax.psum(outputs, axis_name).astype(dtype)
 
     out = _pipelined(stage_params, x_mb)
     return out.reshape(batch, *h.shape[1:])

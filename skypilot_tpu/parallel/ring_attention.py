@@ -91,6 +91,17 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     return jnp.einsum('bhqd->bqhd', out).astype(q.dtype)
 
 
+def ring_attention_manual(q, k, v, *, axis_name: str = 'sp',
+                          causal: bool = True):
+    """Ring attention for callers ALREADY inside a manual region that
+    bound `axis_name` (e.g. the pp×sp pipeline, parallel/pipeline.py
+    seq_axis): q/k/v are (B, S_local, H, D) sequence shards and the ring
+    ppermute rides the existing binding — no nested shard_map, which
+    Shardy rejects under a parent manual computation."""
+    return _ring_attention_local(q, k, v, axis_name=axis_name,
+                                 causal=causal)
+
+
 def ring_attention(q, k, v, mesh=None, *, axis_name: str = 'sp',
                    causal: bool = True,
                    batch_axes=('dp', 'fsdp'), head_axis: Optional[str] = 'tp'):

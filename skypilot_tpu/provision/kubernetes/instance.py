@@ -97,6 +97,34 @@ def _pod_manifest(cluster_name: str, index: int,
     }
 
 
+def _ensure_fuse_proxy_daemonset(namespace: str,
+                                 context: Optional[str]) -> None:
+    """Deploy the privileged fusermount-server DaemonSet (idempotent
+    apply) so unprivileged task pods can FUSE-mount storage.  Best-effort:
+    clusters without the image or RBAC still launch — only storage MOUNT
+    tasks need it (reference: fusermount-server-daemonset.yaml consumed by
+    sky/provision/kubernetes)."""
+    import os
+    if (namespace, context) in _fuse_daemonset_applied:
+        return
+    manifest = os.path.join(os.path.dirname(__file__), 'manifests',
+                            'fusermount_server_daemonset.yaml')
+    try:
+        with open(manifest, encoding='utf-8') as f:
+            _kubectl(['apply', '-f', '-'], context=context,
+                     namespace=namespace, stdin=f.read())
+        _fuse_daemonset_applied.add((namespace, context))
+    except Exception as e:  # pylint: disable=broad-except
+        # Truly best-effort: TimeoutExpired from a slow apiserver (or any
+        # other failure) must not abort provisioning — only FUSE storage
+        # mounts depend on the DaemonSet.
+        logger.debug(f'fuse-proxy DaemonSet not deployed ({e}); '
+                     f'FUSE storage mounts need privileged pods.')
+
+
+_fuse_daemonset_applied: set = set()
+
+
 def run_instances(region: str, cluster_name: str,
                   config: Dict[str, Any]) -> common.ProvisionRecord:
     # The k8s "region" is the namespace (each kube-context being a
@@ -104,6 +132,7 @@ def run_instances(region: str, cluster_name: str,
     # context-per-region model).
     namespace = config.get('namespace') or region or 'default'
     context = config.get('context')
+    _ensure_fuse_proxy_daemonset(namespace, context)
     num_hosts = int(config.get('num_hosts', 1)) * int(
         config.get('num_nodes', 1))
     existing = _list_pods(cluster_name, namespace, context)

@@ -34,6 +34,14 @@ DEFAULT_PORT = 46580
 from skypilot_tpu.server.versions import API_VERSION  # noqa: E402
 
 
+def _ssh_target(record) -> tuple:
+    """(host, port) of a cluster's SSH endpoint for the ws tunnel
+    (separate hook so tests can point it at a fake TCP server)."""
+    info = record['handle'].cluster_info
+    return (info.head.external_ip or info.head.internal_ip,
+            info.head.ssh_port)
+
+
 def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({'error': message}, status=status)
 
@@ -304,6 +312,53 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
         except Exception as e:  # pylint: disable=broad-except
             return _json_error(502, f'Log fetch failed: {e}')
         return web.Response(text=text, content_type='text/plain')
+
+    @routes.get('/ssh/{cluster}')
+    async def ssh_tunnel(request: web.Request) -> web.StreamResponse:
+        """Websocket ↔ TCP bridge to the cluster head's SSH port, so
+        clients behind the API server (no direct network path to the VM)
+        still get `ssh` (reference: the websocket SSH proxy,
+        sky/server/server.py:1712).  Binary ws frames carry raw TCP
+        bytes in both directions."""
+        import aiohttp as aiohttp_mod
+        from skypilot_tpu import state as state_lib
+        cluster = request.match_info['cluster']
+        record = await asyncio.to_thread(state_lib.get_cluster, cluster)
+        if record is None:
+            return _json_error(404, f'No cluster {cluster!r}')
+        host, port = _ssh_target(record)
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError as e:
+            await ws.close(code=1011,
+                           message=f'connect {host}:{port}: {e}'.encode())
+            return ws
+
+        async def _pump_tcp_to_ws():
+            try:
+                while True:
+                    data = await reader.read(65536)
+                    if not data:
+                        break
+                    await ws.send_bytes(data)
+            finally:
+                await ws.close()
+
+        pump = asyncio.create_task(_pump_tcp_to_ws())
+        try:
+            async for msg in ws:
+                if msg.type == aiohttp_mod.WSMsgType.BINARY:
+                    writer.write(msg.data)
+                    await writer.drain()
+                elif msg.type in (aiohttp_mod.WSMsgType.ERROR,
+                                  aiohttp_mod.WSMsgType.CLOSE):
+                    break
+        finally:
+            pump.cancel()
+            writer.close()
+        return ws
 
     @routes.get('/api/volumes')
     async def api_volumes(request: web.Request) -> web.Response:

@@ -126,10 +126,8 @@ def _conn() -> sqlite3.Connection:
 _migrated_paths = set()
 
 
-def _migrate(conn: sqlite3.Connection, path: str) -> None:
-    """Additive column migrations, once per DB path per process."""
-    if path in _migrated_paths:
-        return
+def _migration_v1(conn: sqlite3.Connection) -> None:
+    """Workspace/RBAC columns (round 1)."""
     from skypilot_tpu.utils import db_utils
     db_utils.add_columns_if_missing(
         conn, 'clusters', (('workspace', "TEXT DEFAULT 'default'"),
@@ -138,6 +136,22 @@ def _migrate(conn: sqlite3.Connection, path: str) -> None:
         conn, 'cluster_history', (('hourly_cost', 'REAL'),))
     db_utils.add_columns_if_missing(
         conn, 'storage', (('config_json', 'TEXT'),))
+
+
+# Ordered, append-only (alembic-style linear history): NEVER reorder or
+# edit an entry that has shipped — append a new one.
+_MIGRATIONS = [
+    _migration_v1,
+]
+
+
+def _migrate(conn: sqlite3.Connection, path: str) -> None:
+    """Versioned migrations to head, once per DB path per process
+    (reference: alembic runner sky/utils/db/migration_utils.py)."""
+    if path in _migrated_paths:
+        return
+    from skypilot_tpu.utils import db_utils
+    db_utils.migrate_to_head(conn, _MIGRATIONS)
     _migrated_paths.add(path)
 
 

@@ -1,12 +1,43 @@
-"""Small sqlite helpers shared by the state DBs.
+"""Small sqlite helpers + versioned schema migrations for the state DBs.
 
-Reference parity: sky/utils/db/migration_utils.py (alembic-based there;
-additive ALTER-if-missing suffices for this build's append-only schemas).
+Reference parity: sky/utils/db/migration_utils.py (alembic there).  This
+build's framework is stdlib: a `schema_version` table plus an ORDERED list
+of migration callables, applied transactionally from the recorded version
+to head on every first connection — the alembic upgrade-path model without
+the dependency.  Postgres note: the reference's multi-user API server can
+point state at Postgres via SQLAlchemy; here the seam is the same SQL
+subset + this migration runner, gated until a postgres driver is bundled
+(state.py docstring documents the contract).
 """
 from __future__ import annotations
 
 import sqlite3
-from typing import Iterable, Tuple
+from typing import Callable, Iterable, List, Tuple
+
+Migration = Callable[[sqlite3.Connection], None]
+
+
+def migrate_to_head(conn: sqlite3.Connection,
+                    migrations: List[Migration],
+                    version_table: str = 'schema_version') -> int:
+    """Apply `migrations[recorded:]` in order; returns the new version.
+
+    The recorded version is len(applied-so-far) (alembic-style linear
+    history).  Each migration runs in the connection's transaction and
+    must be additive/idempotent-tolerant: two processes racing on first
+    connect both read the old version, and the loser's re-run must not
+    corrupt (ALTERs go through add_columns_if_missing, CREATEs use IF
+    NOT EXISTS)."""
+    conn.execute(f'CREATE TABLE IF NOT EXISTS {version_table} '
+                 f'(version INTEGER NOT NULL)')
+    row = conn.execute(f'SELECT MAX(version) FROM {version_table}'
+                       ).fetchone()
+    current = row[0] if row and row[0] is not None else 0
+    for version in range(current, len(migrations)):
+        migrations[version](conn)
+        conn.execute(f'INSERT INTO {version_table} (version) VALUES (?)',
+                     (version + 1,))
+    return max(current, len(migrations))
 
 
 def add_columns_if_missing(conn: sqlite3.Connection, table: str,

@@ -47,7 +47,12 @@ def main():
         print('{"clientVersion": {"gitVersion": "v1.fake"}}')
         return
     if cmd == 'apply':
-        manifest = json.load(sys.stdin)
+        raw = sys.stdin.read()
+        try:
+            manifest = json.loads(raw)
+        except json.JSONDecodeError:
+            import yaml
+            manifest = yaml.safe_load(raw)
         name = manifest['metadata']['name']
         # Fake scheduler: pod is instantly Running with a pod IP.
         idx = len(_pods())

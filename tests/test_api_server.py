@@ -132,3 +132,63 @@ def test_request_listing_and_stream(live_server, monkeypatch):
     # Stream terminates for a finished request.
     lines = list(client.stream(request_id))
     assert isinstance(lines, list)
+
+
+def test_websocket_ssh_tunnel(live_server, monkeypatch):
+    """/ssh/{cluster} bridges ws frames <-> the head's TCP port
+    (reference: websocket SSH proxy, sky/server/server.py:1712).  A local
+    TCP echo server stands in for sshd."""
+    import asyncio
+    import socket
+
+    import aiohttp
+
+    import skypilot_tpu as sky
+    from skypilot_tpu.server import server as server_lib
+
+    task = sky.Task(run='true', name='t')
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='wstun')
+    try:
+        # Fake sshd: a TCP echo server on a free port.
+        echo_port = _free_port()
+
+        async def _drive():
+            async def _echo(reader, writer):
+                while True:
+                    data = await reader.read(1024)
+                    if not data:
+                        break
+                    writer.write(b'echo:' + data)
+                    await writer.drain()
+
+            server = await asyncio.start_server(_echo, '127.0.0.1',
+                                                echo_port)
+            try:
+                async with aiohttp.ClientSession() as session:
+                    ws = await session.ws_connect(
+                        f'{live_server}/ssh/wstun')
+                    await ws.send_bytes(b'SSH-2.0-probe\r\n')
+                    msg = await asyncio.wait_for(ws.receive(), 10)
+                    assert msg.type == aiohttp.WSMsgType.BINARY
+                    assert msg.data == b'echo:SSH-2.0-probe\r\n'
+                    await ws.close()
+            finally:
+                # No wait_closed(): py3.12 would block on the tunnel's
+                # still-open TCP connection (closed by the server thread
+                # asynchronously).
+                server.close()
+
+        monkeypatch.setattr(server_lib, '_ssh_target',
+                            lambda record: ('127.0.0.1', echo_port))
+        asyncio.new_event_loop().run_until_complete(_drive())
+
+        # Unknown cluster -> 404, not a hung socket.
+        async def _missing():
+            async with aiohttp.ClientSession() as session:
+                resp = await session.get(f'{live_server}/ssh/nope')
+                assert resp.status == 404
+
+        asyncio.new_event_loop().run_until_complete(_missing())
+    finally:
+        sky.down('wstun')

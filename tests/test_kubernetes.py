@@ -13,6 +13,8 @@ from tests.test_launch_e2e import iso_state  # noqa: F401
 
 
 pytestmark = pytest.mark.slow
+
+
 @pytest.fixture()
 def fake_kube(iso_state, tmp_path, monkeypatch):  # noqa: F811
     """Put a fake kubectl on PATH backed by a state dir."""
@@ -24,9 +26,12 @@ def fake_kube(iso_state, tmp_path, monkeypatch):  # noqa: F811
     shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
     monkeypatch.setenv('PATH', f'{bin_dir}:{os.environ["PATH"]}')
     monkeypatch.setenv('FAKE_KUBE_DIR', str(tmp_path / 'kube_state'))
-    # The credential probe caches; clear it per test.
+    # The credential probe and DaemonSet-applied caches persist per
+    # process; clear them per test.
     from skypilot_tpu.clouds import kubernetes as k8s_cloud
+    from skypilot_tpu.provision.kubernetes import instance as k8s_instance
     k8s_cloud._kubectl_reachable.cache_clear()
+    monkeypatch.setattr(k8s_instance, '_fuse_daemonset_applied', set())
     yield tmp_path / 'kube_state'
     k8s_cloud._kubectl_reachable.cache_clear()
 
@@ -122,3 +127,20 @@ def test_no_kubectl_credentials(iso_state, monkeypatch, tmp_path):  # noqa: F811
     ok, reason = k8s_cloud.Kubernetes().check_credentials()
     assert not ok and 'kubectl' in reason
     k8s_cloud._kubectl_reachable.cache_clear()
+
+
+def test_fuse_proxy_daemonset_deployed(fake_kube):
+    """run_instances applies the fusermount-server DaemonSet so
+    unprivileged pods can FUSE-mount storage (reference:
+    fusermount-server-daemonset.yaml consumed by the k8s provisioner)."""
+    from skypilot_tpu import provision as provision_api
+    provision_api.run_instances('kubernetes', 'default', 'kfp',
+                                {'num_hosts': 1})
+    ds_file = fake_kube / 'skypilot-tpu-fusermount-server.json'
+    assert ds_file.exists()
+    ds = json.loads(ds_file.read_text())
+    assert ds['kind'] == 'DaemonSet'
+    tmpl = ds['spec']['template']['spec']
+    assert tmpl['containers'][0]['securityContext']['privileged'] is True
+    assert any(v.get('hostPath', {}).get('path') == '/dev/fuse'
+               for v in tmpl['volumes'])

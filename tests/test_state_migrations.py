@@ -1,0 +1,78 @@
+"""Versioned state-DB migrations (VERDICT r1 missing #7; reference:
+alembic runner sky/utils/db/migration_utils.py)."""
+import sqlite3
+
+from skypilot_tpu.utils import db_utils
+
+
+def _old_db(path):
+    """A pre-migration round-0 DB: clusters without workspace columns."""
+    conn = sqlite3.connect(path)
+    conn.executescript('''
+        CREATE TABLE clusters (name TEXT PRIMARY KEY, launched_at REAL,
+            handle_json TEXT, status TEXT, last_use TEXT,
+            autostop_json TEXT, to_down INTEGER DEFAULT 0);
+        CREATE TABLE cluster_history (name TEXT, launched_at REAL,
+            torn_down_at REAL, resources TEXT, duration_s REAL);
+        CREATE TABLE storage (name TEXT PRIMARY KEY, store TEXT,
+            mode TEXT, last_attached_cluster TEXT, created_at REAL);
+    ''')
+    conn.execute("INSERT INTO clusters (name, status) VALUES ('old', 'UP')")
+    conn.commit()
+    return conn
+
+
+def test_upgrade_old_db_to_head(tmp_path):
+    path = str(tmp_path / 'state.db')
+    conn = _old_db(path)
+    from skypilot_tpu import state
+    version = db_utils.migrate_to_head(conn, state._MIGRATIONS)
+    assert version == len(state._MIGRATIONS)
+    cols = {r[1] for r in conn.execute('PRAGMA table_info(clusters)')}
+    assert {'workspace', 'user_hash'} <= cols
+    # Existing rows survive with defaults.
+    row = conn.execute(
+        "SELECT workspace FROM clusters WHERE name='old'").fetchone()
+    assert row[0] in ('default', None)
+
+
+def test_migrations_idempotent_and_recorded(tmp_path):
+    path = str(tmp_path / 'state.db')
+    conn = _old_db(path)
+    from skypilot_tpu import state
+    db_utils.migrate_to_head(conn, state._MIGRATIONS)
+    v1 = conn.execute('SELECT MAX(version) FROM schema_version'
+                      ).fetchone()[0]
+    # Second run: no-op, version unchanged.
+    db_utils.migrate_to_head(conn, state._MIGRATIONS)
+    v2 = conn.execute('SELECT MAX(version) FROM schema_version'
+                      ).fetchone()[0]
+    assert v1 == v2 == len(state._MIGRATIONS)
+
+
+def test_new_migration_applies_from_recorded_version(tmp_path):
+    path = str(tmp_path / 'state.db')
+    conn = _old_db(path)
+    from skypilot_tpu import state
+    db_utils.migrate_to_head(conn, state._MIGRATIONS)
+    applied = []
+
+    def _v_next(c):
+        applied.append(True)
+        c.execute('CREATE TABLE IF NOT EXISTS new_feature (x TEXT)')
+
+    extended = list(state._MIGRATIONS) + [_v_next]
+    db_utils.migrate_to_head(conn, extended)
+    assert applied == [True]           # only the NEW migration ran
+    db_utils.migrate_to_head(conn, extended)
+    assert applied == [True]           # and only once
+
+
+def test_fresh_db_through_state_module(tmp_path, monkeypatch, tmp_home):
+    """state._conn on a fresh DB lands at head version."""
+    from skypilot_tpu import state
+    monkeypatch.setattr(state, '_migrated_paths', set())
+    with state._conn() as conn:
+        v = conn.execute('SELECT MAX(version) FROM schema_version'
+                         ).fetchone()[0]
+        assert v == len(state._MIGRATIONS)
