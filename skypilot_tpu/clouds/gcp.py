@@ -171,6 +171,16 @@ class GCP(cloud_lib.Cloud):
                 'num_slices': resources.num_slices,
                 'reservation': config_lib.get_nested(('gcp', 'reservation')),
                 'topology': resources.accelerator_args.get('topology'),
+                # DWS-style capacity queueing via the queuedResources API
+                # (accelerator_args: {queued: true} or config
+                # gcp.use_queued_resources).
+                'queued_provisioning': bool(
+                    resources.accelerator_args.get('queued') or
+                    config_lib.get_nested(('gcp', 'use_queued_resources'),
+                                          False)),
+                'queued_timeout_s': (
+                    resources.accelerator_args.get('queued_timeout_s') or
+                    config_lib.get_nested(('gcp', 'queued_timeout_s'))),
             })
         else:
             variables.update({

@@ -75,10 +75,17 @@ def _merge(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def user_config_path() -> str:
+    """The writable user-layer config file, resolved EXACTLY as
+    _load_layers resolves it (single source: a divergent resolution in
+    the dashboard's editor would write a file reads never consult)."""
+    return os.environ.get(ENV_VAR_CONFIG,
+                          os.path.expanduser(USER_CONFIG_PATH))
+
+
 def _load_layers() -> Dict[str, Any]:
     config = copy.deepcopy(_DEFAULTS)
-    user_path = os.environ.get(ENV_VAR_CONFIG,
-                               os.path.expanduser(USER_CONFIG_PATH))
+    user_path = user_config_path()
     for path in (user_path, PROJECT_CONFIG_PATH):
         if os.path.exists(path):
             try:

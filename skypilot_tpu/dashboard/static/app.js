@@ -112,6 +112,30 @@ async function actCancelClusterJob(cluster, jobId) {
   navigate();
 }
 
+async function saveConfig() {
+  const text = document.querySelector('#config-editor').value;
+  const status = document.querySelector('#config-status');
+  status.textContent = 'saving…';
+  try {
+    const r = await fetch('/api/config', {
+      method: 'POST',
+      headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({user_config: text}),
+    });
+    if (r.ok) {
+      status.textContent = 'saved ✓';
+      return;
+    }
+    let detail = `HTTP ${r.status}`;
+    try {
+      detail = (await r.json()).error || detail;
+    } catch (e) { /* non-JSON error page */ }
+    status.textContent = `error: ${detail}`;
+  } catch (e) {
+    status.textContent = `error: ${e.message}`;
+  }
+}
+
 const PAGES = {
   clusters: {
     title: 'Clusters',
@@ -260,6 +284,20 @@ const PAGES = {
         ]));
     },
   },
+  config: {
+    title: 'Config',
+    async render() {
+      const cfg = await apiGet('/api/config');
+      return '<h3>User config <span class="mono">' +
+          `${esc(cfg.path)}</span></h3>` +
+          `<textarea id="config-editor" class="config-editor" rows="14">` +
+          `${esc(cfg.user_config)}</textarea>` +
+          '<div><button class="action" data-act="save-config">' +
+          'save</button> <span id="config-status"></span></div>' +
+          '<h3>Effective (all layers)</h3>' +
+          `<pre class="logview">${esc(cfg.effective)}</pre>`;
+    },
+  },
   requests: {
     title: 'API Requests',
     async render() {
@@ -305,7 +343,8 @@ document.addEventListener('click', (ev) => {
   const btn = ev.target.closest('button.action');
   if (!btn) return;
   const {act, name, job} = btn.dataset;
-  if (act === 'down') actDown(name);
+  if (act === 'save-config') saveConfig();
+  else if (act === 'down') actDown(name);
   else if (act === 'cancel-job') actCancelJob(Number(job));
   else if (act === 'cancel-cluster-job') {
     actCancelClusterJob(name, Number(job));

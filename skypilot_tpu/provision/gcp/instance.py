@@ -30,6 +30,7 @@ from __future__ import annotations
 import functools
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.provision import common
 from skypilot_tpu.provision.gcp import compute_api
@@ -294,6 +295,7 @@ def run_instances(region: str, cluster_name: str,
     resumed: List[str] = []
     existing = {n['name'].rsplit('/', 1)[-1]: n
                 for n in client.list_nodes(zone)}
+    queued = bool(config.get('queued_provisioning'))
     operations = []
     for name in _slice_names(cluster_name, num_slices):
         node = existing.get(name)
@@ -307,9 +309,59 @@ def run_instances(region: str, cluster_name: str,
             elif node.get('state') in _PENDING_STATES:
                 resumed.append(name)
                 continue
+        if queued:
+            # DWS-style capacity queueing (reference analog: MIG/DWS,
+            # instance_utils.py:988): the queuedResources API parks the
+            # request in Google's queue until capacity exists, instead
+            # of failing with a stockout the failover loop must retry.
+            # All slices' QRs are SUBMITTED first and waited on after
+            # (below) so multi-slice requests co-queue instead of
+            # serializing up to num_slices x timeout.
+            body = _node_body(cluster_name, config)
+            spot = bool(body.pop('schedulingConfig', {}).get(
+                'preemptible'))
+            qr_body: Dict[str, Any] = {
+                'tpu': {'nodeSpec': [{
+                    'parent': f'projects/{config["project_id"]}'
+                              f'/locations/{zone}',
+                    'nodeId': name,
+                    'node': body,
+                }]},
+            }
+            if spot:
+                qr_body['spot'] = {}
+            elif body.pop('reservedInstance', None) or \
+                    config.get('reservation'):
+                # Reservation targeting lives at the QR level, not the
+                # node body: without `guaranteed` the request queues as
+                # on-demand while reserved capacity sits idle.
+                qr_body['guaranteed'] = {'reserved': True}
+            timeout_s = float(config.get('queued_timeout_s') or 1800)
+            qr_body['queueingPolicy'] = {
+                'validUntilDuration': f'{int(timeout_s)}s'}
+            client.create_queued_resource(zone, name, qr_body)
+            created.append(name)
+            continue
         op = client.create_node(zone, name, _node_body(cluster_name, config))
         operations.append(op)
         created.append(name)
+    if queued and created:
+        # Wait all co-queued slices; on ANY failure reap every QR of
+        # this cluster (an ACTIVE sibling slice is a live, billed TPU,
+        # and a FAILED/expired QR record blocks relaunch with 409)
+        # before surfacing the error to the failover loop.
+        timeout_s = float(config.get('queued_timeout_s') or 1800)
+        try:
+            for name in created:
+                client.wait_queued_resource(zone, name,
+                                            timeout=timeout_s)
+        except exceptions.ProvisionerError:
+            for name in _slice_names(cluster_name, num_slices):
+                try:
+                    client.delete_queued_resource(zone, name)
+                except Exception:  # pylint: disable=broad-except
+                    pass
+            raise
     for op in operations:
         client.wait_operation(op)
     return common.ProvisionRecord(
@@ -459,11 +511,35 @@ def terminate_instances(cluster_name: str,
         return
     client = _client(config)
     operations = []
+    reaped_qrs = set()
     for node in client.list_nodes(zone):
         name = node['name'].rsplit('/', 1)[-1]
         labels = node.get('labels') or {}
         if labels.get('skypilot-tpu-cluster') != cluster_name:
             continue
+        if config.get('queued_provisioning'):
+            # Nodes born from a queued resource are owned by it: delete
+            # the QR (force=true also deletes its nodes).
+            try:
+                client.wait_operation(
+                    client.delete_queued_resource(zone, name))
+                reaped_qrs.add(name)
+                continue
+            except exceptions.ProvisionerError:
+                pass   # fall back to plain node delete
         operations.append(client.delete_node(zone, name))
+    if config.get('queued_provisioning'):
+        # Node-LESS queued resources (FAILED/expired before a node
+        # materialized) are invisible to list_nodes but their records
+        # block a same-name relaunch with 409 — reap them by name.
+        for name in _slice_names(cluster_name,
+                                 int(config.get('num_slices', 1))):
+            if name in reaped_qrs:
+                continue
+            try:
+                client.wait_operation(
+                    client.delete_queued_resource(zone, name))
+            except Exception:  # pylint: disable=broad-except
+                pass
     for op in operations:
         client.wait_operation(op)

@@ -108,6 +108,53 @@ class TpuApiClient:
         return self._request(
             'POST', f'{self._zone_path(zone)}/nodes/{node_id}:start')
 
+    # ---- queued resources (DWS-style capacity queueing) ------------------
+    # Reference analog: GCPManagedInstanceGroup / DWS for GPU VMs
+    # (sky/provision/gcp/instance_utils.py:988, mig_utils.py); the
+    # TPU-native mechanism is the queuedResources API — the request waits
+    # in Google's queue until capacity exists instead of failing with a
+    # stockout.
+    def create_queued_resource(self, zone: str, qr_id: str,
+                               body: Dict[str, Any]) -> Dict[str, Any]:
+        return self._request(
+            'POST', f'{self._zone_path(zone)}/queuedResources',
+            json_body=body, params={'queuedResourceId': qr_id})
+
+    def get_queued_resource(self, zone: str, qr_id: str) -> Dict[str, Any]:
+        return self._request(
+            'GET', f'{self._zone_path(zone)}/queuedResources/{qr_id}')
+
+    def delete_queued_resource(self, zone: str,
+                               qr_id: str) -> Dict[str, Any]:
+        return self._request(
+            'DELETE', f'{self._zone_path(zone)}/queuedResources/{qr_id}',
+            params={'force': True})
+
+    def wait_queued_resource(self, zone: str, qr_id: str,
+                             timeout: float = 1800,
+                             poll: float = 10.0) -> Dict[str, Any]:
+        """Poll until the queued resource is ACTIVE (nodes exist) or
+        terminally failed.  FAILED/SUSPENDED surface as CapacityError so
+        the failover loop can blocklist the zone and move on."""
+        deadline = time.time() + timeout
+        while True:
+            qr = self.get_queued_resource(zone, qr_id)
+            state = (qr.get('state') or {}).get('state', '')
+            if state == 'ACTIVE':
+                return qr
+            if state in ('FAILED', 'SUSPENDED'):
+                detail = (qr.get('state') or {}).get(
+                    'stateInitiator', state)
+                raise exceptions.CapacityError(
+                    f'Queued resource {qr_id} entered {state} '
+                    f'({detail}).')
+            if time.time() > deadline:
+                raise exceptions.ProvisionerError(
+                    f'Queued resource {qr_id} not ACTIVE after '
+                    f'{timeout}s (state {state or "unknown"}); it stays '
+                    f'queued — delete it or raise the timeout.')
+            time.sleep(poll)
+
     def wait_operation(self, operation: Dict[str, Any],
                        timeout: float = 1800,
                        poll: float = 5.0) -> Dict[str, Any]:

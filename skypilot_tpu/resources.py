@@ -175,7 +175,8 @@ class Resources:
         if spec is not None:
             args = self._accelerator_args
             unknown = set(args) - {'runtime_version', 'topology', 'num_slices',
-                                   'spare_hosts'}
+                                   'spare_hosts', 'queued',
+                                   'queued_timeout_s'}
             if unknown:
                 raise exceptions.InvalidTaskError(
                     f'Unknown accelerator_args {sorted(unknown)} for TPU.')

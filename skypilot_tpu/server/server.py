@@ -313,6 +313,47 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
             return _json_error(502, f'Log fetch failed: {e}')
         return web.Response(text=text, content_type='text/plain')
 
+    @routes.get('/api/config')
+    async def api_config_get(request: web.Request) -> web.Response:
+        """The USER config layer as YAML text, for the dashboard's config
+        editor (reference: dashboard config page over the server config
+        endpoint).  Only the user file is editable — project/env layers
+        are shown read-only via the `effective` field."""
+        import yaml
+        from skypilot_tpu import config as config_lib
+        path = config_lib.user_config_path()
+        text = ''
+        if os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                text = f.read()
+        return web.json_response({
+            'path': path,
+            'user_config': text,
+            'effective': yaml.safe_dump(config_lib.to_dict(),
+                                        sort_keys=True),
+        })
+
+    @routes.post('/api/config')
+    async def api_config_set(request: web.Request) -> web.Response:
+        import yaml
+        from skypilot_tpu import config as config_lib
+        payload = await request.json()
+        text = payload.get('user_config', '')
+        try:
+            parsed = yaml.safe_load(text) or {}
+            if not isinstance(parsed, dict):
+                raise ValueError('config must be a YAML mapping')
+            from skypilot_tpu.utils import schemas as schemas_lib
+            schemas_lib.validate_config(parsed)
+        except Exception as e:  # pylint: disable=broad-except
+            return _json_error(400, f'Invalid config: {e}')
+        path = config_lib.user_config_path()
+        os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(text)
+        config_lib.reload_config()
+        return web.json_response({'ok': True, 'path': path})
+
     @routes.get('/ssh/{cluster}')
     async def ssh_tunnel(request: web.Request) -> web.StreamResponse:
         """Websocket ↔ TCP bridge to the cluster head's SSH port, so

@@ -29,6 +29,9 @@ _RESOURCES_SCHEMA: Dict[str, Any] = {
                 'topology': {'type': 'string'},
                 'num_slices': {'type': 'integer', 'minimum': 1},
                 'spare_hosts': {'type': 'integer', 'minimum': 0},
+                # DWS-style capacity queueing via queuedResources.
+                'queued': {'type': 'boolean'},
+                'queued_timeout_s': {'type': 'number', 'minimum': 1},
             },
         },
         'cpus': {'anyOf': [{'type': 'string'}, {'type': 'number'}, {'type': 'null'}]},
@@ -165,3 +168,52 @@ def validate_service_config(config: Dict[str, Any]) -> None:
     except jsonschema.ValidationError as e:
         raise exceptions.InvalidServiceSpecError(
             f'Invalid service spec: {e.message}') from e
+
+
+# Global config file schema (reference: the config keys in
+# sky/utils/schemas.py's get_config_schema — permissive on unknown keys,
+# typed on the ones the framework reads).
+CONFIG_SCHEMA = {
+    'type': 'object',
+    'properties': {
+        'gcp': {
+            'type': 'object',
+            'properties': {
+                'project_id': {'type': 'string'},
+                'service_account': {'type': 'string'},
+                'reservation': {'type': ['string', 'null']},
+                'use_queued_resources': {'type': 'boolean'},
+                'queued_timeout_s': {'type': 'number', 'minimum': 1},
+            },
+        },
+        'jobs': {
+            'type': 'object',
+            'properties': {
+                'controller': {
+                    'type': 'object',
+                    'properties': {
+                        'resources': {'type': 'object'},
+                    },
+                },
+                'max_parallel_launches': {'type': 'integer', 'minimum': 1},
+                'max_parallel_jobs': {'type': 'integer', 'minimum': 1},
+            },
+        },
+        'admin_policy': {'type': ['string', 'null']},
+        'api_server': {'type': 'object'},
+        'logs': {'type': 'object'},
+        'usage': {'type': 'object'},
+        'workspace': {'type': 'string'},
+    },
+}
+
+
+def validate_config(config: Dict[str, Any]) -> None:
+    """Validate a global config mapping (`~/.skypilot_tpu/config.yaml`)."""
+    import jsonschema  # deferred (see validate_task_config)
+    try:
+        jsonschema.validate(config, CONFIG_SCHEMA)
+    except jsonschema.ValidationError as e:
+        raise exceptions.InvalidSkyPilotConfigError(
+            f'Invalid config: {e.message} (at '
+            f'{"/".join(str(p) for p in e.absolute_path) or "<root>"})') from e

@@ -159,3 +159,34 @@ def test_appjs_routes_exist_on_server(client):
     for route in sorted(routes):
         assert any(s == route or (s and route.startswith(s.rstrip('/')))
                    for s in served), f'{route} not served; app.js drifted'
+
+
+def test_config_endpoint_roundtrip(client):
+    """Dashboard config editor: GET shows the user layer, POST validates
+    against the config schema and persists (reference: dashboard config
+    page)."""
+    c, loop = client
+
+    async def _run():
+        r = await c.get('/api/config')
+        assert r.status == 200
+        body = await r.json()
+        assert 'effective' in body
+        # Valid config: persists and reloads.
+        r = await c.post('/api/config', json={
+            'user_config': 'gcp:\n  project_id: cfg-test-proj\n'})
+        assert r.status == 200
+        from skypilot_tpu import config as config_lib
+        assert config_lib.get_nested(('gcp', 'project_id')) == \
+            'cfg-test-proj'
+        r = await c.get('/api/config')
+        assert 'cfg-test-proj' in (await r.json())['user_config']
+        # Invalid YAML type: rejected with 400, config unchanged.
+        r = await c.post('/api/config', json={
+            'user_config': 'gcp:\n  project_id: [not, a, string]\n'})
+        assert r.status == 400
+        assert 'Invalid config' in (await r.json())['error']
+        assert config_lib.get_nested(('gcp', 'project_id')) == \
+            'cfg-test-proj'
+
+    loop.run_until_complete(_run())

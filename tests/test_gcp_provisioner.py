@@ -202,3 +202,165 @@ def test_quota_error_typed():
 
     with pytest.raises(exceptions.QuotaExceededError):
         tpu_api.TpuApiClient._raise_typed(Resp())
+
+
+# ---------------------------------------------------------------------------
+# Queued resources (DWS-style capacity queueing;
+# reference analog: GCPManagedInstanceGroup/DWS instance_utils.py:988)
+# ---------------------------------------------------------------------------
+
+class FakeQueuedTpuApi(FakeTpuApi):
+    """FakeTpuApi + queuedResources surface."""
+
+    def __init__(self, project, fail_zones=None, qr_behavior='active'):
+        super().__init__(project, fail_zones)
+        self.queued = {}
+        self.qr_behavior = qr_behavior
+        self.deleted_qrs = []
+
+    def create_queued_resource(self, zone, qr_id, body):
+        self.queued[f'{zone}/{qr_id}'] = body
+        if self.qr_behavior == 'active':
+            # Capacity arrives: materialize the node.
+            spec = body['tpu']['nodeSpec'][0]
+            node_body = dict(spec['node'])
+            if 'spot' in body:
+                node_body['schedulingConfig'] = {'preemptible': True}
+            self.create_node(zone, spec['nodeId'], node_body)
+        return {'name': f'op-qr-{qr_id}', 'done': True}
+
+    def get_queued_resource(self, zone, qr_id):
+        state = {'active': 'ACTIVE', 'failed': 'FAILED',
+                 'stuck': 'WAITING_FOR_RESOURCES'}[self.qr_behavior]
+        return {'name': qr_id, 'state': {'state': state}}
+
+    def delete_queued_resource(self, zone, qr_id):
+        self.queued.pop(f'{zone}/{qr_id}', None)
+        self.deleted_qrs.append(qr_id)
+        # force=true also deletes the node.
+        self.nodes.pop(f'{zone}/{qr_id}', None)
+        return {'name': f'op-del-qr-{qr_id}', 'done': True}
+
+    def wait_queued_resource(self, zone, qr_id, timeout=0, poll=0):
+        # Mirrors TpuApiClient.wait_queued_resource's terminal semantics
+        # without the polling loop.
+        state = self.get_queued_resource(zone, qr_id)['state']['state']
+        if state == 'ACTIVE':
+            return {'state': {'state': state}}
+        if state in ('FAILED', 'SUSPENDED'):
+            raise exceptions.CapacityError(f'QR {qr_id} entered {state}')
+        raise exceptions.ProvisionerError(f'QR {qr_id} stuck in {state}')
+
+
+@pytest.fixture()
+def fake_queued_api(monkeypatch):
+    holder = {}
+
+    def factory(project, session=None):
+        if 'api' not in holder:
+            holder['api'] = FakeQueuedTpuApi(
+                project, qr_behavior=holder.get('behavior', 'active'))
+        return holder['api']
+
+    monkeypatch.setattr(gcp_instance, '_client_factory', factory)
+    yield holder
+
+
+def test_queued_provisioning_creates_via_qr(fake_queued_api):
+    cfg = _config(queued_provisioning=True)
+    record = gcp_instance.run_instances('us-east5', 'q1', cfg)
+    assert record.created_instance_ids == ['q1']
+    api = fake_queued_api['api']
+    assert 'us-east5-b/q1' in api.queued
+    qr = api.queued['us-east5-b/q1']
+    assert qr['tpu']['nodeSpec'][0]['nodeId'] == 'q1'
+    assert 'queueingPolicy' in qr
+    # The node exists and get_cluster_info sees its hosts.
+    info = gcp_instance.get_cluster_info('us-east5', 'q1', cfg)
+    assert info.num_hosts == 4
+
+
+def test_queued_spot_rides_spot_field(fake_queued_api):
+    cfg = _config(queued_provisioning=True, use_spot=True)
+    gcp_instance.run_instances('us-east5', 'q2', cfg)
+    qr = fake_queued_api['api'].queued['us-east5-b/q2']
+    assert 'spot' in qr
+    assert 'schedulingConfig' not in qr['tpu']['nodeSpec'][0]['node']
+
+
+def test_queued_failed_is_capacity_error(fake_queued_api):
+    fake_queued_api['behavior'] = 'failed'
+    cfg = _config(queued_provisioning=True)
+    with pytest.raises(exceptions.CapacityError):
+        gcp_instance.run_instances('us-east5', 'q3', cfg)
+
+
+def test_queued_teardown_deletes_qr(fake_queued_api):
+    cfg = _config(queued_provisioning=True)
+    gcp_instance.run_instances('us-east5', 'q4', cfg)
+    gcp_instance.terminate_instances('q4', cfg)
+    api = fake_queued_api['api']
+    assert 'q4' in api.deleted_qrs
+    assert 'us-east5-b/q4' not in api.nodes
+
+
+def test_queued_failure_reaps_all_qrs(fake_queued_api):
+    """ANY slice's QR failing reaps every QR of the cluster (an ACTIVE
+    sibling is a live billed TPU; a FAILED QR record blocks relaunch)."""
+    fake_queued_api['behavior'] = 'failed'
+    cfg = _config(queued_provisioning=True, num_slices=2)
+    with pytest.raises(exceptions.CapacityError):
+        gcp_instance.run_instances('us-east5', 'q5', cfg)
+    api = fake_queued_api['api']
+    assert sorted(api.deleted_qrs) == ['q5-slice-0', 'q5-slice-1']
+    assert not api.queued
+
+
+def test_queued_multislice_co_queues_before_waiting(fake_queued_api):
+    """All slices' QRs are submitted before any wait (co-queueing)."""
+    api_holder = fake_queued_api
+    order = []
+
+    class Ordered(FakeQueuedTpuApi):
+        def create_queued_resource(self, zone, qr_id, body):
+            order.append(('create', qr_id))
+            return super().create_queued_resource(zone, qr_id, body)
+
+        def wait_queued_resource(self, zone, qr_id, timeout=0, poll=0):
+            order.append(('wait', qr_id))
+            return super().wait_queued_resource(zone, qr_id)
+
+    api_holder['api'] = Ordered('proj')
+    cfg = _config(queued_provisioning=True, num_slices=2)
+    gcp_instance.run_instances('us-east5', 'q6', cfg)
+    assert order == [('create', 'q6-slice-0'), ('create', 'q6-slice-1'),
+                     ('wait', 'q6-slice-0'), ('wait', 'q6-slice-1')]
+
+
+def test_queued_reservation_targets_guaranteed_tier(fake_queued_api):
+    cfg = _config(queued_provisioning=True, reservation='my-res')
+    gcp_instance.run_instances('us-east5', 'q7', cfg)
+    qr = fake_queued_api['api'].queued['us-east5-b/q7']
+    assert qr['guaranteed'] == {'reserved': True}
+    assert 'spot' not in qr
+
+
+def test_queued_timeout_plumbed_from_accelerator_args(fake_queued_api):
+    cfg = _config(queued_provisioning=True, queued_timeout_s=360)
+    gcp_instance.run_instances('us-east5', 'q8', cfg)
+    qr = fake_queued_api['api'].queued['us-east5-b/q8']
+    assert qr['queueingPolicy'] == {'validUntilDuration': '360s'}
+
+
+def test_queued_teardown_reaps_nodeless_qr(fake_queued_api):
+    """A QR whose node never materialized is reaped at teardown by name
+    (it is invisible to list_nodes but blocks relaunch with 409)."""
+    api = fake_queued_api['api'] = FakeQueuedTpuApi('proj',
+                                                    qr_behavior='active')
+    cfg = _config(queued_provisioning=True)
+    gcp_instance.run_instances('us-east5', 'q9', cfg)
+    # Simulate the node dying while the QR record lingers.
+    api.nodes.pop('us-east5-b/q9')
+    gcp_instance.terminate_instances('q9', cfg)
+    assert 'q9' in api.deleted_qrs
+    assert not api.queued
