@@ -2,12 +2,14 @@
 
 The replica process behind examples/serve_llama.yaml: aiohttp app with
 /health (readiness probe target) and /generate, backed by the framework's
-KV-cache engine (skypilot_tpu.infer.Generator) — bucketed prefill, one
-compiled decode shape, in-step sampling.  Analog of the reference's vLLM
-replica (llm/vllm/service.yaml).
+CONTINUOUS-BATCHING engine (skypilot_tpu.infer.ContinuousBatcher) —
+bucketed prefill, one compiled decode shape, in-step sampling, and
+requests joining/leaving the decode batch without waiting for each other
+(--batch-size slots).  Analog of the reference's vLLM replica
+(llm/vllm/service.yaml).
 
 Requests (POST /generate, JSON):
-  {"prompt_ids": [1, 2, 3], "max_new_tokens": 32, "seed": 7}
+  {"prompt_ids": [1, 2, 3], "max_new_tokens": 32}
                                       — token ids in [0, vocab)
   {"prompt": "text", ...}             — tokenized with the HF tokenizer
                                         when --hf-model is set; demo
@@ -15,7 +17,9 @@ Requests (POST /generate, JSON):
 One of prompt_ids / prompt is required; malformed requests are a 400,
 never silently defaulted.  Sampling temperature is a server flag
 (--temperature): the engine compiles it into the decode step, so it is
-per-replica, not per-request.
+per-replica, not per-request — and under continuous batching the
+sampling RNG is engine-level, so a per-request "seed" is NOT supported
+(one is acknowledged with "seed_ignored": true in the response).
 """
 from __future__ import annotations
 
@@ -25,11 +29,89 @@ import json
 import time
 
 
+class BatcherDriver:
+    """Bridges async request handlers to the batcher's scheduler loop:
+    one thread owns the chip, stepping while work exists.
+
+    Handlers must call submit() OFF the event loop (asyncio.to_thread):
+    the lock is held across whole decode chunks, and blocking the loop on
+    it would stall every handler including /health."""
+
+    def __init__(self, batcher):
+        import threading
+        self.batcher = batcher
+        self.lock = threading.Lock()
+        self.wake = threading.Event()
+        self.done_events = {}
+        self.failed = {}          # rid -> error message
+        self.abandoned = set()    # rids whose client went away
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def submit(self, prompt, max_new):
+        import threading
+        with self.lock:
+            rid = self.batcher.submit(prompt, max_new_tokens=max_new)
+            ev = threading.Event()
+            self.done_events[rid] = ev
+        self.wake.set()
+        return rid, ev
+
+    def result(self, rid):
+        with self.lock:
+            self.done_events.pop(rid, None)
+            if rid in self.failed:
+                raise RuntimeError(self.failed.pop(rid))
+            return self.batcher.result(rid)
+
+    def abandon(self, rid):
+        """Client went away mid-flight: reap the request's bookkeeping as
+        soon as it completes (otherwise dead entries accumulate)."""
+        with self.lock:
+            self.done_events.pop(rid, None)
+            self.failed.pop(rid, None)
+            try:
+                if self.batcher.is_done(rid):
+                    self.batcher.result(rid)   # discard
+                else:
+                    self.abandoned.add(rid)
+            except KeyError:
+                pass
+
+    def _loop(self):
+        while True:
+            with self.lock:
+                busy = self.batcher.num_active or self.batcher.num_queued
+            if not busy:
+                self.wake.wait(timeout=0.05)
+                self.wake.clear()
+                continue
+            with self.lock:
+                try:
+                    self.batcher.step()
+                except Exception as e:  # engine error: fail in-flight
+                    # requests as HTTP errors and KEEP SERVING — a dead
+                    # scheduler thread would hang every future request
+                    # while /health still answered OK.
+                    msg = f'engine error: {e!r}'
+                    for rid, ev in list(self.done_events.items()):
+                        self.failed[rid] = msg
+                        ev.set()
+                    continue
+                for rid, ev in list(self.done_events.items()):
+                    if self.batcher.is_done(rid):
+                        ev.set()
+                for rid in list(self.abandoned):
+                    if self.batcher.is_done(rid):
+                        self.batcher.result(rid)   # discard
+                        self.abandoned.discard(rid)
+
+
 def build_generator(model_size: str, max_seq_len: int, temperature: float,
-                    hf_model: str = ''):
+                    hf_model: str = '', batch_size: int = 4):
     import jax
 
-    from skypilot_tpu.infer import Generator, GeneratorConfig
+    from skypilot_tpu.infer import GeneratorConfig
+    from skypilot_tpu.infer.serving import ContinuousBatcher
     from skypilot_tpu.models import llama
 
     tokenizer = None
@@ -52,9 +134,9 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
         }[model_size]
         params = llama.init_params(config, jax.random.PRNGKey(0))
     max_seq_len = min(max_seq_len, config.max_seq_len)
-    gen = Generator(params, config, GeneratorConfig(
-        max_seq_len=max_seq_len, batch_size=1, temperature=temperature,
-        eos_token=eos))
+    gen = ContinuousBatcher(params, config, GeneratorConfig(
+        max_seq_len=max_seq_len, batch_size=batch_size,
+        temperature=temperature, eos_token=eos))
     return gen, config, tokenizer
 
 
@@ -68,16 +150,20 @@ def main() -> int:
     parser.add_argument('--hf-model', default='',
                         help='serve an HF checkpoint (hub name or local '
                              'path) instead of random weights')
+    parser.add_argument('--batch-size', type=int, default=4,
+                        help='continuous-batching slots (concurrent '
+                             'requests decoded in lockstep)')
     args = parser.parse_args()
 
     gen, config, tokenizer = build_generator(
         args.model_size, args.max_seq_len, args.temperature,
-        args.hf_model)
+        args.hf_model, args.batch_size)
     # Compile prefill + decode now so the readiness probe reflects
     # readiness instead of the first request eating the compiles.
-    gen.warmup()
-    # One request at a time on the chip (batch_size=1 engine).
-    chip_lock = asyncio.Lock()
+    warm = gen.submit([1, 1], max_new_tokens=2)
+    gen.run_until_idle()
+    gen.result(warm)
+    driver = BatcherDriver(gen)
 
     from aiohttp import web
 
@@ -113,7 +199,10 @@ def main() -> int:
                     status=400)
             max_new = min(int(body.get('max_new_tokens',
                                        args.max_new_tokens)), 256)
-            seed = int(body.get('seed', 0))
+            seed_sent = 'seed' in body
+            if seed_sent:
+                int(body['seed'])   # type-checked though unused (400 on
+                                    # garbage beats silently ignoring it)
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {'error': f'malformed request: {e}'}, status=400)
@@ -122,18 +211,30 @@ def main() -> int:
                                      status=400)
         t0 = time.monotonic()
         try:
-            async with chip_lock:
-                out = await asyncio.to_thread(
-                    gen.generate, [prompt_ids], max_new, seed)
+            # to_thread: submit takes the scheduler lock, which is held
+            # across whole decode chunks — never block the event loop.
+            rid, ev = await asyncio.to_thread(driver.submit, prompt_ids,
+                                              max_new)
         except ValueError as e:
             return web.json_response({'error': str(e)}, status=400)
+        try:
+            await asyncio.to_thread(ev.wait)
+            out = await asyncio.to_thread(driver.result, rid)
+        except asyncio.CancelledError:
+            # Client disconnected: reap the in-flight request's state.
+            driver.abandon(rid)
+            raise
+        except RuntimeError as e:
+            return web.json_response({'error': str(e)}, status=500)
         resp = {
-            'output_ids': out[0],
-            'num_generated': len(out[0]),
+            'output_ids': out,
+            'num_generated': len(out),
             'latency_s': round(time.monotonic() - t0, 3),
         }
+        if seed_sent:
+            resp['seed_ignored'] = True
         if tokenizer is not None:
-            resp['output_text'] = tokenizer.decode(out[0])
+            resp['output_text'] = tokenizer.decode(out)
         return web.json_response(resp)
 
     app = web.Application()

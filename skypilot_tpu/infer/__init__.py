@@ -7,5 +7,7 @@ TP"); here the engine is a first-class JAX library the serve recipes run.
 from skypilot_tpu.infer.engine import (DecodeState, Generator,
                                        GeneratorConfig)
 from skypilot_tpu.infer.sampling import sample_logits
+from skypilot_tpu.infer.serving import ContinuousBatcher
 
-__all__ = ['DecodeState', 'Generator', 'GeneratorConfig', 'sample_logits']
+__all__ = ['ContinuousBatcher', 'DecodeState', 'Generator',
+           'GeneratorConfig', 'sample_logits']

@@ -39,6 +39,25 @@ class GeneratorConfig:
     eos_token: Optional[int] = None
 
 
+def derive_buckets(gen_config: 'GeneratorConfig'):
+    """Prompt buckets for a GeneratorConfig (shared by the lockstep
+    Generator and the ContinuousBatcher so their compile sets match);
+    validates the largest bucket fits max_seq_len."""
+    if gen_config.prompt_buckets:
+        buckets = sorted(gen_config.prompt_buckets)
+    else:
+        buckets, b = [], 64
+        while b < gen_config.max_seq_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(gen_config.max_seq_len)
+    if buckets[-1] > gen_config.max_seq_len:
+        raise ValueError(
+            f'Largest prompt bucket {buckets[-1]} exceeds '
+            f'max_seq_len {gen_config.max_seq_len}')
+    return buckets
+
+
 @dataclasses.dataclass
 class DecodeState:
     """Host-side view of one generation in flight."""
@@ -55,19 +74,7 @@ class Generator:
         self.params = params
         self.config = config
         self.gen = gen_config
-        if gen_config.prompt_buckets:
-            self.buckets = sorted(gen_config.prompt_buckets)
-        else:
-            self.buckets = []
-            b = 64
-            while b < gen_config.max_seq_len:
-                self.buckets.append(b)
-                b *= 2
-            self.buckets.append(gen_config.max_seq_len)
-        if self.buckets[-1] > gen_config.max_seq_len:
-            raise ValueError(
-                f'Largest prompt bucket {self.buckets[-1]} exceeds '
-                f'max_seq_len {gen_config.max_seq_len}')
+        self.buckets = derive_buckets(gen_config)
 
         self._prefill = jax.jit(functools.partial(
             llama_infer.prefill, config=config))

@@ -1,0 +1,227 @@
+"""Continuous batching over the fixed-shape decode engine.
+
+The serving-throughput property the reference gets from vLLM in its
+recipes (llm/vllm/service.yaml): requests join and leave the decode batch
+WITHOUT waiting for the whole batch to finish.  TPU-first adaptation —
+everything keeps a static shape so nothing recompiles at steady state:
+
+- The KV cache holds `batch_size` SLOTS (L, B, max_len, KV, D).  A request
+  occupies one slot from prefill to eos/max-tokens, then the slot is
+  immediately handed to the next queued request.
+- Per-slot prefill runs at batch 1 into a bucketed shape and is written
+  into the big cache with a jitted dynamic-update (one compile per prompt
+  bucket).
+- Decode always steps ALL slots in lockstep, (B, 1) shapes; free slots
+  decode garbage at position 0 of their (about-to-be-overwritten) cache —
+  masked on the host, costing nothing but the already-paid lockstep FLOPs.
+
+Usage (the serve replica drives this from its request handler):
+
+    batcher = ContinuousBatcher(params, config, gen_config)
+    rid = batcher.submit([1, 2, 3], max_new_tokens=64)
+    while not batcher.is_done(rid):
+        batcher.step()
+    tokens = batcher.result(rid)
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.infer import llama_infer, sampling
+from skypilot_tpu.infer.engine import GeneratorConfig
+from skypilot_tpu.models import llama
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    out: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    done: bool = False
+
+
+class ContinuousBatcher:
+    """Slot-scheduled generation: decode never waits for the batch."""
+
+    def __init__(self, params: llama.Params, config: llama.LlamaConfig,
+                 gen_config: GeneratorConfig = GeneratorConfig(),
+                 decode_chunk: int = 8):
+        self.params = params
+        self.config = config
+        self.gen = gen_config
+        self.decode_chunk = decode_chunk
+        from skypilot_tpu.infer.engine import derive_buckets
+        self.buckets = derive_buckets(gen_config)
+
+        batch = gen_config.batch_size
+        self._cache = llama_infer.init_cache(config, batch,
+                                             gen_config.max_seq_len)
+        self._token = jnp.zeros((batch,), jnp.int32)
+        self._positions = jnp.zeros((batch,), jnp.int32)
+        self._rng = jax.random.PRNGKey(0)
+
+        self._free: List[int] = list(range(batch))
+        self._active: Dict[int, _Request] = {}       # slot -> request
+        self._requests: Dict[int, _Request] = {}     # rid -> request
+        self._queue: List[_Request] = []
+        self._ids = itertools.count(1)
+
+        self._prefill_one = jax.jit(functools.partial(
+            self._prefill_one_impl, config=config), donate_argnums=(2,),
+            static_argnames=())
+        self._decode = jax.jit(functools.partial(
+            self._decode_impl, temperature=gen_config.temperature,
+            top_k=gen_config.top_k, top_p=gen_config.top_p),
+            donate_argnums=(2,), static_argnames=('n',))
+
+    # ---- jitted pieces ---------------------------------------------------
+    def _prefill_one_impl(self, params, tokens, big_cache, length, slot,
+                          token_row, pos_row, rng, *, config):
+        """Prefill ONE prompt (1, bucket) and install it into `slot`."""
+        small = llama_infer.init_cache(config, 1, self.gen.max_seq_len)
+        logits, small = llama_infer.prefill(
+            params, tokens, config=config, cache=small,
+            lengths=length[None])
+        big_cache = {
+            k: jax.lax.dynamic_update_index_in_dim(
+                big_cache[k], small[k][:, 0], slot, axis=1)
+            for k in ('k', 'v')}
+        rng, sub = jax.random.split(rng)
+        first = sampling.sample_logits(
+            logits, sub, temperature=self.gen.temperature,
+            top_k=self.gen.top_k, top_p=self.gen.top_p)[0]
+        token_row = token_row.at[slot].set(first)
+        pos_row = pos_row.at[slot].set(length)
+        return big_cache, token_row, pos_row, first, rng
+
+    def _decode_impl(self, params, token, cache, positions, rng, *, n,
+                     temperature, top_k, top_p):
+        def step(carry, _):
+            token, cache, positions, rng = carry
+            rng, sub = jax.random.split(rng)
+            logits, cache = llama_infer.decode_step(
+                params, token, self.config, cache, positions)
+            nxt = sampling.sample_logits(logits, sub,
+                                         temperature=temperature,
+                                         top_k=top_k, top_p=top_p)
+            return (nxt, cache, positions + 1, rng), nxt
+
+        (token, cache, positions, rng), toks = jax.lax.scan(
+            step, (token, cache, positions, rng), None, length=n)
+        return jnp.swapaxes(toks, 0, 1), token, cache, positions, rng
+
+    # ---- public API ------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: int = 64) -> int:
+        if not prompt:
+            raise ValueError('Empty prompt')
+        if len(prompt) >= self.gen.max_seq_len:
+            raise ValueError(f'Prompt length {len(prompt)} >= max_seq_len '
+                             f'{self.gen.max_seq_len}')
+        if len(prompt) > self.buckets[-1]:
+            # Reject HERE, synchronously: _bucket_for raising later
+            # inside step() would poison whatever thread drives the
+            # scheduler instead of failing the one bad request.
+            raise ValueError(
+                f'Prompt length {len(prompt)} exceeds the largest '
+                f'prompt bucket {self.buckets[-1]}')
+        req = _Request(next(self._ids), list(prompt),
+                       min(max_new_tokens,
+                           self.gen.max_seq_len - len(prompt)))
+        self._requests[req.rid] = req
+        self._queue.append(req)
+        return req.rid
+
+    def is_done(self, rid: int) -> bool:
+        return self._requests[rid].done
+
+    def result(self, rid: int) -> List[int]:
+        req = self._requests.pop(rid)
+        if not req.done:
+            raise ValueError(f'Request {rid} still in flight')
+        return req.out
+
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    @property
+    def num_queued(self) -> int:
+        return len(self._queue)
+
+    def _bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise ValueError(f'Prompt length {length} exceeds largest bucket')
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots (prefill each)."""
+        eos = self.gen.eos_token
+        while self._queue and self._free:
+            req = self._queue.pop(0)
+            slot = self._free.pop(0)
+            bucket = self._bucket_for(len(req.prompt))
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :len(req.prompt)] = np.asarray(req.prompt, np.int32)
+            (self._cache, self._token, self._positions, first,
+             self._rng) = self._prefill_one(
+                self.params, jnp.asarray(tokens), self._cache,
+                jnp.int32(len(req.prompt)), slot, self._token,
+                self._positions, self._rng)
+            req.slot = slot
+            req.out.append(int(first))
+            if (eos is not None and req.out[-1] == eos) or \
+                    len(req.out) >= req.max_new_tokens:
+                self._finish(req)
+            else:
+                self._active[slot] = req
+
+    def _finish(self, req: _Request) -> None:
+        req.done = True
+        if req.slot is not None and req.slot in self._active:
+            del self._active[req.slot]
+        if req.slot is not None:
+            self._free.append(req.slot)
+            # Freed slot decodes garbage until reused: park its position
+            # at 0 so lockstep writes land inside the (dead) cache.
+            self._positions = self._positions.at[req.slot].set(0)
+
+    def step(self) -> None:
+        """One scheduler tick: admit queued requests, then one decode
+        chunk for all active slots."""
+        self._admit()
+        if not self._active:
+            return
+        n = self.decode_chunk
+        capacity = self.gen.max_seq_len - max(
+            int(self._positions[s]) for s in self._active)
+        n = max(1, min(n, capacity))
+        toks, self._token, self._cache, self._positions, self._rng = \
+            self._decode(self.params, self._token, self._cache,
+                         self._positions, self._rng, n=n)
+        host = np.asarray(toks)
+        eos = self.gen.eos_token
+        for slot, req in list(self._active.items()):
+            for t in host[slot]:
+                req.out.append(int(t))
+                if (eos is not None and req.out[-1] == eos) or \
+                        len(req.out) >= req.max_new_tokens:
+                    self._finish(req)
+                    break
+
+    def run_until_idle(self, max_ticks: int = 10_000) -> None:
+        for _ in range(max_ticks):
+            if not self._queue and not self._active:
+                return
+            self.step()
+        raise RuntimeError('run_until_idle exceeded max_ticks')

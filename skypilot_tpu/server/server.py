@@ -337,9 +337,11 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
     async def api_config_set(request: web.Request) -> web.Response:
         import yaml
         from skypilot_tpu import config as config_lib
-        payload = await request.json()
-        text = payload.get('user_config', '')
         try:
+            payload = await request.json()
+            if not isinstance(payload, dict):
+                raise ValueError('body must be a JSON object')
+            text = payload.get('user_config', '')
             parsed = yaml.safe_load(text) or {}
             if not isinstance(parsed, dict):
                 raise ValueError('config must be a YAML mapping')

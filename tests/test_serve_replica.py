@@ -106,3 +106,24 @@ def test_generate_deterministic_greedy(server):
     a = _post(server + '/generate', {'prompt_ids': [5, 6, 7]})[1]
     b = _post(server + '/generate', {'prompt_ids': [5, 6, 7]})[1]
     assert a['output_ids'] == b['output_ids']
+
+
+def test_concurrent_requests_continuous_batching(server):
+    """Concurrent requests share the decode batch (continuous batching):
+    both complete and each matches its solo (greedy) output."""
+    import concurrent.futures as cf
+    solo = {}
+    for ids in ([5, 6, 7], [11, 12]):
+        _, body = _post(server + '/generate',
+                        {'prompt_ids': ids, 'max_new_tokens': 8})
+        solo[tuple(ids)] = body['output_ids']
+
+    with cf.ThreadPoolExecutor(max_workers=2) as ex:
+        futs = {tuple(ids): ex.submit(
+            _post, server + '/generate',
+            {'prompt_ids': list(ids), 'max_new_tokens': 8})
+            for ids in solo}
+        for ids, fut in futs.items():
+            status, body = fut.result(timeout=120)
+            assert status == 200
+            assert body['output_ids'] == solo[ids], ids
