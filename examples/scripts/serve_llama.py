@@ -107,17 +107,28 @@ class BatcherDriver:
 
 
 def build_generator(model_size: str, max_seq_len: int, temperature: float,
-                    hf_model: str = '', batch_size: int = 4):
+                    hf_model: str = '', batch_size: int = 4, tp: int = 1):
     import jax
+    import jax.numpy as jnp
 
     from skypilot_tpu.infer import GeneratorConfig
     from skypilot_tpu.infer.serving import ContinuousBatcher
     from skypilot_tpu.models import llama
 
+    mesh = None
+    if tp > 1:
+        # Megatron-sharded decode over a tp mesh (infer/tp.py): the
+        # TPU-native analog of the reference's vLLM
+        # --tensor-parallel-size recipes (llm/vllm/service.yaml).
+        from skypilot_tpu.infer import tp as tp_lib
+        mesh = tp_lib.make_tp_mesh(tp)
+
     tokenizer = None
     eos = None
     if hf_model:
         from skypilot_tpu.models import convert
+        # Host-RAM numpy tree: the batcher's shard_params device_puts it
+        # shard-wise, so no chip ever holds the full model.
         params, config = convert.load_hf_llama(hf_model)
         try:
             import transformers
@@ -129,14 +140,28 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
     else:
         config = {
             'debug': llama.LLAMA_DEBUG,
+            # tp-shardable smoke size (LLAMA_DEBUG's single KV head
+            # can't divide over a tp mesh).
+            'tiny-tp': llama.LlamaConfig(
+                vocab_size=512, d_model=128, n_layers=2, n_heads=8,
+                n_kv_heads=4, d_ff=256, max_seq_len=512,
+                dtype=jnp.float32, remat=False),
             '1b': llama.LLAMA_1B,
             '8b': llama.LLAMA3_8B,
         }[model_size]
-        params = llama.init_params(config, jax.random.PRNGKey(0))
-    max_seq_len = min(max_seq_len, config.max_seq_len)
+        if mesh is not None:
+            # Random weights init DIRECTLY under the tp shardings (jit
+            # with out_shardings): each chip only allocates its shard —
+            # plain init would OOM one chip on exactly the models tp
+            # exists to serve.
+            from skypilot_tpu.infer import tp as tp_lib
+            params = tp_lib.init_sharded_params(
+                config, jax.random.PRNGKey(0), mesh)
+        else:
+            params = llama.init_params(config, jax.random.PRNGKey(0))
     gen = ContinuousBatcher(params, config, GeneratorConfig(
         max_seq_len=max_seq_len, batch_size=batch_size,
-        temperature=temperature, eos_token=eos))
+        temperature=temperature, eos_token=eos), mesh=mesh)
     return gen, config, tokenizer
 
 
@@ -153,11 +178,15 @@ def main() -> int:
     parser.add_argument('--batch-size', type=int, default=4,
                         help='continuous-batching slots (concurrent '
                              'requests decoded in lockstep)')
+    parser.add_argument('--tp', type=int, default=1,
+                        help='tensor-parallel degree: shard params + KV '
+                             'cache over this many chips so models '
+                             'larger than one chip\'s HBM can serve')
     args = parser.parse_args()
 
     gen, config, tokenizer = build_generator(
         args.model_size, args.max_seq_len, args.temperature,
-        args.hf_model, args.batch_size)
+        args.hf_model, args.batch_size, args.tp)
     # Compile prefill + decode now so the readiness probe reflects
     # readiness instead of the first request eating the compiles.
     warm = gen.submit([1, 1], max_new_tokens=2)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.infer import llama_infer, sampling
+from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.models import llama
 
 
@@ -70,14 +71,22 @@ class Generator:
     lockstep; rows finish independently via the eos mask)."""
 
     def __init__(self, params: llama.Params, config: llama.LlamaConfig,
-                 gen_config: GeneratorConfig = GeneratorConfig()):
+                 gen_config: GeneratorConfig = GeneratorConfig(),
+                 mesh=None):
+        """mesh: optional 1-axis ('tp',) jax.sharding.Mesh (see infer/tp.py)
+        — params/KV cache are megatron-sharded over it so models larger
+        than one chip's HBM can serve; decode math is unchanged (GSPMD
+        partitions the same jitted functions)."""
+        self.mesh = mesh
+        if mesh is not None:
+            tp_lib.validate_tp(config, mesh.shape['tp'])
+            params = tp_lib.shard_params(params, mesh)
         self.params = params
         self.config = config
         self.gen = gen_config
         self.buckets = derive_buckets(gen_config)
 
-        self._prefill = jax.jit(functools.partial(
-            llama_infer.prefill, config=config))
+        self._prefill = jax.jit(self._prefill_impl)
         # Decode runs in on-device chunks (lax.scan over steps): one
         # host fetch per chunk instead of one per token — the per-token
         # device→host sync would dominate wall clock otherwise.
@@ -91,6 +100,17 @@ class Generator:
             sampling.sample_logits,
             temperature=gen_config.temperature,
             top_k=gen_config.top_k, top_p=gen_config.top_p))
+
+    def _prefill_impl(self, params, tokens, cache, lengths):
+        logits, cache = llama_infer.prefill(
+            params, tokens, config=self.config, cache=cache,
+            lengths=lengths)
+        return logits, self._constrain(cache)
+
+    def _constrain(self, cache):
+        if self.mesh is None:
+            return cache
+        return tp_lib.constrain_cache(cache, self.mesh)
 
     def _decode_chunk_impl(self, params, token, cache, positions, rng,
                            *, n, temperature, top_k, top_p):
@@ -108,7 +128,8 @@ class Generator:
 
         (token, cache, positions, rng), toks = jax.lax.scan(
             step, (token, cache, positions, rng), None, length=n)
-        return jnp.swapaxes(toks, 0, 1), token, cache, positions, rng
+        return (jnp.swapaxes(toks, 0, 1), token, self._constrain(cache),
+                positions, rng)
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -151,8 +172,10 @@ class Generator:
             tokens[i, :len(p)] = np.asarray(p, np.int32)
             lens[i] = len(p)
 
-        cache = llama_infer.init_cache(self.config, batch,
-                                       self.gen.max_seq_len)
+        cache = llama_infer.init_cache(
+            self.config, batch, self.gen.max_seq_len,
+            sharding=(None if self.mesh is None
+                      else tp_lib.cache_sharding(self.mesh)))
         logits, cache = self._prefill(self.params, jnp.asarray(tokens),
                                       cache=cache,
                                       lengths=jnp.asarray(lens))

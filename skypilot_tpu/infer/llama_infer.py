@@ -30,11 +30,16 @@ Cache = Dict[str, jax.Array]
 
 
 def init_cache(config: llama.LlamaConfig, batch: int,
-               max_len: int) -> Cache:
+               max_len: int, sharding=None) -> Cache:
+    """sharding: optional NamedSharding (infer/tp.py cache_sharding) —
+    the cache is then allocated shard-per-chip from the start; it is the
+    dominant serving buffer, so allocate-then-reshard would defeat tp's
+    HBM scaling on exactly the large-model configs that need it."""
     shape = (config.n_layers, batch, max_len, config.n_kv_heads,
              config.head_dim)
-    return {'k': jnp.zeros(shape, config.dtype),
-            'v': jnp.zeros(shape, config.dtype)}
+    kwargs = {} if sharding is None else {'device': sharding}
+    return {'k': jnp.zeros(shape, config.dtype, **kwargs),
+            'v': jnp.zeros(shape, config.dtype, **kwargs)}
 
 
 def _qkv(x, attn_p, config):

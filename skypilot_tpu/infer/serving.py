@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.infer import llama_infer, sampling
+from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
 from skypilot_tpu.models import llama
 
@@ -54,7 +55,14 @@ class ContinuousBatcher:
 
     def __init__(self, params: llama.Params, config: llama.LlamaConfig,
                  gen_config: GeneratorConfig = GeneratorConfig(),
-                 decode_chunk: int = 8):
+                 decode_chunk: int = 8, mesh=None):
+        """mesh: optional 1-axis ('tp',) mesh (infer/tp.py) — params and
+        the slot cache are megatron-sharded so serving capacity scales
+        with the tp degree instead of one chip's HBM."""
+        self.mesh = mesh
+        if mesh is not None:
+            tp_lib.validate_tp(config, mesh.shape['tp'])
+            params = tp_lib.shard_params(params, mesh)
         self.params = params
         self.config = config
         self.gen = gen_config
@@ -63,10 +71,16 @@ class ContinuousBatcher:
         self.buckets = derive_buckets(gen_config)
 
         batch = gen_config.batch_size
-        self._cache = llama_infer.init_cache(config, batch,
-                                             gen_config.max_seq_len)
+        self._cache = llama_infer.init_cache(
+            config, batch, gen_config.max_seq_len,
+            sharding=(None if mesh is None
+                      else tp_lib.cache_sharding(mesh)))
         self._token = jnp.zeros((batch,), jnp.int32)
         self._positions = jnp.zeros((batch,), jnp.int32)
+        # Host mirror of _positions, advanced from known increments
+        # (prefill length, +n per decode chunk, 0 on slot free) so the
+        # scheduler never forces a device→host sync on the hot path.
+        self._host_pos = np.zeros((batch,), np.int64)
         self._rng = jax.random.PRNGKey(0)
 
         self._free: List[int] = list(range(batch))
@@ -95,6 +109,7 @@ class ContinuousBatcher:
             k: jax.lax.dynamic_update_index_in_dim(
                 big_cache[k], small[k][:, 0], slot, axis=1)
             for k in ('k', 'v')}
+        big_cache = tp_lib.constrain_cache(big_cache, self.mesh)
         rng, sub = jax.random.split(rng)
         first = sampling.sample_logits(
             logits, sub, temperature=self.gen.temperature,
@@ -117,6 +132,7 @@ class ContinuousBatcher:
 
         (token, cache, positions, rng), toks = jax.lax.scan(
             step, (token, cache, positions, rng), None, length=n)
+        cache = tp_lib.constrain_cache(cache, self.mesh)
         return jnp.swapaxes(toks, 0, 1), token, cache, positions, rng
 
     # ---- public API ------------------------------------------------------
@@ -179,6 +195,7 @@ class ContinuousBatcher:
                 jnp.int32(len(req.prompt)), slot, self._token,
                 self._positions, self._rng)
             req.slot = slot
+            self._host_pos[slot] = len(req.prompt)
             req.out.append(int(first))
             if (eos is not None and req.out[-1] == eos) or \
                     len(req.out) >= req.max_new_tokens:
@@ -195,6 +212,7 @@ class ContinuousBatcher:
             # Freed slot decodes garbage until reused: park its position
             # at 0 so lockstep writes land inside the (dead) cache.
             self._positions = self._positions.at[req.slot].set(0)
+            self._host_pos[req.slot] = 0
 
     def step(self) -> None:
         """One scheduler tick: admit queued requests, then one decode
@@ -203,12 +221,18 @@ class ContinuousBatcher:
         if not self._active:
             return
         n = self.decode_chunk
+        # Capacity from the host-side position mirror: reading
+        # self._positions here would force one blocking device→host
+        # transfer per tick on the serving hot path.
         capacity = self.gen.max_seq_len - max(
-            int(self._positions[s]) for s in self._active)
+            int(self._host_pos[s]) for s in self._active)
         n = max(1, min(n, capacity))
         toks, self._token, self._cache, self._positions, self._rng = \
             self._decode(self.params, self._token, self._cache,
                          self._positions, self._rng, n=n)
+        # Decode advanced EVERY slot's device position by n (free slots
+        # decode garbage in lockstep); mirror that exactly.
+        self._host_pos += n
         host = np.asarray(toks)
         eos = self.gen.eos_token
         for slot, req in list(self._active.items()):

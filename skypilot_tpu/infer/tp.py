@@ -1,0 +1,124 @@
+"""Tensor-parallel sharding for the inference engine.
+
+The reference serves models larger than one accelerator's memory by
+delegating to vLLM with `--tensor-parallel-size N` in its recipes
+(reference parity: llm/vllm/service.yaml — the recipe sets TP so an
+L4:8 host can hold a 70B model).  The TPU-native equivalent is not a
+wrapper around an external engine: decode itself is partitioned over a
+1-axis `tp` mesh with megatron-style shardings and XLA/GSPMD inserts the
+collectives.
+
+What is sharded (and why it covers HBM):
+- attention projections wq/wk/wv on the head output axis, wo on the head
+  input axis  → per-chip attention works on n_heads/tp heads and one
+  psum after wo;
+- MLP w_gate/w_up on the ff output axis, w_down on the ff input axis
+  → one psum after w_down;
+- embed on the d_model axis and lm_head on the vocab axis → no chip
+  holds a full (vocab × d) table;
+- the KV cache on the kv-head axis → the dominant serving buffer
+  (L × B × S × KV × D) scales 1/tp per chip.
+
+Everything else in `llama_infer` is untouched: the same prefill /
+decode_step functions run under jit with sharded inputs, which is the
+point of the GSPMD design — tp is a data layout, not a code path.
+"""
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.parallel.sharding import PartitionRules
+
+# Megatron-style inference rules over a 1-axis ('tp',) mesh.  Note these
+# differ from the training LLAMA_RULES (2D tp × fsdp): inference has no
+# gradient/optimizer state to shard, so fsdp buys nothing, and embed is
+# sharded on d_model (not vocab) so the token gather stays local —
+# gathering from a vocab-sharded table would force GSPMD to rewrite the
+# gather as masked-lookup + psum on every prefill AND decode step.
+INFER_TP_RULES = PartitionRules([
+    (r'embed', P(None, 'tp')),                          # (vocab, d)
+    (r'attn/wq|attn/wk|attn/wv', P(None, None, 'tp')),  # (L, d, heads*hd)
+    (r'attn/wo', P(None, 'tp', None)),                  # (L, heads*hd, d)
+    (r'mlp/w_gate|mlp/w_up', P(None, None, 'tp')),      # (L, d, ff)
+    (r'mlp/w_down', P(None, 'tp', None)),               # (L, ff, d)
+    (r'norm|ln', P()),
+    (r'lm_head', P(None, 'tp')),                        # (d, vocab)
+])
+
+# Cache (L, B, max_len, KV_heads, head_dim): shard the kv-head axis.
+CACHE_SPEC = P(None, None, None, 'tp', None)
+
+
+def validate_tp(config, tp: int) -> None:
+    """Fail fast (at engine construction, not first decode) when the
+    model's axes don't divide over tp chips."""
+    problems = []
+    if config.n_kv_heads % tp:
+        problems.append(f'n_kv_heads={config.n_kv_heads}')
+    if config.n_heads % tp:
+        problems.append(f'n_heads={config.n_heads}')
+    if config.d_ff % tp:
+        problems.append(f'd_ff={config.d_ff}')
+    if config.d_model % tp:
+        problems.append(f'd_model={config.d_model}')
+    if config.vocab_size % tp:
+        problems.append(f'vocab_size={config.vocab_size}')
+    if problems:
+        raise ValueError(
+            f'Model axes not divisible by tp={tp}: '
+            + ', '.join(problems))
+
+
+def make_tp_mesh(tp: int, devices=None):
+    """1-axis ('tp',) mesh over the first tp local devices (local: a
+    serving replica shards within its own host's ICI neighborhood —
+    jax.devices() would include other hosts' non-addressable chips on a
+    multi-host slice and device_put would fail)."""
+    import jax
+    import numpy as np
+    if devices is None:
+        devices = jax.local_devices()
+    if len(devices) < tp:
+        raise ValueError(f'tp={tp} but only {len(devices)} devices')
+    return jax.sharding.Mesh(np.asarray(devices[:tp]), ('tp',))
+
+
+def shard_params(params, mesh):
+    """Place inference params on the tp mesh per INFER_TP_RULES."""
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    return sharding_lib.shard_params(params, mesh, INFER_TP_RULES)
+
+
+def init_sharded_params(config, key, mesh):
+    """Random-init params DIRECTLY under their tp shardings (jit with
+    out_shardings): each chip only ever allocates its own shard.  The
+    allocate-then-device_put path would materialize the full model on
+    one chip first — an OOM for exactly the models tp exists to serve."""
+    import jax
+    from skypilot_tpu.models import llama
+
+    def init(k):
+        return llama.init_params(config, k)
+
+    abstract = jax.eval_shape(init, key)
+    specs = INFER_TP_RULES.tree_specs(abstract)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(init, out_shardings=shardings)(key)
+
+
+def cache_sharding(mesh) -> NamedSharding:
+    return NamedSharding(mesh, CACHE_SPEC)
+
+
+def constrain_cache(cache, mesh):
+    """with_sharding_constraint on a cache pytree — usable inside jit to
+    pin the kv-head sharding through scans (GSPMD usually propagates it,
+    but the constraint makes the layout a contract, not an inference)."""
+    if mesh is None:
+        return cache
+    import jax
+    sh = cache_sharding(mesh)
+    return {k: jax.lax.with_sharding_constraint(v, sh)
+            for k, v in cache.items()}
+

@@ -1,0 +1,121 @@
+"""Tensor-parallel inference: sharded decode matches single-device.
+
+The contract VERDICT r2 asked for: greedy outputs from a tp-sharded
+engine must be IDENTICAL to the unsharded engine (tp is a data layout,
+not a numerics change).  Runs on the hermetic 8-device CPU mesh
+(conftest.py) — the same GSPMD partitioning TPU gets.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import Generator, GeneratorConfig
+from skypilot_tpu.infer import tp as tp_lib
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.models import llama
+
+# f32 everywhere: bf16 reduction-order drift across shardings could flip
+# an argmax tie; f32 keeps greedy parity exact at this scale.
+CFG = llama.LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=8,
+                        n_kv_heads=4, d_ff=128, max_seq_len=128,
+                        dtype=jnp.float32, remat=False)
+GEN = GeneratorConfig(max_seq_len=64, batch_size=2, temperature=0.0,
+                      prompt_buckets=[16])
+
+
+@pytest.fixture(scope='module')
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_validate_tp_rejects_indivisible():
+    with pytest.raises(ValueError, match='n_kv_heads'):
+        tp_lib.validate_tp(CFG, 3)
+
+
+def test_make_tp_mesh_too_many_devices():
+    with pytest.raises(ValueError, match='tp=99'):
+        tp_lib.make_tp_mesh(99)
+
+
+def test_shard_params_layouts(params):
+    mesh = tp_lib.make_tp_mesh(2)
+    sharded = tp_lib.shard_params(params, mesh)
+    wq = sharded['layers']['attn']['wq']
+    # (L, d, heads*hd) sharded on the output axis.
+    assert wq.sharding.spec == jax.sharding.PartitionSpec(
+        None, None, 'tp')
+    # Norms replicated.
+    assert sharded['final_norm'].sharding.is_fully_replicated
+
+
+def test_init_sharded_params_matches_plain_init(params):
+    """init_sharded_params (jit + out_shardings, shard-per-chip alloc)
+    must produce the SAME weights as plain init + device_put."""
+    mesh = tp_lib.make_tp_mesh(2)
+    sharded = tp_lib.init_sharded_params(CFG, jax.random.PRNGKey(0), mesh)
+    wq = sharded['layers']['attn']['wq']
+    assert wq.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, None, 'tp')), 3)
+    # allclose, not bit-equal: jit fuses the init math differently from
+    # eager (same rng stream, ~1e-9 f32 reassociation drift).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        params, sharded)
+
+
+@pytest.mark.parametrize('tp', [2, 4])
+def test_generator_tp_parity(params, tp):
+    prompts = [[5, 9, 2, 7], [11, 3]]
+    base = Generator(params, CFG, GEN).generate(prompts,
+                                                max_new_tokens=12)
+    mesh = tp_lib.make_tp_mesh(tp)
+    sharded = Generator(params, CFG, GEN, mesh=mesh).generate(
+        prompts, max_new_tokens=12)
+    assert base == sharded
+    assert all(len(row) == 12 for row in base)
+
+
+def test_batcher_tp_parity(params):
+    def run(mesh):
+        b = ContinuousBatcher(params, CFG, GEN, mesh=mesh)
+        rids = [b.submit([5, 9, 2, 7], max_new_tokens=10),
+                b.submit([11, 3], max_new_tokens=10)]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    base = run(None)
+    sharded = run(tp_lib.make_tp_mesh(2))
+    assert base == sharded
+    assert all(len(row) == 10 for row in base)
+
+
+def test_batcher_tp_cache_is_sharded(params):
+    mesh = tp_lib.make_tp_mesh(2)
+    want = tp_lib.cache_sharding(mesh)
+    b = ContinuousBatcher(params, CFG, GEN, mesh=mesh)
+    assert b._cache['k'].sharding.is_equivalent_to(want, 5)
+    # Slot reuse keeps working sharded: 3 requests through 2 slots.
+    rids = [b.submit([i + 1, i + 2], max_new_tokens=6) for i in range(3)]
+    b.run_until_idle()
+    outs = [b.result(r) for r in rids]
+    assert all(len(o) == 6 for o in outs)
+    # Decode output cache kept the tp layout (no silent re-replication;
+    # specs compared semantically — jit normalizes away trailing Nones).
+    assert b._cache['k'].sharding.is_equivalent_to(want, 5)
+
+
+def test_host_position_mirror_tracks_device(params):
+    """The scheduler's host-side position mirror must match the device
+    array at every tick (it replaces a per-slot device sync)."""
+    b = ContinuousBatcher(params, CFG, GEN, decode_chunk=4)
+    rids = [b.submit([5, 9, 2], max_new_tokens=9),
+            b.submit([4], max_new_tokens=5)]
+    while any(not b.is_done(r) for r in rids):
+        b.step()
+        np.testing.assert_array_equal(
+            np.asarray(b._positions), b._host_pos.astype(np.int32))
+    for r in rids:
+        b.result(r)
