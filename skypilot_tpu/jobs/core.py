@@ -13,25 +13,23 @@ Reference parity: sky/jobs/ client+server routes.  Two controller modes
 """
 from __future__ import annotations
 
-import json
 import os
+import shlex
 import subprocess
 import sys
-import tempfile
 import time
-import uuid
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs.state import JobsTable, ManagedJobStatus
+from skypilot_tpu.utils import controller_utils
 
 logger = sky_logging.init_logger(__name__)
 
 _DAEMON_PID = '~/.skypilot_tpu/jobs_controller.pid'
 CONTROLLER_CLUSTER = 'skytpu-jobs-controller'
-_JSON_MARKER = 'SKYTPU_JSON:'
 
 
 def _daemon_running() -> bool:
@@ -73,70 +71,24 @@ def _controller_resources_config() -> Optional[Dict[str, Any]]:
 
 
 def _ensure_remote_controller():
-    """Launch or reuse the dedicated controller cluster; returns its
-    handle.  The controller is an ordinary cluster: provisioning installs
-    the framework wheel on it, which is all the controller needs."""
-    from skypilot_tpu import execution
-    from skypilot_tpu import resources as resources_lib
-    from skypilot_tpu import state as state_lib
-    record = state_lib.get_cluster(CONTROLLER_CLUSTER)
-    if record is not None and \
-            record['status'] == state_lib.ClusterStatus.UP:
-        return record['handle']
-    spec = dict(_controller_resources_config() or {})
-    controller_task = task_lib.Task(name='jobs-controller', run='true')
-    controller_task.set_resources(resources_lib.Resources(**spec))
-    _, handle = execution.launch(controller_task,
-                                 cluster_name=CONTROLLER_CLUSTER,
-                                 detach_run=True)
-    return handle
-
-
-def _run_on_controller(handle, cmd: str,
-                       stream: bool = False) -> tuple:
-    """Run `cmd` on the controller head; returns (rc, captured output)."""
-    from skypilot_tpu.provision.provisioner import _make_runners
-    runner = _make_runners(handle.cluster_info)[0]
-    env = None
-    if handle.cluster_info.cloud == 'local':
-        # Hermetic local-cloud controller: its state lives under the
-        # fake host's directory, not the client's ~/.skypilot_tpu.
-        env = {'HOME': handle.cluster_info.head.workdir}
-    with tempfile.NamedTemporaryFile('r', suffix='.log') as log_f:
-        rc = runner.run(cmd, env=env, log_path=log_f.name,
-                        stream_logs=stream)
-        return rc, log_f.read()
-
-
-def _parse_marker(output: str) -> Dict[str, Any]:
-    for line in reversed(output.splitlines()):
-        if line.startswith(_JSON_MARKER):
-            return json.loads(line[len(_JSON_MARKER):])
-    raise exceptions.CommandError(
-        1, 'jobs.remote', f'No controller response in output:\n{output}')
+    return controller_utils.ensure_controller_cluster(
+        CONTROLLER_CLUSTER, 'jobs-controller',
+        _controller_resources_config())
 
 
 def _remote_launch(task: task_lib.Task, name: Optional[str]) -> int:
     handle = _ensure_remote_controller()
     if name:
         task.name = name
-    spec_name = f'job-{uuid.uuid4().hex[:8]}.yaml'
-    remote_dir = '.skypilot_tpu/managed_specs'
-    with tempfile.TemporaryDirectory() as tmp:
-        local_path = os.path.join(tmp, spec_name)
-        with open(local_path, 'w', encoding='utf-8') as f:
-            import yaml
-            yaml.safe_dump(task.to_yaml_config(), f)
-        rc, _ = _run_on_controller(handle, f'mkdir -p {remote_dir}')
-        from skypilot_tpu.provision.provisioner import _make_runners
-        runner = _make_runners(handle.cluster_info)[0]
-        runner.rsync(local_path, f'{remote_dir}/{spec_name}', up=True)
-    rc, out = _run_on_controller(
+    spec_path = controller_utils.ship_spec(
+        handle, task, '.skypilot_tpu/managed_specs', 'job')
+    rc, out = controller_utils.run_on_controller(
         handle, f'python3 -m skypilot_tpu.jobs.remote submit '
-                f'{remote_dir}/{spec_name}')
+                f'{shlex.quote(spec_path)}')
     if rc != 0:
         raise exceptions.CommandError(rc, 'jobs.remote submit', out[-2000:])
-    job_id = int(_parse_marker(out)['job_id'])
+    job_id = int(controller_utils.parse_marker(
+        out, 'jobs.remote submit')['job_id'])
     logger.info(f'Managed job {job_id} ({task.name!r}) submitted to '
                 f'controller cluster {CONTROLLER_CLUSTER!r}.')
     return job_id
@@ -148,11 +100,11 @@ def _remote_queue(skip_finished: bool) -> List[Dict[str, Any]]:
     if record is None:
         return []
     flag = '' if skip_finished else ' --all'
-    rc, out = _run_on_controller(
+    rc, out = controller_utils.run_on_controller(
         record['handle'], f'python3 -m skypilot_tpu.jobs.remote queue{flag}')
     if rc != 0:
         raise exceptions.CommandError(rc, 'jobs.remote queue', out[-2000:])
-    jobs = _parse_marker(out)['jobs']
+    jobs = controller_utils.parse_marker(out, 'jobs.remote queue')['jobs']
     for j in jobs:
         j['status'] = ManagedJobStatus(j['status'])
     return jobs
@@ -163,13 +115,14 @@ def _remote_cancel(job_ids: Optional[List[int]]) -> List[int]:
     record = state_lib.get_cluster(CONTROLLER_CLUSTER)
     if record is None:
         return []
-    ids = ' '.join(str(i) for i in (job_ids or []))
-    rc, out = _run_on_controller(
+    ids = ' '.join(str(int(i)) for i in (job_ids or []))
+    rc, out = controller_utils.run_on_controller(
         record['handle'],
         f'python3 -m skypilot_tpu.jobs.remote cancel {ids}'.rstrip())
     if rc != 0:
         raise exceptions.CommandError(rc, 'jobs.remote cancel', out[-2000:])
-    return list(_parse_marker(out)['cancelled'])
+    return list(controller_utils.parse_marker(
+        out, 'jobs.remote cancel')['cancelled'])
 
 
 def launch(task: task_lib.Task, name: Optional[str] = None,
@@ -235,7 +188,6 @@ def _local_cancel(job_ids: Optional[List[int]] = None) -> List[int]:
 
 def tail_logs(job_id: int, follow: bool = True) -> int:
     """Stream the underlying cluster job's rank-0 log."""
-    from skypilot_tpu import core as core_lib
     from skypilot_tpu import state as state_lib
     if _controller_resources_config() is not None:
         record = state_lib.get_cluster(CONTROLLER_CLUSTER)
@@ -243,11 +195,21 @@ def tail_logs(job_id: int, follow: bool = True) -> int:
             print(f'Managed job {job_id}: controller cluster not up.')
             return 1
         flag = '' if follow else ' --no-follow'
-        rc, _ = _run_on_controller(
+        # jobs.remote logs, NOT the public CLI: the client's config can
+        # leak into the controller's env, and the config-dispatching CLI
+        # would recurse into this remote branch instead of reading the
+        # logs that live right there.
+        rc, _ = controller_utils.run_on_controller(
             record['handle'],
-            f'python3 -m skypilot_tpu.client.cli jobs logs {job_id}{flag}',
-            stream=True)
+            f'python3 -m skypilot_tpu.jobs.remote logs {int(job_id)}'
+            f'{flag}', stream=True)
         return rc
+    return _local_tail_logs(job_id, follow=follow)
+
+
+def _local_tail_logs(job_id: int, follow: bool = True) -> int:
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import state as state_lib
     table = JobsTable()
     record = table.get(job_id)
     if record is None:

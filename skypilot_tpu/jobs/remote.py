@@ -52,6 +52,13 @@ def main(argv) -> int:
         ids = [int(a) for a in argv[1:]] or None
         _emit({'cancelled': core._local_cancel(ids)})  # noqa: SLF001
         return 0
+    if cmd == 'logs':
+        from skypilot_tpu.jobs import core
+        # _local_tail_logs, not the public CLI: the client's config can
+        # leak into this process's env, and the config-dispatching
+        # public path would recurse into the remote branch.
+        return core._local_tail_logs(  # noqa: SLF001
+            int(argv[1]), follow='--no-follow' not in argv)
     print(f'unknown jobs.remote command {cmd!r}', file=sys.stderr)
     return 2
 

@@ -1,13 +1,22 @@
 """Serve user API: up/down/status/update (reference: sky/serve/ client+server).
 
-The serve controller daemon (controllers + load balancers for every
-service) is spawned on first use — a local process standing in for the
-reference's sky-serve-controller VM (same pattern as the jobs controller;
-see skypilot_tpu/serve/controller.py docstring).
+Two controller modes (mirroring the reference's serve-controller-VM
+architecture, SURVEY §1/§3.4 — the same engine runs in three places):
+
+- default: the serve controller daemon (controllers + load balancers for
+  every service) is a local process spawned on first use;
+- ``serve.controller.resources`` configured (e.g. ``{cloud: gcp, cpus: 4}``):
+  a dedicated controller CLUSTER is launched as an ordinary cluster (the
+  reference's sky-serve-controller.yaml.j2 path), the service task is
+  shipped to it, and the serve daemon — replica probes, autoscaling, LB —
+  runs THERE, surviving the client machine
+  (sky/serve/service.py:327,:354).
 """
 from __future__ import annotations
 
 import os
+import re
+import shlex
 import subprocess
 import sys
 import time
@@ -17,13 +26,15 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import serve_state
-from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import controller_utils
 
 logger = sky_logging.init_logger(__name__)
 
 _DAEMON_PID = '~/.skypilot_tpu/serve_controller.pid'
 LB_PORT_START = 8800
+CONTROLLER_CLUSTER = 'skytpu-serve-controller'
 
 
 def _daemon_running() -> bool:
@@ -61,8 +72,148 @@ def _allocate_lb_port() -> int:
     return port
 
 
+# ---------------------------------------------------------------------------
+# Remote controller mode (shared plumbing: utils/controller_utils.py)
+# ---------------------------------------------------------------------------
+
+_SPEC_DIR = '.skypilot_tpu/service_specs'
+
+
+def _controller_resources_config() -> Optional[Dict[str, Any]]:
+    from skypilot_tpu import config
+    return config.get_nested(('serve', 'controller', 'resources'), None)
+
+
+def _ensure_remote_controller():
+    return controller_utils.ensure_controller_cluster(
+        CONTROLLER_CLUSTER, 'serve-controller',
+        _controller_resources_config())
+
+
+def _validate_service_name(name: Optional[str]) -> None:
+    """Service names ride in controller shell commands (quoted) and
+    cluster names; constrain them to one safe token up front."""
+    if name is None:
+        return
+    if not re.fullmatch(r'[A-Za-z0-9][A-Za-z0-9._-]*', name):
+        raise exceptions.InvalidServiceSpecError(
+            f'Invalid service name {name!r}: use letters, digits, '
+            f'".", "_", "-" (no spaces).')
+
+
+def _controller_endpoint_host(handle) -> Optional[str]:
+    """Externally reachable host for the controller's LB ports (None =
+    keep the controller-local URL; true for the local cloud, where
+    127.0.0.1 IS the controller host from the client's perspective)."""
+    if handle.cluster_info.cloud == 'local':
+        return None
+    head = handle.cluster_info.head
+    return head.external_ip or head.internal_ip
+
+
+def _remote_up(task: task_lib.Task, service_name: Optional[str]) -> str:
+    handle = _ensure_remote_controller()
+    spec_path = controller_utils.ship_spec(handle, task, _SPEC_DIR,
+                                           'service')
+    name_arg = f' {shlex.quote(service_name)}' if service_name else ''
+    rc, out = controller_utils.run_on_controller(
+        handle, f'python3 -m skypilot_tpu.serve.remote up '
+                f'{shlex.quote(spec_path)}{name_arg}')
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve.remote up', out[-2000:])
+    endpoint = controller_utils.parse_marker(out, 'serve.remote up'
+                                             )['endpoint']
+    host = _controller_endpoint_host(handle)
+    if host is not None:
+        endpoint = endpoint.replace('127.0.0.1', host)
+    logger.info(f'Service registered on controller cluster '
+                f'{CONTROLLER_CLUSTER!r}; endpoint {endpoint}')
+    return endpoint
+
+
+def _remote_status(service_names: Optional[List[str]]
+                   ) -> List[Dict[str, Any]]:
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+    if record is None:
+        return []
+    rc, out = controller_utils.run_on_controller(
+        record['handle'], 'python3 -m skypilot_tpu.serve.remote status')
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve.remote status',
+                                      out[-2000:])
+    services = controller_utils.parse_marker(
+        out, 'serve.remote status')['services']
+    host = _controller_endpoint_host(record['handle'])
+    for svc in services:
+        svc['status'] = ServiceStatus(svc['status'])
+        if host is not None and svc.get('endpoint'):
+            svc['endpoint'] = svc['endpoint'].replace('127.0.0.1', host)
+        for replica in svc.get('replicas', ()):
+            replica['status'] = ReplicaStatus(replica['status'])
+    if service_names:
+        services = [s for s in services if s['name'] in service_names]
+    return services
+
+
+def _remote_down(service_name: str, purge: bool) -> None:
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+    if record is None:
+        if purge:
+            return
+        raise exceptions.ServeError(
+            f'Service {service_name!r} not found (no controller cluster).')
+    flag = ' --purge' if purge else ''
+    rc, out = controller_utils.run_on_controller(
+        record['handle'],
+        f'python3 -m skypilot_tpu.serve.remote down '
+        f'{shlex.quote(service_name)}{flag}')
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve.remote down', out[-2000:])
+
+
+def _remote_update(task: task_lib.Task, service_name: str) -> int:
+    from skypilot_tpu import state as state_lib
+    record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+    if record is None:
+        raise exceptions.ServeError(
+            f'Service {service_name!r} not found (no controller cluster).')
+    handle = record['handle']
+    spec_path = controller_utils.ship_spec(handle, task, _SPEC_DIR,
+                                           'service')
+    rc, out = controller_utils.run_on_controller(
+        handle, f'python3 -m skypilot_tpu.serve.remote update '
+                f'{shlex.quote(spec_path)} {shlex.quote(service_name)}')
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve.remote update',
+                                      out[-2000:])
+    return int(controller_utils.parse_marker(
+        out, 'serve.remote update')['version'])
+
+
+# ---------------------------------------------------------------------------
+# Public API (dispatches local vs remote-controller mode)
+# ---------------------------------------------------------------------------
+
 def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
     """Register + start a service; returns its endpoint URL."""
+    # Validate BEFORE dispatch: the remote path provisions a whole
+    # controller cluster, and a task with no/invalid `service:` section
+    # must fail here as a typed error, not minutes later as an opaque
+    # CommandError from the controller.
+    if task.service is None:
+        raise exceptions.InvalidServiceSpecError(
+            'Task has no `service:` section.')
+    ServiceSpec.from_yaml_config(task.service)
+    _validate_service_name(service_name or task.name)
+    if _controller_resources_config() is not None:
+        return _remote_up(task, service_name)
+    return _local_up(task, service_name)
+
+
+def _local_up(task: task_lib.Task,
+              service_name: Optional[str] = None) -> str:
     if task.service is None:
         raise exceptions.InvalidServiceSpecError(
             'Task has no `service:` section.')
@@ -84,6 +235,12 @@ def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
 
 def update(task: task_lib.Task, service_name: str) -> int:
     """Rolling update to a new version; returns the new version."""
+    if _controller_resources_config() is not None:
+        return _remote_update(task, service_name)
+    return _local_update(task, service_name)
+
+
+def _local_update(task: task_lib.Task, service_name: str) -> int:
     record = serve_state.get_service(service_name)
     if record is None:
         raise exceptions.ServeError(f'Service {service_name!r} not found.')
@@ -99,6 +256,13 @@ def update(task: task_lib.Task, service_name: str) -> int:
 
 
 def down(service_name: str, purge: bool = False) -> None:
+    if _controller_resources_config() is not None:
+        _remote_down(service_name, purge)
+        return
+    _local_down(service_name, purge)
+
+
+def _local_down(service_name: str, purge: bool = False) -> None:
     record = serve_state.get_service(service_name)
     if record is None:
         if purge:
@@ -118,6 +282,13 @@ def down(service_name: str, purge: bool = False) -> None:
 
 def status(service_names: Optional[List[str]] = None
            ) -> List[Dict[str, Any]]:
+    if _controller_resources_config() is not None:
+        return _remote_status(service_names)
+    return _local_status(service_names)
+
+
+def _local_status(service_names: Optional[List[str]] = None
+                  ) -> List[Dict[str, Any]]:
     records = serve_state.get_services()
     if service_names:
         records = [r for r in records if r['name'] in service_names]
@@ -128,6 +299,29 @@ def status(service_names: Optional[List[str]] = None
 
 def tail_logs(service_name: str, replica_id: int, follow: bool = True
               ) -> int:
+    if _controller_resources_config() is not None:
+        from skypilot_tpu import state as state_lib
+        record = state_lib.get_cluster(CONTROLLER_CLUSTER)
+        if record is None:
+            print(f'Service {service_name!r}: controller cluster not up.')
+            return 1
+        flag = '' if follow else ' --no-follow'
+        # serve.remote logs, NOT the public CLI: the client's config
+        # (incl. serve.controller.resources) can leak into the
+        # controller's env, and the config-dispatching CLI would then
+        # recurse into the remote branch instead of reading the
+        # replica logs that live right there.
+        rc, _ = controller_utils.run_on_controller(
+            record['handle'],
+            f'python3 -m skypilot_tpu.serve.remote logs '
+            f'{shlex.quote(service_name)} {int(replica_id)}{flag}',
+            stream=True)
+        return rc
+    return _local_tail_logs(service_name, replica_id, follow=follow)
+
+
+def _local_tail_logs(service_name: str, replica_id: int,
+                     follow: bool = True) -> int:
     from skypilot_tpu import core as core_lib
     from skypilot_tpu.serve.replica_managers import replica_cluster_name
     return core_lib.tail_logs(

@@ -1,0 +1,83 @@
+"""Controller-side entry points for a REMOTE serve controller.
+
+Reference parity: the sky-serve-controller VM architecture
+(sky/templates/sky-serve-controller.yaml.j2; sky/serve/service.py:327,:354
+— controller + load-balancer processes run ON a controller cluster, so
+services outlive the client machine).  The client ships the service task
+YAML to the controller cluster and invokes this module over the cluster's
+command runner:
+
+    python3 -m skypilot_tpu.serve.remote up <yaml-path> [service-name]
+    python3 -m skypilot_tpu.serve.remote status
+    python3 -m skypilot_tpu.serve.remote down <name> [--purge]
+    python3 -m skypilot_tpu.serve.remote update <yaml-path> <name>
+
+Each command prints one result line prefixed ``SKYTPU_JSON:`` (the same
+wire contract as jobs.remote).  Everything else — serve daemon, replica
+managers, probes, autoscaler, LB — is the SAME code the local mode runs;
+the controller is the library, running elsewhere.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+_MARKER = 'SKYTPU_JSON:'
+
+
+def _emit(payload) -> None:
+    # default=str: service/replica rows carry status enums; the client
+    # reconstructs them from their values.
+    print(f'{_MARKER} {json.dumps(payload, default=str)}', flush=True)
+
+
+def _jsonable_status(records):
+    for record in records:
+        record['status'] = record['status'].value
+        for replica in record.get('replicas', ()):
+            replica['status'] = replica['status'].value
+    return records
+
+
+def main(argv) -> int:
+    cmd = argv[0] if argv else ''
+    if cmd == 'up':
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.serve import core
+        task = task_lib.Task.from_yaml(argv[1])
+        name = argv[2] if len(argv) > 2 else None
+        # _local_up: we ARE the controller — a serve.controller config
+        # key on this host must not recurse into another remote hop.
+        endpoint = core._local_up(task, name)  # noqa: SLF001
+        _emit({'endpoint': endpoint})
+        return 0
+    if cmd == 'status':
+        from skypilot_tpu.serve import core
+        _emit({'services': _jsonable_status(
+            core._local_status(None))})  # noqa: SLF001
+        return 0
+    if cmd == 'down':
+        from skypilot_tpu.serve import core
+        core._local_down(argv[1], purge='--purge' in argv)  # noqa: SLF001
+        _emit({'down': argv[1]})
+        return 0
+    if cmd == 'update':
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.serve import core
+        task = task_lib.Task.from_yaml(argv[1])
+        version = core._local_update(task, argv[2])  # noqa: SLF001
+        _emit({'version': version})
+        return 0
+    if cmd == 'logs':
+        from skypilot_tpu.serve import core
+        # _local_tail_logs, not the public CLI: the client's config can
+        # leak into this process's env, and the config-dispatching
+        # public path would recurse into the remote branch.
+        return core._local_tail_logs(  # noqa: SLF001
+            argv[1], int(argv[2]), follow='--no-follow' not in argv)
+    print(f'unknown serve.remote command {cmd!r}', file=sys.stderr)
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

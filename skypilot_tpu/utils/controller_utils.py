@@ -1,0 +1,91 @@
+"""Shared plumbing for remote controller clusters (jobs + serve).
+
+Reference parity: sky/utils/controller_utils.py — the reference's jobs and
+serve controllers share one controller-cluster toolkit (sizing, launch,
+spec shipping).  Here: ensure-cluster, run-command-with-marker-protocol,
+and spec shipping, parameterized by controller name/config so
+jobs/core.py and serve/core.py cannot drift apart.
+
+Wire contract: controller-side modules (jobs.remote / serve.remote) print
+one ``SKYTPU_JSON: {...}`` line; everything else in the output is logs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+JSON_MARKER = 'SKYTPU_JSON:'
+
+
+def ensure_controller_cluster(cluster_name: str, task_name: str,
+                              resources_config: Optional[Dict[str, Any]]):
+    """Launch or reuse a dedicated controller cluster; returns its handle.
+
+    The controller is an ordinary cluster: provisioning installs the
+    framework wheel on it, which is all a controller needs (SURVEY §1
+    "the same engine runs in three places")."""
+    from skypilot_tpu import execution
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu import task as task_lib
+    record = state_lib.get_cluster(cluster_name)
+    if record is not None and \
+            record['status'] == state_lib.ClusterStatus.UP:
+        return record['handle']
+    controller_task = task_lib.Task(name=task_name, run='true')
+    controller_task.set_resources(
+        resources_lib.Resources(**dict(resources_config or {})))
+    _, handle = execution.launch(controller_task,
+                                 cluster_name=cluster_name,
+                                 detach_run=True)
+    return handle
+
+
+def run_on_controller(handle, cmd: str, stream: bool = False) -> tuple:
+    """Run `cmd` on the controller head; returns (rc, captured output)."""
+    from skypilot_tpu.provision.provisioner import _make_runners
+    runner = _make_runners(handle.cluster_info)[0]
+    env = None
+    if handle.cluster_info.cloud == 'local':
+        # Hermetic local-cloud controller: its state lives under the
+        # fake host's directory, not the client's ~/.skypilot_tpu.
+        env = {'HOME': handle.cluster_info.head.workdir}
+    with tempfile.NamedTemporaryFile('r', suffix='.log') as log_f:
+        rc = runner.run(cmd, env=env, log_path=log_f.name,
+                        stream_logs=stream)
+        return rc, log_f.read()
+
+
+def parse_marker(output: str, what: str) -> Dict[str, Any]:
+    for line in reversed(output.splitlines()):
+        if line.startswith(JSON_MARKER):
+            return json.loads(line[len(JSON_MARKER):])
+    raise exceptions.CommandError(
+        1, what, f'No controller response in output:\n{output}')
+
+
+def ship_spec(handle, task, remote_dir: str, prefix: str) -> str:
+    """Write the task YAML locally, rsync it to the controller; returns
+    the (shell-quoted-safe) remote path."""
+    import yaml
+
+    from skypilot_tpu.provision.provisioner import _make_runners
+    spec_name = f'{prefix}-{uuid.uuid4().hex[:8]}.yaml'
+    rc, out = run_on_controller(
+        handle, f'mkdir -p {shlex.quote(remote_dir)}')
+    if rc != 0:
+        raise exceptions.CommandError(
+            rc, f'mkdir -p {remote_dir}', out[-2000:])
+    with tempfile.TemporaryDirectory() as tmp:
+        local_path = os.path.join(tmp, spec_name)
+        with open(local_path, 'w', encoding='utf-8') as f:
+            yaml.safe_dump(task.to_yaml_config(), f)
+        runner = _make_runners(handle.cluster_info)[0]
+        runner.rsync(local_path, f'{remote_dir}/{spec_name}', up=True)
+    return f'{remote_dir}/{spec_name}'
