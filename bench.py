@@ -78,8 +78,6 @@ def bench_8b_extrapolated(on_tpu: bool) -> dict:
 
     rt = _roundtrip_baseline()
     key = jax.random.PRNGKey(0)
-    one_layer_cfg = dataclasses.replace(cfg, n_layers=1)
-    params = llama.init_params(one_layer_cfg, key)
     tokens = jnp.zeros((batch, seq + 1), jnp.int32)
 
     def _sgd_loop(loss_fn, iters):
@@ -95,11 +93,26 @@ def bench_8b_extrapolated(on_tpu: bool) -> dict:
                        for leaf in jax.tree_util.tree_leaves(p))
         return run
 
-    def full_loss(p, t):
-        return llama.loss_fn(p, {'tokens': t}, one_layer_cfg)
+    def _time_k_layers(k: int, keep_head: bool = False):
+        k_cfg = dataclasses.replace(cfg, n_layers=k)
+        k_params = llama.init_params(k_cfg, key)
 
-    t_1layer_model = _time_chained(
-        _sgd_loop(full_loss, iters), params, iters, rt)
+        def loss(p, t):
+            return llama.loss_fn(p, {'tokens': t}, k_cfg)
+
+        t = _time_chained(_sgd_loop(loss, iters), k_params, iters, rt)
+        # Hand back embed+lm_head so the head timing below does not pay
+        # a third full true-shape init (the fp32 init normals are the
+        # HBM spike, not the kept bf16 tables).
+        head = ({'embed': k_params['embed'],
+                 'lm_head': k_params['lm_head']} if keep_head else None)
+        return t, head
+
+    t_1layer_model, head_params = _time_k_layers(1, keep_head=True)
+    # k=2 true-shape cross-check (VERDICT r2 weak #2): a second
+    # measured point both validates the linear-in-depth model and gives
+    # a per-layer slope free of fixed-overhead bias.
+    t_2layer_model, _ = _time_k_layers(2)
 
     def head_loss(p, t):
         h = p['embed'][t[:, :-1]]
@@ -110,58 +123,83 @@ def bench_8b_extrapolated(on_tpu: bool) -> dict:
                                    axis=-1)[..., 0]
         return jnp.mean(lse - gold)
 
-    head_params = {'embed': params['embed'], 'lm_head': params['lm_head']}
     t_head = _time_chained(
         _sgd_loop(head_loss, iters), head_params, iters, rt)
 
-    t_layer = max(t_1layer_model - t_head, 1e-9)
+    # Per-layer slope from the (1, 2)-layer pair; the 1-layer point then
+    # cross-checks the extrapolation: predicted t_1 = slope + t_head.
+    t_layer = max(t_2layer_model - t_1layer_model, 1e-9)
+    predicted_t1 = t_layer + t_head
+    extrapolation_err = abs(predicted_t1 - t_1layer_model) / t_1layer_model
     t_step = cfg.n_layers * t_layer + t_head
     tok_s = batch * seq / t_step
     n_params = cfg.num_params()
-    mfu = tok_s * 6 * n_params / (197e12 if on_tpu else 1e12)
-    return {
+    # MFU convention (VERDICT r2 weak #2): embedding does NO matmul
+    # FLOPs in forward (it is a gather); 6N with N_total would inflate
+    # the claim by the embed share.  mfu_pct uses matmul params only
+    # (lm_head IS a matmul and stays); mfu_all_params_pct is the 6N_total
+    # figure for comparison with conventions that include it.
+    n_matmul = n_params - cfg.vocab_size * cfg.d_model
+    peak = 197e12 if on_tpu else 1e12
+    mfu = tok_s * 6 * n_matmul / peak
+    mfu_all = tok_s * 6 * n_params / peak
+    out = {
         'tok_s_chip_extrapolated': round(tok_s, 1),
         'params_b': round(n_params / 1e9, 2),
         'mfu_pct': round(100 * mfu, 1),
+        'mfu_all_params_pct': round(100 * mfu_all, 1),
         't_layer_ms': round(t_layer * 1e3, 2),
         't_head_ms': round(t_head * 1e3, 2),
-        'method': f'32x true-shape layer + head (chained SGD steps), '
-                  f'bs={batch}x{seq}',
+        'extrapolation_check_pct': round(100 * extrapolation_err, 1),
+        'method': f'{cfg.n_layers}x true-shape per-layer slope from '
+                  f'(1,2)-layer runs + head (chained SGD steps), '
+                  f'bs={batch}x{seq}; check = 1-layer point vs linear '
+                  f'model; mfu counts matmul params only (embed gather '
+                  f'excluded)',
     }
+    # Same honesty guard as bench_allreduce: a clamped slope (timing
+    # noise made t_2 <= t_1) or a failed cross-check means the linear
+    # model did not hold on this run — flag the number, don't sell it.
+    if t_layer <= 2e-9 or extrapolation_err > 0.25:
+        out['suspect'] = ('slope degenerate or cross-check failed '
+                          '(>25%) — extrapolation invalid on this run')
+    return out
 
 
 def bench_allreduce() -> dict:
     """psum algbw/busbw over all local devices (VERDICT r1 #4b; analog of
     the reference's published nccl_test numbers, examples/nccl_test.yaml
-    :6-14).  On the 1-chip bench host this degenerates to an HBM
-    round-trip; on a pod slice the same code measures ICI (see
-    examples/allreduce_bench.yaml for the multi-host recipe).  Timing via
-    chained fori_loop iterations (see _time_chained)."""
+    :6-14).  Honest on one chip (VERDICT r2 weak #1): there is no
+    collective to measure with a single rank — the r2 fallback body was
+    algebraically identity, XLA folded the whole loop away, and the
+    recorded 2.7e8 GB/s was an artifact — so 1 rank now reports
+    `skipped`.  On a pod slice the same code measures ICI (see
+    examples/allreduce_bench.yaml for the multi-host recipe).  Timing
+    via chained fori_loop iterations (see _time_chained); result is
+    sanity-bounded against physics."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     n = len(jax.devices())
+    if n < 2:
+        return {'ranks': n,
+                'skipped': 'single chip: psum needs >1 device '
+                           '(run examples/allreduce_bench.yaml on a '
+                           'slice for the ICI number)'}
     payload_mb = 256 if jax.devices()[0].platform == 'tpu' else 8
     n_elem = payload_mb * (1 << 20) // 4
     mesh = Mesh(np.array(jax.devices()), ('x',))
-    x = jax.device_put(
-        jnp.ones((n, n_elem // n if n > 1 else n_elem), jnp.float32),
-        NamedSharding(mesh, P('x', None)) if n > 1 else None)
+    x = jax.device_put(jnp.ones((n, n_elem // n), jnp.float32),
+                       NamedSharding(mesh, P('x', None)))
     iters = 20
     rt = _roundtrip_baseline()
 
-    if n > 1:
-        from jax.experimental.shard_map import shard_map
-
-        def one(v):
-            return shard_map(lambda s: jax.lax.psum(s, 'x') / n,
+    def one(v):
+        return jax.shard_map(lambda s: jax.lax.psum(s, 'x') / n,
                              mesh=mesh, in_specs=P('x', None),
                              out_specs=P('x', None))(v)
-    else:
-        def one(v):
-            return (v + v) * 0.5   # 1 rank: payload read+write over HBM
 
     @jax.jit
     def run(v):
@@ -171,10 +209,17 @@ def bench_allreduce() -> dict:
     dt = _time_chained(run, x, iters, rt)
     bytes_total = x.size * 4
     algbw = bytes_total / dt / 1e9
-    busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
-    return {'ranks': n, 'payload_mb': payload_mb,
-            'algbw_gbps': round(algbw, 2), 'busbw_gbps': round(busbw, 2),
-            'time_ms': round(dt * 1e3, 3)}
+    busbw = algbw * (2 * (n - 1) / n)
+    out = {'ranks': n, 'payload_mb': payload_mb,
+           'algbw_gbps': round(algbw, 2), 'busbw_gbps': round(busbw, 2),
+           'time_ms': round(dt * 1e3, 3)}
+    # Physics guard: nothing on this hardware moves >10 TB/s of payload.
+    # A number beyond that means the compiler optimized the loop away
+    # (r2's bug) — flag it rather than publish it.
+    if algbw > 10_000:
+        out['suspect'] = ('exceeds physical bandwidth — loop likely '
+                          'folded; do not trust')
+    return out
 
 
 def bench_launch_latency() -> dict:
@@ -283,7 +328,17 @@ def main() -> None:
                   'params_b': round(n_params / 1e9, 3),
                   'llama8b': llama8b,
                   'allreduce': allreduce,
-                  'launch_latency': latency},
+                  'launch_latency': latency,
+                  # Method changes recorded alongside numbers so trends
+                  # stay interpretable (VERDICT r2 weak #7).
+                  'method_notes': (
+                      'r3: allreduce single-rank reports skipped (r2 '
+                      'number was an XLA fold artifact); 8B tok/s now '
+                      'extrapolated from the (1,2)-layer slope with a '
+                      'cross-check point; 8B mfu_pct counts matmul '
+                      'params only (embed excluded), '
+                      'mfu_all_params_pct kept for the old convention; '
+                      '1B headline metric + timing unchanged from r2')},
     }))
 
 
