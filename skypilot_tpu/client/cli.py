@@ -55,11 +55,16 @@ def _cmd_status(args) -> int:
     rows = []
     for r in records:
         age = time.time() - (r['launched_at'] or time.time())
+        status_str = r['status']
+        # Queued-provisioning detail (waiting-for-capacity / failure
+        # reason) rides in status_message.
+        if r.get('status_message'):
+            status_str = f'{status_str} ({r["status_message"]})'
         rows.append([
             r['name'],
             r.get('resources_str') or str(r['resources']),
             str(r['num_hosts']),
-            r['status'],
+            status_str,
             f'{age/3600:.1f}h',
         ])
     print(_fmt_table(rows, ['NAME', 'RESOURCES', 'HOSTS', 'STATUS', 'AGE']))

@@ -66,6 +66,17 @@ class ProvisionerError(SkyTpuError):
         self.retriable = retriable
 
 
+class ResourceNotFoundError(ProvisionerError):
+    """Cloud API 404: the named resource does not exist.  Distinct from
+    other ProvisionerErrors so callers can treat 'genuinely gone'
+    differently from transient/permission failures (e.g. queued-resource
+    polling must not classify a 500 as a deleted QR)."""
+
+    def __init__(self, message: str, **kwargs) -> None:
+        kwargs.setdefault('retriable', False)
+        super().__init__(message, **kwargs)
+
+
 class QuotaExceededError(ProvisionerError):
     """Cloud quota exhausted in a zone; blocklist the region."""
 

@@ -71,6 +71,18 @@ def _execute(task: task_lib.Task,
         handle: Optional[state.ClusterHandle] = None
         if Stage.PROVISION in stages:
             handle = backend.provision(task, cluster_name)
+            record = state.get_cluster(cluster_name)
+            if record is not None and \
+                    record['status'] == state.ClusterStatus.QUEUED:
+                # DWS-style queued provisioning: no instances exist yet,
+                # so every later stage would fail.  launch returns now;
+                # once status refresh promotes the cluster to UP, run
+                # the task with `skytpu exec`.
+                logger.info(
+                    f'Cluster {cluster_name!r} is QUEUED for capacity; '
+                    f'returning. Track it with `skytpu status`; run the '
+                    f'task with `skytpu exec` once it is UP.')
+                return None, handle
         else:
             record = state.get_cluster(cluster_name)
             if record is None:

@@ -57,3 +57,7 @@ start_instances = _dispatch('start_instances')
 get_cluster_info = _dispatch('get_cluster_info')
 wait_instances = _dispatch('wait_instances')
 query_instances = _dispatch('query_instances')
+# DWS-style queued provisioning (gcp queuedResources): per-slice QR
+# states for a QUEUED cluster, and terminal-failure cleanup.
+query_queued = _dispatch('query_queued')
+reap_queued = _dispatch('reap_queued')

@@ -75,6 +75,11 @@ class ProvisionRecord:
     head_instance_id: str
     created_instance_ids: List[str]
     resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    # DWS-style queueing: the capacity request is parked in the cloud's
+    # queue; no instances exist yet.  The provisioner must NOT wait for
+    # SSH/runtime — the cluster enters ClusterStatus.QUEUED and the
+    # status-refresh path completes provisioning when capacity arrives.
+    queued: bool = False
 
     def is_instance_just_booted(self, instance_id: str) -> bool:
         return (instance_id in self.created_instance_ids or

@@ -62,7 +62,7 @@ class TpuApiClient:
                 'out of capacity' in lowered or 'not enough resources' in lowered:
             raise exceptions.CapacityError(message)
         if resp.status_code == 404:
-            raise exceptions.ProvisionerError(message, retriable=False)
+            raise exceptions.ResourceNotFoundError(message)
         if resp.status_code in (401, 403):
             raise exceptions.ProvisionerError(
                 f'Permission error from TPU API: {message}', retriable=False)

@@ -45,6 +45,10 @@ class ProvisionOutcome:
     handle: ClusterHandle
     region: str
     zone: Optional[str]
+    # DWS-style queueing: the capacity request is parked in the cloud's
+    # queue; handle has no instances yet and the caller must record the
+    # cluster as QUEUED instead of running setup/exec.
+    queued: bool = False
 
 
 def _make_runners(cluster_info: provision_common.ClusterInfo
@@ -221,13 +225,19 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
     return agent_port
 
 
-def _provision_one_zone(cloud_obj: cloud_lib.Cloud,
-                        cluster_name: str, region: str,
-                        config: dict) -> provision_common.ClusterInfo:
+def _provision_one_zone(
+        cloud_obj: cloud_lib.Cloud, cluster_name: str, region: str,
+        config: dict) -> Optional[provision_common.ClusterInfo]:
+    """Returns the ClusterInfo, or None when the capacity request was
+    parked in the cloud's queue (record.queued) — no instances exist to
+    wait for; the caller records QUEUED and returns."""
     cloud = cloud_obj.name
     config = provision_api.bootstrap_instances(cloud, region, cluster_name,
                                                config)
-    provision_api.run_instances(cloud, region, cluster_name, config)
+    record = provision_api.run_instances(cloud, region, cluster_name,
+                                         config)
+    if getattr(record, 'queued', False):
+        return None
     provision_api.wait_instances(cloud, region, cluster_name, 'running',
                                  provider_config=config)
     return provision_api.get_cluster_info(cloud, region, cluster_name,
@@ -271,6 +281,29 @@ def provision_with_failover(
                             f'({to_provision}) in {region}/{zone}...')
                 cluster_info = _provision_one_zone(
                     cloud_obj, cluster_name, region, config)
+                if cluster_info is None:
+                    # Parked in the cloud's capacity queue: hand back a
+                    # QUEUED outcome (no instances, no runtime).  The
+                    # provider config rides in the handle so the
+                    # status-refresh path can poll + complete later.
+                    queued_info = provision_common.ClusterInfo(
+                        cluster_name=cluster_name,
+                        cloud=cloud_obj.name, region=region, zone=zone,
+                        instances=[], provider_config=config)
+                    handle = ClusterHandle(
+                        cluster_name=cluster_name,
+                        launched_resources=to_provision.copy(
+                            region=region, zone=zone),
+                        cluster_info=queued_info,
+                        num_slices=to_provision.num_slices,
+                        agent_port=0)
+                    logger.info(
+                        f'Capacity request for {cluster_name!r} queued '
+                        f'in {region}/{zone}; launch returns now and '
+                        f'status refresh will complete provisioning '
+                        f'when capacity arrives.')
+                    return ProvisionOutcome(handle, region, zone,
+                                            queued=True)
                 agent_port = (AGENT_PORT_START if cloud_obj.name != 'local'
                               else common_utils.find_free_port(
                                   AGENT_PORT_START))
@@ -339,6 +372,26 @@ def restart(handle: ClusterHandle) -> ClusterHandle:
         info.cloud, info.region, handle.cluster_name, info.provider_config)
     handle.cluster_info = new_info
     handle.agent_port = _setup_runtime(new_info, handle.agent_port,
+                                       handle.cluster_name)
+    return handle
+
+
+def promote_queued(handle: ClusterHandle) -> ClusterHandle:
+    """Complete provisioning of a QUEUED cluster whose capacity has
+    arrived (all QRs ACTIVE): wait for the nodes, fetch ClusterInfo, run
+    runtime setup, and return the now-usable handle.  Called by the
+    status-refresh path (core._refresh_one), never by launch."""
+    info = handle.cluster_info
+    provision_api.wait_instances(info.cloud, info.region,
+                                 handle.cluster_name, 'running',
+                                 provider_config=info.provider_config)
+    new_info = provision_api.get_cluster_info(
+        info.cloud, info.region, handle.cluster_name,
+        info.provider_config)
+    handle.cluster_info = new_info
+    agent_port = (AGENT_PORT_START if info.cloud != 'local'
+                  else common_utils.find_free_port(AGENT_PORT_START))
+    handle.agent_port = _setup_runtime(new_info, agent_port,
                                        handle.cluster_name)
     return handle
 

@@ -473,6 +473,31 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
     from skypilot_tpu.workspaces import server as workspaces_server
     users_server.add_routes(app)
     workspaces_server.add_routes(app)
+
+    async def _status_refresh_daemon(app_):
+        """Periodic cluster-status reconciliation (reference:
+        sky/server/daemons.py:93).  This is what promotes QUEUED
+        clusters to UP when their queued capacity arrives — without it,
+        promotion only happens when a user runs `status -r`."""
+        import asyncio
+
+        from skypilot_tpu import core as core_lib
+        interval = float(os.environ.get(
+            'SKYTPU_STATUS_REFRESH_INTERVAL', '60'))
+
+        async def loop():
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    await asyncio.to_thread(core_lib.status, None, True)
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.warning(f'Status-refresh daemon: {e}')
+
+        task = asyncio.get_event_loop().create_task(loop())
+        yield
+        task.cancel()
+
+    app.cleanup_ctx.append(_status_refresh_daemon)
     return app
 
 

@@ -66,7 +66,9 @@ class ClusterHandle:
         self.launched_at = launched_at or time.time()
 
     @property
-    def head_ip(self) -> str:
+    def head_ip(self) -> Optional[str]:
+        if not self.cluster_info.instances:
+            return None   # QUEUED: no instances exist yet
         return self.cluster_info.head.external_ip or \
             self.cluster_info.head.internal_ip
 
@@ -138,10 +140,19 @@ def _migration_v1(conn: sqlite3.Connection) -> None:
         conn, 'storage', (('config_json', 'TEXT'),))
 
 
+def _migration_v2(conn: sqlite3.Connection) -> None:
+    """status_message column: queued-provisioning progress/failure detail
+    surfaced by `skytpu status` (round 3)."""
+    from skypilot_tpu.utils import db_utils
+    db_utils.add_columns_if_missing(
+        conn, 'clusters', (('status_message', 'TEXT'),))
+
+
 # Ordered, append-only (alembic-style linear history): NEVER reorder or
 # edit an entry that has shipped — append a new one.
 _MIGRATIONS = [
     _migration_v1,
+    _migration_v2,
 ]
 
 
@@ -183,10 +194,15 @@ def add_or_update_cluster(handle: ClusterHandle, status: ClusterStatus,
              workspace, user_hash))
 
 
-def set_cluster_status(name: str, status: ClusterStatus) -> None:
+def set_cluster_status(name: str, status: ClusterStatus,
+                       message: Optional[str] = None) -> None:
+    """message: human-readable detail shown by `skytpu status` (queued
+    progress, terminal QR failure).  Always overwritten — a stale
+    message from a previous state is worse than none."""
     with _conn() as conn:
-        conn.execute('UPDATE clusters SET status = ? WHERE name = ?',
-                     (status.value, name))
+        conn.execute(
+            'UPDATE clusters SET status = ?, status_message = ? '
+            'WHERE name = ?', (status.value, message, name))
 
 
 def get_cluster(name: str) -> Optional[Dict[str, Any]]:
@@ -209,6 +225,8 @@ def _row_to_record(row) -> Dict[str, Any]:
         'workspace': (row['workspace'] if 'workspace' in keys else
                       'default') or 'default',
         'user_hash': row['user_hash'] if 'user_hash' in keys else None,
+        'status_message': (row['status_message']
+                           if 'status_message' in keys else None),
     }
 
 

@@ -13,12 +13,23 @@ class ClusterStatus(enum.Enum):
     INIT = 'INIT'          # provisioning or in an unknown/partial state
     UP = 'UP'              # all hosts up, runtime healthy
     STOPPED = 'STOPPED'    # instances stopped (not possible for TPU pods)
+    # DWS-style queued provisioning: the capacity request is parked in
+    # the cloud's queue (GCP queuedResources); no instances exist yet.
+    # launch returns immediately and the status-refresh path promotes
+    # QUEUED -> UP when capacity arrives (reference posture:
+    # sky/server/daemons.py:93 async status reconciliation).
+    QUEUED = 'QUEUED'
+    # Queued provisioning failed terminally (QR FAILED/expired); the
+    # record persists so the error is surfaced until `down`.
+    FAILED = 'FAILED'
 
     def colored_str(self) -> str:
         color = {
             ClusterStatus.INIT: '\x1b[33m',     # yellow
             ClusterStatus.UP: '\x1b[32m',       # green
             ClusterStatus.STOPPED: '\x1b[90m',  # gray
+            ClusterStatus.QUEUED: '\x1b[36m',   # cyan
+            ClusterStatus.FAILED: '\x1b[31m',   # red
         }[self]
         return f'{color}{self.value}\x1b[0m'
 
