@@ -25,7 +25,13 @@ from skypilot_tpu.utils.status_lib import JobStatus
 # a channel each time would double request count and latency.  grpc
 # channels are thread-safe and shared; a value of None means "this agent
 # serves HTTP only" and is also cached.
-_TRANSPORT_CACHE: Dict[str, Optional['object']] = {}
+# base_url -> (client-or-None, cached_at).  A None from an OP failure
+# carries its timestamp so the downgrade to HTTP expires after
+# _GRPC_RETRY_COOLDOWN_S and the handshake re-probes — one transient
+# error (agent restart, dropped connection) must not pin every future
+# client of that agent to HTTP for the life of the process (ADVICE r2).
+_TRANSPORT_CACHE: Dict[str, tuple] = {}
+_GRPC_RETRY_COOLDOWN_S = 60.0
 
 
 class AgentClient:
@@ -48,9 +54,14 @@ class AgentClient:
         if self._grpc_checked or not self._prefer_grpc:
             return self._grpc
         self._grpc_checked = True
-        if self.base_url in _TRANSPORT_CACHE:
-            self._grpc = _TRANSPORT_CACHE[self.base_url]
-            return self._grpc
+        cached = _TRANSPORT_CACHE.get(self.base_url)
+        if cached is not None:
+            client, cached_at = cached
+            if client is not None or \
+                    time.time() - cached_at < _GRPC_RETRY_COOLDOWN_S:
+                self._grpc = client
+                return self._grpc
+            # Downgrade expired: fall through and re-probe the handshake.
         try:
             info = self.health()
             grpc_port = info.get('grpc_port')
@@ -59,16 +70,42 @@ class AgentClient:
                 host = self.base_url.split('://', 1)[-1].rsplit(':', 1)[0]
                 self._grpc = GrpcAgentClient(host, int(grpc_port),
                                              timeout=self.timeout)
-            _TRANSPORT_CACHE[self.base_url] = self._grpc
+                _TRANSPORT_CACHE[self.base_url] = (self._grpc, time.time())
+            else:
+                # Handshake-level absence (old agent / no gRPC): a
+                # durable fact, but still timestamped so an agent
+                # upgrade is eventually noticed.
+                self._grpc = None
+                _TRANSPORT_CACHE[self.base_url] = (None, time.time())
         except Exception:  # pylint: disable=broad-except
-            self._grpc = None   # transient: leave the cache unset
+            self._grpc = None
+            if cached is not None:
+                # Failed RE-probe of an expired downgrade: refresh the
+                # timestamp so the next clients wait out a fresh
+                # cooldown instead of each paying a (possibly
+                # 30s-timeout) health() probe while the agent is down.
+                _TRANSPORT_CACHE[self.base_url] = (None, time.time())
+            # else: first-ever probe failed — leave unset so the next
+            # client retries immediately (pre-cooldown behavior).
         return self._grpc
 
     def _drop_grpc(self) -> None:
-        """A gRPC op failed: this client AND future clients of the same
-        agent go to HTTP (the cached channel would fail for them too)."""
+        """A gRPC op failed: this client AND near-future clients of the
+        same agent go to HTTP (the cached channel would fail for them
+        too) — but only until the cooldown expires and the handshake
+        re-probes.  The dead channel is closed, not just dereferenced:
+        grpc channels hold sockets/threads that GC does not reliably
+        release, and the cooldown cycle would otherwise leak one per
+        recovery in a long-lived server."""
+        dead = self._grpc
         self._grpc = None
-        _TRANSPORT_CACHE[self.base_url] = None
+        _TRANSPORT_CACHE[self.base_url] = (None, time.time())
+        close = getattr(dead, 'close', None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # pylint: disable=broad-except
+                pass
 
     def _try_grpc(self, method: str, *args, **kwargs):
         """Run an op over gRPC when available; (ok, result).  Failure

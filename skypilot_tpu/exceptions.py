@@ -77,6 +77,17 @@ class ResourceNotFoundError(ProvisionerError):
         super().__init__(message, **kwargs)
 
 
+class CloudPermissionError(ProvisionerError):
+    """Cloud API 401/403 (missing IAM permission, disabled API, bad
+    credentials).  Typed so guards can key on the class — GCP's bodies
+    say 'Forbidden' / 'Access Not Configured' / 'has not been used', so
+    substring-matching 'permission' misses most of them."""
+
+    def __init__(self, message: str, **kwargs) -> None:
+        kwargs.setdefault('retriable', False)
+        super().__init__(message, **kwargs)
+
+
 class QuotaExceededError(ProvisionerError):
     """Cloud quota exhausted in a zone; blocklist the region."""
 

@@ -77,26 +77,53 @@ def _fill_in_launchable_resources(
 def _candidates_for_task(
         task: task_lib.Task,
         blocked_resources: Optional[List[resources_lib.Resources]],
+        minimize: 'OptimizeTarget' = None,
 ) -> List[resources_lib.Resources]:
-    """The DP's candidate set for one task.  `ordered:` resource lists are
-    a strict preference: only the first intent with any candidate
-    contributes; `any_of`/single contribute the cheapest K overall."""
+    """The DP's candidate set for one task.  `ordered:` resource lists
+    are a strict preference: only the first intent with any candidate
+    contributes.  Otherwise the kept set is top-K under the PRICE
+    ordering plus — when minimizing TIME — top-K under the
+    estimated-runtime ordering (ADVICE r2: a price-only cut could never
+    keep a faster-but-pricier offering, silently degrading the DP's
+    'exact over the kept set' claim for the TIME target)."""
     mapping = _fill_in_launchable_resources(task, blocked_resources)
+
+    def keep_top_k(cands: List[resources_lib.Resources]
+                   ) -> List[resources_lib.Resources]:
+        by_price = sorted(cands, key=lambda r: (
+            r.price_per_hour if r.price_per_hour is not None else 1e18))
+        kept = by_price[:_MAX_CANDIDATES_PER_TASK]
+        if minimize is OptimizeTarget.TIME:
+            by_time = sorted(cands,
+                             key=lambda r: task.estimate_runtime_hours(r))
+            for cand in by_time[:_MAX_CANDIDATES_PER_TASK]:
+                if not any(cand == k for k in kept):
+                    kept.append(cand)
+        if len(cands) > len(kept):
+            logger.debug(
+                f'Optimizer pruned {len(cands) - len(kept)} of '
+                f'{len(cands)} candidates for task {task.name!r} '
+                f'(kept top-{_MAX_CANDIDATES_PER_TASK} by price'
+                + (' and by estimated time'
+                   if minimize is OptimizeTarget.TIME else '') + ').')
+        return kept
+
     if task.resources_ordered:
         for intent in task.resources:
             if mapping.get(intent):
-                return mapping[intent][:_MAX_CANDIDATES_PER_TASK]
+                # Same dual-ordering keep as the merged path: the
+                # winning intent may have >K offerings and the fastest
+                # must survive a TIME-target cut.
+                return keep_top_k(mapping[intent])
         raise exceptions.ResourcesUnavailableError(
             f'No launchable resources for task {task.name!r}.')
     merged: List[resources_lib.Resources] = []
     for cands in mapping.values():
         merged.extend(cands)
-    merged.sort(key=lambda r: (r.price_per_hour
-                               if r.price_per_hour is not None else 1e18))
     if not merged:
         raise exceptions.ResourcesUnavailableError(
             f'No launchable resources for task {task.name!r}.')
-    return merged[:_MAX_CANDIDATES_PER_TASK]
+    return keep_top_k(merged)
 
 
 def _estimate_cost_per_hour(task: task_lib.Task,
@@ -148,7 +175,8 @@ class Optimizer:
                 'Only chain DAGs are supported (mirrors the reference: '
                 'Dag.is_chain gating in sky/optimizer.py).')
         tasks = list(dag.topological_order())
-        cand_lists = [_candidates_for_task(t, blocked_resources)
+        cand_lists = [_candidates_for_task(t, blocked_resources,
+                                           minimize=minimize)
                       for t in tasks]
 
         # Exact DP over the chain: state = (task index, candidate index);

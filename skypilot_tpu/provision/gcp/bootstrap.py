@@ -49,13 +49,16 @@ def _permission_guard(action: str, permission: str):
             return self
 
         def __exit__(self, exc_type, exc, tb):
-            if (isinstance(exc, exceptions.ProvisionerError)
-                    and 'permission' in str(exc).lower()):
-                raise exceptions.ProvisionerError(
+            # Keyed on the TYPED 401/403 error (ADVICE r2): GCP bodies
+            # say 'Forbidden' / 'Access Not Configured' / 'has not been
+            # used', so substring-matching 'permission' missed most of
+            # them and the actionable message never fired.
+            if isinstance(exc, exceptions.CloudPermissionError):
+                raise exceptions.CloudPermissionError(
                     f'{action} failed: the active credentials lack the '
                     f'`{permission}` IAM permission. Grant it (e.g. role '
                     f'roles/compute.instanceAdmin.v1) to the account and '
-                    f'retry.', retriable=False) from exc
+                    f'retry. ({exc})') from exc
             return False
     return _Guard()
 

@@ -173,7 +173,6 @@ class ComputeApiClient(tpu_api.TpuApiClient):
         if code in _QUOTA_CODES or 'quota' in message.lower():
             raise exceptions.QuotaExceededError(f'{code}: {message}')
         if code in ('PERMISSIONS_ERROR', 'FORBIDDEN'):
-            raise exceptions.ProvisionerError(
-                f'Permission error from GCE: {code}: {message}',
-                retriable=False)
+            raise exceptions.CloudPermissionError(
+                f'Permission error from GCE: {code}: {message}')
         raise exceptions.ProvisionerError(f'{code}: {message}')

@@ -64,8 +64,8 @@ class TpuApiClient:
         if resp.status_code == 404:
             raise exceptions.ResourceNotFoundError(message)
         if resp.status_code in (401, 403):
-            raise exceptions.ProvisionerError(
-                f'Permission error from TPU API: {message}', retriable=False)
+            raise exceptions.CloudPermissionError(
+                f'Permission error from TPU API: {message}')
         raise exceptions.ProvisionerError(message)
 
     # ---- node CRUD -------------------------------------------------------

@@ -85,9 +85,11 @@ class FakeComputeApi:
     # -- global (bootstrap) ----------------------------------------------
     def _check_permission(self, permission):
         if permission in self.deny_permissions:
-            raise exceptions.ProvisionerError(
-                f'Permission denied: required permission {permission}',
-                retriable=False)
+            # What a real 403 produces (tpu_api._raise_typed): the TYPED
+            # error, with a GCP-style body that does NOT contain the
+            # word 'permission' — the guard must key on the class.
+            raise exceptions.CloudPermissionError(
+                f'Forbidden: Access Not Configured ({permission})')
 
     def get_network(self, name):
         self._check_permission('compute.networks.get')
@@ -272,9 +274,13 @@ def test_bootstrap_idempotent_second_call_cached(fake_compute):
 
 
 def test_bootstrap_no_permission_names_permission(fake_compute):
+    """A 'Forbidden'/'Access Not Configured' 403 (no 'permission'
+    substring) must still get the name-the-IAM-permission rewrite
+    (ADVICE r2: the guard keys on the typed 401/403 class)."""
     fake_compute['deny'] = {'compute.firewalls.create'}
-    with pytest.raises(exceptions.ProvisionerError) as exc:
+    with pytest.raises(exceptions.CloudPermissionError) as exc:
         gcp_bootstrap.bootstrap_instances('us-central1', 'c', _config())
+    assert 'IAM permission' in str(exc.value)
     assert 'compute.firewalls.create' in str(exc.value)
     assert not exc.value.retriable
 

@@ -161,10 +161,27 @@ def test_client_prefers_grpc_and_falls_back(live_agent):
     client._grpc = Dead()
     jobs = client.queue(all_jobs=True)
     assert any(j['job_id'] == job_id for j in jobs)
-    assert client._grpc is None   # dropped to HTTP permanently
+    assert client._grpc is None   # dropped to HTTP for now
     # Streamed logs work over the (now-HTTP) transport too.
     text = ''.join(client.tail_logs(job_id, follow=False))
     assert 'via-grpc' in text
+
+    # ADVICE r2: the downgrade must EXPIRE — one transient gRPC failure
+    # must not pin every future client of this agent to HTTP for the
+    # life of the process.  A fresh client during the cooldown stays on
+    # HTTP; after the cooldown it re-probes the handshake and gets gRPC
+    # back.
+    from skypilot_tpu.agent import client as client_mod
+    fresh = client_mod.AgentClient(client.base_url)
+    assert fresh._grpc_client() is None      # within cooldown
+    cached, cached_at = client_mod._TRANSPORT_CACHE[client.base_url]
+    assert cached is None
+    client_mod._TRANSPORT_CACHE[client.base_url] = (
+        None, cached_at - client_mod._GRPC_RETRY_COOLDOWN_S - 1)
+    recovered = client_mod.AgentClient(client.base_url)
+    assert recovered._grpc_client() is not None   # re-probed, gRPC back
+    jobs = recovered.queue(all_jobs=True)
+    assert any(j['job_id'] == job_id for j in jobs)
 
 
 def test_version_gate_no_grpc_advertised(tmp_path):

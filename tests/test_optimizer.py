@@ -1,3 +1,5 @@
+import contextlib
+
 import pytest
 
 from skypilot_tpu import Dag, Resources, Task
@@ -143,16 +145,27 @@ def _fake_cloud(name, price, egress_per_gb):
     return name
 
 
-@pytest.fixture()
-def two_fake_clouds():
+@contextlib.contextmanager
+def _only_fake_clouds(*specs):
+    """Swap the registry for just the given (name, price, egress) fakes
+    so the DP is deterministic; always restores the real registry."""
     from skypilot_tpu.utils.registry import CLOUD_REGISTRY
     saved = dict(CLOUD_REGISTRY._registry)
-    CLOUD_REGISTRY._registry.clear()   # only the fakes: deterministic DP
-    _fake_cloud('cheapsrc', price=1.0, egress_per_gb=0.5)
-    _fake_cloud('stickydst', price=2.0, egress_per_gb=0.0)
-    yield
     CLOUD_REGISTRY._registry.clear()
-    CLOUD_REGISTRY._registry.update(saved)
+    try:
+        for name, price, egress in specs:
+            _fake_cloud(name, price=price, egress_per_gb=egress)
+        yield
+    finally:
+        CLOUD_REGISTRY._registry.clear()
+        CLOUD_REGISTRY._registry.update(saved)
+
+
+@pytest.fixture()
+def two_fake_clouds():
+    with _only_fake_clouds(('cheapsrc', 1.0, 0.5),
+                           ('stickydst', 2.0, 0.0)):
+        yield
 
 
 def _chain(two_sizes_gb):
@@ -205,3 +218,47 @@ def test_time_target_uses_runtime_estimator(two_fake_clouds):
     assert t2.best_resources.cloud == 'cheapsrc'
     Optimizer.optimize(dag2, minimize=OptimizeTarget.TIME, quiet=True)
     assert t2.best_resources.cloud == 'stickydst'  # TIME: 1h < 1.5h
+
+
+def test_time_target_keeps_fast_but_pricey_candidate():
+    """ADVICE r2: with >K candidates, a price-only prune could never
+    keep a faster-but-pricier offering — the TIME target must keep
+    top-K under BOTH orderings."""
+    from skypilot_tpu.optimizer import OptimizeTarget, _MAX_CANDIDATES_PER_TASK
+    n = _MAX_CANDIDATES_PER_TASK + 4
+    fast = f'c{n - 1}'               # priciest — pruned by price-only cut
+    with _only_fake_clouds(*((f'c{i}', 1.0 + i, 0.0) for i in range(n))):
+        t = Task(name='t', run='x')
+        t.set_resources(Resources())
+        t.set_time_estimator(
+            lambda res, fast=fast: 0.5 if res.cloud == fast else 2.0)
+        dag = Dag()
+        dag.add(t)
+        Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+        assert t.best_resources.cloud == fast
+        # COST still picks the cheapest.
+        t2 = Task(name='t2', run='x')
+        t2.set_resources(Resources())
+        dag2 = Dag()
+        dag2.add(t2)
+        Optimizer.optimize(dag2, quiet=True)
+        assert t2.best_resources.cloud == 'c0'
+
+
+def test_time_target_ordered_intent_keeps_fast_candidate():
+    """The ordered: path must apply the same dual-ordering keep — the
+    winning intent can have >K offerings with the fastest outside the
+    cheapest K."""
+    from skypilot_tpu.optimizer import OptimizeTarget, _MAX_CANDIDATES_PER_TASK
+    n = _MAX_CANDIDATES_PER_TASK + 4
+    fast = f'c{n - 1}'
+    with _only_fake_clouds(*((f'c{i}', 1.0 + i, 0.0) for i in range(n))):
+        t = Task(name='t', run='x')
+        # Single ordered intent feasible on every fake cloud.
+        t.set_resources([Resources()], ordered=True)
+        t.set_time_estimator(
+            lambda res, fast=fast: 0.5 if res.cloud == fast else 2.0)
+        dag = Dag()
+        dag.add(t)
+        Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+        assert t.best_resources.cloud == fast
