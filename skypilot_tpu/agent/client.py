@@ -99,7 +99,13 @@ class AgentClient:
         recovery in a long-lived server."""
         dead = self._grpc
         self._grpc = None
-        _TRANSPORT_CACHE[self.base_url] = (None, time.time())
+        cached = _TRANSPORT_CACHE.get(self.base_url)
+        # Only clobber the cache if it still holds the client WE saw
+        # fail: a stale long-lived client's dead channel must not re-pin
+        # everyone to HTTP after a fresh re-probe already cached a live
+        # channel.
+        if cached is None or cached[0] is dead or cached[0] is None:
+            _TRANSPORT_CACHE[self.base_url] = (None, time.time())
         close = getattr(dead, 'close', None)
         if close is not None:
             try:

@@ -57,6 +57,10 @@ _DEFAULTS: Dict[str, Any] = {
     'serve': {'controller': {'resources': {'cpus': '4+'}}},
     'logs': {'store': None},
     'api_server': {'endpoint': None},
+    # State-DB engine (reference: global_user_state.py:54-81): None →
+    # per-module sqlite files; a postgresql:// URI routes cluster/user/
+    # jobs state to a shared server for multi-user API deployments.
+    'db': {'connection_string': None},
     'usage': {'disabled': True},
 }
 

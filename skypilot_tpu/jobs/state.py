@@ -82,22 +82,24 @@ class JobsTable:
 
     def __init__(self, db_path: str = '~/.skypilot_tpu/managed_jobs.db'
                  ) -> None:
-        self.db_path = os.path.expanduser(db_path)
-        os.makedirs(os.path.dirname(self.db_path), exist_ok=True)
+        from skypilot_tpu.utils import db_engine
+        self.db_path = db_path
+        key = db_engine.state_key(db_path)
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
-            if self.db_path not in _MIGRATED:
+            if key not in _MIGRATED:
                 from skypilot_tpu.utils import db_utils
                 db_utils.add_columns_if_missing(
                     conn, 'managed_jobs', (('user_hash', 'TEXT'),
                                            ('pool', 'TEXT')))
-                _MIGRATED.add(self.db_path)
+                _MIGRATED.add(key)
 
-    def _conn(self) -> sqlite3.Connection:
-        conn = sqlite3.connect(self.db_path, timeout=30)
-        conn.execute('PRAGMA journal_mode=WAL')
-        conn.row_factory = sqlite3.Row
-        return conn
+    def _conn(self):
+        """Engine-selected (utils/db_engine.py): the jobs controller's
+        sqlite file by default, shared Postgres when configured
+        (reference: sky/jobs/state.py SQLite/SQLAlchemy duality)."""
+        from skypilot_tpu.utils import db_engine
+        return db_engine.connect(self.db_path)
 
     def submit(self, name: Optional[str], task_config: Dict[str, Any],
                recovery_strategy: str = 'failover',

@@ -114,14 +114,14 @@ class ClusterHandle:
                 f'{self.launched_resources}, hosts={self.num_hosts})')
 
 
-def _conn() -> sqlite3.Connection:
-    path = os.path.expanduser(_DB_PATH)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=30)
-    conn.execute('PRAGMA journal_mode=WAL')
-    conn.row_factory = sqlite3.Row
+def _conn():
+    """Engine-selected connection (utils/db_engine.py): sqlite file by
+    default, Postgres when SKYTPU_DB_CONNECTION_URI / db.connection_string
+    is set (reference: global_user_state.py:54-81 engine selection)."""
+    from skypilot_tpu.utils import db_engine
+    conn = db_engine.connect(_DB_PATH)
     conn.executescript(_SCHEMA)
-    _migrate(conn, path)
+    _migrate(conn, db_engine.state_key(_DB_PATH))
     return conn
 
 

@@ -47,12 +47,12 @@ CREATE TABLE IF NOT EXISTS tokens (
 """
 
 
-def _conn() -> sqlite3.Connection:
-    path = os.path.expanduser(_DB_PATH)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=30)
-    conn.execute('PRAGMA journal_mode=WAL')
-    conn.row_factory = sqlite3.Row
+def _conn():
+    """Engine-selected connection (utils/db_engine.py): sqlite file by
+    default, Postgres when a connection string is configured — user/RBAC
+    state is what a multi-user API server shares first."""
+    from skypilot_tpu.utils import db_engine
+    conn = db_engine.connect(_DB_PATH)
     conn.executescript(_SCHEMA)
     return conn
 

@@ -1,0 +1,212 @@
+"""Pluggable state-DB engine: stdlib sqlite3 (default) or Postgres.
+
+Reference parity: sky/global_user_state.py:54-81 — the reference selects
+a SQLAlchemy engine from a connection string so a multi-user API server
+deployment can point cluster/user/jobs state at Postgres.  SQLAlchemy is
+not bundled in this image, so the seam here is a thin translation layer
+over the SQL subset the state modules actually use:
+
+- placeholder style: sqlite `?`  →  postgres `%s`
+- `PRAGMA table_info(t)`        →  information_schema.columns query
+  (keeps utils/db_utils.add_columns_if_missing portable)
+- `INTEGER PRIMARY KEY AUTOINCREMENT` → `BIGSERIAL PRIMARY KEY`
+- `cursor.lastrowid`            →  `SELECT lastval()`
+- sqlite PRAGMAs are dropped
+
+Selection: the `SKYTPU_DB_CONNECTION_URI` env var or the
+`db.connection_string` config key (e.g. ``postgresql://user:pw@host/db``).
+Unset → per-module sqlite files under ~/.skypilot_tpu (single-user
+default).  With Postgres, all modules share one database; each keeps its
+own tables and migration-version table.
+
+The psycopg2 driver is imported lazily and its absence is an actionable
+error — this sandbox has no driver, so the Postgres path is exercised by
+the same test suite only where a server is available
+(tests/test_db_engine.py skips otherwise), exactly the reference's
+skip-if-unavailable posture.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sqlite3
+from typing import Any, Iterable, Optional, Sequence, Tuple
+
+from skypilot_tpu import exceptions
+
+ENV_VAR = 'SKYTPU_DB_CONNECTION_URI'
+
+
+def connection_string() -> Optional[str]:
+    uri = os.environ.get(ENV_VAR)
+    if uri:
+        return uri
+    from skypilot_tpu import config
+    return config.get_nested(('db', 'connection_string'), None)
+
+
+def connect(sqlite_path: str):
+    """A DB connection for a state module: Postgres when a connection
+    string is configured, else sqlite at `sqlite_path` (expanded).
+
+    Both returned objects support: execute(sql, params) -> cursor with
+    fetchone/fetchall/lastrowid, executescript(sql), context-manager
+    commit/rollback, close(), and row access by index AND column name."""
+    uri = connection_string()
+    if uri:
+        return PostgresConnection(uri)
+    path = os.path.expanduser(sqlite_path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.row_factory = sqlite3.Row
+    return conn
+
+
+def state_key(sqlite_path: str) -> str:
+    """Cache key for once-per-process work (migrations): the postgres
+    URI when configured, else the sqlite path."""
+    return connection_string() or os.path.expanduser(sqlite_path)
+
+
+class _Row:
+    """Tuple row + column-name access (the sqlite3.Row surface the state
+    modules rely on: row[0], row['name'], 'col' in row.keys())."""
+
+    __slots__ = ('_values', '_index')
+
+    def __init__(self, values: Sequence[Any], index: dict) -> None:
+        self._values = tuple(values)
+        self._index = index
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self._values[key]
+        return self._values[self._index[key]]
+
+    def keys(self):
+        return list(self._index)
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __len__(self):
+        return len(self._values)
+
+
+class _PgCursor:
+    def __init__(self, cursor) -> None:
+        self._cursor = cursor
+
+    def _index(self) -> dict:
+        desc = self._cursor.description or []
+        return {col[0]: i for i, col in enumerate(desc)}
+
+    def fetchone(self):
+        row = self._cursor.fetchone()
+        return None if row is None else _Row(row, self._index())
+
+    def fetchall(self):
+        index = self._index()
+        return [_Row(r, index) for r in self._cursor.fetchall()]
+
+    def __iter__(self):
+        return iter(self.fetchall())
+
+    @property
+    def lastrowid(self):
+        # Portable sqlite-cursor surface: the id of the row the last
+        # INSERT gave a sequence value (same-session lastval()).
+        inner = self._cursor.connection.cursor()
+        inner.execute('SELECT lastval()')
+        return inner.fetchone()[0]
+
+
+_PRAGMA_TABLE_INFO = re.compile(r'PRAGMA\s+table_info\(\s*(\w+)\s*\)',
+                                re.IGNORECASE)
+
+
+class PostgresConnection:
+    """psycopg2 connection with the sqlite3.Connection surface the state
+    modules use.  One network connection per instance; callers already
+    treat connections as cheap per-operation objects."""
+
+    def __init__(self, uri: str) -> None:
+        try:
+            import psycopg2  # type: ignore
+        except ImportError as e:
+            raise exceptions.SkyTpuError(
+                f'{ENV_VAR} / db.connection_string is set to a Postgres '
+                f'URI but the psycopg2 driver is not installed. Install '
+                f'psycopg2-binary on the API server, or unset the '
+                f'connection string to use the sqlite default.') from e
+        self._conn = psycopg2.connect(uri)
+
+    # -- translation -----------------------------------------------------
+    @staticmethod
+    def _translate(sql: str) -> str:
+        m = _PRAGMA_TABLE_INFO.search(sql)
+        if m:
+            # Shape-compatible with sqlite's table_info: column name at
+            # index 1 (db_utils.add_columns_if_missing reads r[1]).
+            # current_schema() filter: a same-named table in another
+            # schema of a shared server must not pollute the column set.
+            return ("SELECT ordinal_position, column_name FROM "
+                    "information_schema.columns WHERE table_name = "
+                    f"'{m.group(1).lower()}' "
+                    "AND table_schema = current_schema()")
+        if sql.lstrip().upper().startswith('PRAGMA'):
+            return 'SELECT 1 WHERE FALSE'   # other PRAGMAs: no-op
+        sql = sql.replace('INTEGER PRIMARY KEY AUTOINCREMENT',
+                          'BIGSERIAL PRIMARY KEY')
+        # sqlite REAL is 8-byte; PG real is float4, whose ~256s ulp at
+        # epoch magnitude would corrupt every stored timestamp.
+        sql = re.sub(r'\bREAL\b', 'DOUBLE PRECISION', sql)
+        stripped = sql.lstrip()
+        if stripped.upper().startswith('INSERT OR IGNORE'):
+            head = sql.index('INSERT OR IGNORE')
+            sql = (sql[:head] + 'INSERT' +
+                   sql[head + len('INSERT OR IGNORE'):] +
+                   ' ON CONFLICT DO NOTHING')
+        return sql.replace('?', '%s')
+
+    # -- sqlite3.Connection surface --------------------------------------
+    def execute(self, sql: str, params: Tuple = ()) -> _PgCursor:
+        cursor = self._conn.cursor()
+        cursor.execute(self._translate(sql), params or None)
+        return _PgCursor(cursor)
+
+    def executescript(self, script: str) -> None:
+        for statement in script.split(';'):
+            if statement.strip():
+                self.execute(statement)
+
+    def executemany(self, sql: str, seq: Iterable[Tuple]) -> None:
+        cursor = self._conn.cursor()
+        cursor.executemany(self._translate(sql), list(seq))
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def rollback(self) -> None:
+        self._conn.rollback()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __enter__(self) -> 'PostgresConnection':
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # Unlike sqlite3.Connection, ALSO close: every state-module call
+        # site is a one-shot `with _conn() as conn:` block, and leaving
+        # the TCP connection to GC timing would accumulate idle backend
+        # connections toward the server's max_connections.
+        try:
+            if exc_type is None:
+                self._conn.commit()
+            else:
+                self._conn.rollback()
+        finally:
+            self._conn.close()
+        return False

@@ -45,12 +45,29 @@ def add_columns_if_missing(conn: sqlite3.Connection, table: str,
     """Additive column migration, tolerant of cross-process races (two
     first-connections may both see the column missing; the loser's ALTER
     fails with 'duplicate column name' and is ignored)."""
+    # PRAGMA table_info is translated to an information_schema query on
+    # the Postgres engine (utils/db_engine.py); column name is index 1
+    # in both shapes.
     existing = {r[1] for r in conn.execute(f'PRAGMA table_info({table})')}
     for col, decl in columns:
         if col in existing:
             continue
+        # SAVEPOINT (supported by sqlite AND postgres) so a losing
+        # racer's failed ALTER can be rolled back WITHOUT aborting the
+        # surrounding transaction — on postgres a swallowed error would
+        # otherwise leave the tx in the aborted state and every later
+        # statement (the next column, the migration-version INSERT)
+        # raises InFailedSqlTransaction.
+        conn.execute('SAVEPOINT skytpu_add_col')
         try:
             conn.execute(f'ALTER TABLE {table} ADD COLUMN {col} {decl}')
-        except sqlite3.OperationalError as e:
-            if 'duplicate column name' not in str(e):
+            conn.execute('RELEASE SAVEPOINT skytpu_add_col')
+        except Exception as e:  # pylint: disable=broad-except
+            # sqlite says 'duplicate column name', postgres 'already
+            # exists' — both mean the cross-process race's loser.
+            msg = str(e).lower()
+            if 'duplicate column' not in msg and \
+                    'already exists' not in msg:
                 raise
+            conn.execute('ROLLBACK TO SAVEPOINT skytpu_add_col')
+            conn.execute('RELEASE SAVEPOINT skytpu_add_col')

@@ -152,13 +152,14 @@ def test_client_prefers_grpc_and_falls_back(live_agent):
     job_id = client.submit_job(_spec('echo via-grpc'))
     assert client._grpc is not None, 'should have used gRPC'
     assert client.wait_job(job_id, timeout=60) == JobStatus.SUCCEEDED
-    # Kill the channel: next op silently falls back to HTTP.
+    # Kill the channel IN PLACE (same object the transport cache holds —
+    # _drop_grpc only clears the cache when the failing client is the
+    # cached one): next op silently falls back to HTTP.
     client._grpc.close()
 
-    class Dead:
-        def queue(self, all_jobs):
-            raise RuntimeError('channel down')
-    client._grpc = Dead()
+    def _dead_queue(all_jobs):
+        raise RuntimeError('channel down')
+    client._grpc.queue = _dead_queue
     jobs = client.queue(all_jobs=True)
     assert any(j['job_id'] == job_id for j in jobs)
     assert client._grpc is None   # dropped to HTTP for now
