@@ -196,13 +196,16 @@ class AgentClient:
         return resp.json()['cancelled']
 
     def tail_logs(self, job_id: Optional[int] = None, rank: int = 0,
-                  follow: bool = True) -> Iterator[str]:
+                  follow: bool = True, offset: int = 0) -> Iterator[str]:
         # Streaming op: probe the transport once, then commit — swapping
         # transports mid-stream would replay the log from byte 0 and
         # duplicate everything already yielded.  HTTP fallback is only
         # allowed while NOTHING has been yielded; a mid-stream failure
         # re-raises to the consumer instead.
-        client = self._grpc_client()
+        # offset (bytes, agent v3): incremental pollers read only the
+        # delta; offset reads ride HTTP (the gRPC tail contract has no
+        # offset field).
+        client = self._grpc_client() if offset == 0 else None
         if client is not None:
             yielded = False
             try:
@@ -215,6 +218,8 @@ class AgentClient:
                 if yielded:
                     raise
         params: Dict[str, Any] = {'rank': rank, 'follow': int(follow)}
+        if offset:
+            params['offset'] = offset
         if job_id is not None:
             params['job_id'] = job_id
         with requests.get(self._url('/jobs/tail'), params=params,

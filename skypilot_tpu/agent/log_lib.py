@@ -38,8 +38,12 @@ def run_bash_command_with_log(cmd: str, log_path: str, *,
 
 def tail_logs(log_path: str, *, follow: bool = False,
               from_start: bool = True, stop_when: Optional[callable] = None,
-              poll_interval: float = 0.5) -> Iterator[str]:
-    """Yield log lines; with follow=True keep polling until stop_when()."""
+              poll_interval: float = 0.5, offset: int = 0) -> Iterator[str]:
+    """Yield log lines; with follow=True keep polling until stop_when().
+
+    offset: byte position to start reading from — incremental pollers
+    (the dashboard's live tail) read only the delta instead of refetching
+    the whole file every poll."""
     path = os.path.expanduser(log_path)
     # Wait for the file to appear (driver may not have started writing).
     deadline = time.time() + 30
@@ -48,7 +52,9 @@ def tail_logs(log_path: str, *, follow: bool = False,
             return
         time.sleep(poll_interval)
     with open(path, encoding='utf-8', errors='replace') as f:
-        if not from_start:
+        if offset:
+            f.seek(offset)
+        elif not from_start:
             f.seek(0, os.SEEK_END)
         while True:
             line = f.readline()

@@ -17,7 +17,7 @@ from typing import Any, Dict, Iterator, List, Optional
 from skypilot_tpu.agent import job_lib, log_lib
 from skypilot_tpu.utils.status_lib import JobStatus
 
-AGENT_VERSION = 2  # v2: gRPC transport alongside HTTP
+AGENT_VERSION = 3  # v2: gRPC transport alongside HTTP; v3: tail offset
 
 
 class AgentState:
@@ -96,7 +96,7 @@ class AgentOps:
         return self.state.job_table.get_latest_job_id()
 
     def tail_iter(self, job_id: Optional[int], rank: int,
-                  follow: bool) -> Iterator[str]:
+                  follow: bool, offset: int = 0) -> Iterator[str]:
         if job_id is None:
             job_id = self.latest_job_id()
         if job_id is None:
@@ -108,7 +108,8 @@ class AgentOps:
             st = self.state.job_table.get_status(job_id)
             return st is not None and st.is_terminal()
 
-        return log_lib.tail_logs(log_path, follow=follow, stop_when=_done)
+        return log_lib.tail_logs(log_path, follow=follow, stop_when=_done,
+                                 offset=offset)
 
     def set_autostop(self, idle_minutes: int, down: bool) -> None:
         with open(self.state.autostop_path, 'w', encoding='utf-8') as f:

@@ -89,11 +89,15 @@ def make_app(state: AgentState) -> web.Application:
         # Default matches the proto3 contract: follow=false → read the
         # current log and EOF.  Clients wanting a stream pass follow=1.
         follow = request.query.get('follow', '0') == '1'
+        # offset (bytes): incremental pollers read only the delta
+        # (agent v3; X-Log-Offset echoes support back to the caller).
+        offset = int(request.query.get('offset', 0))
         resp = web.StreamResponse(
-            headers={'Content-Type': 'text/plain; charset=utf-8'})
+            headers={'Content-Type': 'text/plain; charset=utf-8',
+                     'X-Log-Offset': str(offset)})
         await resp.prepare(request)
         loop = asyncio.get_running_loop()
-        it = ops.tail_iter(job_id, rank, follow)
+        it = ops.tail_iter(job_id, rank, follow, offset=offset)
         while True:
             line = await loop.run_in_executor(None,
                                               lambda: next(it, None))

@@ -102,3 +102,60 @@ class Kubernetes(cloud_lib.Cloud):
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         return _kubectl_reachable()
+
+    def check_diagnostics(self, credentials=None) -> list:
+        """`skytpu check -v` probes (reference: sky/check.py per-cloud
+        verbose diagnostics): kubectl client → API-server reachability →
+        create-pods RBAC in the target namespace → GKE TPU node pools
+        (informational)."""
+        out = []
+        ok, reason = (credentials if credentials is not None
+                      else self.check_credentials())
+        out.append(('kubectl', ok, reason or 'kubectl client available'))
+        if not ok:
+            return out
+
+        def _run(args, timeout=20):
+            # EVERY probe can hang on a flaky API server; a timeout must
+            # degrade to a failed probe, never crash the whole check.
+            try:
+                return subprocess.run(['kubectl'] + args,
+                                      capture_output=True,
+                                      timeout=timeout, check=False,
+                                      text=True)
+            except subprocess.TimeoutExpired:
+                return subprocess.CompletedProcess(
+                    ['kubectl'] + args, 124, '',
+                    f'timed out after {timeout}s — check the active '
+                    f'kubeconfig context')
+
+        proc = _run(['get', '--raw', '/version'])
+        if proc.returncode == 0:
+            out.append(('cluster', True, 'API server reachable'))
+        else:
+            out.append(('cluster', False,
+                        f'API server unreachable: '
+                        f'{proc.stderr.strip()[:200]}'))
+            return out
+        namespace = self._namespace()
+        proc = _run(['auth', 'can-i', 'create', 'pods',
+                     '-n', namespace])
+        allowed = proc.returncode == 0 and 'yes' in proc.stdout.lower()
+        out.append(('rbac', allowed,
+                    f'create pods in namespace {namespace!r}: '
+                    + ('allowed' if allowed else
+                       f'DENIED — grant a role with pods create/delete '
+                       f'({(proc.stderr or proc.stdout).strip()[:150]})')))
+        proc = _run(['get', 'nodes', '-l',
+                     'cloud.google.com/gke-tpu-accelerator',
+                     '-o', 'name'])
+        if proc.returncode == 0:
+            n = len([l for l in proc.stdout.splitlines() if l.strip()])
+            out.append(('tpu-nodes', True,
+                        f'{n} GKE TPU node(s) visible'
+                        + ('' if n else ' (CPU-only cluster)')))
+        else:
+            out.append(('tpu-nodes', False,
+                        f'node listing failed: '
+                        f'{proc.stderr.strip()[:150]}'))
+        return out

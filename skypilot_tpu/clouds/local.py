@@ -68,3 +68,26 @@ class Local(cloud_lib.Cloud):
 
     def check_credentials(self) -> Tuple[bool, Optional[str]]:
         return True, None
+
+    def check_diagnostics(self, credentials=None) -> list:
+        """`skytpu check -v` probes: python runtime (jax importable —
+        the compute stack) and local TPU chip visibility via the libtpu
+        device files.  Chip presence is read from /dev (no jax backend
+        init: that would grab the TPU runtime lease just to report a
+        count)."""
+        import glob
+        import importlib.util
+        out = []
+        has_jax = importlib.util.find_spec('jax') is not None
+        out.append(('runtime', has_jax,
+                    'jax importable' if has_jax else
+                    'jax not importable — local compute tasks will fail '
+                    'at import'))
+        chips = sorted(glob.glob('/dev/accel*')) or \
+            sorted(glob.glob('/dev/vfio/*'))
+        out.append(('tpu-chips', True,
+                    f'{len(chips)} local TPU device file(s) '
+                    f'({", ".join(chips[:4])})' if chips else
+                    '0 local TPU chips (CPU-only host; local cloud '
+                    'still runs CPU tasks)'))
+        return out

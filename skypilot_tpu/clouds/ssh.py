@@ -86,3 +86,48 @@ class Ssh(cloud_lib.Cloud):
             return False, ('No SSH node pools configured in '
                            '~/.skypilot_tpu/ssh_node_pools.yaml')
         return True, None
+
+    def check_diagnostics(self, credentials=None) -> list:
+        """`skytpu check -v` probes: pool config → per-host TCP liveness
+        on each host's ssh port (a dead/unroutable host is the common
+        BYO-pool failure, and a launch-time SSH timeout names no host).
+        Bounded to the first 16 hosts per pool (reference: sky/check.py
+        per-cloud verbose diagnostics)."""
+        import socket
+        out = []
+        ok, reason = (credentials if credentials is not None
+                      else self.check_credentials())
+        out.append(('pools', ok, reason or 'pool config found'))
+        if not ok:
+            return out
+        import concurrent.futures as cf
+        manager = SSHNodePoolManager()
+
+        def _probe(host):
+            try:
+                with socket.create_connection(
+                        (host['ip'], int(host['ssh_port'])),
+                        timeout=5):
+                    return None
+            except OSError as e:
+                return f'{host["ip"]}:{host["ssh_port"]} ({e})'
+
+        for pool_name in sorted(manager.get_all_pools()):
+            hosts = manager.pool_hosts(pool_name)
+            # Concurrent probes: 16 firewalled hosts probed serially
+            # would stall `check -v` for 80s per dead pool.
+            with cf.ThreadPoolExecutor(max_workers=16) as pool:
+                results = list(pool.map(_probe, hosts[:16]))
+            dead = [r for r in results if r is not None]
+            checked = min(len(hosts), 16)
+            if dead:
+                out.append((f'pool:{pool_name}', False,
+                            f'{len(dead)}/{checked} host(s) unreachable '
+                            f'on their ssh port: '
+                            + '; '.join(dead[:4])))
+            else:
+                suffix = (f' (first 16 of {len(hosts)})'
+                          if len(hosts) > 16 else '')
+                out.append((f'pool:{pool_name}', True,
+                            f'{checked} host(s) reachable{suffix}'))
+        return out

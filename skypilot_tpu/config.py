@@ -51,10 +51,16 @@ _DEFAULTS: Dict[str, Any] = {
         'locked_clouds': [],
     },
     'jobs': {
-        'controller': {'resources': {'cpus': '4+'}},
+        # controller.resources None → controllers run as LOCAL daemons;
+        # a user-set resources dict (e.g. {cloud: gcp, cpus: '4+'})
+        # switches to the dedicated-controller-cluster mode.  The
+        # default must stay None: a non-None default would silently
+        # force every jobs/serve call into remote mode (provisioning a
+        # controller cluster) on unconfigured installs.
+        'controller': {'resources': None},
         'max_parallel_launches': 4,
     },
-    'serve': {'controller': {'resources': {'cpus': '4+'}}},
+    'serve': {'controller': {'resources': None}},
     'logs': {'store': None},
     'api_server': {'endpoint': None},
     # State-DB engine (reference: global_user_state.py:54-81): None →

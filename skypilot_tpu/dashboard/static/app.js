@@ -112,6 +112,51 @@ async function actCancelClusterJob(cluster, jobId) {
   navigate();
 }
 
+// --- live log tail (chunked fetch stream; reference: dashboard live
+// log view over the stream endpoint) ------------------------------------
+
+let tailAbort = null;
+
+function stopLogTail(stateText) {
+  if (tailAbort) {
+    tailAbort.abort();
+    tailAbort = null;
+  }
+  const state = document.querySelector('#tail-state');
+  if (state && stateText) state.textContent = stateText;
+}
+
+async function startLogTail(cluster, jobId) {
+  stopLogTail();
+  const view = $('#logview');
+  if (!view) return;
+  tailAbort = new AbortController();
+  try {
+    const r = await fetch(
+        `/api/cluster_logs?cluster=${encodeURIComponent(cluster)}` +
+        `&job_id=${encodeURIComponent(jobId)}&follow=1`,
+        {signal: tailAbort.signal});
+    if (!r.ok) throw new Error(`logs: HTTP ${r.status}`);
+    const reader = r.body.getReader();
+    const decoder = new TextDecoder();
+    let first = true;
+    for (;;) {
+      const {done, value} = await reader.read();
+      if (done) break;
+      const chunk = decoder.decode(value, {stream: true});
+      if (first) { view.textContent = ''; first = false; }
+      view.textContent += chunk;
+      view.scrollTop = view.scrollHeight;
+    }
+    stopLogTail('finished');
+  } catch (e) {
+    if (e.name !== 'AbortError') {
+      view.textContent += `\n[stream error: ${e.message}]`;
+      stopLogTail('error');
+    }
+  }
+}
+
 async function saveConfig() {
   const text = document.querySelector('#config-editor').value;
   const status = document.querySelector('#config-status');
@@ -149,8 +194,13 @@ const PAGES = {
           rows.map((c) => [
             `<a class="mono" href="#cluster/${esc(c.name)}">` +
                 `${esc(c.name)}</a>`,
-            badge(c.status),
-            esc(c.infra || [c.cloud, c.region].filter(Boolean).join('/')),
+            // status_message: queued-provisioning progress / failure
+            // detail rides as a hover tooltip on the badge.
+            c.status_message
+                ? `<span title="${esc(c.status_message)}">` +
+                  `${badge(c.status)} ⓘ</span>`
+                : badge(c.status),
+            esc(c.infra || '-'),
             `<span class="mono">${esc(c.resources_str || '-')}</span>`,
             fmtCost(c.cost_per_hour),
             fmtTime(c.launched_at),
@@ -182,13 +232,15 @@ const PAGES = {
     title: 'Job logs',
     async render(arg) {
       const [cluster, jobId] = String(arg).split('/');
-      const r = await fetch(
-          `/api/cluster_logs?cluster=${encodeURIComponent(cluster)}` +
-          `&job_id=${encodeURIComponent(jobId)}`);
-      if (!r.ok) throw new Error(`logs: HTTP ${r.status}`);
-      const text = await r.text();
-      return `<h3 class="mono">${esc(cluster)} · job ${esc(jobId)}</h3>` +
-          `<pre class="logview">${esc(text) || '(empty log)'}</pre>`;
+      // Render the shell immediately; the live tail streams into it
+      // (chunked /api/cluster_logs?follow=1) until the job finishes,
+      // the user navigates away, or ⏸ stops it.
+      setTimeout(() => startLogTail(cluster, jobId), 0);
+      return `<h3 class="mono">${esc(cluster)} · job ${esc(jobId)} ` +
+          `<span id="tail-state" class="status info">live</span> ` +
+          '<button class="action" data-act="stop-tail">⏸ stop</button>' +
+          '</h3>' +
+          '<pre id="logview" class="logview">(waiting for log…)</pre>';
     },
   },
   jobs: {
@@ -317,6 +369,7 @@ const PAGES = {
 let currentPage = null;
 
 async function navigate() {
+  stopLogTail();   // leaving the logs page must end its stream
   const hash = (location.hash || '#clusters').slice(1);
   // Routes: 'page' or 'page/arg' (e.g. cluster/<name>, logs/<c>/<id>).
   const slash = hash.indexOf('/');
@@ -348,7 +401,7 @@ document.addEventListener('click', (ev) => {
   else if (act === 'cancel-job') actCancelJob(Number(job));
   else if (act === 'cancel-cluster-job') {
     actCancelClusterJob(name, Number(job));
-  }
+  } else if (act === 'stop-tail') stopLogTail('stopped');
 });
 
 async function showServerInfo() {

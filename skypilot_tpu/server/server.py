@@ -291,27 +291,85 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
 
     @routes.get('/api/cluster_logs')
     async def api_cluster_logs(request: web.Request) -> web.Response:
-        """One job's rank-0 log (non-follow), for the dashboard log view."""
+        """One job's rank-0 log for the dashboard log view.  With
+        follow=1, a chunked text stream that tails the job live until
+        it reaches a terminal state or the browser disconnects (the
+        dashboard's live-tail view; reference: dashboard log pages over
+        the stream endpoint)."""
         from skypilot_tpu import state as state_lib
         from skypilot_tpu.agent.client import AgentClient
         cluster = request.query.get('cluster', '')
         job_id = request.query.get('job_id')
         rank = int(request.query.get('rank', 0))
+        follow = request.query.get('follow') in ('1', 'true')
         record = state_lib.get_cluster(cluster)
         if record is None:
             return _json_error(404, f'No cluster {cluster!r}')
         handle = record['handle']
+        client = AgentClient(
+            f'http://{handle.head_ip}:{handle.agent_port}')
+        jid = int(job_id) if job_id else None
 
-        def _read() -> str:
-            client = AgentClient(
-                f'http://{handle.head_ip}:{handle.agent_port}')
-            return ''.join(client.tail_logs(
-                int(job_id) if job_id else None, rank=rank, follow=False))
+        if not follow:
+            def _read() -> str:
+                return ''.join(client.tail_logs(jid, rank=rank,
+                                                follow=False))
+            try:
+                text = await asyncio.to_thread(_read)
+            except Exception as e:  # pylint: disable=broad-except
+                return _json_error(502, f'Log fetch failed: {e}')
+            return web.Response(text=text, content_type='text/plain')
+
+        if jid is None:
+            # Follow needs a termination condition (job reaching a
+            # terminal state); without job_id the loop would poll
+            # forever.
+            return _json_error(400, 'follow=1 requires job_id')
+        resp = web.StreamResponse()
+        resp.content_type = 'text/plain'
+        await resp.prepare(request)
+        # Poll-based tail rather than the agent's blocking follow
+        # generator: each poll is a short non-follow read, so a browser
+        # disconnect cancels cleanly between polls — a thread stuck
+        # mid-iteration on a long job could not be interrupted.  Each
+        # poll reads only the byte delta past `pos` (agent v3 offset;
+        # refetching the whole log every second would be O(n²) over a
+        # long job's lifetime).
+        pos = 0
+
+        def _read_delta() -> str:
+            return ''.join(client.tail_logs(jid, rank=rank, follow=False,
+                                            offset=pos))
+
+        async def _emit_delta() -> None:
+            nonlocal pos
+            delta = await asyncio.to_thread(_read_delta)
+            if delta:
+                await resp.write(delta.encode())
+                pos += len(delta.encode())
+
         try:
-            text = await asyncio.to_thread(_read)
-        except Exception as e:  # pylint: disable=broad-except
-            return _json_error(502, f'Log fetch failed: {e}')
-        return web.Response(text=text, content_type='text/plain')
+            while True:
+                try:
+                    await _emit_delta()
+                except Exception as e:  # pylint: disable=broad-except
+                    await resp.write(
+                        f'\n[log stream error: {e}]\n'.encode())
+                    break
+                status = await asyncio.to_thread(client.job_status, jid)
+                if status is None or status.is_terminal():
+                    # One final drain: lines written between the last
+                    # read and the terminal transition must not vanish.
+                    try:
+                        await _emit_delta()
+                    except Exception:  # pylint: disable=broad-except
+                        pass
+                    break
+                await asyncio.sleep(1.0)
+        except (ConnectionResetError, asyncio.CancelledError):
+            return resp   # browser went away between polls
+        await resp.write_eof()
+        return resp
 
     @routes.get('/api/config')
     async def api_config_get(request: web.Request) -> web.Response:
