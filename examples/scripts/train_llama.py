@@ -11,6 +11,39 @@ import os
 from skypilot_tpu.utils import env_contract
 
 
+def text_batches(path: str, hf_model: str, batch: int, seq: int,
+                 vocab: int):
+    """Next-token batches from a plain-text corpus: tokenize once (HF
+    tokenizer when --hf-model names one, byte-level fallback), then
+    yield random contiguous windows forever — the simplest honest
+    finetune data path (the reference's lora.yaml delegates this to
+    torchtune's dataset config, llm/llama-3_1-finetuning/lora.yaml)."""
+    import numpy as np
+    with open(path, encoding='utf-8') as f:
+        text = f.read()
+    if not text.strip():
+        raise SystemExit(f'--data-file {path} is empty: nothing to '
+                         f'finetune on.')
+    ids = None
+    if hf_model:
+        try:
+            import transformers
+            tok = transformers.AutoTokenizer.from_pretrained(hf_model)
+            ids = np.asarray(tok(text)['input_ids'], np.int32)
+        except Exception:  # no tokenizer files: byte fallback below
+            ids = None
+    if ids is None:
+        ids = np.frombuffer(text.encode('utf-8'),
+                            np.uint8).astype(np.int32) % vocab
+    if len(ids) < seq + 2:
+        reps = (seq + 2) // max(len(ids), 1) + 1
+        ids = np.tile(ids, reps)
+    rng = np.random.default_rng(0)
+    while True:
+        starts = rng.integers(0, len(ids) - seq - 1, size=batch)
+        yield {'tokens': np.stack([ids[s:s + seq + 1] for s in starts])}
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model-size', default='1b',
@@ -31,6 +64,14 @@ def main() -> None:
                         help='HF checkpoint (hub name or local path) to '
                              'finetune from instead of random init; '
                              'overrides --model-size')
+    parser.add_argument('--data-file', default='',
+                        help='plain-text finetune corpus; tokenized with '
+                             'the --hf-model tokenizer when available, '
+                             'else bytes mod vocab. Default: synthetic '
+                             'batches (throughput benchmarking).')
+    parser.add_argument('--throttle-s', type=float, default=0.0,
+                        help='sleep between checkpoint chunks (demo '
+                             'pacing, e.g. to observe recovery)')
     args = parser.parse_args()
 
     env_contract.initialize_from_env()
@@ -87,18 +128,28 @@ def main() -> None:
                                   total_steps=args.steps))
 
     if args.resume == 'auto' and args.checkpoint_dir:
+        import re
         steps = []
         if os.path.isdir(args.checkpoint_dir):
             for d in os.listdir(args.checkpoint_dir):
-                if d.startswith('step_'):
-                    steps.append(int(d.split('_')[1]))
+                # Full match only: a preemption mid-save leaves Orbax
+                # temp dirs like 'step_6.orbax-checkpoint-tmp' behind,
+                # and parsing those would crash every recovery attempt.
+                m = re.fullmatch(r'step_(\d+)', d)
+                if m:
+                    steps.append(int(m.group(1)))
         if steps:
             trainer.restore_checkpoint(args.checkpoint_dir, max(steps))
             if jax.process_index() == 0:
                 print(f'resumed from step {trainer.step}')
 
     batch_size = args.batch_size or mesh_config.dp * mesh_config.fsdp
-    batches = synthetic_batches(batch_size, args.seq_len, config.vocab_size)
+    if args.data_file:
+        batches = text_batches(args.data_file, args.hf_model, batch_size,
+                               args.seq_len, config.vocab_size)
+    else:
+        batches = synthetic_batches(batch_size, args.seq_len,
+                                    config.vocab_size)
     tokens_per_batch = batch_size * args.seq_len
     while trainer.step < args.steps:
         chunk = min(args.checkpoint_every, args.steps - trainer.step)
@@ -106,6 +157,9 @@ def main() -> None:
                               tokens_per_batch=tokens_per_batch)
         if args.checkpoint_dir:
             trainer.save_checkpoint(args.checkpoint_dir)
+        if args.throttle_s:
+            import time
+            time.sleep(args.throttle_s)
     if jax.process_index() == 0:
         print(f"final: loss={summary['loss']:.4f} "
               f"tokens/sec={summary.get('tokens_per_sec', 0):.0f} "

@@ -67,8 +67,9 @@ def prefill(params: llama.Params, tokens: jax.Array,
     """
     batch, seq = tokens.shape
     max_len = cache['k'].shape[2]
-    cos, sin = rope_ops.rope_frequencies(config.head_dim, max_len,
-                                         config.rope_theta)
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, max_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
     h = params['embed'][tokens]
 
     attention_fn = functools.partial(attention_ops.flash_attention,
@@ -114,8 +115,9 @@ def decode_step(params: llama.Params, token: jax.Array,
     """
     batch = token.shape[0]
     max_len = cache['k'].shape[2]
-    cos, sin = rope_ops.rope_frequencies(config.head_dim, max_len,
-                                         config.rope_theta)
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, max_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
     h = params['embed'][token][:, None]            # (B, 1, d)
     pos = positions[:, None].astype(jnp.int32)      # (B, 1)
     # Attention mask over cache slots: slot j visible iff j <= pos.

@@ -36,11 +36,18 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
     """
     import dataclasses
     scaling = getattr(hf_config, 'rope_scaling', None)
+    rope_scaling = None
     if scaling and float(scaling.get('factor', 1.0)) != 1.0:
-        raise NotImplementedError(
-            f'rope_scaling={scaling!r} is not implemented in '
-            'skypilot_tpu.ops.rope (Llama-3.1+ checkpoints need it); '
-            'converting anyway would give wrong positions.')
+        rope_type = scaling.get('rope_type', scaling.get('type', ''))
+        if rope_type != 'llama3':
+            # Refusing beats converting to subtly wrong positions.
+            raise NotImplementedError(
+                f'rope_scaling type {rope_type!r} is not implemented in '
+                "skypilot_tpu.ops.rope (supported: 'llama3', the "
+                'Llama-3.1/3.2 scheme).')
+        rope_scaling = tuple(sorted(
+            (k, float(v) if isinstance(v, (int, float)) else v)
+            for k, v in scaling.items()))
     hf_head_dim = getattr(hf_config, 'head_dim', None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
     if hf_head_dim is not None and hf_head_dim != derived:
@@ -56,6 +63,7 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
         d_ff=hf_config.intermediate_size,
         max_seq_len=hf_config.max_position_embeddings,
         rope_theta=float(getattr(hf_config, 'rope_theta', 500000.0)),
+        rope_scaling=rope_scaling,
         norm_eps=float(hf_config.rms_norm_eps),
         dtype=dtype)
     return dataclasses.replace(cfg, **overrides) if overrides else cfg

@@ -42,6 +42,11 @@ class LlamaConfig:
     d_ff: int = 14336
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # HF-style rope_scaling dict (rope_type 'llama3' — Llama-3.1/3.2
+    # long-context frequency remap; ops/rope.py).  None = unscaled.
+    # Stored as a hashable tuple of items: the frozen config must stay
+    # usable anywhere jit treats it as a static value.
+    rope_scaling: Optional[tuple] = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -55,6 +60,10 @@ class LlamaConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def rope_scaling_dict(self) -> Optional[Dict[str, Any]]:
+        return dict(self.rope_scaling) if self.rope_scaling else None
 
     def num_params(self) -> int:
         d, ff, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
@@ -168,8 +177,9 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
         attention_fn = functools.partial(attention_ops.flash_attention,
                                          causal=True)
     seq_len = tokens.shape[1]
-    cos, sin = rope_ops.rope_frequencies(config.head_dim, seq_len,
-                                         config.rope_theta)
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, seq_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
     h = params['embed'][tokens]
 
     layer_fn = functools.partial(_layer, config=config, cos=cos, sin=sin,
@@ -210,8 +220,9 @@ def forward_pipelined(params: Params, tokens: jax.Array,
                                          causal=True)
     num_stages = mesh.shape['pp']
     seq_len = tokens.shape[1]
-    cos, sin = rope_ops.rope_frequencies(config.head_dim, seq_len,
-                                         config.rope_theta)
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, seq_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
     h = params['embed'][tokens]
 
     layer_fn = functools.partial(_layer, config=config, cos=cos, sin=sin,

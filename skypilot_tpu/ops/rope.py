@@ -1,15 +1,51 @@
 """Rotary position embeddings (pure XLA — elementwise, fuses into matmuls)."""
 from __future__ import annotations
 
+from typing import Any, Dict, Optional
+
 import jax
 import jax.numpy as jnp
 
 
+def _llama3_scale(inv_freq: jax.Array,
+                  scaling: Dict[str, Any]) -> jax.Array:
+    """Llama-3.1 'llama3' rope scaling (the frequency remap every
+    3.1/3.2 HF checkpoint ships in config.json rope_scaling): low
+    frequencies divide by `factor`, high frequencies pass through, and
+    the band between interpolates smoothly.  Matches HF
+    modeling_rope_utils._compute_llama3_parameters."""
+    factor = float(scaling['factor'])
+    low_freq_factor = float(scaling.get('low_freq_factor', 1.0))
+    high_freq_factor = float(scaling.get('high_freq_factor', 4.0))
+    old_len = float(scaling.get('original_max_position_embeddings', 8192))
+    low_freq_wavelen = old_len / low_freq_factor
+    high_freq_wavelen = old_len / high_freq_factor
+    wavelen = 2.0 * jnp.pi / inv_freq
+    smooth = (old_len / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    interpolated = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    scaled = jnp.where(wavelen > low_freq_wavelen, inv_freq / factor,
+                       jnp.where(wavelen < high_freq_wavelen, inv_freq,
+                                 interpolated))
+    return scaled
+
+
 def rope_frequencies(head_dim: int, max_seq_len: int,
-                     theta: float = 500000.0) -> tuple:
-    """(cos, sin) tables of shape (max_seq_len, head_dim // 2), f32."""
+                     theta: float = 500000.0,
+                     scaling: Optional[Dict[str, Any]] = None) -> tuple:
+    """(cos, sin) tables of shape (max_seq_len, head_dim // 2), f32.
+
+    scaling: an HF-style rope_scaling dict; rope_type 'llama3' is
+    implemented (Llama-3.1/3.2 checkpoints), others raise."""
     inv_freq = 1.0 / (theta ** (
         jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling:
+        rope_type = scaling.get('rope_type', scaling.get('type', ''))
+        if rope_type != 'llama3':
+            raise NotImplementedError(
+                f'rope_scaling type {rope_type!r} not implemented '
+                f"(supported: 'llama3')")
+        inv_freq = _llama3_scale(inv_freq, scaling)
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)
     return jnp.cos(freqs), jnp.sin(freqs)

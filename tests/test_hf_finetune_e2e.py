@@ -1,0 +1,116 @@
+"""End-to-end HF-weights finetune recipe under the managed-jobs
+controller (VERDICT r2 missing #5): a tiny REAL HF-format checkpoint is
+converted via models/convert.py, finetuned with Orbax checkpoints on a
+real text corpus, preempted mid-run, and recovery RESUMES from the last
+checkpoint instead of restarting (reference:
+llm/llama-3_1-finetuning/lora.yaml:24-47)."""
+import glob
+import os
+import re
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu import state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.provision.local import instance as local_instance
+
+from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture)
+from tests.test_managed_jobs import scheduler  # noqa: F401  (fixture)
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN = os.path.join(REPO, 'examples', 'scripts', 'train_llama.py')
+
+
+@pytest.fixture(scope='module')
+def hf_fixture_checkpoint(tmp_path_factory):
+    """A REAL HF-format Llama checkpoint at toy scale (save_pretrained:
+    config.json + safetensors), so the convert path is exercised exactly
+    as with the public 8B weights."""
+    import torch
+    import transformers
+    path = tmp_path_factory.mktemp('hf_ckpt')
+    config = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rope_theta=10000.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(config)
+    model.save_pretrained(path)
+    return str(path)
+
+
+def test_convert_fixture_checkpoint_loads(hf_fixture_checkpoint):
+    from skypilot_tpu.models import convert
+    params, config = convert.load_hf_llama(hf_fixture_checkpoint)
+    assert config.n_layers == 2 and config.d_model == 64
+    assert params['layers']['attn']['wq'].shape == (2, 64, 64)
+
+
+def test_finetune_preempt_resume(scheduler, hf_fixture_checkpoint,  # noqa: F811
+                                 tmp_path):
+    ckpt_dir = str(tmp_path / 'ckpts')
+    out_log = str(tmp_path / 'train.out')
+    corpus = str(tmp_path / 'corpus.txt')
+    with open(corpus, 'w', encoding='utf-8') as f:
+        f.write('the quick brown fox jumps over the lazy dog. ' * 200)
+    # JAX_PLATFORMS=cpu: the job runs in a fresh process where the
+    # compute stack must not touch the real TPU (env_contract honors
+    # the env var).  tee to a shared file: the ephemeral cluster (and
+    # its logs) is torn down after success, but the resume evidence
+    # must survive.
+    # XLA_FLAGS= : the pytest process's 8-virtual-device flag must not
+    # leak into the job (batch 2 is not divisible over 8 dp shards).
+    # pipefail: without it the job's exit code is tee's, and a crashed
+    # training run would be reported SUCCEEDED.
+    run = (f'set -o pipefail; '
+           f'XLA_FLAGS= JAX_PLATFORMS=cpu {sys.executable} {TRAIN} '
+           f'--hf-model {hf_fixture_checkpoint} --seq-len 32 '
+           f'--batch-size 2 --steps 20 --checkpoint-every 2 '
+           f'--throttle-s 1.5 --data-file {corpus} '
+           f'--checkpoint-dir {ckpt_dir} --resume auto '
+           f'2>&1 | tee -a {out_log}')
+    cfg = {'name': 'hf-ft', 'run': run,
+           'resources': {'cloud': 'local',
+                         'job_recovery': {'strategy': 'failover'}}}
+    job_id = scheduler.submit('hf-ft', cfg)
+
+    def _complete_steps():
+        # Full-match only: in-flight Orbax saves appear as
+        # step_N.orbax-checkpoint-tmp and are NOT durable checkpoints.
+        return sorted(
+            int(m.group(1))
+            for d in glob.glob(f'{ckpt_dir}/step_*')
+            for m in [re.fullmatch(r'step_(\d+)',
+                                   os.path.basename(d))] if m)
+
+    # Wait until a DURABLE checkpoint lands, then preempt the cluster.
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if _complete_steps():
+            break
+        time.sleep(1.0)
+    assert _complete_steps(), 'no checkpoint ever written'
+    record = scheduler.table.get(job_id)
+    assert record['status'] in (ManagedJobStatus.RUNNING,
+                                ManagedJobStatus.STARTING), record
+    local_instance.simulate_preemption(record['cluster_name'])
+
+    status = scheduler.wait_job(job_id, timeout=420)
+    record = scheduler.table.get(job_id)
+    assert status == ManagedJobStatus.SUCCEEDED, record
+    assert record['recovery_count'] >= 1, record
+
+    log_text = open(out_log, encoding='utf-8').read()
+    # The relaunched run restored the Orbax checkpoint instead of
+    # restarting from the converted weights.
+    assert 'resumed from step' in log_text, log_text[-2000:]
+    assert 'final: loss=' in log_text
+    steps = _complete_steps()
+    assert steps[-1] == 20, steps
+    # Ephemeral cluster torn down after success.
+    assert state.get_cluster(record['cluster_name']) is None
