@@ -20,6 +20,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu import provision as provision_api
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import state
@@ -49,6 +50,17 @@ class TpuBackend:
                 self._check_resources_match(handle, task)
                 if record['status'] == ClusterStatus.UP:
                     logger.info(f'Reusing cluster {cluster_name!r}.')
+                    ports = task.best_resources.ports
+                    if ports:
+                        # A relaunch may ADD ports to an existing
+                        # cluster; open_ports is idempotent (and a
+                        # no-op for clouds without a network layer) —
+                        # without this, only fresh provisions ever get
+                        # their Service.
+                        provision_api.open_ports(
+                            handle.cluster_info.cloud, cluster_name,
+                            common_utils.expand_ports(ports),
+                            handle.cluster_info.provider_config)
                     return handle
             to_provision = task.best_resources
             if not to_provision.is_launchable:
@@ -146,11 +158,30 @@ class TpuBackend:
                 raise exceptions.StorageError(
                     f'Volume {volume_name!r} not found; create it with '
                     f'`skytpu volumes apply` first.')
-            if cloud == 'local':
-                from skypilot_tpu.provision.local import volume as lvol
-                vdir = lvol.volume_dir(volume_name)
-                cmd = (f'mkdir -p {os.path.dirname(mount_path)} && '
-                       f'rm -rf {mount_path} && ln -sfn {vdir} {mount_path}')
+            if cloud in ('local', 'kubernetes'):
+                if cloud == 'local':
+                    from skypilot_tpu.provision.local import \
+                        volume as lvol
+                    vdir = lvol.volume_dir(volume_name)
+                else:
+                    # The PVC rides the pod spec (k8s attaches at
+                    # pod-create time, instance._pod_manifest); link
+                    # the task's path onto the in-pod claim mount.
+                    from skypilot_tpu.provision.kubernetes import \
+                        volume as kvol
+                    vdir = f'{kvol.POD_MOUNT_BASE}/{volume_name}'
+                # test -d first: symlinking to a missing target
+                # SUCCEEDS, and the job's own mkdir would then write
+                # checkpoints into pod-ephemeral storage that vanishes
+                # with the pod (a reused cluster whose pods were
+                # created without this volume hits exactly this).
+                cmd = (f'test -d {vdir} || {{ echo "volume '
+                       f'{volume_name} not attached to this cluster '
+                       f'(pods were created without it — relaunch on '
+                       f'a fresh cluster)" >&2; exit 41; }}; '
+                       f'mkdir -p {os.path.dirname(mount_path)} && '
+                       f'rm -rf {mount_path} && '
+                       f'ln -sfn {vdir} {mount_path}')
             else:
                 device = f'/dev/disk/by-id/google-{volume_name}'
                 # Idempotent: re-launches on a reused cluster re-run this.

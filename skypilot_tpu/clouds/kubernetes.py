@@ -94,6 +94,14 @@ class Kubernetes(cloud_lib.Cloud):
             out['cpus'] = str(resources.cpus).rstrip('+')
         if resources.memory:
             out['memory_gb'] = str(resources.memory).rstrip('+')
+        if resources.ports:
+            # `resources: ports:` → Service in front of the head pod
+            # (provision/kubernetes/network.py).  Range strings like
+            # '8080-8090' are valid port specs and expand here.
+            from skypilot_tpu.utils import common_utils
+            out['ports'] = common_utils.expand_ports(resources.ports)
+            out['port_mode'] = config_lib.get_nested(
+                ('kubernetes', 'port_mode'), default_value='nodeport')
         if spec is not None:
             out['tpu_chips_per_host'] = spec.chips_per_host
             out['tpu_accelerator'] = spec.gke_accelerator
@@ -158,4 +166,12 @@ class Kubernetes(cloud_lib.Cloud):
             out.append(('tpu-nodes', False,
                         f'node listing failed: '
                         f'{proc.stderr.strip()[:150]}'))
+        # fuse-proxy DaemonSet rollout (needed only for storage MOUNT
+        # tasks; informational when simply not deployed yet).
+        from skypilot_tpu.provision.kubernetes import instance as k8s_inst
+        try:
+            ready, detail = k8s_inst.verify_fuse_proxy(namespace)
+        except Exception as e:  # pylint: disable=broad-except
+            ready, detail = False, f'fuse-proxy probe failed: {e}'
+        out.append(('fuse-proxy', ready, detail))
         return out

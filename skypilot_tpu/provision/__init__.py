@@ -61,3 +61,34 @@ query_instances = _dispatch('query_instances')
 # states for a QUEUED cluster, and terminal-failure cleanup.
 query_queued = _dispatch('query_queued')
 reap_queued = _dispatch('reap_queued')
+
+
+def _dispatch_optional(module_suffix: str, fn_name: str):
+    """Dispatch that no-ops for clouds without the capability (mirrors
+    the reference's per-cloud optional ops, sky/provision/__init__.py
+    open_ports)."""
+    def _call(cloud: str, *args, **kwargs):
+        import importlib
+        target = f'skypilot_tpu.provision.{cloud}.{module_suffix}'
+        try:
+            module = importlib.import_module(target)
+        except ModuleNotFoundError as e:
+            # Only the TARGET module being absent means "cloud has no
+            # such layer"; a transitive import failure inside an
+            # existing module is a real bug and must surface.
+            if e.name and target.startswith(e.name):
+                return None   # the cloud (or its module) has no layer
+            raise
+        impl = getattr(module, fn_name, None)
+        if impl is None:
+            return None
+        return impl(*args, **kwargs)
+    _call.__name__ = fn_name
+    return _call
+
+
+# Port exposure (kubernetes Services today; firewall rules for VM clouds
+# are cloud-level bootstrap).  No-op for clouds without an impl.
+open_ports = _dispatch_optional('network', 'open_ports')
+cleanup_ports = _dispatch_optional('network', 'cleanup_ports')
+query_ports = _dispatch_optional('network', 'query_ports')

@@ -1,0 +1,109 @@
+"""Kubernetes port exposure: a Service in front of the head pod.
+
+Reference parity: sky/provision/kubernetes/network.py — the reference's
+open_ports/cleanup_ports create Services (and optionally Ingress) for
+`resources: ports:`; this build covers the Service modes (nodeport
+default, loadbalancer via provider config `port_mode: loadbalancer`),
+driven through kubectl like the rest of the provisioner.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.kubernetes.instance import (LABEL_CLUSTER,
+                                                        LABEL_ROLE,
+                                                        _kubectl)
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _service_name(cluster_name: str) -> str:
+    return f'{cluster_name}-ports'
+
+
+def _service_manifest(cluster_name: str, ports: List[int],
+                      mode: str) -> Dict[str, Any]:
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {
+            'name': _service_name(cluster_name),
+            'labels': {LABEL_CLUSTER: cluster_name},
+        },
+        'spec': {
+            'type': ('LoadBalancer' if mode == 'loadbalancer'
+                     else 'NodePort'),
+            'selector': {LABEL_CLUSTER: cluster_name,
+                         LABEL_ROLE: 'head'},
+            'ports': [{'name': f'port-{p}', 'port': int(p),
+                       'targetPort': int(p), 'protocol': 'TCP'}
+                      for p in ports],
+        },
+    }
+
+
+def open_ports(cluster_name: str, ports: List[int],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """Expose `ports` of the head pod (idempotent apply)."""
+    if not ports:
+        return
+    pc = provider_config or {}
+    namespace = pc.get('namespace', 'default')
+    mode = (pc.get('port_mode') or 'nodeport').lower()
+    manifest = _service_manifest(cluster_name, ports, mode)
+    _kubectl(['apply', '-f', '-'], context=pc.get('context'),
+             namespace=namespace, stdin=json.dumps(manifest))
+    logger.info(f'Opened ports {ports} for {cluster_name!r} '
+                f'({mode} service {_service_name(cluster_name)!r}).')
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Optional[Dict[str, Any]] = None
+                  ) -> None:
+    pc = provider_config or {}
+    _kubectl(['delete', 'service', _service_name(cluster_name),
+              '--ignore-not-found'],
+             context=pc.get('context'),
+             namespace=pc.get('namespace', 'default'))
+
+
+def query_ports(cluster_name: str,
+                provider_config: Optional[Dict[str, Any]] = None
+                ) -> Dict[int, str]:
+    """{port: endpoint-url} for the cluster's exposed ports.  NodePort
+    endpoints use the first node's address; LoadBalancer uses the
+    service ingress IP/hostname once assigned."""
+    pc = provider_config or {}
+    out = _kubectl(['get', 'service', _service_name(cluster_name),
+                    '-o', 'json'], context=pc.get('context'),
+                   namespace=pc.get('namespace', 'default'))
+    svc = json.loads(out)
+    spec = svc.get('spec', {})
+    endpoints: Dict[int, str] = {}
+    if spec.get('type') == 'LoadBalancer':
+        ingress = (svc.get('status', {}).get('loadBalancer', {})
+                   .get('ingress') or [{}])[0]
+        host = ingress.get('ip') or ingress.get('hostname')
+        if host:
+            for entry in spec.get('ports', []):
+                endpoints[int(entry['port'])] = \
+                    f'http://{host}:{entry["port"]}'
+        return endpoints
+    # NodePort: any node's address reaches the service.
+    nodes = json.loads(_kubectl(
+        ['get', 'nodes', '-o', 'json'], context=pc.get('context')))
+    addresses = [a for n in nodes.get('items', [])
+                 for a in n.get('status', {}).get('addresses', [])]
+    host = next((a['address'] for a in addresses
+                 if a.get('type') == 'ExternalIP'),
+                next((a['address'] for a in addresses
+                      if a.get('type') == 'InternalIP'), None))
+    if host:
+        for entry in spec.get('ports', []):
+            node_port = entry.get('nodePort')
+            if node_port:
+                endpoints[int(entry['port'])] = \
+                    f'http://{host}:{node_port}'
+    return endpoints

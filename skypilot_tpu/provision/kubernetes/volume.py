@@ -1,0 +1,57 @@
+"""Kubernetes PVC-backed volumes.
+
+Reference parity: sky/provision/kubernetes/volume.py — `skytpu volumes
+apply` with `cloud: kubernetes` creates a PersistentVolumeClaim; pods of
+a task listing the volume mount the claim at pod-create time
+(instance._pod_manifest), which is the only way k8s attaches storage.
+
+Volume field mapping: region → namespace (the provisioner's
+namespace-as-region model), type → storageClassName (None = the
+cluster's default class).
+"""
+from __future__ import annotations
+
+import json
+import typing
+
+from skypilot_tpu.provision.kubernetes.instance import _kubectl
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.volumes.core import Volume
+
+# Where PVCs land inside task pods; backend.mount_volumes symlinks the
+# task's requested mount path here.
+POD_MOUNT_BASE = '/mnt/skytpu-volumes'
+
+
+def pvc_name(volume_name: str) -> str:
+    return f'skytpu-vol-{volume_name}'
+
+
+def apply_volume(volume: 'Volume') -> None:
+    spec = {
+        'accessModes': ['ReadWriteOnce'],
+        'resources': {'requests': {
+            'storage': f'{volume.size_gb or 10}Gi'}},
+    }
+    # type → storageClassName; the GCP PD names (pd-*) are this
+    # framework's cross-cloud defaults, not k8s classes — those fall
+    # through to the cluster's default class.
+    if volume.type and not volume.type.startswith('pd-'):
+        spec['storageClassName'] = volume.type
+    manifest = {
+        'apiVersion': 'v1',
+        'kind': 'PersistentVolumeClaim',
+        'metadata': {'name': pvc_name(volume.name),
+                     'labels': {'skypilot-tpu/volume': volume.name}},
+        'spec': spec,
+    }
+    _kubectl(['apply', '-f', '-'],
+             namespace=volume.region or 'default',
+             stdin=json.dumps(manifest))
+
+
+def delete_volume(volume: 'Volume') -> None:
+    _kubectl(['delete', 'pvc', pvc_name(volume.name),
+              '--ignore-not-found'],
+             namespace=volume.region or 'default')

@@ -309,6 +309,13 @@ def provision_with_failover(
                                   AGENT_PORT_START))
                 agent_port = _setup_runtime(cluster_info, agent_port,
                                             cluster_name)
+                if config.get('ports'):
+                    # Task-declared ports (reference: open_ports in the
+                    # provision API, sky/provision/__init__.py): no-op
+                    # on clouds without a network layer.
+                    provision_api.open_ports(
+                        cloud_obj.name, cluster_name,
+                        config['ports'], config)
                 logger.info(
                     f'Provisioned {cluster_name!r} in {region}/{zone} '
                     f'({cluster_info.num_hosts} host(s), '
@@ -397,6 +404,14 @@ def promote_queued(handle: ClusterHandle) -> ClusterHandle:
 
 
 def teardown(handle: ClusterHandle, terminate: bool = True) -> None:
+    if terminate:
+        try:
+            provision_api.cleanup_ports(
+                handle.cluster_info.cloud, handle.cluster_name,
+                handle.cluster_info.provider_config)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Port cleanup for {handle.cluster_name!r} '
+                           f'failed ({e}); a stale Service may remain.')
     op = (provision_api.terminate_instances if terminate
           else provision_api.stop_instances)
     op(handle.cluster_info.cloud, handle.cluster_name,

@@ -125,3 +125,25 @@ def readable_time_duration(seconds: Optional[float]) -> str:
     if seconds < 86400:
         return f'{seconds // 3600}h {(seconds % 3600) // 60}m'
     return f'{seconds // 86400}d {(seconds % 86400) // 3600}h'
+
+
+def expand_ports(ports) -> List[int]:
+    """Resources.ports entries (ints or 'a-b' range strings, the shapes
+    the task schema accepts) -> a flat, validated list of ints."""
+    from skypilot_tpu import exceptions
+    out: List[int] = []
+    for entry in ports or ():
+        text = str(entry)
+        try:
+            if '-' in text:
+                lo, hi = (int(p) for p in text.split('-', 1))
+                if lo > hi:
+                    raise ValueError
+                out.extend(range(lo, hi + 1))
+            else:
+                out.append(int(text))
+        except ValueError as e:
+            raise exceptions.InvalidTaskError(
+                f'Invalid port spec {entry!r}: use an integer or '
+                f'"lo-hi" range.') from e
+    return out

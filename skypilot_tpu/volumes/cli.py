@@ -7,8 +7,8 @@ import time
 def _cmd_apply(args) -> int:
     from skypilot_tpu.volumes import core
     volume = core.Volume(name=args.name, cloud=args.cloud,
-                         zone=args.zone, type=args.type,
-                         size_gb=args.size)
+                         region=args.region, zone=args.zone,
+                         type=args.type, size_gb=args.size)
     record = core.apply(volume)
     print(f"Volume {record['name']!r}: {record['status'].value}")
     return 0
@@ -43,6 +43,9 @@ def register(sub) -> None:
     pa = vsub.add_parser('apply', help='Create a volume (idempotent)')
     pa.add_argument('name')
     pa.add_argument('--cloud', default='gcp')
+    pa.add_argument('--region',
+                    help='gcp: region of the zone; kubernetes: the '
+                         'namespace the PVC lands in')
     pa.add_argument('--zone')
     pa.add_argument('--type', default='pd-ssd')
     pa.add_argument('--size', type=int, default=100)

@@ -1,9 +1,10 @@
 #!/usr/bin/env python3
 """Fake kubectl for hermetic Kubernetes-provisioner tests.
 
-Persists pod state as JSON files under $FAKE_KUBE_DIR.  Supports the
-subset the provisioner uses: apply -f -, get pods -l ... -o json,
-delete pods -l ..., version --client, exec POD -- bash -c CMD.
+Persists object state as JSON files under $FAKE_KUBE_DIR, keyed by
+kind/name.  Supports the subset the provisioner uses: apply -f -, get
+{pods,service,pvc,daemonset,nodes}, delete by -l selector or kind+name,
+version --client, exec POD -- bash -c CMD.
 """
 import json
 import os
@@ -17,22 +18,59 @@ def _dir():
     return d
 
 
-def _pods():
+def _key(kind, name):
+    return f'{kind.lower()}.{name}.json'
+
+
+def _objects(kind=None):
     out = []
-    for name in sorted(os.listdir(_dir())):
-        if name.endswith('.json'):
-            with open(os.path.join(_dir(), name)) as f:
-                out.append(json.load(f))
+    for fname in sorted(os.listdir(_dir())):
+        if not fname.endswith('.json'):
+            continue
+        if kind is not None and not fname.startswith(f'{kind.lower()}.'):
+            continue
+        with open(os.path.join(_dir(), fname)) as f:
+            out.append(json.load(f))
     return out
 
 
-def _matches(pod, selector):
-    labels = pod['metadata'].get('labels', {})
+def _matches(obj, selector):
+    labels = obj['metadata'].get('labels', {})
     for clause in selector.split(','):
         k, _, v = clause.partition('=')
         if labels.get(k) != v:
             return False
     return True
+
+
+# kubectl resource aliases → manifest kinds.
+_KINDS = {'pod': 'Pod', 'pods': 'Pod', 'service': 'Service',
+          'services': 'Service', 'svc': 'Service',
+          'pvc': 'PersistentVolumeClaim',
+          'persistentvolumeclaim': 'PersistentVolumeClaim',
+          'persistentvolumeclaims': 'PersistentVolumeClaim',
+          'daemonset': 'DaemonSet', 'daemonsets': 'DaemonSet',
+          'nodes': 'Node', 'node': 'Node'}
+
+
+def _fake_status(manifest):
+    kind = manifest.get('kind', 'Pod')
+    if kind == 'Pod':
+        idx = len(_objects('pod'))
+        return {'phase': os.environ.get('FAKE_KUBE_PHASE', 'Running'),
+                'podIP': f'10.244.0.{idx + 10}'}
+    if kind == 'Service':
+        # NodePort allocation; LB ingress when requested.
+        for i, port in enumerate(manifest['spec'].get('ports', [])):
+            port.setdefault('nodePort', 30000 + i)
+        if manifest['spec'].get('type') == 'LoadBalancer':
+            return {'loadBalancer': {'ingress': [{'ip': '203.0.113.7'}]}}
+        return {}
+    if kind == 'DaemonSet':
+        n = int(os.environ.get('FAKE_KUBE_DS_NODES', '2'))
+        ready = int(os.environ.get('FAKE_KUBE_DS_READY', str(n)))
+        return {'desiredNumberScheduled': n, 'numberReady': ready}
+    return {}
 
 
 def main():
@@ -54,31 +92,69 @@ def main():
             import yaml
             manifest = yaml.safe_load(raw)
         name = manifest['metadata']['name']
-        # Fake scheduler: pod is instantly Running with a pod IP.
-        idx = len(_pods())
-        manifest['status'] = {'phase': os.environ.get(
-            'FAKE_KUBE_PHASE', 'Running'), 'podIP': f'10.244.0.{idx + 10}'}
-        with open(os.path.join(_dir(), f'{name}.json'), 'w') as f:
+        kind = manifest.get('kind', 'Pod')
+        manifest['status'] = _fake_status(manifest)
+        with open(os.path.join(_dir(), _key(kind, name)), 'w') as f:
             json.dump(manifest, f)
-        print(f'pod/{name} created')
+        print(f'{kind.lower()}/{name} created')
+        return
+    if cmd == 'auth':
+        # `auth can-i ...` — the fake cluster allows everything.
+        print('yes')
         return
     if cmd == 'get':
-        selector = args[args.index('-l') + 1] if '-l' in args else ''
-        items = [p for p in _pods() if _matches(p, selector)]
-        print(json.dumps({'items': items}))
+        if '--raw' in args:
+            print('{"gitVersion": "v1.fake"}')
+            return
+        resource = args[1] if len(args) > 1 else 'pods'
+        kind = _KINDS.get(resource, 'Pod')
+        if kind == 'Node' and not _objects('node'):
+            # A default node so NodePort endpoints resolve.
+            print(json.dumps({'items': [{
+                'metadata': {'name': 'fake-node'},
+                'status': {'addresses': [
+                    {'type': 'InternalIP', 'address': '10.0.0.99'}]},
+            }]}))
+            return
+        if '-l' in args:
+            selector = args[args.index('-l') + 1]
+            items = [o for o in _objects(kind.lower())
+                     if _matches(o, selector)]
+            print(json.dumps({'items': items}))
+            return
+        if len(args) > 2 and not args[2].startswith('-'):
+            path = os.path.join(_dir(), _key(kind, args[2]))
+            if not os.path.exists(path):
+                print(f'{resource} {args[2]} not found', file=sys.stderr)
+                sys.exit(1)
+            with open(path) as f:
+                print(f.read())
+            return
+        print(json.dumps({'items': _objects(kind.lower())}))
         return
     if cmd == 'delete':
-        selector = args[args.index('-l') + 1] if '-l' in args else ''
-        for pod in _pods():
-            if _matches(pod, selector):
-                os.remove(os.path.join(
-                    _dir(), f"{pod['metadata']['name']}.json"))
+        resource = args[1] if len(args) > 1 else 'pods'
+        kind = _KINDS.get(resource, 'Pod')
+        if '-l' in args:
+            selector = args[args.index('-l') + 1]
+            for obj in _objects(kind.lower()):
+                if _matches(obj, selector):
+                    os.remove(os.path.join(
+                        _dir(), _key(kind, obj['metadata']['name'])))
+        elif len(args) > 2 and not args[2].startswith('-'):
+            path = os.path.join(_dir(), _key(kind, args[2]))
+            if os.path.exists(path):
+                os.remove(path)
+            elif '--ignore-not-found' not in args:
+                print(f'{resource} {args[2]} not found', file=sys.stderr)
+                sys.exit(1)
         print('deleted')
         return
     if cmd == 'exec':
         sep = args.index('--')
         pod_name = args[1]
-        if not os.path.exists(os.path.join(_dir(), f'{pod_name}.json')):
+        if not os.path.exists(os.path.join(_dir(),
+                                           _key('pod', pod_name))):
             print(f'pod {pod_name} not found', file=sys.stderr)
             sys.exit(1)
         # Run the command locally (the pod "is" this machine).
