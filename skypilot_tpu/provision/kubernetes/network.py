@@ -46,13 +46,25 @@ def _service_manifest(cluster_name: str, ports: List[int],
 
 def open_ports(cluster_name: str, ports: List[int],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    """Expose `ports` of the head pod (idempotent apply)."""
+    """Expose `ports` of the head pod (idempotent apply).  Ports MERGE
+    with any already-open ones: `kubectl apply` replaces spec.ports
+    wholesale, and a relaunch with different ports must not cut off a
+    still-running job's traffic."""
     if not ports:
         return
     pc = provider_config or {}
     namespace = pc.get('namespace', 'default')
     mode = (pc.get('port_mode') or 'nodeport').lower()
-    manifest = _service_manifest(cluster_name, ports, mode)
+    try:
+        existing = json.loads(_kubectl(
+            ['get', 'service', _service_name(cluster_name), '-o',
+             'json'], context=pc.get('context'), namespace=namespace))
+        already = [int(e['port'])
+                   for e in existing.get('spec', {}).get('ports', [])]
+    except Exception:  # pylint: disable=broad-except
+        already = []   # no service yet
+    merged = sorted(set(already) | {int(p) for p in ports})
+    manifest = _service_manifest(cluster_name, merged, mode)
     _kubectl(['apply', '-f', '-'], context=pc.get('context'),
              namespace=namespace, stdin=json.dumps(manifest))
     logger.info(f'Opened ports {ports} for {cluster_name!r} '

@@ -47,6 +47,7 @@ def apply_volume(volume: 'Volume') -> None:
         'spec': spec,
     }
     _kubectl(['apply', '-f', '-'],
+             context=_configured_context(),
              namespace=volume.region or 'default',
              stdin=json.dumps(manifest))
 
@@ -54,4 +55,13 @@ def apply_volume(volume: 'Volume') -> None:
 def delete_volume(volume: 'Volume') -> None:
     _kubectl(['delete', 'pvc', pvc_name(volume.name),
               '--ignore-not-found'],
+             context=_configured_context(),
              namespace=volume.region or 'default')
+
+
+def _configured_context():
+    """The SAME context the provisioner uses (kubernetes.context): a
+    PVC created in the active kubeconfig cluster while pods land in the
+    configured one would hang every task Pending."""
+    from skypilot_tpu import config as config_lib
+    return config_lib.get_nested(('kubernetes', 'context'))

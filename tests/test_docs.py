@@ -1,0 +1,44 @@
+"""Docs stay in sync with the code (VERDICT r2 missing #7): the
+reference docs are generated from the schemas/CLI, and this suite fails
+when they drift."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_generated_reference_docs_in_sync():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts', 'gen_docs.py'),
+         '--check'], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f'Generated docs drifted from the schemas/CLI:\n{proc.stdout}'
+        f'\nRun `python scripts/gen_docs.py` and commit.')
+
+
+def test_docs_index_links_resolve():
+    import re
+    docs = os.path.join(REPO, 'docs')
+    for page in ('README.md', 'quickstart.md'):
+        text = open(os.path.join(docs, page), encoding='utf-8').read()
+        for target in re.findall(r'\]\(([\w./-]+\.md)\)', text):
+            assert os.path.exists(os.path.join(docs, target)), \
+                f'{page} links to missing {target}'
+
+
+def test_quickstart_commands_reference_real_cli():
+    """Every `skytpu <sub>` command mentioned in the quickstart must be
+    a real subcommand."""
+    import re
+
+    from skypilot_tpu.client import cli
+    parser = cli.build_parser()
+    sub = next(a for a in parser._actions
+               if hasattr(a, 'choices') and a.choices)
+    valid = set(sub.choices)
+    text = open(os.path.join(REPO, 'docs', 'quickstart.md'),
+                encoding='utf-8').read()
+    used = set(re.findall(r'skytpu (\w+)', text))
+    missing = used - valid
+    assert not missing, f'quickstart uses unknown subcommands {missing}'

@@ -286,3 +286,26 @@ def test_volume_namespace_mismatch_fails_fast(fake_kube):
             'kubernetes', 'default', 'kns',
             {'num_hosts': 1, 'volumes': ['nsvol']})
     vol_core.delete('nsvol')
+
+
+def test_open_ports_merges_with_existing(fake_kube):
+    """A relaunch adding ports must not close ports a running job uses
+    (kubectl apply replaces spec.ports wholesale)."""
+    from skypilot_tpu.provision.kubernetes import network
+    network.open_ports('km', [8080], {'namespace': 'default'})
+    network.open_ports('km', [9000], {'namespace': 'default'})
+    svc = json.loads((fake_kube / 'service.km-ports.json').read_text())
+    assert [p['port'] for p in svc['spec']['ports']] == [8080, 9000]
+
+
+def test_cross_cloud_volume_on_k8s_fails_fast(fake_kube):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import provision as provision_api
+    from skypilot_tpu.volumes import core as vol_core
+    vol_core.apply(vol_core.Volume(name='localvol', cloud='local'))
+    with pytest.raises(exceptions.ProvisionerError,
+                       match='--cloud kubernetes'):
+        provision_api.run_instances(
+            'kubernetes', 'default', 'kxc',
+            {'num_hosts': 1, 'volumes': ['localvol']})
+    vol_core.delete('localvol')
