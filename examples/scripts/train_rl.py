@@ -1,0 +1,94 @@
+"""GRPO RL post-training recipe (the TPU-native analog of the
+reference's RLHF recipes, llm/verl/multinode.yaml — PPO via an external
+framework over Ray; here the rollout engine and the sharded learner are
+the bundled library, colocated on the same chips).
+
+Demo reward functions are verifiable-by-construction (no reward model):
+  token-band    fraction of completion tokens with id <= --target-token
+                (default vocab/8, so the starting policy already scores
+                ~12% and GRPO has gradient signal — measurably climbs
+                within a handful of steps at debug scale)
+  length        1 - |len(completion) - target| / target
+Swap in your own by editing REWARDS — the contract is
+reward(prompt_ids, completion_ids) -> float.
+"""
+import argparse
+
+from skypilot_tpu.utils import env_contract
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model-size', default='debug',
+                        choices=['debug', '1b', '8b'])
+    parser.add_argument('--hf-model', default='')
+    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--group-size', type=int, default=8)
+    parser.add_argument('--prompts-per-step', type=int, default=2)
+    parser.add_argument('--max-new-tokens', type=int, default=16)
+    parser.add_argument('--learning-rate', type=float, default=1e-4)
+    parser.add_argument('--temperature', type=float, default=1.0)
+    parser.add_argument('--kl-coef', type=float, default=0.0)
+    parser.add_argument('--reward', default='token-band',
+                        choices=['token-band', 'length'])
+    parser.add_argument('--target-token', type=int, default=0,
+                        help='0 = vocab_size // 8')
+    parser.add_argument('--target-length', type=int, default=8)
+    parser.add_argument('--fsdp', type=int, default=0)
+    parser.add_argument('--tp', type=int, default=1)
+    args = parser.parse_args()
+
+    env_contract.initialize_from_env()
+    import jax
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import rl
+
+    if args.hf_model:
+        from skypilot_tpu.models import convert
+        params, config = convert.load_hf_llama(args.hf_model)
+    else:
+        config = {'debug': llama.LLAMA_DEBUG, '1b': llama.LLAMA_1B,
+                  '8b': llama.LLAMA3_8B}[args.model_size]
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+
+    n = jax.device_count()
+    mesh = make_mesh(MeshConfig(
+        dp=max(1, n // (max(args.fsdp, 1) * args.tp)),
+        fsdp=max(args.fsdp, 1), tp=args.tp))
+
+    target = args.target_token or max(config.vocab_size // 8, 1)
+    REWARDS = {
+        'token-band': lambda p, c: (
+            sum(1 for t in c if t <= target) / max(len(c), 1)),
+        'length': lambda p, c: (
+            1.0 - abs(len(c) - args.target_length)
+            / max(args.target_length, 1)),
+    }
+    trainer = rl.GrpoTrainer(
+        params, config, mesh, sharding_lib.LLAMA_RULES,
+        REWARDS[args.reward], group_size=args.group_size,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        learning_rate=args.learning_rate, kl_coef=args.kl_coef,
+        total_steps=args.steps)
+
+    prompts = [[(11 * (i + 1)) % config.vocab_size,
+                (13 * (i + 1)) % config.vocab_size]
+               for i in range(args.prompts_per_step)]
+    metrics = {}
+    for _ in range(args.steps):
+        metrics = trainer.step(prompts)
+        if jax.process_index() == 0:
+            print(f"rl step {metrics['step']}: "
+                  f"reward={metrics['reward_mean']:.3f}"
+                  f"±{metrics['reward_std']:.3f} "
+                  f"loss={metrics['loss']:.4f}")
+    if jax.process_index() == 0:
+        print(f"rl OK: {args.steps} steps, final "
+              f"reward={metrics.get('reward_mean', float('nan')):.3f}")
+
+
+if __name__ == '__main__':
+    main()

@@ -222,59 +222,71 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest='command')
 
     p = sub.add_parser('launch', help='Provision and run a task')
-    p.add_argument('yaml')
-    p.add_argument('-c', '--cluster', default=None)
-    p.add_argument('-d', '--detach-run', action='store_true')
+    p.add_argument('yaml', help='Task YAML file')
+    p.add_argument('-c', '--cluster', default=None,
+                   help='Cluster name (default: auto-generated)')
+    p.add_argument('-d', '--detach-run', action='store_true',
+                   help='Return after submission instead of tailing')
     p.add_argument('--down', action='store_true',
                    help='Tear down after the job finishes')
-    p.add_argument('--env', action='append', metavar='K=V')
+    p.add_argument('--env', action='append', metavar='K=V',
+                   help='Override/add a task env var (repeatable)')
     p.set_defaults(fn=_cmd_launch)
 
     p = sub.add_parser('exec', help='Run on an existing cluster (no setup)')
-    p.add_argument('yaml')
-    p.add_argument('-c', '--cluster', required=True)
-    p.add_argument('-d', '--detach-run', action='store_true')
+    p.add_argument('yaml', help='Task YAML file')
+    p.add_argument('-c', '--cluster', required=True,
+                   help='Existing cluster to run on')
+    p.add_argument('-d', '--detach-run', action='store_true',
+                   help='Return after submission instead of tailing')
     p.set_defaults(fn=_cmd_exec)
 
     p = sub.add_parser('status', help='List clusters')
-    p.add_argument('-r', '--refresh', action='store_true')
+    p.add_argument('-r', '--refresh', action='store_true',
+                   help='Reconcile against the cloud before printing')
     p.set_defaults(fn=_cmd_status)
 
     p = sub.add_parser('queue', help='Cluster job queue')
-    p.add_argument('cluster')
-    p.add_argument('-a', '--all', action='store_true')
+    p.add_argument('cluster', help='Cluster name')
+    p.add_argument('-a', '--all', action='store_true',
+                   help='Include finished jobs')
     p.set_defaults(fn=_cmd_queue)
 
     p = sub.add_parser('logs', help='Tail job logs')
-    p.add_argument('cluster')
-    p.add_argument('job_id', nargs='?', type=int, default=None)
-    p.add_argument('--rank', type=int, default=0)
-    p.add_argument('--no-follow', action='store_true')
+    p.add_argument('cluster', help='Cluster name')
+    p.add_argument('job_id', nargs='?', type=int, default=None,
+                   help='Job id (default: latest)')
+    p.add_argument('--rank', type=int, default=0,
+                   help='Host rank whose log to read')
+    p.add_argument('--no-follow', action='store_true',
+                   help='Print the current log and exit')
     p.set_defaults(fn=_cmd_logs)
 
     p = sub.add_parser('cancel', help='Cancel jobs')
-    p.add_argument('cluster')
-    p.add_argument('job_ids', nargs='*', type=int)
+    p.add_argument('cluster', help='Cluster name')
+    p.add_argument('job_ids', nargs='*', type=int,
+                   help='Job ids (default: all running)')
     p.set_defaults(fn=_cmd_cancel)
 
     p = sub.add_parser('down', help='Terminate clusters')
-    p.add_argument('clusters', nargs='+')
+    p.add_argument('clusters', nargs='+', help='Cluster names')
     p.set_defaults(fn=_cmd_down)
 
     p = sub.add_parser('stop', help='Stop a cluster (single-host only)')
-    p.add_argument('cluster')
+    p.add_argument('cluster', help='Cluster name')
     p.set_defaults(fn=_cmd_stop)
 
     p = sub.add_parser('start', help='Restart a stopped cluster')
-    p.add_argument('cluster')
+    p.add_argument('cluster', help='Cluster name')
     p.set_defaults(fn=_cmd_start)
 
     p = sub.add_parser('cost-report', help='Cost of live + past clusters')
     p.set_defaults(fn=_cmd_cost_report)
 
     p = sub.add_parser('autostop', help='Auto-teardown after idleness')
-    p.add_argument('cluster')
-    p.add_argument('-i', '--idle-minutes', type=int, default=5)
+    p.add_argument('cluster', help='Cluster name')
+    p.add_argument('-i', '--idle-minutes', type=int, default=5,
+                   help='Tear down after this many idle minutes')
     p.set_defaults(fn=_cmd_autostop)
 
     p = sub.add_parser('check', help='Check cloud credentials')
@@ -283,17 +295,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=_cmd_check)
 
     p = sub.add_parser('show-tpus', help='List TPU offerings and prices')
-    p.add_argument('filter', nargs='?', default=None)
+    p.add_argument('filter', nargs='?', default=None,
+                   help='Substring filter, e.g. v5e or v5e-16')
     p.set_defaults(fn=_cmd_show_tpus)
 
     p = sub.add_parser('ssh', help='Open a shell on the cluster head')
-    p.add_argument('cluster')
+    p.add_argument('cluster', help='Cluster name')
     p.add_argument('cmd', nargs='*', help='Run this instead of a shell')
     p.set_defaults(fn=_cmd_ssh)
 
     p = sub.add_parser('catalog', help='Offering catalog cache')
     p.add_argument('catalog_cmd', nargs='?', default='status',
-                   choices=['status', 'refresh'])
+                   choices=['status', 'refresh'],
+                   help='status: cache info; refresh: re-fetch')
     p.set_defaults(fn=_cmd_catalog)
 
     # Jobs / serve groups (registered lazily to keep import light).

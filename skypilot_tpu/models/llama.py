@@ -269,9 +269,15 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         forward_fn = functools.partial(forward,
                                        attention_fn=attention_fn)
     logits = forward_fn(params, tokens[:, :-1], config)
-    targets = tokens[:, 1:]
-    # logsumexp form: one (B, S) reduction instead of materializing the
-    # full (B, S, vocab) log_softmax.
+    return -jnp.mean(token_logprobs(logits, tokens[:, 1:]))
+
+
+def token_logprobs(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """log p(targets) from logits — (..., S) f32.  logsumexp form: one
+    (B, S) reduction instead of materializing the full log_softmax.
+    Shared by the SFT loss, the MoE loss, and the RL policy gradient so
+    the numerics cannot drift apart."""
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - picked)
+    picked = jnp.take_along_axis(logits, targets[..., None],
+                                 axis=-1)[..., 0]
+    return picked - lse

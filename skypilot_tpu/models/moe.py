@@ -188,9 +188,8 @@ def forward(params: Params, tokens: jax.Array, config: MoeConfig,
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array], config: MoeConfig,
             attention_fn=None) -> jax.Array:
+    from skypilot_tpu.models import llama as llama_lib
     tokens = batch['tokens']
     logits, aux = forward(params, tokens[:, :-1], config, attention_fn)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ll = llama_lib.token_logprobs(logits, tokens[:, 1:])
     return -jnp.mean(ll) + config.router_aux_weight * aux

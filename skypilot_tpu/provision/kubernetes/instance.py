@@ -193,8 +193,10 @@ def run_instances(region: str, cluster_name: str,
             raise exceptions.ProvisionerError(
                 f'Volume {volume_name!r} was created on cloud '
                 f'{record.get("cloud")!r}; a kubernetes task needs a '
-                f'kubernetes volume (skytpu volumes apply '
-                f'{volume_name} --cloud kubernetes).',
+                f'kubernetes volume. Volumes cannot change cloud: '
+                f'delete it (skytpu volumes delete {volume_name}) and '
+                f're-create it with --cloud kubernetes, or use a '
+                f'different volume name.',
                 retriable=False)
         vol_ns = record.get('region') or 'default'
         if vol_ns != namespace:

@@ -55,14 +55,21 @@ def open_ports(cluster_name: str, ports: List[int],
     pc = provider_config or {}
     namespace = pc.get('namespace', 'default')
     mode = (pc.get('port_mode') or 'nodeport').lower()
+    from skypilot_tpu import exceptions
     try:
         existing = json.loads(_kubectl(
             ['get', 'service', _service_name(cluster_name), '-o',
              'json'], context=pc.get('context'), namespace=namespace))
         already = [int(e['port'])
                    for e in existing.get('spec', {}).get('ports', [])]
-    except Exception:  # pylint: disable=broad-except
-        already = []   # no service yet
+    except exceptions.ProvisionerError as e:
+        # ONLY NotFound means "no service yet".  A transient read
+        # failure followed by a successful apply would wholesale-replace
+        # spec.ports and cut off a running job's existing ports — the
+        # exact bug the merge exists to prevent.
+        if 'not found' not in str(e).lower():
+            raise
+        already = []
     merged = sorted(set(already) | {int(p) for p in ports})
     manifest = _service_manifest(cluster_name, merged, mode)
     _kubectl(['apply', '-f', '-'], context=pc.get('context'),

@@ -1,0 +1,211 @@
+"""GRPO-style RL post-training for the bundled Llama (TPU-native).
+
+Reference parity: the reference runs RLHF through external frameworks in
+recipes (llm/verl/multinode.yaml — PPO via Ray across GPU nodes;
+llm/nemorl/). The TPU-first redesign is library code over the stack that
+already ships here: rollouts come from the inference engine (bucketed
+prefill + fixed-shape decode on the SAME chips), the update is the
+sharded Trainer step, and actor/learner are colocated — on TPU slices
+the chips are homogeneous and weight shipping between disjoint
+actor/learner pools would cost more than it saves.
+
+Algorithm: GRPO (group-relative policy optimization) — sample G
+completions per prompt, advantage = per-group standardized reward,
+token-level policy gradient with an optional k3 KL penalty to a frozen
+reference policy. No value network (the group baseline replaces it),
+which is what makes it a good fit for a first-class recipe.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+
+
+def group_advantages(rewards: np.ndarray, group_size: int,
+                     eps: float = 1e-6) -> np.ndarray:
+    """(B,) rewards, B = num_groups * group_size (completions of the
+    same prompt contiguous) -> (B,) standardized within each group."""
+    if rewards.size % group_size:
+        raise ValueError(f'{rewards.size} rewards not divisible by '
+                         f'group size {group_size}')
+    groups = rewards.reshape(-1, group_size).astype(np.float32)
+    mean = groups.mean(axis=1, keepdims=True)
+    std = groups.std(axis=1, keepdims=True)
+    return ((groups - mean) / (std + eps)).reshape(-1)
+
+
+def _token_logprobs(params: llama.Params, tokens: jax.Array,
+                    config: llama.LlamaConfig) -> jax.Array:
+    """log p(tokens[:, 1:]) under the policy — (B, T-1) f32."""
+    logits = llama.forward(params, tokens[:, :-1], config)
+    return llama.token_logprobs(logits, tokens[:, 1:])
+
+
+def grpo_loss(params: llama.Params, batch: Dict[str, jax.Array],
+              config: llama.LlamaConfig,
+              kl_coef: float = 0.0,
+              ref_params: Optional[llama.Params] = None) -> jax.Array:
+    """batch:
+      tokens          (B, T)   prompt+completion, right-padded
+      completion_mask (B, T-1) 1.0 where position t predicts a
+                               completion token (prompt + padding = 0)
+      advantage       (B,)     group-standardized reward
+
+    Token-level policy gradient: -E[adv * log p(token)] over completion
+    tokens, plus kl_coef * k3-KL to ref_params when given (the
+    unbiased low-variance estimator exp(d) - d - 1, d = ref_lp - lp).
+    """
+    tokens = batch['tokens']
+    mask = batch['completion_mask'].astype(jnp.float32)
+    adv = batch['advantage'].astype(jnp.float32)[:, None]
+    logprobs = _token_logprobs(params, tokens, config)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    pg = -(adv * logprobs * mask).sum() / denom
+    if kl_coef and ref_params is not None:
+        ref_lp = jax.lax.stop_gradient(
+            _token_logprobs(ref_params, tokens, config))
+        d = ref_lp - logprobs
+        kl = ((jnp.exp(d) - d - 1.0) * mask).sum() / denom
+        return pg + kl_coef * kl
+    return pg
+
+
+def build_batch(prompts, completions, advantages,
+                pad_to: int) -> Dict[str, np.ndarray]:
+    """Host-side batch assembly: rows = prompt_i + completion_i padded
+    to `pad_to` (one static shape per bucket — no per-length
+    recompiles)."""
+    batch = len(completions)
+    tokens = np.zeros((batch, pad_to), np.int32)
+    mask = np.zeros((batch, pad_to - 1), np.float32)
+    for i, (prompt, completion) in enumerate(zip(prompts, completions)):
+        seq = list(prompt) + list(completion)
+        seq = seq[:pad_to]
+        tokens[i, :len(seq)] = seq
+        # Position t of the mask gates the prediction of tokens[t+1]:
+        # completion tokens sit at indices [len(prompt), len(seq)).
+        start = max(len(prompt) - 1, 0)
+        mask[i, start:len(seq) - 1] = 1.0
+    return {'tokens': tokens, 'completion_mask': mask,
+            'advantage': np.asarray(advantages, np.float32)}
+
+
+class GrpoTrainer:
+    """Rollout → reward → group advantage → sharded update, one object.
+
+    reward_fn(prompt_ids, completion_ids) -> float, on the host — the
+    task-specific part (verifiable rewards: exact match, test pass,
+    length constraints ...).
+    """
+
+    def __init__(self, params: llama.Params,
+                 config: llama.LlamaConfig, mesh, rules,
+                 reward_fn, *, group_size: int = 4,
+                 max_new_tokens: int = 32,
+                 max_prompt_len: int = 64,
+                 temperature: float = 1.0,
+                 learning_rate: float = 1e-5,
+                 kl_coef: float = 0.0,
+                 total_steps: int = 100,
+                 seed: int = 0):
+        import functools
+
+        from skypilot_tpu.infer import Generator, GeneratorConfig
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        from skypilot_tpu.train.trainer import TrainConfig, Trainer
+        self.config = config
+        self.group_size = group_size
+        self.max_new_tokens = max_new_tokens
+        self.reward_fn = reward_fn
+        self.kl_coef = kl_coef
+        self.seed = seed
+        # The frozen reference MUST be sharded like the policy before
+        # the loss closure captures it: a closure-captured unsharded
+        # tree is baked into the jit as a fully-replicated per-device
+        # constant — an instant OOM for exactly the fsdp-sharded models
+        # the KL penalty is used with.  And it must be a COPY: the
+        # Trainer donates its param buffers every step, and an aliased
+        # reference would be deleted out from under the loss.
+        if kl_coef:
+            sharded = sharding_lib.shard_params(params, mesh, rules)
+            self._ref_params = jax.jit(lambda t: t)(sharded)
+        else:
+            self._ref_params = None
+        loss = functools.partial(grpo_loss, config=config,
+                                 kl_coef=kl_coef,
+                                 ref_params=self._ref_params)
+        self.trainer = Trainer(loss, params, mesh, rules,
+                               TrainConfig(learning_rate=learning_rate,
+                                           warmup_steps=1,
+                                           total_steps=total_steps))
+        # Rollouts read the LIVE policy params each call (same chips,
+        # same buffers — the colocated-actor design).  The KV cache is
+        # sized to the ROLLOUT length, not the model's max_seq_len: RL
+        # sequences are prompt+completion (~hundreds of tokens), and a
+        # model-length cache would multiply decode HBM traffic by the
+        # unused tail on every step of the hot loop.
+        rollout_len = max_prompt_len + max_new_tokens + 1
+        gen_len = min(config.max_seq_len,
+                      1 << (rollout_len - 1).bit_length())
+        self.max_prompt_len = max_prompt_len
+        self._gen_config = GeneratorConfig(
+            max_seq_len=gen_len,
+            batch_size=group_size, temperature=temperature)
+        self._generator = Generator(self.trainer.params, config,
+                                    self._gen_config)
+
+    def step(self, prompts) -> Dict[str, float]:
+        """One GRPO iteration over `prompts` (G completions each)."""
+        too_long = [p for p in prompts
+                    if len(p) > self.max_prompt_len]
+        if too_long:
+            raise ValueError(
+                f'{len(too_long)} prompt(s) exceed max_prompt_len='
+                f'{self.max_prompt_len}; raise it at construction.')
+        self._generator.params = self.trainer.params
+        all_prompts, completions, rewards = [], [], []
+        for i, prompt in enumerate(prompts):
+            outs = self._generator.generate(
+                [list(prompt)] * self.group_size,
+                max_new_tokens=self.max_new_tokens,
+                seed=self.seed * 100_003 + self.trainer.step * 1_009 + i)
+            for completion in outs:
+                all_prompts.append(list(prompt))
+                completions.append(completion)
+                rewards.append(float(self.reward_fn(prompt, completion)))
+        advantages = group_advantages(np.asarray(rewards),
+                                      self.group_size)
+        pad_to = max(len(p) + len(c)
+                     for p, c in zip(all_prompts, completions))
+        # Bucket to a multiple of 16: one compiled update shape per
+        # bucket instead of one per max-length.
+        pad_to = ((pad_to + 15) // 16) * 16
+        batch = build_batch(all_prompts, completions, advantages, pad_to)
+        # The batch axis shards over dp×fsdp: pad to the shard multiple
+        # with zero-mask rows (their completion_mask is all zero, so
+        # they contribute nothing to the masked loss).
+        shards = (self.trainer.mesh.shape.get('dp', 1)
+                  * self.trainer.mesh.shape.get('fsdp', 1))
+        rows = batch['tokens'].shape[0]
+        if rows % shards:
+            extra = shards - rows % shards
+            batch = {
+                'tokens': np.concatenate(
+                    [batch['tokens'],
+                     np.zeros((extra, pad_to), np.int32)]),
+                'completion_mask': np.concatenate(
+                    [batch['completion_mask'],
+                     np.zeros((extra, pad_to - 1), np.float32)]),
+                'advantage': np.concatenate(
+                    [batch['advantage'], np.zeros(extra, np.float32)]),
+            }
+        metrics = self.trainer.run_step(batch)
+        return {'loss': float(metrics['loss']),
+                'reward_mean': float(np.mean(rewards)),
+                'reward_std': float(np.std(rewards)),
+                'step': self.trainer.step}

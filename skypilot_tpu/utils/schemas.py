@@ -199,6 +199,44 @@ CONFIG_SCHEMA = {
                 'max_parallel_jobs': {'type': 'integer', 'minimum': 1},
             },
         },
+        'serve': {
+            'type': 'object',
+            'properties': {
+                'controller': {
+                    'type': 'object',
+                    'properties': {
+                        'resources': {'type': ['object', 'null']},
+                    },
+                },
+            },
+        },
+        'provision': {
+            'type': 'object',
+            'properties': {
+                'ssh_timeout': {'type': 'number', 'minimum': 1},
+                'max_retries_per_zone': {'type': 'integer',
+                                         'minimum': 0},
+                'locked_clouds': {'type': 'array',
+                                  'items': {'type': 'string'}},
+            },
+        },
+        'kubernetes': {
+            'type': 'object',
+            'properties': {
+                'namespace': {'type': 'string'},
+                'context': {'type': ['string', 'null']},
+                'image': {'type': 'string'},
+                'port_mode': {'enum': ['nodeport', 'loadbalancer']},
+            },
+        },
+        'db': {
+            'type': 'object',
+            'properties': {
+                # postgresql:// URI routes cluster/user/jobs state to a
+                # shared server (utils/db_engine.py); null = sqlite.
+                'connection_string': {'type': ['string', 'null']},
+            },
+        },
         'admin_policy': {'type': ['string', 'null']},
         'api_server': {'type': 'object'},
         'logs': {'type': 'object'},

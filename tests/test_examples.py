@@ -61,7 +61,11 @@ def test_docker_example_image(tmp_home):
     ('train_moe.py',
      ['--ep', '4', '--dp', '2', '--model-size', 'debug', '--seq-len',
       '128', '--batch-size', '4', '--steps', '2']),
-], ids=['long_context', 'moe'])
+    ('train_rl.py',
+     ['--model-size', 'debug', '--steps', '2', '--group-size', '4',
+      '--prompts-per-step', '1', '--max-new-tokens', '4',
+      '--fsdp', '2']),
+], ids=['long_context', 'moe', 'rl'])
 def test_parallel_recipe_scripts_run_on_cpu_mesh(script, args):
     """The sp-ring and ep recipes execute end-to-end on a virtual
     8-device CPU mesh."""

@@ -1,0 +1,95 @@
+"""GRPO RL post-training (train/rl.py — the verl-recipe analog):
+advantage math, loss masking/gradients, and the end-to-end property
+that matters — the policy measurably moves toward the reward."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import MeshConfig, make_mesh
+from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.train import rl
+
+CFG = llama.LlamaConfig(vocab_size=64, d_model=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=128,
+                        max_seq_len=128, dtype=jnp.float32, remat=False)
+
+
+def test_group_advantages_standardizes_within_groups():
+    rewards = np.array([1.0, 3.0, 10.0, 10.0])
+    adv = rl.group_advantages(rewards, group_size=2)
+    np.testing.assert_allclose(adv[:2], [-1.0, 1.0], atol=1e-4)
+    # Degenerate group (all equal): zero advantage, no div-by-zero.
+    np.testing.assert_allclose(adv[2:], [0.0, 0.0], atol=1e-4)
+    with pytest.raises(ValueError):
+        rl.group_advantages(np.ones(5), group_size=2)
+
+
+def test_build_batch_masks_only_completion():
+    batch = rl.build_batch([[5, 6]], [[7, 8, 9]], [1.0], pad_to=8)
+    assert batch['tokens'][0].tolist() == [5, 6, 7, 8, 9, 0, 0, 0]
+    # mask[t] gates the prediction of tokens[t+1]: positions predicting
+    # 7, 8, 9 (indices 1, 2, 3) are on; prompt + padding off.
+    assert batch['completion_mask'][0].tolist() == \
+        [0, 1, 1, 1, 0, 0, 0]
+
+
+def test_grpo_loss_gradient_direction():
+    """Positive-advantage completions must get MORE likely after a
+    gradient step; negative-advantage ones less likely."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    batch = {k: jnp.asarray(v) for k, v in rl.build_batch(
+        [[1, 2], [1, 2]], [[3, 4], [5, 6]], [1.0, -1.0],
+        pad_to=8).items()}
+
+    def lp_of(params, row):
+        lp = rl._token_logprobs(params, batch['tokens'][row:row + 1],
+                                CFG)
+        mask = batch['completion_mask'][row:row + 1]
+        return float((lp * mask).sum())
+
+    grads = jax.grad(rl.grpo_loss)(params, batch, config=CFG)
+    stepped = jax.tree.map(lambda p, g: p - 0.05 * g, params, grads)
+    assert lp_of(stepped, 0) > lp_of(params, 0)   # reinforced
+    assert lp_of(stepped, 1) < lp_of(params, 1)   # suppressed
+
+
+def test_kl_penalty_pulls_toward_reference():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    ref = llama.init_params(CFG, jax.random.PRNGKey(1))
+    batch = {k: jnp.asarray(v) for k, v in rl.build_batch(
+        [[1]], [[3, 4, 5]], [0.0], pad_to=8).items()}
+    # Zero advantage isolates the KL term; the penalty must be positive
+    # for a policy that differs from the reference and ~0 at the
+    # reference itself.
+    loss_diff = rl.grpo_loss(params, batch, config=CFG, kl_coef=1.0,
+                             ref_params=ref)
+    loss_same = rl.grpo_loss(params, batch, config=CFG, kl_coef=1.0,
+                             ref_params=params)
+    assert float(loss_diff) > float(loss_same)
+    assert abs(float(loss_same)) < 1e-5
+
+
+@pytest.mark.slow
+def test_grpo_learns_target_token_reward():
+    """The e2e property: a few GRPO iterations measurably raise the
+    reward (policy emits the target token more often)."""
+    target = 7
+
+    def reward(prompt, completion):
+        return sum(1 for t in completion if t == target) / max(
+            len(completion), 1)
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    trainer = rl.GrpoTrainer(params, CFG, mesh,
+                             sharding_lib.LLAMA_RULES, reward,
+                             group_size=8, max_new_tokens=8,
+                             temperature=1.0, learning_rate=5e-3,
+                             total_steps=12, seed=3)
+    prompts = [[11, 13], [17, 19]]
+    history = [trainer.step(prompts)['reward_mean'] for _ in range(10)]
+    early = float(np.mean(history[:3]))
+    late = float(np.mean(history[-3:]))
+    assert late > early + 0.1, f'no learning: {history}'
