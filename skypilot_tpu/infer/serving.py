@@ -8,9 +8,10 @@ everything keeps a static shape so nothing recompiles at steady state:
 - The KV cache holds `batch_size` SLOTS (L, B, max_len, KV, D).  A request
   occupies one slot from prefill to eos/max-tokens, then the slot is
   immediately handed to the next queued request.
-- Per-slot prefill runs at batch 1 into a bucketed shape and is written
-  into the big cache with a jitted dynamic-update (one compile per prompt
-  bucket).
+- Queued requests are admitted in GROUPS: one bucketed prefill forward
+  covers up to admit_group prompts and scatters each row into its slot
+  (bounded compile set: group sizes × prompt buckets).  Sequential
+  per-request prefills would pay one dispatch + host round-trip each.
 - Decode always steps ALL slots in lockstep, (B, 1) shapes; free slots
   decode garbage at position 0 of their (about-to-be-overwritten) cache —
   masked on the host, costing nothing but the already-paid lockstep FLOPs.
@@ -89,8 +90,12 @@ class ContinuousBatcher:
         self._queue: List[_Request] = []
         self._ids = itertools.count(1)
 
-        self._prefill_one = jax.jit(functools.partial(
-            self._prefill_one_impl, config=config), donate_argnums=(2,),
+        # Admission group size: up to this many queued requests prefill
+        # in ONE dispatch (compiled per actual group size — at most
+        # admit_group compiles per prompt bucket).
+        self._admit_group = max(1, min(4, batch))
+        self._prefill_group = jax.jit(functools.partial(
+            self._prefill_group_impl, config=config), donate_argnums=(2,),
             static_argnames=())
         self._decode = jax.jit(functools.partial(
             self._decode_impl, temperature=gen_config.temperature,
@@ -98,25 +103,33 @@ class ContinuousBatcher:
             donate_argnums=(2,), static_argnames=('n',))
 
     # ---- jitted pieces ---------------------------------------------------
-    def _prefill_one_impl(self, params, tokens, big_cache, length, slot,
-                          token_row, pos_row, rng, *, config):
-        """Prefill ONE prompt (1, bucket) and install it into `slot`."""
-        small = llama_infer.init_cache(config, 1, self.gen.max_seq_len)
+    def _prefill_group_impl(self, params, tokens, big_cache, lengths,
+                            slots, token_row, pos_row, rng, *, config):
+        """Prefill a GROUP of prompts (G, bucket) in one forward and
+        install each row into its slot.  G is the ACTUAL group size
+        (1..admit_group): at most admit_group compiles per prompt
+        bucket, and a trickle-traffic admission of one request costs a
+        1-row forward, not admit_group rows of padding FLOPs.  Batched
+        admission amortizes what used to be G sequential prefill
+        dispatches (each a full tunnel round-trip) into one."""
+        group = tokens.shape[0]
+        small = llama_infer.init_cache(config, group,
+                                       self.gen.max_seq_len)
         logits, small = llama_infer.prefill(
-            params, tokens, config=config, cache=small,
-            lengths=length[None])
-        big_cache = {
-            k: jax.lax.dynamic_update_index_in_dim(
-                big_cache[k], small[k][:, 0], slot, axis=1)
-            for k in ('k', 'v')}
+            params, tokens, config=config, cache=small, lengths=lengths)
+        # Scatter each group row into its slot on the batch axis (1):
+        # big[:, slots[i]] = small[:, i].
+        big_cache = dict(big_cache)
+        for key in ('k', 'v'):
+            big_cache[key] = big_cache[key].at[:, slots].set(small[key])
         big_cache = tp_lib.constrain_cache(big_cache, self.mesh)
         rng, sub = jax.random.split(rng)
-        first = sampling.sample_logits(
+        firsts = sampling.sample_logits(
             logits, sub, temperature=self.gen.temperature,
-            top_k=self.gen.top_k, top_p=self.gen.top_p)[0]
-        token_row = token_row.at[slot].set(first)
-        pos_row = pos_row.at[slot].set(length)
-        return big_cache, token_row, pos_row, first, rng
+            top_k=self.gen.top_k, top_p=self.gen.top_p)
+        token_row = token_row.at[slots].set(firsts)
+        pos_row = pos_row.at[slots].set(lengths)
+        return big_cache, token_row, pos_row, firsts, rng
 
     def _decode_impl(self, params, token, cache, positions, rng, *, n,
                      temperature, top_k, top_p):
@@ -181,27 +194,48 @@ class ContinuousBatcher:
         raise ValueError(f'Prompt length {length} exceeds largest bucket')
 
     def _admit(self) -> None:
-        """Move queued requests into free slots (prefill each)."""
+        """Move queued requests into free slots: admission groups of up
+        to _admit_group requests sharing a prompt bucket prefill in ONE
+        dispatch (G sequential prefills would pay G tunnel round-trips
+        and G full forward launches)."""
         eos = self.gen.eos_token
+
         while self._queue and self._free:
-            req = self._queue.pop(0)
-            slot = self._free.pop(0)
-            bucket = self._bucket_for(len(req.prompt))
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :len(req.prompt)] = np.asarray(req.prompt, np.int32)
-            (self._cache, self._token, self._positions, first,
-             self._rng) = self._prefill_one(
+            group_size = self._admit_group
+            bucket = self._bucket_for(len(self._queue[0].prompt))
+            group: List[_Request] = []
+            while (self._queue and self._free
+                   and len(group) < group_size
+                   and self._bucket_for(len(self._queue[0].prompt))
+                   == bucket):
+                request = self._queue.pop(0)
+                request.slot = self._free.pop(0)
+                group.append(request)
+            # Exact group size: G ∈ {1..admit_group} — bounded compiles
+            # per bucket, no padding-row FLOPs for trickle traffic.
+            effective = len(group)
+            tokens = np.zeros((effective, bucket), np.int32)
+            lengths = np.ones((effective,), np.int32)
+            slots = np.zeros((effective,), np.int32)
+            for i, request in enumerate(group):
+                tokens[i, :len(request.prompt)] = np.asarray(
+                    request.prompt, np.int32)
+                lengths[i] = len(request.prompt)
+                slots[i] = request.slot
+            (self._cache, self._token, self._positions, firsts,
+             self._rng) = self._prefill_group(
                 self.params, jnp.asarray(tokens), self._cache,
-                jnp.int32(len(req.prompt)), slot, self._token,
+                jnp.asarray(lengths), jnp.asarray(slots), self._token,
                 self._positions, self._rng)
-            req.slot = slot
-            self._host_pos[slot] = len(req.prompt)
-            req.out.append(int(first))
-            if (eos is not None and req.out[-1] == eos) or \
-                    len(req.out) >= req.max_new_tokens:
-                self._finish(req)
-            else:
-                self._active[slot] = req
+            firsts = np.asarray(firsts)
+            for i, req in enumerate(group):
+                self._host_pos[req.slot] = len(req.prompt)
+                req.out.append(int(firsts[i]))
+                if (eos is not None and req.out[-1] == eos) or \
+                        len(req.out) >= req.max_new_tokens:
+                    self._finish(req)
+                else:
+                    self._active[req.slot] = req
 
     def _finish(self, req: _Request) -> None:
         req.done = True
