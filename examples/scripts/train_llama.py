@@ -79,7 +79,7 @@ def main() -> None:
     import jax
     from skypilot_tpu.models import llama
     from skypilot_tpu.parallel import (MeshConfig, auto_mesh_config,
-                                       make_mesh)
+                                       make_mesh, make_multislice_mesh)
     from skypilot_tpu.parallel import ring_attention as ring_lib
     from skypilot_tpu.parallel import sharding as sharding_lib
     from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
@@ -97,6 +97,10 @@ def main() -> None:
         }[args.model_size]
 
     n = jax.device_count()
+    # Multislice (env contract sets MEGASCALE_NUM_SLICES per rank): the
+    # dp axis spans the slices over DCN, fsdp/tp/sp stay inside ICI.
+    num_slices = int(os.environ.get(
+        env_contract.MEGASCALE_NUM_SLICES, '1'))
     if args.fsdp or args.dp or args.tp > 1 or args.sp > 1:
         dp = args.dp or max(1, n // (max(args.fsdp, 1) * args.sp * args.tp))
         mesh_config = MeshConfig(dp=dp, fsdp=max(args.fsdp, 1), sp=args.sp,
@@ -104,12 +108,14 @@ def main() -> None:
     else:
         mesh_config = auto_mesh_config(
             n, model_params_b=config.num_params() / 1e9,
-            seq_len=args.seq_len)
-    mesh = make_mesh(mesh_config)
+            seq_len=args.seq_len, num_slices=num_slices)
+    mesh = make_multislice_mesh(mesh_config, num_slices)
     if jax.process_index() == 0:
         print(f'devices={n} {mesh_config} model={args.model_size} '
               f'({config.num_params()/1e9:.2f}B params) '
-              f'seq={args.seq_len}')
+              f'seq={args.seq_len}'
+              + (f' slices={num_slices} (dp over DCN)'
+                 if num_slices > 1 else ''))
 
     attention_fn = None
     if mesh_config.sp > 1:

@@ -222,11 +222,23 @@ class ContinuousBatcher:
                     request.prompt, np.int32)
                 lengths[i] = len(request.prompt)
                 slots[i] = request.slot
-            (self._cache, self._token, self._positions, firsts,
-             self._rng) = self._prefill_group(
-                self.params, jnp.asarray(tokens), self._cache,
-                jnp.asarray(lengths), jnp.asarray(slots), self._token,
-                self._positions, self._rng)
+            try:
+                (self._cache, self._token, self._positions, firsts,
+                 self._rng) = self._prefill_group(
+                    self.params, jnp.asarray(tokens), self._cache,
+                    jnp.asarray(lengths), jnp.asarray(slots),
+                    self._token, self._positions, self._rng)
+            except Exception:
+                # A failed dispatch (fresh compile OOM, device error)
+                # must not leak the group: re-queue the requests at the
+                # front and return their slots, THEN surface the error
+                # (is_done would otherwise spin forever and the slots
+                # would shrink capacity permanently).
+                for request in reversed(group):
+                    self._free.insert(0, request.slot)
+                    request.slot = None
+                    self._queue.insert(0, request)
+                raise
             firsts = np.asarray(firsts)
             for i, req in enumerate(group):
                 self._host_pos[req.slot] = len(req.prompt)

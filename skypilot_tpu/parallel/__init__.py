@@ -1,6 +1,8 @@
-from skypilot_tpu.parallel.mesh import MeshConfig, make_mesh, auto_mesh_config
+from skypilot_tpu.parallel.mesh import (MeshConfig, auto_mesh_config,
+                                         make_mesh, make_multislice_mesh)
 from skypilot_tpu.parallel.sharding import (PartitionRules, shard_params,
                                             constrain)
 
-__all__ = ['MeshConfig', 'make_mesh', 'auto_mesh_config', 'PartitionRules',
+__all__ = ['MeshConfig', 'make_mesh', 'make_multislice_mesh',
+           'auto_mesh_config', 'PartitionRules',
            'shard_params', 'constrain']

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,14 +68,66 @@ def make_mesh(config: MeshConfig,
     return jax.sharding.Mesh(arr, AXES)
 
 
+def make_multislice_mesh(config: MeshConfig, num_slices: int,
+                         devices: Optional[Sequence] = None):
+    """Hybrid ICI×DCN mesh for a multislice job: the dp axis spans the
+    slices over DCN (slice-major blocks — one gradient psum per step
+    crosses DCN), while fsdp/ep/sp/tp stay inside each slice's ICI
+    domain (all-gather/ring/all-to-all traffic never leaves a slice).
+
+    Device→slice assignment uses the TPU runtime's `slice_index`
+    attribute when present; virtual CPU meshes (tests, dry runs) fall
+    back to contiguous grouping.  Requires config.dp % num_slices == 0
+    (the DCN axis must divide dp)."""
+    import jax
+    if num_slices <= 1:
+        return make_mesh(config, devices)
+    if devices is None:
+        devices = jax.devices()
+    if config.num_devices != len(devices):
+        raise ValueError(f'{config} needs {config.num_devices} devices, '
+                         f'have {len(devices)}.')
+    if config.dp % num_slices:
+        raise ValueError(
+            f'dp={config.dp} not divisible by num_slices={num_slices}: '
+            f'the DCN boundary rides the dp axis (put the cross-slice '
+            f'factor in dp; fsdp/tp/sp must stay inside a slice).')
+    per_slice = len(devices) // num_slices
+    by_slice: Dict[int, list] = {}
+    for i, dev in enumerate(devices):
+        slice_id = getattr(dev, 'slice_index', None)
+        if slice_id is None:
+            slice_id = i // per_slice   # virtual-slice fallback
+        by_slice.setdefault(slice_id, []).append(dev)
+    if sorted(len(v) for v in by_slice.values()) != \
+            [per_slice] * num_slices:
+        raise ValueError(
+            f'Uneven slices: {[len(v) for v in by_slice.values()]}')
+    dp_inner = config.dp // num_slices
+    ici_shape = (config.pp, dp_inner, config.fsdp, config.ep,
+                 config.sp, config.tp)
+    # Slice-major blocks along dp: global dp index = slice_id*dp_inner
+    # + inner index, so only dp collectives cross the DCN boundary.
+    blocks = [np.asarray(by_slice[s]).reshape(ici_shape)
+              for s in sorted(by_slice)]
+    arr = np.concatenate(blocks, axis=1)
+    return jax.sharding.Mesh(arr, AXES)
+
+
 def auto_mesh_config(num_devices: int,
                      model_params_b: float = 8.0,
-                     seq_len: int = 8192) -> MeshConfig:
+                     seq_len: int = 8192,
+                     num_slices: int = 1) -> MeshConfig:
     """Heuristic mesh for a given chip count and model scale.
 
     Policy (scaling-book recipe): shard params with fsdp until per-chip
     param+optimizer state fits comfortably; add tp for models too large for
     pure fsdp at small batch; add sp only for long context (>32k); rest dp.
+
+    num_slices > 1 (multislice): dp must carry the DCN boundary
+    (make_multislice_mesh), so fsdp shards move into dp until
+    dp % num_slices == 0 — a slice-unaware config would fail mesh
+    construction on exactly the multislice jobs it is for.
     """
     remaining = num_devices
     tp = 1
@@ -96,4 +148,13 @@ def auto_mesh_config(num_devices: int,
     while fsdp * 2 <= min(remaining, want_fsdp):
         fsdp *= 2
     remaining //= fsdp
-    return MeshConfig(dp=remaining, fsdp=fsdp, sp=sp, tp=tp)
+    dp = remaining
+    while num_slices > 1 and dp % num_slices and fsdp > 1:
+        fsdp //= 2
+        dp *= 2
+    if num_slices > 1 and dp % num_slices:
+        raise ValueError(
+            f'Cannot place {num_slices} slices on the dp axis for '
+            f'{num_devices} devices (dp={dp}); pass an explicit mesh '
+            f'(e.g. --dp {num_slices}).')
+    return MeshConfig(dp=dp, fsdp=fsdp, sp=sp, tp=tp)

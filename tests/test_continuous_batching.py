@@ -92,3 +92,31 @@ def test_eos_frees_slot_early(params):
     b.run_until_idle()
     assert len(b.result(r1)) <= 3
     assert len(b.result(r2)) <= 3
+
+
+def test_admission_failure_leaks_nothing(params):
+    """A failed prefill dispatch re-queues the group and returns the
+    slots (a leak would spin is_done forever and permanently shrink
+    serving capacity)."""
+    b = ContinuousBatcher(params, CFG, _gen_config())
+    rids = [b.submit([3, 4], max_new_tokens=4),
+            b.submit([5, 6], max_new_tokens=4)]
+    original = b._prefill_group
+    calls = {'n': 0}
+
+    def flaky(*args, **kwargs):
+        if calls['n'] == 0:
+            calls['n'] += 1
+            raise RuntimeError('RESOURCE_EXHAUSTED: compile OOM')
+        return original(*args, **kwargs)
+
+    b._prefill_group = flaky
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match='RESOURCE_EXHAUSTED'):
+        b.step()
+    # Nothing leaked: both requests back in the queue, all slots free.
+    assert b.num_queued == 2 and b.num_active == 0
+    assert sorted(b._free) == list(range(2))
+    # The next tick succeeds and both complete.
+    b.run_until_idle()
+    assert all(len(b.result(r)) == 4 for r in rids)
