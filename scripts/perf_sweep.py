@@ -29,12 +29,14 @@ def fused_ce_loss(params, batch, config):
     return llama.loss_fn(params, batch, config)
 
 
-def run(name: str, config, loss, batch_size=8, seq=1024, steps=12):
+def run(name: str, config, loss, batch_size=8, seq=1024, steps=12,
+        mu_dtype=None):
     n_chips = len(jax.devices())
     mesh = make_mesh(MeshConfig(fsdp=n_chips))
     params = llama.init_params(config, jax.random.PRNGKey(0))
     trainer = Trainer(loss, params, mesh, sharding_lib.LLAMA_RULES,
-                      TrainConfig(warmup_steps=2, total_steps=steps))
+                      TrainConfig(warmup_steps=2, total_steps=steps,
+                                  mu_dtype=mu_dtype))
     batches = synthetic_batches(batch_size, seq, config.vocab_size)
     summary = trainer.fit(batches, steps, log_every=0,
                           tokens_per_batch=batch_size * seq)
@@ -85,6 +87,14 @@ def main():
         cfg = dataclasses.replace(BASE, remat_policy='dots')
         run('dots_bs12', cfg, lambda p, b: fused_ce_loss(p, b, cfg),
             batch_size=12)
+    if 'mu_bf16' in which:
+        cfg = dataclasses.replace(BASE, remat_policy='dots')
+        run('mu_bf16', cfg, lambda p, b: fused_ce_loss(p, b, cfg),
+            mu_dtype='bfloat16')
+    if 'mu_bf16_bs12' in which:
+        cfg = dataclasses.replace(BASE, remat_policy='dots')
+        run('mu_bf16_bs12', cfg, lambda p, b: fused_ce_loss(p, b, cfg),
+            batch_size=12, mu_dtype='bfloat16')
 
 
 if __name__ == '__main__':

@@ -32,6 +32,12 @@ class TrainConfig:
     max_grad_norm: float = 1.0
     b1: float = 0.9
     b2: float = 0.95
+    # Adam first-moment dtype.  'bfloat16' halves mu's HBM footprint
+    # and read/write traffic per step — mu is a smoothed gradient
+    # average, where bf16's ~3 decimal digits are ample (nu stays f32:
+    # its values span squared-gradient magnitudes and feed an rsqrt).
+    # None = f32 (exact parity with the classic recipe).
+    mu_dtype: Optional[str] = None
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
@@ -43,7 +49,8 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(config.max_grad_norm),
         optax.adamw(schedule, b1=config.b1, b2=config.b2,
-                    weight_decay=config.weight_decay),
+                    weight_decay=config.weight_decay,
+                    mu_dtype=config.mu_dtype),
     )
 
 

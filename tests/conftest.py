@@ -19,6 +19,14 @@ import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
 jax.config.update('jax_num_cpu_devices', 8)
+# Persistent XLA compilation cache: the suite is compile-heavy on this
+# 1-core box (VERDICT r2 weak #8) and most test programs are identical
+# across runs — reruns skip those compiles.  Safe to delete any time.
+_cache_dir = os.path.join(os.path.dirname(__file__), '..',
+                          '.pytest_cache', 'jax_compilation_cache')
+jax.config.update('jax_compilation_cache_dir',
+                  os.path.abspath(_cache_dir))
+jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.3)
 
 import pytest  # noqa: E402
 

@@ -88,3 +88,26 @@ def test_trainer_checkpoint_roundtrip(tmp_path):
     trainer.restore_checkpoint(str(tmp_path / 'ckpt'), step=1)
     after = jax.tree.map(np.asarray, trainer.params)
     jax.tree.map(np.testing.assert_allclose, before, after)
+
+
+def test_trainer_mu_dtype_bf16():
+    """TrainConfig.mu_dtype='bfloat16' stores Adam's first moment in
+    bf16 (half the mu HBM footprint) and still trains."""
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    trainer = Trainer(lambda p, b: llama.loss_fn(p, b, config), params,
+                      make_mesh(MeshConfig(dp=jax.device_count())),
+                      sharding_lib.LLAMA_RULES,
+                      TrainConfig(warmup_steps=1, total_steps=2,
+                                  mu_dtype='bfloat16'))
+    import optax
+    # tree_get: layout-independent (optax chain internals reorder
+    # across versions).
+    mu = optax.tree_utils.tree_get(trainer.opt_state, 'mu')
+    assert all(leaf.dtype == jnp.bfloat16
+               for leaf in jax.tree.leaves(mu))
+    nu = optax.tree_utils.tree_get(trainer.opt_state, 'nu')
+    assert all(leaf.dtype == jnp.float32
+               for leaf in jax.tree.leaves(nu))
+    batch = next(synthetic_batches(8, 32, config.vocab_size))
+    assert np.isfinite(float(trainer.run_step(batch)['loss']))
