@@ -357,12 +357,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     from skypilot_tpu import exceptions
     try:
-        return args.fn(args)
+        rc = args.fn(args)
+        # Flush INSIDE the try: in default-buffered Python the whole
+        # output may still sit in the stdout buffer here, and a closed
+        # pipe would otherwise only surface at interpreter-shutdown
+        # flush — past this handler.
+        sys.stdout.flush()
+        return rc
     except exceptions.SkyTpuError as e:
         print(f'Error: {e}', file=sys.stderr)
         return 1
     except KeyboardInterrupt:
         return 130
+    except BrokenPipeError:
+        # `skytpu ... | head` closes our stdout mid-write; that is the
+        # consumer's prerogative, not an error.  Redirect stdout to
+        # devnull so the interpreter's shutdown flush cannot raise a
+        # second time, and exit with the conventional 128+SIGPIPE.
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 141
 
 
 if __name__ == '__main__':

@@ -72,3 +72,20 @@ def test_workspaces_cli_crud(tmp_home, capsys):
     capsys.readouterr()  # drop the delete echo line
     cli.main(['workspaces', 'list'])
     assert 'team-a' not in capsys.readouterr().out
+
+
+def test_cli_handles_broken_pipe(tmp_home):
+    """`skytpu show-tpus | head` must exit 141 quietly, not traceback —
+    the consumer closing the pipe is its prerogative.  Deterministic:
+    `head -c 0` exits before the CLI writes anything, so the write/flush
+    inside main()'s try ALWAYS hits a closed pipe."""
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        ['bash', '-c',
+         f'{sys.executable} -m skypilot_tpu.client.cli show-tpus '
+         f'| head -c 0; echo "cli_rc=${{PIPESTATUS[0]}}"'],
+        capture_output=True, text=True, timeout=120)
+    assert 'cli_rc=141' in proc.stdout, proc.stdout
+    assert 'Traceback' not in proc.stderr
+    assert 'BrokenPipeError' not in proc.stderr
