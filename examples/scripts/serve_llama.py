@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import time
 
 
@@ -47,21 +48,40 @@ class BatcherDriver:
         self.abandoned = set()    # rids whose client went away
         threading.Thread(target=self._loop, daemon=True).start()
 
+    @staticmethod
+    def _fatal_if_channel_broken(e: BaseException) -> None:
+        """A lost multi-host control peer is unrecoverable: exit so the
+        replica manager's probe fails and the whole replica is replaced.
+        Limping on would serve 500s forever behind a green /health."""
+        from skypilot_tpu.infer.multihost import ChannelBrokenError
+        if isinstance(e, ChannelBrokenError):
+            print(f'FATAL: {e}; exiting so the replica is replaced.',
+                  flush=True)
+            os._exit(70)
+
     def submit(self, prompt, max_new):
         import threading
-        with self.lock:
-            rid = self.batcher.submit(prompt, max_new_tokens=max_new)
-            ev = threading.Event()
-            self.done_events[rid] = ev
+        try:
+            with self.lock:
+                rid = self.batcher.submit(prompt, max_new_tokens=max_new)
+                ev = threading.Event()
+                self.done_events[rid] = ev
+        except Exception as e:
+            self._fatal_if_channel_broken(e)
+            raise
         self.wake.set()
         return rid, ev
 
     def result(self, rid):
-        with self.lock:
-            self.done_events.pop(rid, None)
-            if rid in self.failed:
-                raise RuntimeError(self.failed.pop(rid))
-            return self.batcher.result(rid)
+        try:
+            with self.lock:
+                self.done_events.pop(rid, None)
+                if rid in self.failed:
+                    raise RuntimeError(self.failed.pop(rid))
+                return self.batcher.result(rid)
+        except Exception as e:
+            self._fatal_if_channel_broken(e)
+            raise
 
     def abandon(self, rid):
         """Client went away mid-flight: reap the request's bookkeeping as
@@ -78,13 +98,27 @@ class BatcherDriver:
                 pass
 
     def _loop(self):
+        idle_since = time.monotonic()
+        ping = getattr(self.batcher, 'ping', None)
         while True:
             with self.lock:
                 busy = self.batcher.num_active or self.batcher.num_queued
             if not busy:
+                # Multi-host replica: ping workers while idle so a dead
+                # host is noticed now, not on the next user request.
+                if ping is not None and \
+                        time.monotonic() - idle_since > 5.0:
+                    idle_since = time.monotonic()
+                    try:
+                        with self.lock:
+                            ping()
+                    except Exception as e:
+                        self._fatal_if_channel_broken(e)
+                        raise
                 self.wake.wait(timeout=0.05)
                 self.wake.clear()
                 continue
+            idle_since = time.monotonic()
             with self.lock:
                 try:
                     self.batcher.step()
@@ -92,6 +126,7 @@ class BatcherDriver:
                     # requests as HTTP errors and KEEP SERVING — a dead
                     # scheduler thread would hang every future request
                     # while /health still answered OK.
+                    self._fatal_if_channel_broken(e)
                     msg = f'engine error: {e!r}'
                     for rid, ev in list(self.done_events.items()):
                         self.failed[rid] = msg
@@ -107,7 +142,8 @@ class BatcherDriver:
 
 
 def build_generator(model_size: str, max_seq_len: int, temperature: float,
-                    hf_model: str = '', batch_size: int = 4, tp: int = 1):
+                    hf_model: str = '', batch_size: int = 4, tp: int = 1,
+                    mesh=None):
     import jax
     import jax.numpy as jnp
 
@@ -115,8 +151,7 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
     from skypilot_tpu.infer.serving import ContinuousBatcher
     from skypilot_tpu.models import llama
 
-    mesh = None
-    if tp > 1:
+    if mesh is None and tp > 1:
         # Megatron-sharded decode over a tp mesh (infer/tp.py): the
         # TPU-native analog of the reference's vLLM
         # --tensor-parallel-size recipes (llm/vllm/service.yaml).
@@ -182,11 +217,61 @@ def main() -> int:
                         help='tensor-parallel degree: shard params + KV '
                              'cache over this many chips so models '
                              'larger than one chip\'s HBM can serve')
+    parser.add_argument('--devices-per-host', type=int, default=0,
+                        help='CPU-emulation only: virtual devices per '
+                             'host process (real TPU hosts discover '
+                             'their chips)')
+    parser.add_argument('--control-port', type=int, default=0,
+                        help='multi-host scheduler control port '
+                             '(default: coordinator port + 2)')
     args = parser.parse_args()
 
+    # Multi-host replica (infer/multihost.py): every host of the replica
+    # slice runs this same script under the gang env contract; decode is
+    # sharded over ONE global mesh spanning all hosts' chips, and only
+    # the head (process 0) binds the HTTP socket.  The TPU-native analog
+    # of the reference's vLLM tensor-parallel replicas
+    # (llm/vllm/service.yaml).
+    from skypilot_tpu.infer import multihost
+    if args.devices_per_host:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        jax.config.update('jax_num_cpu_devices', args.devices_per_host)
+    info = multihost.initialize_from_env()
+    mesh = None
+    if info['num_hosts'] > 1:
+        # Replica teardown must not block on jax.distributed's atexit
+        # barrier: once any peer host is killed, the barrier can never
+        # complete, and the agent only sends SIGTERM.  A replica holds
+        # no durable state (the controller owns service state), so a
+        # hard exit is correct.  Registered AFTER distributed init:
+        # jax.distributed installs a C++ preemption-notifier SIGTERM
+        # handler that would otherwise swallow the signal.
+        import signal
+        signal.signal(signal.SIGTERM, lambda *a: os._exit(143))
+        signal.signal(signal.SIGINT, lambda *a: os._exit(130))
+        mesh = multihost.make_replica_mesh()
     gen, config, tokenizer = build_generator(
         args.model_size, args.max_seq_len, args.temperature,
-        args.hf_model, args.batch_size, args.tp)
+        args.hf_model, args.batch_size,
+        args.tp if mesh is None else mesh.size, mesh=mesh)
+    if info['num_hosts'] > 1:
+        control_port = args.control_port or info['control_port']
+        if info['host_id'] != 0:
+            # Worker host: replay the head's scheduler stream forever
+            # (exits when the head broadcasts shutdown / hangs up).
+            channel = multihost.ControlChannel.connect(
+                info['coordinator_host'], control_port)
+            print(json.dumps({'multihost_worker': info['host_id'],
+                              'hosts': info['num_hosts']}), flush=True)
+            try:
+                multihost.worker_loop(gen, channel)
+            except ConnectionError:
+                pass  # head exited: the replica is going down
+            os._exit(0)  # skip the unjoinable distributed atexit barrier
+        channel = multihost.ControlChannel.head(
+            control_port, info['num_hosts'] - 1)
+        gen = multihost.MultiHostBatcher(gen, channel)
     # Compile prefill + decode now so the readiness probe reflects
     # readiness instead of the first request eating the compiles.
     warm = gen.submit([1, 1], max_new_tokens=2)
@@ -270,7 +355,12 @@ def main() -> int:
     app.router.add_get('/health', health)
     app.router.add_post('/generate', generate)
     print(json.dumps({'serving': args.model_size, 'port': args.port}))
-    web.run_app(app, host='0.0.0.0', port=args.port, print=None)
+    # Multi-host head: handle_signals=False keeps OUR SIGTERM handler
+    # (aiohttp's graceful shutdown would deadlock in the jax.distributed
+    # atexit barrier once any peer host is killed).  Single-host
+    # replicas keep aiohttp's graceful shutdown.
+    web.run_app(app, host='0.0.0.0', port=args.port, print=None,
+                handle_signals=(info['num_hosts'] == 1))
     return 0
 
 

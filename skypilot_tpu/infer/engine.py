@@ -96,10 +96,11 @@ class Generator:
                               top_k=gen_config.top_k,
                               top_p=gen_config.top_p),
             static_argnames=('n',))
-        self._sample = jax.jit(functools.partial(
-            sampling.sample_logits,
-            temperature=gen_config.temperature,
-            top_k=gen_config.top_k, top_p=gen_config.top_p))
+        self._sample = jax.jit(lambda logits, rng: tp_lib.replicate(
+            sampling.sample_logits(
+                logits, rng, temperature=gen_config.temperature,
+                top_k=gen_config.top_k, top_p=gen_config.top_p),
+            self.mesh))
 
     def _prefill_impl(self, params, tokens, cache, lengths):
         logits, cache = llama_infer.prefill(
@@ -128,8 +129,8 @@ class Generator:
 
         (token, cache, positions, rng), toks = jax.lax.scan(
             step, (token, cache, positions, rng), None, length=n)
-        return (jnp.swapaxes(toks, 0, 1), token, self._constrain(cache),
-                positions, rng)
+        toks = tp_lib.replicate(jnp.swapaxes(toks, 0, 1), self.mesh)
+        return toks, token, self._constrain(cache), positions, rng
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:

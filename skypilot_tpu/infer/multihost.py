@@ -1,0 +1,342 @@
+"""Multi-host sharded decode: one serving replica spans every host of its
+TPU slice.
+
+Why: a v5e host addresses 8 chips (~128 GB HBM); a 70B bf16 model with a
+real KV cache cannot serve from one host at all.  The reference reaches
+the same capability with vLLM tensor-parallel recipes spanning all GPUs
+of a replica (reference parity: llm/vllm/service.yaml sets
+--tensor-parallel-size; sky/backends/cloud_vm_ray_backend.py:6306 treats
+a TPU slice's hosts as one logical node).  The TPU-native design has no
+external engine to delegate to — decode itself spans hosts:
+
+- every host of the replica slice calls ``jax.distributed.initialize``
+  (from the gang env contract, utils/env_contract.py) and joins ONE
+  global ('tp',) mesh over ``jax.devices()`` — all chips of all hosts;
+- the model/KV shardings are unchanged (infer/tp.py megatron rules):
+  GSPMD partitions the same jitted prefill/decode over the global mesh,
+  inserting ICI collectives that now also cross hosts;
+- the scheduler runs SPMD **on the host side too**: every host executes
+  the identical ContinuousBatcher call sequence, so every host issues
+  the identical XLA programs in the same order (a requirement of
+  multi-controller JAX).  The head host (process 0) owns the HTTP
+  socket and broadcasts each scheduler call (submit/step/result) over a
+  TCP control channel before executing it locally; workers replay.
+
+Determinism contract: every value the scheduler's host logic branches on
+(sampled tokens) is constrained to a fully-replicated layout before it
+leaves jit (infer/tp.py:replicate), so all hosts fetch identical values
+and their host-side control flow cannot diverge.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import socket
+import struct
+from typing import Any, List, Optional, Sequence
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import env_contract
+
+logger = sky_logging.init_logger(__name__)
+
+# Control-channel port offset from the jax.distributed coordinator port:
+# the contract only reserves one port, and head:coordinator+2 is free by
+# construction (+1 is the MEGASCALE coordinator on multislice jobs).
+CONTROL_PORT_OFFSET = 2
+
+
+def initialize_from_env(timeout_s: Optional[int] = None) -> dict:
+    """Join the replica's process group from the gang env contract.
+
+    Returns {num_hosts, host_id, coordinator_host, control_port} — a
+    single-host replica returns num_hosts=1 without touching
+    jax.distributed.
+    """
+    num_hosts = int(os.environ.get(env_contract.NUM_PROCESSES, '1'))
+    host_id = int(os.environ.get(env_contract.PROCESS_ID, '0'))
+    coord = os.environ.get(env_contract.COORDINATOR_ADDRESS, '')
+    if num_hosts > 1:
+        env_contract.initialize_from_env(timeout_s=timeout_s)
+    if coord:
+        host, port = coord.rsplit(':', 1)
+        control_port = int(port) + CONTROL_PORT_OFFSET
+    else:
+        host, control_port = '127.0.0.1', 0
+    return {'num_hosts': num_hosts, 'host_id': host_id,
+            'coordinator_host': host, 'control_port': control_port}
+
+
+def make_replica_mesh(tp: Optional[int] = None):
+    """1-axis ('tp',) mesh over ALL devices of the replica — every chip
+    of every host (contrast infer/tp.py:make_tp_mesh, which stays within
+    jax.local_devices() for single-host serving).  Requires
+    jax.distributed to be initialized on every host first."""
+    import jax
+    import numpy as np
+    devices = jax.devices()
+    tp = tp or len(devices)
+    if tp != len(devices):
+        # A strict subset would leave some hosts' chips idle but still
+        # participating in nothing — reject rather than half-use a slice.
+        raise ValueError(
+            f'multi-host replica must use every chip: tp={tp} but the '
+            f'replica has {len(devices)} devices')
+    return jax.sharding.Mesh(np.asarray(devices), ('tp',))
+
+
+# ---------------------------------------------------------------------------
+# Control channel: head broadcasts scheduler commands to workers.
+# ---------------------------------------------------------------------------
+
+
+class ChannelBrokenError(RuntimeError):
+    """A control-channel peer is gone: the replica's SPMD streams can no
+    longer stay in lockstep.  Fatal for the whole replica — the serving
+    process must exit so the replica manager replaces it."""
+
+
+def _auth_token() -> bytes:
+    """Shared worker-admission token derived from the gang env contract
+    (every host of the replica has the identical contract; nothing else
+    on the network does).  SKYTPU_CONTROL_TOKEN overrides for deployments
+    that provision a real secret."""
+    explicit = os.environ.get('SKYTPU_CONTROL_TOKEN', '')
+    seed = explicit or '|'.join((
+        os.environ.get(env_contract.TASK_ID, ''),
+        os.environ.get(env_contract.COORDINATOR_ADDRESS, ''),
+        os.environ.get(env_contract.NODE_IPS, ''),
+    ))
+    return hashlib.sha256(('skytpu-control:' + seed).encode()).digest()
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(struct.pack('>I', len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b''
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError('control channel closed')
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (length,) = struct.unpack('>I', _recv_exact(sock, 4))
+    op, args = json.loads(_recv_exact(sock, length).decode())
+    return op, tuple(args)
+
+
+class ControlChannel:
+    """Head→workers command broadcast (TCP, length-prefixed JSON).
+
+    The payloads are scheduler commands (method name + ints/lists), not
+    tensors: tensor traffic rides the ICI/DCN collectives inside jit.
+    JSON, not pickle: a control port must never be a deserialization
+    gadget.  Admission is gated by a shared-token handshake (see
+    _auth_token) so a stray network peer can neither occupy a worker
+    slot nor receive prompt broadcasts.
+    """
+
+    def __init__(self, role: str, socks: List[socket.socket]):
+        self.role = role
+        self._socks = socks
+
+    @classmethod
+    def head(cls, port: int, num_workers: int,
+             timeout_s: float = 120.0) -> 'ControlChannel':
+        import time
+        token = _auth_token()
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(('0.0.0.0', port))
+        server.listen(num_workers + 4)
+        deadline = time.monotonic() + timeout_s
+        socks: List[socket.socket] = []
+        try:
+            while len(socks) < num_workers:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionError(
+                        f'only {len(socks)}/{num_workers} workers '
+                        f'authenticated within {timeout_s}s')
+                server.settimeout(remaining)
+                conn, addr = server.accept()
+                try:
+                    conn.settimeout(10.0)
+                    presented = _recv_exact(conn, len(token))
+                    if not hmac.compare_digest(presented, token):
+                        raise ConnectionError('bad token')
+                    conn.settimeout(None)
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except (ConnectionError, OSError) as e:
+                    logger.warning(
+                        f'control: rejected peer {addr}: {e}')
+                    conn.close()
+                    continue
+                socks.append(conn)
+                logger.info(f'control: worker connected from {addr}')
+        except Exception:
+            for sock in socks:
+                sock.close()
+            raise
+        finally:
+            server.close()
+        return cls('head', socks)
+
+    @classmethod
+    def connect(cls, host: str, port: int,
+                timeout_s: float = 120.0) -> 'ControlChannel':
+        import time
+        deadline = time.monotonic() + timeout_s
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(None)
+                sock.sendall(_auth_token())
+                return cls('worker', [sock])
+            except OSError as e:  # head not listening yet
+                last_err = e
+                time.sleep(0.2)
+        raise ConnectionError(
+            f'control channel connect to {host}:{port} timed out: '
+            f'{last_err}')
+
+    def broadcast(self, obj: Any) -> None:
+        assert self.role == 'head'
+        try:
+            for sock in self._socks:
+                _send_msg(sock, obj)
+        except OSError as e:
+            raise ChannelBrokenError(
+                f'worker control connection lost: {e}') from e
+
+    def recv(self) -> Any:
+        assert self.role == 'worker'
+        return _recv_msg(self._socks[0])
+
+    def close(self) -> None:
+        for sock in self._socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# SPMD scheduler: identical ContinuousBatcher call sequence on every host.
+# ---------------------------------------------------------------------------
+
+# Batcher methods that touch device state — these MUST replay on every
+# host in order (each one dispatches XLA programs / mutates the shared
+# scheduler state that decides future dispatches).  'ping' is a liveness
+# no-op the head sends while idle so a dead worker is noticed before the
+# next real request.
+_MUTATING = ('submit', 'step', 'result', 'ping')
+
+
+class MultiHostBatcher:
+    """Head-side proxy: broadcast each mutating scheduler call, then run
+    it locally.  Pure reads (is_done, num_active, ...) stay local — the
+    SPMD invariant makes every host's copy identical anyway.
+
+    Drop-in for ContinuousBatcher in the replica server (the
+    BatcherDriver in examples/scripts/serve_llama.py drives either).
+    """
+
+    def __init__(self, batcher, channel: ControlChannel):
+        assert channel.role == 'head'
+        self._batcher = batcher
+        self._channel = channel
+
+    # -- mutating (local first, then broadcast) --
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 64) -> int:
+        # Local call FIRST: submit/result are host-only bookkeeping (no
+        # device dispatch), and their validation errors (bad prompt
+        # length, unknown rid) must stay local — broadcasting an invalid
+        # call would raise the same error on every worker, which is
+        # fatal there (worker_loop), bricking the replica on one bad
+        # user request.
+        prompt = [int(t) for t in prompt]
+        rid = self._batcher.submit(prompt, max_new_tokens=max_new_tokens)
+        self._channel.broadcast(('submit', (prompt, int(max_new_tokens))))
+        return rid
+
+    def step(self) -> None:
+        # Broadcast first: step dispatches collective XLA programs, so
+        # workers should start theirs concurrently (it cannot fail
+        # host-side validation — no args).
+        self._channel.broadcast(('step', ()))
+        self._batcher.step()
+
+    def result(self, rid: int) -> List[int]:
+        out = self._batcher.result(rid)
+        self._channel.broadcast(('result', (int(rid),)))
+        return out
+
+    def ping(self) -> None:
+        """Liveness probe: raises ChannelBrokenError if a worker died.
+        The serving driver calls this while idle — without it a dead
+        worker is only noticed on the next request's broadcast."""
+        self._channel.broadcast(('ping', ()))
+
+    def run_until_idle(self, max_ticks: int = 10_000) -> None:
+        # In terms of self.step() so every tick broadcasts.
+        for _ in range(max_ticks):
+            if not self._batcher.num_queued and not self._batcher.num_active:
+                return
+            self.step()
+        raise RuntimeError('run_until_idle exceeded max_ticks')
+
+    def shutdown(self) -> None:
+        self._channel.broadcast(('shutdown', ()))
+        self._channel.close()
+
+    # -- pure reads (local) --
+    def is_done(self, rid: int) -> bool:
+        return self._batcher.is_done(rid)
+
+    @property
+    def num_active(self) -> int:
+        return self._batcher.num_active
+
+    @property
+    def num_queued(self) -> int:
+        return self._batcher.num_queued
+
+
+def worker_loop(batcher, channel: ControlChannel) -> None:
+    """Non-head hosts: replay the head's scheduler calls until shutdown.
+
+    Any exception here is fatal for the replica (the SPMD streams have
+    diverged); let it propagate so the gang driver surfaces the failure
+    and the replica manager replaces the replica.
+    """
+    assert channel.role == 'worker'
+    while True:
+        op, args = channel.recv()
+        if op == 'shutdown':
+            channel.close()
+            return
+        if op not in _MUTATING:
+            raise RuntimeError(f'unexpected control op {op!r}')
+        if op == 'ping':
+            continue
+        if op == 'result':
+            # Discard: pops the request from the local mirror so worker
+            # state keeps matching the head's.
+            batcher.result(*args)
+        elif op == 'submit':
+            prompt, max_new = args
+            batcher.submit(prompt, max_new_tokens=max_new)
+        else:
+            batcher.step()

@@ -1,0 +1,152 @@
+"""Multi-host decode parity harness.
+
+Emulates a multi-host serving replica with N local PROCESSES (one per
+"host", each owning ``devices_per_host`` virtual CPU devices) joined via
+``jax.distributed`` + gloo collectives — the same multi-controller
+topology a real TPU slice has, minus the ICI.  The head process submits
+prompts through the MultiHostBatcher control channel; every process runs
+the identical SPMD scheduler (infer/multihost.py); greedy outputs must
+equal a single-process baseline.
+
+Used by the driver's ``dryrun_multichip`` and by
+tests/test_multihost_decode.py.  Reference capability being proven:
+llm/vllm/service.yaml tensor-parallel serving across all GPUs of a
+replica.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+PROMPTS = [[5, 9, 2, 7], [11, 3]]
+MAX_NEW = 8
+_SEED = 2
+
+
+def _model(num_devices: int):
+    """Tiny f32 llama whose axes divide over num_devices tp shards."""
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    return llama.LlamaConfig(
+        vocab_size=512, d_model=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=256, max_seq_len=512, dtype=jnp.float32, remat=False)
+
+
+def _gen_config():
+    from skypilot_tpu.infer import GeneratorConfig
+    return GeneratorConfig(max_seq_len=64, batch_size=2, temperature=0.0,
+                           prompt_buckets=[16])
+
+
+def baseline_decode() -> List[List[int]]:
+    """Single-process, unsharded greedy decode of PROMPTS."""
+    import jax
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    config = _model(1)
+    params = llama.init_params(config, jax.random.PRNGKey(_SEED))
+    batcher = ContinuousBatcher(params, config, _gen_config())
+    rids = [batcher.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    batcher.run_until_idle()
+    return [batcher.result(r) for r in rids]
+
+
+def _host_main(host_id: int, num_hosts: int, devices_per_host: int,
+               coord_port: int, control_port: int) -> None:
+    """One emulated host (runs in its own process)."""
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_num_cpu_devices', devices_per_host)
+    jax.distributed.initialize(
+        coordinator_address=f'127.0.0.1:{coord_port}',
+        num_processes=num_hosts, process_id=host_id)
+
+    from skypilot_tpu.infer import multihost
+    from skypilot_tpu.infer import tp as tp_lib
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+
+    mesh = multihost.make_replica_mesh()
+    config = _model(num_hosts * devices_per_host)
+    params = tp_lib.init_sharded_params(config, jax.random.PRNGKey(_SEED),
+                                        mesh)
+    batcher = ContinuousBatcher(params, config, _gen_config(), mesh=mesh)
+
+    if host_id == 0:
+        channel = multihost.ControlChannel.head(control_port,
+                                                num_hosts - 1)
+        spmd = multihost.MultiHostBatcher(batcher, channel)
+        rids = [spmd.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+        spmd.run_until_idle()
+        outs = [spmd.result(r) for r in rids]
+        spmd.shutdown()
+        print('MULTIHOST_RESULT ' + json.dumps(outs), flush=True)
+    else:
+        channel = multihost.ControlChannel.connect('127.0.0.1',
+                                                   control_port)
+        multihost.worker_loop(batcher, channel)
+
+
+def run_check(num_hosts: int = 2, devices_per_host: int = 2,
+              timeout_s: float = 600.0,
+              baseline: Optional[Sequence[Sequence[int]]] = None,
+              ) -> List[List[int]]:
+    """Spawn the emulated hosts, return (and verify) the head's outputs.
+
+    ``baseline``: pass a pre-computed baseline_decode() result to skip
+    recomputing it (the driver's dryrun computes it in-process).
+    """
+    from skypilot_tpu.utils import common_utils
+    coord_port = common_utils.find_free_port(20000)
+    control_port = common_utils.find_free_port(coord_port + 1)
+
+    env = dict(os.environ)
+    # The pytest/driver XLA_FLAGS (forced host device count) leaks into
+    # children and would override devices_per_host; scrub it.
+    env.pop('XLA_FLAGS', None)
+    env['JAX_PLATFORMS'] = 'cpu'
+
+    procs = []
+    for host_id in range(num_hosts):
+        procs.append(subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.infer.multihost_check',
+             str(host_id), str(num_hosts), str(devices_per_host),
+             str(coord_port), str(control_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env))
+    outputs = []
+    try:
+        for proc in procs:
+            out, _ = proc.communicate(timeout=timeout_s)
+            outputs.append(out)
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+    for host_id, (proc, out) in enumerate(zip(procs, outputs)):
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f'multihost check host {host_id} failed '
+                f'(rc={proc.returncode}):\n{out[-4000:]}')
+    head_out = outputs[0]
+    for line in head_out.splitlines():
+        if line.startswith('MULTIHOST_RESULT '):
+            result = json.loads(line[len('MULTIHOST_RESULT '):])
+            break
+    else:
+        raise RuntimeError(f'no result line from head:\n{head_out[-4000:]}')
+    expected = list(map(list, baseline)) if baseline is not None \
+        else baseline_decode()
+    if result != expected:
+        raise AssertionError(
+            f'multi-host decode diverged from single-process baseline: '
+            f'{result} vs {expected}')
+    return result
+
+
+if __name__ == '__main__':
+    _host_main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+               int(sys.argv[4]), int(sys.argv[5]))

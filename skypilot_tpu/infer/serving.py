@@ -124,9 +124,9 @@ class ContinuousBatcher:
             big_cache[key] = big_cache[key].at[:, slots].set(small[key])
         big_cache = tp_lib.constrain_cache(big_cache, self.mesh)
         rng, sub = jax.random.split(rng)
-        firsts = sampling.sample_logits(
+        firsts = tp_lib.replicate(sampling.sample_logits(
             logits, sub, temperature=self.gen.temperature,
-            top_k=self.gen.top_k, top_p=self.gen.top_p)
+            top_k=self.gen.top_k, top_p=self.gen.top_p), self.mesh)
         token_row = token_row.at[slots].set(firsts)
         pos_row = pos_row.at[slots].set(lengths)
         return big_cache, token_row, pos_row, firsts, rng
@@ -146,7 +146,8 @@ class ContinuousBatcher:
         (token, cache, positions, rng), toks = jax.lax.scan(
             step, (token, cache, positions, rng), None, length=n)
         cache = tp_lib.constrain_cache(cache, self.mesh)
-        return jnp.swapaxes(toks, 0, 1), token, cache, positions, rng
+        toks = tp_lib.replicate(jnp.swapaxes(toks, 0, 1), self.mesh)
+        return toks, token, cache, positions, rng
 
     # ---- public API ------------------------------------------------------
     def submit(self, prompt: Sequence[int],

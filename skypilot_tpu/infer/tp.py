@@ -111,6 +111,22 @@ def cache_sharding(mesh) -> NamedSharding:
     return NamedSharding(mesh, CACHE_SPEC)
 
 
+def replicate(x, mesh):
+    """Constrain x to a fully-replicated layout (usable inside jit).
+
+    Applied to every value the scheduler's HOST logic reads (sampled
+    tokens): on a single host this is a no-op XLA already picks; on a
+    multi-host replica (infer/multihost.py) it is the determinism
+    contract — a fully-replicated jax.Array is fetchable from every
+    process and identical on all of them, so host-side control flow
+    cannot diverge across the SPMD hosts."""
+    if mesh is None:
+        return x
+    import jax
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P()))
+
+
 def constrain_cache(cache, mesh):
     """with_sharding_constraint on a cache pytree — usable inside jit to
     pin the kv-head sharding through scans (GSPMD usually propagates it,

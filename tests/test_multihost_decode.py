@@ -1,0 +1,214 @@
+"""Multi-host sharded decode (infer/multihost.py).
+
+Unit layer: the control channel and the SPMD scheduler replay contract,
+with fake batchers (no jax).  Integration layer (slow): N real processes
+joined via jax.distributed on CPU, greedy parity with a single-process
+baseline (multihost_check).  Reference capability:
+llm/vllm/service.yaml tensor-parallel serving spanning a whole replica.
+"""
+import threading
+
+import pytest
+
+from skypilot_tpu.infer import multihost
+from skypilot_tpu.utils import common_utils
+
+
+class FakeBatcher:
+    """Records the scheduler call stream; returns canned results."""
+
+    def __init__(self):
+        self.calls = []
+        self._next = 1
+        self.num_active = 0
+        self.num_queued = 0
+
+    def submit(self, prompt, max_new_tokens=64):
+        self.calls.append(('submit', list(prompt), max_new_tokens))
+        rid = self._next
+        self._next += 1
+        return rid
+
+    def step(self):
+        self.calls.append(('step',))
+
+    def result(self, rid):
+        self.calls.append(('result', rid))
+        return [7, 8, 9]
+
+    def is_done(self, rid):
+        return True
+
+
+def _head_worker_pair():
+    port = common_utils.find_free_port(21000)
+    out = {}
+
+    def accept():
+        out['head'] = multihost.ControlChannel.head(port, 1)
+
+    t = threading.Thread(target=accept)
+    t.start()
+    worker = multihost.ControlChannel.connect('127.0.0.1', port)
+    t.join(timeout=10)
+    return out['head'], worker
+
+
+def test_control_channel_roundtrip():
+    head, worker = _head_worker_pair()
+    try:
+        head.broadcast(('submit', ([1, 2, 3], 16)))
+        head.broadcast(('step', ()))
+        assert worker.recv() == ('submit', ([1, 2, 3], 16))
+        assert worker.recv() == ('step', ())
+    finally:
+        head.close()
+        worker.close()
+
+
+def test_control_channel_closed_raises():
+    head, worker = _head_worker_pair()
+    head.close()
+    with pytest.raises(ConnectionError):
+        worker.recv()
+    worker.close()
+
+
+def test_spmd_replay_mirrors_call_stream():
+    """Every mutating call on the head replays on the worker, in order —
+    the invariant that keeps the multi-controller XLA dispatch streams
+    identical."""
+    head_ch, worker_ch = _head_worker_pair()
+    head_b, worker_b = FakeBatcher(), FakeBatcher()
+    spmd = multihost.MultiHostBatcher(head_b, head_ch)
+
+    done = threading.Event()
+
+    def run_worker():
+        multihost.worker_loop(worker_b, worker_ch)
+        done.set()
+
+    t = threading.Thread(target=run_worker, daemon=True)
+    t.start()
+
+    rid = spmd.submit([4, 5], max_new_tokens=8)
+    spmd.step()
+    assert spmd.result(rid) == [7, 8, 9]
+    assert spmd.is_done(rid)  # pure read: no broadcast
+    spmd.shutdown()
+    assert done.wait(timeout=10), 'worker_loop did not exit on shutdown'
+    assert worker_b.calls == head_b.calls == [
+        ('submit', [4, 5], 8), ('step',), ('result', rid)]
+
+
+def test_head_rejects_bad_token(monkeypatch):
+    """An unauthenticated peer neither occupies a worker slot nor
+    receives broadcasts; the real worker still connects."""
+    import socket as socket_lib
+    port = common_utils.find_free_port(21000)
+    out = {}
+
+    def accept():
+        out['head'] = multihost.ControlChannel.head(port, 1, timeout_s=30)
+
+    t = threading.Thread(target=accept)
+    t.start()
+    # Stranger with the wrong token: must be rejected.  (Retry loop:
+    # the head thread may not have bound the port yet.)
+    import time as time_lib
+    deadline = time_lib.monotonic() + 15
+    while True:
+        try:
+            stranger = socket_lib.create_connection(('127.0.0.1', port),
+                                                    timeout=10)
+            break
+        except OSError:
+            if time_lib.monotonic() > deadline:
+                raise
+            time_lib.sleep(0.1)
+    stranger.sendall(b'\x00' * 32)
+    # Real worker authenticates fine afterwards.
+    worker = multihost.ControlChannel.connect('127.0.0.1', port)
+    t.join(timeout=15)
+    assert 'head' in out
+    try:
+        out['head'].broadcast(('ping', ()))
+        assert worker.recv() == ('ping', ())
+        # The stranger's socket was closed by the head.
+        stranger.settimeout(5)
+        assert stranger.recv(1) == b''
+    finally:
+        out['head'].close()
+        worker.close()
+        stranger.close()
+
+
+def test_ping_liveness_and_broken_channel():
+    """ping is a worker no-op; once the worker dies, any broadcast
+    raises ChannelBrokenError (the head must then exit so the replica is
+    replaced)."""
+    head_ch, worker_ch = _head_worker_pair()
+    spmd = multihost.MultiHostBatcher(FakeBatcher(), head_ch)
+    spmd.ping()
+    assert worker_ch.recv() == ('ping', ())
+    worker_ch.close()
+    with pytest.raises(multihost.ChannelBrokenError):
+        for _ in range(50):  # buffered sends may take a few broadcasts
+            spmd.ping()
+    head_ch.close()
+
+
+def test_submit_validation_error_stays_local():
+    """An invalid submit must raise on the head WITHOUT broadcasting —
+    workers replaying it would die (worker errors are fatal by
+    design)."""
+
+    class RejectingBatcher(FakeBatcher):
+
+        def submit(self, prompt, max_new_tokens=64):
+            raise ValueError('prompt too long')
+
+    head_ch, worker_ch = _head_worker_pair()
+    spmd = multihost.MultiHostBatcher(RejectingBatcher(), head_ch)
+    try:
+        with pytest.raises(ValueError):
+            spmd.submit([1] * 100, max_new_tokens=4)
+        # Nothing was broadcast: the next message the worker sees is the
+        # explicit ping, not the failed submit.
+        spmd.ping()
+        assert worker_ch.recv() == ('ping', ())
+    finally:
+        head_ch.close()
+        worker_ch.close()
+
+
+def test_worker_loop_rejects_unknown_op():
+    head_ch, worker_ch = _head_worker_pair()
+    try:
+        head_ch.broadcast(('reboot', ()))
+        with pytest.raises(RuntimeError, match='unexpected control op'):
+            multihost.worker_loop(FakeBatcher(), worker_ch)
+    finally:
+        head_ch.close()
+        worker_ch.close()
+
+
+def test_make_replica_mesh_rejects_partial_use():
+    """A multi-host replica must use every chip — a strict subset would
+    strand whole hosts."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip('needs >1 device')
+    with pytest.raises(ValueError, match='every chip'):
+        multihost.make_replica_mesh(tp=1)
+
+
+@pytest.mark.slow
+def test_multihost_decode_parity():
+    """2 host processes x 2 CPU devices: greedy outputs through the
+    MultiHostBatcher control channel equal the single-process
+    baseline."""
+    from skypilot_tpu.infer import multihost_check
+    out = multihost_check.run_check(num_hosts=2, devices_per_host=2)
+    assert len(out) == len(multihost_check.PROMPTS)
+    assert all(len(o) == multihost_check.MAX_NEW for o in out)
