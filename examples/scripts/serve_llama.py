@@ -86,16 +86,20 @@ class BatcherDriver:
     def abandon(self, rid):
         """Client went away mid-flight: reap the request's bookkeeping as
         soon as it completes (otherwise dead entries accumulate)."""
-        with self.lock:
-            self.done_events.pop(rid, None)
-            self.failed.pop(rid, None)
-            try:
-                if self.batcher.is_done(rid):
-                    self.batcher.result(rid)   # discard
-                else:
-                    self.abandoned.add(rid)
-            except KeyError:
-                pass
+        try:
+            with self.lock:
+                self.done_events.pop(rid, None)
+                self.failed.pop(rid, None)
+                try:
+                    if self.batcher.is_done(rid):
+                        self.batcher.result(rid)   # discard
+                    else:
+                        self.abandoned.add(rid)
+                except KeyError:
+                    pass
+        except Exception as e:  # result() broadcasts on multi-host
+            self._fatal_if_channel_broken(e)
+            raise
 
     def _loop(self):
         idle_since = time.monotonic()
@@ -135,15 +139,23 @@ class BatcherDriver:
                 for rid, ev in list(self.done_events.items()):
                     if self.batcher.is_done(rid):
                         ev.set()
-                for rid in list(self.abandoned):
-                    if self.batcher.is_done(rid):
-                        self.batcher.result(rid)   # discard
-                        self.abandoned.discard(rid)
+                try:
+                    for rid in list(self.abandoned):
+                        if self.batcher.is_done(rid):
+                            self.batcher.result(rid)   # discard
+                            self.abandoned.discard(rid)
+                except Exception as e:  # result() broadcasts on multi-host
+                    self._fatal_if_channel_broken(e)
+                    raise
 
 
 def build_generator(model_size: str, max_seq_len: int, temperature: float,
                     hf_model: str = '', batch_size: int = 4, tp: int = 1,
-                    mesh=None):
+                    mesh_builder=None):
+    """mesh_builder: optional config -> Mesh callable (the multi-host
+    path builds its mesh from the resolved model's KV-head count — the
+    GQA overshard factor depends on it, so the config must exist
+    first)."""
     import jax
     import jax.numpy as jnp
 
@@ -151,15 +163,9 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
     from skypilot_tpu.infer.serving import ContinuousBatcher
     from skypilot_tpu.models import llama
 
-    if mesh is None and tp > 1:
-        # Megatron-sharded decode over a tp mesh (infer/tp.py): the
-        # TPU-native analog of the reference's vLLM
-        # --tensor-parallel-size recipes (llm/vllm/service.yaml).
-        from skypilot_tpu.infer import tp as tp_lib
-        mesh = tp_lib.make_tp_mesh(tp)
-
     tokenizer = None
     eos = None
+    params = None
     if hf_model:
         from skypilot_tpu.models import convert
         # Host-RAM numpy tree: the batcher's shard_params device_puts it
@@ -184,6 +190,17 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
             '1b': llama.LLAMA_1B,
             '8b': llama.LLAMA3_8B,
         }[model_size]
+
+    mesh = None
+    if mesh_builder is not None:
+        mesh = mesh_builder(config)
+    elif tp > 1:
+        # Megatron-sharded decode over a tp mesh (infer/tp.py): the
+        # TPU-native analog of the reference's vLLM
+        # --tensor-parallel-size recipes (llm/vllm/service.yaml).
+        from skypilot_tpu.infer import tp as tp_lib
+        mesh = tp_lib.make_tp_mesh(tp, n_kv_heads=config.n_kv_heads)
+    if params is None:
         if mesh is not None:
             # Random weights init DIRECTLY under the tp shardings (jit
             # with out_shardings): each chip only allocates its shard —
@@ -238,7 +255,7 @@ def main() -> int:
         jax.config.update('jax_platforms', 'cpu')
         jax.config.update('jax_num_cpu_devices', args.devices_per_host)
     info = multihost.initialize_from_env()
-    mesh = None
+    mesh_builder = None
     if info['num_hosts'] > 1:
         # Replica teardown must not block on jax.distributed's atexit
         # barrier: once any peer host is killed, the barrier can never
@@ -250,11 +267,12 @@ def main() -> int:
         import signal
         signal.signal(signal.SIGTERM, lambda *a: os._exit(143))
         signal.signal(signal.SIGINT, lambda *a: os._exit(130))
-        mesh = multihost.make_replica_mesh()
+        mesh_builder = lambda cfg: multihost.make_replica_mesh(  # noqa: E731
+            n_kv_heads=cfg.n_kv_heads)
     gen, config, tokenizer = build_generator(
         args.model_size, args.max_seq_len, args.temperature,
-        args.hf_model, args.batch_size,
-        args.tp if mesh is None else mesh.size, mesh=mesh)
+        args.hf_model, args.batch_size, args.tp,
+        mesh_builder=mesh_builder)
     if info['num_hosts'] > 1:
         control_port = args.control_port or info['control_port']
         if info['host_id'] != 0:

@@ -1,0 +1,80 @@
+"""On-cluster autostop ENFORCEMENT: the cluster tears itself down.
+
+Reference parity: sky/skylet/events.py:34-138 (AutostopEvent) — the
+skylet on the head node executes the stop/down when the idle threshold
+passes, so an idle cluster whose client/API server is gone still goes
+away.  TPU-native shape: `down` is the only supported mode (a TPU pod
+slice cannot "stop"; the proto contract in schemas/agent.proto already
+rejects stop-when-idle), and the delete is issued from a DETACHED
+process: the TPU/GCE delete API is server-side once the request lands,
+and the local cloud's teardown kills the agent's own process group — in
+both cases the issuing process must not be the agent itself.
+
+The descriptor (selfdown.json, written into the agent base dir by the
+provisioner at agent-start time) carries exactly what
+provision.terminate_instances needs: {cloud, cluster_name,
+provider_config}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+DESCRIPTOR = 'selfdown.json'
+LOG = 'selfdown.log'
+
+
+def write_descriptor(base_dir: str, cloud: str, cluster_name: str,
+                     provider_config: dict) -> None:
+    """Provisioner-side: record how this cluster deletes itself."""
+    path = os.path.join(base_dir, DESCRIPTOR)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'cloud': cloud, 'cluster_name': cluster_name,
+                   'provider_config': provider_config}, f)
+
+
+def descriptor_command(base_dir: str, cloud: str, cluster_name: str,
+                       provider_config: dict) -> str:
+    """Shell command writing the descriptor on a remote host (base64 so
+    no quoting of the provider config can break)."""
+    import base64
+    payload = base64.b64encode(json.dumps(
+        {'cloud': cloud, 'cluster_name': cluster_name,
+         'provider_config': provider_config}).encode()).decode()
+    return (f'mkdir -p {base_dir} && echo {payload} | base64 -d > '
+            f'{base_dir}/{DESCRIPTOR}')
+
+
+def main() -> int:
+    base_dir = sys.argv[1]
+    log_path = os.path.join(base_dir, LOG)
+
+    def log(msg: str) -> None:
+        with open(log_path, 'a', encoding='utf-8') as f:
+            f.write(f'[{time.strftime("%Y-%m-%d %H:%M:%S")}] {msg}\n')
+
+    desc_path = os.path.join(base_dir, DESCRIPTOR)
+    try:
+        with open(desc_path, encoding='utf-8') as f:
+            desc = json.load(f)
+    except (OSError, ValueError) as e:
+        log(f'cannot read {desc_path}: {e}; autostop down not enforced')
+        return 1
+    log(f'idle threshold passed: terminating own cluster '
+        f'{desc["cluster_name"]!r} on {desc["cloud"]}')
+    try:
+        from skypilot_tpu import provision as provision_api
+        provision_api.terminate_instances(desc['cloud'],
+                                          desc['cluster_name'],
+                                          desc.get('provider_config'))
+    except Exception as e:  # pylint: disable=broad-except
+        log(f'terminate failed: {e!r}')
+        return 1
+    log('terminate issued.')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -129,9 +129,11 @@ def make_app(state: AgentState) -> web.Application:
 
 async def _events_loop(state: AgentState, interval: float) -> None:
     """Periodic events (mirrors skylet EVENTS sky/skylet/skylet.py:26-41).
-    The autostop event records idleness; enforcement (actual teardown) is
-    done by the client-side status refresh reading /autostop + idle time,
-    since a TPU pod cannot stop itself cleanly mid-delete."""
+    The autostop event records idleness AND enforces `down` from the
+    cluster itself (reference: AutostopEvent, sky/skylet/events.py:34-138)
+    — an idle slice whose client/API server died still goes away.  The
+    terminate is issued by a detached helper process
+    (agent/selfdown.py): the teardown kills this agent too."""
     last_heartbeat = 0.0
     while True:
         await asyncio.sleep(interval)
@@ -144,6 +146,9 @@ async def _events_loop(state: AgentState, interval: float) -> None:
                 cfg['idle_seconds'] = (
                     0.0 if state.job_table.has_active_jobs()
                     else time.time() - idle_from)
+                if _should_enforce_down(cfg):
+                    cfg['enforce_started_at'] = time.time()
+                    _spawn_selfdown(state)
                 with open(state.autostop_path, 'w', encoding='utf-8') as f:
                     json.dump(cfg, f)
         except Exception:  # pylint: disable=broad-except
@@ -165,11 +170,45 @@ async def _events_loop(state: AgentState, interval: float) -> None:
                 pass
 
 
+# Re-issue the (idempotent) terminate if a previous attempt has not
+# taken the cluster down after this long — e.g. a transient cloud-API
+# failure in the helper.
+_ENFORCE_RETRY_SECONDS = 300.0
+
+
+def _should_enforce_down(cfg: dict) -> bool:
+    """Idle past the threshold with down=true, and no recent attempt."""
+    if not cfg.get('down') or cfg.get('idle_minutes') is None:
+        return False
+    if cfg['idle_seconds'] < float(cfg['idle_minutes']) * 60.0:
+        return False
+    started = cfg.get('enforce_started_at')
+    return started is None or time.time() - started > _ENFORCE_RETRY_SECONDS
+
+
+def _spawn_selfdown(state: AgentState) -> None:
+    """Detached (own session): the teardown kills the agent's process
+    group on the local cloud, and deletes the VM under every process on
+    a real TPU host — the issuing process must survive neither."""
+    import subprocess
+    import sys as sys_lib
+    subprocess.Popen(
+        [sys_lib.executable, '-m', 'skypilot_tpu.agent.selfdown',
+         state.base_dir],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+
+
 def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--base-dir', required=True)
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
-    parser.add_argument('--event-interval', type=float, default=20.0)
+    # Env override: tests and latency-sensitive deployments tune the
+    # events cadence of agents they do not start directly (the local
+    # cloud's agent inherits the launcher's environment).
+    parser.add_argument('--event-interval', type=float,
+                        default=float(os.environ.get(
+                            'SKYTPU_AGENT_EVENT_INTERVAL', '20.0')))
     parser.add_argument('--cluster-name', default=None)
     parser.add_argument('--grpc-port', type=int, default=None,
                         help='gRPC transport port (default: port+1; '

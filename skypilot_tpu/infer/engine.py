@@ -79,7 +79,7 @@ class Generator:
         partitions the same jitted functions)."""
         self.mesh = mesh
         if mesh is not None:
-            tp_lib.validate_tp(config, mesh.shape['tp'])
+            tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
         self.params = params
         self.config = config

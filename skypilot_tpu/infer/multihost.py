@@ -69,13 +69,16 @@ def initialize_from_env(timeout_s: Optional[int] = None) -> dict:
             'coordinator_host': host, 'control_port': control_port}
 
 
-def make_replica_mesh(tp: Optional[int] = None):
-    """1-axis ('tp',) mesh over ALL devices of the replica — every chip
+def make_replica_mesh(tp: Optional[int] = None,
+                      n_kv_heads: Optional[int] = None):
+    """('tp', 'tpq') mesh over ALL devices of the replica — every chip
     of every host (contrast infer/tp.py:make_tp_mesh, which stays within
-    jax.local_devices() for single-host serving).  Requires
+    jax.local_devices() for single-host serving).  n_kv_heads enables
+    the GQA overshard axis when the replica has more chips than the
+    model has KV heads (infer/tp.py:INFER_TP_RULES).  Requires
     jax.distributed to be initialized on every host first."""
     import jax
-    import numpy as np
+    from skypilot_tpu.infer import tp as tp_lib
     devices = jax.devices()
     tp = tp or len(devices)
     if tp != len(devices):
@@ -84,7 +87,7 @@ def make_replica_mesh(tp: Optional[int] = None):
         raise ValueError(
             f'multi-host replica must use every chip: tp={tp} but the '
             f'replica has {len(devices)} devices')
-    return jax.sharding.Mesh(np.asarray(devices), ('tp',))
+    return tp_lib._tp_mesh_from_devices(devices, tp, n_kv_heads)
 
 
 # ---------------------------------------------------------------------------

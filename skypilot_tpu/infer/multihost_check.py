@@ -68,8 +68,8 @@ def _host_main(host_id: int, num_hosts: int, devices_per_host: int,
     from skypilot_tpu.infer import tp as tp_lib
     from skypilot_tpu.infer.serving import ContinuousBatcher
 
-    mesh = multihost.make_replica_mesh()
     config = _model(num_hosts * devices_per_host)
+    mesh = multihost.make_replica_mesh(n_kv_heads=config.n_kv_heads)
     params = tp_lib.init_sharded_params(config, jax.random.PRNGKey(_SEED),
                                         mesh)
     batcher = ContinuousBatcher(params, config, _gen_config(), mesh=mesh)

@@ -62,7 +62,7 @@ class ContinuousBatcher:
         with the tp degree instead of one chip's HBM."""
         self.mesh = mesh
         if mesh is not None:
-            tp_lib.validate_tp(config, mesh.shape['tp'])
+            tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
         self.params = params
         self.config = config
@@ -175,9 +175,14 @@ class ContinuousBatcher:
         return self._requests[rid].done
 
     def result(self, rid: int) -> List[int]:
-        req = self._requests.pop(rid)
+        # Check BEFORE popping: an in-flight result() call must leave
+        # the request tracked (and on a multi-host replica, head-local
+        # validation errors must not mutate state the workers still
+        # hold — infer/multihost.py relies on this).
+        req = self._requests[rid]
         if not req.done:
             raise ValueError(f'Request {rid} still in flight')
+        del self._requests[rid]
         return req.out
 
     @property

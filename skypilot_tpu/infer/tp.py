@@ -25,36 +25,71 @@ point of the GSPMD design — tp is a data layout, not a code path.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from skypilot_tpu.parallel.sharding import PartitionRules
 
-# Megatron-style inference rules over a 1-axis ('tp',) mesh.  Note these
-# differ from the training LLAMA_RULES (2D tp × fsdp): inference has no
-# gradient/optimizer state to shard, so fsdp buys nothing, and embed is
-# sharded on d_model (not vocab) so the token gather stays local —
-# gathering from a vocab-sharded table would force GSPMD to rewrite the
-# gather as masked-lookup + psum on every prefill AND decode step.
+# Megatron-style inference rules over a 2-axis ('tp', 'tpq') mesh.
+# 'tp' carries the KV-head sharding; 'tpq' is the GQA OVERSHARD axis:
+# when the requested parallelism exceeds n_kv_heads (Llama-3 8B/70B have
+# only 8 KV heads, a v5e-16 replica has 16 chips), query heads / MLP /
+# vocab shard over tp x tpq while each KV head (and its cache shard) is
+# REPLICATED across the tpq subgroup.  The mesh layout keeps GQA
+# locality: chip (i, j) holds q-heads whose group index is exactly i, so
+# attention needs no cross-chip KV gather.  tpq=1 degenerates to plain
+# megatron tp.  Note these rules differ from the training LLAMA_RULES
+# (2D tp x fsdp): inference has no gradient/optimizer state to shard, so
+# fsdp buys nothing, and embed is sharded on d_model (not vocab) so the
+# token gather stays local — gathering from a vocab-sharded table would
+# force GSPMD to rewrite the gather as masked-lookup + psum on every
+# prefill AND decode step.
 INFER_TP_RULES = PartitionRules([
-    (r'embed', P(None, 'tp')),                          # (vocab, d)
-    (r'attn/wq|attn/wk|attn/wv', P(None, None, 'tp')),  # (L, d, heads*hd)
-    (r'attn/wo', P(None, 'tp', None)),                  # (L, heads*hd, d)
-    (r'mlp/w_gate|mlp/w_up', P(None, None, 'tp')),      # (L, d, ff)
-    (r'mlp/w_down', P(None, 'tp', None)),               # (L, ff, d)
+    (r'embed', P(None, ('tp', 'tpq'))),                 # (vocab, d)
+    (r'attn/wk|attn/wv', P(None, None, 'tp')),          # (L, d, kv*hd)
+    (r'attn/wq', P(None, None, ('tp', 'tpq'))),         # (L, d, heads*hd)
+    (r'attn/wo', P(None, ('tp', 'tpq'), None)),         # (L, heads*hd, d)
+    (r'mlp/w_gate|mlp/w_up', P(None, None, ('tp', 'tpq'))),  # (L, d, ff)
+    (r'mlp/w_down', P(None, ('tp', 'tpq'), None)),      # (L, ff, d)
     (r'norm|ln', P()),
-    (r'lm_head', P(None, 'tp')),                        # (d, vocab)
+    (r'lm_head', P(None, ('tp', 'tpq'))),               # (d, vocab)
 ])
 
-# Cache (L, B, max_len, KV_heads, head_dim): shard the kv-head axis.
+# Cache (L, B, max_len, KV_heads, head_dim): shard the kv-head axis over
+# 'tp'; implicitly replicated over the 'tpq' overshard subgroup.
 CACHE_SPEC = P(None, None, None, 'tp', None)
+
+
+def tp_factors(config, tp: int):
+    """(tp_kv, tp_q): KV-head sharding degree and the GQA overshard
+    degree, tp = tp_kv * tp_q."""
+    tp_kv = min(tp, config.n_kv_heads)
+    return tp_kv, tp // max(tp_kv, 1)
+
+
+def validate_mesh(config, mesh) -> None:
+    """Mesh/model agreement: the 'tp' axis must equal the model's KV
+    sharding degree (a mesh built without n_kv_heads on a GQA model
+    would try to split the KV cache too finely)."""
+    validate_tp(config, mesh.size)
+    tp_kv, _ = tp_factors(config, mesh.size)
+    if dict(zip(mesh.axis_names, mesh.devices.shape)).get('tp') != tp_kv:
+        raise ValueError(
+            f"mesh tp axis {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+            f'does not match the model: need tp={tp_kv} x tpq='
+            f'{mesh.size // tp_kv} for n_kv_heads={config.n_kv_heads} — '
+            f'build the mesh with make_tp_mesh(tp, n_kv_heads=...)')
 
 
 def validate_tp(config, tp: int) -> None:
     """Fail fast (at engine construction, not first decode) when the
     model's axes don't divide over tp chips."""
     problems = []
-    if config.n_kv_heads % tp:
-        problems.append(f'n_kv_heads={config.n_kv_heads}')
+    tp_kv, tp_q = tp_factors(config, tp)
+    if tp_kv * tp_q != tp or config.n_kv_heads % tp_kv:
+        problems.append(f'n_kv_heads={config.n_kv_heads} (tp must be a '
+                        f'multiple or divisor of it)')
     if config.n_heads % tp:
         problems.append(f'n_heads={config.n_heads}')
     if config.d_ff % tp:
@@ -69,18 +104,30 @@ def validate_tp(config, tp: int) -> None:
             + ', '.join(problems))
 
 
-def make_tp_mesh(tp: int, devices=None):
-    """1-axis ('tp',) mesh over the first tp local devices (local: a
-    serving replica shards within its own host's ICI neighborhood —
-    jax.devices() would include other hosts' non-addressable chips on a
-    multi-host slice and device_put would fail)."""
+def _tp_mesh_from_devices(devices, tp: int, n_kv_heads: Optional[int]):
     import jax
     import numpy as np
+    tp_kv = min(tp, n_kv_heads) if n_kv_heads else tp
+    if tp % max(tp_kv, 1):
+        raise ValueError(f'tp={tp} not a multiple of tp_kv={tp_kv}')
+    tp_q = tp // max(tp_kv, 1)
+    return jax.sharding.Mesh(
+        np.asarray(devices[:tp]).reshape(tp_kv, tp_q), ('tp', 'tpq'))
+
+
+def make_tp_mesh(tp: int, n_kv_heads: Optional[int] = None, devices=None):
+    """('tp', 'tpq') mesh over the first tp local devices (local: a
+    serving replica shards within its own host's ICI neighborhood —
+    jax.devices() would include other hosts' non-addressable chips on a
+    multi-host slice and device_put would fail).  n_kv_heads: the
+    model's KV-head count — when tp exceeds it, the extra parallelism
+    goes to the 'tpq' GQA overshard axis (see INFER_TP_RULES)."""
+    import jax
     if devices is None:
         devices = jax.local_devices()
     if len(devices) < tp:
         raise ValueError(f'tp={tp} but only {len(devices)} devices')
-    return jax.sharding.Mesh(np.asarray(devices[:tp]), ('tp',))
+    return _tp_mesh_from_devices(devices, tp, n_kv_heads)
 
 
 def shard_params(params, mesh):

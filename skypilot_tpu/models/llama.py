@@ -56,6 +56,13 @@ class LlamaConfig:
     # ~300MB/layer of saved dots for the 1B bench shape; the right trade
     # whenever the model fits.
     remat_policy: Optional[str] = None
+    # Blockwise cross-entropy chunk (tokens): loss_fn then computes the
+    # softmax CE from hidden states in sequence chunks and NEVER
+    # materializes the full (B, S, vocab) f32 logits (ops/losses.py) —
+    # at flagship shapes the full-logits head costs ~2 layers of step
+    # time and ~2 GB of held residuals.  None = full logits (fine for
+    # small vocabularies).
+    loss_chunk: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -170,9 +177,12 @@ def _layer(h: jax.Array, layer_params: Params, *, config: LlamaConfig,
     return h
 
 
-def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
-            attention_fn: Optional[AttentionFn] = None) -> jax.Array:
-    """tokens (B, S) int32 → logits (B, S, vocab) f32."""
+def hidden_states(params: Params, tokens: jax.Array, config: LlamaConfig,
+                  attention_fn: Optional[AttentionFn] = None) -> jax.Array:
+    """tokens (B, S) int32 → post-final-norm hidden states (B, S, d).
+    The pre-head trunk of forward(); loss_fn consumes this directly when
+    the cross entropy is chunked (config.loss_chunk), so the full logits
+    tensor never exists."""
     if attention_fn is None:
         attention_fn = functools.partial(attention_ops.flash_attention,
                                          causal=True)
@@ -191,7 +201,14 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
         return layer_fn(carry, layer_params), None
 
     h, _ = jax.lax.scan(scan_body, h, params['layers'])
-    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    return rmsnorm_ops.rms_norm(h, params['final_norm'],
+                                eps=config.norm_eps)
+
+
+def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
+            attention_fn: Optional[AttentionFn] = None) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, vocab) f32."""
+    h = hidden_states(params, tokens, config, attention_fn=attention_fn)
     return (h @ params['lm_head']).astype(jnp.float32)
 
 
@@ -265,6 +282,15 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     """Next-token cross entropy.  batch: {'tokens': (B, S)}; the model
     predicts tokens[:, 1:] from tokens[:, :-1]."""
     tokens = batch['tokens']
+    if forward_fn is None and config.loss_chunk:
+        # Blockwise CE (ops/losses.py): hidden states -> per-chunk
+        # logits -> logprobs, one (B, chunk, vocab) block at a time.
+        from skypilot_tpu.ops import losses as losses_ops
+        h = hidden_states(params, tokens[:, :-1], config,
+                          attention_fn=attention_fn)
+        return losses_ops.chunked_softmax_xent(
+            h, params['lm_head'], tokens[:, 1:],
+            chunk_size=config.loss_chunk)
     if forward_fn is None:
         forward_fn = functools.partial(forward,
                                        attention_fn=attention_fn)
@@ -273,11 +299,9 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
 
 
 def token_logprobs(logits: jax.Array, targets: jax.Array) -> jax.Array:
-    """log p(targets) from logits — (..., S) f32.  logsumexp form: one
-    (B, S) reduction instead of materializing the full log_softmax.
-    Shared by the SFT loss, the MoE loss, and the RL policy gradient so
-    the numerics cannot drift apart."""
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(logits, targets[..., None],
-                                 axis=-1)[..., 0]
-    return picked - lse
+    """log p(targets) from logits — (..., S) f32.  Shared by the SFT
+    loss, the MoE loss, and the RL policy gradient; delegates to the
+    single CE-numerics implementation in ops/losses.py (also used by the
+    blockwise path) so the numerics cannot drift apart."""
+    from skypilot_tpu.ops import losses as losses_ops
+    return losses_ops.token_logprobs(logits, targets)

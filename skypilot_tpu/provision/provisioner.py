@@ -107,6 +107,12 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
     if cluster_info.cloud == 'local':
         base_dir = f'{head.workdir}/.agent'
         os.makedirs(base_dir, exist_ok=True)
+        # Self-teardown descriptor BEFORE the agent starts: on-cluster
+        # autostop enforcement (agent/selfdown.py) reads it.
+        from skypilot_tpu.agent import selfdown
+        selfdown.write_descriptor(base_dir, cluster_info.cloud,
+                                  cluster_name,
+                                  cluster_info.provider_config)
         last_exc: Optional[Exception] = None
         for attempt in range(5):
             port = common_utils.find_free_port(agent_port + attempt)
@@ -212,6 +218,15 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
     except Exception as e:  # pylint: disable=broad-except
         logger.warning(f'Log-shipping agent setup failed ({e}); '
                        f'job logs will not be exported.')
+    # Self-teardown descriptor for on-cluster autostop enforcement
+    # (agent/selfdown.py) — written before the agent starts.
+    from skypilot_tpu.agent import selfdown
+    rc = runner.run(selfdown.descriptor_command(
+        '~/.skypilot_tpu_agent', cluster_info.cloud, cluster_name,
+        cluster_info.provider_config), timeout=60)
+    if rc != 0:
+        logger.warning('Could not write the self-teardown descriptor; '
+                       'on-cluster autostop down will not enforce.')
     cmd = (f'nohup python3 -m skypilot_tpu.agent.server '
            f'--base-dir ~/.skypilot_tpu_agent --port {agent_port} '
            f'--cluster-name {cluster_name} '

@@ -52,3 +52,44 @@ def test_cancel_kills_rank_processes(iso_state):  # noqa: F811
     time.sleep(1.5)
     # If the rank loop survived the cancel it would have re-touched marker.
     assert not os.path.exists(marker), 'rank process survived cancel'
+
+
+# --- on-cluster autostop enforcement (agent/server.py + selfdown.py) ---
+
+def test_should_enforce_down_predicate():
+    from skypilot_tpu.agent import server as agent_server
+    f = agent_server._should_enforce_down
+    # Not down / no threshold / not yet idle → no.
+    assert not f({'down': False, 'idle_minutes': 1, 'idle_seconds': 999})
+    assert not f({'down': True, 'idle_minutes': None, 'idle_seconds': 999})
+    assert not f({'down': True, 'idle_minutes': 1, 'idle_seconds': 59})
+    # Idle past threshold → yes.
+    assert f({'down': True, 'idle_minutes': 1, 'idle_seconds': 61})
+    # Recent attempt → no (retry only after the cooldown).
+    import time
+    assert not f({'down': True, 'idle_minutes': 1, 'idle_seconds': 61,
+                  'enforce_started_at': time.time()})
+    assert f({'down': True, 'idle_minutes': 1, 'idle_seconds': 61,
+              'enforce_started_at': time.time() - 301})
+
+
+def test_selfdown_descriptor_roundtrip(tmp_path):
+    from skypilot_tpu.agent import selfdown
+    selfdown.write_descriptor(str(tmp_path), 'local', 'c1',
+                              {'num_hosts': 2})
+    import json
+    with open(tmp_path / 'selfdown.json', encoding='utf-8') as f:
+        desc = json.load(f)
+    assert desc == {'cloud': 'local', 'cluster_name': 'c1',
+                    'provider_config': {'num_hosts': 2}}
+    # The remote variant produces a shell command that recreates the
+    # same file through base64 (quoting-proof).
+    import subprocess
+    remote_dir = tmp_path / 'remote'
+    cmd = selfdown.descriptor_command(str(remote_dir), 'gcp', 'c2',
+                                      {'zone': 'us-central2-b'})
+    subprocess.run(cmd, shell=True, check=True)
+    with open(remote_dir / 'selfdown.json', encoding='utf-8') as f:
+        desc2 = json.load(f)
+    assert desc2['cloud'] == 'gcp' and desc2['cluster_name'] == 'c2'
+    assert desc2['provider_config'] == {'zone': 'us-central2-b'}

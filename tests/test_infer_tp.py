@@ -43,9 +43,16 @@ def test_shard_params_layouts(params):
     mesh = tp_lib.make_tp_mesh(2)
     sharded = tp_lib.shard_params(params, mesh)
     wq = sharded['layers']['attn']['wq']
-    # (L, d, heads*hd) sharded on the output axis.
-    assert wq.sharding.spec == jax.sharding.PartitionSpec(
-        None, None, 'tp')
+    # (L, d, heads*hd) sharded on the output axis (over both tp axes).
+    assert wq.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, None, ('tp', 'tpq'))),
+        3)
+    # KV projections shard over 'tp' only (GQA: replicated over 'tpq').
+    wk = sharded['layers']['attn']['wk']
+    assert wk.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, None, 'tp')), 3)
     # Norms replicated.
     assert sharded['final_norm'].sharding.is_fully_replicated
 
@@ -58,7 +65,8 @@ def test_init_sharded_params_matches_plain_init(params):
     wq = sharded['layers']['attn']['wq']
     assert wq.sharding.is_equivalent_to(
         jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(None, None, 'tp')), 3)
+            mesh, jax.sharding.PartitionSpec(None, None, ('tp', 'tpq'))),
+        3)
     # allclose, not bit-equal: jit fuses the init math differently from
     # eager (same rng stream, ~1e-9 f32 reassociation drift).
     jax.tree.map(
@@ -66,12 +74,12 @@ def test_init_sharded_params_matches_plain_init(params):
         params, sharded)
 
 
-@pytest.mark.parametrize('tp', [2, 4])
+@pytest.mark.parametrize('tp', [2, 4, 8])
 def test_generator_tp_parity(params, tp):
     prompts = [[5, 9, 2, 7], [11, 3]]
     base = Generator(params, CFG, GEN).generate(prompts,
                                                 max_new_tokens=12)
-    mesh = tp_lib.make_tp_mesh(tp)
+    mesh = tp_lib.make_tp_mesh(tp, n_kv_heads=CFG.n_kv_heads)
     sharded = Generator(params, CFG, GEN, mesh=mesh).generate(
         prompts, max_new_tokens=12)
     assert base == sharded
@@ -119,3 +127,43 @@ def test_host_position_mirror_tracks_device(params):
             np.asarray(b._positions), b._host_pos.astype(np.int32))
     for r in rids:
         b.result(r)
+
+
+def test_gqa_overshard_factors():
+    """tp beyond n_kv_heads splits into (tp_kv, tp_q): KV shards over
+    tp_kv, queries/MLP/vocab over the full tp."""
+    assert tp_lib.tp_factors(CFG, 2) == (2, 1)
+    assert tp_lib.tp_factors(CFG, 4) == (4, 1)
+    assert tp_lib.tp_factors(CFG, 8) == (4, 2)   # 4 kv heads, 8 chips
+    tp_lib.validate_tp(CFG, 8)                   # 8 q heads: legal
+    mesh = tp_lib.make_tp_mesh(8, n_kv_heads=CFG.n_kv_heads)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        'tp': 4, 'tpq': 2}
+
+
+def test_gqa_overshard_batcher_parity(params):
+    """tp=8 over a 4-KV-head model (the Llama-3-8B-on-v5e-16 shape, in
+    miniature): KV cache shards over 4, replicates over 2; greedy decode
+    equals unsharded."""
+    def run(mesh):
+        b = ContinuousBatcher(params, CFG, GEN, mesh=mesh)
+        rids = [b.submit([5, 9, 2, 7], max_new_tokens=10),
+                b.submit([11, 3], max_new_tokens=10)]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    base = run(None)
+    sharded = run(tp_lib.make_tp_mesh(8, n_kv_heads=CFG.n_kv_heads))
+    assert base == sharded
+
+
+def test_result_in_flight_does_not_drop_request(params):
+    """result() on an in-flight request raises WITHOUT popping it (the
+    multi-host SPMD mirror depends on failed validation not mutating
+    state)."""
+    b = ContinuousBatcher(params, CFG, GEN)
+    rid = b.submit([5, 9, 2, 7], max_new_tokens=4)
+    with pytest.raises(ValueError, match='in flight'):
+        b.result(rid)
+    b.run_until_idle()
+    assert len(b.result(rid)) == 4

@@ -155,3 +155,25 @@ def test_workdir_sync(iso_state, tmp_path):
     log = open(os.path.join(handle.cluster_info.head.workdir, '.agent',
                             'logs', f'job-{job_id}', 'rank-0.log')).read()
     assert 'payload-123' in log
+
+
+def test_autostop_down_enforced_on_cluster(iso_state, monkeypatch):
+    """ON-CLUSTER autostop enforcement (reference: AutostopEvent,
+    sky/skylet/events.py:34-138): after the idle threshold, the AGENT
+    itself tears the cluster down via a detached helper — no client-side
+    status refresh involved (the client does nothing after setting
+    autostop; an idle slice whose client died must still go away)."""
+    monkeypatch.setenv('SKYTPU_AGENT_EVENT_INTERVAL', '0.5')
+    task = _make_task(run='echo idle-soon')
+    job_id, handle = execution.launch(task, cluster_name='autodown',
+                                      detach_run=True)
+    assert _wait_job(handle, job_id) == JobStatus.SUCCEEDED
+    core.autostop('autodown', idle_minutes=0.03, down=True)  # ~2s idle
+    from skypilot_tpu.provision.local import instance as local_instance
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if not local_instance.query_instances('autodown'):
+            break
+        time.sleep(1.0)
+    assert not local_instance.query_instances('autodown'), (
+        'agent did not tear its own cluster down')
