@@ -83,6 +83,13 @@ class BatcherDriver:
             self._fatal_if_channel_broken(e)
             raise
 
+    def partial(self, rid):
+        """Tokens so far (streaming poll); raises if the request failed."""
+        with self.lock:
+            if rid in self.failed:
+                raise RuntimeError(self.failed.pop(rid))
+            return self.batcher.partial(rid)
+
     def abandon(self, rid):
         """Client went away mid-flight: reap the request's bookkeeping as
         soon as it completes (otherwise dead entries accumulate)."""
@@ -217,6 +224,267 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
     return gen, config, tokenizer
 
 
+# ---------------------------------------------------------------------------
+# OpenAI-compatible surface (/v1/completions, /v1/chat/completions,
+# /v1/models).  The de-facto serving API users get from the reference's
+# vLLM/TGI/SGLang recipes (llm/vllm/service.yaml, llm/tgi/) — existing
+# OpenAI clients can point at a `skytpu serve` endpoint unchanged.
+# Streaming uses SSE `data: {json}\n\n` chunks terminated by
+# `data: [DONE]`, the OpenAI wire format.
+# ---------------------------------------------------------------------------
+
+
+def _encode_text(text: str, tokenizer, config):
+    if tokenizer is not None:
+        return list(tokenizer(str(text))['input_ids'])
+    return [b % config.vocab_size for b in str(text).encode('utf-8')]
+
+
+def _decode_ids(ids, tokenizer):
+    if tokenizer is not None:
+        return tokenizer.decode(ids)
+    return bytes(t % 256 for t in ids).decode('utf-8', errors='replace')
+
+
+def _chat_to_ids(messages, tokenizer, config):
+    if tokenizer is not None and getattr(tokenizer, 'chat_template', None):
+        return list(tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True))
+    text = ''.join(f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+                   for m in messages) + 'assistant: '
+    return _encode_text(text, tokenizer, config)
+
+
+def attach_openai_routes(app, driver, config, tokenizer, *,
+                         model_name: str, default_max_tokens: int,
+                         eos_token=None) -> None:
+    import uuid
+
+    from aiohttp import web
+
+    def _finish_reason(out):
+        return 'stop' if (eos_token is not None and out
+                          and out[-1] == eos_token) else 'length'
+
+    def _strip_eos(out):
+        if eos_token is not None and out and out[-1] == eos_token:
+            return out[:-1]
+        return out
+
+    def _apply_stop(text, stop):
+        """(text, hit): truncate at the first stop sequence."""
+        if not stop:
+            return text, False
+        seqs = [stop] if isinstance(stop, str) else list(stop)
+        cut = min((text.find(s) for s in seqs if s and text.find(s) >= 0),
+                  default=-1)
+        if cut >= 0:
+            return text[:cut], True
+        return text, False
+
+    async def _parse(request, *, chat: bool):
+        """-> (prompt_ids, opts) or an error Response."""
+        try:
+            body = await request.json()
+        except ValueError:
+            return None, web.json_response(
+                {'error': {'message': 'invalid JSON body',
+                           'type': 'invalid_request_error'}}, status=400)
+        try:
+            if int(body.get('n', 1)) != 1:
+                return None, web.json_response(
+                    {'error': {'message': 'only n=1 is supported',
+                               'type': 'invalid_request_error'}},
+                    status=400)
+            if chat:
+                messages = body.get('messages')
+                if not isinstance(messages, list) or not messages:
+                    raise ValueError("'messages' must be a non-empty list")
+                ids = _chat_to_ids(messages, tokenizer, config)
+            else:
+                prompt = body.get('prompt')
+                if isinstance(prompt, str):
+                    ids = _encode_text(prompt, tokenizer, config)
+                elif isinstance(prompt, list) and prompt and \
+                        all(isinstance(t, int) for t in prompt):
+                    ids = [int(t) for t in prompt]
+                elif isinstance(prompt, list) and len(prompt) == 1 and \
+                        isinstance(prompt[0], str):
+                    ids = _encode_text(prompt[0], tokenizer, config)
+                else:
+                    raise ValueError(
+                        "'prompt' must be a string, a token-id list, or "
+                        'a single-string list')
+            bad = [t for t in ids if not 0 <= t < config.vocab_size]
+            if bad:
+                raise ValueError(f'token ids out of range: {bad[:5]}')
+            opts = {
+                'max_tokens': min(int(body.get('max_tokens',
+                                               default_max_tokens)), 256),
+                'stream': bool(body.get('stream', False)),
+                'stop': body.get('stop'),
+            }
+        except (TypeError, ValueError) as e:
+            return None, web.json_response(
+                {'error': {'message': str(e),
+                           'type': 'invalid_request_error'}}, status=400)
+        if not ids:
+            return None, web.json_response(
+                {'error': {'message': 'empty prompt',
+                           'type': 'invalid_request_error'}}, status=400)
+        return (ids, opts), None
+
+    def _usage(prompt_ids, out):
+        return {'prompt_tokens': len(prompt_ids),
+                'completion_tokens': len(out),
+                'total_tokens': len(prompt_ids) + len(out)}
+
+    async def _stream(request, rid, ev, prompt_ids, opts, *, chat,
+                      rid_str, created):
+        resp = web.StreamResponse(headers={
+            'Content-Type': 'text/event-stream',
+            'Cache-Control': 'no-cache'})
+        await resp.prepare(request)
+
+        def chunk(delta_text=None, finish=None, first=False):
+            if chat:
+                delta = {}
+                if first:
+                    delta['role'] = 'assistant'
+                if delta_text:
+                    delta['content'] = delta_text
+                choice = {'index': 0, 'delta': delta,
+                          'finish_reason': finish}
+                obj = 'chat.completion.chunk'
+            else:
+                choice = {'index': 0, 'text': delta_text or '',
+                          'logprobs': None, 'finish_reason': finish}
+                obj = 'text_completion'
+            payload = {'id': rid_str, 'object': obj, 'created': created,
+                       'model': model_name, 'choices': [choice]}
+            return f'data: {json.dumps(payload)}\n\n'.encode()
+
+        def emit_safe_length(text, stop, final):
+            """How much of `text` can stream now without risk of
+            retraction: hold back (a) a trailing replacement char — a
+            multi-token unicode char decodes as U+FFFD until its last
+            token arrives — and (b) any suffix that is a PREFIX of a
+            stop sequence (the non-streaming path suppresses the stop
+            text; the stream must too)."""
+            n = len(text)
+            if not final:
+                while n > 0 and text[n - 1] == '�':
+                    n -= 1
+                seqs = ([stop] if isinstance(stop, str)
+                        else list(stop or []))
+                for s in seqs:
+                    for k in range(min(len(s), n), 0, -1):
+                        if text[n - k:n] == s[:k]:
+                            n -= k
+                            break
+            return n
+
+        sent_text = ''
+        stopped = False
+        try:
+            if chat:
+                await resp.write(chunk(first=True))
+            while True:
+                done = ev.is_set()
+                out = _strip_eos(await asyncio.to_thread(
+                    driver.partial, rid))
+                if not done:
+                    # Hold the newest token back: its text can change
+                    # when the next token completes a merge.
+                    out = out[:-1] if out else out
+                text = _decode_ids(out, tokenizer)
+                text, hit = _apply_stop(text, opts['stop'])
+                safe = text[:emit_safe_length(text, opts['stop'],
+                                              final=hit or done)]
+                if safe.startswith(sent_text) and \
+                        len(safe) > len(sent_text):
+                    await resp.write(chunk(safe[len(sent_text):]))
+                    sent_text = safe
+                if hit:
+                    stopped = True
+                    break
+                if done:
+                    break
+                await asyncio.sleep(0.05)
+            final = await asyncio.to_thread(driver.partial, rid)
+            reason = 'stop' if stopped else _finish_reason(final)
+            await resp.write(chunk(finish=reason))
+            await resp.write(b'data: [DONE]\n\n')
+            await resp.write_eof()
+        finally:
+            driver.abandon(rid)  # reap whether finished or cut short
+        return resp
+
+    async def _complete(request, *, chat: bool):
+        parsed, err = await _parse(request, chat=chat)
+        if err is not None:
+            return err
+        prompt_ids, opts = parsed
+        created = int(time.time())
+        rid_str = ('chatcmpl-' if chat else 'cmpl-') + uuid.uuid4().hex[:24]
+        try:
+            rid, ev = await asyncio.to_thread(driver.submit, prompt_ids,
+                                              opts['max_tokens'])
+        except ValueError as e:
+            return web.json_response(
+                {'error': {'message': str(e),
+                           'type': 'invalid_request_error'}}, status=400)
+        if opts['stream']:
+            return await _stream(request, rid, ev, prompt_ids, opts,
+                                 chat=chat, rid_str=rid_str,
+                                 created=created)
+        try:
+            await asyncio.to_thread(ev.wait)
+            out = await asyncio.to_thread(driver.result, rid)
+        except asyncio.CancelledError:
+            driver.abandon(rid)
+            raise
+        except RuntimeError as e:
+            return web.json_response(
+                {'error': {'message': str(e), 'type': 'server_error'}},
+                status=500)
+        finish = _finish_reason(out)
+        trimmed = _strip_eos(out)
+        text = _decode_ids(trimmed, tokenizer)
+        text, hit = _apply_stop(text, opts['stop'])
+        if hit:
+            finish = 'stop'
+        if chat:
+            choice = {'index': 0,
+                      'message': {'role': 'assistant', 'content': text},
+                      'finish_reason': finish}
+            obj = 'chat.completion'
+        else:
+            choice = {'index': 0, 'text': text, 'logprobs': None,
+                      'finish_reason': finish}
+            obj = 'text_completion'
+        return web.json_response({
+            'id': rid_str, 'object': obj, 'created': created,
+            'model': model_name, 'choices': [choice],
+            'usage': _usage(prompt_ids, trimmed)})
+
+    async def completions(request):
+        return await _complete(request, chat=False)
+
+    async def chat_completions(request):
+        return await _complete(request, chat=True)
+
+    async def models(request):
+        return web.json_response({
+            'object': 'list',
+            'data': [{'id': model_name, 'object': 'model', 'created': 0,
+                      'owned_by': 'skypilot-tpu'}]})
+
+    app.router.add_post('/v1/completions', completions)
+    app.router.add_post('/v1/chat/completions', chat_completions)
+    app.router.add_get('/v1/models', models)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument('--port', type=int, default=8080)
@@ -317,13 +585,9 @@ def main() -> int:
                                   f'[0, {config.vocab_size}): {bad[:5]}'},
                         status=400)
             elif 'prompt' in body:
-                if tokenizer is not None:
-                    prompt_ids = tokenizer(str(body['prompt'])
-                                           )['input_ids']
-                else:  # demo byte-level fallback (no bundled tokenizer)
-                    prompt_ids = [b % config.vocab_size
-                                  for b in str(body['prompt']
-                                               ).encode('utf-8')]
+                # Same tokenize-or-byte-fallback as the /v1/* surface.
+                prompt_ids = _encode_text(body['prompt'], tokenizer,
+                                          config)
             else:
                 return web.json_response(
                     {'error': "provide 'prompt_ids' (token ids) or "
@@ -372,6 +636,12 @@ def main() -> int:
     app = web.Application()
     app.router.add_get('/health', health)
     app.router.add_post('/generate', generate)
+    attach_openai_routes(
+        app, driver, config, tokenizer,
+        model_name=args.hf_model or args.model_size,
+        default_max_tokens=args.max_new_tokens,
+        eos_token=(tokenizer.eos_token_id if tokenizer is not None
+                   else None))
     print(json.dumps({'serving': args.model_size, 'port': args.port}))
     # Multi-host head: handle_signals=False keeps OUR SIGTERM handler
     # (aiohttp's graceful shutdown would deadlock in the jax.distributed

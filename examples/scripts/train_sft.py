@@ -1,0 +1,121 @@
+"""SFT entry point: supervised finetuning of any converted HF family
+(Llama / Mistral / Gemma) on a {prompt, completion} JSONL dataset with
+prompt-masked loss (skypilot_tpu/train/sft.py).
+
+The post-training analog of the reference's torchtune finetune recipes
+(llm/llama-3_1-finetuning/, llm/gemma/) — runs identically on one host
+or a full slice via the injected env contract.
+"""
+import argparse
+import os
+
+from skypilot_tpu.utils import env_contract
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--hf-model', default='',
+                        help='HF checkpoint (Llama/Mistral/Gemma, hub '
+                             'name or local path); empty = debug-size '
+                             'random init (smoke testing)')
+    parser.add_argument('--data-file', required=True,
+                        help='JSONL of {"prompt", "completion"} pairs')
+    parser.add_argument('--seq-len', type=int, default=2048)
+    parser.add_argument('--batch-size', type=int, default=0,
+                        help='global batch; 0 = 1 per dp shard')
+    parser.add_argument('--steps', type=int, default=200)
+    parser.add_argument('--dp', type=int, default=0)
+    parser.add_argument('--fsdp', type=int, default=0)
+    parser.add_argument('--tp', type=int, default=1)
+    parser.add_argument('--learning-rate', type=float, default=1e-5)
+    parser.add_argument('--loss-chunk', type=int, default=0,
+                        help='blockwise-CE chunk (0 = full logits); use '
+                             'for 100k+ vocabularies')
+    parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--checkpoint-dir', default='')
+    parser.add_argument('--checkpoint-every', type=int, default=50)
+    parser.add_argument('--resume', default='no', choices=['no', 'auto'])
+    args = parser.parse_args()
+
+    env_contract.initialize_from_env()
+    import dataclasses
+
+    import jax
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import TrainConfig, Trainer
+    from skypilot_tpu.train import sft
+
+    tokenizer = None
+    eos_id = None
+    if args.hf_model:
+        from skypilot_tpu.models import convert
+        params, config = convert.load_hf_model(args.hf_model)
+        try:
+            import transformers
+            tokenizer = transformers.AutoTokenizer.from_pretrained(
+                args.hf_model)
+            eos_id = tokenizer.eos_token_id
+        except Exception:
+            tokenizer = None
+    else:
+        config = llama.LLAMA_DEBUG
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+    if args.loss_chunk:
+        config = dataclasses.replace(config, loss_chunk=args.loss_chunk)
+
+    def encode(text: str):
+        if tokenizer is not None:
+            return tokenizer(text)['input_ids']
+        return [b % config.vocab_size for b in text.encode('utf-8')]
+
+    n = jax.device_count()
+    dp = args.dp or max(1, n // (max(args.fsdp, 1) * args.tp))
+    mesh_config = MeshConfig(dp=dp, fsdp=max(args.fsdp, 1), tp=args.tp)
+    mesh = make_mesh(mesh_config)
+    batch_size = args.batch_size or max(2, dp * max(args.fsdp, 1))
+    if jax.process_index() == 0:
+        print(f'SFT: devices={n} {mesh_config} '
+              f'model={args.hf_model or "debug"} '
+              f'({config.num_params()/1e9:.2f}B) seq={args.seq_len} '
+              f'batch={batch_size}', flush=True)
+
+    trainer = Trainer(
+        lambda p, b: sft.sft_loss_fn(p, b, config), params, mesh,
+        sharding_lib.LLAMA_RULES,
+        TrainConfig(learning_rate=args.learning_rate,
+                    warmup_steps=min(50, args.steps // 10 + 1),
+                    total_steps=args.steps))
+
+    if args.resume == 'auto' and args.checkpoint_dir:
+        import re
+        steps = []
+        if os.path.isdir(args.checkpoint_dir):
+            for d in os.listdir(args.checkpoint_dir):
+                m = re.fullmatch(r'step_(\d+)', d)
+                if m:
+                    steps.append(int(m.group(1)))
+        if steps:
+            trainer.restore_checkpoint(args.checkpoint_dir, max(steps))
+            if jax.process_index() == 0:
+                print(f'resumed from step {trainer.step}', flush=True)
+
+    batches = sft.sft_batches(args.data_file, encode, batch_size,
+                              args.seq_len, eos_id=eos_id)
+    while trainer.step < args.steps:
+        metrics = trainer.run_step(next(batches))
+        step = trainer.step
+        if jax.process_index() == 0 and step % args.log_every == 0:
+            print(f'step {step}: loss={float(metrics["loss"]):.4f}',
+                  flush=True)
+        if args.checkpoint_dir and step % args.checkpoint_every == 0:
+            trainer.save_checkpoint(args.checkpoint_dir)
+    if args.checkpoint_dir:
+        trainer.save_checkpoint(args.checkpoint_dir)
+    if jax.process_index() == 0:
+        print('SFT done.', flush=True)
+
+
+if __name__ == '__main__':
+    main()

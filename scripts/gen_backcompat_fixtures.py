@@ -1,0 +1,100 @@
+"""Generate backward-compat fixtures: state DBs + serialized objects as
+THIS version writes them.
+
+Committed outputs live in tests/fixtures/backcompat/ and future
+versions must keep loading them (tests/test_backcompat.py) — the role
+of the reference's tests/smoke_tests/backward_compat/ suite.  Re-run
+this script in a round that intentionally changes a schema, commit the
+new files ALONGSIDE the old ones (new name = the round), and keep the
+old files loading through migrations.
+
+Usage: python scripts/gen_backcompat_fixtures.py [round_tag]
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+
+def main() -> None:
+    tag = sys.argv[1] if len(sys.argv) > 1 else 'r4'
+    out_dir = os.path.join(os.path.dirname(__file__), '..', 'tests',
+                           'fixtures', 'backcompat')
+    os.makedirs(out_dir, exist_ok=True)
+
+    home = tempfile.mkdtemp(prefix='backcompat-gen-')
+    os.environ['HOME'] = home
+    os.environ.pop('SKYTPU_DB_CONNECTION_URI', None)
+
+    from skypilot_tpu import config
+    config.reload_config()
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import state
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.provision import common as pc
+    from skypilot_tpu.utils.status_lib import ClusterStatus
+
+    # --- clusters/storage state DB ---
+    info = pc.ClusterInfo(
+        cluster_name='fix-c1', cloud='local', region='local', zone=None,
+        instances=[pc.InstanceInfo('h0', '127.0.0.1', '127.0.0.1',
+                                   workdir='/tmp/h0')])
+    res = resources_lib.Resources(cloud='local',
+                                  accelerators='tpu-v5e-8')
+    handle = state.ClusterHandle('fix-c1', res, info, agent_port=46591)
+    state.add_or_update_cluster(handle, ClusterStatus.UP,
+                                autostop={'idle_minutes': 5,
+                                          'down': True},
+                                workspace='default', user_hash='u-fix')
+    state.add_storage('fix-st', 'gcs', 'MOUNT', 'fix-c1',
+                      config={'name': 'bucket-x'})
+
+    # --- users DB ---
+    from skypilot_tpu.users import state as users_state
+    users_state.add_or_update_user(users_state.User(
+        id='u-fix', name='fixture',
+        password_hash=users_state.hash_password('pw')))
+    users_state.set_role('u-fix', 'admin')
+    users_state.set_workspace_users('default', ['u-fix'])
+
+    # --- managed jobs DB ---
+    from skypilot_tpu.jobs import state as jobs_state
+    table = jobs_state.JobsTable()
+    job_id = table.submit('fix-job', {'run': 'echo fixture',
+                                      'name': 'fix-job'},
+                          recovery_strategy='failover',
+                          max_restarts_on_errors=2, user_hash='u-fix')
+    table.set_status(job_id, jobs_state.ManagedJobStatus.SUCCEEDED)
+
+    import gc
+    import sqlite3
+    gc.collect()   # drop lingering per-op connections before checkpoint
+    for src, dst in (('state.db', f'state_{tag}.db'),
+                     ('users.db', f'users_{tag}.db'),
+                     ('managed_jobs.db', f'managed_jobs_{tag}.db')):
+        path = os.path.join(home, '.skypilot_tpu', src)
+        # WAL mode keeps writes in the -wal sidecar; fold them into the
+        # main file so the single copied file is the whole database.
+        conn = sqlite3.connect(path)
+        conn.execute('PRAGMA wal_checkpoint(TRUNCATE)')
+        conn.close()
+        shutil.copy(path, os.path.join(out_dir, dst))
+
+    # --- serialized Resources + Task (versioned plain dicts) ---
+    with open(os.path.join(out_dir, f'resources_{tag}.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(res.to_yaml_config(), f, indent=1, sort_keys=True)
+    task = task_lib.Task(name='fix-task', run='echo fixture',
+                         num_nodes=2)
+    task.set_resources(res)
+    task.update_envs({'FOO': 'bar'})
+    with open(os.path.join(out_dir, f'task_{tag}.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(task.to_yaml_config(), f, indent=1, sort_keys=True)
+
+    print(f'fixtures written to {out_dir} (tag {tag})')
+
+
+if __name__ == '__main__':
+    main()

@@ -51,9 +51,8 @@ def _qkv(x, attn_p, config):
     return q, k, v
 
 
-def _mlp(x, mlp_p):
-    gate = jax.nn.silu((x @ mlp_p['w_gate']).astype(jnp.float32)
-                       ).astype(x.dtype)
+def _mlp(x, mlp_p, act: str = 'silu'):
+    gate = llama.gate_activation(x @ mlp_p['w_gate'], act)
     return (gate * (x @ mlp_p['w_up'])) @ mlp_p['w_down']
 
 
@@ -70,7 +69,7 @@ def prefill(params: llama.Params, tokens: jax.Array,
     cos, sin = rope_ops.rope_frequencies(
         config.head_dim, max_len, config.rope_theta,
         scaling=config.rope_scaling_dict)
-    h = params['embed'][tokens]
+    h = llama.embed_tokens(params, tokens, config)
 
     attention_fn = functools.partial(attention_ops.flash_attention,
                                      causal=True)
@@ -86,7 +85,7 @@ def prefill(params: llama.Params, tokens: jax.Array,
         h = h + (o.reshape(batch, seq, -1) @ attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
-        h = h + _mlp(x, mlp_p)
+        h = h + _mlp(x, mlp_p, config.mlp_act)
         # Write this layer's K/V into the cache slot (padded region too —
         # masked out at decode time by the length mask).
         k_pad = jnp.zeros((batch, max_len) + k.shape[2:], k.dtype
@@ -118,7 +117,7 @@ def decode_step(params: llama.Params, token: jax.Array,
     cos, sin = rope_ops.rope_frequencies(
         config.head_dim, max_len, config.rope_theta,
         scaling=config.rope_scaling_dict)
-    h = params['embed'][token][:, None]            # (B, 1, d)
+    h = llama.embed_tokens(params, token, config)[:, None]  # (B, 1, d)
     pos = positions[:, None].astype(jnp.int32)      # (B, 1)
     # Attention mask over cache slots: slot j visible iff j <= pos.
     slot = jnp.arange(max_len)[None, :]             # (1, max_len)
@@ -156,7 +155,7 @@ def decode_step(params: llama.Params, token: jax.Array,
         h = h + (o.reshape(batch, 1, -1) @ attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
-        h = h + _mlp(x, mlp_p)
+        h = h + _mlp(x, mlp_p, config.mlp_act)
         return h, (k_cache, v_cache)
 
     h, (k_all, v_all) = jax.lax.scan(

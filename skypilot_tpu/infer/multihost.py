@@ -308,6 +308,9 @@ class MultiHostBatcher:
     def is_done(self, rid: int) -> bool:
         return self._batcher.is_done(rid)
 
+    def partial(self, rid: int):
+        return self._batcher.partial(rid)
+
     @property
     def num_active(self) -> int:
         return self._batcher.num_active

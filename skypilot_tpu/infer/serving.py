@@ -174,6 +174,12 @@ class ContinuousBatcher:
     def is_done(self, rid: int) -> bool:
         return self._requests[rid].done
 
+    def partial(self, rid: int) -> List[int]:
+        """Tokens generated SO FAR (streaming reads this while the
+        request is in flight; a snapshot copy — the scheduler keeps
+        appending)."""
+        return list(self._requests[rid].out)
+
     def result(self, rid: int) -> List[int]:
         # Check BEFORE popping: an in-flight result() call must leave
         # the request tracked (and on a multi-host replica, head-local

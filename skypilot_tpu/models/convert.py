@@ -1,9 +1,21 @@
-"""HuggingFace Llama checkpoint → stacked-layer JAX pytree.
+"""HuggingFace checkpoint → stacked-layer JAX pytree (Llama, Mistral,
+Gemma families).
 
-The bridge from the public Llama-3 weights to this framework's training
+The bridge from public HF weights to this framework's training
 (models/llama.py) and inference (infer/) paths: the reference's recipes
-get weights via torchtune/vLLM downloads (llm/llama-3_1-finetuning);
-here conversion is library code.
+get weights via torchtune/vLLM downloads (llm/llama-3_1-finetuning,
+llm/gemma/, llm/mixtral/ — the breadth role this module plays natively);
+here conversion is library code with per-family config mapping
+(auto-detected from `model_type`):
+
+- llama: the base mapping.
+- mistral: identical tensor layout; sliding-window attention is gated —
+  conversion refuses when max_seq_len exceeds the window (window == full
+  causal below it) rather than silently changing semantics.
+- gemma: gelu-tanh gated MLP, embeddings scaled by sqrt(d_model),
+  decoupled head_dim, tied lm_head, and (1 + w) RMSNorm — folded into
+  the stored norm weights at conversion so the runtime kernel is
+  unchanged.
 
 Layout notes:
 - HF `nn.Linear.weight` is (out_features, in_features); this framework
@@ -11,8 +23,8 @@ Layout notes:
   transposed on the way in.
 - Layers stack on a leading axis (one lax.scan drives the whole stack),
   so per-layer tensors are np.stack'ed.
-- HF Llama rotary uses rotate_half (split-halves) — identical to
-  ops/rope.py — so Q/K need no head-dim permutation.
+- HF rotary uses rotate_half (split-halves) — identical to ops/rope.py —
+  so Q/K need no head-dim permutation (all three families).
 """
 from __future__ import annotations
 
@@ -48,12 +60,47 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
         rope_scaling = tuple(sorted(
             (k, float(v) if isinstance(v, (int, float)) else v)
             for k, v in scaling.items()))
+    model_type = getattr(hf_config, 'model_type', 'llama')
+    if model_type not in ('llama', 'mistral', 'gemma'):
+        raise NotImplementedError(
+            f'model_type {model_type!r} is not supported '
+            "(supported: 'llama', 'mistral', 'gemma').")
+
     hf_head_dim = getattr(hf_config, 'head_dim', None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
+    head_dim_override = None
     if hf_head_dim is not None and hf_head_dim != derived:
-        raise NotImplementedError(
-            f'explicit head_dim={hf_head_dim} != hidden/heads={derived} '
-            'is not supported by the stacked Llama pytree.')
+        # Gemma-7B: head_dim 256 with hidden/heads = 192.
+        head_dim_override = int(hf_head_dim)
+
+    family: Dict[str, Any] = {}
+    if model_type == 'gemma':
+        act = getattr(hf_config, 'hidden_activation', None) or \
+            getattr(hf_config, 'hidden_act', 'gelu_pytorch_tanh')
+        if act not in ('gelu', 'gelu_pytorch_tanh'):
+            raise NotImplementedError(f'gemma activation {act!r}')
+        family = {'mlp_act': 'gelu_tanh',
+                  'embed_scale': float(hf_config.hidden_size) ** 0.5}
+    elif model_type == 'mistral':
+        window = getattr(hf_config, 'sliding_window', None)
+        if window is not None:
+            explicit = overrides.get('max_seq_len')
+            if explicit is not None and explicit > window:
+                # Beyond the window the attention semantics change —
+                # refuse an EXPLICIT ask rather than silently differ.
+                raise NotImplementedError(
+                    f'Mistral sliding-window attention (window='
+                    f'{window}) is not implemented for sequences '
+                    f'beyond the window; pass max_seq_len<={window}.')
+            if hf_config.max_position_embeddings > window:
+                # Default case (e.g. Mistral-7B-v0.1: 32k positions,
+                # 4k window): cap the usable context at the window,
+                # where sliding == full causal — every entry point
+                # (serve/train/SFT scripts) then loads real Mistral
+                # checkpoints without per-caller overrides.
+                family['max_seq_len'] = int(window)
+
+    family.setdefault('max_seq_len', hf_config.max_position_embeddings)
     cfg = llama.LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -61,18 +108,25 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
         n_heads=hf_config.num_attention_heads,
         n_kv_heads=hf_config.num_key_value_heads,
         d_ff=hf_config.intermediate_size,
-        max_seq_len=hf_config.max_position_embeddings,
         rope_theta=float(getattr(hf_config, 'rope_theta', 500000.0)),
         rope_scaling=rope_scaling,
         norm_eps=float(hf_config.rms_norm_eps),
-        dtype=dtype)
+        head_dim_override=head_dim_override,
+        dtype=dtype,
+        **family)
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
 
 
 def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
-                            config: llama.LlamaConfig) -> Params:
-    """Convert an HF Llama state_dict (torch tensors or numpy arrays,
-    fp32/bf16) into the stacked pytree llama.init_params produces."""
+                            config: llama.LlamaConfig,
+                            norm_offset: float = 0.0) -> Params:
+    """Convert an HF state_dict (torch tensors or numpy arrays,
+    fp32/bf16) into the stacked pytree llama.init_params produces.
+
+    norm_offset: added to every RMSNorm weight at conversion — Gemma
+    stores norms as (1 + w), so passing 1.0 folds that parameterization
+    into the stored weights and the runtime kernel stays family-free.
+    """
 
     def get(name: str) -> np.ndarray:
         w = state_dict[name]
@@ -84,12 +138,14 @@ def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
         # bf16 has no numpy dtype: round-trip through jnp.
         return jnp.asarray(x, dtype=config.dtype)
 
-    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+    def stack(fmt: str, transpose: bool = True,
+              offset: float = 0.0) -> jnp.ndarray:
         ws = []
         for i in range(config.n_layers):
             w = get(fmt.format(i))
-            ws.append(np.asarray(w, np.float32).T if transpose
-                      else np.asarray(w, np.float32))
+            w = np.asarray(w, np.float32).T if transpose \
+                else np.asarray(w, np.float32)
+            ws.append(w + offset if offset else w)
         return cast(np.stack(ws))
 
     prefix = 'model.'
@@ -107,9 +163,10 @@ def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
     return {
         'embed': embed,
         'layers': {
-            'ln1': stack(L + 'input_layernorm.weight', transpose=False),
+            'ln1': stack(L + 'input_layernorm.weight', transpose=False,
+                         offset=norm_offset),
             'ln2': stack(L + 'post_attention_layernorm.weight',
-                         transpose=False),
+                         transpose=False, offset=norm_offset),
             'attn': {
                 'wq': stack(L + 'self_attn.q_proj.weight'),
                 'wk': stack(L + 'self_attn.k_proj.weight'),
@@ -122,17 +179,19 @@ def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
                 'w_down': stack(L + 'mlp.down_proj.weight'),
             },
         },
-        'final_norm': cast(get(f'{prefix}norm.weight')),
+        'final_norm': cast(get(f'{prefix}norm.weight')
+                           + np.float32(norm_offset)),
         'lm_head': lm_head,
     }
 
 
-def load_hf_llama(model_name_or_path: str,
+def load_hf_model(model_name_or_path: str,
                   dtype: Any = jnp.bfloat16,
                   **config_overrides: Any
                   ) -> Tuple[Params, llama.LlamaConfig]:
-    """Load an HF Llama checkpoint (local path or hub name) and return
-    (params, config) ready for the trainer / inference engine."""
+    """Load an HF checkpoint (local path or hub name; Llama, Mistral, or
+    Gemma — auto-detected) and return (params, config) ready for the
+    trainer / inference engine."""
     import torch
     import transformers
     # bf16 load: fp32 would double (torch) + redouble (numpy copies)
@@ -141,6 +200,12 @@ def load_hf_llama(model_name_or_path: str,
         model_name_or_path, torch_dtype=torch.bfloat16)
     config = config_from_hf(model.config, dtype=dtype,
                             **config_overrides)
-    params = hf_state_dict_to_params(model.state_dict(), config)
+    norm_offset = 1.0 if model.config.model_type == 'gemma' else 0.0
+    params = hf_state_dict_to_params(model.state_dict(), config,
+                                     norm_offset=norm_offset)
     del model
     return params, config
+
+
+# Back-compat alias (r3 recipes/scripts import load_hf_llama).
+load_hf_llama = load_hf_model

@@ -63,9 +63,19 @@ class LlamaConfig:
     # time and ~2 GB of held residuals.  None = full logits (fine for
     # small vocabularies).
     loss_chunk: Optional[int] = None
+    # Family knobs (models/convert.py sets these from the HF config):
+    # Gemma uses gelu-tanh gated MLPs, scales embeddings by sqrt(d), and
+    # decouples head_dim from d_model/n_heads (7B: 256 vs 192).  Llama
+    # and Mistral keep the defaults.  Gemma's (1+w) RMSNorm is folded
+    # into the weights at conversion, not a runtime knob.
+    mlp_act: str = 'silu'                  # 'silu' | 'gelu_tanh'
+    embed_scale: float = 1.0
+    head_dim_override: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.d_model // self.n_heads
 
     @property
@@ -136,6 +146,30 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
 
 AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
 
+
+def gate_activation(x: jax.Array, kind: str) -> jax.Array:
+    """Gated-MLP activation in f32 (silu for Llama/Mistral, tanh-gelu
+    for Gemma), cast back to the compute dtype."""
+    xf = x.astype(jnp.float32)
+    if kind == 'silu':
+        out = jax.nn.silu(xf)
+    elif kind == 'gelu_tanh':
+        out = jax.nn.gelu(xf, approximate=True)
+    else:
+        raise ValueError(f'Unknown mlp_act {kind!r}')
+    return out.astype(x.dtype)
+
+
+def embed_tokens(params: Params, tokens: jax.Array,
+                 config: LlamaConfig) -> jax.Array:
+    """Token embedding lookup + the family's embedding scale (Gemma
+    multiplies by sqrt(d_model), computed in the table dtype to match
+    the published numerics)."""
+    h = params['embed'][tokens]
+    if config.embed_scale != 1.0:
+        h = h * jnp.asarray(config.embed_scale, h.dtype)
+    return h
+
 _REMAT_POLICIES = {
     None: lambda: None,
     'dots': lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
@@ -171,8 +205,7 @@ def _layer(h: jax.Array, layer_params: Params, *, config: LlamaConfig,
     h = h + (o.reshape(batch, seq, nh * hd) @ attn_p['wo'])
 
     x = rmsnorm_ops.rms_norm(h, layer_params['ln2'], eps=config.norm_eps)
-    gate = jax.nn.silu((x @ mlp_p['w_gate']).astype(jnp.float32)
-                       ).astype(x.dtype)
+    gate = gate_activation(x @ mlp_p['w_gate'], config.mlp_act)
     h = h + ((gate * (x @ mlp_p['w_up'])) @ mlp_p['w_down'])
     return h
 
@@ -190,7 +223,7 @@ def hidden_states(params: Params, tokens: jax.Array, config: LlamaConfig,
     cos, sin = rope_ops.rope_frequencies(
         config.head_dim, seq_len, config.rope_theta,
         scaling=config.rope_scaling_dict)
-    h = params['embed'][tokens]
+    h = embed_tokens(params, tokens, config)
 
     layer_fn = functools.partial(_layer, config=config, cos=cos, sin=sin,
                                  attention_fn=attention_fn)
@@ -240,7 +273,7 @@ def forward_pipelined(params: Params, tokens: jax.Array,
     cos, sin = rope_ops.rope_frequencies(
         config.head_dim, seq_len, config.rope_theta,
         scaling=config.rope_scaling_dict)
-    h = params['embed'][tokens]
+    h = embed_tokens(params, tokens, config)
 
     layer_fn = functools.partial(_layer, config=config, cos=cos, sin=sin,
                                  attention_fn=attention_fn)

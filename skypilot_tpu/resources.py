@@ -357,6 +357,10 @@ class Resources:
 
     @staticmethod
     def _config_to_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+        # 'version' is what to_yaml_config stamps — accepted (and
+        # dropped) everywhere a dumped config can be loaded back, so
+        # from_yaml_config(to_yaml_config()) always round-trips.
+        config = {k: v for k, v in config.items() if k != 'version'}
         known = {'infra', 'cloud', 'region', 'zone', 'accelerators',
                  'accelerator_args', 'cpus', 'memory', 'instance_type',
                  'use_spot', 'disk_size', 'disk_tier', 'ports', 'image_id',

@@ -75,6 +75,7 @@ class SkyServeLoadBalancer:
                 status=503,
                 text='No ready replicas. Use "serve status" to check.')
         self.policy.pre_execute_hook(url)
+        out = None
         try:
             target = url + str(request.rel_url)
             async with aiohttp.ClientSession(auto_decompress=False) as sess:
@@ -83,13 +84,30 @@ class SkyServeLoadBalancer:
                         headers=request.headers.copy(),
                         data=await request.read(),
                         allow_redirects=False) as resp:
-                    body = await resp.read()
                     headers = {k: v for k, v in resp.headers.items()
                                if k.lower() not in
                                ('transfer-encoding', 'content-length')}
-                    return web.Response(status=resp.status, body=body,
-                                        headers=headers)
+                    # Stream the body through chunk-by-chunk: replicas
+                    # serve SSE (/v1/* stream=true) and buffering would
+                    # hold every token until completion.
+                    out = web.StreamResponse(status=resp.status,
+                                             headers=headers)
+                    await out.prepare(request)
+                    async for chunk in resp.content.iter_chunked(16384):
+                        await out.write(chunk)
+                    await out.write_eof()
+                    return out
         except aiohttp.ClientError as e:
+            if out is not None:
+                # Replica died MID-stream: the status line already went
+                # out, so a 502 response is impossible — end the stream
+                # (client sees truncation, which is the truth).
+                logger.warning(f'Replica {url} failed mid-stream: {e}')
+                try:
+                    await out.write_eof()
+                except (ConnectionError, RuntimeError):
+                    pass
+                return out
             return web.Response(status=502,
                                 text=f'Replica {url} unreachable: {e}')
         finally:

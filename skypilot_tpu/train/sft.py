@@ -1,0 +1,104 @@
+"""Supervised finetuning (SFT): prompt-masked cross entropy + the
+JSONL data path.
+
+The post-training recipe family the reference ships via torchtune
+configs (llm/llama-3_1-finetuning/ — lora.yaml's dataset/loss config;
+the capability, not the implementation): train only on COMPLETION
+tokens of {prompt, completion} pairs, so the model learns the response
+distribution without burning capacity re-modeling its own prompts.
+Works with every converted family (Llama/Mistral/Gemma —
+models/convert.py) and composes with the blockwise CE
+(config.loss_chunk) since the mask applies to per-token logprobs.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import losses as losses_ops
+
+
+def sft_loss_fn(params, batch: Dict[str, jax.Array],
+                config: llama.LlamaConfig,
+                attention_fn=None) -> jax.Array:
+    """Masked next-token CE.  batch: {'tokens': (B, S+1) int32,
+    'loss_mask': (B, S)} — mask[b, j] gates the loss on TARGET
+    tokens[b, j+1] (1.0 for completion tokens, 0.0 for prompt/pad)."""
+    tokens, mask = batch['tokens'], batch['loss_mask']
+    if config.loss_chunk:
+        h = llama.hidden_states(params, tokens[:, :-1], config,
+                                attention_fn=attention_fn)
+        lp = losses_ops.chunked_token_logprobs(
+            h, params['lm_head'], tokens[:, 1:],
+            chunk_size=config.loss_chunk)
+    else:
+        logits = llama.forward(params, tokens[:, :-1], config,
+                               attention_fn=attention_fn)
+        lp = losses_ops.token_logprobs(logits, tokens[:, 1:])
+    mask = mask.astype(lp.dtype)
+    return -(lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def encode_example(prompt_ids: List[int], completion_ids: List[int],
+                   seq_len: int, pad_id: int = 0):
+    """One example -> (tokens (S+1,), mask (S,)).  Truncates from the
+    right; the mask covers exactly the completion targets that
+    survived."""
+    ids = list(prompt_ids) + list(completion_ids)
+    ids = ids[:seq_len + 1]
+    tokens = np.full((seq_len + 1,), pad_id, np.int32)
+    tokens[:len(ids)] = ids
+    mask = np.zeros((seq_len,), np.float32)
+    # Target position j predicts tokens[j+1]: completion targets start
+    # at j = len(prompt) - 1 and end before the pad.
+    start = max(len(prompt_ids) - 1, 0)
+    stop = max(len(ids) - 1, 0)
+    mask[start:stop] = 1.0
+    return tokens, mask
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path, encoding='utf-8') as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            ex = json.loads(line)
+            if 'prompt' not in ex or 'completion' not in ex:
+                raise ValueError(
+                    f'{path}:{i + 1}: each JSONL line needs "prompt" '
+                    f'and "completion" fields')
+            out.append(ex)
+    if not out:
+        raise ValueError(f'{path}: no examples')
+    return out
+
+
+def sft_batches(path: str, encode: Callable[[str], List[int]],
+                batch_size: int, seq_len: int,
+                eos_id: Optional[int] = None,
+                seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Forever-iterator of SFT batches from a {prompt, completion}
+    JSONL file.  `encode`: text -> token ids (HF tokenizer or byte
+    fallback).  eos appended to each completion when given so the model
+    learns to stop."""
+    examples = load_jsonl(path)
+    pairs = []
+    for ex in examples:
+        p = list(encode(ex['prompt']))
+        c = list(encode(ex['completion']))
+        if eos_id is not None:
+            c = c + [eos_id]
+        pairs.append((p, c))
+    rng = np.random.default_rng(seed)
+    while True:
+        idx = rng.integers(0, len(pairs), size=batch_size)
+        toks, masks = zip(*(encode_example(*pairs[i], seq_len)
+                            for i in idx))
+        yield {'tokens': np.stack(toks), 'loss_mask': np.stack(masks)}

@@ -168,7 +168,11 @@ class PostgresConnection:
             sql = (sql[:head] + 'INSERT' +
                    sql[head + len('INSERT OR IGNORE'):] +
                    ' ON CONFLICT DO NOTHING')
-        return sql.replace('?', '%s')
+        # Placeholder style: only OUTSIDE string literals — a '?' inside
+        # a quoted literal is data, and blanket replace would corrupt it
+        # (proven over the live statement corpus in tests/test_pg_corpus.py).
+        return re.sub(r"'(?:[^']|'')*'|(\?)",
+                      lambda m: '%s' if m.group(1) else m.group(0), sql)
 
     # -- sqlite3.Connection surface --------------------------------------
     def execute(self, sql: str, params: Tuple = ()) -> _PgCursor:

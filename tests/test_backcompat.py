@@ -1,0 +1,110 @@
+"""Backward compatibility against COMMITTED old-version artifacts
+(tests/fixtures/backcompat/) — the role of the reference's
+tests/smoke_tests/backward_compat/ suite.
+
+The fixtures are real files written by earlier code (state_v0: the
+round-0 schema; *_r4: round-4's writers — regenerate new tags with
+scripts/gen_backcompat_fixtures.py when a schema changes, keeping old
+tags loading).  Current code must open every one of them: migrations
+apply, handles deserialize, versioned dicts load.
+"""
+import json
+import os
+import shutil
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), 'fixtures',
+                        'backcompat')
+
+
+@pytest.fixture()
+def fixture_home(tmp_path, monkeypatch):
+    """Isolated HOME with fixture DBs installed at the live paths
+    (copies: the committed files must never be mutated by migrations)."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    monkeypatch.delenv('SKYTPU_DB_CONNECTION_URI', raising=False)
+    from skypilot_tpu import config
+    config.reload_config()
+    os.makedirs(tmp_path / '.skypilot_tpu', exist_ok=True)
+
+    def install(fixture, name):
+        shutil.copy(os.path.join(FIXTURES, fixture),
+                    tmp_path / '.skypilot_tpu' / name)
+
+    yield install
+    config.reload_config()
+
+
+def test_round0_state_db_migrates_and_loads(fixture_home):
+    """The oldest committed schema (no workspace/user_hash/status_message
+    columns) migrates to head and its cluster record loads through the
+    CURRENT reader."""
+    fixture_home('state_v0.db', 'state.db')
+    from skypilot_tpu import state
+    record = state.get_cluster('old-c')
+    assert record is not None
+    assert record['handle'].cluster_name == 'old-c'
+    assert record['workspace'] == 'default'     # migration default
+    assert record['status'].value == 'UP'
+
+
+def test_r4_state_db_loads(fixture_home):
+    fixture_home('state_r4.db', 'state.db')
+    from skypilot_tpu import state
+    record = state.get_cluster('fix-c1')
+    assert record is not None
+    handle = record['handle']
+    assert handle.agent_port == 46591
+    assert 'tpu-v5e-8' in handle.launched_resources.accelerators
+    assert record['autostop'] == {'idle_minutes': 5, 'down': True}
+    assert record['user_hash'] == 'u-fix'
+    storage = state.get_storage('fix-st')
+    assert storage['store'] == 'gcs'
+    assert json.loads(storage['config_json'])['name'] == 'bucket-x'
+
+
+def test_r4_users_db_loads(fixture_home):
+    fixture_home('users_r4.db', 'users.db')
+    from skypilot_tpu.users import state as users_state
+    user = users_state.get_user('u-fix')
+    assert user is not None and user.name == 'fixture'
+    assert users_state.verify_password('pw', user.password_hash)
+    assert users_state.get_role('u-fix') == 'admin'
+    assert users_state.workspace_users('default') == ['u-fix']
+
+
+def test_r4_jobs_db_loads(fixture_home):
+    fixture_home('managed_jobs_r4.db', 'managed_jobs.db')
+    from skypilot_tpu.jobs import state as jobs_state
+    table = jobs_state.JobsTable()
+    [job] = [j for j in table.list() if j['name'] == 'fix-job']
+    assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    assert job['task_config']['run'] == 'echo fixture'
+    assert job['max_restarts_on_errors'] == 2
+
+
+def test_r4_resources_dict_loads():
+    from skypilot_tpu import resources as resources_lib
+    with open(os.path.join(FIXTURES, 'resources_r4.json'),
+              encoding='utf-8') as f:
+        cfg = json.load(f)
+    res = resources_lib.Resources.from_dict(cfg)
+    assert res.cloud == 'local'
+    assert 'tpu-v5e-8' in res.accelerators
+    # The task-YAML loader accepts the stamped dict too (round-trip).
+    [again] = resources_lib.Resources.from_yaml_config(
+        res.to_yaml_config())
+    assert again.accelerators == res.accelerators
+
+
+def test_r4_task_dict_loads():
+    from skypilot_tpu import task as task_lib
+    with open(os.path.join(FIXTURES, 'task_r4.json'),
+              encoding='utf-8') as f:
+        cfg = json.load(f)
+    task = task_lib.Task.from_yaml_config(cfg)
+    assert task.name == 'fix-task'
+    assert task.num_nodes == 2
+    assert task.envs.get('FOO') == 'bar'
+    assert 'tpu-v5e-8' in next(iter(task.resources)).accelerators

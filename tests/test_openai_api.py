@@ -1,0 +1,180 @@
+"""OpenAI-compatible serving surface (/v1/*) on the replica server.
+
+The capability users get from the reference's vLLM/TGI recipes
+(llm/vllm/service.yaml): any OpenAI client can point at the endpoint.
+Contract-tests the response schemas, the SSE stream framing
+(data: {json} ... data: [DONE]), finish reasons, usage accounting, and
+error shapes against a real server process on the debug model.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.slow
+SCRIPT = os.path.join(os.path.dirname(__file__), '..', 'examples',
+                      'scripts', 'serve_llama.py')
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post_stream(url, payload):
+    """-> list of SSE data payloads (raw strings, [DONE] included)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers['Content-Type'].startswith('text/event-stream')
+        buf = b''
+        while True:
+            chunk = r.read(1)
+            if not chunk:
+                break
+            buf += chunk
+        for block in buf.decode().split('\n\n'):
+            if block.startswith('data: '):
+                events.append(block[len('data: '):])
+    return events
+
+
+@pytest.fixture(scope='module')
+def server():
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, SCRIPT, '--port', str(port),
+         '--model-size', 'debug', '--max-seq-len', '128'],
+        env=dict(os.environ), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError('server died: ' + proc.stdout.read(
+                ).decode(errors='replace')[-2000:])
+        try:
+            with urllib.request.urlopen(base + '/health', timeout=5) as r:
+                if r.status == 200:
+                    break
+        except (urllib.error.URLError, OSError):
+            time.sleep(1.0)
+    else:
+        proc.kill()
+        raise RuntimeError('server never became healthy')
+    yield base
+    proc.terminate()
+    proc.wait(timeout=15)
+
+
+def test_models_endpoint(server):
+    with urllib.request.urlopen(server + '/v1/models', timeout=30) as r:
+        body = json.loads(r.read())
+    assert body['object'] == 'list'
+    assert body['data'][0]['id'] == 'debug'
+
+
+def test_completions_schema(server):
+    status, body = _post(server + '/v1/completions',
+                         {'prompt': 'hello tpu', 'max_tokens': 6})
+    assert status == 200
+    assert body['object'] == 'text_completion'
+    assert body['id'].startswith('cmpl-')
+    assert body['model'] == 'debug'
+    [choice] = body['choices']
+    assert choice['index'] == 0
+    assert isinstance(choice['text'], str)
+    assert choice['finish_reason'] == 'length'
+    assert body['usage']['completion_tokens'] == 6
+    assert body['usage']['total_tokens'] == \
+        body['usage']['prompt_tokens'] + 6
+
+
+def test_completions_token_id_prompt(server):
+    status, body = _post(server + '/v1/completions',
+                         {'prompt': [5, 9, 2], 'max_tokens': 4})
+    assert status == 200
+    assert body['usage']['prompt_tokens'] == 3
+
+
+def test_chat_completions_schema(server):
+    status, body = _post(
+        server + '/v1/chat/completions',
+        {'messages': [{'role': 'user', 'content': 'hi'}],
+         'max_tokens': 5})
+    assert status == 200
+    assert body['object'] == 'chat.completion'
+    assert body['id'].startswith('chatcmpl-')
+    [choice] = body['choices']
+    assert choice['message']['role'] == 'assistant'
+    assert isinstance(choice['message']['content'], str)
+    assert choice['finish_reason'] == 'length'
+
+
+def test_completions_streaming_sse(server):
+    events = _post_stream(server + '/v1/completions',
+                          {'prompt': 'stream me', 'max_tokens': 8,
+                           'stream': True})
+    assert events[-1] == '[DONE]'
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert all(p['object'] == 'text_completion' for p in parsed)
+    # Exactly one terminal chunk carries the finish_reason.
+    finishes = [p['choices'][0]['finish_reason'] for p in parsed]
+    assert finishes[-1] == 'length'
+    assert all(f is None for f in finishes[:-1])
+    assert any(p['choices'][0]['text'] for p in parsed)
+
+
+def test_chat_streaming_role_then_content(server):
+    events = _post_stream(
+        server + '/v1/chat/completions',
+        {'messages': [{'role': 'user', 'content': 'hi'}],
+         'max_tokens': 6, 'stream': True})
+    assert events[-1] == '[DONE]'
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert all(p['object'] == 'chat.completion.chunk' for p in parsed)
+    assert parsed[0]['choices'][0]['delta'].get('role') == 'assistant'
+    assert any(p['choices'][0]['delta'].get('content') for p in parsed)
+    assert parsed[-1]['choices'][0]['finish_reason'] == 'length'
+
+
+def test_openai_error_shapes(server):
+    status, body = _post(server + '/v1/completions',
+                         {'prompt': 'x', 'n': 3})
+    assert status == 400
+    assert body['error']['type'] == 'invalid_request_error'
+    status, body = _post(server + '/v1/completions', {})
+    assert status == 400
+    status, body = _post(server + '/v1/chat/completions',
+                         {'messages': []})
+    assert status == 400
+
+
+def test_completions_greedy_deterministic(server):
+    a = _post(server + '/v1/completions',
+              {'prompt': [5, 6, 7], 'max_tokens': 6})[1]
+    b = _post(server + '/v1/completions',
+              {'prompt': [5, 6, 7], 'max_tokens': 6})[1]
+    assert a['choices'][0]['text'] == b['choices'][0]['text']
