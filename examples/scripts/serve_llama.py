@@ -158,7 +158,7 @@ class BatcherDriver:
 
 def build_generator(model_size: str, max_seq_len: int, temperature: float,
                     hf_model: str = '', batch_size: int = 4, tp: int = 1,
-                    mesh_builder=None):
+                    mesh_builder=None, kv_cache_dtype=None):
     """mesh_builder: optional config -> Mesh callable (the multi-host
     path builds its mesh from the resolved model's KV-head count — the
     GQA overshard factor depends on it, so the config must exist
@@ -220,7 +220,8 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
             params = llama.init_params(config, jax.random.PRNGKey(0))
     gen = ContinuousBatcher(params, config, GeneratorConfig(
         max_seq_len=max_seq_len, batch_size=batch_size,
-        temperature=temperature, eos_token=eos), mesh=mesh)
+        temperature=temperature, eos_token=eos,
+        kv_cache_dtype=kv_cache_dtype), mesh=mesh)
     return gen, config, tokenizer
 
 
@@ -502,6 +503,11 @@ def main() -> int:
                         help='tensor-parallel degree: shard params + KV '
                              'cache over this many chips so models '
                              'larger than one chip\'s HBM can serve')
+    parser.add_argument('--kv-cache-dtype', default=None,
+                        choices=[None, 'int8'],
+                        help='int8: quantized KV cache — ~2x the '
+                             'slots/context per GB of HBM (the vLLM '
+                             'kv_cache_dtype analog)')
     parser.add_argument('--devices-per-host', type=int, default=0,
                         help='CPU-emulation only: virtual devices per '
                              'host process (real TPU hosts discover '
@@ -540,7 +546,7 @@ def main() -> int:
     gen, config, tokenizer = build_generator(
         args.model_size, args.max_seq_len, args.temperature,
         args.hf_model, args.batch_size, args.tp,
-        mesh_builder=mesh_builder)
+        mesh_builder=mesh_builder, kv_cache_dtype=args.kv_cache_dtype)
     if info['num_hosts'] > 1:
         control_port = args.control_port or info['control_port']
         if info['host_id'] != 0:

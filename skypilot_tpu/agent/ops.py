@@ -111,6 +111,62 @@ class AgentOps:
         return log_lib.tail_logs(log_path, follow=follow, stop_when=_done,
                                  offset=offset)
 
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of this host's utilization — the
+        per-cluster metrics the dashboard's cluster drill-down shows
+        (reference scope: sky/dashboard per-cluster views backed by
+        external-metrics; here the agent itself is the exporter).
+        HTTP-only: Prometheus scrapes HTTP, so there is no gRPC mirror
+        of this surface."""
+        jobs = self.state.job_table.queue(all_jobs=True)
+        active = sum(1 for j in jobs
+                     if not JobStatus(j['status']).is_terminal())
+        pending = sum(1 for j in jobs
+                      if JobStatus(j['status']) == JobStatus.PENDING)
+        lines = [
+            '# TYPE skytpu_agent_uptime_seconds gauge',
+            f'skytpu_agent_uptime_seconds '
+            f'{time.time() - self.state.started_at:.1f}',
+            '# TYPE skytpu_agent_jobs_total gauge',
+            f'skytpu_agent_jobs_total {len(jobs)}',
+            '# TYPE skytpu_agent_jobs_active gauge',
+            f'skytpu_agent_jobs_active {active}',
+            '# TYPE skytpu_agent_jobs_pending gauge',
+            f'skytpu_agent_jobs_pending {pending}',
+        ]
+        idle = 0.0
+        if not self.state.job_table.has_active_jobs():
+            idle = max(0.0, time.time()
+                       - self.state.job_table.last_activity_time())
+        lines += ['# TYPE skytpu_agent_idle_seconds gauge',
+                  f'skytpu_agent_idle_seconds {idle:.1f}']
+        try:
+            load1 = os.getloadavg()[0]
+            lines += ['# TYPE skytpu_agent_load1 gauge',
+                      f'skytpu_agent_load1 {load1:.2f}']
+        except OSError:
+            pass
+        try:
+            meminfo = {}
+            with open('/proc/meminfo', encoding='utf-8') as f:
+                for line in f:
+                    key, _, rest = line.partition(':')
+                    meminfo[key] = int(rest.split()[0]) * 1024
+            total = meminfo.get('MemTotal', 0)
+            avail = meminfo.get('MemAvailable', 0)
+            lines += ['# TYPE skytpu_agent_mem_total_bytes gauge',
+                      f'skytpu_agent_mem_total_bytes {total}',
+                      '# TYPE skytpu_agent_mem_used_bytes gauge',
+                      f'skytpu_agent_mem_used_bytes {total - avail}']
+        except (OSError, ValueError, IndexError):
+            pass
+        import glob
+        chips = len(glob.glob('/dev/accel*')) or len(
+            glob.glob('/dev/vfio/*'))
+        lines += ['# TYPE skytpu_agent_tpu_chips gauge',
+                  f'skytpu_agent_tpu_chips {chips}']
+        return '\n'.join(lines) + '\n'
+
     def set_autostop(self, idle_minutes: int, down: bool) -> None:
         with open(self.state.autostop_path, 'w', encoding='utf-8') as f:
             json.dump({'idle_minutes': idle_minutes, 'down': bool(down),

@@ -107,6 +107,11 @@ def make_app(state: AgentState) -> web.Application:
         await resp.write_eof()
         return resp
 
+    @routes.get('/metrics')
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=ops.metrics_text(),
+                            content_type='text/plain')
+
     @routes.post('/autostop')
     async def autostop(request: web.Request) -> web.Response:
         body = await request.json()

@@ -77,6 +77,45 @@ function fmtCost(c) {
   return c == null ? '-' : `$${Number(c).toFixed(2)}/hr`;
 }
 
+function fmtDur(seconds) {
+  if (seconds == null) return '-';
+  const s = Math.round(Number(seconds));
+  if (s < 60) return `${s}s`;
+  if (s < 3600) return `${Math.floor(s / 60)}m ${s % 60}s`;
+  return `${Math.floor(s / 3600)}h ${Math.floor((s % 3600) / 60)}m`;
+}
+
+function gib(bytes) {
+  return bytes == null ? '-' : `${(bytes / 2 ** 30).toFixed(1)} GiB`;
+}
+
+// Managed-jobs timeline: one bar per job from submitted_at to
+// end_at/now, colored by status (reference scope direction:
+// sky/dashboard jobs views).  Pure CSS bars — no chart library.
+function jobsTimeline(rows) {
+  const jobs = rows.filter((j) => j.submitted_at);
+  if (!jobs.length) return '';
+  const now = Date.now() / 1000;
+  const t0 = Math.min(...jobs.map((j) => j.submitted_at));
+  const span = Math.max(now - t0, 1);
+  const bars = jobs.map((j) => {
+    const end = j.end_at || now;
+    const left = ((j.submitted_at - t0) / span) * 100;
+    const width = Math.max(((end - j.submitted_at) / span) * 100, 0.8);
+    const cls = STATUS_CLASS[String(j.status).toUpperCase()] || 'info';
+    const dur = fmtDur(end - j.submitted_at);
+    return '<div class="tl-row">' +
+        `<span class="tl-label mono">#${esc(j.job_id)} ` +
+        `${esc(j.name || '')}</span>` +
+        '<div class="tl-track">' +
+        `<div class="tl-bar ${cls}" style="left:${left}%;` +
+        `width:${width}%" title="${esc(j.status)} · ${esc(dur)}">` +
+        '</div></div>' +
+        `<span class="tl-dur">${esc(dur)}</span></div>`;
+  }).join('');
+  return `<h3>Timeline</h3><div class="timeline">${bars}</div>`;
+}
+
 // --- pages -------------------------------------------------------------
 
 // --- actions (cancel/down/logs; reference: dashboard row actions) ------
@@ -214,7 +253,28 @@ const PAGES = {
     async render(arg) {
       const jobs = await apiGet(
           `/api/cluster_jobs?cluster=${encodeURIComponent(arg)}`);
-      return `<h3 class="mono">${esc(arg)}</h3>` + table(
+      // Utilization from the head agent's Prometheus gauges (parsed by
+      // the server at /api/cluster_metrics) — unreachable agents (a
+      // STOPPED cluster) degrade to a note, not a broken page.
+      let util = '';
+      try {
+        const m = (await apiGet(
+            `/api/cluster_metrics?cluster=${encodeURIComponent(arg)}`
+            )).metrics;
+        util = cards([
+          [m.skytpu_agent_jobs_active ?? '-', 'active jobs'],
+          [m.skytpu_agent_load1 ?? '-', 'load (1m)'],
+          [`${gib(m.skytpu_agent_mem_used_bytes)} / ` +
+           `${gib(m.skytpu_agent_mem_total_bytes)}`, 'memory'],
+          [m.skytpu_agent_tpu_chips ?? '-', 'TPU chips'],
+          [fmtDur(m.skytpu_agent_uptime_seconds), 'agent uptime'],
+          [fmtDur(m.skytpu_agent_idle_seconds), 'idle'],
+        ]);
+      } catch (e) {
+        util = `<div class="empty">utilization unavailable ` +
+            `(${esc(e.message)})</div>`;
+      }
+      return `<h3 class="mono">${esc(arg)}</h3>` + util + table(
         ['Job', 'Name', 'Status', 'Submitted', 'Actions'],
         jobs.map((j) => [
           esc(j.job_id),
@@ -263,7 +323,7 @@ const PAGES = {
             fmtTime(j.submitted_at),
             `<button class="action" data-act="cancel-job" ` +
                 `data-job="${Number(j.job_id)}">cancel</button>`,
-          ]));
+          ])) + jobsTimeline(rows);
     },
   },
   services: {
@@ -355,11 +415,35 @@ const PAGES = {
     async render() {
       const rows = await apiGet('/api/requests');
       return table(
-        ['ID', 'Name', 'Status', 'Created'],
+        ['ID', 'Name', 'Status', 'Created', 'Duration'],
         rows.slice().reverse().slice(0, 200).map((r) => [
-          `<span class="mono">${esc(r.request_id.slice(0, 8))}</span>`,
+          `<a class="mono" href="#request/${esc(r.request_id)}">` +
+              `${esc(r.request_id.slice(0, 8))}</a>`,
           esc(r.name), badge(r.status), fmtTime(r.created_at),
+          esc(r.finished_at
+              ? fmtDur(r.finished_at - r.created_at) : '…'),
         ]));
+    },
+  },
+  request: {
+    title: 'Request',
+    async render(arg) {
+      const d = await apiGet(
+          `/api/request?request_id=${encodeURIComponent(arg)}`);
+      const dur = d.finished_at
+          ? fmtDur(d.finished_at - d.created_at) : 'in flight';
+      return `<h3 class="mono">${esc(d.request_id)}</h3>` +
+          cards([[esc(d.name), 'operation'], [dur, 'duration']]) +
+          `<p>${badge(d.status)} · user ` +
+          `<span class="mono">${esc(d.user || '-')}</span> · ` +
+          `${fmtTime(d.created_at)}</p>` +
+          '<h3>Arguments</h3>' +
+          `<pre class="logview">${
+            esc(JSON.stringify(d.payload, null, 1))}</pre>` +
+          (d.error ? `<h3>Error</h3><pre class="logview">` +
+                     `${esc(d.error)}</pre>`
+                   : '<h3>Result</h3><pre class="logview">' +
+                     `${esc(JSON.stringify(d.result, null, 1))}</pre>`);
     },
   },
 };

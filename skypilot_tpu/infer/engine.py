@@ -38,6 +38,30 @@ class GeneratorConfig:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     eos_token: Optional[int] = None
+    # None = model dtype; 'int8' = quantized KV cache (per-token absmax
+    # scales, infer/llama_infer.py) — ~2x slots/context per GB of HBM
+    # and half the cache read traffic on the bandwidth-bound decode.
+    kv_cache_dtype: Optional[str] = None
+    # 'inplace' (default): fori_loop decode with row-level cache
+    # scatter (no per-layer full-slice write-back); 'scan': the layer
+    # scan with cache in xs/ys.  Same math, different HBM traffic —
+    # see llama_infer.decode_step_inplace.
+    decode_impl: str = 'inplace'
+
+
+def validate_context(gen_config: 'GeneratorConfig', model_config) -> None:
+    """The engine's context window must fit the MODEL's positional
+    ceiling: serving past config.max_seq_len silently changes semantics
+    (rope extrapolation; and for Mistral, models/convert.py caps
+    max_seq_len at the sliding window precisely so attention beyond it
+    cannot masquerade as full-causal).  Shared by both engines."""
+    if gen_config.max_seq_len > model_config.max_seq_len:
+        raise ValueError(
+            f'GeneratorConfig.max_seq_len={gen_config.max_seq_len} '
+            f'exceeds the model\'s context ceiling '
+            f'{model_config.max_seq_len} (for Mistral this is the '
+            f'sliding window — serving beyond it would silently change '
+            f'attention semantics)')
 
 
 def derive_buckets(gen_config: 'GeneratorConfig'):
@@ -81,6 +105,7 @@ class Generator:
         if mesh is not None:
             tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
+        validate_context(gen_config, config)
         self.params = params
         self.config = config
         self.gen = gen_config
@@ -117,10 +142,12 @@ class Generator:
                            *, n, temperature, top_k, top_p):
         """n decode steps fully on device → tokens (B, n) + final state."""
 
+        decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
+
         def step(carry, _):
             token, cache, positions, rng = carry
             rng, sub = jax.random.split(rng)
-            logits, cache = llama_infer.decode_step(
+            logits, cache = decode_fn(
                 params, token, self.config, cache, positions)
             nxt = sampling.sample_logits(
                 logits, sub, temperature=temperature, top_k=top_k,
@@ -176,7 +203,8 @@ class Generator:
         cache = llama_infer.init_cache(
             self.config, batch, self.gen.max_seq_len,
             sharding=(None if self.mesh is None
-                      else tp_lib.cache_sharding(self.mesh)))
+                      else tp_lib.cache_sharding(self.mesh)),
+            kv_dtype=self.gen.kv_cache_dtype)
         logits, cache = self._prefill(self.params, jnp.asarray(tokens),
                                       cache=cache,
                                       lengths=jnp.asarray(lens))

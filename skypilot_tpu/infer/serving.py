@@ -64,18 +64,21 @@ class ContinuousBatcher:
         if mesh is not None:
             tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
+        from skypilot_tpu.infer.engine import (derive_buckets,
+                                               validate_context)
+        validate_context(gen_config, config)
         self.params = params
         self.config = config
         self.gen = gen_config
         self.decode_chunk = decode_chunk
-        from skypilot_tpu.infer.engine import derive_buckets
         self.buckets = derive_buckets(gen_config)
 
         batch = gen_config.batch_size
         self._cache = llama_infer.init_cache(
             config, batch, gen_config.max_seq_len,
             sharding=(None if mesh is None
-                      else tp_lib.cache_sharding(mesh)))
+                      else tp_lib.cache_sharding(mesh)),
+            kv_dtype=gen_config.kv_cache_dtype)
         self._token = jnp.zeros((batch,), jnp.int32)
         self._positions = jnp.zeros((batch,), jnp.int32)
         # Host mirror of _positions, advanced from known increments
@@ -114,13 +117,14 @@ class ContinuousBatcher:
         dispatches (each a full tunnel round-trip) into one."""
         group = tokens.shape[0]
         small = llama_infer.init_cache(config, group,
-                                       self.gen.max_seq_len)
+                                       self.gen.max_seq_len,
+                                       kv_dtype=self.gen.kv_cache_dtype)
         logits, small = llama_infer.prefill(
             params, tokens, config=config, cache=small, lengths=lengths)
         # Scatter each group row into its slot on the batch axis (1):
         # big[:, slots[i]] = small[:, i].
         big_cache = dict(big_cache)
-        for key in ('k', 'v'):
+        for key in big_cache:   # k/v (+ scales when int8-quantized)
             big_cache[key] = big_cache[key].at[:, slots].set(small[key])
         big_cache = tp_lib.constrain_cache(big_cache, self.mesh)
         rng, sub = jax.random.split(rng)
@@ -133,10 +137,12 @@ class ContinuousBatcher:
 
     def _decode_impl(self, params, token, cache, positions, rng, *, n,
                      temperature, top_k, top_p):
+        decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
+
         def step(carry, _):
             token, cache, positions, rng = carry
             rng, sub = jax.random.split(rng)
-            logits, cache = llama_infer.decode_step(
+            logits, cache = decode_fn(
                 params, token, self.config, cache, positions)
             nxt = sampling.sample_logits(logits, sub,
                                          temperature=temperature,

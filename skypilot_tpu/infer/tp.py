@@ -59,6 +59,8 @@ INFER_TP_RULES = PartitionRules([
 # Cache (L, B, max_len, KV_heads, head_dim): shard the kv-head axis over
 # 'tp'; implicitly replicated over the 'tpq' overshard subgroup.
 CACHE_SPEC = P(None, None, None, 'tp', None)
+# int8-cache scales (L, B, max_len, KV_heads): same kv-head sharding.
+CACHE_SCALE_SPEC = P(None, None, None, 'tp')
 
 
 def tp_factors(config, tp: int):
@@ -158,6 +160,10 @@ def cache_sharding(mesh) -> NamedSharding:
     return NamedSharding(mesh, CACHE_SPEC)
 
 
+def cache_scale_sharding(mesh) -> NamedSharding:
+    return NamedSharding(mesh, CACHE_SCALE_SPEC)
+
+
 def replicate(x, mesh):
     """Constrain x to a fully-replicated layout (usable inside jit).
 
@@ -181,7 +187,9 @@ def constrain_cache(cache, mesh):
     if mesh is None:
         return cache
     import jax
-    sh = cache_sharding(mesh)
-    return {k: jax.lax.with_sharding_constraint(v, sh)
-            for k, v in cache.items()}
+    kv_sh = cache_sharding(mesh)
+    scale_sh = cache_scale_sharding(mesh)
+    return {k: jax.lax.with_sharding_constraint(
+        v, scale_sh if k.endswith('_scale') else kv_sh)
+        for k, v in cache.items()}
 

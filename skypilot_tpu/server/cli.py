@@ -85,3 +85,35 @@ def register(sub) -> None:
 
     pi = asub.add_parser('info', help='Show API server status')
     pi.set_defaults(fn=_cmd_info)
+
+    pm = asub.add_parser(
+        'manifest',
+        help='Print a Kubernetes manifest for a hosted API server '
+             '(pipe to `kubectl apply -f -`; the role of the '
+             'reference\'s helm chart)')
+    pm.add_argument('--namespace', default='skypilot-tpu')
+    pm.add_argument('--image', default=None,
+                    help='container image (default: a python base that '
+                         'pip-installs the package at boot)')
+    pm.add_argument('--port', type=int, default=None)
+    pm.add_argument('--state-storage', default='10Gi',
+                    help='PVC size for ~/.skypilot_tpu state')
+    pm.add_argument('--db-secret', default=None,
+                    help='Secret (key connection_string) holding a '
+                         'Postgres URI; enables multi-replica HA')
+    pm.add_argument('--replicas', type=int, default=1)
+    pm.set_defaults(fn=_cmd_manifest)
+
+
+def _cmd_manifest(args) -> int:
+    from skypilot_tpu.server import deploy
+    from skypilot_tpu.server.server import DEFAULT_PORT
+    kwargs = {'namespace': args.namespace,
+              'state_storage': args.state_storage,
+              'db_secret_name': args.db_secret,
+              'replicas': args.replicas,
+              'port': args.port or DEFAULT_PORT}
+    if args.image:
+        kwargs['image'] = args.image
+    print(deploy.render_yaml(**kwargs), end='')
+    return 0

@@ -1,0 +1,110 @@
+"""Kubernetes deployment manifest for the API server control plane.
+
+Reference parity: charts/skypilot (helm: api-deployment.yaml,
+api-service.yaml, db-secrets.yaml — the API server as a k8s service
+with persistent state and optional Postgres).  No helm binary is
+required here: the manifest is rendered from parameters and applied
+with plain `kubectl apply -f -` (`skytpu api manifest | kubectl apply
+-f -`).
+
+Pieces:
+- PVC for ~/.skypilot_tpu (cluster/user/jobs sqlite state survives pod
+  restarts) — unnecessary when a Postgres URI is configured, but
+  harmless (logs/config still live there);
+- Deployment running `python -m skypilot_tpu.server.server`, with
+  SKYTPU_DB_CONNECTION_URI injected from a Secret when --db-secret is
+  given (utils/db_engine.py then routes all state to Postgres, the
+  multi-replica HA setup);
+- ClusterIP Service on the API port.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.server.server import DEFAULT_PORT
+
+DEFAULT_IMAGE = 'python:3.12-slim'
+APP_LABEL = 'skypilot-tpu-api'
+
+
+def render_objects(namespace: str = 'skypilot-tpu',
+                   image: str = DEFAULT_IMAGE,
+                   port: int = DEFAULT_PORT,
+                   state_storage: str = '10Gi',
+                   db_secret_name: Optional[str] = None,
+                   replicas: int = 1) -> List[Dict[str, Any]]:
+    """The manifest as a list of k8s objects (dicts)."""
+    labels = {'app': APP_LABEL}
+    if replicas > 1 and not db_secret_name:
+        raise ValueError(
+            'replicas > 1 requires --db-secret (shared Postgres state); '
+            'sqlite-on-PVC state cannot be shared between API pods.')
+    env = [{'name': 'SKYTPU_API_PORT', 'value': str(port)}]
+    if db_secret_name:
+        env.append({'name': 'SKYTPU_DB_CONNECTION_URI',
+                    'valueFrom': {'secretKeyRef': {
+                        'name': db_secret_name,
+                        'key': 'connection_string'}}})
+    container: Dict[str, Any] = {
+        'name': 'api-server',
+        'image': image,
+        'command': ['/bin/sh', '-c'],
+        'args': [
+            'pip install skypilot-tpu || true; '
+            f'python -m skypilot_tpu.server.server --port {port}'],
+        'env': env,
+        'ports': [{'containerPort': port}],
+        'readinessProbe': {
+            'httpGet': {'path': '/api/health', 'port': port},
+            'initialDelaySeconds': 5,
+            'periodSeconds': 10},
+    }
+    pod_spec: Dict[str, Any] = {'containers': [container]}
+    objects: List[Dict[str, Any]] = [
+        {'apiVersion': 'v1', 'kind': 'Namespace',
+         'metadata': {'name': namespace}},
+    ]
+    if db_secret_name:
+        # Postgres holds all state: no PVC.  A shared RWO volume would
+        # deadlock multi-replica scheduling AND RollingUpdate's surge
+        # pod on volume attach; pod-local disk suffices for logs.
+        strategy = {'type': 'RollingUpdate'}
+    else:
+        strategy = {'type': 'Recreate'}   # the PVC is RWO: one pod max
+        objects.append(
+            {'apiVersion': 'v1', 'kind': 'PersistentVolumeClaim',
+             'metadata': {'name': f'{APP_LABEL}-state',
+                          'namespace': namespace, 'labels': labels},
+             'spec': {'accessModes': ['ReadWriteOnce'],
+                      'resources': {
+                          'requests': {'storage': state_storage}}}})
+        container['volumeMounts'] = [{
+            'name': 'state', 'mountPath': '/root/.skypilot_tpu'}]
+        pod_spec['volumes'] = [{
+            'name': 'state',
+            'persistentVolumeClaim': {
+                'claimName': f'{APP_LABEL}-state'}}]
+    objects += [
+        {'apiVersion': 'apps/v1', 'kind': 'Deployment',
+         'metadata': {'name': APP_LABEL, 'namespace': namespace,
+                      'labels': labels},
+         'spec': {
+             'replicas': replicas,
+             'selector': {'matchLabels': labels},
+             'strategy': strategy,
+             'template': {
+                 'metadata': {'labels': labels},
+                 'spec': pod_spec}}},
+        {'apiVersion': 'v1', 'kind': 'Service',
+         'metadata': {'name': APP_LABEL, 'namespace': namespace,
+                      'labels': labels},
+         'spec': {'type': 'ClusterIP', 'selector': labels,
+                  'ports': [{'port': port, 'targetPort': port}]}},
+    ]
+    return objects
+
+
+def render_yaml(**kwargs: Any) -> str:
+    import yaml
+    return yaml.safe_dump_all(render_objects(**kwargs),
+                              default_flow_style=False, sort_keys=False)

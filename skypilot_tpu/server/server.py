@@ -246,6 +246,7 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
         return web.json_response([{
             'request_id': r['request_id'], 'name': r['name'],
             'status': r['status'].value, 'created_at': r['created_at'],
+            'finished_at': r['finished_at'],
         } for r in records])
 
     @routes.post('/api/cancel')
@@ -288,6 +289,57 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
                        else j.get('status')),
             'submitted_at': j.get('submitted_at'),
         } for j in rows])
+
+    @routes.get('/api/cluster_metrics')
+    async def api_cluster_metrics(request: web.Request) -> web.Response:
+        """Utilization of one cluster for the dashboard drill-down:
+        fetches the head agent's Prometheus /metrics and returns the
+        skytpu_agent_* gauges as JSON (parsed server-side so the SPA
+        stays a dumb renderer and the shape is contract-testable)."""
+        from skypilot_tpu import state as state_lib
+        cluster = request.query.get('cluster', '')
+        record = await asyncio.to_thread(state_lib.get_cluster, cluster)
+        if record is None:
+            return _json_error(404, f'No cluster {cluster!r}')
+        url = record['handle'].agent_url() + '/metrics'
+
+        def fetch():
+            import requests as requests_http
+            resp = requests_http.get(url, timeout=10)
+            resp.raise_for_status()
+            return resp.text
+
+        try:
+            text = await asyncio.to_thread(fetch)
+        except Exception as e:  # pylint: disable=broad-except
+            return _json_error(502, f'agent metrics unreachable: {e}')
+        gauges = {}
+        for line in text.splitlines():
+            if line.startswith('skytpu_agent_'):
+                try:
+                    name, value = line.rsplit(None, 1)
+                    gauges[name] = float(value)
+                except ValueError:
+                    continue
+        return web.json_response({'cluster': cluster, 'metrics': gauges})
+
+    @routes.get('/api/request')
+    async def api_request_detail(request: web.Request) -> web.Response:
+        """One request's full record (args, result, error, timing) for
+        the dashboard requests drill-down."""
+        rid = request.query.get('request_id', '')
+        record = await asyncio.to_thread(requests_lib.get, rid)
+        if record is None:
+            return _json_error(404, f'No request {rid!r}')
+        return web.json_response({
+            'request_id': record['request_id'], 'name': record['name'],
+            'status': record['status'].value,
+            'payload': record['payload'],
+            'result': record['result'], 'error': record['error'],
+            'user': record['user'],
+            'created_at': record['created_at'],
+            'finished_at': record['finished_at'],
+        })
 
     @routes.get('/api/cluster_logs')
     async def api_cluster_logs(request: web.Request) -> web.Response:

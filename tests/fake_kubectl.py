@@ -87,16 +87,18 @@ def main():
     if cmd == 'apply':
         raw = sys.stdin.read()
         try:
-            manifest = json.loads(raw)
+            manifests = [json.loads(raw)]
         except json.JSONDecodeError:
             import yaml
-            manifest = yaml.safe_load(raw)
-        name = manifest['metadata']['name']
-        kind = manifest.get('kind', 'Pod')
-        manifest['status'] = _fake_status(manifest)
-        with open(os.path.join(_dir(), _key(kind, name)), 'w') as f:
-            json.dump(manifest, f)
-        print(f'{kind.lower()}/{name} created')
+            # Multi-document YAML, like real kubectl.
+            manifests = [m for m in yaml.safe_load_all(raw) if m]
+        for manifest in manifests:
+            name = manifest['metadata']['name']
+            kind = manifest.get('kind', 'Pod')
+            manifest['status'] = _fake_status(manifest)
+            with open(os.path.join(_dir(), _key(kind, name)), 'w') as f:
+                json.dump(manifest, f)
+            print(f'{kind.lower()}/{name} created')
         return
     if cmd == 'auth':
         # `auth can-i ...` — the fake cluster allows everything.

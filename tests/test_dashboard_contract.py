@@ -198,3 +198,68 @@ def test_follow_tail_includes_late_output(live):
         assert 'late-part' in text
 
     loop.run_until_complete(asyncio.wait_for(_run(), timeout=60))
+
+
+def test_cluster_metrics_endpoint_feeds_drilldown(live):
+    """/api/cluster_metrics returns the skytpu_agent_* gauges the
+    cluster page's utilization cards read (parsed from the REAL agent's
+    Prometheus /metrics)."""
+    c, loop = live
+
+    async def _run():
+        r = await c.get('/api/cluster_metrics?cluster=dashc')
+        assert r.status == 200, await r.text()
+        return (await r.json())['metrics']
+
+    metrics = loop.run_until_complete(_run())
+    body = _page_bodies()['cluster']
+    wanted = set(re.findall(r'\bm\.(skytpu_agent_\w+)', body))
+    assert wanted, 'cluster page reads no metrics (extractor broken?)'
+    missing = wanted - set(metrics)
+    # Gauges read with ?? fallbacks may be absent on exotic hosts, but
+    # the core set must exist.
+    assert {'skytpu_agent_jobs_active', 'skytpu_agent_uptime_seconds',
+            'skytpu_agent_idle_seconds'} <= set(metrics), metrics
+    assert not (missing - {'skytpu_agent_load1',
+                           'skytpu_agent_mem_used_bytes',
+                           'skytpu_agent_mem_total_bytes'}), missing
+
+
+def test_request_detail_page_contract(live):
+    """The #request/<id> drill-down's reads all exist in the live
+    /api/request response."""
+    c, loop = live
+
+    async def _run():
+        rows = await (await c.get('/api/requests')).json()
+        assert rows, 'no seeded requests'
+        rid = rows[0]['request_id']
+        r = await c.get(f'/api/request?request_id={rid}')
+        assert r.status == 200
+        return await r.json()
+
+    detail = loop.run_until_complete(_run())
+    body = _page_bodies()['request']
+    fields = set(re.findall(r'\bd\.(\w+)', body))
+    assert {'request_id', 'name', 'status', 'payload'} <= fields
+    missing = {f for f in fields if f not in detail}
+    assert not missing, (missing, sorted(detail))
+
+
+def test_jobs_timeline_uses_live_fields(live):
+    """The timeline reads submitted_at/end_at/status/job_id/name — all
+    must exist in the live jobs-queue rows."""
+    c, loop = live
+    rows = loop.run_until_complete(
+        _fetch_rows(c, 'call', '/jobs/queue'))
+    assert rows
+    src = open(APP_JS, encoding='utf-8').read()
+    tl = src[src.index('function jobsTimeline'):
+             src.index('// --- pages')]
+    fields = set(re.findall(r'\bj\.(\w+)', tl))
+    assert 'submitted_at' in fields and 'end_at' in fields
+    row = rows[0]
+    missing = {f for f in fields if f not in row}
+    missing -= {f for f in missing
+                if re.search(rf'\.{f}\s*(\|\||\?\?)', tl)}
+    assert not missing, (missing, sorted(row))

@@ -103,3 +103,103 @@ def test_sample_logits_greedy_and_filters():
     seen = {int(sample_logits(logits, jax.random.PRNGKey(s),
                               temperature=5.0)[0]) for s in range(40)}
     assert len(seen) > 1
+
+
+# --- int8 KV cache (infer/llama_infer.py quantized cache) ---
+
+def test_quantize_kv_roundtrip_error_small():
+    from skypilot_tpu.infer import llama_infer
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 128),
+                          jnp.float32)
+    q, s = llama_infer._quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (4, 8)
+    back = llama_infer._dequantize(q, s, jnp.float32)
+    err = jnp.abs(back - x) / (jnp.max(jnp.abs(x)) + 1e-9)
+    assert float(jnp.max(err)) < 0.01
+
+
+def test_init_cache_rejects_unknown_dtype():
+    from skypilot_tpu.infer import llama_infer
+    from skypilot_tpu.models import llama
+    with pytest.raises(ValueError, match='int8'):
+        llama_infer.init_cache(llama.LLAMA_DEBUG, 1, 8, kv_dtype='fp4')
+
+
+def test_int8_kv_cache_generates_matching_greedy():
+    """Quantized-cache greedy decode matches the full-precision engine
+    on the tiny model (int8 with per-token absmax scales is ~0.4%
+    error — far below this model's logit margins)."""
+    from skypilot_tpu.infer import GeneratorConfig
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+
+    def run(kv_dtype):
+        b = ContinuousBatcher(params, config, GeneratorConfig(
+            max_seq_len=64, batch_size=2, temperature=0.0,
+            prompt_buckets=[16], kv_cache_dtype=kv_dtype))
+        rids = [b.submit([5, 9, 2, 7], max_new_tokens=10),
+                b.submit([11, 3], max_new_tokens=10)]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    full = run(None)
+    quant = run('int8')
+    assert all(len(o) == 10 for o in quant)
+    assert quant == full
+
+
+def test_decode_impl_inplace_matches_scan():
+    """decode_step_inplace (fori_loop, row-scatter cache) is the same
+    math as the scan implementation — greedy outputs identical, for
+    both bf16-style and int8 caches."""
+    from skypilot_tpu.infer import GeneratorConfig
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+
+    def run(decode_impl, kv_dtype):
+        b = ContinuousBatcher(params, config, GeneratorConfig(
+            max_seq_len=64, batch_size=2, temperature=0.0,
+            prompt_buckets=[16], decode_impl=decode_impl,
+            kv_cache_dtype=kv_dtype))
+        rids = [b.submit([5, 9, 2, 7], max_new_tokens=10),
+                b.submit([11, 3], max_new_tokens=10)]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    for kv_dtype in (None, 'int8'):
+        assert run('inplace', kv_dtype) == run('scan', kv_dtype), kv_dtype
+
+
+def test_engine_rejects_context_beyond_model_ceiling():
+    """GeneratorConfig.max_seq_len beyond the MODEL's max_seq_len is a
+    semantics change (rope extrapolation; Mistral sliding window) —
+    both engines refuse at construction."""
+    from skypilot_tpu.infer import Generator, GeneratorConfig
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    import dataclasses
+    config = dataclasses.replace(llama.LLAMA_DEBUG, max_seq_len=64)
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gen = GeneratorConfig(max_seq_len=128, batch_size=1)
+    with pytest.raises(ValueError, match='context ceiling'):
+        Generator(params, config, gen)
+    with pytest.raises(ValueError, match='context ceiling'):
+        ContinuousBatcher(params, config, gen)
+
+
+def test_decode_impl_typo_rejected():
+    from skypilot_tpu.infer import GeneratorConfig
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    b = ContinuousBatcher(params, config, GeneratorConfig(
+        max_seq_len=64, batch_size=1, prompt_buckets=[16],
+        decode_impl='in-place'))
+    b.submit([1, 2], max_new_tokens=2)
+    with pytest.raises(ValueError, match='decode_impl'):
+        b.step()

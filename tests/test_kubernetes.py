@@ -309,3 +309,61 @@ def test_cross_cloud_volume_on_k8s_fails_fast(fake_kube):
             'kubernetes', 'default', 'kxc',
             {'num_hosts': 1, 'volumes': ['localvol']})
     vol_core.delete('localvol')
+
+
+# --- API-server deployment manifest (server/deploy.py; the helm-chart
+# role of the reference's charts/skypilot) ---
+
+def test_api_manifest_applies_against_kubectl(fake_kube):
+    """`skytpu api manifest | kubectl apply -f -` creates the
+    namespace, PVC, Deployment, and Service."""
+    import subprocess
+    from skypilot_tpu.server import deploy
+    manifest = deploy.render_yaml()
+    subprocess.run(['kubectl', 'apply', '-f', '-'],
+                   input=manifest.encode(), check=True)
+    kinds = {f.split('.')[0] for f in os.listdir(fake_kube)}
+    assert {'namespace', 'persistentvolumeclaim', 'deployment',
+            'service'} <= kinds, kinds
+
+
+def test_api_manifest_db_secret_wiring():
+    from skypilot_tpu.server import deploy
+    objs = deploy.render_objects(db_secret_name='pg-uri', replicas=2)
+    [dep] = [o for o in objs if o['kind'] == 'Deployment']
+    [container] = dep['spec']['template']['spec']['containers']
+    [env] = [e for e in container['env']
+             if e['name'] == 'SKYTPU_DB_CONNECTION_URI']
+    assert env['valueFrom']['secretKeyRef'] == {
+        'name': 'pg-uri', 'key': 'connection_string'}
+    assert dep['spec']['replicas'] == 2
+    assert dep['spec']['strategy']['type'] == 'RollingUpdate'
+    # With Postgres there must be NO shared RWO PVC: it would deadlock
+    # multi-replica scheduling / RollingUpdate surge pods on attach.
+    assert not [o for o in objs
+                if o['kind'] == 'PersistentVolumeClaim']
+    assert 'volumeMounts' not in container
+    assert 'volumes' not in dep['spec']['template']['spec']
+
+
+def test_api_manifest_rejects_ha_without_db():
+    """Multiple API pods sharing sqlite-on-PVC would corrupt state —
+    the renderer refuses."""
+    from skypilot_tpu.server import deploy
+    with pytest.raises(ValueError, match='db-secret'):
+        deploy.render_objects(replicas=3)
+
+
+def test_api_manifest_cli_prints_yaml(capsys):
+    from skypilot_tpu.server import cli as server_cli
+    import argparse
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers()
+    server_cli.register(sub)
+    args = parser.parse_args(['api', 'manifest'])
+    assert args.fn(args) == 0
+    out = capsys.readouterr().out
+    import yaml
+    objs = list(yaml.safe_load_all(out))
+    assert {o['kind'] for o in objs} == {
+        'Namespace', 'PersistentVolumeClaim', 'Deployment', 'Service'}
