@@ -173,13 +173,27 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
     tokenizer = None
     eos = None
     params = None
+    hf_streamable = False
     if hf_model:
+        import glob as glob_lib
+
+        import transformers
+
         from skypilot_tpu.models import convert
-        # Host-RAM numpy tree: the batcher's shard_params device_puts it
-        # shard-wise, so no chip ever holds the full model.
-        params, config = convert.load_hf_llama(hf_model)
+        # Local safetensors checkpoint + a mesh coming: STREAM-convert
+        # straight onto the shards (convert.load_hf_model_sharded) —
+        # host RAM stays at one tensor, which is what makes 70B-class
+        # replicas loadable at all.  Otherwise the host-RAM tree path.
+        hf_streamable = bool(
+            os.path.isdir(hf_model)
+            and glob_lib.glob(os.path.join(hf_model, '*.safetensors'))
+            and (mesh_builder is not None or tp > 1))
+        if hf_streamable:
+            config = convert.config_from_hf(
+                transformers.AutoConfig.from_pretrained(hf_model))
+        else:
+            params, config = convert.load_hf_model(hf_model)
         try:
-            import transformers
             tokenizer = transformers.AutoTokenizer.from_pretrained(
                 hf_model)
             eos = tokenizer.eos_token_id
@@ -207,7 +221,14 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
         # --tensor-parallel-size recipes (llm/vllm/service.yaml).
         from skypilot_tpu.infer import tp as tp_lib
         mesh = tp_lib.make_tp_mesh(tp, n_kv_heads=config.n_kv_heads)
-    if params is None:
+    if params is None and hf_streamable and mesh is not None:
+        from skypilot_tpu.infer import tp as tp_lib
+        from skypilot_tpu.models import convert
+        # config passed through: the mesh above was sized from it.
+        params, config = convert.load_hf_model_sharded(
+            hf_model, mesh, tp_lib.INFER_TP_RULES, config=config)
+        print(json.dumps({'load_path': 'streamed-sharded'}), flush=True)
+    elif params is None:
         if mesh is not None:
             # Random weights init DIRECTLY under the tp shardings (jit
             # with out_shardings): each chip only allocates its shard —
@@ -523,6 +544,11 @@ def main() -> int:
     # the head (process 0) binds the HTTP socket.  The TPU-native analog
     # of the reference's vLLM tensor-parallel replicas
     # (llm/vllm/service.yaml).
+    # Honor an explicit JAX_PLATFORMS before ANY backend init (a
+    # sitecustomize pin would otherwise grab the real TPU in processes
+    # meant for CPU).
+    from skypilot_tpu.utils import env_contract
+    env_contract.reassert_jax_platforms()
     from skypilot_tpu.infer import multihost
     if args.devices_per_host:
         import jax

@@ -81,14 +81,13 @@ def make_env_vars(node_rank: int,
     return envs
 
 
-def initialize_from_env(timeout_s: Optional[int] = None) -> None:
-    """Call jax.distributed.initialize from the injected contract.
+def reassert_jax_platforms() -> None:
+    """Re-assert the JAX_PLATFORMS env var over any sitecustomize pin.
 
-    Run this at the top of any multi-host recipe.  No-op for single-host
-    jobs (the contract is still present, with one node).  Also re-asserts
-    the user's JAX_PLATFORMS first: some sandboxes pin jax_platforms from
-    sitecustomize, which would otherwise override the env var.
-    """
+    Some sandboxes set jax_platforms programmatically at interpreter
+    start, which silently overrides the env var — a subprocess meant
+    for CPU would grab the real TPU.  Call before any backend init
+    (no-op once the backend exists)."""
     if os.environ.get('JAX_PLATFORMS'):
         import jax
         try:
@@ -96,6 +95,15 @@ def initialize_from_env(timeout_s: Optional[int] = None) -> None:
                               os.environ['JAX_PLATFORMS'])
         except RuntimeError:
             pass  # backend already initialized; trust the environment
+
+
+def initialize_from_env(timeout_s: Optional[int] = None) -> None:
+    """Call jax.distributed.initialize from the injected contract.
+
+    Run this at the top of any multi-host recipe.  No-op for single-host
+    jobs (the contract is still present, with one node).  Also re-asserts
+    the user's JAX_PLATFORMS first (reassert_jax_platforms)."""
+    reassert_jax_platforms()
     num_processes = int(os.environ.get(NUM_PROCESSES, '1'))
     if num_processes <= 1:
         return

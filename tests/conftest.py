@@ -8,7 +8,12 @@ tests/common_test_fixtures.py:182 — everything testable with no cloud/TPU).
 import os
 
 # Belt and braces: env vars work when jax is not yet imported...
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# FORCE-override (not setdefault): this sandbox exports
+# JAX_PLATFORMS=axon globally, and every subprocess a test spawns
+# (serve replicas, train scripts, agents) inherits os.environ — a
+# setdefault would silently put those subprocesses on the real TPU,
+# racing whatever owns the chip.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (

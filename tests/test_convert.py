@@ -259,3 +259,72 @@ def test_gemma_trains_hermetically(hf_gemma):
     for _ in range(4):
         loss, params = step(params)
     assert float(loss) < float(loss0)
+
+
+# --- streaming shard-on-load (load_hf_model_sharded) ---
+
+def test_sharded_load_matches_full_load(tmp_path, hf_model):
+    """Stream-converting a local safetensors checkpoint directly onto a
+    tp mesh produces the SAME weights (and the tp shardings) as the
+    full host-side load — without ever materializing the model tree on
+    the host."""
+    import jax
+    from skypilot_tpu.infer import tp as tp_lib
+    model_dir = str(tmp_path / 'ckpt')
+    hf_model.save_pretrained(model_dir, safe_serialization=True)
+
+    full_params, full_cfg = convert.load_hf_model(model_dir,
+                                                  dtype=jnp.float32)
+    mesh = tp_lib.make_tp_mesh(2, n_kv_heads=full_cfg.n_kv_heads)
+    params, cfg = convert.load_hf_model_sharded(
+        model_dir, mesh, tp_lib.INFER_TP_RULES, dtype=jnp.float32)
+    assert cfg == full_cfg
+    # Near-identical: load_hf_model round-trips through torch bf16,
+    # the streaming reader takes raw f32 from disk (MORE accurate).
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-2),
+        params, full_params)
+    # ...and already sharded per the tp rules.
+    wq = params['layers']['attn']['wq']
+    assert wq.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, None, ('tp', 'tpq'))),
+        3)
+
+
+def test_sharded_load_gemma_norm_offset(tmp_path, hf_gemma):
+    """The (1+w) Gemma norm fold applies on the streaming path too."""
+    import jax
+    from skypilot_tpu.infer import tp as tp_lib
+    model_dir = str(tmp_path / 'gemma')
+    hf_gemma.save_pretrained(model_dir, safe_serialization=True)
+    full_params, cfg = convert.load_hf_model(model_dir,
+                                             dtype=jnp.float32)
+    mesh = tp_lib.make_tp_mesh(1, n_kv_heads=cfg.n_kv_heads)
+    params, _ = convert.load_hf_model_sharded(
+        model_dir, mesh, tp_lib.INFER_TP_RULES, dtype=jnp.float32)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-2),
+        params, full_params)
+
+
+def test_sharded_load_requires_safetensors(tmp_path):
+    from skypilot_tpu.infer import tp as tp_lib
+    import jax
+    (tmp_path / 'empty').mkdir()
+    # Write a minimal config so AutoConfig resolves before the weights
+    # check fails.
+    import json as json_lib
+    with open(tmp_path / 'empty' / 'config.json', 'w') as f:
+        json_lib.dump({'model_type': 'llama', 'vocab_size': 32,
+                       'hidden_size': 16, 'intermediate_size': 32,
+                       'num_hidden_layers': 1,
+                       'num_attention_heads': 2,
+                       'num_key_value_heads': 1,
+                       'max_position_embeddings': 32,
+                       'rms_norm_eps': 1e-5}, f)
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:1]).reshape(1, 1), ('tp', 'tpq'))
+    with pytest.raises(FileNotFoundError, match='safetensors'):
+        convert.load_hf_model_sharded(str(tmp_path / 'empty'), mesh,
+                                      tp_lib.INFER_TP_RULES)

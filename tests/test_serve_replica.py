@@ -127,3 +127,53 @@ def test_concurrent_requests_continuous_batching(server):
             status, body = fut.result(timeout=120)
             assert status == 200
             assert body['output_ids'] == solo[ids], ids
+
+
+def test_hf_local_checkpoint_streams_onto_tp_mesh(tmp_path):
+    """--hf-model <local safetensors dir> with --tp: the server
+    stream-converts the checkpoint directly onto the tp shards
+    (convert.load_hf_model_sharded) and serves from it."""
+    transformers = pytest.importorskip('transformers')
+    torch = pytest.importorskip('torch')
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256)
+    torch.manual_seed(0)
+    model_dir = str(tmp_path / 'ckpt')
+    transformers.LlamaForCausalLM(cfg).save_pretrained(
+        model_dir, safe_serialization=True)
+
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, SCRIPT, '--port', str(port),
+         '--hf-model', model_dir, '--tp', '2',
+         '--max-seq-len', '128', '--batch-size', '2'],
+        env=dict(os.environ), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 180
+    try:
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError('server died: ' + proc.stdout.read()
+                                   .decode(errors='replace')[-2000:])
+            try:
+                with urllib.request.urlopen(base + '/health',
+                                            timeout=5) as r:
+                    if r.status == 200:
+                        break
+            except (urllib.error.URLError, OSError):
+                time.sleep(1.0)
+        else:
+            raise RuntimeError('server never became healthy')
+        status, body = _post(base + '/generate',
+                             {'prompt_ids': [5, 9, 2], 'max_new_tokens': 4})
+        assert status == 200
+        assert len(body['output_ids']) == 4
+    finally:
+        proc.terminate()
+        out, _ = proc.communicate(timeout=15)
+    # The STREAMING loader must have been the path taken — a silent
+    # fallback to the host-RAM torch load would pass /generate too.
+    assert b'"load_path": "streamed-sharded"' in out, out[-1500:]
