@@ -1,0 +1,122 @@
+"""Offline batch inference: JSONL prompts in, JSONL generations out.
+
+The bulk-generation counterpart of the serving recipe (reference
+parity: the vLLM offline-batched-inference pattern of llm/ recipes,
+llm/vllm/ — there it is a vLLM script inside a task; here the engine is
+library code).  Drives the same ContinuousBatcher as serving, so
+throughput properties (grouped prefill, fixed decode shapes, slot
+reuse) carry over; results stream to the output file as they finish,
+and --resume skips prompts already present in the output (preemption-
+friendly under managed jobs).
+
+Input lines:  {"id": optional, "prompt": "text"} or
+              {"id": ..., "prompt_ids": [1, 2, 3]}
+Output lines: {"id", "prompt_tokens", "output_ids", "output_text?"}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--input', required=True)
+    parser.add_argument('--output', required=True)
+    parser.add_argument('--hf-model', default='')
+    parser.add_argument('--model-size', default='debug')
+    parser.add_argument('--max-new-tokens', type=int, default=128)
+    parser.add_argument('--max-seq-len', type=int, default=1024)
+    parser.add_argument('--batch-size', type=int, default=8,
+                        help='decode slots')
+    parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--tp', type=int, default=1)
+    parser.add_argument('--kv-cache-dtype', default=None,
+                        choices=[None, 'int8'])
+    parser.add_argument('--resume', action='store_true',
+                        help='skip ids already in --output (append)')
+    args = parser.parse_args()
+
+    from skypilot_tpu.utils import env_contract
+    env_contract.reassert_jax_platforms()
+
+    # Reuse the serve recipe's model/engine construction (single source
+    # for family detect, sharded load, tokenizer fallback).
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'serve_llama', os.path.join(os.path.dirname(__file__),
+                                    'serve_llama.py'))
+    serve_llama = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_llama)
+
+    gen, config, tokenizer = serve_llama.build_generator(
+        args.model_size, args.max_seq_len, args.temperature,
+        args.hf_model, args.batch_size, args.tp,
+        kv_cache_dtype=args.kv_cache_dtype)
+
+    done_ids = set()
+    if args.resume and os.path.exists(args.output):
+        with open(args.output, encoding='utf-8') as f:
+            for line in f:
+                try:
+                    done_ids.add(json.loads(line)['id'])
+                except (ValueError, KeyError):
+                    continue
+
+    todo = []
+    with open(args.input, encoding='utf-8') as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            ex = json.loads(line)
+            ex_id = ex.get('id', i)
+            if ex_id in done_ids:
+                continue
+            if 'prompt_ids' in ex:
+                ids = [int(t) for t in ex['prompt_ids']]
+            elif 'prompt' in ex:
+                ids = serve_llama._encode_text(ex['prompt'], tokenizer,
+                                               config)
+            else:
+                raise SystemExit(
+                    f'{args.input}:{i + 1}: need "prompt" or '
+                    f'"prompt_ids"')
+            todo.append((ex_id, ids))
+    print(f'batch_infer: {len(todo)} prompts '
+          f'({len(done_ids)} already done)', flush=True)
+
+    mode = 'a' if args.resume else 'w'
+    in_flight = {}   # rid -> (id, n_prompt)
+    written = 0
+    with open(args.output, mode, encoding='utf-8') as out:
+        queue = list(todo)
+        while queue or in_flight:
+            # Keep up to 2x slots in flight: the batcher admits into
+            # free slots as others finish (continuous batching).
+            while queue and len(in_flight) < 2 * args.batch_size:
+                ex_id, ids = queue.pop(0)
+                rid = gen.submit(ids,
+                                 max_new_tokens=args.max_new_tokens)
+                in_flight[rid] = (ex_id, len(ids))
+            gen.step()
+            for rid in [r for r in list(in_flight) if gen.is_done(r)]:
+                ex_id, n_prompt = in_flight.pop(rid)
+                out_ids = gen.result(rid)
+                rec = {'id': ex_id, 'prompt_tokens': n_prompt,
+                       'output_ids': out_ids}
+                if tokenizer is not None:
+                    rec['output_text'] = tokenizer.decode(out_ids)
+                out.write(json.dumps(rec) + '\n')
+                out.flush()
+                written += 1
+                if written % 50 == 0:
+                    print(f'batch_infer: {written} done', flush=True)
+    print(f'batch_infer: wrote {written} generations to {args.output}',
+          flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -1,18 +1,22 @@
 """Serve state DB (reference: sky/serve/serve_state.py).
 
-Sqlite tables for services and replicas, plus the status enums
-(`ServiceStatus`, `ReplicaStatus`) mirroring the reference's state machine.
+Service/replica tables plus the status enums (`ServiceStatus`,
+`ReplicaStatus`) mirroring the reference's state machine.  Storage is
+engine-selected (utils/db_engine.py): the serve controller's sqlite
+file by default, shared Postgres when a connection string is
+configured — an HA serve controller then keeps its service/replica
+state off the controller host (same duality as the cluster/user/jobs
+state modules).
 """
 from __future__ import annotations
 
 import enum
 import json
-import os
-import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
 _DB_PATH = '~/.skypilot_tpu/serve.db'
+_SCHEMA_APPLIED: set = set()
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS services (
@@ -94,13 +98,13 @@ _TERMINAL_REPLICA_STATUSES = frozenset({
 })
 
 
-def _conn() -> sqlite3.Connection:
-    path = os.path.expanduser(_DB_PATH)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=30)
-    conn.execute('PRAGMA journal_mode=WAL')
-    conn.row_factory = sqlite3.Row
-    conn.executescript(_SCHEMA)
+def _conn():
+    from skypilot_tpu.utils import db_engine
+    conn = db_engine.connect(_DB_PATH)
+    key = db_engine.state_key(_DB_PATH)
+    if key not in _SCHEMA_APPLIED:
+        conn.executescript(_SCHEMA)
+        _SCHEMA_APPLIED.add(key)
     return conn
 
 
@@ -109,15 +113,15 @@ def _conn() -> sqlite3.Connection:
 def add_service(name: str, spec_json: Dict[str, Any],
                 task_json: Dict[str, Any]) -> bool:
     with _conn() as conn:
-        try:
-            conn.execute(
-                'INSERT INTO services (name, status, spec_json, task_json, '
-                'created_at) VALUES (?, ?, ?, ?, ?)',
-                (name, ServiceStatus.CONTROLLER_INIT.value,
-                 json.dumps(spec_json), json.dumps(task_json), time.time()))
-        except sqlite3.IntegrityError:
-            return False
-    return True
+        # INSERT OR IGNORE + rowcount instead of catching the driver's
+        # IntegrityError: portable across sqlite and the Postgres
+        # engine (db_engine translates to ON CONFLICT DO NOTHING).
+        cur = conn.execute(
+            'INSERT OR IGNORE INTO services (name, status, spec_json, '
+            'task_json, created_at) VALUES (?, ?, ?, ?, ?)',
+            (name, ServiceStatus.CONTROLLER_INIT.value,
+             json.dumps(spec_json), json.dumps(task_json), time.time()))
+        return cur.rowcount > 0
 
 
 def update_service(name: str, *, status: Optional[ServiceStatus] = None,
@@ -183,10 +187,19 @@ def add_replica(service_name: str, replica_id: int, cluster_name: str,
                 version: int, is_spot: bool = False,
                 location: Optional[Dict[str, Any]] = None) -> None:
     with _conn() as conn:
+        # ON CONFLICT DO UPDATE (not sqlite's INSERT OR REPLACE, which
+        # Postgres lacks): identical syntax on both engines.
         conn.execute(
-            'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
+            'INSERT INTO replicas (service_name, replica_id, '
             'status, version, cluster_name, is_spot, location_json, '
-            'launched_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
+            'launched_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?) '
+            'ON CONFLICT (service_name, replica_id) DO UPDATE SET '
+            'status = excluded.status, version = excluded.version, '
+            'cluster_name = excluded.cluster_name, '
+            'is_spot = excluded.is_spot, '
+            'location_json = excluded.location_json, '
+            'launched_at = excluded.launched_at, '
+            'consecutive_failures = 0, status_message = NULL',
             (service_name, replica_id, ReplicaStatus.PENDING.value, version,
              cluster_name, int(is_spot),
              json.dumps(location) if location else None, time.time()))

@@ -114,6 +114,10 @@ class _PgCursor:
         return iter(self.fetchall())
 
     @property
+    def rowcount(self):
+        return self._cursor.rowcount
+
+    @property
     def lastrowid(self):
         # Portable sqlite-cursor surface: the id of the row the last
         # INSERT gave a sequence value (same-session lastval()).

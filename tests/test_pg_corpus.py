@@ -119,6 +119,25 @@ def _drive_state_modules():
     users_state.remove_workspace('w1')
     users_state.delete_user('u1')
 
+    # Serve controller state (skypilot_tpu/serve/serve_state.py).
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+    assert serve_state.add_service('pgsvc', {'readiness_probe': '/'},
+                                   {'run': 'x'})
+    assert not serve_state.add_service('pgsvc', {}, {})  # duplicate
+    serve_state.update_service('pgsvc', status=ServiceStatus.READY,
+                               endpoint='http://127.0.0.1:1')
+    serve_state.add_replica('pgsvc', 1, 'pgsvc-r1', version=1)
+    serve_state.add_replica('pgsvc', 1, 'pgsvc-r1b', version=2)  # upsert
+    serve_state.update_replica('pgsvc', 1, status=ReplicaStatus.READY,
+                               url='http://127.0.0.1:2')
+    serve_state.get_service('pgsvc')
+    serve_state.get_services()
+    serve_state.get_replicas('pgsvc')
+    serve_state.next_replica_id('pgsvc')
+    serve_state.remove_replica('pgsvc', 1)
+    serve_state.remove_service('pgsvc')
+
     # Managed jobs (skypilot_tpu/jobs/state.py).
     from skypilot_tpu.jobs import state as jobs_state
     table = jobs_state.JobsTable()
