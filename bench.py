@@ -83,7 +83,11 @@ def bench_8b_extrapolated(on_tpu: bool) -> dict:
             n_kv_heads=8, d_ff=14336, max_seq_len=4096,
             dtype=jnp.bfloat16, remat=True, remat_policy='dots',
             loss_chunk=512)
-        batch, seq, iters = 1, 4096, 8
+        # bs=2 (r3 used 1): blockwise CE freed the ~2 GB the full
+        # logits held, and the larger M dim is worth ~+5% per chip
+        # (probe: 22.3k vs 21.3k tok/s on the k=2 piece) — also the
+        # realistic per-chip batch of an fsdp run.
+        batch, seq, iters = 2, 4096, 8
     else:
         cfg = llama.LLAMA_DEBUG
         batch, seq, iters = 1, 64, 2
@@ -484,11 +488,15 @@ def main() -> None:
                   # stay interpretable (VERDICT r2 weak #7).
                   'method_notes': (
                       'r4: blockwise cross-entropy (loss_chunk) on the '
-                      '1B (chunk 256) and 8B (chunk 512) configs — the '
-                      'full-logits head cost ~2 layers of step time in '
-                      'r3; timing + extrapolation method unchanged '
-                      'from r3 (chained SGD fori_loop, (1,2)-layer '
-                      'slope + head, matmul-params MFU convention)')},
+                      '1B (chunk 256) and 8B (chunk 512) configs; 8B '
+                      'extrapolation now bs=2x4096 (r3: bs=1 — the '
+                      'full logits no longer pin the HBM) with a '
+                      'retry-on-failed-cross-check guard; decode is '
+                      'the new in-place (fori+row-scatter) impl with '
+                      'roofline/latency reporting; timing + '
+                      'extrapolation method otherwise unchanged from '
+                      'r3 (chained SGD fori_loop, (1,2)-layer slope + '
+                      'head, matmul-params MFU convention)')},
     }))
 
 
