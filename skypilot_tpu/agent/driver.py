@@ -150,6 +150,19 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
     num_slices = int(spec.get('num_slices', 1))
     hosts_per_slice = max(len(hosts) // num_slices, 1)
 
+    # jax.distributed coordinator port: the default is fine on real
+    # clusters (each gang's head is its own machine), but on the local
+    # cloud every gang shares 127.0.0.1 — two multi-host jobs (e.g.
+    # consecutive serve replicas) would collide on the coordinator AND
+    # the +2 control port.  Stable per-job offset (crc32, not hash():
+    # every rank thread must agree and hash() is per-process salted).
+    coordinator_port = env_contract.COORDINATOR_PORT_DEFAULT
+    if len(hosts) > 1 and all(ip in ('127.0.0.1', 'localhost')
+                              for ip in node_ips):
+        import zlib
+        seed = str(spec.get('task_id') or job_id)
+        coordinator_port += 4 * (zlib.crc32(seed.encode()) % 499)
+
     job_table.set_status(job_id, JobStatus.RUNNING)
     procs: List[Optional[subprocess.Popen]] = [None] * len(hosts)
     returncodes: List[Optional[int]] = [None] * len(hosts)
@@ -166,6 +179,7 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             rank, node_ips,
             num_chips_per_node=int(spec.get('num_chips_per_node', 0)),
             task_id=spec.get('task_id', ''),
+            coordinator_port=coordinator_port,
             num_slices=num_slices,
             slice_id=rank // hosts_per_slice))
         container = spec.get('docker_container')

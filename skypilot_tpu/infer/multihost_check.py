@@ -27,11 +27,15 @@ _SEED = 2
 
 
 def _model(num_devices: int):
-    """Tiny f32 llama whose axes divide over num_devices tp shards."""
+    """Tiny f32 llama whose axes divide over num_devices tp shards.
+    n_kv_heads=2 < the 4-device default replica so the check also
+    exercises the GQA OVERSHARD layout (tp=4 -> tp_kv=2 x tpq=2)
+    ACROSS processes — each KV head replicated over a cross-host
+    subgroup, the Llama-3-8B-on-v5e-16 shape in miniature."""
     import jax.numpy as jnp
     from skypilot_tpu.models import llama
     return llama.LlamaConfig(
-        vocab_size=512, d_model=128, n_layers=2, n_heads=8, n_kv_heads=4,
+        vocab_size=512, d_model=128, n_layers=2, n_heads=8, n_kv_heads=2,
         d_ff=256, max_seq_len=512, dtype=jnp.float32, remat=False)
 
 

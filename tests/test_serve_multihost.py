@@ -1,8 +1,9 @@
 """Serve e2e: one replica SPANNING MULTIPLE HOSTS of its slice.
 
-The service's replica resources ask for a 2-host TPU slice
-(local-cloud emulation: tpu-v5e-8 = 2 host processes); the replica task
-runs the real serving script on every host under the gang env contract.
+The service's replica resources ask for a 4-host TPU slice
+(local-cloud emulation: tpu-v5e-16 = 4 host processes; v5e-8 is a
+SINGLE 8-chip host in this catalog); the replica task runs the real
+serving script on every host under the gang env contract.
 The hosts join one jax.distributed process group, decode is sharded over
 the global ('tp',) mesh (infer/multihost.py), only the head binds HTTP,
 and the replica manager probes/serves through the head — proving a model
@@ -34,16 +35,17 @@ _SCRIPT = os.path.abspath(
 _RUN = ('export XLA_FLAGS=; export JAX_PLATFORMS=cpu; '
         f'python {_SCRIPT} --port $SKYPILOT_SERVE_PORT '
         '--model-size tiny-tp --max-seq-len 128 --batch-size 2 '
-        '--devices-per-host 2')
+        '--devices-per-host 1')
 
 
 def _service_task():
     return task_lib.Task.from_yaml_config({
         'name': 'mh-svc',
         'run': _RUN,
-        # tpu-v5e-8 on the local cloud = 2 emulated hosts x 4 chips;
-        # the serving script itself uses 2 virtual CPU devices per host.
-        'resources': {'cloud': 'local', 'accelerators': 'tpu-v5e-8'},
+        # tpu-v5e-16 on the local cloud = 4 emulated host processes;
+        # the serving script uses 1 virtual CPU device per host, so the
+        # global mesh is tp=4 across 4 OS processes.
+        'resources': {'cloud': 'local', 'accelerators': 'tpu-v5e-16'},
         'service': {
             'readiness_probe': {'path': '/health',
                                 'initial_delay_seconds': 300},
@@ -77,6 +79,13 @@ def test_multihost_replica_serves(mh_service):
     assert controller.manager.ready_urls(), \
         serve_state.get_replicas('mh-svc')
     [url] = controller.manager.ready_urls()
+    # The replica REALLY spans 4 host processes: rank 0..3 all alive
+    # (a single-host fallback would pass the HTTP checks below —
+    # assert the topology, not just the endpoint).
+    port = int(url.rsplit(':', 1)[1])
+    ranks = {info[1] for info in _scan_rank_pids().values()
+             if info[2] == str(port)}
+    assert ranks == {'0', '1', '2', '3'}, ranks
     resp = requests.post(url + '/generate',
                          json={'prompt_ids': [5, 9, 2, 7],
                                'max_new_tokens': 6},
@@ -90,3 +99,85 @@ def test_multihost_replica_serves(mh_service):
                                 'max_new_tokens': 6},
                           timeout=120).json()
     assert again['output_ids'] == body['output_ids']
+
+
+def _scan_rank_pids():
+    """{pid: (cmdline, SKYTPU_PROCESS_ID, SKYPILOT_SERVE_PORT)} for
+    every live python serve_llama process (matched via /proc environ:
+    the rank's cmdline holds the unexpanded $SKYPILOT_SERVE_PORT)."""
+    out = {}
+    for pid in os.listdir('/proc'):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f'/proc/{pid}/cmdline', 'rb') as f:
+                cmdline = f.read().replace(b'\0', b' ').decode(
+                    errors='replace')
+            if 'serve_llama.py' not in cmdline or \
+                    'python' not in cmdline:
+                continue
+            with open(f'/proc/{pid}/environ', 'rb') as f:
+                env = dict(kv.split('=', 1) for kv in
+                           f.read().decode(errors='replace').split('\0')
+                           if '=' in kv)
+            out[int(pid)] = (cmdline[:80],
+                             env.get('SKYTPU_PROCESS_ID'),
+                             env.get('SKYPILOT_SERVE_PORT'))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _find_rank_pid(port: int, rank: int):
+    for pid, (_, proc_id, serve_port) in _scan_rank_pids().items():
+        if proc_id == str(rank) and serve_port == str(port):
+            return pid
+    return None
+
+
+def test_worker_host_death_replaces_replica(mh_service):
+    """Chaos: kill one WORKER host of the 4-host replica.  The head's
+    idle ping hits the broken control channel, the head hard-exits
+    (serve_llama._fatal_if_channel_broken), probes fail, and the
+    controller replaces the whole replica — the multi-host failure
+    story end to end (reference scope: replica recovery,
+    sky/serve/replica_managers.py)."""
+    controller = mh_service
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        controller.step()
+        if controller.manager.ready_urls():
+            break
+        time.sleep(1.0)
+    assert controller.manager.ready_urls(), \
+        serve_state.get_replicas('mh-svc')
+    [old] = [r for r in serve_state.get_replicas('mh-svc')
+             if r['status'].value == 'READY']
+
+    port = int(old['url'].rsplit(':', 1)[1])
+    worker_pid = _find_rank_pid(port, rank=1)
+    assert worker_pid is not None, (
+        f'worker rank not found for port {port}; '
+        f'live: {_scan_rank_pids()}')
+    os.kill(worker_pid, 9)   # SIGKILL: an abrupt host loss
+
+    from skypilot_tpu.serve import replica_managers as rm
+    deadline = time.time() + 300
+    replaced = False
+    while time.time() < deadline:
+        controller.step()
+        fresh = [r for r in serve_state.get_replicas('mh-svc')
+                 if r['status'].value == 'READY'
+                 and r['replica_id'] != old['replica_id']]
+        if fresh:
+            replaced = True
+            break
+        time.sleep(1.0)
+    assert replaced, serve_state.get_replicas('mh-svc')
+    # The replacement serves requests.
+    [url] = controller.manager.ready_urls()
+    resp = requests.post(url + '/generate',
+                         json={'prompt_ids': [5, 9, 2],
+                               'max_new_tokens': 4}, timeout=120)
+    assert resp.status_code == 200, resp.text
+    del rm
