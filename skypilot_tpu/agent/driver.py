@@ -159,9 +159,26 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
     coordinator_port = env_contract.COORDINATOR_PORT_DEFAULT
     if len(hosts) > 1 and all(ip in ('127.0.0.1', 'localhost')
                               for ip in node_ips):
+        import socket
         import zlib
         seed = str(spec.get('task_id') or job_id)
-        coordinator_port += 4 * (zlib.crc32(seed.encode()) % 499)
+        start = coordinator_port + 4 * (zlib.crc32(seed.encode()) % 499)
+
+        def _free(port: int) -> bool:
+            with socket.socket() as sock:
+                try:
+                    sock.bind(('127.0.0.1', port))
+                    return True
+                except OSError:
+                    return False
+
+        # The job needs coordinator, +1 (MEGASCALE) and +2 (serve
+        # control channel) free: scan from the deterministic seed so a
+        # crc32 collision with a live job (or any stray listener)
+        # moves on instead of joining the wrong process group.
+        coordinator_port = next(
+            (p for p in range(start, start + 2000, 4)
+             if all(_free(p + k) for k in range(3))), start)
 
     job_table.set_status(job_id, JobStatus.RUNNING)
     procs: List[Optional[subprocess.Popen]] = [None] * len(hosts)

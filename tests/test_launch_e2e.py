@@ -76,13 +76,21 @@ def test_gang_multihost_env_contract(iso_state):
     assert _wait_job(handle, job_id) == JobStatus.SUCCEEDED
     log_dir = os.path.join(handle.cluster_info.head.workdir, '.agent',
                            'logs', f'job-{job_id}')
+    coord_ports = set()
     for rank in range(4):
         content = open(os.path.join(log_dir, f'rank-{rank}.log')).read()
         assert f'rank={rank} of=4' in content
         # Port: base 8476 + per-job offset on loopback gangs (two
-        # local multi-host jobs must not share a coordinator).
+        # local multi-host jobs must not share a coordinator) — and
+        # every rank of ONE job must agree on the same port (a
+        # per-process-salted derivation would hang jax.distributed).
         import re as re_lib
-        assert re_lib.search(r'coord=127\.0\.0\.1:\d+', content)
+        m = re_lib.search(r'coord=127\.0\.0\.1:(\d+)', content)
+        assert m, content
+        if rank == 0:
+            coord_ports.clear()
+        coord_ports.add(m.group(1))
+        assert len(coord_ports) == 1, coord_ports
         assert 'chips=4' in content
 
 

@@ -99,6 +99,13 @@ def test_multihost_replica_serves(mh_service):
                                 'max_new_tokens': 6},
                           timeout=120).json()
     assert again['output_ids'] == body['output_ids']
+    # The OpenAI-compatible surface rides the same multi-host engine.
+    oai = requests.post(url + '/v1/completions',
+                        json={'prompt': [5, 9, 2, 7], 'max_tokens': 4},
+                        timeout=120)
+    assert oai.status_code == 200, oai.text
+    assert oai.json()['object'] == 'text_completion'
+    assert oai.json()['usage']['completion_tokens'] == 4
 
 
 def _scan_rank_pids():
@@ -161,7 +168,6 @@ def test_worker_host_death_replaces_replica(mh_service):
         f'live: {_scan_rank_pids()}')
     os.kill(worker_pid, 9)   # SIGKILL: an abrupt host loss
 
-    from skypilot_tpu.serve import replica_managers as rm
     deadline = time.time() + 300
     replaced = False
     while time.time() < deadline:
@@ -180,4 +186,3 @@ def test_worker_host_death_replaces_replica(mh_service):
                          json={'prompt_ids': [5, 9, 2],
                                'max_new_tokens': 4}, timeout=120)
     assert resp.status_code == 200, resp.text
-    del rm
