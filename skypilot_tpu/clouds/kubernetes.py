@@ -154,14 +154,40 @@ class Kubernetes(cloud_lib.Cloud):
                     + ('allowed' if allowed else
                        f'DENIED — grant a role with pods create/delete '
                        f'({(proc.stderr or proc.stdout).strip()[:150]})')))
+        # RBAC for the other objects launches create: Services (ports)
+        # and PVCs (volumes) — a cluster that can make pods but not
+        # these fails midway through provisioning otherwise.
+        for resource, why in (('services', 'task `ports:`'),
+                              ('persistentvolumeclaims',
+                               'k8s volumes')):
+            proc = _run(['auth', 'can-i', 'create', resource,
+                         '-n', namespace])
+            res_ok = proc.returncode == 0 and 'yes' in proc.stdout.lower()
+            out.append((f'rbac-{resource}', res_ok,
+                        f'create {resource} ({why}): '
+                        + ('allowed' if res_ok else 'DENIED')))
         proc = _run(['get', 'nodes', '-l',
                      'cloud.google.com/gke-tpu-accelerator',
-                     '-o', 'name'])
+                     '-o', 'json'])
         if proc.returncode == 0:
-            n = len([l for l in proc.stdout.splitlines() if l.strip()])
+            import json as json_lib
+            try:
+                items = json_lib.loads(proc.stdout).get('items', [])
+            except ValueError:
+                items = []
+            # Allocatable TPU chips: the k8s analog of GCP's quota
+            # probe — nodes can exist with zero schedulable chips.
+            chips = 0
+            for node in items:
+                alloc = node.get('status', {}).get('allocatable', {})
+                try:
+                    chips += int(alloc.get('google.com/tpu', 0))
+                except (TypeError, ValueError):
+                    pass
             out.append(('tpu-nodes', True,
-                        f'{n} GKE TPU node(s) visible'
-                        + ('' if n else ' (CPU-only cluster)')))
+                        f'{len(items)} GKE TPU node(s), '
+                        f'{chips} allocatable TPU chip(s)'
+                        + ('' if items else ' (CPU-only cluster)')))
         else:
             out.append(('tpu-nodes', False,
                         f'node listing failed: '

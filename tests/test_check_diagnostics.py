@@ -104,15 +104,22 @@ def fake_kubectl(monkeypatch):
 
 
 def test_k8s_probes_full_chain(fake_kubectl):
+    import json
     fake_kubectl['get --raw'] = (0, '{"gitVersion": "v1.29"}', '')
     fake_kubectl['auth can-i'] = (0, 'yes\n', '')
-    fake_kubectl['get nodes'] = (0, 'node/tpu-a\nnode/tpu-b\n', '')
+    fake_kubectl['get nodes'] = (0, json.dumps({'items': [
+        {'status': {'allocatable': {'google.com/tpu': '4'}}},
+        {'status': {'allocatable': {'google.com/tpu': '4'}}},
+    ]}), '')
     probes = k8s_cloud.Kubernetes().check_diagnostics()
     by_name = {p[0]: p for p in probes}
     assert by_name['kubectl'][1] and by_name['cluster'][1]
     assert by_name['rbac'][1] is True
+    # Services/PVC RBAC probed too (ports + volumes provisioning).
+    assert by_name['rbac-services'][1] is True
+    assert by_name['rbac-persistentvolumeclaims'][1] is True
     assert by_name['tpu-nodes'][1] is True
-    assert '2 GKE TPU node(s)' in by_name['tpu-nodes'][2]
+    assert '2 GKE TPU node(s), 8 allocatable' in by_name['tpu-nodes'][2]
 
 
 def test_k8s_rbac_denied_names_fix(fake_kubectl):
@@ -123,6 +130,7 @@ def test_k8s_rbac_denied_names_fix(fake_kubectl):
     by_name = {p[0]: p for p in probes}
     assert by_name['rbac'][1] is False
     assert 'DENIED' in by_name['rbac'][2]
+    assert by_name['rbac-services'][1] is False
     # 0 TPU nodes is informational, not a failure.
     assert by_name['tpu-nodes'][1] is True
     assert 'CPU-only' in by_name['tpu-nodes'][2]
