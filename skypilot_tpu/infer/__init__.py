@@ -6,8 +6,13 @@ TP"); here the engine is a first-class JAX library the serve recipes run.
 """
 from skypilot_tpu.infer.engine import (DecodeState, Generator,
                                        GeneratorConfig)
+from skypilot_tpu.infer.multihost import (ControlChannel,
+                                          MultiHostBatcher,
+                                          make_replica_mesh,
+                                          worker_loop)
 from skypilot_tpu.infer.sampling import sample_logits
 from skypilot_tpu.infer.serving import ContinuousBatcher
 
-__all__ = ['ContinuousBatcher', 'DecodeState', 'Generator',
-           'GeneratorConfig', 'sample_logits']
+__all__ = ['ContinuousBatcher', 'ControlChannel', 'DecodeState',
+           'Generator', 'GeneratorConfig', 'MultiHostBatcher',
+           'make_replica_mesh', 'sample_logits', 'worker_loop']
