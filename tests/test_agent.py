@@ -93,3 +93,42 @@ def test_selfdown_descriptor_roundtrip(tmp_path):
         desc2 = json.load(f)
     assert desc2['cloud'] == 'gcp' and desc2['cluster_name'] == 'c2'
     assert desc2['provider_config'] == {'zone': 'us-central2-b'}
+
+
+def test_selfdown_main_missing_descriptor(tmp_path):
+    """No selfdown.json -> logged + rc 1, never an exception (the
+    detached helper must fail safe on clusters provisioned before the
+    descriptor existed)."""
+    import os
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.agent.selfdown',
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert proc.returncode == 1
+    log = (tmp_path / 'selfdown.log').read_text()
+    assert 'not enforced' in log
+
+
+def test_agent_metrics_text_shape(tmp_path):
+    """Prometheus exposition: every advertised gauge present and
+    parseable (the dashboard's cluster drill-down consumes these
+    through /api/cluster_metrics)."""
+    from skypilot_tpu.agent.ops import AgentOps, AgentState
+    ops = AgentOps(AgentState(str(tmp_path)))
+    text = ops.metrics_text()
+    gauges = {}
+    for line in text.splitlines():
+        if line.startswith('skytpu_agent_'):
+            name, value = line.rsplit(None, 1)
+            gauges[name] = float(value)
+    for wanted in ('skytpu_agent_uptime_seconds',
+                   'skytpu_agent_jobs_total',
+                   'skytpu_agent_jobs_active',
+                   'skytpu_agent_jobs_pending',
+                   'skytpu_agent_idle_seconds',
+                   'skytpu_agent_tpu_chips'):
+        assert wanted in gauges, (wanted, sorted(gauges))
+    assert gauges['skytpu_agent_jobs_total'] == 0
