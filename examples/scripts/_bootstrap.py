@@ -1,0 +1,15 @@
+"""Shared path shim for the example scripts: runnable from a source
+checkout without the wheel installed.
+
+Every script in this directory starts with `import _bootstrap` — the
+script's own directory is on sys.path for direct execution, so this
+resolves locally; on a real cluster (wheel pip-installed by the
+provisioner) the find_spec check is a no-op.
+"""
+import importlib.util
+import os
+import sys
+
+if importlib.util.find_spec('skypilot_tpu') is None:
+    sys.path.insert(0, os.path.abspath(
+        os.path.join(os.path.dirname(__file__), '..', '..')))

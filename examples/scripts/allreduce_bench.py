@@ -2,6 +2,8 @@
 import argparse
 import json
 
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
 from skypilot_tpu.utils import env_contract
 
 

@@ -8,6 +8,8 @@ recovery resume-from-step (reference contract: SURVEY.md §5.4).
 import argparse
 import os
 
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
 from skypilot_tpu.utils import env_contract
 
 

@@ -17,6 +17,8 @@ from __future__ import annotations
 import argparse
 import functools
 
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
 
 def main() -> int:
     parser = argparse.ArgumentParser()

@@ -14,6 +14,8 @@ reward(prompt_ids, completion_ids) -> float.
 """
 import argparse
 
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
 from skypilot_tpu.utils import env_contract
 
 

@@ -76,6 +76,15 @@ def ship_and_install_cmd(remote_wheel_path: str) -> str:
     --force-reinstall: the package version is constant (0.1.0) while the
     content hash changes, so a plain install would no-op on any VM with a
     preinstalled copy and leave stale code running.
+
+    Environment install first, --user as the fallback: when the host's
+    python3 is a virtualenv (user site disabled — pip refuses --user,
+    or installs somewhere sys.path never sees), the env install is the
+    only one that works; on bare-metal TPU VMs with a system python the
+    env install needs root and --user is the right mode.  The trailing
+    import check is the contract either way.
     """
-    return (f'python3 -m pip install --user --no-deps --force-reinstall '
-            f'{remote_wheel_path} && python3 -c "import skypilot_tpu"')
+    flags = '--no-deps --force-reinstall --quiet'
+    return (f'(python3 -m pip install {flags} {remote_wheel_path} || '
+            f'python3 -m pip install --user {flags} {remote_wheel_path})'
+            f' && python3 -c "import skypilot_tpu"')

@@ -113,6 +113,19 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
         selfdown.write_descriptor(base_dir, cluster_info.cloud,
                                   cluster_name,
                                   cluster_info.provider_config)
+        # The local cloud ships no wheel (the "cluster" IS the client
+        # machine): jobs must import skypilot_tpu exactly as the client
+        # does — including a source checkout never pip-installed.  The
+        # agent inherits the client's import root via PYTHONPATH and
+        # every job it spawns inherits it in turn.
+        import skypilot_tpu as _pkg
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        agent_env = dict(os.environ)
+        prior = agent_env.get('PYTHONPATH', '')
+        if pkg_root not in prior.split(os.pathsep):
+            agent_env['PYTHONPATH'] = (
+                pkg_root + (os.pathsep + prior if prior else ''))
         last_exc: Optional[Exception] = None
         for attempt in range(5):
             port = common_utils.find_free_port(agent_port + attempt)
@@ -122,6 +135,7 @@ def _setup_runtime(cluster_info: provision_common.ClusterInfo,
                  '--cluster-name', cluster_name],
                 stdout=open(f'{head.workdir}/agent.log', 'ab'),
                 stderr=subprocess.STDOUT,
+                env=agent_env,
                 start_new_session=True)
             with open(f'{base_dir}/agent.pid', 'w', encoding='utf-8') as f:
                 f.write(str(proc.pid))

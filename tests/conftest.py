@@ -18,6 +18,18 @@ _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
+# Every subprocess a test spawns (example scripts, launch-path job
+# commands, agents) must run THIS checkout, not whatever stale wheel a
+# previous launch e2e pip-installed into the shared venv: `python -m
+# pytest` puts the cwd on sys.path for the test process itself, but
+# plain `python script.py` / `python3 -m skypilot_tpu...` children put
+# only the script dir / site-packages there.
+_repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                          '..'))
+_pp = os.environ.get('PYTHONPATH', '')
+if _repo_root not in _pp.split(os.pathsep):
+    os.environ['PYTHONPATH'] = (
+        _repo_root + (os.pathsep + _pp if _pp else ''))
 # ...but this sandbox's sitecustomize imports jax before conftest runs, so
 # also set the config programmatically (effective until backend init).
 import jax  # noqa: E402
