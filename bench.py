@@ -280,18 +280,29 @@ def bench_decode(on_tpu: bool) -> dict:
     kv_elems = (config.n_layers * slots * avg_ctx * config.n_kv_heads
                 * config.head_dim * 2)
 
-    def roofline_tok_s(kv_bytes_per_elem, scale_bytes):
-        weight_bytes = config.num_params() * dtype_bytes
+    n_embed = config.vocab_size * config.d_model
+    n_matmul = config.num_params() - n_embed
+
+    def roofline_tok_s(kv_bytes_per_elem, scale_bytes, weights_dtype):
+        if weights_dtype == 'int8':
+            # matmul weights stream as int8 (+f32 per-out-channel
+            # scales, <0.1% — folded into the int8 byte count); the
+            # embed table stays model-dtype (row gather, but the bound
+            # conservatively charges a full read like the bf16 case).
+            weight_bytes = n_matmul + n_embed * dtype_bytes
+        else:
+            weight_bytes = config.num_params() * dtype_bytes
         kv_bytes = kv_elems * kv_bytes_per_elem + scale_bytes
         return hbm_bw / (weight_bytes + kv_bytes) * slots
 
-    def measure(kv_cache_dtype):
+    def measure(kv_cache_dtype, weights_dtype=None):
         batcher = ContinuousBatcher(
             params, config,
             GeneratorConfig(max_seq_len=prompt_len + max_new + 1,
                             batch_size=slots, temperature=0.0,
                             prompt_buckets=[prompt_len],
-                            kv_cache_dtype=kv_cache_dtype),
+                            kv_cache_dtype=kv_cache_dtype,
+                            weights_dtype=weights_dtype),
             decode_chunk=chunk)
         chunk_times = []
         orig_step = batcher.step
@@ -324,9 +335,9 @@ def bench_decode(on_tpu: bool) -> dict:
         if kv_cache_dtype == 'int8':
             bound = roofline_tok_s(
                 1, config.n_layers * slots * avg_ctx
-                * config.n_kv_heads * 2 * 4)
+                * config.n_kv_heads * 2 * 4, weights_dtype)
         else:
-            bound = roofline_tok_s(dtype_bytes, 0)
+            bound = roofline_tok_s(dtype_bytes, 0, weights_dtype)
         tok_s = generated / dt
         return {
             'decode_tok_s': round(tok_s, 1),
@@ -343,6 +354,10 @@ def bench_decode(on_tpu: bool) -> dict:
         'params_b': round(config.num_params() / 1e9, 2),
         'bf16': measure(None),
         'int8_kv': measure('int8'),
+        # Weight-only int8 + int8 KV: the full quantized serving config
+        # (infer/quant.py) — the weight stream dominates decode bytes,
+        # so this is where the roofline itself drops ~2x.
+        'int8_w_kv': measure('int8', 'int8'),
         'method': f'continuous batching, {slots} slots x {max_new} '
                   f'tokens, chunk {chunk}, greedy over 2 steady batches, decode_impl=inplace '
                   f'(fori_loop + row-scatter cache: +30% over the r3 '
@@ -351,7 +366,11 @@ def bench_decode(on_tpu: bool) -> dict:
                   f'at {hbm_bw/1e9:.0f} GB/s — the engine actually '
                   f'reads the FULL static max_len cache each step '
                   f'(static shapes), so the avg-context bound is not '
-                  f'reachable; latency = pure-decode chunk wall / steps (admission ticks excluded)',
+                  f'reachable; latency = pure-decode chunk wall / steps '
+                  f'(admission ticks excluded); int8_w_kv adds '
+                  f'weight-only int8 (per-out-channel scales) on top '
+                  f'of the int8 KV cache — its roofline charges int8 '
+                  f'matmul weights + model-dtype embed',
     }
     # Back-compat top-level number for trend tracking across rounds.
     out['decode_tok_s'] = out['bf16']['decode_tok_s']

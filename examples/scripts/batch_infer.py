@@ -19,6 +19,8 @@ import argparse
 import json
 import os
 
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
 
 def main() -> int:
     parser = argparse.ArgumentParser()
@@ -33,6 +35,8 @@ def main() -> int:
     parser.add_argument('--temperature', type=float, default=0.0)
     parser.add_argument('--tp', type=int, default=1)
     parser.add_argument('--kv-cache-dtype', default=None,
+                        choices=[None, 'int8'])
+    parser.add_argument('--weights-dtype', default=None,
                         choices=[None, 'int8'])
     parser.add_argument('--resume', action='store_true',
                         help='skip ids already in --output (append)')
@@ -53,7 +57,8 @@ def main() -> int:
     gen, config, tokenizer = serve_llama.build_generator(
         args.model_size, args.max_seq_len, args.temperature,
         args.hf_model, args.batch_size, args.tp,
-        kv_cache_dtype=args.kv_cache_dtype)
+        kv_cache_dtype=args.kv_cache_dtype,
+        weights_dtype=args.weights_dtype)
 
     done_ids = set()
     if args.resume and os.path.exists(args.output):

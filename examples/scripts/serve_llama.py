@@ -15,11 +15,12 @@ Requests (POST /generate, JSON):
                                         when --hf-model is set; demo
                                         byte-level fallback otherwise
 One of prompt_ids / prompt is required; malformed requests are a 400,
-never silently defaulted.  Sampling temperature is a server flag
-(--temperature): the engine compiles it into the decode step, so it is
-per-replica, not per-request — and under continuous batching the
-sampling RNG is engine-level, so a per-request "seed" is NOT supported
-(one is acknowledged with "seed_ignored": true in the response).
+never silently defaulted.  temperature / top_p are PER-REQUEST on the
+OpenAI surface (device operands per decode slot, infer/serving.py);
+--temperature sets the server default for requests that omit them.
+Under continuous batching the sampling RNG is engine-level, so a
+per-request "seed" is NOT supported (one is acknowledged with
+"seed_ignored": true in the response).
 """
 from __future__ import annotations
 
@@ -28,6 +29,8 @@ import asyncio
 import json
 import os
 import time
+
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
 
 
 class BatcherDriver:
@@ -59,11 +62,13 @@ class BatcherDriver:
                   flush=True)
             os._exit(70)
 
-    def submit(self, prompt, max_new):
+    def submit(self, prompt, max_new, temperature=None, top_p=None):
         import threading
         try:
             with self.lock:
-                rid = self.batcher.submit(prompt, max_new_tokens=max_new)
+                rid = self.batcher.submit(prompt, max_new_tokens=max_new,
+                                          temperature=temperature,
+                                          top_p=top_p)
                 ev = threading.Event()
                 self.done_events[rid] = ev
         except Exception as e:
@@ -158,7 +163,8 @@ class BatcherDriver:
 
 def build_generator(model_size: str, max_seq_len: int, temperature: float,
                     hf_model: str = '', batch_size: int = 4, tp: int = 1,
-                    mesh_builder=None, kv_cache_dtype=None):
+                    mesh_builder=None, kv_cache_dtype=None,
+                    weights_dtype=None):
     """mesh_builder: optional config -> Mesh callable (the multi-host
     path builds its mesh from the resolved model's KV-head count — the
     GQA overshard factor depends on it, so the config must exist
@@ -242,7 +248,8 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
     gen = ContinuousBatcher(params, config, GeneratorConfig(
         max_seq_len=max_seq_len, batch_size=batch_size,
         temperature=temperature, eos_token=eos,
-        kv_cache_dtype=kv_cache_dtype), mesh=mesh)
+        kv_cache_dtype=kv_cache_dtype,
+        weights_dtype=weights_dtype), mesh=mesh)
     return gen, config, tokenizer
 
 
@@ -345,6 +352,12 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
                                                default_max_tokens)), 256),
                 'stream': bool(body.get('stream', False)),
                 'stop': body.get('stop'),
+                # Per-request sampling, honored per decode SLOT
+                # (infer/serving.py); absent -> server defaults.
+                'temperature': (None if body.get('temperature') is None
+                                else float(body['temperature'])),
+                'top_p': (None if body.get('top_p') is None
+                          else float(body['top_p'])),
             }
         except (TypeError, ValueError) as e:
             return None, web.json_response(
@@ -450,8 +463,9 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
         created = int(time.time())
         rid_str = ('chatcmpl-' if chat else 'cmpl-') + uuid.uuid4().hex[:24]
         try:
-            rid, ev = await asyncio.to_thread(driver.submit, prompt_ids,
-                                              opts['max_tokens'])
+            rid, ev = await asyncio.to_thread(
+                driver.submit, prompt_ids, opts['max_tokens'],
+                opts['temperature'], opts['top_p'])
         except ValueError as e:
             return web.json_response(
                 {'error': {'message': str(e),
@@ -529,6 +543,13 @@ def main() -> int:
                         help='int8: quantized KV cache — ~2x the '
                              'slots/context per GB of HBM (the vLLM '
                              'kv_cache_dtype analog)')
+    parser.add_argument('--weights-dtype', default=None,
+                        choices=[None, 'int8'],
+                        help='int8: weight-only quantization (per-out-'
+                             'channel scales) — halves weight HBM '
+                             'footprint AND the weight-stream bytes '
+                             'that bound decode (the vLLM '
+                             '--quantization analog)')
     parser.add_argument('--devices-per-host', type=int, default=0,
                         help='CPU-emulation only: virtual devices per '
                              'host process (real TPU hosts discover '
@@ -572,7 +593,8 @@ def main() -> int:
     gen, config, tokenizer = build_generator(
         args.model_size, args.max_seq_len, args.temperature,
         args.hf_model, args.batch_size, args.tp,
-        mesh_builder=mesh_builder, kv_cache_dtype=args.kv_cache_dtype)
+        mesh_builder=mesh_builder, kv_cache_dtype=args.kv_cache_dtype,
+        weights_dtype=args.weights_dtype)
     if info['num_hosts'] > 1:
         control_port = args.control_port or info['control_port']
         if info['host_id'] != 0:

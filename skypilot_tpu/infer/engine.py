@@ -42,11 +42,34 @@ class GeneratorConfig:
     # scales, infer/llama_infer.py) — ~2x slots/context per GB of HBM
     # and half the cache read traffic on the bandwidth-bound decode.
     kv_cache_dtype: Optional[str] = None
+    # None = serve weights in model dtype; 'int8' = weight-only
+    # quantization (per-out-channel scales, infer/quant.py) — halves
+    # the weight-stream bytes that dominate the decode roofline and
+    # the params' HBM footprint.  Composes with kv_cache_dtype and tp.
+    weights_dtype: Optional[str] = None
     # 'inplace' (default): fori_loop decode with row-level cache
     # scatter (no per-layer full-slice write-back); 'scan': the layer
     # scan with cache in xs/ys.  Same math, different HBM traffic —
     # see llama_infer.decode_step_inplace.
     decode_impl: str = 'inplace'
+
+
+def prepare_params(params, gen_config: 'GeneratorConfig'):
+    """Apply GeneratorConfig.weights_dtype to a (possibly tp-sharded)
+    param pytree.  Shared by Generator and ContinuousBatcher so the two
+    engines cannot drift.  Never donates: device_put can ALIAS buffers
+    (zero-copy resharding — e.g. replicated small tensors), so even the
+    post-shard_params tree may share memory with caller-held arrays and
+    donation would delete them.  The bf16 originals are freed by GC
+    when the engine drops its reference right after this call; the
+    transient both-copies window is the price of safety."""
+    if gen_config.weights_dtype is None:
+        return params
+    if gen_config.weights_dtype != 'int8':
+        raise ValueError(f"weights_dtype must be None or 'int8', "
+                         f'got {gen_config.weights_dtype!r}')
+    from skypilot_tpu.infer import quant
+    return quant.quantize_weights(params)
 
 
 def validate_context(gen_config: 'GeneratorConfig', model_config) -> None:
@@ -106,7 +129,7 @@ class Generator:
             tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
         validate_context(gen_config, config)
-        self.params = params
+        self.params = prepare_params(params, gen_config)
         self.config = config
         self.gen = gen_config
         self.buckets = derive_buckets(gen_config)

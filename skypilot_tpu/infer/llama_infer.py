@@ -21,6 +21,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.infer import quant
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops import rmsnorm as rmsnorm_ops
@@ -76,17 +77,27 @@ def _dequantize(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
 
 
 def _qkv(x, attn_p, config):
+    """Weights here (and in _mlp / wo / lm_head below) go through
+    quant.matmul, which transparently handles int8 weight-only
+    quantized params (infer/quant.py) — plain bf16 params take the
+    identity path."""
     batch, seq, _ = x.shape
     hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
-    q = (x @ attn_p['wq']).reshape(batch, seq, nh, hd)
-    k = (x @ attn_p['wk']).reshape(batch, seq, nkv, hd)
-    v = (x @ attn_p['wv']).reshape(batch, seq, nkv, hd)
-    return q, k, v
+    q = quant.matmul(x, attn_p['wq'])
+    k = quant.matmul(x, attn_p['wk'])
+    v = quant.matmul(x, attn_p['wv'])
+    if 'bq' in attn_p:  # Qwen2-family qkv biases (config.attn_bias)
+        q, k, v = (q + attn_p['bq'], k + attn_p['bk'],
+                   v + attn_p['bv'])
+    return (q.reshape(batch, seq, nh, hd),
+            k.reshape(batch, seq, nkv, hd),
+            v.reshape(batch, seq, nkv, hd))
 
 
 def _mlp(x, mlp_p, act: str = 'silu'):
-    gate = llama.gate_activation(x @ mlp_p['w_gate'], act)
-    return (gate * (x @ mlp_p['w_up'])) @ mlp_p['w_down']
+    gate = llama.gate_activation(quant.matmul(x, mlp_p['w_gate']), act)
+    return quant.matmul(gate * quant.matmul(x, mlp_p['w_up']),
+                        mlp_p['w_down'])
 
 
 def prefill(params: llama.Params, tokens: jax.Array,
@@ -117,7 +128,7 @@ def prefill(params: llama.Params, tokens: jax.Array,
         q = rope_ops.apply_rope(q, cos[:seq], sin[:seq])
         k = rope_ops.apply_rope(k, cos[:seq], sin[:seq])
         o = attention_fn(q, k, v)
-        h = h + (o.reshape(batch, seq, -1) @ attn_p['wo'])
+        h = h + quant.matmul(o.reshape(batch, seq, -1), attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
         h = h + _mlp(x, mlp_p, config.mlp_act)
@@ -147,7 +158,8 @@ def prefill(params: llama.Params, tokens: jax.Array,
     # (B, S, vocab) matmul during prefill.
     last = jnp.take_along_axis(
         h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-    logits = (last @ params['lm_head']).astype(jnp.float32)
+    logits = quant.matmul(last, params['lm_head'],
+                          out_dtype=jnp.float32)
     if quantized:
         k_all, v_all, ks_all, vs_all = caches
         return logits, {'k': k_all, 'v': v_all,
@@ -246,7 +258,7 @@ def decode_step_inplace(params: llama.Params, token: jax.Array,
         s = jnp.where(visible[:, None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
         o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff)
-        h = h + (o.reshape(batch, 1, -1) @ attn_p['wo'])
+        h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
         h = h + _mlp(x, mlp_p, config.mlp_act)
@@ -254,7 +266,8 @@ def decode_step_inplace(params: llama.Params, token: jax.Array,
 
     h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
     h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
-    logits = (h[:, 0] @ params['lm_head']).astype(jnp.float32)
+    logits = quant.matmul(h[:, 0], params['lm_head'],
+                          out_dtype=jnp.float32)
     return logits, cache
 
 
@@ -322,7 +335,7 @@ def decode_step(params: llama.Params, token: jax.Array,
         s = jnp.where(visible[:, None, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
         o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff)
-        h = h + (o.reshape(batch, 1, -1) @ attn_p['wo'])
+        h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
         h = h + _mlp(x, mlp_p, config.mlp_act)
@@ -337,7 +350,8 @@ def decode_step(params: llama.Params, token: jax.Array,
         xs = (params['layers'], cache['k'], cache['v'])
     h, caches = jax.lax.scan(scan_body, h, xs)
     h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
-    logits = (h[:, 0] @ params['lm_head']).astype(jnp.float32)
+    logits = quant.matmul(h[:, 0], params['lm_head'],
+                          out_dtype=jnp.float32)
     if quantized:
         k_all, v_all, ks_all, vs_all = caches
         return logits, {'k': k_all, 'v': v_all,

@@ -262,7 +262,8 @@ class MultiHostBatcher:
         self._channel = channel
 
     # -- mutating (local first, then broadcast) --
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 64) -> int:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
+               temperature=None, top_p=None) -> int:
         # Local call FIRST: submit/result are host-only bookkeeping (no
         # device dispatch), and their validation errors (bad prompt
         # length, unknown rid) must stay local — broadcasting an invalid
@@ -270,8 +271,13 @@ class MultiHostBatcher:
         # fatal there (worker_loop), bricking the replica on one bad
         # user request.
         prompt = [int(t) for t in prompt]
-        rid = self._batcher.submit(prompt, max_new_tokens=max_new_tokens)
-        self._channel.broadcast(('submit', (prompt, int(max_new_tokens))))
+        rid = self._batcher.submit(prompt, max_new_tokens=max_new_tokens,
+                                   temperature=temperature, top_p=top_p)
+        # Sampling params are part of the broadcast: they become DEVICE
+        # operands of the SPMD decode, so every host must install the
+        # same per-slot values or the collective programs diverge.
+        self._channel.broadcast(('submit', (prompt, int(max_new_tokens),
+                                            temperature, top_p)))
         return rid
 
     def step(self) -> None:
@@ -342,7 +348,11 @@ def worker_loop(batcher, channel: ControlChannel) -> None:
             # state keeps matching the head's.
             batcher.result(*args)
         elif op == 'submit':
-            prompt, max_new = args
-            batcher.submit(prompt, max_new_tokens=max_new)
+            # 2-tuple accepted for wire-compat with older heads.
+            prompt, max_new = args[0], args[1]
+            temperature = args[2] if len(args) > 2 else None
+            top_p = args[3] if len(args) > 3 else None
+            batcher.submit(prompt, max_new_tokens=max_new,
+                           temperature=temperature, top_p=top_p)
         else:
             batcher.step()

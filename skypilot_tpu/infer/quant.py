@@ -1,0 +1,132 @@
+"""Weight-only int8 quantization for the inference engine.
+
+Decode is HBM-bandwidth-bound: every step streams the full weight set
+(plus the KV cache) from HBM, so halving weight bytes is ~2x decode
+throughput on exactly the models where it matters.  This implements the
+standard per-output-channel symmetric int8 scheme (the weight-only mode
+the reference's vLLM recipes expose as `--quantization`; reference
+parity: llm/vllm/service.yaml serves quantized checkpoints the same
+way — here the quantizer is library code over the live param pytree):
+
+- each linear weight W (.., in, out) -> int8 Q with a per-out-channel
+  f32 scale s = absmax(W[..., :, c]) / 127, so Q * s ~= W;
+- the matmul runs as (x @ Q.astype(bf16)) * s: XLA fuses the int8->bf16
+  convert into the dot's operand read, so HBM sees only int8 bytes, and
+  the per-channel rescale is applied to the small (batch, out) result,
+  never to the weight;
+- embeddings and norms stay in model dtype (the embed read is a
+  per-token row gather, not a full-table stream; norms are tiny).
+
+Composes with tensor parallelism: quantization is per-output-channel,
+so shard-then-quantize == quantize-then-shard, and `quantize_weights`
+preserves each weight's NamedSharding (scales inherit the out-axis
+sharding) by running under jit with explicit out_shardings.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# Linear weights streamed in full every decode step.  embed is excluded
+# (row gather); norm vectors are noise-level bytes.
+_QUANT_PATH = re.compile(
+    r'(attn/(wq|wk|wv|wo)|mlp/(w_gate|w_up|w_down)|lm_head)$')
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, 'key'):
+            parts.append(str(p.key))
+        elif hasattr(p, 'idx'):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return '/'.join(parts)
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and 'q' in w and 's' in w
+
+
+def quantize_array(w: jax.Array) -> Dict[str, jax.Array]:
+    """(.., in, out) weight -> {'q': int8, 's': f32 per-out-channel}."""
+    a = w.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(a), axis=-2) / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(a / s[..., None, :]), -127, 127
+                 ).astype(jnp.int8)
+    return {'q': q, 's': s}
+
+
+def matmul(x: jax.Array, w: Any, out_dtype=None) -> jax.Array:
+    """x @ w for a plain array OR a quantized {'q', 's'} weight.
+
+    The quantized path keeps the dot in x.dtype (bf16 on TPU — the
+    int8->bf16 convert fuses into the MXU operand read) and applies the
+    per-channel scale to the result in f32 before casting to out_dtype.
+    """
+    if is_quantized(w):
+        y = (x @ w['q'].astype(x.dtype)).astype(jnp.float32)
+        y = y * w['s'].astype(jnp.float32)
+        return y.astype(out_dtype or x.dtype)
+    y = x @ w
+    return y.astype(out_dtype) if out_dtype is not None else y
+
+
+def _scale_sharding(w: jax.Array):
+    """The scale's NamedSharding: the weight's spec with the contracted
+    (-2, 'in') axis dropped.  None when the weight is not on a mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = getattr(w, 'sharding', None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    spec = tuple(sh.spec) + (None,) * (w.ndim - len(tuple(sh.spec)))
+    return NamedSharding(sh.mesh, P(*spec[:-2], spec[-1]))
+
+
+def quantize_weights(params: Dict[str, Any],
+                     donate: bool = False) -> Dict[str, Any]:
+    """Quantize every linear weight in a llama-family param pytree.
+
+    Runs as one jitted program with out_shardings pinned to the inputs'
+    layouts, so tp-sharded params quantize shard-locally (no gather, no
+    resharding).  donate=True frees the bf16 originals as it goes
+    (transient HBM = int8 output only, not bf16+int8) — ONLY safe when
+    the leaves provably share no buffers with anything else: device_put
+    can alias zero-copy (a replicated norm vector after shard_params
+    still points at the caller's buffer), and donating an aliased leaf
+    deletes the caller's array.  The engines therefore pass False and
+    rely on GC; reserve True for load paths that construct the tree
+    from scratch (e.g. streaming checkpoint shard-on-load).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    quantize_mask = [_QUANT_PATH.search(_path_str(p)) is not None
+                     for p, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+
+    def convert(leaves):
+        return [quantize_array(leaf) if m else leaf
+                for m, leaf in zip(quantize_mask, leaves)]
+
+    kwargs = {'donate_argnums': 0} if donate else {}
+    on_mesh = any(_scale_sharding(leaf) is not None for leaf in leaves)
+    if on_mesh:
+        out_shardings = [
+            {'q': leaf.sharding, 's': _scale_sharding(leaf)}
+            if m else leaf.sharding
+            for m, leaf in zip(quantize_mask, leaves)]
+        out = jax.jit(convert, out_shardings=out_shardings,
+                      **kwargs)(leaves)
+    else:
+        out = jax.jit(convert, **kwargs)(leaves)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def quantized_bytes(params: Dict[str, Any]) -> int:
+    """Total HBM bytes of the param pytree (int8 + scales + residual
+    bf16) — the decode roofline's weight-stream term."""
+    return sum(leaf.size * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(params))

@@ -19,9 +19,14 @@ def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
     return jnp.where(logits >= kth, logits, _NEG_INF)
 
 
-def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
+def _mask_top_p(logits: jax.Array, p) -> jax.Array:
     """Nucleus filter: keep the smallest prefix of the sorted distribution
-    whose cumulative probability reaches p (the top token always stays)."""
+    whose cumulative probability reaches p (the top token always stays).
+    p: python float OR a (B,) array of per-row thresholds (p >= 1
+    keeps every token for that row)."""
+    p = jnp.asarray(p)
+    if p.ndim == 1:
+        p = p[:, None]
     sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
@@ -53,3 +58,32 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
     if top_p is not None and 0.0 < top_p < 1.0:
         logits = _mask_top_p(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_batched(logits: jax.Array, rng: jax.Array,
+                          temperature: jax.Array, top_p: jax.Array,
+                          top_k: Optional[int] = None,
+                          nucleus: bool = True) -> jax.Array:
+    """Per-ROW sampling params: temperature (B,) f32 (0 = greedy for
+    that row), top_p (B,) f32 (>= 1 disables nucleus for that row).
+
+    The per-request sampling path of the continuous batcher (the
+    OpenAI API's temperature/top_p are per request): params ride as
+    device operands, so one compiled program serves every mix.  top_k
+    stays a STATIC server-wide knob — a per-row k would need a dynamic
+    sort prefix, and the OpenAI surface has no top_k field.
+
+    nucleus=False (static) skips the top_p machinery entirely — the
+    full-vocab sort is the expensive part of this sampler, and the
+    scheduler knows host-side when no active request uses top_p.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / t
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        scaled = _mask_top_k(scaled, top_k)
+    if nucleus:
+        scaled = _mask_top_p(scaled, top_p)
+    sampled = jax.random.categorical(rng, scaled, axis=-1
+                                     ).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -46,6 +46,9 @@ class _Request:
     rid: int
     prompt: List[int]
     max_new_tokens: int
+    # Per-request sampling (OpenAI API fields); None = server default.
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
     out: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
@@ -65,9 +68,10 @@ class ContinuousBatcher:
             tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
         from skypilot_tpu.infer.engine import (derive_buckets,
+                                               prepare_params,
                                                validate_context)
         validate_context(gen_config, config)
-        self.params = params
+        self.params = prepare_params(params, gen_config)
         self.config = config
         self.gen = gen_config
         self.decode_chunk = decode_chunk
@@ -81,6 +85,19 @@ class ContinuousBatcher:
             kv_dtype=gen_config.kv_cache_dtype)
         self._token = jnp.zeros((batch,), jnp.int32)
         self._positions = jnp.zeros((batch,), jnp.int32)
+        # Per-SLOT sampling params (device operands of the decode
+        # program — one compile serves every request mix); host mirror
+        # of "any non-greedy slot" picks the cheap all-greedy program.
+        self._temp_row = jnp.full((batch,), gen_config.temperature,
+                                  jnp.float32)
+        self._top_p_row = jnp.full(
+            (batch,), gen_config.top_p if gen_config.top_p else 1.0,
+            jnp.float32)
+        self._host_temp = np.full((batch,), gen_config.temperature,
+                                  np.float32)
+        self._host_top_p = np.full(
+            (batch,), gen_config.top_p if gen_config.top_p else 1.0,
+            np.float32)
         # Host mirror of _positions, advanced from known increments
         # (prefill length, +n per decode chunk, 0 on slot free) so the
         # scheduler never forces a device→host sync on the hot path.
@@ -101,13 +118,14 @@ class ContinuousBatcher:
             self._prefill_group_impl, config=config), donate_argnums=(2,),
             static_argnames=())
         self._decode = jax.jit(functools.partial(
-            self._decode_impl, temperature=gen_config.temperature,
-            top_k=gen_config.top_k, top_p=gen_config.top_p),
-            donate_argnums=(2,), static_argnames=('n',))
+            self._decode_impl, top_k=gen_config.top_k),
+            donate_argnums=(2,),
+            static_argnames=('n', 'all_greedy', 'nucleus'))
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
-                            slots, token_row, pos_row, rng, *, config):
+                            slots, token_row, pos_row, temp_row,
+                            top_p_row, temps, top_ps, rng, *, config):
         """Prefill a GROUP of prompts (G, bucket) in one forward and
         install each row into its slot.  G is the ACTUAL group size
         (1..admit_group): at most admit_group compiles per prompt
@@ -128,15 +146,21 @@ class ContinuousBatcher:
             big_cache[key] = big_cache[key].at[:, slots].set(small[key])
         big_cache = tp_lib.constrain_cache(big_cache, self.mesh)
         rng, sub = jax.random.split(rng)
-        firsts = tp_lib.replicate(sampling.sample_logits(
-            logits, sub, temperature=self.gen.temperature,
-            top_k=self.gen.top_k, top_p=self.gen.top_p), self.mesh)
+        firsts = tp_lib.replicate(sampling.sample_logits_batched(
+            logits, sub, temps, top_ps, top_k=self.gen.top_k),
+            self.mesh)
         token_row = token_row.at[slots].set(firsts)
         pos_row = pos_row.at[slots].set(lengths)
-        return big_cache, token_row, pos_row, firsts, rng
+        temp_row = temp_row.at[slots].set(temps)
+        top_p_row = top_p_row.at[slots].set(top_ps)
+        return (big_cache, token_row, pos_row, temp_row, top_p_row,
+                firsts, rng)
 
-    def _decode_impl(self, params, token, cache, positions, rng, *, n,
-                     temperature, top_k, top_p):
+    def _decode_impl(self, params, token, cache, positions, temp_row,
+                     top_p_row, rng, *, n, all_greedy, nucleus, top_k):
+        # all_greedy (static): every active slot decodes greedily, so
+        # the sampler is a plain argmax — no per-step vocab sort.  Two
+        # compiled variants total; the host picks from its temp mirror.
         decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
 
         def step(carry, _):
@@ -144,9 +168,15 @@ class ContinuousBatcher:
             rng, sub = jax.random.split(rng)
             logits, cache = decode_fn(
                 params, token, self.config, cache, positions)
-            nxt = sampling.sample_logits(logits, sub,
-                                         temperature=temperature,
-                                         top_k=top_k, top_p=top_p)
+            if all_greedy:
+                nxt = sampling.sample_logits(logits, sub,
+                                             temperature=0.0)
+            else:
+                # nucleus=False drops the per-step full-vocab sort when
+                # no active request uses top_p (host mirror knows).
+                nxt = sampling.sample_logits_batched(
+                    logits, sub, temp_row, top_p_row, top_k=top_k,
+                    nucleus=nucleus)
             return (nxt, cache, positions + 1, rng), nxt
 
         (token, cache, positions, rng), toks = jax.lax.scan(
@@ -157,9 +187,19 @@ class ContinuousBatcher:
 
     # ---- public API ------------------------------------------------------
     def submit(self, prompt: Sequence[int],
-               max_new_tokens: int = 64) -> int:
+               max_new_tokens: int = 64,
+               temperature: Optional[float] = None,
+               top_p: Optional[float] = None) -> int:
+        """temperature/top_p: per-request sampling (None = the server
+        defaults in GeneratorConfig) — the OpenAI API's per-request
+        fields, honored per SLOT inside the lockstep decode."""
         if not prompt:
             raise ValueError('Empty prompt')
+        if temperature is not None and temperature < 0.0:
+            raise ValueError(f'temperature must be >= 0, '
+                             f'got {temperature}')
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f'top_p must be in (0, 1], got {top_p}')
         if len(prompt) >= self.gen.max_seq_len:
             raise ValueError(f'Prompt length {len(prompt)} >= max_seq_len '
                              f'{self.gen.max_seq_len}')
@@ -172,7 +212,8 @@ class ContinuousBatcher:
                 f'prompt bucket {self.buckets[-1]}')
         req = _Request(next(self._ids), list(prompt),
                        min(max_new_tokens,
-                           self.gen.max_seq_len - len(prompt)))
+                           self.gen.max_seq_len - len(prompt)),
+                       temperature=temperature, top_p=top_p)
         self._requests[req.rid] = req
         self._queue.append(req)
         return req.rid
@@ -235,17 +276,30 @@ class ContinuousBatcher:
             tokens = np.zeros((effective, bucket), np.int32)
             lengths = np.ones((effective,), np.int32)
             slots = np.zeros((effective,), np.int32)
+            temps = np.zeros((effective,), np.float32)
+            top_ps = np.ones((effective,), np.float32)
+            default_temp = self.gen.temperature
+            default_top_p = self.gen.top_p if self.gen.top_p else 1.0
             for i, request in enumerate(group):
                 tokens[i, :len(request.prompt)] = np.asarray(
                     request.prompt, np.int32)
                 lengths[i] = len(request.prompt)
                 slots[i] = request.slot
+                temps[i] = (default_temp if request.temperature is None
+                            else request.temperature)
+                top_ps[i] = (default_top_p if request.top_p is None
+                             else request.top_p)
             try:
-                (self._cache, self._token, self._positions, firsts,
+                (self._cache, self._token, self._positions,
+                 self._temp_row, self._top_p_row, firsts,
                  self._rng) = self._prefill_group(
                     self.params, jnp.asarray(tokens), self._cache,
                     jnp.asarray(lengths), jnp.asarray(slots),
-                    self._token, self._positions, self._rng)
+                    self._token, self._positions, self._temp_row,
+                    self._top_p_row, jnp.asarray(temps),
+                    jnp.asarray(top_ps), self._rng)
+                self._host_temp[slots] = temps
+                self._host_top_p[slots] = top_ps
             except Exception:
                 # A failed dispatch (fresh compile OOM, device error)
                 # must not leak the group: re-queue the requests at the
@@ -291,9 +345,15 @@ class ContinuousBatcher:
         capacity = self.gen.max_seq_len - max(
             int(self._host_pos[s]) for s in self._active)
         n = max(1, min(n, capacity))
-        toks, self._token, self._cache, self._positions, self._rng = \
-            self._decode(self.params, self._token, self._cache,
-                         self._positions, self._rng, n=n)
+        all_greedy = not any(
+            float(self._host_temp[s]) > 0.0 for s in self._active)
+        nucleus = any(
+            float(self._host_top_p[s]) < 1.0 for s in self._active)
+        (toks, self._token, self._cache, self._positions,
+         self._rng) = self._decode(
+            self.params, self._token, self._cache, self._positions,
+            self._temp_row, self._top_p_row, self._rng, n=n,
+            all_greedy=all_greedy, nucleus=nucleus)
         # Decode advanced EVERY slot's device position by n (free slots
         # decode garbage in lockstep); mirror that exactly.
         self._host_pos += n

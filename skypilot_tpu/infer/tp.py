@@ -47,6 +47,8 @@ from skypilot_tpu.parallel.sharding import PartitionRules
 # prefill AND decode step.
 INFER_TP_RULES = PartitionRules([
     (r'embed', P(None, ('tp', 'tpq'))),                 # (vocab, d)
+    (r'attn/bk|attn/bv', P(None, 'tp')),                # (L, kv*hd) qwen2
+    (r'attn/bq', P(None, ('tp', 'tpq'))),               # (L, heads*hd)
     (r'attn/wk|attn/wv', P(None, None, 'tp')),          # (L, d, kv*hd)
     (r'attn/wq', P(None, None, ('tp', 'tpq'))),         # (L, d, heads*hd)
     (r'attn/wo', P(None, ('tp', 'tpq'), None)),         # (L, heads*hd, d)

@@ -178,3 +178,24 @@ def test_completions_greedy_deterministic(server):
     b = _post(server + '/v1/completions',
               {'prompt': [5, 6, 7], 'max_tokens': 6})[1]
     assert a['choices'][0]['text'] == b['choices'][0]['text']
+
+
+def test_completions_per_request_sampling(server):
+    """temperature/top_p are honored per request: valid values accept,
+    invalid reject with OpenAI error shape, and temperature=0 stays
+    deterministic regardless of the neighbor's params."""
+    payload = {'prompt': [5, 9, 2], 'max_tokens': 6,
+               'temperature': 0.8, 'top_p': 0.9}
+    status, body = _post(server + '/v1/completions', payload)
+    assert status == 200
+    assert body['choices'][0]['text'] is not None
+    # Greedy request is reproducible.
+    greedy = {'prompt': [5, 9, 2], 'max_tokens': 6, 'temperature': 0}
+    _, b1 = _post(server + '/v1/completions', greedy)
+    _, b2 = _post(server + '/v1/completions', greedy)
+    assert b1['choices'][0]['text'] == b2['choices'][0]['text']
+    # Invalid top_p -> 400 with the OpenAI error envelope.
+    status, body = _post(server + '/v1/completions',
+                         {'prompt': [5], 'top_p': 0.0})
+    assert status == 400
+    assert body['error']['type'] == 'invalid_request_error'

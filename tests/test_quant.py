@@ -1,0 +1,132 @@
+"""Weight-only int8 quantization (infer/quant.py): numerics, engine
+integration, tp-sharding preservation."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import quant
+from skypilot_tpu.infer.engine import Generator, GeneratorConfig
+from skypilot_tpu.models import llama
+
+
+def test_quantize_array_roundtrip_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 48), jnp.float32)
+    qw = quant.quantize_array(w)
+    assert qw['q'].dtype == jnp.int8 and qw['q'].shape == (32, 48)
+    assert qw['s'].shape == (48,)
+    deq = qw['q'].astype(jnp.float32) * qw['s'][None, :]
+    # Per-channel int8: max error bounded by scale/2 per entry.
+    err = np.abs(np.asarray(deq - w))
+    bound = np.asarray(qw['s'])[None, :] * 0.5 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_quantize_array_stacked_layers():
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 8))
+    qw = quant.quantize_array(w)
+    assert qw['q'].shape == (3, 16, 8) and qw['s'].shape == (3, 8)
+    # Per-layer scales: layer 0 scaled up must not affect layer 1.
+    w2 = w.at[0].multiply(100.0)
+    qw2 = quant.quantize_array(w2)
+    np.testing.assert_allclose(qw2['s'][1], qw['s'][1])
+
+
+def test_matmul_quantized_close_to_exact():
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(3), (64, 32), jnp.float32)
+    exact = x @ w
+    approx = quant.matmul(x, quant.quantize_array(w))
+    rel = float(jnp.linalg.norm(approx - exact) / jnp.linalg.norm(exact))
+    assert rel < 0.01, rel
+    # Plain-weight path is the identity matmul.
+    np.testing.assert_allclose(np.asarray(quant.matmul(x, w)),
+                               np.asarray(exact), rtol=1e-6)
+
+
+def test_quantize_weights_selects_linear_only():
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    qp = quant.quantize_weights(params)
+    assert quant.is_quantized(qp['lm_head'])
+    assert quant.is_quantized(qp['layers']['attn']['wq'])
+    assert quant.is_quantized(qp['layers']['mlp']['w_down'])
+    # Embeddings and norms stay in model dtype.
+    assert not quant.is_quantized(qp['embed'])
+    assert qp['embed'].dtype == params['embed'].dtype
+    assert not quant.is_quantized(qp['final_norm'])
+    # Originals are untouched without donate=True.
+    assert params['lm_head'].dtype == config.dtype
+    # Footprint shrinks: int8 + scales < bf16/f32 originals.
+    assert quant.quantized_bytes(qp) < quant.quantized_bytes(params)
+
+
+def test_generator_int8_weights_matches_bf16_shapes_and_quality():
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gen_bf16 = Generator(params, config, GeneratorConfig(
+        max_seq_len=64, batch_size=2, temperature=0.0))
+    gen_int8 = Generator(params, config, GeneratorConfig(
+        max_seq_len=64, batch_size=2, temperature=0.0,
+        weights_dtype='int8'))
+    prompts = [[3, 5, 7], [11, 2]]
+    out_bf16 = gen_bf16.generate(prompts, max_new_tokens=8)
+    out_int8 = gen_int8.generate(prompts, max_new_tokens=8)
+    assert [len(o) for o in out_int8] == [len(o) for o in out_bf16]
+    # Same-params prefill logits agree closely (greedy ids can differ
+    # at near-ties; logits closeness is the real numerics contract).
+    from skypilot_tpu.infer import llama_infer
+    cache_a = llama_infer.init_cache(config, 2, 64)
+    cache_b = llama_infer.init_cache(config, 2, 64)
+    tokens = jnp.asarray([[3, 5, 7, 0], [11, 2, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([3, 2], jnp.int32)
+    la, _ = llama_infer.prefill(params, tokens, config, cache_a, lengths)
+    lb, _ = llama_infer.prefill(gen_int8.params, tokens, config,
+                                cache_b, lengths)
+    rel = float(jnp.linalg.norm(lb - la) / jnp.linalg.norm(la))
+    assert rel < 0.05, rel
+
+
+def test_generator_int8_weights_plus_int8_kv():
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gen = Generator(params, config, GeneratorConfig(
+        max_seq_len=64, batch_size=1, temperature=0.0,
+        weights_dtype='int8', kv_cache_dtype='int8'))
+    (out,) = gen.generate([[1, 2, 3]], max_new_tokens=6)
+    assert len(out) == 6
+    assert all(0 <= t < config.vocab_size for t in out)
+
+
+def test_bad_weights_dtype_rejected():
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match='weights_dtype'):
+        Generator(params, config,
+                  GeneratorConfig(max_seq_len=64, weights_dtype='int4'))
+
+
+def test_tp_sharded_int8_preserves_shardings_and_parity():
+    """Quantizing AFTER tp sharding keeps every shard layout (q keeps
+    the weight's spec; scales inherit the out-axis spec) and greedy
+    decode matches the unsharded int8 engine."""
+    from skypilot_tpu.infer import tp as tp_lib
+    if len(jax.devices()) < 2:
+        pytest.skip('needs 2 virtual devices')
+    config = llama.LLAMA_DEBUG  # n_heads=2, n_kv_heads=1 -> tp=2 max
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    mesh = tp_lib.make_tp_mesh(2, n_kv_heads=config.n_kv_heads)
+    gcfg = GeneratorConfig(max_seq_len=64, batch_size=2,
+                           temperature=0.0, weights_dtype='int8')
+    gen_tp = Generator(params, config, gcfg, mesh=mesh)
+    qwq = gen_tp.params['layers']['attn']['wq']
+    assert quant.is_quantized(qwq)
+    wq_spec = qwq['q'].sharding.spec
+    s_spec = qwq['s'].sharding.spec
+    # q keeps the megatron column sharding; scale follows the out axis.
+    assert tuple(wq_spec)[-1] == tuple(s_spec)[-1]
+    gen_1 = Generator(params, config, gcfg)
+    prompts = [[3, 5, 7], [11, 2]]
+    assert gen_tp.generate(prompts, max_new_tokens=8) == \
+        gen_1.generate(prompts, max_new_tokens=8)
