@@ -1,5 +1,5 @@
 """HuggingFace checkpoint → stacked-layer JAX pytree (Llama, Mistral,
-Gemma families).
+Gemma, Qwen2 families).
 
 The bridge from public HF weights to this framework's training
 (models/llama.py) and inference (infer/) paths: the reference's recipes
@@ -16,6 +16,9 @@ here conversion is library code with per-family config mapping
   decoupled head_dim, tied lm_head, and (1 + w) RMSNorm — folded into
   the stored norm weights at conversion so the runtime kernel is
   unchanged.
+- qwen2 (Qwen2/Qwen2.5): Llama layout + biases on the q/k/v
+  projections (config.attn_bias); per-layer mixed sliding-window
+  (use_sliding_window=True) is refused.
 
 Layout notes:
 - HF `nn.Linear.weight` is (out_features, in_features); this framework
@@ -61,10 +64,10 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
             (k, float(v) if isinstance(v, (int, float)) else v)
             for k, v in scaling.items()))
     model_type = getattr(hf_config, 'model_type', 'llama')
-    if model_type not in ('llama', 'mistral', 'gemma'):
+    if model_type not in ('llama', 'mistral', 'gemma', 'qwen2'):
         raise NotImplementedError(
             f'model_type {model_type!r} is not supported '
-            "(supported: 'llama', 'mistral', 'gemma').")
+            "(supported: 'llama', 'mistral', 'gemma', 'qwen2').")
 
     hf_head_dim = getattr(hf_config, 'head_dim', None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
@@ -74,7 +77,18 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
         head_dim_override = int(hf_head_dim)
 
     family: Dict[str, Any] = {}
-    if model_type == 'gemma':
+    if model_type == 'qwen2':
+        # Qwen2/Qwen2.5: Llama architecture + biases on q/k/v only.
+        family = {'attn_bias': True}
+        if getattr(hf_config, 'use_sliding_window', False):
+            # Qwen2's sliding window applies only above
+            # max_window_layers — a per-layer mixed attention this
+            # stack does not implement.  Off by default on every
+            # released checkpoint; refuse rather than silently differ.
+            raise NotImplementedError(
+                'qwen2 use_sliding_window=True (per-layer mixed '
+                'sliding-window attention) is not implemented')
+    elif model_type == 'gemma':
         act = getattr(hf_config, 'hidden_activation', None) or \
             getattr(hf_config, 'hidden_act', 'gelu_pytorch_tanh')
         if act not in ('gelu', 'gelu_pytorch_tanh'):
@@ -172,6 +186,13 @@ def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
                 'wk': stack(L + 'self_attn.k_proj.weight'),
                 'wv': stack(L + 'self_attn.v_proj.weight'),
                 'wo': stack(L + 'self_attn.o_proj.weight'),
+                **({'bq': stack(L + 'self_attn.q_proj.bias',
+                                transpose=False),
+                    'bk': stack(L + 'self_attn.k_proj.bias',
+                                transpose=False),
+                    'bv': stack(L + 'self_attn.v_proj.bias',
+                                transpose=False)}
+                   if config.attn_bias else {}),
             },
             'mlp': {
                 'w_gate': stack(L + 'mlp.gate_proj.weight'),
@@ -234,6 +255,16 @@ _STACKED_LEAVES = [
      True, False),
     (('layers', 'mlp', 'w_down'), '{p}layers.{i}.mlp.down_proj.weight',
      True, False),
+]
+
+# Qwen2-family extras (config.attn_bias): 1-D biases, no transpose.
+_STACKED_BIAS_LEAVES = [
+    (('layers', 'attn', 'bq'), '{p}layers.{i}.self_attn.q_proj.bias',
+     False, False),
+    (('layers', 'attn', 'bk'), '{p}layers.{i}.self_attn.k_proj.bias',
+     False, False),
+    (('layers', 'attn', 'bv'), '{p}layers.{i}.self_attn.v_proj.bias',
+     False, False),
 ]
 
 
@@ -382,7 +413,9 @@ def load_hf_model_sharded(model_dir: str, mesh, rules,
         host_tensor(f'{prefix}norm.weight', False, norm_offset),
         ('final_norm',))
 
-    for path_tuple, template, transpose, is_norm in _STACKED_LEAVES:
+    stacked = _STACKED_LEAVES + (
+        _STACKED_BIAS_LEAVES if config.attn_bias else [])
+    for path_tuple, template, transpose, is_norm in stacked:
         buf = alloc(path_tuple)
         for i in range(config.n_layers):
             name = template.format(p=prefix, i=i)

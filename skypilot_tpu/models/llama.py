@@ -71,6 +71,9 @@ class LlamaConfig:
     mlp_act: str = 'silu'                  # 'silu' | 'gelu_tanh'
     embed_scale: float = 1.0
     head_dim_override: Optional[int] = None
+    # Qwen2-family: biases on the q/k/v projections only (o_proj and
+    # the MLP stay bias-free, matching the HF architecture).
+    attn_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -86,6 +89,9 @@ class LlamaConfig:
         d, ff, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
         attn = d * self.n_heads * self.head_dim * 2 + \
             d * self.n_kv_heads * self.head_dim * 2
+        if self.attn_bias:
+            attn += self.n_heads * self.head_dim + \
+                2 * self.n_kv_heads * self.head_dim
         mlp = 3 * d * ff
         return v * d * 2 + l * (attn + mlp + 2 * d) + d
 
@@ -130,6 +136,10 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
                 'wk': dense_init(keys[2], nl, d, nkv * hd),
                 'wv': dense_init(keys[3], nl, d, nkv * hd),
                 'wo': dense_init(keys[4], nl, nh * hd, d),
+                **({'bq': jnp.zeros((nl, nh * hd), dt),
+                    'bk': jnp.zeros((nl, nkv * hd), dt),
+                    'bv': jnp.zeros((nl, nkv * hd), dt)}
+                   if config.attn_bias else {}),
             },
             'mlp': {
                 'w_gate': dense_init(keys[5], nl, d, ff),
@@ -196,9 +206,13 @@ def _layer(h: jax.Array, layer_params: Params, *, config: LlamaConfig,
     attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
 
     x = rmsnorm_ops.rms_norm(h, layer_params['ln1'], eps=config.norm_eps)
-    q = (x @ attn_p['wq']).reshape(batch, seq, nh, hd)
-    k = (x @ attn_p['wk']).reshape(batch, seq, nkv, hd)
-    v = (x @ attn_p['wv']).reshape(batch, seq, nkv, hd)
+    q, k, v = x @ attn_p['wq'], x @ attn_p['wk'], x @ attn_p['wv']
+    if 'bq' in attn_p:  # Qwen2-family qkv biases (config.attn_bias)
+        q, k, v = (q + attn_p['bq'], k + attn_p['bk'],
+                   v + attn_p['bv'])
+    q = q.reshape(batch, seq, nh, hd)
+    k = k.reshape(batch, seq, nkv, hd)
+    v = v.reshape(batch, seq, nkv, hd)
     q = rope_ops.apply_rope(q, cos, sin, positions=positions)
     k = rope_ops.apply_rope(k, cos, sin, positions=positions)
     o = attention_fn(q, k, v)

@@ -68,6 +68,7 @@ def constrain(x, mesh, spec: P):
 # tp on the head/ff dimension, fsdp on the d_model dimension.
 LLAMA_RULES = PartitionRules([
     (r'embed', P('tp', 'fsdp')),                 # (vocab, d)
+    (r'attn/bq|attn/bk|attn/bv', P(None, 'tp')),  # (L, heads*hd) qwen2
     (r'attn/wq|attn/wk|attn/wv', P(None, 'fsdp', 'tp')),   # (L, d, heads*hd)
     (r'attn/wo', P(None, 'tp', 'fsdp')),         # (L, heads*hd, d)
     (r'mlp/w_gate|mlp/w_up', P(None, 'fsdp', 'tp')),       # (L, d, ff)

@@ -328,3 +328,92 @@ def test_sharded_load_requires_safetensors(tmp_path):
     with pytest.raises(FileNotFoundError, match='safetensors'):
         convert.load_hf_model_sharded(str(tmp_path / 'empty'), mesh,
                                       tp_lib.INFER_TP_RULES)
+
+
+# --- Qwen2 family ---
+
+@pytest.fixture(scope='module')
+def hf_qwen2():
+    cfg = transformers.Qwen2Config(
+        vocab_size=160, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rms_norm_eps=1e-6, rope_theta=10000.0,
+        use_sliding_window=False, tie_word_embeddings=False,
+        attn_implementation='eager')
+    torch.manual_seed(2)
+    model = transformers.Qwen2ForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_qwen2_config_mapping(hf_qwen2):
+    cfg = convert.config_from_hf(hf_qwen2.config, dtype=jnp.float32)
+    assert cfg.attn_bias is True
+    assert cfg.mlp_act == 'silu' and cfg.embed_scale == 1.0
+    assert cfg.n_kv_heads == 2
+
+
+def test_qwen2_param_tree_has_biases(hf_qwen2):
+    cfg = convert.config_from_hf(hf_qwen2.config, dtype=jnp.float32)
+    params = convert.hf_state_dict_to_params(hf_qwen2.state_dict(), cfg)
+    init = llama.init_params(cfg, jax.random.PRNGKey(0))
+    assert jax.tree.structure(params) == jax.tree.structure(init)
+    assert params['layers']['attn']['bq'].shape == (2, 64)
+    assert params['layers']['attn']['bk'].shape == (2, 32)
+    # num_params accounting includes the biases.
+    n_leaves = sum(x.size for x in jax.tree.leaves(params))
+    assert n_leaves == cfg.num_params()
+
+
+def test_qwen2_forward_logits_match_transformers(hf_qwen2):
+    cfg = convert.config_from_hf(hf_qwen2.config, dtype=jnp.float32)
+    params = convert.hf_state_dict_to_params(hf_qwen2.state_dict(), cfg)
+    tokens = np.array([[7, 3, 99, 14, 52, 8]], np.int32)
+    with torch.no_grad():
+        hf_logits = hf_qwen2(torch.from_numpy(tokens).long()
+                             ).logits.float().numpy()
+    logits = np.asarray(llama.forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(logits, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_qwen2_generate_matches_transformers_greedy(hf_qwen2):
+    from skypilot_tpu.infer.engine import Generator, GeneratorConfig
+    cfg = convert.config_from_hf(hf_qwen2.config, dtype=jnp.float32)
+    params = convert.hf_state_dict_to_params(hf_qwen2.state_dict(), cfg)
+    prompt = [7, 3, 99, 14]
+    with torch.no_grad():
+        hf_out = hf_qwen2.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0)[0, len(prompt):].tolist()
+    gen = Generator(params, cfg, GeneratorConfig(
+        max_seq_len=64, batch_size=1, temperature=0.0))
+    (ours,) = gen.generate([prompt], max_new_tokens=8)
+    assert ours == hf_out
+
+
+def test_qwen2_sliding_window_refused():
+    cfg = transformers.Qwen2Config(
+        vocab_size=160, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, use_sliding_window=True,
+        sliding_window=128, max_window_layers=2)
+    with pytest.raises(NotImplementedError, match='sliding'):
+        convert.config_from_hf(cfg, dtype=jnp.float32)
+
+
+def test_sharded_load_qwen2_biases(tmp_path, hf_qwen2):
+    """The streaming loader fills the Qwen2 bias leaves too, matching
+    the full host-side load."""
+    from skypilot_tpu.infer import tp as tp_lib
+    model_dir = str(tmp_path / 'qwen2_ckpt')
+    hf_qwen2.save_pretrained(model_dir, safe_serialization=True)
+    full_params, full_cfg = convert.load_hf_model(model_dir,
+                                                  dtype=jnp.float32)
+    mesh = tp_lib.make_tp_mesh(2, n_kv_heads=full_cfg.n_kv_heads)
+    params, cfg = convert.load_hf_model_sharded(
+        model_dir, mesh, tp_lib.INFER_TP_RULES, dtype=jnp.float32)
+    assert cfg.attn_bias is True
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-2),
+        params, full_params)
