@@ -1,5 +1,5 @@
 """SFT entry point: supervised finetuning of any converted HF family
-(Llama / Mistral / Gemma) on a {prompt, completion} JSONL dataset with
+(Llama / Mistral / Gemma / Qwen2) on a {prompt, completion} JSONL dataset with
 prompt-masked loss (skypilot_tpu/train/sft.py).
 
 The post-training analog of the reference's torchtune finetune recipes
@@ -9,13 +9,15 @@ or a full slice via the injected env contract.
 import argparse
 import os
 
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
 from skypilot_tpu.utils import env_contract
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--hf-model', default='',
-                        help='HF checkpoint (Llama/Mistral/Gemma, hub '
+                        help='HF checkpoint (Llama/Mistral/Gemma/Qwen2, hub '
                              'name or local path); empty = debug-size '
                              'random init (smoke testing)')
     parser.add_argument('--data-file', required=True,
@@ -31,6 +33,18 @@ def main() -> None:
     parser.add_argument('--loss-chunk', type=int, default=0,
                         help='blockwise-CE chunk (0 = full logits); use '
                              'for 100k+ vocabularies')
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='>0: LoRA finetune — train rank-r adapters '
+                             'only (train/lora.py); grads/optimizer/'
+                             'checkpoints are adapter-sized')
+    parser.add_argument('--lora-alpha', type=float, default=32.0)
+    parser.add_argument('--lora-targets', default='attn',
+                        help="preset (attn, attn-qv, all-linear) or a "
+                             'regex over param paths')
+    parser.add_argument('--merge-save', default='',
+                        help='LoRA only: after training, save the '
+                             'MERGED full model (Orbax) here for '
+                             'serving')
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--checkpoint-dir', default='')
     parser.add_argument('--checkpoint-every', type=int, default=50)
@@ -81,12 +95,35 @@ def main() -> None:
               f'({config.num_params()/1e9:.2f}B) seq={args.seq_len} '
               f'batch={batch_size}', flush=True)
 
-    trainer = Trainer(
-        lambda p, b: sft.sft_loss_fn(p, b, config), params, mesh,
-        sharding_lib.LLAMA_RULES,
-        TrainConfig(learning_rate=args.learning_rate,
-                    warmup_steps=min(50, args.steps // 10 + 1),
-                    total_steps=args.steps))
+    base_loss = lambda p, b: sft.sft_loss_fn(p, b, config)  # noqa: E731
+    train_config = TrainConfig(
+        learning_rate=args.learning_rate,
+        warmup_steps=min(50, args.steps // 10 + 1),
+        total_steps=args.steps)
+    lora_state = None
+    if args.lora_rank > 0:
+        from skypilot_tpu.train import lora as lora_lib
+        lcfg = lora_lib.LoraConfig(rank=args.lora_rank,
+                                   alpha=args.lora_alpha,
+                                   targets=args.lora_targets)
+        # Freeze the base: shard it over the mesh once; only adapters
+        # go through the Trainer (its grads/Adam/checkpoints).
+        base_params = sharding_lib.shard_params(
+            params, mesh, sharding_lib.LLAMA_RULES)
+        adapters = lora_lib.init_lora(base_params, lcfg,
+                                      jax.random.PRNGKey(1))
+        if jax.process_index() == 0:
+            n_a, n_p = lora_lib.split_shapes(adapters)
+            print(f'LoRA: {n_a} adapted weights, {n_p/1e6:.2f}M '
+                  f'trainable params (rank {lcfg.rank}, '
+                  f'targets {args.lora_targets})', flush=True)
+        trainer = Trainer(
+            lora_lib.wrap_loss(base_loss, base_params, lcfg),
+            adapters, mesh, lora_lib.LORA_RULES, train_config)
+        lora_state = (base_params, lcfg)
+    else:
+        trainer = Trainer(base_loss, params, mesh,
+                          sharding_lib.LLAMA_RULES, train_config)
 
     if args.resume == 'auto' and args.checkpoint_dir:
         import re
@@ -113,6 +150,19 @@ def main() -> None:
             trainer.save_checkpoint(args.checkpoint_dir)
     if args.checkpoint_dir:
         trainer.save_checkpoint(args.checkpoint_dir)
+    if lora_state is not None and args.merge_save:
+        from skypilot_tpu.train import lora as lora_lib
+        base_params, lcfg = lora_state
+        merged = lora_lib.merge_lora(base_params, trainer.params, lcfg)
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(os.path.abspath(args.merge_save),
+                                'merged'),
+                   {'params': merged}, force=True)
+        ckptr.wait_until_finished()
+        if jax.process_index() == 0:
+            print(f'merged model saved to {args.merge_save}/merged',
+                  flush=True)
     if jax.process_index() == 0:
         print('SFT done.', flush=True)
 

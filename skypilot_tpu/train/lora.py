@@ -1,0 +1,165 @@
+"""LoRA: low-rank adapter finetuning over the stacked-layer pytree.
+
+The parameter-efficient finetune mode the reference's recipes get from
+torchtune (reference parity: llm/llama-3_1-finetuning/lora.yaml — the
+capability, not the implementation).  Instead of porting a torch
+module wrapper, adapters here are a PYTREE mirroring the base params:
+for each targeted linear weight W (.., in, out) the tree holds
+{'a': (.., in, r), 'b': (.., r, out)} with B zero-initialized, so
+W_eff = W + (alpha/r) * A @ B starts exactly at the base model.
+
+Design for the TPU trainer (train/trainer.py):
+- the TRAINABLE tree passed to Trainer is just the adapter pytree —
+  grads, Adam mu/nu, and checkpoints are all adapter-sized (~0.1-1% of
+  the model), which is the entire point of LoRA at 8B+ scales;
+- the frozen base params are closed over by the wrapped loss and stay
+  sharded however the caller placed them (fsdp/tp);
+- apply_lora materializes W_eff per step inside the jitted loss — one
+  extra weight-sized buffer (shard-local under fsdp), traded for
+  leaving the model code completely untouched.  The factored form
+  (x@A)@B would save that buffer at the cost of threading adapters
+  through every layer; revisit if finetune memory becomes the bound.
+- adapters are stored f32 (they ARE the master weights of the
+  finetune); the A@B product is cast to the base dtype on application.
+
+Stacked layers work transparently: a targeted (L, in, out) weight gets
+(L, in, r) / (L, r, out) adapters and the einsum batches over L.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.parallel.sharding import PartitionRules
+
+# Adapters are tiny (rank * (in+out) per target); replicate them — dp
+# grad sync comes free from GSPMD, and no reshard logic is needed.
+LORA_RULES = PartitionRules([(r'.*', P())])
+
+# Preset target sets (torchtune lora.yaml exposes the same choice as
+# lora_attn_modules / apply_lora_to_mlp).
+TARGET_PRESETS = {
+    'attn': r'attn/(wq|wk|wv|wo)$',
+    'attn-qv': r'attn/(wq|wv)$',
+    'all-linear': r'(attn/(wq|wk|wv|wo)|mlp/(w_gate|w_up|w_down))$',
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 16
+    alpha: float = 32.0
+    # A TARGET_PRESETS key, or a raw regex over param paths.
+    targets: str = 'attn'
+
+    @property
+    def target_pattern(self) -> str:
+        return TARGET_PRESETS.get(self.targets, self.targets)
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, 'key', getattr(p, 'idx', p))))
+    return '/'.join(parts)
+
+
+def _set_nested(tree: Dict[str, Any], path, value) -> None:
+    node = tree
+    keys = [str(getattr(p, 'key', getattr(p, 'idx', p))) for p in path]
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+
+
+def _get_nested(tree: Dict[str, Any], path):
+    node = tree
+    for p in path:
+        k = str(getattr(p, 'key', getattr(p, 'idx', p)))
+        if not isinstance(node, dict) or k not in node:
+            return None
+        node = node[k]
+    return node
+
+
+def init_lora(params: Any, lora_config: LoraConfig,
+              key: jax.Array) -> Dict[str, Any]:
+    """Adapter pytree for every targeted weight.  A ~ N(0, 1/in_dim)
+    (kaiming-style fan-in), B = 0 — so step 0 is exactly the base
+    model, the property every LoRA schedule assumes."""
+    pattern = re.compile(lora_config.target_pattern)
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    targets = [(path, leaf) for path, leaf in flat
+               if pattern.search(_path_str(path))]
+    if not targets:
+        raise ValueError(
+            f'LoRA targets pattern {lora_config.target_pattern!r} '
+            f'matched no params (paths: '
+            f'{[_path_str(p) for p, _ in flat][:8]}...)')
+    out: Dict[str, Any] = {}
+    keys = jax.random.split(key, len(targets))
+    for k, (path, leaf) in zip(keys, targets):
+        if leaf.ndim < 2:
+            raise ValueError(f'LoRA target {_path_str(path)} is not a '
+                             f'matrix: shape {leaf.shape}')
+        lead, (in_dim, out_dim) = leaf.shape[:-2], leaf.shape[-2:]
+        a = (jax.random.normal(k, lead + (in_dim, lora_config.rank),
+                               jnp.float32) * (in_dim ** -0.5))
+        b = jnp.zeros(lead + (lora_config.rank, out_dim), jnp.float32)
+        _set_nested(out, path, {'a': a, 'b': b})
+    return out
+
+
+def apply_lora(params: Any, lora: Dict[str, Any],
+               lora_config: LoraConfig) -> Any:
+    """Effective params: W + (alpha/r) * A @ B for adapted weights,
+    passthrough otherwise.  Jit-safe; product cast to the base dtype."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        ad = _get_nested(lora, path)
+        if ad is None:
+            out.append(leaf)
+            continue
+        delta = jnp.einsum('...ir,...ro->...io', ad['a'], ad['b'])
+        out.append(leaf + (lora_config.scaling * delta).astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def merge_lora(params: Any, lora: Dict[str, Any],
+               lora_config: LoraConfig) -> Any:
+    """Concrete merged params for export/serving (one jitted pass —
+    the serving engine then runs them with zero LoRA overhead)."""
+    return jax.jit(lambda p, l: apply_lora(p, l, lora_config))(
+        params, lora)
+
+
+def wrap_loss(base_loss_fn, base_params: Any,
+              lora_config: LoraConfig):
+    """loss(lora, batch) over the ADAPTER tree, for Trainer: the base
+    params ride as closed-over sharded constants (frozen — no grads,
+    no optimizer state, no checkpoint bytes)."""
+    def loss(lora, batch):
+        return base_loss_fn(apply_lora(base_params, lora, lora_config),
+                            batch)
+    return loss
+
+
+def num_params(lora: Dict[str, Any]) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(lora))
+
+
+def split_shapes(lora: Dict[str, Any]) -> Tuple[int, int]:
+    """(n_adapters, n_params) for logging."""
+    leaves = jax.tree_util.tree_leaves(lora)
+    return len(leaves) // 2, sum(x.size for x in leaves)
