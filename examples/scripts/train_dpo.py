@@ -1,0 +1,176 @@
+"""DPO entry point: preference tuning of any converted HF family on a
+{prompt, chosen, rejected} JSONL dataset (skypilot_tpu/train/dpo.py).
+
+With --lora-rank (recommended at 8B+) the reference policy is the
+frozen base itself — no second model copy in HBM; full-parameter mode
+keeps a frozen sharded copy of the initial weights.
+"""
+import argparse
+import os
+
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
+from skypilot_tpu.utils import env_contract
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--hf-model', default='',
+                        help='HF checkpoint (Llama/Mistral/Gemma/Qwen2); '
+                             'empty = debug-size random init')
+    parser.add_argument('--data-file', required=True,
+                        help='JSONL of {"prompt", "chosen", "rejected"}')
+    parser.add_argument('--seq-len', type=int, default=1024)
+    parser.add_argument('--batch-size', type=int, default=0,
+                        help='pairs per step; 0 = 1 per dp shard')
+    parser.add_argument('--steps', type=int, default=200)
+    parser.add_argument('--beta', type=float, default=0.1,
+                        help='DPO temperature (implicit reward scale)')
+    parser.add_argument('--dp', type=int, default=0)
+    parser.add_argument('--fsdp', type=int, default=0)
+    parser.add_argument('--tp', type=int, default=1)
+    parser.add_argument('--learning-rate', type=float, default=5e-7)
+    parser.add_argument('--loss-chunk', type=int, default=0)
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='>0: LoRA-DPO — adapters train, the base '
+                             'IS the reference policy (no 2x model '
+                             'HBM)')
+    parser.add_argument('--lora-alpha', type=float, default=32.0)
+    parser.add_argument('--lora-targets', default='attn')
+    parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--checkpoint-dir', default='')
+    parser.add_argument('--checkpoint-every', type=int, default=50)
+    parser.add_argument('--resume', default='no', choices=['no', 'auto'])
+    parser.add_argument('--merge-save', default='')
+    args = parser.parse_args()
+
+    env_contract.initialize_from_env()
+    import dataclasses
+
+    import jax
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import TrainConfig, Trainer
+    from skypilot_tpu.train import dpo
+
+    tokenizer = None
+    eos_id = None
+    if args.hf_model:
+        from skypilot_tpu.models import convert
+        params, config = convert.load_hf_model(args.hf_model)
+        try:
+            import transformers
+            tokenizer = transformers.AutoTokenizer.from_pretrained(
+                args.hf_model)
+            eos_id = tokenizer.eos_token_id
+        except Exception:
+            tokenizer = None
+    else:
+        config = llama.LLAMA_DEBUG
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+    if args.loss_chunk:
+        config = dataclasses.replace(config, loss_chunk=args.loss_chunk)
+
+    def encode(text: str):
+        if tokenizer is not None:
+            return tokenizer(text)['input_ids']
+        return [b % config.vocab_size for b in text.encode('utf-8')]
+
+    n = jax.device_count()
+    dp = args.dp or max(1, n // (max(args.fsdp, 1) * args.tp))
+    mesh_config = MeshConfig(dp=dp, fsdp=max(args.fsdp, 1), tp=args.tp)
+    mesh = make_mesh(mesh_config)
+    batch_size = args.batch_size or max(2, dp * max(args.fsdp, 1))
+    if jax.process_index() == 0:
+        print(f'DPO: devices={n} {mesh_config} '
+              f'model={args.hf_model or "debug"} '
+              f'({config.num_params()/1e9:.2f}B) seq={args.seq_len} '
+              f'pairs/step={batch_size} beta={args.beta}', flush=True)
+
+    train_config = TrainConfig(
+        learning_rate=args.learning_rate,
+        warmup_steps=min(50, args.steps // 10 + 1),
+        total_steps=args.steps, weight_decay=0.0)
+    lora_state = None
+    if args.lora_rank > 0:
+        from skypilot_tpu.train import lora as lora_lib
+        lcfg = lora_lib.LoraConfig(rank=args.lora_rank,
+                                   alpha=args.lora_alpha,
+                                   targets=args.lora_targets)
+        base_params = sharding_lib.shard_params(
+            params, mesh, sharding_lib.LLAMA_RULES)
+        adapters = lora_lib.init_lora(base_params, lcfg,
+                                      jax.random.PRNGKey(1))
+        if jax.process_index() == 0:
+            n_a, n_p = lora_lib.split_shapes(adapters)
+            print(f'LoRA-DPO: {n_a} adapted weights, {n_p/1e6:.2f}M '
+                  f'trainable; reference = frozen base (no copy)',
+                  flush=True)
+
+        def loss(adapters_tree, batch):
+            policy = lora_lib.apply_lora(base_params, adapters_tree,
+                                         lcfg)
+            # The base tree with adapters off IS the reference policy.
+            return dpo.dpo_loss_fn(policy, base_params, batch, config,
+                                   beta=args.beta)
+
+        trainer = Trainer(loss, adapters, mesh, lora_lib.LORA_RULES,
+                          train_config)
+        lora_state = (base_params, lcfg)
+    else:
+        # Full-parameter DPO: frozen sharded copy of the start point.
+        ref_params = sharding_lib.shard_params(
+            params, mesh, sharding_lib.LLAMA_RULES)
+
+        def loss(p, batch):
+            return dpo.dpo_loss_fn(p, ref_params, batch, config,
+                                   beta=args.beta)
+
+        trainer = Trainer(loss, params, mesh,
+                          sharding_lib.LLAMA_RULES, train_config)
+
+    if args.resume == 'auto' and args.checkpoint_dir:
+        import re
+        steps = []
+        if os.path.isdir(args.checkpoint_dir):
+            for d in os.listdir(args.checkpoint_dir):
+                m = re.fullmatch(r'step_(\d+)', d)
+                if m:
+                    steps.append(int(m.group(1)))
+        if steps:
+            trainer.restore_checkpoint(args.checkpoint_dir, max(steps))
+            if jax.process_index() == 0:
+                print(f'resumed from step {trainer.step}', flush=True)
+
+    batches = dpo.dpo_batches(args.data_file, encode, batch_size,
+                              args.seq_len, eos_id=eos_id)
+    while trainer.step < args.steps:
+        metrics = trainer.run_step(next(batches))
+        step = trainer.step
+        if jax.process_index() == 0 and step % args.log_every == 0:
+            print(f'step {step}: loss={float(metrics["loss"]):.4f}',
+                  flush=True)
+        if args.checkpoint_dir and step % args.checkpoint_every == 0:
+            trainer.save_checkpoint(args.checkpoint_dir)
+    if args.checkpoint_dir:
+        trainer.save_checkpoint(args.checkpoint_dir)
+    if lora_state is not None and args.merge_save:
+        from skypilot_tpu.train import lora as lora_lib
+        base_params, lcfg = lora_state
+        merged = lora_lib.merge_lora(base_params, trainer.params, lcfg)
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(os.path.abspath(args.merge_save),
+                                'merged'),
+                   {'params': merged}, force=True)
+        ckptr.wait_until_finished()
+        if jax.process_index() == 0:
+            print(f'merged model saved to {args.merge_save}/merged',
+                  flush=True)
+    if jax.process_index() == 0:
+        print('DPO done.', flush=True)
+
+
+if __name__ == '__main__':
+    main()

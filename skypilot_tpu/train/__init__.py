@@ -2,3 +2,12 @@ from skypilot_tpu.train.trainer import (TrainConfig, Trainer,
                                         make_optimizer, synthetic_batches)
 
 __all__ = ['TrainConfig', 'Trainer', 'make_optimizer', 'synthetic_batches']
+
+
+def __getattr__(name):
+    # Lazy submodule access (sft / dpo / lora / rl): keeps
+    # `import skypilot_tpu.train` light for CLI paths that never train.
+    if name in ('sft', 'dpo', 'lora', 'rl'):
+        import importlib
+        return importlib.import_module(f'skypilot_tpu.train.{name}')
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
