@@ -516,8 +516,99 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
             'data': [{'id': model_name, 'object': 'model', 'created': 0,
                       'owned_by': 'skypilot-tpu'}]})
 
+    # /v1/embeddings: mean-pooled final hidden states
+    # (llama_infer.encode — quant-aware, no KV cache).  Single-host
+    # only: the encode program is dispatched outside the multi-host
+    # scheduler replay, so a replica spanning hosts would desync its
+    # SPMD workers — those get a clean 501, not a wedged replica.
+    _embed_state = {}
+
+    def _embed_sync(batcher, tokens, lengths):
+        import jax
+        import numpy as _np
+        from skypilot_tpu.infer import llama_infer
+        if 'fn' not in _embed_state:
+            _embed_state['fn'] = jax.jit(
+                lambda p, t, l: llama_infer.encode(p, t, config, l))
+        out = _embed_state['fn'](batcher.params, tokens, lengths)
+        return _np.asarray(out)
+
+    async def embeddings(request):
+        import numpy as np
+        if getattr(driver.batcher, 'ping', None) is not None:
+            return web.json_response(
+                {'error': {'message': 'embeddings are not supported on '
+                                      'multi-host replicas',
+                           'type': 'invalid_request_error'}}, status=501)
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError('request body must be a JSON object')
+            raw = body.get('input')
+            if raw is None:
+                raise ValueError("'input' is required")
+            if isinstance(raw, str) or (
+                    isinstance(raw, list) and raw
+                    and isinstance(raw[0], int)):
+                raw = [raw]
+            if not isinstance(raw, list) or not raw or len(raw) > 64:
+                raise ValueError("'input' must be 1..64 strings or "
+                                 'token-id lists')
+            ids_list = []
+            for item in raw:
+                ids = (list(item) if isinstance(item, list)
+                       else _encode_text(item, tokenizer, config))
+                if not ids:
+                    raise ValueError('empty input')
+                bad = [t for t in ids
+                       if not isinstance(t, int)
+                       or not 0 <= t < config.vocab_size]
+                if bad:
+                    raise ValueError(
+                        f'token ids must be ints in [0, '
+                        f'{config.vocab_size}): {bad[:5]}')
+                ids_list.append(ids)
+            buckets = driver.batcher.buckets
+            longest = max(len(i) for i in ids_list)
+            bucket = next((b for b in buckets if longest <= b), None)
+            if bucket is None:
+                raise ValueError(f'input length {longest} exceeds the '
+                                 f'largest bucket {buckets[-1]}')
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {'error': {'message': str(e),
+                           'type': 'invalid_request_error'}}, status=400)
+        # Pad the BATCH axis to a power of two as well: unpadded sizes
+        # would compile up to 64 programs per bucket, each compile
+        # stalling token generation under the scheduler lock.  Pad rows
+        # carry length 1 over token 0 and are dropped from the reply.
+        n_real = len(ids_list)
+        n_pad = 1
+        while n_pad < n_real:
+            n_pad *= 2
+        tokens = np.zeros((n_pad, bucket), np.int32)
+        lengths = np.ones((n_pad,), np.int32)
+        for i, ids in enumerate(ids_list):
+            tokens[i, :len(ids)] = np.asarray(ids, np.int32)
+            lengths[i] = len(ids)
+
+        def run():
+            # The scheduler lock serializes with decode: one chip owner.
+            with driver.lock:
+                return _embed_sync(driver.batcher, tokens, lengths)
+        vecs = await asyncio.to_thread(run)
+        n_tokens = int(lengths[:n_real].sum())
+        return web.json_response({
+            'object': 'list', 'model': model_name,
+            'data': [{'object': 'embedding', 'index': i,
+                      'embedding': [float(x) for x in vecs[i]]}
+                     for i in range(n_real)],
+            'usage': {'prompt_tokens': n_tokens,
+                      'total_tokens': n_tokens}})
+
     app.router.add_post('/v1/completions', completions)
     app.router.add_post('/v1/chat/completions', chat_completions)
+    app.router.add_post('/v1/embeddings', embeddings)
     app.router.add_get('/v1/models', models)
 
 

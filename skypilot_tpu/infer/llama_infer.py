@@ -168,6 +168,63 @@ def prefill(params: llama.Params, tokens: jax.Array,
     return logits, {'k': k_all, 'v': v_all}
 
 
+def encode(params: llama.Params, tokens: jax.Array,
+           config: llama.LlamaConfig, lengths: jax.Array) -> jax.Array:
+    """Mean-pooled final hidden states (B, d) over each row's valid
+    prefix — the /v1/embeddings path.  Same quant-aware layer stack as
+    prefill (works on int8 weight-only params, unlike the training
+    forward), no KV cache, logits never computed."""
+    batch, seq = tokens.shape
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, seq, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, tokens, config)
+    attention_fn = functools.partial(attention_ops.flash_attention,
+                                     causal=True)
+
+    def layer(h, layer_params):
+        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)
+        q = rope_ops.apply_rope(q, cos[:seq], sin[:seq])
+        k = rope_ops.apply_rope(k, cos[:seq], sin[:seq])
+        o = attention_fn(q, k, v)
+        h = h + quant.matmul(o.reshape(batch, seq, -1), attn_p['wo'])
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                 eps=config.norm_eps)
+        h = h + _mlp(x, mlp_p, config.mlp_act)
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, params['layers'])
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    mask = (jnp.arange(seq)[None, :] < lengths[:, None]).astype(h.dtype)
+    pooled = (h * mask[..., None]).sum(axis=1) / \
+        jnp.maximum(mask.sum(axis=1), 1.0)[:, None]
+    return pooled.astype(jnp.float32)
+
+
+def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config):
+    """Per-token GQA attention + MLP residual block AFTER the cache
+    update — the math shared verbatim by all three decode
+    implementations (scan / inplace / unrolled), so a numerics fix
+    lands in one place."""
+    batch = h.shape[0]
+    attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+    group = config.n_heads // config.n_kv_heads
+    q_g = q.reshape(batch, 1, config.n_kv_heads, group, config.head_dim)
+    scale = config.head_dim ** -0.5
+    s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(visible[:, None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff)
+    h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
+    x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                             eps=config.norm_eps)
+    return h + _mlp(x, mlp_p, config.mlp_act)
+
+
 def get_decode_fn(impl: str):
     """Decode implementation by name — rejects unknown values so a typo
     cannot silently select the slower path."""
@@ -175,8 +232,11 @@ def get_decode_fn(impl: str):
         return decode_step_inplace
     if impl == 'scan':
         return decode_step
+    if impl == 'unroll':
+        return decode_step_unrolled
     raise ValueError(
-        f"decode_impl must be 'inplace' or 'scan', got {impl!r}")
+        f"decode_impl must be 'inplace', 'scan' or 'unroll', "
+        f'got {impl!r}')
 
 
 def decode_step_inplace(params: llama.Params, token: jax.Array,
@@ -249,22 +309,78 @@ def decode_step_inplace(params: llama.Params, token: jax.Array,
                                                  False)
             v_eff = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
                                                  False)
-        group = config.n_heads // config.n_kv_heads
-        q_g = q.reshape(batch, 1, config.n_kv_heads, group,
-                        config.head_dim)
-        scale = config.head_dim ** -0.5
-        s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff,
-                       preferred_element_type=jnp.float32) * scale
-        s = jnp.where(visible[:, None, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff)
-        h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
-        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
-                                 eps=config.norm_eps)
-        h = h + _mlp(x, mlp_p, config.mlp_act)
+        h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible,
+                            config)
         return (h, cache)
 
     h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    logits = quant.matmul(h[:, 0], params['lm_head'],
+                          out_dtype=jnp.float32)
+    return logits, cache
+
+
+def decode_step_unrolled(params: llama.Params, token: jax.Array,
+                         config: llama.LlamaConfig, cache: Cache,
+                         positions: jax.Array
+                         ) -> Tuple[jax.Array, Cache]:
+    """decode_step_inplace with the layer loop UNROLLED (python loop,
+    static layer indices).
+
+    Kept as a measured NEGATIVE result: the hypothesis was that the
+    fori_loop's dynamic weight slices force per-step copies of the
+    stacked params, and static indices would let XLA read sub-buffers
+    in place.  Measured on a v5e chip (1B, 16 slots): unrolled decodes
+    ~9% SLOWER than the fori_loop (2560 vs 2809 tok/s bf16; int8
+    likewise) — XLA already streams loop-sliced weights without a
+    copy, and the unrolled graph schedules worse.  Same math, greedy
+    outputs identical (tested); selectable for re-measurement on new
+    hardware/compiler versions via decode_impl='unroll'.
+    """
+    batch = token.shape[0]
+    max_len = cache['k'].shape[2]
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, max_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, token, config)[:, None]  # (B, 1, d)
+    pos = positions[:, None].astype(jnp.int32)
+    slot = jnp.arange(max_len)[None, :]
+    visible = slot <= pos
+    quantized = 'k_scale' in cache
+    b_idx = jnp.arange(batch)
+    group = config.n_heads // config.n_kv_heads
+    scale = config.head_dim ** -0.5
+    cache = dict(cache)
+
+    for i in range(config.n_layers):
+        layer_params = jax.tree.map(lambda x: x[i], params['layers'])
+        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)
+        q = rope_ops.apply_rope(q, cos, sin, positions=pos)
+        k = rope_ops.apply_rope(k, cos, sin, positions=pos)
+        if quantized:
+            k_row, k_s_row = _quantize_kv(k[:, 0])
+            v_row, v_s_row = _quantize_kv(v[:, 0])
+            cache['k'] = cache['k'].at[i, b_idx, positions].set(k_row)
+            cache['v'] = cache['v'].at[i, b_idx, positions].set(v_row)
+            cache['k_scale'] = cache['k_scale'].at[
+                i, b_idx, positions].set(k_s_row)
+            cache['v_scale'] = cache['v_scale'].at[
+                i, b_idx, positions].set(v_s_row)
+            k_eff = _dequantize(cache['k'][i], cache['k_scale'][i],
+                                q.dtype)
+            v_eff = _dequantize(cache['v'][i], cache['v_scale'][i],
+                                q.dtype)
+        else:
+            cache['k'] = cache['k'].at[i, b_idx, positions].set(k[:, 0])
+            cache['v'] = cache['v'].at[i, b_idx, positions].set(v[:, 0])
+            k_eff = cache['k'][i]
+            v_eff = cache['v'][i]
+        h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible,
+                            config)
+
     h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
     logits = quant.matmul(h[:, 0], params['lm_head'],
                           out_dtype=jnp.float32)
@@ -321,24 +437,14 @@ def decode_step(params: llama.Params, token: jax.Array,
             k_cache = k_cache.at[b_idx, positions].set(k[:, 0])
             v_cache = v_cache.at[b_idx, positions].set(v[:, 0])
             k_eff, v_eff = k_cache, v_cache
-        # GQA attention of the single query over the cache prefix.  The
-        # query is reshaped into (KV, group) head blocks and contracted
-        # against the UN-repeated cache: decode is bandwidth-bound, and
-        # materializing repeated K/V would multiply the dominant memory
-        # traffic by the group factor (4x for Llama-3 8B).
-        group = config.n_heads // config.n_kv_heads
-        q_g = q.reshape(batch, 1, config.n_kv_heads, group,
-                        config.head_dim)
-        scale = config.head_dim ** -0.5
-        s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff,
-                       preferred_element_type=jnp.float32) * scale
-        s = jnp.where(visible[:, None, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff)
-        h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
-        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
-                                 eps=config.norm_eps)
-        h = h + _mlp(x, mlp_p, config.mlp_act)
+        # GQA attention of the single query over the cache prefix: the
+        # query is contracted in (KV, group) blocks against the
+        # UN-repeated cache inside _token_attn_mlp — decode is
+        # bandwidth-bound, and materializing repeated K/V would
+        # multiply the dominant memory traffic by the group factor
+        # (4x for Llama-3 8B).
+        h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible,
+                            config)
         if quantized:
             return h, (k_cache, v_cache, k_s, v_s)
         return h, (k_cache, v_cache)

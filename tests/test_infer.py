@@ -172,6 +172,9 @@ def test_decode_impl_inplace_matches_scan():
 
     for kv_dtype in (None, 'int8'):
         assert run('inplace', kv_dtype) == run('scan', kv_dtype), kv_dtype
+        # The unrolled (static-layer-index) variant is the same math
+        # too — kept as a measured negative perf result, still correct.
+        assert run('unroll', kv_dtype) == run('scan', kv_dtype), kv_dtype
 
 
 def test_engine_rejects_context_beyond_model_ceiling():

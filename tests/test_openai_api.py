@@ -199,3 +199,27 @@ def test_completions_per_request_sampling(server):
                          {'prompt': [5], 'top_p': 0.0})
     assert status == 400
     assert body['error']['type'] == 'invalid_request_error'
+
+
+def test_embeddings_endpoint(server):
+    """/v1/embeddings: mean-pooled hidden states with the OpenAI
+    response schema; deterministic; validates inputs."""
+    payload = {'input': [[5, 9, 2], [7, 7]]}
+    status, body = _post(server + '/v1/embeddings', payload)
+    assert status == 200, body
+    assert body['object'] == 'list' and len(body['data']) == 2
+    v0 = body['data'][0]['embedding']
+    assert len(v0) == 256  # LLAMA_DEBUG d_model
+    assert body['usage']['prompt_tokens'] == 5
+    # Deterministic across calls.
+    _, body2 = _post(server + '/v1/embeddings', payload)
+    assert body2['data'][0]['embedding'] == v0
+    # Different input -> different vector.
+    assert body['data'][1]['embedding'] != v0
+    # Single string input form is accepted.
+    status, body3 = _post(server + '/v1/embeddings', {'input': 'hello'})
+    assert status == 200 and len(body3['data']) == 1
+    # Bad input -> OpenAI error shape.
+    status, err = _post(server + '/v1/embeddings', {'input': []})
+    assert status == 400
+    assert err['error']['type'] == 'invalid_request_error'
