@@ -1,0 +1,147 @@
+"""Perplexity evaluation: score a text corpus with any converted HF
+family (or a debug model), sharded over the slice.
+
+The evaluation counterpart of the finetune recipes (reference parity:
+the evaluation step users run inside llm/ recipes via lm-eval/torch —
+here a library-driven loop over the same sharded forward):
+
+    python examples/scripts/eval_ppl.py --hf-model meta-llama/Llama-3.1-8B \
+        --data-file corpus.txt --seq-len 2048 --fsdp 16
+
+Prints one JSON line: {"perplexity", "nll", "tokens", "seqs"} —
+next-token NLL averaged over all non-pad target tokens.
+"""
+import argparse
+import json
+
+import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
+
+from skypilot_tpu.utils import env_contract
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--hf-model', default='',
+                        help='HF checkpoint (Llama/Mistral/Gemma/Qwen2);'
+                             ' empty = debug-size random init')
+    parser.add_argument('--data-file', required=True,
+                        help='plain-text corpus (evaluated in seq-len '
+                             'windows) or JSONL with a "text" field')
+    parser.add_argument('--seq-len', type=int, default=1024)
+    parser.add_argument('--batch-size', type=int, default=0,
+                        help='0 = one row per device')
+    parser.add_argument('--max-batches', type=int, default=0,
+                        help='cap evaluated batches (0 = whole corpus)')
+    parser.add_argument('--dp', type=int, default=0)
+    parser.add_argument('--fsdp', type=int, default=0)
+    parser.add_argument('--tp', type=int, default=1)
+    parser.add_argument('--loss-chunk', type=int, default=0)
+    args = parser.parse_args()
+
+    env_contract.initialize_from_env()
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.ops import losses as losses_ops
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+
+    tokenizer = None
+    if args.hf_model:
+        from skypilot_tpu.models import convert
+        params, config = convert.load_hf_model(args.hf_model)
+        try:
+            import transformers
+            tokenizer = transformers.AutoTokenizer.from_pretrained(
+                args.hf_model)
+        except Exception:
+            tokenizer = None
+    else:
+        config = llama.LLAMA_DEBUG
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+    if args.loss_chunk:
+        config = dataclasses.replace(config, loss_chunk=args.loss_chunk)
+
+    def encode(text: str):
+        if tokenizer is not None:
+            return tokenizer(text)['input_ids']
+        return [b % config.vocab_size for b in text.encode('utf-8')]
+
+    # Corpus -> one token stream -> (seq_len + 1) windows.
+    ids = []
+    with open(args.data_file, encoding='utf-8') as f:
+        for line in f:
+            line = line.rstrip('\n')
+            if not line:
+                continue
+            if line.lstrip().startswith('{'):
+                try:
+                    line = json.loads(line).get('text', line)
+                except ValueError:
+                    pass
+            ids.extend(encode(line))
+    window = args.seq_len + 1
+    n_windows = len(ids) // window
+    if n_windows == 0:
+        raise SystemExit(f'corpus too small: {len(ids)} tokens < '
+                         f'one {window}-token window')
+    stream = np.asarray(ids[:n_windows * window], np.int32
+                        ).reshape(n_windows, window)
+
+    n = jax.device_count()
+    dp = args.dp or max(1, n // (max(args.fsdp, 1) * args.tp))
+    mesh = make_mesh(MeshConfig(dp=dp, fsdp=max(args.fsdp, 1),
+                                tp=args.tp))
+    batch_size = args.batch_size or dp * max(args.fsdp, 1)
+    params = sharding_lib.shard_params(params, mesh,
+                                       sharding_lib.LLAMA_RULES)
+    batch_sharding = NamedSharding(mesh, sharding_lib.BATCH_SPEC)
+
+    @jax.jit
+    def nll_and_count(p, tokens):
+        """Sum NLL + token count for one full (B, S+1) batch."""
+        if config.loss_chunk:
+            h = llama.hidden_states(p, tokens[:, :-1], config)
+            lp = losses_ops.chunked_token_logprobs(
+                h, p['lm_head'], tokens[:, 1:],
+                chunk_size=config.loss_chunk)
+        else:
+            logits = llama.forward(p, tokens[:, :-1], config)
+            lp = losses_ops.token_logprobs(logits, tokens[:, 1:])
+        return -lp.sum(), lp.size
+
+    # Ragged tail windows (< one full batch) are dropped, and SAID so:
+    # silent exclusion would make perplexities incomparable across
+    # batch sizes.
+    dropped = n_windows % batch_size
+    if dropped and jax.process_index() == 0:
+        print(f'note: dropping {dropped} tail window(s) '
+              f'({n_windows} windows, batch {batch_size})', flush=True)
+    total_nll, total_tokens, batches = 0.0, 0, 0
+    for start in range(0, n_windows - batch_size + 1, batch_size):
+        batch = jax.device_put(stream[start:start + batch_size],
+                               batch_sharding)
+        nll, count = nll_and_count(params, batch)
+        total_nll += float(nll)
+        total_tokens += int(count)
+        batches += 1
+        if args.max_batches and batches >= args.max_batches:
+            break
+    if total_tokens == 0:
+        raise SystemExit(f'corpus yields no full batch: {n_windows} '
+                         f'windows < batch {batch_size}')
+    nll = total_nll / total_tokens
+    if jax.process_index() == 0:
+        print(json.dumps({'perplexity': round(float(np.exp(nll)), 4),
+                          'nll': round(nll, 5),
+                          'tokens': total_tokens, 'seqs': batches
+                          * batch_size}))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -164,7 +164,7 @@ class BatcherDriver:
 def build_generator(model_size: str, max_seq_len: int, temperature: float,
                     hf_model: str = '', batch_size: int = 4, tp: int = 1,
                     mesh_builder=None, kv_cache_dtype=None,
-                    weights_dtype=None):
+                    weights_dtype=None, prefill_chunk=None):
     """mesh_builder: optional config -> Mesh callable (the multi-host
     path builds its mesh from the resolved model's KV-head count — the
     GQA overshard factor depends on it, so the config must exist
@@ -249,7 +249,8 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
         max_seq_len=max_seq_len, batch_size=batch_size,
         temperature=temperature, eos_token=eos,
         kv_cache_dtype=kv_cache_dtype,
-        weights_dtype=weights_dtype), mesh=mesh)
+        weights_dtype=weights_dtype,
+        prefill_chunk=prefill_chunk), mesh=mesh)
     return gen, config, tokenizer
 
 
@@ -556,8 +557,14 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
                                  'token-id lists')
             ids_list = []
             for item in raw:
-                ids = (list(item) if isinstance(item, list)
-                       else _encode_text(item, tokenizer, config))
+                if isinstance(item, list):
+                    ids = list(item)
+                elif isinstance(item, str):
+                    ids = _encode_text(item, tokenizer, config)
+                else:
+                    raise ValueError(
+                        'each input must be a string or a token-id '
+                        f'list, got {type(item).__name__}')
                 if not ids:
                     raise ValueError('empty input')
                 bad = [t for t in ids
@@ -634,6 +641,11 @@ def main() -> int:
                         help='int8: quantized KV cache — ~2x the '
                              'slots/context per GB of HBM (the vLLM '
                              'kv_cache_dtype analog)')
+    parser.add_argument('--prefill-chunk', type=int, default=0,
+                        help='>0: chunked prefill — prompts longer '
+                             'than this prefill in windows interleaved '
+                             'with decode ticks, so one long prompt '
+                             'cannot stall in-flight generations')
     parser.add_argument('--weights-dtype', default=None,
                         choices=[None, 'int8'],
                         help='int8: weight-only quantization (per-out-'
@@ -685,7 +697,8 @@ def main() -> int:
         args.model_size, args.max_seq_len, args.temperature,
         args.hf_model, args.batch_size, args.tp,
         mesh_builder=mesh_builder, kv_cache_dtype=args.kv_cache_dtype,
-        weights_dtype=args.weights_dtype)
+        weights_dtype=args.weights_dtype,
+        prefill_chunk=args.prefill_chunk or None)
     if info['num_hosts'] > 1:
         control_port = args.control_port or info['control_port']
         if info['host_id'] != 0:

@@ -89,6 +89,27 @@ function gib(bytes) {
   return bytes == null ? '-' : `${(bytes / 2 ** 30).toFixed(1)} GiB`;
 }
 
+// Inline-SVG sparkline from the server's utilization history ring
+// (/api/cluster_metrics history field) — no chart library.
+function sparkline(values, label) {
+  const pts = values.filter((v) => v != null);
+  if (pts.length < 2) return '';
+  const w = 160; const h = 28;
+  const max = Math.max(...pts, 1e-9);
+  const min = Math.min(...pts, 0);
+  const span = Math.max(max - min, 1e-9);
+  const step = w / (pts.length - 1);
+  const line = pts.map((v, i) =>
+      `${(i * step).toFixed(1)},` +
+      `${(h - 2 - ((v - min) / span) * (h - 4)).toFixed(1)}`).join(' ');
+  return '<div class="spark">' +
+      `<svg width="${w}" height="${h}" viewBox="0 0 ${w} ${h}">` +
+      `<polyline fill="none" stroke="currentColor" stroke-width="1.5" ` +
+      `points="${line}"/></svg>` +
+      `<span class="spark-label">${esc(label)} ` +
+      `(${pts[pts.length - 1]})</span></div>`;
+}
+
 // Managed-jobs timeline: one bar per job from submitted_at to
 // end_at/now, colored by status (reference scope direction:
 // sky/dashboard jobs views).  Pure CSS bars — no chart library.
@@ -258,10 +279,18 @@ const PAGES = {
       // STOPPED cluster) degrade to a note, not a broken page.
       let util = '';
       try {
-        const m = (await apiGet(
-            `/api/cluster_metrics?cluster=${encodeURIComponent(arg)}`
-            )).metrics;
-        util = cards([
+        const resp = await apiGet(
+            `/api/cluster_metrics?cluster=${encodeURIComponent(arg)}`);
+        const m = resp.metrics;
+        const hist = resp.history || [];
+        const sparks =
+            sparkline(hist.map((s) => s.load1), 'load (1m)') +
+            sparkline(hist.map((s) => s.jobs_active), 'active jobs') +
+            sparkline(hist.map((s) => s.mem_used_bytes == null ? null :
+                +(s.mem_used_bytes / 2 ** 30).toFixed(2)),
+                'mem GiB');
+        util = (sparks ? `<div class="sparks">${sparks}</div>` : '') +
+            cards([
           [m.skytpu_agent_jobs_active ?? '-', 'active jobs'],
           [m.skytpu_agent_load1 ?? '-', 'load (1m)'],
           [`${gib(m.skytpu_agent_mem_used_bytes)} / ` +
@@ -274,6 +303,11 @@ const PAGES = {
         util = `<div class="empty">utilization unavailable ` +
             `(${esc(e.message)})</div>`;
       }
+      // Auto-poll while this page is showing (each poll appends one
+      // history sample server-side, filling the sparklines live) —
+      // scheduled OUTSIDE the try so a transiently unreachable agent
+      // does not permanently freeze the page.
+      schedulePagePoll('cluster', arg);
       return `<h3 class="mono">${esc(arg)}</h3>` + util + table(
         ['Job', 'Name', 'Status', 'Submitted', 'Actions'],
         jobs.map((j) => [
@@ -451,9 +485,22 @@ const PAGES = {
 // --- router ------------------------------------------------------------
 
 let currentPage = null;
+let pagePollTimer = null;
+
+// Re-render the page on an interval while the user stays on it (the
+// cluster page uses this to grow its utilization history); navigation
+// cancels the pending poll.
+function schedulePagePoll(page, arg, ms = 8000) {
+  clearTimeout(pagePollTimer);
+  pagePollTimer = setTimeout(() => {
+    const hash = (location.hash || '#clusters').slice(1);
+    if (hash === (arg == null ? page : `${page}/${arg}`)) navigate();
+  }, ms);
+}
 
 async function navigate() {
   stopLogTail();   // leaving the logs page must end its stream
+  clearTimeout(pagePollTimer);
   const hash = (location.hash || '#clusters').slice(1);
   // Routes: 'page' or 'page/arg' (e.g. cluster/<name>, logs/<c>/<id>).
   const slash = hash.indexOf('/');

@@ -52,6 +52,12 @@ class GeneratorConfig:
     # scan with cache in xs/ys.  Same math, different HBM traffic —
     # see llama_infer.decode_step_inplace.
     decode_impl: str = 'inplace'
+    # Chunked prefill (ContinuousBatcher only): prompts LONGER than
+    # this many tokens prefill in prefill_chunk-sized windows
+    # interleaved with decode ticks, so one long prompt cannot stall
+    # every in-flight generation for its full forward (the vLLM
+    # chunked-prefill scheduling idea).  None = whole-prompt prefill.
+    prefill_chunk: Optional[int] = None
 
 
 def prepare_params(params, gen_config: 'GeneratorConfig'):

@@ -168,6 +168,101 @@ def prefill(params: llama.Params, tokens: jax.Array,
     return logits, {'k': k_all, 'v': v_all}
 
 
+def prefill_window(params: llama.Params, tokens_w: jax.Array,
+                   config: llama.LlamaConfig, cache: Cache,
+                   slot: jax.Array, start: jax.Array
+                   ) -> Tuple[jax.Array, Cache]:
+    """Advance ONE slot's prefill by a fixed-size window (chunked
+    prefill): queries at positions [start, start+W) attend over the
+    slot's cache prefix plus the window itself; the window's K/V are
+    written into cache[:, slot, start:start+W).
+
+    Returns (hidden states (W, d) post-final-norm for the window,
+    updated cache).  W is static (one compile per window size); pad
+    tokens beyond the valid prompt are written to the cache but sit
+    ABOVE every later query/decode position's mask, so they are never
+    attended (the row's position bookkeeping stops at the true length).
+
+    This is the scheduler primitive behind
+    GeneratorConfig.prefill_chunk: a long prompt no longer stalls the
+    decode batch for its full forward — the batcher interleaves one
+    window per tick with decode chunks (the vLLM chunked-prefill
+    scheduling idea, expressed over the slot cache).
+    """
+    (w,) = tokens_w.shape
+    max_len = cache['k'].shape[2]
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, max_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, tokens_w[None], config)  # (1, W, d)
+    q_pos = start + jnp.arange(w, dtype=jnp.int32)          # (W,)
+    # Key j visible to query row i iff j <= start + i.
+    visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (W, max)
+    quantized = 'k_scale' in cache
+    dest = start + jnp.arange(w, dtype=jnp.int32)
+    group = config.n_heads // config.n_kv_heads
+    scale = config.head_dim ** -0.5
+
+    def body(i, carry):
+        h, cache = carry
+        layer_params = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
+                                                   keepdims=False),
+            params['layers'])
+        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)       # (1, W, H/KV, hd)
+        q = rope_ops.apply_rope(q, cos, sin, positions=q_pos[None])
+        k = rope_ops.apply_rope(k, cos, sin, positions=q_pos[None])
+        if quantized:
+            k_q, k_s = _quantize_kv(k[0])
+            v_q, v_s = _quantize_kv(v[0])
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, slot, dest].set(k_q),
+                v=cache['v'].at[i, slot, dest].set(v_q),
+                k_scale=cache['k_scale'].at[i, slot, dest].set(k_s),
+                v_scale=cache['v_scale'].at[i, slot, dest].set(v_s))
+            # Slice the SLOT first, then dequantize: converting the
+            # whole batch's cache per layer per window would read B x
+            # the needed bytes on the serving hot path.
+            k_layer = jax.lax.dynamic_index_in_dim(cache['k'], i, 0,
+                                                   False)
+            v_layer = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
+                                                   False)
+            ks_layer = jax.lax.dynamic_index_in_dim(
+                cache['k_scale'], i, 0, False)
+            vs_layer = jax.lax.dynamic_index_in_dim(
+                cache['v_scale'], i, 0, False)
+            k_slot = _dequantize(k_layer[slot], ks_layer[slot], q.dtype)
+            v_slot = _dequantize(v_layer[slot], vs_layer[slot], q.dtype)
+        else:
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, slot, dest].set(k[0]),
+                v=cache['v'].at[i, slot, dest].set(v[0]))
+            k_slot = jax.lax.dynamic_index_in_dim(cache['k'], i, 0,
+                                                  False)[slot]
+            v_slot = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
+                                                  False)[slot]
+        q_g = q[0].reshape(w, config.n_kv_heads, group, config.head_dim)
+        s = jnp.einsum('wkgd,skd->kgws', q_g, k_slot,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(visible[None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o = jnp.einsum('kgws,skd->wkgd', p, v_slot)
+        h = h + quant.matmul(o.reshape(1, w, -1), attn_p['wo'])
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                 eps=config.norm_eps)
+        h = h + _mlp(x, mlp_p, config.mlp_act)
+        return (h, cache)
+
+    h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    return h[0], cache
+
+
 def encode(params: llama.Params, tokens: jax.Array,
            config: llama.LlamaConfig, lengths: jax.Array) -> jax.Array:
     """Mean-pooled final hidden states (B, d) over each row's valid

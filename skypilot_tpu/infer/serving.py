@@ -52,6 +52,9 @@ class _Request:
     out: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
+    # Chunked prefill: tokens of the prompt already written to the
+    # slot cache (0 while queued; == len(prompt) when ready to decode).
+    prefill_pos: int = 0
 
 
 class ContinuousBatcher:
@@ -71,6 +74,11 @@ class ContinuousBatcher:
                                                prepare_params,
                                                validate_context)
         validate_context(gen_config, config)
+        if gen_config.prefill_chunk is not None and \
+                gen_config.prefill_chunk <= 0:
+            # Fail at construction, not inside the scheduler thread.
+            raise ValueError(f'prefill_chunk must be positive, got '
+                             f'{gen_config.prefill_chunk}')
         self.params = prepare_params(params, gen_config)
         self.config = config
         self.gen = gen_config
@@ -121,6 +129,15 @@ class ContinuousBatcher:
             self._decode_impl, top_k=gen_config.top_k),
             donate_argnums=(2,),
             static_argnames=('n', 'all_greedy', 'nucleus'))
+        # Chunked prefill (gen_config.prefill_chunk): one window of one
+        # long prompt per scheduler tick, interleaved with decode.
+        self._incremental: Optional[_Request] = None
+        self._prefill_window = jax.jit(
+            lambda p, t, c, s, st: llama_infer.prefill_window(
+                p, t, config, c, s, st),
+            donate_argnums=(2,))
+        self._install_first = jax.jit(functools.partial(
+            self._install_first_impl, top_k=gen_config.top_k))
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
@@ -185,6 +202,27 @@ class ContinuousBatcher:
         toks = tp_lib.replicate(jnp.swapaxes(toks, 0, 1), self.mesh)
         return toks, token, cache, positions, rng
 
+    def _install_first_impl(self, params, h_last, last_idx, token_row,
+                            pos_row, temp_row, top_p_row, length, slot,
+                            temp, top_p, rng, *, top_k):
+        """Finish a chunked prefill: logits at the prompt's last valid
+        window row -> sample the first token with the request's params
+        -> install token/position/sampling rows for its slot."""
+        from skypilot_tpu.infer import quant
+        h = jax.lax.dynamic_index_in_dim(h_last, last_idx, 0,
+                                         keepdims=True)
+        logits = quant.matmul(h, params['lm_head'],
+                              out_dtype=jnp.float32)
+        rng, sub = jax.random.split(rng)
+        first = tp_lib.replicate(sampling.sample_logits_batched(
+            logits, sub, temp[None], top_p[None], top_k=top_k)[0],
+            self.mesh)
+        token_row = token_row.at[slot].set(first)
+        pos_row = pos_row.at[slot].set(length)
+        temp_row = temp_row.at[slot].set(temp)
+        top_p_row = top_p_row.at[slot].set(top_p)
+        return token_row, pos_row, temp_row, top_p_row, first, rng
+
     # ---- public API ------------------------------------------------------
     def submit(self, prompt: Sequence[int],
                max_new_tokens: int = 64,
@@ -244,7 +282,11 @@ class ContinuousBatcher:
 
     @property
     def num_queued(self) -> int:
-        return len(self._queue)
+        # The in-flight chunked prefill counts as queued: it is not yet
+        # decoding, and every "is there work left" check (run_until_idle,
+        # the serve driver's busy test, the bench's pure-decode filter)
+        # must see it.
+        return len(self._queue) + (1 if self._incremental else 0)
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -259,7 +301,26 @@ class ContinuousBatcher:
         and G full forward launches)."""
         eos = self.gen.eos_token
 
+        chunk_w = self.gen.prefill_chunk
         while self._queue and self._free:
+            if chunk_w and len(self._queue[0].prompt) > chunk_w:
+                if self._incremental is not None:
+                    break    # one long prefill in flight; FIFO waits
+                request = self._queue.pop(0)
+                request.slot = self._free.pop(0)
+                self._incremental = request
+                # Park the slot's decode-garbage writes at the LAST
+                # cache row: lockstep decode advances EVERY slot and
+                # parking at 0 (the freed-slot convention) would let
+                # those writes clobber rows this prefill just wrote.
+                # Writes beyond max_len-1 clamp onto max_len-1, whose
+                # garbage is overwritten by the real write if the
+                # generation ever reaches it.
+                park = jnp.int32(self.gen.max_seq_len - 1)
+                self._positions = self._positions.at[
+                    request.slot].set(park)
+                self._host_pos[request.slot] = int(park)
+                continue
             group_size = self._admit_group
             bucket = self._bucket_for(len(self._queue[0].prompt))
             group: List[_Request] = []
@@ -332,10 +393,77 @@ class ContinuousBatcher:
             self._positions = self._positions.at[req.slot].set(0)
             self._host_pos[req.slot] = 0
 
+    def _advance_prefill(self) -> None:
+        """One window of the in-flight chunked prefill (at most one
+        long prompt at a time); on the final window, sample the first
+        token and promote the request to the decode batch."""
+        req = self._incremental
+        if req is None:
+            return
+        w = self.gen.prefill_chunk
+        start = req.prefill_pos
+        end = min(start + w, len(req.prompt))
+        window = np.zeros((w,), np.int32)
+        window[:end - start] = np.asarray(req.prompt[start:end],
+                                          np.int32)
+        try:
+            h_last, self._cache = self._prefill_window(
+                self.params, jnp.asarray(window), self._cache,
+                jnp.int32(req.slot), jnp.int32(start))
+        except Exception:
+            # Same contract as the grouped-admission handler: a failed
+            # dispatch must not leak the slot or leave _incremental set
+            # (the driver keeps serving after engine errors, and a
+            # stuck incremental would hot-retry the failing window
+            # every tick forever).  Restart-from-zero on re-queue: the
+            # slot's cache rows are rewritten wholesale anyway.
+            self._incremental = None
+            req.prefill_pos = 0
+            self._free.insert(0, req.slot)
+            req.slot = None
+            self._queue.insert(0, req)
+            raise
+        req.prefill_pos = end
+        if end < len(req.prompt):
+            return
+        default_temp = self.gen.temperature
+        default_top_p = self.gen.top_p if self.gen.top_p else 1.0
+        temp = (default_temp if req.temperature is None
+                else req.temperature)
+        top_p = default_top_p if req.top_p is None else req.top_p
+        try:
+            (self._token, self._positions, self._temp_row,
+             self._top_p_row, first, self._rng) = self._install_first(
+                self.params, h_last, jnp.int32(end - 1 - start),
+                self._token, self._positions, self._temp_row,
+                self._top_p_row, jnp.int32(len(req.prompt)),
+                jnp.int32(req.slot), jnp.float32(temp),
+                jnp.float32(top_p), self._rng)
+        except Exception:
+            self._incremental = None
+            req.prefill_pos = 0
+            self._free.insert(0, req.slot)
+            req.slot = None
+            self._queue.insert(0, req)
+            raise
+        self._host_pos[req.slot] = len(req.prompt)
+        self._host_temp[req.slot] = temp
+        self._host_top_p[req.slot] = top_p
+        self._incremental = None
+        eos = self.gen.eos_token
+        req.out.append(int(np.asarray(first)))
+        if (eos is not None and req.out[-1] == eos) or \
+                len(req.out) >= req.max_new_tokens:
+            self._finish(req)
+        else:
+            self._active[req.slot] = req
+
     def step(self) -> None:
-        """One scheduler tick: admit queued requests, then one decode
-        chunk for all active slots."""
+        """One scheduler tick: admit queued requests, advance the
+        in-flight chunked prefill by one window, then one decode chunk
+        for all active slots."""
         self._admit()
+        self._advance_prefill()
         if not self._active:
             return
         n = self.decode_chunk
@@ -369,7 +497,8 @@ class ContinuousBatcher:
 
     def run_until_idle(self, max_ticks: int = 10_000) -> None:
         for _ in range(max_ticks):
-            if not self._queue and not self._active:
+            if not self._queue and not self._active and \
+                    self._incremental is None:
                 return
             self.step()
         raise RuntimeError('run_until_idle exceeded max_ticks')

@@ -92,6 +92,9 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
                                        version_middleware,
                                        auth_lib.auth_middleware])
     routes = web.RouteTableDef()
+    # Per-app utilization history rings (cluster -> deque of samples)
+    # feeding the dashboard's sparklines; see api_cluster_metrics.
+    _metrics_history: dict = {}
 
     # Request names whose execution lands resources in a workspace; these
     # get a workspace-permission pre-check under auth enforcement
@@ -321,7 +324,22 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
                     gauges[name] = float(value)
                 except ValueError:
                     continue
-        return web.json_response({'cluster': cluster, 'metrics': gauges})
+        # Rolling in-server history ring so the dashboard's cluster
+        # page can draw utilization sparklines: each poll appends one
+        # sample (the SPA auto-refreshes the page, so history density
+        # follows viewing, costing nothing when nobody watches).
+        import collections
+        import time as time_lib
+        ring = _metrics_history.setdefault(
+            cluster, collections.deque(maxlen=120))
+        ring.append({
+            'ts': time_lib.time(),
+            'load1': gauges.get('skytpu_agent_load1'),
+            'jobs_active': gauges.get('skytpu_agent_jobs_active'),
+            'mem_used_bytes': gauges.get('skytpu_agent_mem_used_bytes'),
+        })
+        return web.json_response({'cluster': cluster, 'metrics': gauges,
+                                  'history': list(ring)})
 
     @routes.get('/api/request')
     async def api_request_detail(request: web.Request) -> web.Response:

@@ -263,3 +263,26 @@ def test_jobs_timeline_uses_live_fields(live):
     missing -= {f for f in missing
                 if re.search(rf'\.{f}\s*(\|\||\?\?)', tl)}
     assert not missing, (missing, sorted(row))
+
+
+def test_cluster_metrics_history_grows(live):
+    """Each /api/cluster_metrics poll appends one sample to the
+    server-side history ring; the SPA's sparklines read exactly these
+    fields."""
+    c, loop = live
+
+    async def _run():
+        r1 = await (await c.get(
+            '/api/cluster_metrics?cluster=dashc')).json()
+        r2 = await (await c.get(
+            '/api/cluster_metrics?cluster=dashc')).json()
+        return r1, r2
+
+    r1, r2 = loop.run_until_complete(asyncio.wait_for(_run(), 30))
+    assert len(r2['history']) == len(r1['history']) + 1
+    sample = r2['history'][-1]
+    # Fields the SPA maps over (app.js sparkline calls).
+    body = _page_bodies()['cluster']
+    wanted = set(re.findall(r'\bs\.(\w+)', body))
+    assert wanted <= set(sample), (wanted, sample)
+    assert sample['ts'] > 0

@@ -1,0 +1,56 @@
+"""Perplexity eval script (examples/scripts/eval_ppl.py)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+SCRIPT = os.path.join(os.path.dirname(__file__), '..', 'examples',
+                      'scripts', 'eval_ppl.py')
+
+
+def _run(args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS='cpu', XLA_FLAGS='')
+    return subprocess.run([sys.executable, SCRIPT] + args,
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+def test_eval_ppl_end_to_end(tmp_path):
+    corpus = tmp_path / 'corpus.txt'
+    corpus.write_text('the quick brown fox jumps over the lazy dog. '
+                      * 300)
+    proc = _run(['--data-file', str(corpus), '--seq-len', '32',
+                 '--batch-size', '2', '--max-batches', '3'])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    # Random debug weights over a 512 vocab: ppl near uniform (=512),
+    # way below the absurd and above 1.
+    assert 1.0 < out['perplexity'] < 5000.0
+    assert out['tokens'] == 3 * 2 * 32
+    # Deterministic re-run.
+    proc2 = _run(['--data-file', str(corpus), '--seq-len', '32',
+                  '--batch-size', '2', '--max-batches', '3'])
+    out2 = json.loads(proc2.stdout.strip().splitlines()[-1])
+    assert out2['nll'] == out['nll']
+
+
+def test_eval_ppl_jsonl_and_too_small(tmp_path):
+    small = tmp_path / 'small.txt'
+    small.write_text('tiny')
+    proc = _run(['--data-file', str(small), '--seq-len', '64'])
+    assert proc.returncode != 0
+    assert 'corpus too small' in proc.stdout + proc.stderr
+    jl = tmp_path / 'corpus.jsonl'
+    with open(jl, 'w', encoding='utf-8') as f:
+        for _ in range(40):
+            f.write(json.dumps({'text': 'some text for evaluation '
+                                        * 8}) + '\n')
+    proc = _run(['--data-file', str(jl), '--seq-len', '32',
+                 '--batch-size', '2', '--max-batches', '2'])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out['tokens'] == 2 * 2 * 32

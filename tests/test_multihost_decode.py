@@ -23,7 +23,8 @@ class FakeBatcher:
         self.num_active = 0
         self.num_queued = 0
 
-    def submit(self, prompt, max_new_tokens=64):
+    def submit(self, prompt, max_new_tokens=64, temperature=None,
+               top_p=None):
         self.calls.append(('submit', list(prompt), max_new_tokens))
         rid = self._next
         self._next += 1
@@ -165,7 +166,8 @@ def test_submit_validation_error_stays_local():
 
     class RejectingBatcher(FakeBatcher):
 
-        def submit(self, prompt, max_new_tokens=64):
+        def submit(self, prompt, max_new_tokens=64, temperature=None,
+                   top_p=None):
             raise ValueError('prompt too long')
 
     head_ch, worker_ch = _head_worker_pair()
