@@ -29,6 +29,10 @@ def main() -> None:
     parser.add_argument('--dp', type=int, default=0)
     parser.add_argument('--fsdp', type=int, default=0)
     parser.add_argument('--tp', type=int, default=1)
+    parser.add_argument('--ep', type=int, default=1,
+                        help='expert-parallel degree (Mixtral-family '
+                             'checkpoints only): shards the expert '
+                             'bank over the ep mesh axis')
     parser.add_argument('--learning-rate', type=float, default=1e-5)
     parser.add_argument('--loss-chunk', type=int, default=0,
                         help='blockwise-CE chunk (0 = full logits); use '
@@ -84,9 +88,17 @@ def main() -> None:
             return tokenizer(text)['input_ids']
         return [b % config.vocab_size for b in text.encode('utf-8')]
 
+    is_moe = hasattr(config, 'n_experts')
+    if args.ep > 1 and not is_moe:
+        raise SystemExit('--ep needs a Mixtral-family checkpoint')
+    # MoE param trees need the moe/* rules: under LLAMA_RULES the
+    # expert bank (the dominant parameter mass of a Mixtral) matches
+    # no pattern and would silently replicate per chip.
+    rules = sharding_lib.MOE_RULES if is_moe else sharding_lib.LLAMA_RULES
     n = jax.device_count()
-    dp = args.dp or max(1, n // (max(args.fsdp, 1) * args.tp))
-    mesh_config = MeshConfig(dp=dp, fsdp=max(args.fsdp, 1), tp=args.tp)
+    dp = args.dp or max(1, n // (max(args.fsdp, 1) * args.tp * args.ep))
+    mesh_config = MeshConfig(dp=dp, fsdp=max(args.fsdp, 1), tp=args.tp,
+                             ep=args.ep)
     mesh = make_mesh(mesh_config)
     batch_size = args.batch_size or max(2, dp * max(args.fsdp, 1))
     if jax.process_index() == 0:
@@ -109,7 +121,7 @@ def main() -> None:
         # Freeze the base: shard it over the mesh once; only adapters
         # go through the Trainer (its grads/Adam/checkpoints).
         base_params = sharding_lib.shard_params(
-            params, mesh, sharding_lib.LLAMA_RULES)
+            params, mesh, rules)
         adapters = lora_lib.init_lora(base_params, lcfg,
                                       jax.random.PRNGKey(1))
         if jax.process_index() == 0:
@@ -123,7 +135,7 @@ def main() -> None:
         lora_state = (base_params, lcfg)
     else:
         trainer = Trainer(base_loss, params, mesh,
-                          sharding_lib.LLAMA_RULES, train_config)
+                          rules, train_config)
 
     if args.resume == 'auto' and args.checkpoint_dir:
         import re

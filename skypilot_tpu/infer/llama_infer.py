@@ -100,6 +100,21 @@ def _mlp(x, mlp_p, act: str = 'silu'):
                         mlp_p['w_down'])
 
 
+def _ffn(x, layer_params, config):
+    """Per-layer feed-forward: dense gated MLP, or — when the layer
+    carries a Mixtral-style expert bank ('moe' subtree, models/moe.py)
+    — the exact dropless top-k MoE block.  Decode streams every
+    expert's weights from HBM regardless once B x top_k covers the
+    expert set, so the dense-dispatch formulation costs bandwidth
+    (the decode bound) nothing; expert weights stay model-dtype under
+    weights_dtype='int8' (quant._QUANT_PATH excludes them)."""
+    if 'moe' in layer_params:
+        from skypilot_tpu.models import moe as moe_lib
+        y, _ = moe_lib.moe_block_dense(x, layer_params['moe'], config)
+        return y
+    return _mlp(x, layer_params['mlp'], config.mlp_act)
+
+
 def prefill(params: llama.Params, tokens: jax.Array,
             config: llama.LlamaConfig, cache: Cache,
             lengths: jax.Array) -> Tuple[jax.Array, Cache]:
@@ -121,7 +136,7 @@ def prefill(params: llama.Params, tokens: jax.Array,
     quantized = 'k_scale' in cache
 
     def layer(h, layer_params):
-        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        attn_p = layer_params['attn']
         x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
                                  eps=config.norm_eps)
         q, k, v = _qkv(x, attn_p, config)
@@ -131,7 +146,7 @@ def prefill(params: llama.Params, tokens: jax.Array,
         h = h + quant.matmul(o.reshape(batch, seq, -1), attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
-        h = h + _mlp(x, mlp_p, config.mlp_act)
+        h = h + _ffn(x, layer_params, config)
         # Write this layer's K/V into the cache slot (padded region too —
         # masked out at decode time by the length mask).
         if quantized:
@@ -209,7 +224,7 @@ def prefill_window(params: llama.Params, tokens_w: jax.Array,
             lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
                                                    keepdims=False),
             params['layers'])
-        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        attn_p = layer_params['attn']
         x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
                                  eps=config.norm_eps)
         q, k, v = _qkv(x, attn_p, config)       # (1, W, H/KV, hd)
@@ -255,7 +270,7 @@ def prefill_window(params: llama.Params, tokens_w: jax.Array,
         h = h + quant.matmul(o.reshape(1, w, -1), attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
-        h = h + _mlp(x, mlp_p, config.mlp_act)
+        h = h + _ffn(x, layer_params, config)
         return (h, cache)
 
     h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
@@ -278,7 +293,7 @@ def encode(params: llama.Params, tokens: jax.Array,
                                      causal=True)
 
     def layer(h, layer_params):
-        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        attn_p = layer_params['attn']
         x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
                                  eps=config.norm_eps)
         q, k, v = _qkv(x, attn_p, config)
@@ -288,7 +303,7 @@ def encode(params: llama.Params, tokens: jax.Array,
         h = h + quant.matmul(o.reshape(batch, seq, -1), attn_p['wo'])
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
-        h = h + _mlp(x, mlp_p, config.mlp_act)
+        h = h + _ffn(x, layer_params, config)
         return h, None
 
     h, _ = jax.lax.scan(layer, h, params['layers'])
@@ -305,7 +320,7 @@ def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config):
     implementations (scan / inplace / unrolled), so a numerics fix
     lands in one place."""
     batch = h.shape[0]
-    attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+    attn_p = layer_params['attn']
     group = config.n_heads // config.n_kv_heads
     q_g = q.reshape(batch, 1, config.n_kv_heads, group, config.head_dim)
     scale = config.head_dim ** -0.5
@@ -317,7 +332,7 @@ def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config):
     h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
     x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                              eps=config.norm_eps)
-    return h + _mlp(x, mlp_p, config.mlp_act)
+    return h + _ffn(x, layer_params, config)
 
 
 def get_decode_fn(impl: str):
@@ -370,7 +385,7 @@ def decode_step_inplace(params: llama.Params, token: jax.Array,
             lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
                                                    keepdims=False),
             params['layers'])
-        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        attn_p = layer_params['attn']
         x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
                                  eps=config.norm_eps)
         q, k, v = _qkv(x, attn_p, config)
@@ -449,7 +464,7 @@ def decode_step_unrolled(params: llama.Params, token: jax.Array,
 
     for i in range(config.n_layers):
         layer_params = jax.tree.map(lambda x: x[i], params['layers'])
-        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        attn_p = layer_params['attn']
         x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
                                  eps=config.norm_eps)
         q, k, v = _qkv(x, attn_p, config)
@@ -511,7 +526,7 @@ def decode_step(params: llama.Params, token: jax.Array,
             layer_params, k_cache, v_cache, k_s, v_s = xs
         else:
             layer_params, k_cache, v_cache = xs
-        attn_p, mlp_p = layer_params['attn'], layer_params['mlp']
+        attn_p = layer_params['attn']
         x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
                                  eps=config.norm_eps)
         q, k, v = _qkv(x, attn_p, config)           # (B, 1, H/KV, D)

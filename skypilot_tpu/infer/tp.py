@@ -54,6 +54,17 @@ INFER_TP_RULES = PartitionRules([
     (r'attn/wo', P(None, ('tp', 'tpq'), None)),         # (L, heads*hd, d)
     (r'mlp/w_gate|mlp/w_up', P(None, None, ('tp', 'tpq'))),  # (L, d, ff)
     (r'mlp/w_down', P(None, ('tp', 'tpq'), None)),      # (L, ff, d)
+    # Mixtral expert bank (models/moe.py): megatron-shard each expert's
+    # ff axis, exactly like the dense mlp — every chip holds a 1/tp
+    # slice of EVERY expert, so routing needs no cross-chip token
+    # exchange and the combine's psum after w_down is the same one the
+    # dense path pays.  (Expert-parallel 'ep' sharding is the TRAINING
+    # layout, parallel/sharding.py MOE_RULES — for decode it would turn
+    # each token's top-k dispatch into an all-to-all on the latency
+    # path.)  The tiny router is replicated.
+    (r'moe/router', P()),                               # (L, d, E)
+    (r'moe/w_gate|moe/w_up', P(None, None, None, ('tp', 'tpq'))),
+    (r'moe/w_down', P(None, None, ('tp', 'tpq'), None)),
     (r'norm|ln', P()),
     (r'lm_head', P(None, ('tp', 'tpq'))),               # (d, vocab)
 ])

@@ -1,5 +1,5 @@
 """HuggingFace checkpoint → stacked-layer JAX pytree (Llama, Mistral,
-Gemma, Qwen2 families).
+Gemma, Qwen2, Mixtral families).
 
 The bridge from public HF weights to this framework's training
 (models/llama.py) and inference (infer/) paths: the reference's recipes
@@ -19,6 +19,12 @@ here conversion is library code with per-family config mapping
 - qwen2 (Qwen2/Qwen2.5): Llama layout + biases on the q/k/v
   projections (config.attn_bias); per-layer mixed sliding-window
   (use_sliding_window=True) is refused.
+- mixtral (Mixtral 8x7B/8x22B): sparse-MoE layers — block_sparse_moe
+  gate + per-expert w1/w3/w2 map onto the stacked expert bank of
+  models/moe.py (router (L,d,E), w_gate/w_up (L,E,d,ff), w_down
+  (L,E,ff,d)); router_impl defaults to 'dense' (exact dropless top-k,
+  HF-parity numerics) — override 'capacity' for efficient large-scale
+  finetunes that accept overflow drops.
 
 Layout notes:
 - HF `nn.Linear.weight` is (out_features, in_features); this framework
@@ -64,10 +70,12 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
             (k, float(v) if isinstance(v, (int, float)) else v)
             for k, v in scaling.items()))
     model_type = getattr(hf_config, 'model_type', 'llama')
-    if model_type not in ('llama', 'mistral', 'gemma', 'qwen2'):
+    if model_type not in ('llama', 'mistral', 'gemma', 'qwen2',
+                          'mixtral'):
         raise NotImplementedError(
             f'model_type {model_type!r} is not supported '
-            "(supported: 'llama', 'mistral', 'gemma', 'qwen2').")
+            "(supported: 'llama', 'mistral', 'gemma', 'qwen2', "
+            "'mixtral').")
 
     hf_head_dim = getattr(hf_config, 'head_dim', None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
@@ -95,7 +103,7 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
             raise NotImplementedError(f'gemma activation {act!r}')
         family = {'mlp_act': 'gelu_tanh',
                   'embed_scale': float(hf_config.hidden_size) ** 0.5}
-    elif model_type == 'mistral':
+    elif model_type in ('mistral', 'mixtral'):
         window = getattr(hf_config, 'sliding_window', None)
         if window is not None:
             explicit = overrides.get('max_seq_len')
@@ -115,7 +123,23 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
                 family['max_seq_len'] = int(window)
 
     family.setdefault('max_seq_len', hf_config.max_position_embeddings)
-    cfg = llama.LlamaConfig(
+    config_cls = llama.LlamaConfig
+    if model_type == 'mixtral':
+        from skypilot_tpu.models import moe
+        config_cls = moe.MoeConfig
+        family.update(
+            n_experts=hf_config.num_local_experts,
+            top_k=hf_config.num_experts_per_tok,
+            router_aux_weight=float(getattr(
+                hf_config, 'router_aux_loss_coef', 0.02)),
+            # Exact dropless routing by default: a converted checkpoint
+            # must reproduce the source model's numerics (the capacity
+            # formulation drops overflow tokens — fine for from-scratch
+            # training, wrong for serving/eval of released weights).
+            # Override router_impl='capacity' for large-scale finetunes
+            # that accept drops for the efficient dispatch.
+            router_impl='dense')
+    cfg = config_cls(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,
@@ -174,6 +198,34 @@ def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
         lm_head = cast(get(f'{prefix}embed_tokens.weight').T)
 
     L = prefix + 'layers.{}.'
+
+    def stack_experts(fmt: str) -> jnp.ndarray:
+        """Mixtral expert bank: {i} layers x {e} experts of HF (out, in)
+        linears -> (L, E, in, out) input-major, matching
+        moe.init_params."""
+        n_experts = getattr(config, 'n_experts')
+        return cast(np.stack([
+            np.stack([np.asarray(get(fmt.format(i, e)), np.float32).T
+                      for e in range(n_experts)])
+            for i in range(config.n_layers)]))
+
+    if hasattr(config, 'n_experts'):
+        # Mixtral block_sparse_moe: gate.weight (E, d) routers and
+        # per-expert w1 (gate) / w3 (up) / w2 (down) linears.
+        M = L + 'block_sparse_moe.'
+        ffn = {'moe': {
+            'router': stack(M + 'gate.weight'),           # (L, d, E)
+            'w_gate': stack_experts(M + 'experts.{}.w1.weight'),
+            'w_up': stack_experts(M + 'experts.{}.w3.weight'),
+            'w_down': stack_experts(M + 'experts.{}.w2.weight'),
+        }}
+    else:
+        ffn = {'mlp': {
+            'w_gate': stack(L + 'mlp.gate_proj.weight'),
+            'w_up': stack(L + 'mlp.up_proj.weight'),
+            'w_down': stack(L + 'mlp.down_proj.weight'),
+        }}
+
     return {
         'embed': embed,
         'layers': {
@@ -194,11 +246,7 @@ def hf_state_dict_to_params(state_dict: Dict[str, np.ndarray],
                                 transpose=False)}
                    if config.attn_bias else {}),
             },
-            'mlp': {
-                'w_gate': stack(L + 'mlp.gate_proj.weight'),
-                'w_up': stack(L + 'mlp.up_proj.weight'),
-                'w_down': stack(L + 'mlp.down_proj.weight'),
-            },
+            **ffn,
         },
         'final_norm': cast(get(f'{prefix}norm.weight')
                            + np.float32(norm_offset)),
@@ -358,9 +406,12 @@ def load_hf_model_sharded(model_dir: str, mesh, rules,
             'embed_tokens.weight' in reader:
         prefix = ''
 
-    abstract = jax.eval_shape(
-        functools.partial(llama.init_params, config),
-        jax.random.PRNGKey(0))
+    if hasattr(config, 'n_experts'):
+        from skypilot_tpu.models import moe
+        init_fn = functools.partial(moe.init_params, config)
+    else:
+        init_fn = functools.partial(llama.init_params, config)
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     specs = rules.tree_specs(abstract)
 
     def sharding_for(path_tuple):
@@ -400,7 +451,9 @@ def load_hf_model_sharded(model_dir: str, mesh, rules,
         return jax.device_put(np.asarray(host_array, dtype),
                               sharding_for(path_tuple))
 
-    params: Params = {'layers': {'attn': {}, 'mlp': {}}}
+    params: Params = {'layers': {'attn': {}}}
+    if not hasattr(config, 'n_experts'):
+        params['layers']['mlp'] = {}
     embed_host = host_tensor(f'{prefix}embed_tokens.weight', False, 0.0)
     params['embed'] = put(embed_host, ('embed',))
     if 'lm_head.weight' in reader:
@@ -413,8 +466,18 @@ def load_hf_model_sharded(model_dir: str, mesh, rules,
         host_tensor(f'{prefix}norm.weight', False, norm_offset),
         ('final_norm',))
 
-    stacked = _STACKED_LEAVES + (
-        _STACKED_BIAS_LEAVES if config.attn_bias else [])
+    is_moe = hasattr(config, 'n_experts')
+    stacked = list(_STACKED_LEAVES)
+    if is_moe:
+        # Mixtral: no dense mlp leaves; the router streams per-layer
+        # like any stacked leaf, the expert banks per (layer, expert).
+        stacked = [lf for lf in stacked if lf[0][1] != 'mlp']
+        stacked.append((
+            ('layers', 'moe', 'router'),
+            '{p}layers.{i}.block_sparse_moe.gate.weight', True, False))
+        params['layers']['moe'] = {}
+    if config.attn_bias:
+        stacked += _STACKED_BIAS_LEAVES
     for path_tuple, template, transpose, is_norm in stacked:
         buf = alloc(path_tuple)
         for i in range(config.n_layers):
@@ -426,4 +489,32 @@ def load_hf_model_sharded(model_dir: str, mesh, rules,
         for key in path_tuple[:-1]:
             node = node[key]
         node[path_tuple[-1]] = buf
+
+    if is_moe:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def set_expert(buf, x, i, e):
+            # Traced (i, e) scalars: ONE compile for the whole bank,
+            # not one per (layer, expert) pair.
+            return jax.lax.dynamic_update_slice(
+                buf, x.astype(buf.dtype)[None, None], (i, e, 0, 0))
+
+        moe_leaves = [
+            (('layers', 'moe', 'w_gate'),
+             '{p}layers.{i}.block_sparse_moe.experts.{e}.w1.weight'),
+            (('layers', 'moe', 'w_up'),
+             '{p}layers.{i}.block_sparse_moe.experts.{e}.w3.weight'),
+            (('layers', 'moe', 'w_down'),
+             '{p}layers.{i}.block_sparse_moe.experts.{e}.w2.weight'),
+        ]
+        for path_tuple, template in moe_leaves:
+            buf = alloc(path_tuple)
+            for i in range(config.n_layers):
+                for e in range(config.n_experts):
+                    w = host_tensor(
+                        template.format(p=prefix, i=i, e=e), True, 0.0)
+                    buf = set_expert(buf, w, i, e)
+            node = params
+            for key in path_tuple[:-1]:
+                node = node[key]
+            node[path_tuple[-1]] = buf
     return params, config

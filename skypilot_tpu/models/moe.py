@@ -33,6 +33,15 @@ class MoeConfig(llama.LlamaConfig):
     top_k: int = 2
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
+    # 'capacity': GShard dense-dispatch with per-expert capacity
+    # (tokens past capacity are dropped — the efficient TRAINING
+    # formulation; static shapes, all-to-all under 'ep').
+    # 'dense': exact dropless top-k — every expert computes every
+    # token, combine weights zero out the unchosen (E x the FLOPs but
+    # bit-exact vs HF Mixtral; the EVAL/inference formulation, and
+    # what infer/ uses for decode where weight streaming, not FLOPs,
+    # is the bound).
+    router_impl: str = 'capacity'
 
     def num_params(self) -> int:
         d, ff, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
@@ -118,10 +127,58 @@ def top_k_gating(router_logits: jax.Array, top_k: int, capacity: int
     return dispatch, combine, aux_loss
 
 
+def moe_block_dense(x: jax.Array, moe_params: Params, config: MoeConfig
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Exact dropless top-k MoE: every expert computes every token and
+    the combine weights zero out the unchosen experts.
+
+    Matches HF Mixtral semantics bit-for-bit (softmax over ALL experts,
+    take top-k, renormalize the chosen weights to sum to 1) with fully
+    static shapes — the property XLA needs — at the cost of E x the
+    FLOPs of the chosen path.  That trade is right for:
+    - decode (infer/): one token per slot is weight-bandwidth-bound and
+      every expert's weights stream from HBM regardless once B x top_k
+      covers most experts;
+    - eval / checkpoint-parity testing, where capacity drops would make
+      converted-weight logits diverge from the source model.
+    Training at scale keeps the 'capacity' formulation (moe_block).
+    """
+    gates = jax.nn.softmax(
+        (x @ moe_params['router']).astype(jnp.float32), axis=-1)
+    top_w, top_idx = jax.lax.top_k(gates, config.top_k)      # (B,S,k)
+    top_w = top_w / jnp.maximum(
+        jnp.sum(top_w, axis=-1, keepdims=True), 1e-9)
+    # (B,S,E) combine weights, zero where the expert was not chosen.
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, config.n_experts, dtype=jnp.float32)
+        * top_w[..., None], axis=-2)
+    up = jnp.einsum('bsd,edf->ebsf', x, moe_params['w_up'])
+    gate = llama.gate_activation(
+        jnp.einsum('bsd,edf->ebsf', x, moe_params['w_gate']),
+        config.mlp_act)
+    expert_out = jnp.einsum('ebsf,efd->ebsd', gate * up,
+                            moe_params['w_down'])
+    y = jnp.einsum('bse,ebsd->bsd', combine.astype(x.dtype), expert_out)
+    # Same load-balance statistic as the capacity path so training
+    # curves stay comparable if someone trains with router_impl='dense'.
+    top1 = jnp.argmax(gates, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(
+        top1, config.n_experts, dtype=jnp.float32), axis=(0, 1))
+    frac_gates = jnp.mean(gates, axis=(0, 1))
+    aux = config.n_experts * jnp.sum(frac_tokens * frac_gates)
+    return y, aux
+
+
 def moe_block(x: jax.Array, moe_params: Params, config: MoeConfig
               ) -> Tuple[jax.Array, jax.Array]:
     """x (B, S, d) -> (y (B, S, d), aux_loss).  Expert einsums carry the
     E axis; with E sharded over 'ep' XLA inserts the token all-to-all."""
+    if config.router_impl == 'dense':
+        return moe_block_dense(x, moe_params, config)
+    if config.router_impl != 'capacity':
+        raise ValueError(
+            f"router_impl must be 'capacity' or 'dense', "
+            f'got {config.router_impl!r}')
     batch, seq, d = x.shape
     capacity = max(1, int(config.top_k * seq * config.capacity_factor /
                           config.n_experts))
@@ -163,16 +220,20 @@ def _layer(carry, layer_params: Params, *, config: MoeConfig,
     return (h + y, aux_acc + aux), None
 
 
-def forward(params: Params, tokens: jax.Array, config: MoeConfig,
-            attention_fn=None) -> Tuple[jax.Array, jax.Array]:
-    """tokens (B, S) -> (logits (B,S,V) f32, aux_loss scalar)."""
+def hidden_states(params: Params, tokens: jax.Array, config: MoeConfig,
+                  attention_fn=None) -> Tuple[jax.Array, jax.Array]:
+    """tokens (B, S) -> (post-final-norm hidden states (B, S, d),
+    mean router aux loss) — the MoE analog of llama.hidden_states, so
+    blockwise-CE losses (SFT and friends) can apply the head
+    chunk-wise without materializing full logits."""
     if attention_fn is None:
         attention_fn = functools.partial(attention_ops.flash_attention,
                                          causal=True)
     seq_len = tokens.shape[1]
-    cos, sin = rope_ops.rope_frequencies(config.head_dim, seq_len,
-                                         config.rope_theta)
-    h = params['embed'][tokens]
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, seq_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, tokens, config)
 
     layer_fn = functools.partial(_layer, config=config, cos=cos, sin=sin,
                                  attention_fn=attention_fn)
@@ -182,8 +243,15 @@ def forward(params: Params, tokens: jax.Array, config: MoeConfig,
                                (h, jnp.zeros((), jnp.float32)),
                                params['layers'])
     h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    return h, aux / config.n_layers
+
+
+def forward(params: Params, tokens: jax.Array, config: MoeConfig,
+            attention_fn=None) -> Tuple[jax.Array, jax.Array]:
+    """tokens (B, S) -> (logits (B,S,V) f32, aux_loss scalar)."""
+    h, aux = hidden_states(params, tokens, config, attention_fn)
     logits = (h @ params['lm_head']).astype(jnp.float32)
-    return logits, aux / config.n_layers
+    return logits, aux
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array], config: MoeConfig,

@@ -30,7 +30,18 @@ def sft_loss_fn(params, batch: Dict[str, jax.Array],
     'loss_mask': (B, S)} — mask[b, j] gates the loss on TARGET
     tokens[b, j+1] (1.0 for completion tokens, 0.0 for prompt/pad)."""
     tokens, mask = batch['tokens'], batch['loss_mask']
-    if config.loss_chunk:
+    aux = None
+    if hasattr(config, 'n_experts'):
+        # Mixtral-family (models/moe.py): the trunk also yields the
+        # router load-balance aux loss, weighted in below so finetunes
+        # keep the expert assignment healthy.
+        from skypilot_tpu.models import moe
+        h, aux = moe.hidden_states(params, tokens[:, :-1], config,
+                                   attention_fn=attention_fn)
+        lp = losses_ops.chunked_token_logprobs(
+            h, params['lm_head'], tokens[:, 1:],
+            chunk_size=config.loss_chunk or tokens.shape[1])
+    elif config.loss_chunk:
         h = llama.hidden_states(params, tokens[:, :-1], config,
                                 attention_fn=attention_fn)
         lp = losses_ops.chunked_token_logprobs(
@@ -41,7 +52,10 @@ def sft_loss_fn(params, batch: Dict[str, jax.Array],
                                attention_fn=attention_fn)
         lp = losses_ops.token_logprobs(logits, tokens[:, 1:])
     mask = mask.astype(lp.dtype)
-    return -(lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    loss = -(lp * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    if aux is not None:
+        loss = loss + config.router_aux_weight * aux
+    return loss
 
 
 def encode_example(prompt_ids: List[int], completion_ids: List[int],

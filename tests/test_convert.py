@@ -417,3 +417,120 @@ def test_sharded_load_qwen2_biases(tmp_path, hf_qwen2):
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-2),
         params, full_params)
+
+
+# --- Mixtral (sparse MoE) family ---
+
+@pytest.fixture(scope='module')
+def hf_mixtral():
+    cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=256,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation='eager')
+    torch.manual_seed(3)
+    model = transformers.MixtralForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_mixtral_config_mapping(hf_mixtral):
+    from skypilot_tpu.models import moe
+    cfg = convert.config_from_hf(hf_mixtral.config, dtype=jnp.float32)
+    assert isinstance(cfg, moe.MoeConfig)
+    assert cfg.n_experts == 4 and cfg.top_k == 2
+    # Exact dropless routing by default: converted checkpoints must
+    # reproduce the source numerics (capacity routing drops tokens).
+    assert cfg.router_impl == 'dense'
+
+
+def test_mixtral_param_tree_matches_init_shapes(hf_mixtral):
+    from skypilot_tpu.models import moe
+    cfg = convert.config_from_hf(hf_mixtral.config, dtype=jnp.float32)
+    params = convert.hf_state_dict_to_params(hf_mixtral.state_dict(),
+                                             cfg)
+    ref = moe.init_params(cfg, jax.random.PRNGKey(0))
+    assert jax.tree.map(lambda x: x.shape, params) == \
+        jax.tree.map(lambda x: x.shape, ref)
+
+
+def test_mixtral_forward_logits_match_transformers(hf_mixtral):
+    from skypilot_tpu.models import moe
+    cfg = convert.config_from_hf(hf_mixtral.config, dtype=jnp.float32)
+    params = convert.hf_state_dict_to_params(hf_mixtral.state_dict(),
+                                             cfg)
+    tokens = np.array([[5, 9, 42, 7, 100, 3, 64, 28]], np.int32)
+    with torch.no_grad():
+        hf_logits = hf_mixtral(torch.from_numpy(tokens).long()
+                               ).logits.float().numpy()
+    logits, _ = moe.forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(logits), hf_logits,
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_mixtral_generate_matches_transformers_greedy(hf_mixtral):
+    """Engine decode (prefill + KV-cache decode via the dense-dispatch
+    MoE FFN) over converted weights reproduces HF greedy."""
+    from skypilot_tpu.infer import Generator, GeneratorConfig
+    cfg = convert.config_from_hf(hf_mixtral.config, dtype=jnp.float32)
+    params = convert.hf_state_dict_to_params(hf_mixtral.state_dict(),
+                                             cfg)
+    prompt = [5, 9, 42, 7]
+    n_new = 6
+    with torch.no_grad():
+        hf_out = hf_mixtral.generate(
+            torch.tensor([prompt]).long(), max_new_tokens=n_new,
+            do_sample=False, num_beams=1,
+            eos_token_id=None)  # compare raw continuations, no early eos
+    want = hf_out[0, len(prompt):].tolist()
+    gen = Generator(params, cfg,
+                    GeneratorConfig(max_seq_len=64, batch_size=1,
+                                    prompt_buckets=[16]))
+    got = gen.generate([prompt], max_new_tokens=n_new)[0]
+    assert got == want
+
+
+def test_mixtral_dense_routing_matches_capacity_when_no_drops(
+        hf_mixtral):
+    """With generous capacity the GShard training formulation and the
+    exact dense formulation agree — the two routers implement the same
+    math, differing only in overflow handling."""
+    import dataclasses
+    from skypilot_tpu.models import moe
+    cfg = convert.config_from_hf(hf_mixtral.config, dtype=jnp.float32)
+    params = convert.hf_state_dict_to_params(hf_mixtral.state_dict(),
+                                             cfg)
+    tokens = jnp.asarray(
+        np.array([[5, 9, 42, 7, 100, 3, 64, 28]], np.int32))
+    dense_logits, _ = moe.forward(params, tokens, cfg)
+    cap_cfg = dataclasses.replace(cfg, router_impl='capacity',
+                                  capacity_factor=float(cfg.n_experts))
+    cap_logits, _ = moe.forward(params, tokens, cap_cfg)
+    np.testing.assert_allclose(np.asarray(dense_logits),
+                               np.asarray(cap_logits),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_sharded_load_mixtral_expert_bank(tmp_path, hf_mixtral):
+    """The streaming loader fills the (L, E, ..) expert leaves and the
+    router, matching the full host-side load, already tp-sharded."""
+    from skypilot_tpu.infer import tp as tp_lib
+    model_dir = str(tmp_path / 'mixtral_ckpt')
+    hf_mixtral.save_pretrained(model_dir, safe_serialization=True)
+    full_params, full_cfg = convert.load_hf_model(model_dir,
+                                                  dtype=jnp.float32)
+    mesh = tp_lib.make_tp_mesh(2, n_kv_heads=full_cfg.n_kv_heads)
+    params, cfg = convert.load_hf_model_sharded(
+        model_dir, mesh, tp_lib.INFER_TP_RULES, dtype=jnp.float32)
+    assert cfg == full_cfg
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-2),
+        params, full_params)
+    w_gate = params['layers']['moe']['w_gate']
+    assert w_gate.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(
+            mesh,
+            jax.sharding.PartitionSpec(None, None, None,
+                                       ('tp', 'tpq'))), 4)
