@@ -432,6 +432,50 @@ def bench_launch_latency() -> dict:
                 'error': combined[-300:]}
 
 
+def build_headline(tok_s: float, mfu: float, llama8b: dict,
+                   decode: dict, latency: dict) -> dict:
+    """Compact tail-safe summary of every north-star number (VERDICT r4
+    weak #1: the full JSON's leading metrics fell out of the driver's
+    tail capture — this dict is printed LAST as `BENCH_HEADLINE {...}`
+    so any tail capture contains the complete headline set)."""
+    def _decode_brief(d):
+        if not isinstance(d, dict):
+            return None
+        if 'error' in d:
+            return {'error': str(d['error'])[:120]}
+        brief = {}
+        for variant in ('bf16', 'int8_kv', 'int8_w_kv'):
+            v = d.get(variant)
+            if isinstance(v, dict):
+                brief[variant] = {
+                    'e2e_tok_s': v.get('decode_tok_s'),
+                    'steady_tok_s': v.get('steady_decode_tok_s'),
+                    'roofline_pct': v.get('roofline_pct'),
+                    'steady_roofline_pct': v.get('steady_roofline_pct'),
+                }
+        return brief
+
+    headline = {
+        'llama_1b_tok_s_chip': round(tok_s, 1),
+        'llama_1b_mfu_pct': round(100 * mfu, 1),
+        'llama_8b_tok_s_chip': llama8b.get('tok_s_chip_extrapolated'),
+        'llama_8b_mfu_pct': llama8b.get('mfu_pct'),
+        'llama_8b_extrapolation_check_pct':
+            llama8b.get('extrapolation_check_pct'),
+        'decode': _decode_brief(decode),
+        'launch_to_first_line_s': (latency or {}).get(
+            'launch_to_first_line_s'),
+        'vs_baseline': round(tok_s / TARGET_TOKENS_PER_SEC_PER_CHIP, 3),
+    }
+    if 'suspect' in llama8b:
+        headline['llama_8b_suspect'] = llama8b['suspect']
+    if 'error' in llama8b:
+        headline['llama_8b_error'] = str(llama8b['error'])[:120]
+    if latency and 'error' in latency:
+        headline['launch_latency_error'] = str(latency['error'])[:120]
+    return headline
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -506,7 +550,7 @@ def main() -> None:
     peak = 197e12 if on_tpu else 1e12
     mfu = tok_s * flops_per_token / peak
 
-    print(json.dumps({
+    full = {
         'metric': 'llama_1b_train_tokens_per_sec_per_chip',
         'value': round(tok_s, 1),
         'unit': 'tokens/s/chip',
@@ -533,7 +577,16 @@ def main() -> None:
                       'extrapolation method otherwise unchanged from '
                       'r3 (chained SGD fori_loop, (1,2)-layer slope + '
                       'head, matmul-params MFU convention)')},
-    }))
+    }
+    print(json.dumps(full))
+    # HEADLINE line LAST: the driver records only the output TAIL, and in
+    # r4 the full JSON grew enough that its leading headline metrics fell
+    # out of the captured window (VERDICT r4 weak #1).  This compact
+    # summary is printed after the full record so a tail capture of any
+    # reasonable size always contains every north-star number; the full
+    # JSON above remains the authoritative detailed artifact.
+    print('BENCH_HEADLINE ' + json.dumps(
+        build_headline(tok_s, mfu, llama8b, decode, latency)))
 
 
 if __name__ == '__main__':

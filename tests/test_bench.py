@@ -26,6 +26,57 @@ def test_allreduce_multirank_measures_and_bounds():
         assert 'suspect' in out
 
 
+def test_headline_contains_every_north_star_number():
+    """VERDICT r4 weak #1: the headline summary printed LAST must carry
+    the full north-star set so the driver's tail capture is auditable."""
+    h = bench.build_headline(
+        tok_s=12345.6, mfu=0.585,
+        llama8b={'tok_s_chip_extrapolated': 2358.0, 'mfu_pct': 53.9,
+                 'extrapolation_check_pct': 2.1},
+        decode={'bf16': {'decode_tok_s': 2910.9,
+                         'steady_decode_tok_s': 3864.0,
+                         'roofline_pct': 43.7,
+                         'steady_roofline_pct': 58.0},
+                'int8_kv': {'decode_tok_s': 2900.0,
+                            'steady_decode_tok_s': 3861.0,
+                            'roofline_pct': 41.0,
+                            'steady_roofline_pct': 55.5},
+                'int8_w_kv': {'decode_tok_s': 4000.0,
+                              'steady_decode_tok_s': 5043.0,
+                              'roofline_pct': 32.0,
+                              'steady_roofline_pct': 40.8}},
+        latency={'launch_to_first_line_s': 6.08})
+    assert h['llama_1b_tok_s_chip'] == 12345.6
+    assert h['llama_1b_mfu_pct'] == 58.5
+    assert h['llama_8b_tok_s_chip'] == 2358.0
+    assert h['llama_8b_mfu_pct'] == 53.9
+    assert h['llama_8b_extrapolation_check_pct'] == 2.1
+    for variant in ('bf16', 'int8_kv', 'int8_w_kv'):
+        v = h['decode'][variant]
+        assert v['e2e_tok_s'] and v['steady_tok_s']
+        assert v['roofline_pct'] and v['steady_roofline_pct']
+    assert h['launch_to_first_line_s'] == 6.08
+    assert 'llama_8b_suspect' not in h
+    # Round-trips through a single JSON line (the tail contract).
+    import json
+    line = 'BENCH_HEADLINE ' + json.dumps(h)
+    assert '\n' not in line
+    assert json.loads(line.split(' ', 1)[1]) == h
+
+
+def test_headline_surfaces_suberrors():
+    h = bench.build_headline(
+        tok_s=1.0, mfu=0.1, llama8b={'error': 'x' * 500},
+        decode={'error': 'y' * 500}, latency=None)
+    assert len(h['llama_8b_error']) == 120
+    assert len(h['decode']['error']) == 120
+    assert h['launch_to_first_line_s'] is None
+    h2 = bench.build_headline(
+        tok_s=1.0, mfu=0.1, llama8b={}, decode={},
+        latency={'launch_to_first_line_s': None, 'error': 'timeout'})
+    assert h2['launch_latency_error'] == 'timeout'
+
+
 @pytest.mark.slow
 def test_8b_extrapolation_reports_check_and_convention():
     out = bench.bench_8b_extrapolated(on_tpu=False)
