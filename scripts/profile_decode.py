@@ -1,0 +1,111 @@
+"""Decode step-time breakdown on the real chip (round-5 task 1 probe).
+
+Ablations that localize the gap between measured steady decode and the
+HBM roofline (BENCH_r04: 58% of the avg-context bound):
+  A. step time vs n_layers (1, 8, 16)  -> per-layer slope + fixed cost
+  B. per-layer slope vs cache max_len (64, 192, 384) -> KV-read share
+  C. expected weight-stream time per layer (bytes / 819 GB/s) vs slope
+Prints one JSON line per measurement.
+"""
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.infer import llama_infer, sampling
+from skypilot_tpu.models import llama
+
+SLOTS = 16
+CHUNK = 64
+HBM_BW = 819e9
+
+
+def _roundtrip() -> float:
+    f = jax.jit(lambda a: a.sum())
+    x = jnp.ones((8,), jnp.float32)
+    float(f(x))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(f(x))
+    return (time.perf_counter() - t0) / 3
+
+
+def time_decode(config, max_len, n=CHUNK, repeats=3):
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    cache = llama_infer.init_cache(config, SLOTS, max_len)
+    token = jnp.zeros((SLOTS,), jnp.int32)
+    positions = jnp.full((SLOTS,), max_len // 2, jnp.int32)
+
+    @jax.jit
+    def run(params, token, cache, positions):
+        def step(carry, _):
+            token, cache, positions = carry
+            logits, cache = llama_infer.decode_step_inplace(
+                params, token, config, cache, positions)
+            nxt = sampling.sample_logits(logits, jax.random.PRNGKey(0),
+                                         temperature=0.0)
+            return (nxt, cache, positions), nxt
+
+        (token, cache, positions), toks = jax.lax.scan(
+            step, (token, cache, positions), None, length=n)
+        return jnp.sum(toks[..., :1]) + jnp.sum(token)
+
+    rt = _roundtrip()
+    float(run(params, token, cache, positions))
+    best = float('inf')
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(run(params, token, cache, positions))
+        best = min(best, time.perf_counter() - t0)
+    del params, cache
+    return max((best - rt) / n, 1e-9)
+
+
+def main():
+    base = llama.LLAMA_1B
+    on_tpu = jax.devices()[0].platform == 'tpu'
+    if not on_tpu:
+        base = llama.LLAMA_DEBUG
+        print(json.dumps({'warning': 'not on tpu — debug shapes'}))
+
+    layer_bytes = 2 * (base.num_params()
+                       - 2 * base.vocab_size * base.d_model) \
+        / base.n_layers
+    head_bytes = 2 * base.vocab_size * base.d_model
+    print(json.dumps({'layer_weight_mb': round(layer_bytes / 1e6, 1),
+                      'lm_head_mb': round(head_bytes / 1e6, 1),
+                      'ideal_layer_stream_ms':
+                          round(1e3 * layer_bytes / HBM_BW, 4)}))
+
+    # A: layers sweep at fixed max_len
+    results = {}
+    for nl in (1, 8, 16):
+        cfg = dataclasses.replace(base, n_layers=nl)
+        dt = time_decode(cfg, 384)
+        results[nl] = dt
+        print(json.dumps({'ablation': 'layers', 'n_layers': nl,
+                          'max_len': 384,
+                          'step_ms': round(1e3 * dt, 4)}))
+    slope = (results[16] - results[8]) / 8
+    fixed = results[1] - slope
+    print(json.dumps({'per_layer_ms': round(1e3 * slope, 4),
+                      'fixed_ms': round(1e3 * fixed, 4),
+                      'ideal_layer_ms':
+                          round(1e3 * layer_bytes / HBM_BW, 4),
+                      'kv_read_mb_384': round(
+                          2 * 2 * SLOTS * 384 * base.n_kv_heads
+                          * base.head_dim / 1e6, 1)}))
+
+    # B: cache length sweep at full depth
+    for ml in (64, 192, 384, 768):
+        dt = time_decode(base, ml)
+        print(json.dumps({'ablation': 'max_len', 'max_len': ml,
+                          'step_ms': round(1e3 * dt, 4),
+                          'tok_s': round(SLOTS / dt, 1)}))
+
+
+if __name__ == '__main__':
+    main()
