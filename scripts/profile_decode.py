@@ -3,7 +3,7 @@
 Ablations that localize the gap between measured steady decode and the
 HBM roofline (BENCH_r04: 58% of the avg-context bound):
   A. step time vs n_layers (1, 8, 16)  -> per-layer slope + fixed cost
-  B. per-layer slope vs cache max_len (64, 192, 384) -> KV-read share
+  B. per-layer slope vs cache max_len (64, 192, 384, 768) -> KV-read share
   C. expected weight-stream time per layer (bytes / 819 GB/s) vs slope
 Prints one JSON line per measurement.
 """
@@ -37,7 +37,10 @@ def time_decode(config, max_len, n=CHUNK, repeats=3):
     params = llama.init_params(config, jax.random.PRNGKey(0))
     cache = llama_infer.init_cache(config, SLOTS, max_len)
     token = jnp.zeros((SLOTS,), jnp.int32)
-    positions = jnp.full((SLOTS,), max_len // 2, jnp.int32)
+    # Constant across the max_len sweep: the inplace kernel attends over
+    # the full cache regardless of position, so varying positions with
+    # max_len would conflate rotary/window effects with KV-read cost.
+    positions = jnp.full((SLOTS,), 32, jnp.int32)
 
     @jax.jit
     def run(params, token, cache, positions):

@@ -24,10 +24,20 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.agent import job_lib
+from skypilot_tpu.telemetry import steplog
+from skypilot_tpu.telemetry import trace as trace_lib
 from skypilot_tpu.utils import env_contract
+from skypilot_tpu.utils import timeline
 from skypilot_tpu.utils.status_lib import JobStatus
 
 _CANCELLED_RC = 137
+
+# Spec envs the driver adopts into its OWN environment: the trace id and
+# timeline file make the driver's spans part of the launch's single
+# cross-process trace (timeline.save merges; atexit fires on SIGTERM's
+# sys.exit too), the profile dir rides along for rank defaults.
+_TELEMETRY_ENVS = (trace_lib.ENV_VAR, timeline.ENV_VAR,
+                   'SKYTPU_PROFILE_DIR')
 
 
 def _host_shell_argv(host: Dict[str, Any], cmd: str) -> List[str]:
@@ -199,6 +209,12 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             coordinator_port=coordinator_port,
             num_slices=num_slices,
             slice_id=rank // hosts_per_slice))
+        # Per-rank JSONL step telemetry lands next to the rank's log by
+        # default (Trainer.fit / Generator code in the workload writes
+        # it; the agent's /telemetry endpoint tails it).
+        env.setdefault(steplog.ENV_VAR,
+                       os.path.join(log_dir,
+                                    f'rank-{rank}.telemetry.jsonl'))
         container = spec.get('docker_container')
         if container:
             # Unique per submission: job ids restart at 1 per cluster
@@ -374,9 +390,16 @@ def main() -> int:
         spec = json.load(f)
     job_table = job_lib.JobTable(spec['job_db'])
     job_id = int(spec['job_id'])
+    for key in _TELEMETRY_ENVS:
+        value = (spec.get('envs') or {}).get(key)
+        if value:
+            os.environ.setdefault(key, str(value))
     signal.signal(signal.SIGTERM, lambda *a: (_kill_ranks(), sys.exit(143)))
     try:
-        return run_gang(spec, job_table, job_id)
+        with timeline.Event('agent.run_gang',
+                            args={'job_id': job_id,
+                                  'job_name': spec.get('job_name')}):
+            return run_gang(spec, job_table, job_id)
     except SystemExit:
         raise
     except BaseException:  # noqa: B036 — any driver crash must mark the job

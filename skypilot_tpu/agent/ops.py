@@ -15,6 +15,9 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from skypilot_tpu.agent import job_lib, log_lib
+from skypilot_tpu.telemetry import steplog
+from skypilot_tpu.telemetry import trace as trace_lib
+from skypilot_tpu.utils import timeline
 from skypilot_tpu.utils.status_lib import JobStatus
 
 AGENT_VERSION = 3  # v2: gRPC transport alongside HTTP; v3: tail offset
@@ -54,33 +57,49 @@ class AgentOps:
 
     def submit(self, spec: Dict[str, Any]) -> int:
         state = self.state
-        job_id = state.job_table.add_job(
-            name=spec.get('job_name'),
-            username=spec.get('username', 'unknown'),
-            run_timestamp=spec.get('run_timestamp', ''),
-            log_dir='',
-            spec=spec)
-        log_dir = state.log_dir_for(job_id)
-        state.job_table.set_log_dir(job_id, log_dir)
-        spec['log_dir'] = log_dir
-        spec['job_id'] = job_id
-        spec['job_db'] = state.job_table.db_path
-        os.makedirs(log_dir, exist_ok=True)
-        spec_path = os.path.join(log_dir, 'spec.json')
-        with open(spec_path, 'w', encoding='utf-8') as f:
-            json.dump(spec, f)
-        state.job_table.set_status(job_id, JobStatus.PENDING)
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.agent.driver', spec_path],
-            stdout=open(os.path.join(log_dir, 'driver.log'), 'ab'),
-            stderr=subprocess.STDOUT,
-            start_new_session=True)
-        state.job_table.set_pid(job_id, proc.pid)
-        # Pid file so teardown can reap the (own-session) driver even
-        # after the agent dies (see provision/local terminate path).
-        with open(os.path.join(log_dir, 'driver.pid'), 'w',
-                  encoding='utf-8') as f:
-            f.write(str(proc.pid))
+        # Adopt the submitting launch's trace context (rode the spec's
+        # envs over HTTP/gRPC) so the agent's own spans correlate.
+        envs = spec.get('envs') or {}
+        with trace_lib.trace_scope(envs.get(trace_lib.ENV_VAR)):
+            job_id = self._submit(spec)
+        # Flush spans now (no-op when tracing is off): the agent is
+        # long-lived, so waiting for its atexit would leave the launch's
+        # trace file without agent spans until shutdown.
+        timeline.save()
+        return job_id
+
+    def _submit(self, spec: Dict[str, Any]) -> int:
+        state = self.state
+        with timeline.Event('agent.submit',
+                            args={'job_name': spec.get('job_name')}):
+            job_id = state.job_table.add_job(
+                name=spec.get('job_name'),
+                username=spec.get('username', 'unknown'),
+                run_timestamp=spec.get('run_timestamp', ''),
+                log_dir='',
+                spec=spec)
+            log_dir = state.log_dir_for(job_id)
+            state.job_table.set_log_dir(job_id, log_dir)
+            spec['log_dir'] = log_dir
+            spec['job_id'] = job_id
+            spec['job_db'] = state.job_table.db_path
+            os.makedirs(log_dir, exist_ok=True)
+            spec_path = os.path.join(log_dir, 'spec.json')
+            with open(spec_path, 'w', encoding='utf-8') as f:
+                json.dump(spec, f)
+            state.job_table.set_status(job_id, JobStatus.PENDING)
+            proc = subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.agent.driver',
+                 spec_path],
+                stdout=open(os.path.join(log_dir, 'driver.log'), 'ab'),
+                stderr=subprocess.STDOUT,
+                start_new_session=True)
+            state.job_table.set_pid(job_id, proc.pid)
+            # Pid file so teardown can reap the (own-session) driver even
+            # after the agent dies (see provision/local terminate path).
+            with open(os.path.join(log_dir, 'driver.pid'), 'w',
+                      encoding='utf-8') as f:
+                f.write(str(proc.pid))
         return job_id
 
     def queue(self, all_jobs: bool) -> List[Dict[str, Any]]:
@@ -165,7 +184,39 @@ class AgentOps:
             glob.glob('/dev/vfio/*'))
         lines += ['# TYPE skytpu_agent_tpu_chips gauge',
                   f'skytpu_agent_tpu_chips {chips}']
-        return '\n'.join(lines) + '\n'
+        text = '\n'.join(lines) + '\n'
+        # Data-plane families (skytpu_train_*/infer_*/serve_*) live on
+        # the shared REGISTRY: when an engine runs inside the agent
+        # process they show up here too, one scrape per host.
+        try:
+            from skypilot_tpu import metrics as metrics_lib
+            text += metrics_lib.render_metrics().decode('utf-8')
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return text
+
+    def telemetry_tail(self, limit: int = 50) -> Dict[str, Any]:
+        """Recent JSONL step-telemetry records: the agent's own
+        utilization samples (<base_dir>/telemetry.jsonl) plus each
+        job's per-rank files — the dashboard's /api/cluster_metrics
+        surfaces this."""
+        agent_records = steplog.read(
+            os.path.join(self.state.base_dir, 'telemetry.jsonl'), limit)
+        jobs: Dict[str, List[Dict[str, Any]]] = {}
+        for job in self.state.job_table.queue(all_jobs=True)[:10]:
+            job_id = job['job_id']
+            log_dir = self.state.log_dir_for(job_id)
+            records: List[Dict[str, Any]] = []
+            try:
+                import glob
+                for path in sorted(glob.glob(
+                        os.path.join(log_dir, 'rank-*.telemetry.jsonl'))):
+                    records.extend(steplog.read(path, limit))
+            except OSError:
+                pass
+            if records:
+                jobs[str(job_id)] = records[-limit:]
+        return {'agent': agent_records, 'jobs': jobs}
 
     def set_autostop(self, idle_minutes: int, down: bool) -> None:
         with open(self.state.autostop_path, 'w', encoding='utf-8') as f:

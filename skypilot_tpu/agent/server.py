@@ -34,6 +34,7 @@ from aiohttp import web
 
 from skypilot_tpu.agent import log_lib
 from skypilot_tpu.agent.ops import AGENT_VERSION, AgentOps, AgentState
+from skypilot_tpu.telemetry import steplog
 from skypilot_tpu.utils.status_lib import JobStatus
 
 DEFAULT_PORT = 46590  # same port as the reference's skylet gRPC
@@ -112,6 +113,11 @@ def make_app(state: AgentState) -> web.Application:
         return web.Response(text=ops.metrics_text(),
                             content_type='text/plain')
 
+    @routes.get('/telemetry')
+    async def telemetry(request: web.Request) -> web.Response:
+        limit = int(request.query.get('limit', 50))
+        return web.json_response(ops.telemetry_tail(limit=limit))
+
     @routes.post('/autostop')
     async def autostop(request: web.Request) -> web.Response:
         body = await request.json()
@@ -140,8 +146,22 @@ async def _events_loop(state: AgentState, interval: float) -> None:
     terminate is issued by a detached helper process
     (agent/selfdown.py): the teardown kills this agent too."""
     last_heartbeat = 0.0
+    telemetry_path = os.path.join(state.base_dir, 'telemetry.jsonl')
     while True:
         await asyncio.sleep(interval)
+        # One utilization sample per tick (JSONL, bounded by steplog's
+        # size cap) — /telemetry serves the tail to the dashboard.
+        try:
+            sample: Dict[str, Any] = {'kind': 'agent_sample',
+                                      'active_jobs':
+                                      state.job_table.has_active_jobs()}
+            try:
+                sample['load1'] = os.getloadavg()[0]
+            except OSError:
+                pass
+            steplog.write(sample, path=telemetry_path)
+        except Exception:  # pylint: disable=broad-except
+            pass
         try:
             if os.path.exists(state.autostop_path):
                 with open(state.autostop_path, encoding='utf-8') as f:

@@ -27,8 +27,10 @@ from skypilot_tpu import state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent.client import AgentClient
 from skypilot_tpu.provision import provisioner
+from skypilot_tpu.telemetry import trace as trace_lib
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import common_utils, locks
+from skypilot_tpu.utils import timeline
 from skypilot_tpu.utils.status_lib import ClusterStatus, JobStatus
 
 logger = sky_logging.init_logger(__name__)
@@ -281,6 +283,13 @@ class TpuBackend:
                                'port': inst.ssh_port}
             hosts.append(host)
         run_timestamp = common_utils.make_run_id()
+        # Telemetry context crosses the process boundary as env vars:
+        # trace id + timeline file + profile dir ride the job spec so
+        # the agent driver exports them to every rank.  Task-declared
+        # envs win on collision (the user may pin their own trace id).
+        envs = dict(task.envs_and_secrets)
+        for key, value in trace_lib.propagation_envs().items():
+            envs.setdefault(key, value)
         spec = {
             'job_name': task.name,
             'username': common_utils.get_user_hash(),
@@ -288,7 +297,7 @@ class TpuBackend:
             'task_id': f'{handle.cluster_name}-{run_timestamp}',
             'hosts': hosts,
             'commands': commands,
-            'envs': task.envs_and_secrets,
+            'envs': envs,
             'num_chips_per_node': handle.num_chips_per_host,
             'num_slices': handle.num_slices,
         }
@@ -296,7 +305,9 @@ class TpuBackend:
             from skypilot_tpu.provision import docker_utils
             spec['docker_container'] = docker_utils.CONTAINER_NAME
         client = AgentClient(handle.agent_url())
-        job_id = client.submit_job(spec)
+        with timeline.Event('backend.execute',
+                            args={'cluster': handle.cluster_name}):
+            job_id = client.submit_job(spec)
         logger.info(f'Job {job_id} submitted to {handle.cluster_name!r} '
                     f'({len(hosts)} rank(s)).')
         return job_id

@@ -299,6 +299,28 @@ const PAGES = {
           [fmtDur(m.skytpu_agent_uptime_seconds), 'agent uptime'],
           [fmtDur(m.skytpu_agent_idle_seconds), 'idle'],
         ]);
+        // JSONL step-telemetry tail (agent samples + per-rank job
+        // records, served via the agent's /telemetry endpoint) — show
+        // the most recent record per job so a running fit/generate is
+        // visible without opening the logs.
+        const tele = resp.telemetry || {};
+        const teleRows = [];
+        for (const [jobId, recs] of Object.entries(tele.jobs || {})) {
+          const r = recs[recs.length - 1];
+          if (!r) continue;
+          const fields = Object.entries(r)
+              .filter(([k]) => k !== 'kind' && k !== 'ts')
+              .map(([k, v]) => `${k}=${typeof v === 'number' ?
+                  +v.toPrecision(4) : v}`)
+              .join(' ');
+          teleRows.push([esc(jobId), esc(r.kind || '-'),
+                         `<span class="mono">${esc(fields)}</span>`,
+                         fmtTime(r.ts)]);
+        }
+        if (teleRows.length) {
+          util += '<h4>Step telemetry</h4>' +
+              table(['Job', 'Kind', 'Latest', 'At'], teleRows);
+        }
       } catch (e) {
         util = `<div class="empty">utilization unavailable ` +
             `(${esc(e.message)})</div>`;

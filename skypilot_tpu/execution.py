@@ -19,6 +19,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.backends import TpuBackend
+from skypilot_tpu.utils import timeline
 from skypilot_tpu.utils.status_lib import JobStatus
 
 logger = sky_logging.init_logger(__name__)
@@ -59,18 +60,21 @@ def _execute(task: task_lib.Task,
                                           down=down))
     with config_lib.override_config(task.config_overrides):
         if Stage.OPTIMIZE in stages:
-            record = state.get_cluster(cluster_name)
-            if record is not None:
-                # Reuse: skip optimization, keep the cluster's resources.
-                task.set_resources_chosen(
-                    record['handle'].launched_resources)
-            elif not task.best_resources.is_launchable:
-                optimizer_lib.Optimizer.optimize_task(
-                    task, blocked_resources=blocked_resources)
+            with timeline.Event('stage:OPTIMIZE'):
+                record = state.get_cluster(cluster_name)
+                if record is not None:
+                    # Reuse: skip optimization, keep the cluster's
+                    # resources.
+                    task.set_resources_chosen(
+                        record['handle'].launched_resources)
+                elif not task.best_resources.is_launchable:
+                    optimizer_lib.Optimizer.optimize_task(
+                        task, blocked_resources=blocked_resources)
 
         handle: Optional[state.ClusterHandle] = None
         if Stage.PROVISION in stages:
-            handle = backend.provision(task, cluster_name)
+            with timeline.Event('stage:PROVISION'):
+                handle = backend.provision(task, cluster_name)
             record = state.get_cluster(cluster_name)
             if record is not None and \
                     record['status'] == state.ClusterStatus.QUEUED:
@@ -91,16 +95,21 @@ def _execute(task: task_lib.Task,
             handle = record['handle']
 
         if Stage.SYNC_WORKDIR in stages:
-            backend.sync_workdir(handle, task.workdir)
+            with timeline.Event('stage:SYNC_WORKDIR'):
+                backend.sync_workdir(handle, task.workdir)
         if Stage.SYNC_FILE_MOUNTS in stages:
-            backend.sync_file_mounts(handle, task.file_mounts)
-            backend.mount_volumes(handle, task.volumes)
+            with timeline.Event('stage:SYNC_FILE_MOUNTS'):
+                backend.sync_file_mounts(handle, task.file_mounts)
+                backend.mount_volumes(handle, task.volumes)
         if Stage.SETUP in stages:
-            backend.setup(handle, task)
+            with timeline.Event('stage:SETUP'):
+                backend.setup(handle, task)
 
         job_id: Optional[int] = None
         if Stage.EXEC in stages:
-            job_id = backend.execute(handle, task, detach_run=detach_run)
+            with timeline.Event('stage:EXEC'):
+                job_id = backend.execute(handle, task,
+                                         detach_run=detach_run)
             if job_id is not None and not detach_run:
                 backend.tail_logs(handle, job_id)
 

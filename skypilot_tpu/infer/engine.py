@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -25,6 +26,8 @@ import numpy as np
 from skypilot_tpu.infer import llama_infer, sampling
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.models import llama
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
+from skypilot_tpu.telemetry.profiler import profile_window
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,8 +52,12 @@ class GeneratorConfig:
     weights_dtype: Optional[str] = None
     # 'inplace' (default): fori_loop decode with row-level cache
     # scatter (no per-layer full-slice write-back); 'scan': the layer
-    # scan with cache in xs/ys.  Same math, different HBM traffic —
-    # see llama_infer.decode_step_inplace.
+    # scan with cache in xs/ys; 'paged': inplace's cache layout with
+    # attention done by the Pallas decode kernel (ops/decode_attention)
+    # reading the stacked — possibly int8 — cache directly, so no
+    # dequantized K/V copy is ever materialized.  Requires
+    # max_seq_len % 64 == 0 and head_dim % 128 == 0.  Same math,
+    # different HBM traffic — see llama_infer.decode_step_inplace.
     decode_impl: str = 'inplace'
     # Chunked prefill (ContinuousBatcher only): prompts LONGER than
     # this many tokens prefill in prefill_chunk-sized windows
@@ -234,12 +241,18 @@ class Generator:
             sharding=(None if self.mesh is None
                       else tp_lib.cache_sharding(self.mesh)),
             kv_dtype=self.gen.kv_cache_dtype)
+        prefill_start = time.perf_counter()
         logits, cache = self._prefill(self.params, jnp.asarray(tokens),
                                       cache=cache,
                                       lengths=jnp.asarray(lens))
         rng = jax.random.PRNGKey(seed)
         rng, sub = jax.random.split(rng)
         token = self._sample(logits, sub)
+        # The host fetch below is the barrier that makes this a real
+        # dispatch-to-first-token time (includes sampling).
+        first_host = np.asarray(token)
+        telemetry_metrics.INFER_PREFILL_SECONDS.labels(
+            bucket=str(bucket)).observe(time.perf_counter() - prefill_start)
 
         eos = self.gen.eos_token
         out: List[List[int]] = [[] for _ in range(batch)]
@@ -261,22 +274,39 @@ class Generator:
 
         # First token came from prefill; the rest stream in on-device
         # chunks (bounded chunk-size set → bounded compile set).
-        if _absorb(np.asarray(token)[:, None]):
+        decode_seconds = 0.0
+        dispatched = 0
+        try:
+            if _absorb(first_host[:, None]):
+                return [out[i] for i in range(len(prompts))]
+            remaining = max_new - 1
+            chunk = 32
+            with profile_window('generate'):
+                while remaining > 0:
+                    # Always run a FULL chunk when cache capacity allows,
+                    # even past max_new (host trims): one compiled decode
+                    # shape beats saving the overshot steps.  A smaller
+                    # chunk only near the cache end.
+                    capacity = self.gen.max_seq_len - int(np.max(positions))
+                    n = min(chunk, capacity)
+                    if n <= 0:
+                        break
+                    chunk_start = time.perf_counter()
+                    toks, token, cache, positions, rng = self._decode_chunk(
+                        self.params, token, cache, positions, rng, n=n)
+                    host_toks = np.asarray(toks)  # barrier for the chunk
+                    chunk_dt = time.perf_counter() - chunk_start
+                    telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(
+                        chunk_dt)
+                    decode_seconds += chunk_dt
+                    dispatched += n * len(prompts)
+                    remaining -= n
+                    if _absorb(host_toks):
+                        break
             return [out[i] for i in range(len(prompts))]
-        remaining = max_new - 1
-        chunk = 32
-        while remaining > 0:
-            # Always run a FULL chunk when cache capacity allows, even
-            # past max_new (host trims): one compiled decode shape
-            # beats saving the overshot steps.  A smaller chunk only
-            # near the cache end.
-            capacity = self.gen.max_seq_len - int(np.max(positions))
-            n = min(chunk, capacity)
-            if n <= 0:
-                break
-            toks, token, cache, positions, rng = self._decode_chunk(
-                self.params, token, cache, positions, rng, n=n)
-            remaining -= n
-            if _absorb(np.asarray(toks)):
-                break
-        return [out[i] for i in range(len(prompts))]
+        finally:
+            if decode_seconds > 0:
+                telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
+                    dispatched / decode_seconds)
+            telemetry_metrics.INFER_GENERATED_TOKENS.inc(
+                sum(len(out[i]) for i in range(len(prompts))))

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from skypilot_tpu.infer import quant
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import decode_attention as decode_attention_ops
 from skypilot_tpu.ops import rmsnorm as rmsnorm_ops
 from skypilot_tpu.ops import rope as rope_ops
 
@@ -344,8 +345,10 @@ def get_decode_fn(impl: str):
         return decode_step
     if impl == 'unroll':
         return decode_step_unrolled
+    if impl == 'paged':
+        return decode_step_paged
     raise ValueError(
-        f"decode_impl must be 'inplace', 'scan' or 'unroll', "
+        f"decode_impl must be 'inplace', 'scan', 'unroll' or 'paged', "
         f'got {impl!r}')
 
 
@@ -421,6 +424,86 @@ def decode_step_inplace(params: llama.Params, token: jax.Array,
                                                  False)
         h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible,
                             config)
+        return (h, cache)
+
+    h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    logits = quant.matmul(h[:, 0], params['lm_head'],
+                          out_dtype=jnp.float32)
+    return logits, cache
+
+
+def decode_step_paged(params: llama.Params, token: jax.Array,
+                      config: llama.LlamaConfig, cache: Cache,
+                      positions: jax.Array
+                      ) -> Tuple[jax.Array, Cache]:
+    """decode_step_inplace with attention done by the Pallas paged
+    decode kernel (ops/decode_attention).
+
+    Same cache layout and row-scatter writes as inplace; the read side
+    changes: instead of slicing a layer's FULL (B, S, KV, hd) cache and
+    masking (which reads max_len rows per slot per step, and for int8
+    caches materializes a dequantized full-layer copy), the kernel
+    streams only each slot's valid cache blocks straight from the
+    stacked — possibly int8 — cache, dequantizing block-wise in VMEM.
+    Per-step cache traffic scales with actual context, not max_len.
+
+    Constraints (from the kernel): max_len % 64 == 0 and
+    head_dim % 128 == 0.  Off-TPU the kernel runs in interpret mode
+    (slow but exact — parity is tested on CPU; perf is a TPU property).
+    """
+    batch = token.shape[0]
+    max_len = cache['k'].shape[2]
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, max_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, token, config)[:, None]  # (B, 1, d)
+    pos = positions[:, None].astype(jnp.int32)
+    quantized = 'k_scale' in cache
+    b_idx = jnp.arange(batch)
+    group = config.n_heads // config.n_kv_heads
+
+    def body(i, carry):
+        h, cache = carry
+        layer_params = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
+                                                   keepdims=False),
+            params['layers'])
+        attn_p = layer_params['attn']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)
+        q = rope_ops.apply_rope(q, cos, sin, positions=pos)
+        k = rope_ops.apply_rope(k, cos, sin, positions=pos)
+        if quantized:
+            k_row, k_s_row = _quantize_kv(k[:, 0])
+            v_row, v_s_row = _quantize_kv(v[:, 0])
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, b_idx, positions].set(k_row),
+                v=cache['v'].at[i, b_idx, positions].set(v_row),
+                k_scale=cache['k_scale'].at[i, b_idx, positions]
+                .set(k_s_row),
+                v_scale=cache['v_scale'].at[i, b_idx, positions]
+                .set(v_s_row))
+            scales = (cache['k_scale'], cache['v_scale'])
+        else:
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, b_idx, positions].set(k[:, 0]),
+                v=cache['v'].at[i, b_idx, positions].set(v[:, 0]))
+            scales = (None, None)
+        # The kernel reads the STACKED cache at layer i directly — no
+        # per-layer slice or dequantized copy is ever materialized.
+        q_r = q[:, 0].reshape(batch, config.n_kv_heads, group,
+                              config.head_dim)
+        o = decode_attention_ops.decode_attention(
+            q_r, cache['k'], cache['v'], i, positions.astype(jnp.int32),
+            scales[0], scales[1])
+        h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                 eps=config.norm_eps)
+        h = h + _ffn(x, layer_params, config)
         return (h, cache)
 
     h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))

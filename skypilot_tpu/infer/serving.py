@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -39,6 +40,7 @@ from skypilot_tpu.infer import llama_infer, sampling
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
 from skypilot_tpu.models import llama
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
 
 
 @dataclasses.dataclass
@@ -55,6 +57,8 @@ class _Request:
     # Chunked prefill: tokens of the prompt already written to the
     # slot cache (0 while queued; == len(prompt) when ready to decode).
     prefill_pos: int = 0
+    # Wall time of submit(); admission observes the queue wait.
+    submitted_at: float = 0.0
 
 
 class ContinuousBatcher:
@@ -251,7 +255,8 @@ class ContinuousBatcher:
         req = _Request(next(self._ids), list(prompt),
                        min(max_new_tokens,
                            self.gen.max_seq_len - len(prompt)),
-                       temperature=temperature, top_p=top_p)
+                       temperature=temperature, top_p=top_p,
+                       submitted_at=time.perf_counter())
         self._requests[req.rid] = req
         self._queue.append(req)
         return req.rid
@@ -294,6 +299,12 @@ class ContinuousBatcher:
                 return b
         raise ValueError(f'Prompt length {length} exceeds largest bucket')
 
+    @staticmethod
+    def _observe_queue_wait(req: _Request) -> None:
+        if req.submitted_at:
+            telemetry_metrics.INFER_QUEUE_WAIT_SECONDS.observe(
+                time.perf_counter() - req.submitted_at)
+
     def _admit(self) -> None:
         """Move queued requests into free slots: admission groups of up
         to _admit_group requests sharing a prompt bucket prefill in ONE
@@ -308,6 +319,7 @@ class ContinuousBatcher:
                     break    # one long prefill in flight; FIFO waits
                 request = self._queue.pop(0)
                 request.slot = self._free.pop(0)
+                self._observe_queue_wait(request)
                 self._incremental = request
                 # Park the slot's decode-garbage writes at the LAST
                 # cache row: lockstep decode advances EVERY slot and
@@ -330,6 +342,7 @@ class ContinuousBatcher:
                    == bucket):
                 request = self._queue.pop(0)
                 request.slot = self._free.pop(0)
+                self._observe_queue_wait(request)
                 group.append(request)
             # Exact group size: G ∈ {1..admit_group} — bounded compiles
             # per bucket, no padding-row FLOPs for trickle traffic.
@@ -465,6 +478,7 @@ class ContinuousBatcher:
         self._admit()
         self._advance_prefill()
         if not self._active:
+            telemetry_metrics.INFER_SLOT_OCCUPANCY.set(0.0)
             return
         n = self.decode_chunk
         # Capacity from the host-side position mirror: reading
@@ -477,6 +491,8 @@ class ContinuousBatcher:
             float(self._host_temp[s]) > 0.0 for s in self._active)
         nucleus = any(
             float(self._host_top_p[s]) < 1.0 for s in self._active)
+        active_slots = len(self._active)
+        chunk_start = time.perf_counter()
         (toks, self._token, self._cache, self._positions,
          self._rng) = self._decode(
             self.params, self._token, self._cache, self._positions,
@@ -485,15 +501,25 @@ class ContinuousBatcher:
         # Decode advanced EVERY slot's device position by n (free slots
         # decode garbage in lockstep); mirror that exactly.
         self._host_pos += n
-        host = np.asarray(toks)
+        host = np.asarray(toks)  # barrier: honest chunk wall time
+        chunk_dt = time.perf_counter() - chunk_start
+        telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(chunk_dt)
+        if chunk_dt > 0:
+            telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
+                n * active_slots / chunk_dt)
         eos = self.gen.eos_token
+        appended = 0
         for slot, req in list(self._active.items()):
             for t in host[slot]:
                 req.out.append(int(t))
+                appended += 1
                 if (eos is not None and req.out[-1] == eos) or \
                         len(req.out) >= req.max_new_tokens:
                     self._finish(req)
                     break
+        telemetry_metrics.INFER_GENERATED_TOKENS.inc(appended)
+        telemetry_metrics.INFER_SLOT_OCCUPANCY.set(
+            len(self._active) / self.gen.batch_size)
 
     def run_until_idle(self, max_ticks: int = 10_000) -> None:
         for _ in range(max_ticks):

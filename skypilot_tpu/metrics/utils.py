@@ -45,4 +45,9 @@ def observe_request(path: str, method: str, status: int,
 
 def render_metrics() -> bytes:
     """Prometheus text exposition of all framework metrics."""
+    # Deferred (telemetry.metrics imports this module's REGISTRY):
+    # importing at render time registers the data-plane families
+    # (skytpu_train_/infer_/serve_*) so every exposition point shows
+    # the full schema, even from a process that never ran an engine.
+    from skypilot_tpu.telemetry import metrics as _telemetry_metrics  # noqa: F401  pylint: disable=unused-import,cyclic-import
     return prometheus_client.generate_latest(REGISTRY)

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Union
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu.serve.service_spec import ServiceSpec
@@ -124,6 +125,15 @@ class Autoscaler:
     ) -> List[AutoscalerDecision]:
         raise NotImplementedError
 
+    def _record(self, decisions: List[AutoscalerDecision]
+                ) -> List[AutoscalerDecision]:
+        """Count emitted decisions (skytpu_serve_autoscaler_decisions_total
+        per service/operator) and pass them through — every
+        generate_scaling_decisions implementation returns via this."""
+        telemetry_metrics.record_autoscaler_decisions(
+            self.service_name, decisions)
+        return decisions
+
     def info(self) -> Dict[str, Any]:
         return {
             'target_num_replicas': self.target_num_replicas,
@@ -149,11 +159,12 @@ class FixedSizeAutoscaler(Autoscaler):
         target = self.get_final_target_num_replicas()
         alive = [r for r in replicas if not r['status'].is_terminal()]
         if len(alive) < target:
-            return _scale_up(target - len(alive))
+            return self._record(_scale_up(target - len(alive)))
         if len(alive) > target:
-            return _scale_down_ids(select_replicas_to_scale_down(
-                alive, len(alive) - target))
-        return []
+            return self._record(_scale_down_ids(
+                select_replicas_to_scale_down(
+                    alive, len(alive) - target)))
+        return self._record([])
 
 
 class _AutoscalerWithHysteresis(Autoscaler):
@@ -260,11 +271,12 @@ class RequestRateAutoscaler(_AutoscalerWithHysteresis):
         target = self.get_final_target_num_replicas()
         alive = [r for r in replicas if not r['status'].is_terminal()]
         if len(alive) < target:
-            return _scale_up(target - len(alive))
+            return self._record(_scale_up(target - len(alive)))
         if len(alive) > target:
-            return _scale_down_ids(select_replicas_to_scale_down(
-                alive, len(alive) - target))
-        return []
+            return self._record(_scale_down_ids(
+                select_replicas_to_scale_down(
+                    alive, len(alive) - target)))
+        return self._record([])
 
     def info(self) -> Dict[str, Any]:
         out = super().info()
@@ -333,4 +345,4 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         elif len(ondemand) > num_ondemand_target:
             decisions.extend(_scale_down_ids(select_replicas_to_scale_down(
                 ondemand, len(ondemand) - num_ondemand_target)))
-        return decisions
+        return self._record(decisions)

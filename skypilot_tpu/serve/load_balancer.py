@@ -17,6 +17,7 @@ from typing import List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu.serve.controller import ServeController
@@ -76,6 +77,8 @@ class SkyServeLoadBalancer:
                 text='No ready replicas. Use "serve status" to check.')
         self.policy.pre_execute_hook(url)
         out = None
+        start = time.perf_counter()
+        status = 'error'
         try:
             target = url + str(request.rel_url)
             async with aiohttp.ClientSession(auto_decompress=False) as sess:
@@ -87,6 +90,7 @@ class SkyServeLoadBalancer:
                     headers = {k: v for k, v in resp.headers.items()
                                if k.lower() not in
                                ('transfer-encoding', 'content-length')}
+                    status = str(resp.status)
                     # Stream the body through chunk-by-chunk: replicas
                     # serve SSE (/v1/* stream=true) and buffering would
                     # hold every token until completion.
@@ -98,20 +102,27 @@ class SkyServeLoadBalancer:
                     await out.write_eof()
                     return out
         except aiohttp.ClientError as e:
+            telemetry_metrics.SERVE_REPLICA_ERRORS.labels(replica=url).inc()
             if out is not None:
                 # Replica died MID-stream: the status line already went
                 # out, so a 502 response is impossible — end the stream
                 # (client sees truncation, which is the truth).
+                status = 'truncated'
                 logger.warning(f'Replica {url} failed mid-stream: {e}')
                 try:
                     await out.write_eof()
                 except (ConnectionError, RuntimeError):
                     pass
                 return out
+            status = '502'
             return web.Response(status=502,
                                 text=f'Replica {url} unreachable: {e}')
         finally:
             self.policy.post_execute_hook(url)
+            telemetry_metrics.SERVE_REPLICA_REQUESTS.labels(
+                replica=url, status=status).inc()
+            telemetry_metrics.SERVE_REPLICA_SECONDS.labels(
+                replica=url).observe(time.perf_counter() - start)
 
     async def _sync_loop(self):
         while True:

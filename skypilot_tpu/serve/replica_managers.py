@@ -25,6 +25,7 @@ from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
 
 logger = sky_logging.init_logger(__name__)
 
@@ -273,7 +274,11 @@ class ReplicaManager:
                 serve_state.update_replica(self.service_name, replica_id,
                                            status=ReplicaStatus.NOT_READY,
                                            consecutive_failures=failures)
-        return serve_state.get_replicas(self.service_name)
+        records = serve_state.get_replicas(self.service_name)
+        telemetry_metrics.SERVE_REPLICAS_READY.labels(
+            service=self.service_name).set(sum(
+                1 for r in records if r['status'] == ReplicaStatus.READY))
+        return records
 
     def _async_teardown(self, replica_id: int) -> None:
         thread = threading.Thread(

@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, Optional
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import requests_lib
 from skypilot_tpu.server.requests_lib import RequestStatus
+from skypilot_tpu.telemetry import trace as trace_lib
+from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
 
@@ -90,12 +92,22 @@ def execute_request(request_id: str) -> None:
     requests_lib.set_status(request_id, RequestStatus.RUNNING)
     fn = REGISTRY.get(record['name'])
     _router.attach(record['log_path'])
+    # Rebind the request's trace context: this worker thread never saw
+    # the server middleware's contextvar, so the id rides the payload
+    # (inline/test mode has no payload stamp — the request id itself
+    # becomes the trace id, keeping spans correlated either way).
+    payload = record['payload']
+    trace_id = (payload.get(trace_lib.PAYLOAD_KEY)
+                if isinstance(payload, dict) else None) or request_id
     try:
         if fn is None:
             raise ValueError(f'Unknown request name: {record["name"]}')
         from skypilot_tpu.usage import usage_lib
-        with usage_lib.usage_event(record['name']):
-            result = fn(record['payload'])
+        with trace_lib.trace_scope(trace_id), \
+                timeline.Event(f'request:{record["name"]}',
+                               args={'request_id': request_id}), \
+                usage_lib.usage_event(record['name']):
+            result = fn(payload)
         _finish(request_id, RequestStatus.SUCCEEDED, result=result)
     except Exception as e:  # pylint: disable=broad-except
         logger.error(f'Request {request_id} ({record["name"]}) failed: '

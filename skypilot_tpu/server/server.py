@@ -65,6 +65,20 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
         return resp
 
     @web.middleware
+    async def trace_middleware(request: web.Request, handler):
+        from skypilot_tpu.telemetry import trace as trace_lib
+        # Honor a client-sent trace id (lets callers stitch our spans
+        # into their own trace); mint one otherwise.  The id is echoed
+        # on the response and rides queued payloads to the executor.
+        trace_id = (request.headers.get(trace_lib.TRACE_HEADER)
+                    or trace_lib.new_trace_id())
+        request['trace_id'] = trace_id
+        with trace_lib.trace_scope(trace_id):
+            resp = await handler(request)
+        resp.headers[trace_lib.TRACE_HEADER] = trace_id
+        return resp
+
+    @web.middleware
     async def metrics_middleware(request: web.Request, handler):
         from skypilot_tpu import metrics as metrics_lib
         import time as time_lib
@@ -88,7 +102,8 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
                                         status,
                                         time_lib.monotonic() - start)
 
-    app = web.Application(middlewares=[metrics_middleware,
+    app = web.Application(middlewares=[trace_middleware,
+                                       metrics_middleware,
                                        version_middleware,
                                        auth_lib.auth_middleware])
     routes = web.RouteTableDef()
@@ -139,6 +154,12 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
                  ) -> web.Response:
         payload.pop('_user_hash', None)  # never trust a client-sent value
         from skypilot_tpu import config as config_lib
+        from skypilot_tpu.telemetry import trace as trace_lib
+        # Stamp the request's trace id: the executor worker thread that
+        # eventually runs this payload has no access to our contextvar.
+        trace_id = trace_lib.get_trace_id()
+        if trace_id:
+            payload[trace_lib.PAYLOAD_KEY] = trace_id
         enforce = config_lib.get_nested(('api_server', 'auth_enabled'),
                                         default_value=False)
         if enforce and user_id:
@@ -304,7 +325,8 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
         record = await asyncio.to_thread(state_lib.get_cluster, cluster)
         if record is None:
             return _json_error(404, f'No cluster {cluster!r}')
-        url = record['handle'].agent_url() + '/metrics'
+        agent_url = record['handle'].agent_url()
+        url = agent_url + '/metrics'
 
         def fetch():
             import requests as requests_http
@@ -312,10 +334,22 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
             resp.raise_for_status()
             return resp.text
 
+        def fetch_telemetry():
+            # Best-effort: pre-telemetry agents have no /telemetry.
+            import requests as requests_http
+            try:
+                resp = requests_http.get(agent_url + '/telemetry',
+                                         params={'limit': 20}, timeout=10)
+                resp.raise_for_status()
+                return resp.json()
+            except Exception:  # pylint: disable=broad-except
+                return {}
+
         try:
             text = await asyncio.to_thread(fetch)
         except Exception as e:  # pylint: disable=broad-except
             return _json_error(502, f'agent metrics unreachable: {e}')
+        telemetry = await asyncio.to_thread(fetch_telemetry)
         gauges = {}
         for line in text.splitlines():
             if line.startswith('skytpu_agent_'):
@@ -339,7 +373,8 @@ def make_app(pool: Optional[executor_lib.RequestWorkerPool] = None
             'mem_used_bytes': gauges.get('skytpu_agent_mem_used_bytes'),
         })
         return web.json_response({'cluster': cluster, 'metrics': gauges,
-                                  'history': list(ring)})
+                                  'history': list(ring),
+                                  'telemetry': telemetry})
 
     @routes.get('/api/request')
     async def api_request_detail(request: web.Request) -> web.Response:

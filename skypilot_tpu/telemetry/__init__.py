@@ -1,0 +1,15 @@
+"""End-to-end observability: data-plane metrics, trace-context
+propagation, profiler hooks, and JSONL step telemetry.
+
+The control plane already exports API-request metrics
+(skypilot_tpu/metrics); this package adds the DATA plane — train step
+time/MFU, decode latency and slot occupancy, replica health — on the
+same registry, so one /metrics scrape covers both.  Trace-context
+helpers thread a single request/trace id from the API server's
+middleware through the executor, backend and agent into job processes
+(utils/timeline.py spans carry it, so one launch produces one
+cross-process Perfetto trace).  See docs/observability.md.
+"""
+from skypilot_tpu.telemetry import metrics, steplog, trace
+
+__all__ = ['metrics', 'steplog', 'trace']

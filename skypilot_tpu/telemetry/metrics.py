@@ -1,0 +1,174 @@
+"""Data-plane metric families, registered on the control plane's
+prometheus registry (skypilot_tpu/metrics/utils.py:REGISTRY) so they
+ride the existing /metrics expositions (API server and agent).
+
+Naming contract (tests/test_telemetry.py locks it): every family is
+prefixed `skytpu_` with a subsystem segment — skytpu_train_*,
+skytpu_infer_*, skytpu_serve_* — matching the control plane's
+skytpu_api_* / skytpu_agent_* conventions.
+
+Instrumentation cost discipline: these are process-local prometheus
+objects (a mutex-guarded float add per observation, no I/O); the hot
+paths that call them (decode chunk, scheduler tick) dispatch device
+work that dwarfs that.  Anything that would force an EXTRA device→host
+sync is opt-in only (see train/trainer.py run_step).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import prometheus_client
+
+from skypilot_tpu.metrics.utils import REGISTRY
+
+# ---- train (train/trainer.py) ------------------------------------------
+
+TRAIN_STEP_SECONDS = prometheus_client.Histogram(
+    'skytpu_train_step_duration_seconds',
+    'Train step wall time; phase=warmup covers compile + pipeline fill '
+    '(individually timed, host-fetch barrier per step), phase=steady is '
+    'the end-to-end-timed block (per-step average, one final barrier)',
+    ['phase'],
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60),
+    registry=REGISTRY)
+
+TRAIN_TOKENS_PER_SEC = prometheus_client.Gauge(
+    'skytpu_train_tokens_per_second',
+    'Steady-state training throughput (all chips)',
+    registry=REGISTRY)
+
+TRAIN_MFU = prometheus_client.Gauge(
+    'skytpu_train_mfu_ratio',
+    'Model FLOPs utilization of the steady block (0..1)',
+    registry=REGISTRY)
+
+TRAIN_LOSS = prometheus_client.Gauge(
+    'skytpu_train_loss',
+    'Most recently fetched training loss',
+    registry=REGISTRY)
+
+TRAIN_GRAD_NORM = prometheus_client.Gauge(
+    'skytpu_train_grad_norm',
+    'Most recently fetched global gradient norm',
+    registry=REGISTRY)
+
+TRAIN_STEPS = prometheus_client.Counter(
+    'skytpu_train_steps_total',
+    'Train steps dispatched',
+    registry=REGISTRY)
+
+# ---- infer (infer/engine.py, infer/serving.py) -------------------------
+
+INFER_PREFILL_SECONDS = prometheus_client.Histogram(
+    'skytpu_infer_prefill_duration_seconds',
+    'Prefill dispatch-to-first-token wall time, by prompt bucket',
+    ['bucket'],
+    buckets=(0.002, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 10, 60),
+    registry=REGISTRY)
+
+INFER_DECODE_CHUNK_SECONDS = prometheus_client.Histogram(
+    'skytpu_infer_decode_chunk_duration_seconds',
+    'On-device decode chunk wall time (dispatch to host fetch)',
+    buckets=(0.002, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 10, 60),
+    registry=REGISTRY)
+
+INFER_QUEUE_WAIT_SECONDS = prometheus_client.Histogram(
+    'skytpu_infer_queue_wait_seconds',
+    'Continuous-batcher admission wait: submit() to slot assignment',
+    buckets=(0.001, 0.01, 0.05, 0.25, 1, 5, 15, 60, 300),
+    registry=REGISTRY)
+
+INFER_SLOT_OCCUPANCY = prometheus_client.Gauge(
+    'skytpu_infer_slot_occupancy_ratio',
+    'Active decode slots / batch_size after the last scheduler tick',
+    registry=REGISTRY)
+
+INFER_STEADY_TOKENS_PER_SEC = prometheus_client.Gauge(
+    'skytpu_infer_steady_tokens_per_second',
+    'Decode throughput of the most recent chunk/generation '
+    '(tokens dispatched / decode wall time, all slots)',
+    registry=REGISTRY)
+
+INFER_GENERATED_TOKENS = prometheus_client.Counter(
+    'skytpu_infer_generated_tokens_total',
+    'Tokens returned to callers (post eos/max-token trim)',
+    registry=REGISTRY)
+
+# ---- serve (serve/load_balancer.py, replica_managers.py, autoscalers.py)
+
+SERVE_REPLICA_REQUESTS = prometheus_client.Counter(
+    'skytpu_serve_replica_requests_total',
+    'Proxied requests per replica and response status',
+    ['replica', 'status'],
+    registry=REGISTRY)
+
+SERVE_REPLICA_SECONDS = prometheus_client.Histogram(
+    'skytpu_serve_replica_request_duration_seconds',
+    'End-to-end proxied request latency per replica (streaming included)',
+    ['replica'],
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 10, 60, 600),
+    registry=REGISTRY)
+
+SERVE_REPLICA_ERRORS = prometheus_client.Counter(
+    'skytpu_serve_replica_errors_total',
+    'Proxy failures per replica (unreachable or died mid-stream)',
+    ['replica'],
+    registry=REGISTRY)
+
+SERVE_REPLICAS_READY = prometheus_client.Gauge(
+    'skytpu_serve_replicas_ready',
+    'Replicas READY after the last probe pass, per service',
+    ['service'],
+    registry=REGISTRY)
+
+SERVE_AUTOSCALER_DECISIONS = prometheus_client.Counter(
+    'skytpu_serve_autoscaler_decisions_total',
+    'Autoscaler decisions emitted, per service and operator',
+    ['service', 'operator'],
+    registry=REGISTRY)
+
+
+def record_autoscaler_decisions(service_name: str,
+                                decisions: List[Any]) -> None:
+    """Count a generate_scaling_decisions() result (one inc per
+    decision, labeled scale_up/scale_down)."""
+    for decision in decisions:
+        op = getattr(decision, 'operator', decision)
+        op = getattr(op, 'value', op)
+        SERVE_AUTOSCALER_DECISIONS.labels(
+            service=service_name, operator=str(op).lower()).inc()
+
+
+def histogram_quantile(hist: prometheus_client.Histogram, q: float,
+                       labels: Optional[Dict[str, str]] = None
+                       ) -> Optional[float]:
+    """Prometheus-style quantile estimate from a histogram's cumulative
+    bucket counts (upper-bound of the bucket containing the q-th
+    observation — the resolution /metrics consumers get).  labels
+    filters to one child; None aggregates every child.  Returns None
+    when the histogram is empty."""
+    buckets: Dict[float, float] = {}
+    for family in hist.collect():
+        for sample in family.samples:
+            if not sample.name.endswith('_bucket'):
+                continue
+            if labels and any(sample.labels.get(k) != v
+                              for k, v in labels.items()):
+                continue
+            le = float(sample.labels['le'])
+            buckets[le] = buckets.get(le, 0.0) + sample.value
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]           # +Inf bucket = observation count
+    if total <= 0:
+        return None
+    target = q * total
+    finite = [b for b in bounds if not math.isinf(b)]
+    for le in bounds:
+        if buckets[le] >= target:
+            # Observations above every finite bound: report the largest
+            # finite upper bound (what promQL's histogram_quantile does).
+            return finite[-1] if math.isinf(le) and finite else le
+    return finite[-1] if finite else None

@@ -1,0 +1,51 @@
+"""Opt-in jax.profiler windows around the data-plane hot loops.
+
+Setting SKYTPU_PROFILE_DIR makes Trainer.fit and Generator.generate
+wrap their steady sections in jax.profiler.start_trace/stop_trace, so
+a production run can be profiled by flipping one env var — no code
+change, no always-on overhead (the env check is the only cost when
+disabled).
+
+Each window writes to <SKYTPU_PROFILE_DIR>/<name>-pid<pid>/ (the
+XPlane/trace files TensorBoard's profile plugin and Perfetto load).
+Windows never nest (jax.profiler has one global trace) and never
+raise: a profiler failure must not take down the training/serving loop
+it observes.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator
+
+ENV_VAR = 'SKYTPU_PROFILE_DIR'
+
+_ACTIVE = threading.Lock()
+
+
+@contextlib.contextmanager
+def profile_window(name: str) -> Iterator[None]:
+    base = os.environ.get(ENV_VAR)
+    if not base or not _ACTIVE.acquire(blocking=False):
+        yield
+        return
+    started = False
+    try:
+        import jax
+        path = os.path.join(os.path.expanduser(base),
+                            f'{name}-pid{os.getpid()}')
+        os.makedirs(path, exist_ok=True)
+        try:
+            jax.profiler.start_trace(path)
+            started = True
+        except Exception:  # pylint: disable=broad-except
+            pass
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pylint: disable=broad-except
+                pass
+        _ACTIVE.release()

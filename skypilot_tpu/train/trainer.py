@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
@@ -21,6 +22,14 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
+from skypilot_tpu.telemetry import steplog
+from skypilot_tpu.telemetry.profiler import profile_window
+
+# Opt-in per-step sync timing for run_step: a block_until_ready per step
+# gives honest step wall times but bills one device round-trip per step,
+# so it must never be on during fit's end-to-end-timed steady block.
+_STEP_METRICS_ENV = 'SKYTPU_STEP_METRICS'
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,16 +132,25 @@ class Trainer:
         return train_step
 
     def run_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        sync = bool(os.environ.get(_STEP_METRICS_ENV))
+        start = time.perf_counter() if sync else 0.0
         batch = {k: jax.device_put(v, self._batch_sharding)
                  for k, v in batch.items()}
         self.params, self.opt_state, metrics = self._train_step(
             self.params, self.opt_state, batch)
         self.step += 1
+        telemetry_metrics.TRAIN_STEPS.inc()
+        if sync:
+            jax.block_until_ready(metrics)
+            telemetry_metrics.TRAIN_STEP_SECONDS.labels(phase='sync').observe(
+                time.perf_counter() - start)
         return metrics
 
     def fit(self, batches: Iterator[Dict[str, np.ndarray]], num_steps: int,
             log_every: int = 10,
-            tokens_per_batch: Optional[int] = None) -> Dict[str, float]:
+            tokens_per_batch: Optional[int] = None,
+            flops_per_token: Optional[float] = None,
+            peak_flops: Optional[float] = None) -> Dict[str, float]:
         """Run steps; returns summary incl. steady-state throughput.
 
         Timing: warmup steps (compile + pipeline fill) are forced to
@@ -141,30 +159,63 @@ class Trainer:
         block_until_ready is NOT trusted: remote-tunnel PJRT backends can
         report buffers ready before execution finishes, and a per-step
         host fetch would bill one RTT per step to the device.
+
+        Telemetry: warmup steps are observed individually (phase=warmup —
+        the host fetch is already a barrier); the steady block is recorded
+        as its per-step average (phase=steady) plus throughput/loss/grad
+        gauges after the final barrier.  With flops_per_token and
+        tokens_per_batch, MFU = achieved / peak is also reported
+        (peak_flops defaults to 197e12 per TPU chip, 1e12 on CPU).
         """
         if num_steps <= 0:
             return {'loss': float('nan'), 'step_time_s': float('nan')}
         warmup = min(max(1, min(num_steps // 3, 4)), num_steps - 1)
         last_metrics: Dict[str, Any] = {}
         for i in range(warmup):
+            step_start = time.perf_counter()
             last_metrics = self.run_step(next(batches))
             loss = float(last_metrics['loss'])  # host fetch = real barrier
+            telemetry_metrics.TRAIN_STEP_SECONDS.labels(
+                phase='warmup').observe(time.perf_counter() - step_start)
             if log_every:
                 print(f'warmup step {self.step}: loss={loss:.4f}')
         timed = num_steps - warmup
-        start = time.perf_counter()
-        for i in range(timed):
-            last_metrics = self.run_step(next(batches))
-            if log_every and (i + 1) % log_every == 0:
-                # No host fetch here: a sync fetch would stall dispatch and
-                # bill a device round-trip to the timed block.
-                print(f'step {self.step} dispatched')
-        final_loss = float(last_metrics['loss'])  # barrier for the block
-        elapsed = time.perf_counter() - start
+        with profile_window('trainer_fit'):
+            start = time.perf_counter()
+            for i in range(timed):
+                last_metrics = self.run_step(next(batches))
+                if log_every and (i + 1) % log_every == 0:
+                    # No host fetch here: a sync fetch would stall dispatch
+                    # and bill a device round-trip to the timed block.
+                    print(f'step {self.step} dispatched')
+            final_loss = float(last_metrics['loss'])  # barrier for the block
+            elapsed = time.perf_counter() - start
         step_time = elapsed / timed
-        out = {'loss': final_loss, 'step_time_s': step_time}
+        grad_norm = float(last_metrics['grad_norm'])
+        for _ in range(timed):
+            telemetry_metrics.TRAIN_STEP_SECONDS.labels(
+                phase='steady').observe(step_time)
+        telemetry_metrics.TRAIN_LOSS.set(final_loss)
+        telemetry_metrics.TRAIN_GRAD_NORM.set(grad_norm)
+        out = {'loss': final_loss, 'step_time_s': step_time,
+               'grad_norm': grad_norm}
         if tokens_per_batch:
             out['tokens_per_sec'] = tokens_per_batch / step_time
+            telemetry_metrics.TRAIN_TOKENS_PER_SEC.set(out['tokens_per_sec'])
+            if flops_per_token:
+                if peak_flops is None:
+                    on_tpu = jax.default_backend() == 'tpu'
+                    peak_flops = (197e12 if on_tpu else 1e12) * len(
+                        jax.devices())
+                out['mfu'] = (flops_per_token * out['tokens_per_sec']
+                              / peak_flops)
+                telemetry_metrics.TRAIN_MFU.set(out['mfu'])
+        if steplog.enabled():
+            steplog.write({'kind': 'train_fit', 'step': self.step,
+                           'step_time_s': step_time, 'loss': final_loss,
+                           'grad_norm': grad_norm,
+                           'tokens_per_sec': out.get('tokens_per_sec'),
+                           'mfu': out.get('mfu')})
         return out
 
     # ---- checkpointing (Orbax; local path or gs:// URI) ------------------

@@ -3,6 +3,14 @@
 Reference parity: sky/utils/timeline.py:1-40 — Event context manager +
 @event decorator; enabled via SKYTPU_TIMELINE_FILE env var; output loads in
 chrome://tracing / Perfetto.
+
+Spans nest (a per-thread stack records each span's parent) and carry the
+current trace id (skypilot_tpu/telemetry/trace.py), so events from the
+API server, executor thread, agent and job ranks can be correlated in
+one trace.  save() MERGES into an existing trace file under a file lock
+instead of overwriting, which is what lets all those processes share a
+single SKYTPU_TIMELINE_FILE: each process appends its spans whenever it
+saves (explicitly or at exit), and the last writer leaves the union.
 """
 from __future__ import annotations
 
@@ -14,28 +22,55 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+import filelock
+
+from skypilot_tpu.telemetry import trace as trace_lib
+
+ENV_VAR = 'SKYTPU_TIMELINE_FILE'
+_ENV_VAR = ENV_VAR  # Backwards-compat alias.
+
 _EVENTS: List[Dict[str, Any]] = []
 _LOCK = threading.Lock()
-_ENV_VAR = 'SKYTPU_TIMELINE_FILE'
+_TLS = threading.local()
 
 
 def _enabled() -> bool:
-    return bool(os.environ.get(_ENV_VAR))
+    return bool(os.environ.get(ENV_VAR))
+
+
+def _span_stack() -> List[str]:
+    stack = getattr(_TLS, 'stack', None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
 
 
 class Event:
-    """Context manager recording a complete ('X') trace event."""
+    """Context manager recording a complete ('X') trace event.
 
-    def __init__(self, name: str, message: Optional[str] = None) -> None:
+    Nested Events record their enclosing Event's name as args.parent,
+    and every event carries args.trace_id when a trace id is in scope
+    (contextvar or SKYTPU_TRACE_ID env)."""
+
+    def __init__(self, name: str, message: Optional[str] = None,
+                 args: Optional[Dict[str, Any]] = None) -> None:
         self._name = name
         self._message = message
+        self._args = args
         self._start = 0.0
+        self._parent: Optional[str] = None
 
     def __enter__(self) -> 'Event':
         self._start = time.time()
+        stack = _span_stack()
+        self._parent = stack[-1] if stack else None
+        stack.append(self._name)
         return self
 
-    def __exit__(self, *args) -> None:
+    def __exit__(self, *exc_info) -> None:
+        stack = _span_stack()
+        if stack and stack[-1] == self._name:
+            stack.pop()
         if not _enabled():
             return
         event = {
@@ -47,8 +82,16 @@ class Event:
             'pid': os.getpid(),
             'tid': threading.get_ident() % 100000,
         }
+        args: Dict[str, Any] = dict(self._args) if self._args else {}
         if self._message:
-            event['args'] = {'message': self._message}
+            args['message'] = self._message
+        if self._parent:
+            args['parent'] = self._parent
+        trace_id = trace_lib.get_trace_id()
+        if trace_id:
+            args['trace_id'] = trace_id
+        if args:
+            event['args'] = args
         with _LOCK:
             _EVENTS.append(event)
 
@@ -68,10 +111,23 @@ def event(fn: Callable = None, name: Optional[str] = None) -> Callable:
 
 @atexit.register
 def save() -> None:
-    path = os.environ.get(_ENV_VAR)
+    """Flush buffered events, merging with whatever is already in the
+    trace file (several processes of one launch share the path).  The
+    buffer is cleared after a successful write, so calling save() more
+    than once (explicitly and again at exit) never duplicates events."""
+    path = os.environ.get(ENV_VAR)
     if not path or not _EVENTS:
         return
-    os.makedirs(os.path.dirname(os.path.expanduser(path)) or '.',
-                exist_ok=True)
-    with _LOCK, open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
-        json.dump({'traceEvents': _EVENTS}, f)
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with _LOCK:
+        events, existing = list(_EVENTS), []
+        with filelock.FileLock(path + '.lock'):
+            try:
+                with open(path, encoding='utf-8') as f:
+                    existing = json.load(f).get('traceEvents', [])
+            except (OSError, ValueError):
+                existing = []
+            with open(path, 'w', encoding='utf-8') as f:
+                json.dump({'traceEvents': existing + events}, f)
+        _EVENTS.clear()

@@ -35,7 +35,13 @@ if _repo_root not in _pp.split(os.pathsep):
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', 8)
+try:
+    jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS fallback above covers it as long as the backend was
+    # not initialized before this conftest ran.
+    pass
 # Persistent XLA compilation cache: the suite is compile-heavy on this
 # 1-core box (VERDICT r2 weak #8) and most test programs are identical
 # across runs — reruns skip those compiles.  Safe to delete any time.
@@ -65,6 +71,13 @@ def pytest_collection_modifyitems(config, items):
         for item in items:
             if 'smoke' in item.keywords:
                 item.add_marker(skip_smoke)
+    if jax.default_backend() != 'tpu':
+        skip_tpu = pytest.mark.skip(
+            reason='requires a real TPU backend (this harness forces '
+                   'JAX_PLATFORMS=cpu)')
+        for item in items:
+            if 'tpu' in item.keywords:
+                item.add_marker(skip_tpu)
 
 
 @pytest.fixture()

@@ -1,0 +1,284 @@
+"""End-to-end telemetry layer (skypilot_tpu/telemetry/): data-plane
+metric families on the shared registry, trace-context propagation
+(server -> executor -> agent), nested timeline spans sharing one trace
+file across processes, and JSONL step-telemetry."""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu.metrics import REGISTRY
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
+from skypilot_tpu.telemetry import steplog
+from skypilot_tpu.telemetry import trace as trace_lib
+from skypilot_tpu.utils import timeline
+from tests.test_api_server import live_server  # noqa: F401  (fixture)
+from tests.test_launch_e2e import iso_state  # noqa: F401  (fixture)
+
+
+def _sample(name, labels=None):
+    return REGISTRY.get_sample_value(name, labels or {})
+
+
+# --- metric families / naming contract ---
+
+def test_all_families_use_skytpu_prefix():
+    """Every family on the shared registry carries the skytpu_ prefix —
+    the exposition contract scrape configs and dashboards rely on."""
+    for family in REGISTRY.collect():
+        assert family.name.startswith('skytpu_'), family.name
+
+
+def test_render_metrics_exposes_data_plane_families():
+    from skypilot_tpu import metrics as metrics_lib
+    text = metrics_lib.render_metrics().decode('utf-8')
+    families = {line.split()[2] for line in text.splitlines()
+                if line.startswith('# TYPE ')}
+    data_plane = {f for f in families
+                  if f.startswith(('skytpu_train_', 'skytpu_infer_',
+                                   'skytpu_serve_'))}
+    assert len(data_plane) >= 8, sorted(data_plane)
+
+
+def test_histogram_quantile():
+    for v in (0.01, 0.02, 0.02, 0.2):
+        telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(v)
+    q = telemetry_metrics.histogram_quantile(
+        telemetry_metrics.INFER_DECODE_CHUNK_SECONDS, 0.5)
+    assert q is not None and 0.0 < q <= 0.25
+
+
+# --- data-plane emission from a real (tiny, CPU) train/infer run ---
+
+@pytest.mark.slow
+def test_trainer_fit_populates_train_metrics():
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import TrainConfig, Trainer, synthetic_batches
+    config = llama.LlamaConfig(vocab_size=256, d_model=64, n_layers=2,
+                               n_heads=4, n_kv_heads=2, d_ff=128,
+                               max_seq_len=64, dtype=jnp.float32,
+                               remat=False)
+    mesh = make_mesh(MeshConfig(fsdp=len(jax.devices())))
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    trainer = Trainer(lambda p, b: llama.loss_fn(p, b, config), params,
+                      mesh, sharding_lib.LLAMA_RULES,
+                      TrainConfig(warmup_steps=1, total_steps=3))
+
+    def count(phase):
+        return _sample('skytpu_train_step_duration_seconds_count',
+                       {'phase': phase}) or 0.0
+
+    warmup0, steady0 = count('warmup'), count('steady')
+    steps0 = _sample('skytpu_train_steps_total') or 0.0
+    summary = trainer.fit(synthetic_batches(8, 32, config.vocab_size), 3,
+                          log_every=0, tokens_per_batch=8 * 32,
+                          flops_per_token=6 * config.num_params())
+    assert count('warmup') == warmup0 + 1
+    assert count('steady') == steady0 + 2
+    assert (_sample('skytpu_train_steps_total') or 0.0) == steps0 + 3
+    assert _sample('skytpu_train_tokens_per_second') == pytest.approx(
+        summary['tokens_per_sec'])
+    assert _sample('skytpu_train_loss') == pytest.approx(summary['loss'])
+    assert summary['mfu'] > 0
+    assert _sample('skytpu_train_mfu_ratio') == pytest.approx(
+        summary['mfu'])
+
+
+@pytest.mark.slow
+def test_generator_generate_populates_infer_metrics():
+    import jax
+    from skypilot_tpu.infer import Generator, GeneratorConfig
+    from skypilot_tpu.models import llama
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gen = Generator(params, config,
+                    GeneratorConfig(max_seq_len=64, batch_size=2,
+                                    prompt_buckets=[16]))
+    prefill0 = _sample('skytpu_infer_prefill_duration_seconds_count',
+                       {'bucket': '16'}) or 0.0
+    tokens0 = _sample('skytpu_infer_generated_tokens_total') or 0.0
+    out = gen.generate([[5, 9, 2, 7], [11, 3]], max_new_tokens=8)
+    assert _sample('skytpu_infer_prefill_duration_seconds_count',
+                   {'bucket': '16'}) == prefill0 + 1
+    generated = sum(len(o) for o in out)
+    assert _sample('skytpu_infer_generated_tokens_total') == \
+        tokens0 + generated
+    assert (_sample('skytpu_infer_steady_tokens_per_second') or 0.0) > 0
+
+
+@pytest.mark.slow
+def test_batcher_populates_queue_and_occupancy_metrics():
+    import jax
+    from skypilot_tpu.infer import GeneratorConfig
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    b = ContinuousBatcher(params, config, GeneratorConfig(
+        max_seq_len=64, batch_size=2, temperature=0.0,
+        prompt_buckets=[16]))
+    wait0 = _sample('skytpu_infer_queue_wait_seconds_count') or 0.0
+    rids = [b.submit([5, 9, 2, 7], max_new_tokens=5),
+            b.submit([11, 3], max_new_tokens=5)]
+    b.run_until_idle()
+    assert all(b.result(r) for r in rids)
+    assert (_sample('skytpu_infer_queue_wait_seconds_count') or 0.0) \
+        >= wait0 + 2
+    # Idle after run_until_idle: the occupancy gauge reads 0.
+    assert _sample('skytpu_infer_slot_occupancy_ratio') == 0.0
+
+
+# --- trace-context propagation ---
+
+def test_propagation_envs(monkeypatch, tmp_path):
+    monkeypatch.delenv(trace_lib.ENV_VAR, raising=False)
+    monkeypatch.delenv(timeline.ENV_VAR, raising=False)
+    monkeypatch.delenv('SKYTPU_PROFILE_DIR', raising=False)
+    assert trace_lib.propagation_envs() == {}
+    monkeypatch.setenv(timeline.ENV_VAR, 'rel/trace.json')
+    with trace_lib.trace_scope('abc123'):
+        envs = trace_lib.propagation_envs()
+    assert envs[trace_lib.ENV_VAR] == 'abc123'
+    # Relative paths are absolutized: child processes run elsewhere.
+    assert os.path.isabs(envs[timeline.ENV_VAR])
+
+
+def test_trace_scope_nesting_and_fallback(monkeypatch):
+    monkeypatch.setenv(trace_lib.ENV_VAR, 'from-env')
+    assert trace_lib.get_trace_id() == 'from-env'
+    with trace_lib.trace_scope('outer'):
+        assert trace_lib.get_trace_id() == 'outer'
+        with trace_lib.trace_scope(None):  # no-op scope
+            assert trace_lib.get_trace_id() == 'outer'
+    assert trace_lib.get_trace_id() == 'from-env'
+
+
+def test_trace_id_survives_executor_dispatch(iso_state):  # noqa: F811
+    """The executor rebinds the trace context on its worker side: a
+    payload-stamped id (set by the server middleware) wins; without one
+    the request id itself becomes the trace id."""
+    from skypilot_tpu.server import executor
+    seen = {}
+
+    @executor.entrypoint('test.trace_probe')
+    def _probe(payload):
+        seen[payload['tag']] = trace_lib.get_trace_id()
+        return {}
+
+    try:
+        rid = executor.schedule_request('test.trace_probe',
+                                        {'tag': 'bare'})
+        assert seen['bare'] == rid
+        executor.schedule_request(
+            'test.trace_probe',
+            {'tag': 'stamped', trace_lib.PAYLOAD_KEY: 'stamp123'})
+        assert seen['stamped'] == 'stamp123'
+    finally:
+        executor.REGISTRY.pop('test.trace_probe', None)
+
+
+@pytest.mark.slow
+def test_server_middleware_mints_and_echoes_trace_header(live_server):  # noqa: F811
+    import requests
+    resp = requests.get(live_server + '/api/health', timeout=10)
+    minted = resp.headers.get(trace_lib.TRACE_HEADER)
+    assert minted
+    resp = requests.get(live_server + '/api/health', timeout=10,
+                        headers={trace_lib.TRACE_HEADER: 'caller-id-1'})
+    assert resp.headers.get(trace_lib.TRACE_HEADER) == 'caller-id-1'
+
+
+# --- timeline spans ---
+
+def test_timeline_spans_nest_and_merge_on_save(monkeypatch, tmp_path):
+    path = str(tmp_path / 'trace.json')
+    monkeypatch.setenv(timeline.ENV_VAR, path)
+    with trace_lib.trace_scope('ttrace'):
+        with timeline.Event('outer'):
+            with timeline.Event('inner'):
+                pass
+    timeline.save()
+    events = json.load(open(path))['traceEvents']
+    by_name = {e['name']: e for e in events}
+    assert 'parent' not in by_name['outer'].get('args', {})
+    assert by_name['inner']['args']['parent'] == 'outer'
+    assert by_name['outer']['args']['trace_id'] == 'ttrace'
+    assert by_name['inner']['args']['trace_id'] == 'ttrace'
+    # Second save MERGES (simulating another process appending) and a
+    # drained buffer adds nothing — no duplicate spans.
+    timeline.save()
+    with timeline.Event('later'):
+        pass
+    timeline.save()
+    names = [e['name'] for e in
+             json.load(open(path))['traceEvents']]
+    assert sorted(names) == ['inner', 'later', 'outer']
+
+
+# --- JSONL step-telemetry ---
+
+def test_steplog_roundtrip_and_limits(monkeypatch, tmp_path):
+    path = str(tmp_path / 'steps.jsonl')
+    monkeypatch.delenv(steplog.ENV_VAR, raising=False)
+    assert not steplog.enabled()
+    steplog.write({'kind': 'noop'})  # disabled: silently dropped
+    monkeypatch.setenv(steplog.ENV_VAR, path)
+    assert steplog.enabled()
+    for i in range(5):
+        steplog.write({'kind': 'step', 'i': i})
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write('not json\n')
+    # read() tails the last `limit` LINES and skips malformed ones, so
+    # the garbage line occupies a slot but never surfaces.
+    records = steplog.read(path, limit=3)
+    assert [r['i'] for r in records] == [3, 4]
+    assert all('ts' in r for r in records)
+    assert [r['i'] for r in steplog.read(path)] == [0, 1, 2, 3, 4]
+    assert steplog.read(str(tmp_path / 'missing.jsonl')) == []
+
+
+# --- the acceptance e2e: one launch, one trace file, shared trace id ---
+
+@pytest.mark.slow
+def test_launch_single_trace_file_spans_processes(iso_state,  # noqa: F811
+                                                  monkeypatch, tmp_path):
+    """A single launch with SKYTPU_TIMELINE_FILE set yields ONE trace
+    file whose spans come from more than one process (launcher + agent,
+    at least) and share a common trace id."""
+    from skypilot_tpu import execution
+    from tests.test_launch_e2e import _make_task, _wait_job
+    path = str(tmp_path / 'launch-trace.json')
+    monkeypatch.setenv(timeline.ENV_VAR, path)
+    monkeypatch.setenv(trace_lib.ENV_VAR, 'e2e-trace-1')
+    job_id, handle = execution.launch(_make_task(run='echo traced'),
+                                      cluster_name='ttrace',
+                                      detach_run=True)
+    from skypilot_tpu.utils.status_lib import JobStatus
+    assert _wait_job(handle, job_id) == JobStatus.SUCCEEDED
+    timeline.save()  # flush the launcher's stage spans
+
+    def snapshot():
+        try:
+            return json.load(open(path)).get('traceEvents', [])
+        except (OSError, ValueError):
+            return []
+
+    # The agent flushes its spans on submit; the gang driver at exit.
+    deadline = time.time() + 30
+    events = snapshot()
+    while time.time() < deadline and \
+            len({e['pid'] for e in events}) < 2:
+        time.sleep(0.5)
+        events = snapshot()
+    names = {e['name'] for e in events}
+    assert 'stage:PROVISION' in names and 'stage:EXEC' in names
+    assert 'agent.submit' in names
+    assert len({e['pid'] for e in events}) >= 2, names
+    traced = {e['args']['trace_id'] for e in events
+              if 'trace_id' in e.get('args', {})}
+    assert traced == {'e2e-trace-1'}
