@@ -65,6 +65,21 @@ class GeneratorConfig:
     # every in-flight generation for its full forward (the vLLM
     # chunked-prefill scheduling idea).  None = whole-prompt prefill.
     prefill_chunk: Optional[int] = None
+    # KV-cache LENGTH buckets (ascending): the cache is allocated at
+    # the smallest bucket that covers the live slots' max position and
+    # pad-migrated up (or truncated down) as generations cross bucket
+    # boundaries, so per-step HBM cache traffic scales with LIVE
+    # context, not max_seq_len.  Each bucket is its own compiled decode
+    # shape (bounded set).  None → powers of two from 64 up to
+    # max_seq_len; [max_seq_len] → the old fixed-max_len behavior.
+    # With decode_impl='paged' every bucket must satisfy the kernel's
+    # max_len % 64 == 0 constraint (the default power-of-two set does).
+    cache_buckets: Optional[Sequence[int]] = None
+    # Steps per fused on-device decode chunk (fori_loop with in-loop
+    # sampling and EOS/done tracking): ONE device→host transfer per
+    # chunk instead of one per token.  1 degenerates to a per-step
+    # host loop (the parity-test reference).
+    decode_chunk: int = 32
 
 
 def prepare_params(params, gen_config: 'GeneratorConfig'):
@@ -119,6 +134,47 @@ def derive_buckets(gen_config: 'GeneratorConfig'):
     return buckets
 
 
+def derive_cache_buckets(gen_config: 'GeneratorConfig'):
+    """Cache-LENGTH buckets (shared by Generator and ContinuousBatcher
+    so their compile sets match).  Distinct from derive_buckets: prompt
+    buckets bound PROMPT lengths (user-tunable down to tiny values);
+    cache buckets bound the decode cache's position capacity and must
+    always reach max_seq_len so any admitted generation can run to the
+    context ceiling — the largest bucket is forced to max_seq_len."""
+    if gen_config.cache_buckets:
+        buckets = sorted(set(int(b) for b in gen_config.cache_buckets))
+        if buckets[0] <= 0:
+            raise ValueError(
+                f'cache_buckets must be positive, got {buckets}')
+        if buckets[-1] > gen_config.max_seq_len:
+            raise ValueError(
+                f'Largest cache bucket {buckets[-1]} exceeds '
+                f'max_seq_len {gen_config.max_seq_len}')
+        if buckets[-1] != gen_config.max_seq_len:
+            buckets.append(gen_config.max_seq_len)
+        return buckets
+    buckets, b = [], 64
+    while b < gen_config.max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(gen_config.max_seq_len)
+    return buckets
+
+
+def host_fetch(*arrays):
+    """THE device→host transfer point of the decode data path: every
+    fetch of decode results (token blocks, positions, done flags) in
+    both engines goes through this one call, so the sync-free streaming
+    contract — O(1) transfers per decode CHUNK, never per token — is
+    countable (skytpu_infer_host_syncs_total) and testable (the parity
+    suite monkeypatches this module attribute with a counting wrapper).
+    Multiple arrays fetched together count as ONE sync: they ride one
+    dispatch boundary, and that boundary's latency is what the fused
+    decode loop exists to amortize."""
+    telemetry_metrics.INFER_HOST_SYNCS.inc()
+    return tuple(np.asarray(a) for a in arrays)
+
+
 @dataclasses.dataclass
 class DecodeState:
     """Host-side view of one generation in flight."""
@@ -146,17 +202,32 @@ class Generator:
         self.config = config
         self.gen = gen_config
         self.buckets = derive_buckets(gen_config)
+        self.cache_buckets = derive_cache_buckets(gen_config)
+        if gen_config.decode_chunk < 1:
+            raise ValueError(f'decode_chunk must be >= 1, got '
+                             f'{gen_config.decode_chunk}')
 
         self._prefill = jax.jit(self._prefill_impl)
-        # Decode runs in on-device chunks (lax.scan over steps): one
-        # host fetch per chunk instead of one per token — the per-token
-        # device→host sync would dominate wall clock otherwise.
+        # Fused multi-step decode (fori_loop over steps with in-loop
+        # sampling + EOS/done tracking): ONE host fetch per chunk
+        # instead of one per token — the per-token device→host sync
+        # would dominate wall clock otherwise.  Compiled per
+        # (n, cache bucket) pair: a bounded set.
         self._decode_chunk = jax.jit(
             functools.partial(self._decode_chunk_impl,
                               temperature=gen_config.temperature,
                               top_k=gen_config.top_k,
-                              top_p=gen_config.top_p),
+                              top_p=gen_config.top_p,
+                              eos=gen_config.eos_token),
             static_argnames=('n',))
+        # Bucket migration: pad/truncate the cache's position axis on
+        # device — one on-device copy, no host round-trip.  (Not
+        # donated: the output shape always differs from the input's, so
+        # XLA could never alias the buffers anyway.)
+        self._resize = jax.jit(
+            lambda cache, new_len: self._constrain(
+                llama_infer.resize_cache(cache, new_len)),
+            static_argnames=('new_len',))
         self._sample = jax.jit(lambda logits, rng: tp_lib.replicate(
             sampling.sample_logits(
                 logits, rng, temperature=gen_config.temperature,
@@ -174,26 +245,52 @@ class Generator:
             return cache
         return tp_lib.constrain_cache(cache, self.mesh)
 
-    def _decode_chunk_impl(self, params, token, cache, positions, rng,
-                           *, n, temperature, top_k, top_p):
-        """n decode steps fully on device → tokens (B, n) + final state."""
-
+    def _decode_chunk_impl(self, params, token, cache, positions, done,
+                           limit, rng, *, n, temperature, top_k, top_p,
+                           eos):
+        """n fused decode steps fully on device (fori_loop): in-loop
+        sampling (greedy or temperature/top-k/top-p via the shared
+        Gumbel-max sampler) and per-row EOS/budget tracking, emitting a
+        (B, n) token block — host syncs are O(1) per CHUNK, not per
+        token.  Done rows FREEZE: position and feed token stop
+        advancing (their lockstep compute rewrites the same cache row,
+        costing nothing extra) and they emit the fill token; `limit` is
+        each row's remaining token budget, decremented only while
+        live."""
         decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
+        batch = token.shape[0]
+        fill = jnp.int32(eos if eos is not None else 0)
 
-        def step(carry, _):
-            token, cache, positions, rng = carry
+        def body(i, carry):
+            token, cache, positions, done, limit, rng, toks = carry
             rng, sub = jax.random.split(rng)
             logits, cache = decode_fn(
                 params, token, self.config, cache, positions)
             nxt = sampling.sample_logits(
                 logits, sub, temperature=temperature, top_k=top_k,
                 top_p=top_p)
-            return (nxt, cache, positions + 1, rng), nxt
+            live = jnp.logical_not(done)
+            emit = jnp.where(live, nxt, fill)
+            limit = limit - live.astype(jnp.int32)
+            hit_eos = ((nxt == eos) if eos is not None
+                       else jnp.zeros_like(done))
+            done = done | (live & (hit_eos | (limit <= 0)))
+            positions = positions + live.astype(jnp.int32)
+            token = jnp.where(live, nxt, token)
+            toks = toks.at[i].set(emit)
+            return (token, cache, positions, done, limit, rng, toks)
 
-        (token, cache, positions, rng), toks = jax.lax.scan(
-            step, (token, cache, positions, rng), None, length=n)
-        toks = tp_lib.replicate(jnp.swapaxes(toks, 0, 1), self.mesh)
-        return toks, token, self._constrain(cache), positions, rng
+        token, cache, positions, done, limit, rng, toks = \
+            jax.lax.fori_loop(
+                0, n, body,
+                (token, cache, positions, done, limit, rng,
+                 jnp.zeros((n, batch), jnp.int32)))
+
+        def rep(x):
+            return tp_lib.replicate(x, self.mesh)
+        return (rep(jnp.swapaxes(toks, 0, 1)), token,
+                self._constrain(cache), rep(positions), rep(done),
+                limit, rng)
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -203,14 +300,22 @@ class Generator:
             f'Prompt length {length} exceeds the largest bucket '
             f'{self.buckets[-1]} (max_seq_len {self.gen.max_seq_len})')
 
+    def _cache_bucket_for(self, rows: int) -> int:
+        """Smallest cache bucket with at least `rows` position rows."""
+        for b in self.cache_buckets:
+            if rows <= b:
+                return b
+        return self.cache_buckets[-1]
+
     def warmup(self, bucket: Optional[int] = None) -> None:
         """Compile prefill (smallest bucket by default) + the full-size
         decode chunk so the first request reflects steady-state latency
         (readiness probes)."""
         b = bucket or self.buckets[0]
-        # 33 = prefill token + one full 32-step decode chunk.
+        # Prefill token + one full fused decode chunk.
         self.generate([[1] * 2], max_new_tokens=min(
-            33, self.gen.max_seq_len - 2), _bucket=b)
+            1 + self.gen.decode_chunk, self.gen.max_seq_len - 2),
+            _bucket=b)
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 64,
@@ -236,8 +341,14 @@ class Generator:
             tokens[i, :len(p)] = np.asarray(p, np.int32)
             lens[i] = len(p)
 
+        # Bucketed cache: allocate at the smallest bucket covering the
+        # prefill write (bucket rows) and the first decode write
+        # (max prompt len + 1), NOT at max_seq_len — per-step attention
+        # HBM traffic scales with the live bucket.  Grows later as
+        # generations cross bucket boundaries.
+        cache_len = self._cache_bucket_for(max(bucket, max(lengths) + 1))
         cache = llama_infer.init_cache(
-            self.config, batch, self.gen.max_seq_len,
+            self.config, batch, cache_len,
             sharding=(None if self.mesh is None
                       else tp_lib.cache_sharding(self.mesh)),
             kv_dtype=self.gen.kv_cache_dtype)
@@ -250,14 +361,14 @@ class Generator:
         token = self._sample(logits, sub)
         # The host fetch below is the barrier that makes this a real
         # dispatch-to-first-token time (includes sampling).
-        first_host = np.asarray(token)
+        (first_host,) = host_fetch(token)
+        syncs = 1
         telemetry_metrics.INFER_PREFILL_SECONDS.labels(
             bucket=str(bucket)).observe(time.perf_counter() - prefill_start)
 
         eos = self.gen.eos_token
         out: List[List[int]] = [[] for _ in range(batch)]
         done = [False] * batch
-        positions = jnp.asarray(lens)
 
         def _absorb(host_tokens: np.ndarray) -> bool:
             """Append a (B, n) host chunk, trimming at eos.  True = all
@@ -272,35 +383,72 @@ class Generator:
             return all(done[i] or len(out[i]) >= max_new
                        for i in range(len(prompts)))
 
-        # First token came from prefill; the rest stream in on-device
-        # chunks (bounded chunk-size set → bounded compile set).
+        # Device-side per-row decode state: done rows FREEZE inside the
+        # fused chunk (pad rows start done; a first-token eos finishes a
+        # row before any chunk runs); limit is the remaining budget (the
+        # first token already shipped, hence max_new - 1).
+        positions = jnp.asarray(lens)
+        host_positions = lens.copy()
+        host_done = np.ones((batch,), bool)
+        limit0 = np.zeros((batch,), np.int32)
+        for i in range(len(prompts)):
+            host_done[i] = eos is not None and int(first_host[i]) == eos
+            limit0[i] = max_new - 1
+        done_dev = jnp.asarray(host_done)
+        limit_dev = jnp.asarray(limit0)
+
+        # First token came from prefill; the rest stream in fused
+        # on-device chunks (bounded (chunk, cache bucket) compile set).
         decode_seconds = 0.0
         dispatched = 0
         try:
             if _absorb(first_host[:, None]):
                 return [out[i] for i in range(len(prompts))]
-            remaining = max_new - 1
-            chunk = 32
+            chunk = self.gen.decode_chunk
             with profile_window('generate'):
-                while remaining > 0:
-                    # Always run a FULL chunk when cache capacity allows,
-                    # even past max_new (host trims): one compiled decode
-                    # shape beats saving the overshot steps.  A smaller
-                    # chunk only near the cache end.
-                    capacity = self.gen.max_seq_len - int(np.max(positions))
-                    n = min(chunk, capacity)
+                while True:
+                    live = [i for i in range(len(prompts))
+                            if not host_done[i] and not done[i]
+                            and len(out[i]) < max_new]
+                    if not live:
+                        break
+                    # Always run a FULL chunk when context capacity
+                    # allows, even past max_new (the device limit
+                    # freezes rows; the host trims): one compiled
+                    # decode shape beats saving the overshot steps.  A
+                    # smaller chunk only near the context ceiling.
+                    live_max = max(int(host_positions[i]) for i in live)
+                    n = min(chunk, self.gen.max_seq_len - live_max)
                     if n <= 0:
                         break
+                    # Bucket crossing: this chunk's last write lands at
+                    # row live_max + n - 1 → migrate before dispatch.
+                    target = self._cache_bucket_for(live_max + n)
+                    if target != cache_len:
+                        telemetry_metrics.INFER_CACHE_MIGRATIONS.labels(
+                            direction=('grow' if target > cache_len
+                                       else 'shrink')).inc()
+                        cache = self._resize(cache, new_len=target)
+                        cache_len = target
                     chunk_start = time.perf_counter()
-                    toks, token, cache, positions, rng = self._decode_chunk(
-                        self.params, token, cache, positions, rng, n=n)
-                    host_toks = np.asarray(toks)  # barrier for the chunk
+                    (toks, token, cache, positions, done_dev, limit_dev,
+                     rng) = self._decode_chunk(
+                         self.params, token, cache, positions, done_dev,
+                         limit_dev, rng, n=n)
+                    # ONE transfer for the whole chunk: token block +
+                    # the control rows that steer the next iteration.
+                    host_toks, host_positions, host_done = host_fetch(
+                        toks, positions, done_dev)
+                    syncs += 1
                     chunk_dt = time.perf_counter() - chunk_start
                     telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(
                         chunk_dt)
+                    telemetry_metrics.INFER_DECODE_BUCKET_CHUNKS.labels(
+                        bucket=str(cache_len)).inc()
+                    telemetry_metrics.INFER_DECODE_CACHE_ROWS.set(
+                        cache_len)
                     decode_seconds += chunk_dt
                     dispatched += n * len(prompts)
-                    remaining -= n
                     if _absorb(host_toks):
                         break
             return [out[i] for i in range(len(prompts))]
@@ -308,5 +456,7 @@ class Generator:
             if decode_seconds > 0:
                 telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
                     dispatched / decode_seconds)
-            telemetry_metrics.INFER_GENERATED_TOKENS.inc(
-                sum(len(out[i]) for i in range(len(prompts))))
+            total = sum(len(out[i]) for i in range(len(prompts)))
+            telemetry_metrics.INFER_GENERATED_TOKENS.inc(total)
+            telemetry_metrics.INFER_HOST_SYNCS_PER_TOKEN.set(
+                syncs / max(total, 1))

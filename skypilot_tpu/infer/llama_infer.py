@@ -64,6 +64,35 @@ def init_cache(config: llama.LlamaConfig, batch: int,
             'v_scale': jnp.zeros(shape[:-1], jnp.float32, **scale_kwargs)}
 
 
+def resize_cache(cache: Cache, new_len: int) -> Cache:
+    """Pad (zeros) or truncate the cache's position axis (2) to new_len
+    — the bucket-migration primitive of the length-bucketed decode path.
+
+    Zero-padded tail rows are invisible: every decode variant masks
+    attention with `slot <= position`, and a position only reaches a new
+    row after the row's real K/V write (decode writes before it
+    attends).  Truncation is only legal when every live slot's position
+    is < new_len — the engines guarantee it (they shrink from host-side
+    position bookkeeping, never speculatively).  Works on both cache
+    layouts: k/v (L, B, S, KV, hd) and the int8 scales (L, B, S, KV)
+    share the position axis.  Callers jit this with new_len static and
+    the cache donated so the migration is one on-device copy, not an
+    alloc + copy + host round-trip.
+    """
+    cur = cache['k'].shape[2]
+    if new_len == cur:
+        return cache
+    out = {}
+    for key, arr in cache.items():
+        if new_len > cur:
+            pad = [(0, 0)] * arr.ndim
+            pad[2] = (0, new_len - cur)
+            out[key] = jnp.pad(arr, pad)
+        else:
+            out[key] = jax.lax.slice_in_dim(arr, 0, new_len, axis=2)
+    return out
+
+
 def _quantize_kv(x: jax.Array):
     """(..., hd) -> (int8 values, f32 absmax scale over hd)."""
     scale = jnp.maximum(
@@ -315,21 +344,37 @@ def encode(params: llama.Params, tokens: jax.Array,
     return pooled.astype(jnp.float32)
 
 
-def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config):
+def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config,
+                    k_scale=None, v_scale=None):
     """Per-token GQA attention + MLP residual block AFTER the cache
     update — the math shared verbatim by all three decode
     implementations (scan / inplace / unrolled), so a numerics fix
-    lands in one place."""
+    lands in one place.
+
+    int8 cache path (k_scale/v_scale (B, S, KV) given): k_eff/v_eff are
+    the RAW int8 cache slices and the per-token absmax scales are
+    applied AFTER each contraction — to the (B, KV, G, 1, S) score
+    block and to the probabilities — instead of materializing a
+    dequantized (B, S, KV, hd) copy of the layer's cache per step.
+    Scale-after-matmul is exact (the scale is constant over the
+    contracted hd axis), and it is what closes the int8_w_kv roofline
+    gap: the dominant decode read stays int8 bytes end-to-end."""
     batch = h.shape[0]
     attn_p = layer_params['attn']
     group = config.n_heads // config.n_kv_heads
     q_g = q.reshape(batch, 1, config.n_kv_heads, group, config.head_dim)
     scale = config.head_dim ** -0.5
-    s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff,
+    s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff.astype(q.dtype),
                    preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:
+        # (B, S, KV) -> (B, KV, 1, 1, S) onto the score block.
+        s = s * jnp.swapaxes(k_scale, 1, 2)[:, :, None, None, :]
     s = jnp.where(visible[:, None, None, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff)
+    p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        p = p * jnp.swapaxes(v_scale, 1, 2)[:, :, None, None, :]
+    p = p.astype(q.dtype)
+    o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff.astype(q.dtype))
     h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
     x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                              eps=config.norm_eps)
@@ -405,14 +450,17 @@ def decode_step_inplace(params: llama.Params, token: jax.Array,
                 .set(k_s_row),
                 v_scale=cache['v_scale'].at[i, b_idx, positions]
                 .set(v_s_row))
-            k_eff = _dequantize(
-                jax.lax.dynamic_index_in_dim(cache['k'], i, 0, False),
-                jax.lax.dynamic_index_in_dim(cache['k_scale'], i, 0,
-                                             False), q.dtype)
-            v_eff = _dequantize(
-                jax.lax.dynamic_index_in_dim(cache['v'], i, 0, False),
-                jax.lax.dynamic_index_in_dim(cache['v_scale'], i, 0,
-                                             False), q.dtype)
+            # RAW int8 slices + scales: _token_attn_mlp applies the
+            # scales after each contraction — no dequantized layer copy
+            # is materialized on the decode hot path.
+            k_eff = jax.lax.dynamic_index_in_dim(cache['k'], i, 0,
+                                                 False)
+            v_eff = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
+                                                 False)
+            k_s = jax.lax.dynamic_index_in_dim(cache['k_scale'], i, 0,
+                                               False)
+            v_s = jax.lax.dynamic_index_in_dim(cache['v_scale'], i, 0,
+                                               False)
         else:
             cache = dict(
                 cache,
@@ -422,8 +470,9 @@ def decode_step_inplace(params: llama.Params, token: jax.Array,
                                                  False)
             v_eff = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
                                                  False)
+            k_s = v_s = None
         h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible,
-                            config)
+                            config, k_scale=k_s, v_scale=v_s)
         return (h, cache)
 
     h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
@@ -562,17 +611,16 @@ def decode_step_unrolled(params: llama.Params, token: jax.Array,
                 i, b_idx, positions].set(k_s_row)
             cache['v_scale'] = cache['v_scale'].at[
                 i, b_idx, positions].set(v_s_row)
-            k_eff = _dequantize(cache['k'][i], cache['k_scale'][i],
-                                q.dtype)
-            v_eff = _dequantize(cache['v'][i], cache['v_scale'][i],
-                                q.dtype)
+            k_eff, v_eff = cache['k'][i], cache['v'][i]
+            k_s, v_s = cache['k_scale'][i], cache['v_scale'][i]
         else:
             cache['k'] = cache['k'].at[i, b_idx, positions].set(k[:, 0])
             cache['v'] = cache['v'].at[i, b_idx, positions].set(v[:, 0])
             k_eff = cache['k'][i]
             v_eff = cache['v'][i]
+            k_s = v_s = None
         h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible,
-                            config)
+                            config, k_scale=k_s, v_scale=v_s)
 
     h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
     logits = quant.matmul(h[:, 0], params['lm_head'],
@@ -624,12 +672,13 @@ def decode_step(params: llama.Params, token: jax.Array,
             v_cache = v_cache.at[b_idx, positions].set(v_q)
             k_s = k_s.at[b_idx, positions].set(k_s_new)
             v_s = v_s.at[b_idx, positions].set(v_s_new)
-            k_eff = _dequantize(k_cache, k_s, q.dtype)
-            v_eff = _dequantize(v_cache, v_s, q.dtype)
+            k_eff, v_eff = k_cache, v_cache
+            k_s_eff, v_s_eff = k_s, v_s
         else:
             k_cache = k_cache.at[b_idx, positions].set(k[:, 0])
             v_cache = v_cache.at[b_idx, positions].set(v[:, 0])
             k_eff, v_eff = k_cache, v_cache
+            k_s_eff = v_s_eff = None
         # GQA attention of the single query over the cache prefix: the
         # query is contracted in (KV, group) blocks against the
         # UN-repeated cache inside _token_attn_mlp — decode is
@@ -637,7 +686,7 @@ def decode_step(params: llama.Params, token: jax.Array,
         # multiply the dominant memory traffic by the group factor
         # (4x for Llama-3 8B).
         h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible,
-                            config)
+                            config, k_scale=k_s_eff, v_scale=v_s_eff)
         if quantized:
             return h, (k_cache, v_cache, k_s, v_s)
         return h, (k_cache, v_cache)

@@ -63,7 +63,12 @@ def _host_main(host_id: int, num_hosts: int, devices_per_host: int,
     """One emulated host (runs in its own process)."""
     import jax
     jax.config.update('jax_platforms', 'cpu')
-    jax.config.update('jax_num_cpu_devices', devices_per_host)
+    try:
+        jax.config.update('jax_num_cpu_devices', devices_per_host)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices option; run_check pins
+        # the virtual device count via XLA_FLAGS instead.
+        pass
     jax.distributed.initialize(
         coordinator_address=f'127.0.0.1:{coord_port}',
         num_processes=num_hosts, process_id=host_id)
@@ -107,9 +112,12 @@ def run_check(num_hosts: int = 2, devices_per_host: int = 2,
     control_port = common_utils.find_free_port(coord_port + 1)
 
     env = dict(os.environ)
-    # The pytest/driver XLA_FLAGS (forced host device count) leaks into
-    # children and would override devices_per_host; scrub it.
-    env.pop('XLA_FLAGS', None)
+    # Replace any leaked pytest/driver XLA_FLAGS (its forced host
+    # device count would override devices_per_host) with the child's
+    # own: the XLA flag also covers jax < 0.5, where _host_main's
+    # jax_num_cpu_devices config option does not exist.
+    env['XLA_FLAGS'] = (f'--xla_force_host_platform_device_count='
+                        f'{devices_per_host}')
     env['JAX_PLATFORMS'] = 'cpu'
 
     procs = []

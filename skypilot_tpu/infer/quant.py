@@ -69,7 +69,17 @@ def matmul(x: jax.Array, w: Any, out_dtype=None) -> jax.Array:
     per-channel scale to the result in f32 before casting to out_dtype.
     """
     if is_quantized(w):
-        y = (x @ w['q'].astype(x.dtype)).astype(jnp.float32)
+        q = w['q']
+        # dot_general with preferred_element_type=f32: the int8→x.dtype
+        # convert fuses into the MXU operand read AND the product
+        # accumulates straight into f32 — no (batch, out) low-precision
+        # intermediate is materialized and then upcast, which is what
+        # the naive `(x @ q.astype).astype(f32)` lowering did.  The
+        # per-out-channel rescale stays on the small result.
+        y = jax.lax.dot_general(
+            x, q.astype(x.dtype),
+            dimension_numbers=(((x.ndim - 1,), (q.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32)
         y = y * w['s'].astype(jnp.float32)
         return y.astype(out_dtype or x.dtype)
     y = x @ w

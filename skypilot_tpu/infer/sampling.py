@@ -13,6 +13,21 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def gumbel_argmax(logits: jax.Array, rng: jax.Array) -> jax.Array:
+    """Exact categorical draw via the Gumbel-max trick:
+    argmax(logits + G), G ~ Gumbel(0,1) iid, samples softmax(logits).
+
+    This is THE sampling primitive of both engines' decode loops: it is
+    a pure map + reduce (no inverse-CDF scan), so it fuses into the
+    jitted multi-step decode body, and because the per-step host loop
+    and the fused fori_loop path both draw through this one function
+    with the same key schedule, their token streams are identical by
+    construction (the CPU parity tests lock that)."""
+    g = jax.random.gumbel(rng, logits.shape, jnp.float32)
+    return jnp.argmax(logits.astype(jnp.float32) + g,
+                      axis=-1).astype(jnp.int32)
+
+
 def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
     """Keep the k highest logits per row, mask the rest to -inf."""
     kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
@@ -57,7 +72,7 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
         logits = _mask_top_k(logits, top_k)
     if top_p is not None and 0.0 < top_p < 1.0:
         logits = _mask_top_p(logits, top_p)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return gumbel_argmax(logits, rng)
 
 
 def sample_logits_batched(logits: jax.Array, rng: jax.Array,
@@ -84,6 +99,5 @@ def sample_logits_batched(logits: jax.Array, rng: jax.Array,
         scaled = _mask_top_k(scaled, top_k)
     if nucleus:
         scaled = _mask_top_p(scaled, top_p)
-    sampled = jax.random.categorical(rng, scaled, axis=-1
-                                     ).astype(jnp.int32)
+    sampled = gumbel_argmax(scaled, rng)
     return jnp.where(temperature <= 0.0, greedy, sampled)

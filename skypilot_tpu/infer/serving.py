@@ -5,16 +5,23 @@ recipes (llm/vllm/service.yaml): requests join and leave the decode batch
 WITHOUT waiting for the whole batch to finish.  TPU-first adaptation —
 everything keeps a static shape so nothing recompiles at steady state:
 
-- The KV cache holds `batch_size` SLOTS (L, B, max_len, KV, D).  A request
-  occupies one slot from prefill to eos/max-tokens, then the slot is
-  immediately handed to the next queued request.
+- The KV cache holds `batch_size` SLOTS (L, B, cache_len, KV, D), where
+  cache_len is the smallest LENGTH BUCKET covering the live batch's max
+  context (pad-migrated up / truncated down at bucket crossings, each
+  bucket one compiled decode shape) — per-step cache traffic scales
+  with live context, not max_seq_len.  A request occupies one slot from
+  prefill to eos/max-tokens, then the slot is immediately handed to the
+  next queued request.
 - Queued requests are admitted in GROUPS: one bucketed prefill forward
   covers up to admit_group prompts and scatters each row into its slot
   (bounded compile set: group sizes × prompt buckets).  Sequential
   per-request prefills would pay one dispatch + host round-trip each.
-- Decode always steps ALL slots in lockstep, (B, 1) shapes; free slots
-  decode garbage at position 0 of their (about-to-be-overwritten) cache —
-  masked on the host, costing nothing but the already-paid lockstep FLOPs.
+- Decode runs FUSED multi-step chunks over ALL slots in lockstep:
+  sampling and per-slot EOS/budget tracking stay on device, so the
+  host sees ONE transfer per chunk (tokens + positions + done rows),
+  never one per token.  Done/free slots freeze — their lockstep
+  compute rewrites one dead cache row and emits a fill token the host
+  absorber drops.
 
 Usage (the serve replica drives this from its request handler):
 
@@ -36,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import llama_infer, sampling
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
@@ -75,6 +83,7 @@ class ContinuousBatcher:
             tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
         from skypilot_tpu.infer.engine import (derive_buckets,
+                                               derive_cache_buckets,
                                                prepare_params,
                                                validate_context)
         validate_context(gen_config, config)
@@ -88,23 +97,41 @@ class ContinuousBatcher:
         self.gen = gen_config
         self.decode_chunk = decode_chunk
         self.buckets = derive_buckets(gen_config)
+        self.cache_buckets = derive_cache_buckets(gen_config)
 
         batch = gen_config.batch_size
+        # Bucketed slot cache: starts at the SMALLEST bucket and
+        # pad-migrates up (truncates down) as admissions and live
+        # contexts cross bucket boundaries, so lockstep decode's
+        # per-step cache traffic tracks the live batch's max context,
+        # not max_seq_len.
+        self._cache_len = self.cache_buckets[0]
         self._cache = llama_infer.init_cache(
-            config, batch, gen_config.max_seq_len,
+            config, batch, self._cache_len,
             sharding=(None if mesh is None
                       else tp_lib.cache_sharding(mesh)),
             kv_dtype=gen_config.kv_cache_dtype)
-        self._token = jnp.zeros((batch,), jnp.int32)
-        self._positions = jnp.zeros((batch,), jnp.int32)
+        def _row(value):
+            row_sh = tp_lib.replicated_sharding(mesh)
+            return value if row_sh is None else jax.device_put(
+                value, row_sh)
+
+        self._token = _row(jnp.zeros((batch,), jnp.int32))
+        self._positions = _row(jnp.zeros((batch,), jnp.int32))
+        # Device-side decode state: done rows FREEZE inside the fused
+        # decode chunk (free slots start done — they no longer burn
+        # cache-walk garbage writes past row 0); limit is each active
+        # row's remaining token budget.
+        self._done = _row(jnp.ones((batch,), bool))
+        self._limit = _row(jnp.zeros((batch,), jnp.int32))
         # Per-SLOT sampling params (device operands of the decode
         # program — one compile serves every request mix); host mirror
         # of "any non-greedy slot" picks the cheap all-greedy program.
-        self._temp_row = jnp.full((batch,), gen_config.temperature,
-                                  jnp.float32)
-        self._top_p_row = jnp.full(
+        self._temp_row = _row(jnp.full((batch,), gen_config.temperature,
+                                       jnp.float32))
+        self._top_p_row = _row(jnp.full(
             (batch,), gen_config.top_p if gen_config.top_p else 1.0,
-            jnp.float32)
+            jnp.float32))
         self._host_temp = np.full((batch,), gen_config.temperature,
                                   np.float32)
         self._host_top_p = np.full(
@@ -127,12 +154,20 @@ class ContinuousBatcher:
         # admit_group compiles per prompt bucket).
         self._admit_group = max(1, min(4, batch))
         self._prefill_group = jax.jit(functools.partial(
-            self._prefill_group_impl, config=config), donate_argnums=(2,),
+            self._prefill_group_impl, config=config,
+            eos=gen_config.eos_token), donate_argnums=(2,),
             static_argnames=())
         self._decode = jax.jit(functools.partial(
-            self._decode_impl, top_k=gen_config.top_k),
+            self._decode_impl, top_k=gen_config.top_k,
+            eos=gen_config.eos_token),
             donate_argnums=(2,),
             static_argnames=('n', 'all_greedy', 'nucleus'))
+        # Bucket migration: pad/truncate the cache's position axis on
+        # device (one copy, no host round-trip).
+        self._resize = jax.jit(
+            lambda cache, new_len: tp_lib.constrain_cache(
+                llama_infer.resize_cache(cache, new_len), self.mesh),
+            static_argnames=('new_len',))
         # Chunked prefill (gen_config.prefill_chunk): one window of one
         # long prompt per scheduler tick, interleaved with decode.
         self._incremental: Optional[_Request] = None
@@ -141,12 +176,14 @@ class ContinuousBatcher:
                 p, t, config, c, s, st),
             donate_argnums=(2,))
         self._install_first = jax.jit(functools.partial(
-            self._install_first_impl, top_k=gen_config.top_k))
+            self._install_first_impl, top_k=gen_config.top_k,
+            eos=gen_config.eos_token))
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
-                            slots, token_row, pos_row, temp_row,
-                            top_p_row, temps, top_ps, rng, *, config):
+                            slots, token_row, pos_row, done_row,
+                            limit_row, temp_row, top_p_row, temps,
+                            top_ps, limits, rng, *, config, eos):
         """Prefill a GROUP of prompts (G, bucket) in one forward and
         install each row into its slot.  G is the ACTUAL group size
         (1..admit_group): at most admit_group compiles per prompt
@@ -155,8 +192,11 @@ class ContinuousBatcher:
         admission amortizes what used to be G sequential prefill
         dispatches (each a full tunnel round-trip) into one."""
         group = tokens.shape[0]
+        # The scratch cache mirrors the big cache's CURRENT bucket (its
+        # position capacity is a runtime property of the operand, so
+        # each bucket is simply part of this program's compiled shape).
         small = llama_infer.init_cache(config, group,
-                                       self.gen.max_seq_len,
+                                       big_cache['k'].shape[2],
                                        kv_dtype=self.gen.kv_cache_dtype)
         logits, small = llama_infer.prefill(
             params, tokens, config=config, cache=small, lengths=lengths)
@@ -170,22 +210,36 @@ class ContinuousBatcher:
         firsts = tp_lib.replicate(sampling.sample_logits_batched(
             logits, sub, temps, top_ps, top_k=self.gen.top_k),
             self.mesh)
+        # A request can finish ON its first token (eos, or a 1-token
+        # budget): its slot must enter the decode loop already frozen.
+        first_done = ((firsts == eos) if eos is not None
+                      else jnp.zeros(firsts.shape, bool)) | (limits <= 0)
         token_row = token_row.at[slots].set(firsts)
         pos_row = pos_row.at[slots].set(lengths)
+        done_row = done_row.at[slots].set(first_done)
+        limit_row = limit_row.at[slots].set(limits)
         temp_row = temp_row.at[slots].set(temps)
         top_p_row = top_p_row.at[slots].set(top_ps)
-        return (big_cache, token_row, pos_row, temp_row, top_p_row,
-                firsts, rng)
+        return (big_cache, token_row, pos_row, done_row, limit_row,
+                temp_row, top_p_row, firsts, rng)
 
-    def _decode_impl(self, params, token, cache, positions, temp_row,
-                     top_p_row, rng, *, n, all_greedy, nucleus, top_k):
+    def _decode_impl(self, params, token, cache, positions, done, limit,
+                     temp_row, top_p_row, rng, *, n, all_greedy,
+                     nucleus, top_k, eos):
         # all_greedy (static): every active slot decodes greedily, so
         # the sampler is a plain argmax — no per-step vocab sort.  Two
-        # compiled variants total; the host picks from its temp mirror.
+        # compiled variants per cache bucket; the host picks from its
+        # temp mirror.  Fused fori_loop: n steps with in-loop sampling
+        # and per-slot EOS/budget tracking — ONE host transfer per
+        # chunk.  Done slots FREEZE (position and feed token stop
+        # advancing; their lockstep compute rewrites one dead cache row)
+        # and emit the fill token, which the host absorber drops.
         decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
+        batch = token.shape[0]
+        fill = jnp.int32(eos if eos is not None else 0)
 
-        def step(carry, _):
-            token, cache, positions, rng = carry
+        def body(i, carry):
+            token, cache, positions, done, limit, rng, toks = carry
             rng, sub = jax.random.split(rng)
             logits, cache = decode_fn(
                 params, token, self.config, cache, positions)
@@ -198,20 +252,36 @@ class ContinuousBatcher:
                 nxt = sampling.sample_logits_batched(
                     logits, sub, temp_row, top_p_row, top_k=top_k,
                     nucleus=nucleus)
-            return (nxt, cache, positions + 1, rng), nxt
+            live = jnp.logical_not(done)
+            emit = jnp.where(live, nxt, fill)
+            limit = limit - live.astype(jnp.int32)
+            hit_eos = ((nxt == eos) if eos is not None
+                       else jnp.zeros_like(done))
+            done = done | (live & (hit_eos | (limit <= 0)))
+            positions = positions + live.astype(jnp.int32)
+            token = jnp.where(live, nxt, token)
+            toks = toks.at[i].set(emit)
+            return (token, cache, positions, done, limit, rng, toks)
 
-        (token, cache, positions, rng), toks = jax.lax.scan(
-            step, (token, cache, positions, rng), None, length=n)
+        token, cache, positions, done, limit, rng, toks = \
+            jax.lax.fori_loop(
+                0, n, body,
+                (token, cache, positions, done, limit, rng,
+                 jnp.zeros((n, batch), jnp.int32)))
         cache = tp_lib.constrain_cache(cache, self.mesh)
-        toks = tp_lib.replicate(jnp.swapaxes(toks, 0, 1), self.mesh)
-        return toks, token, cache, positions, rng
+
+        def rep(x):
+            return tp_lib.replicate(x, self.mesh)
+        return (rep(jnp.swapaxes(toks, 0, 1)), token, cache,
+                rep(positions), rep(done), limit, rng)
 
     def _install_first_impl(self, params, h_last, last_idx, token_row,
-                            pos_row, temp_row, top_p_row, length, slot,
-                            temp, top_p, rng, *, top_k):
+                            pos_row, done_row, limit_row, temp_row,
+                            top_p_row, length, slot, temp, top_p, limit,
+                            rng, *, top_k, eos):
         """Finish a chunked prefill: logits at the prompt's last valid
         window row -> sample the first token with the request's params
-        -> install token/position/sampling rows for its slot."""
+        -> install token/position/done/budget rows for its slot."""
         from skypilot_tpu.infer import quant
         h = jax.lax.dynamic_index_in_dim(h_last, last_idx, 0,
                                          keepdims=True)
@@ -221,11 +291,16 @@ class ContinuousBatcher:
         first = tp_lib.replicate(sampling.sample_logits_batched(
             logits, sub, temp[None], top_p[None], top_k=top_k)[0],
             self.mesh)
+        first_done = jnp.logical_or(
+            (first == eos) if eos is not None else False, limit <= 0)
         token_row = token_row.at[slot].set(first)
         pos_row = pos_row.at[slot].set(length)
+        done_row = done_row.at[slot].set(first_done)
+        limit_row = limit_row.at[slot].set(limit)
         temp_row = temp_row.at[slot].set(temp)
         top_p_row = top_p_row.at[slot].set(top_p)
-        return token_row, pos_row, temp_row, top_p_row, first, rng
+        return (token_row, pos_row, done_row, limit_row, temp_row,
+                top_p_row, first, rng)
 
     # ---- public API ------------------------------------------------------
     def submit(self, prompt: Sequence[int],
@@ -299,6 +374,28 @@ class ContinuousBatcher:
                 return b
         raise ValueError(f'Prompt length {length} exceeds largest bucket')
 
+    def _cache_bucket_for(self, rows: int) -> int:
+        """Smallest cache bucket with at least `rows` position rows."""
+        for b in self.cache_buckets:
+            if rows <= b:
+                return b
+        return self.cache_buckets[-1]
+
+    def _migrate(self, target: int) -> None:
+        telemetry_metrics.INFER_CACHE_MIGRATIONS.labels(
+            direction=('grow' if target > self._cache_len
+                       else 'shrink')).inc()
+        self._cache = self._resize(self._cache, new_len=target)
+        self._cache_len = target
+
+    def _grow_for(self, rows: int) -> None:
+        """Grow (never shrink) the cache to cover `rows` positions —
+        admission's side of the bucket contract: prefill writes and the
+        admitted request's first decode write must land in-bucket."""
+        target = self._cache_bucket_for(rows)
+        if target > self._cache_len:
+            self._migrate(target)
+
     @staticmethod
     def _observe_queue_wait(req: _Request) -> None:
         if req.submitted_at:
@@ -321,14 +418,20 @@ class ContinuousBatcher:
                 request.slot = self._free.pop(0)
                 self._observe_queue_wait(request)
                 self._incremental = request
-                # Park the slot's decode-garbage writes at the LAST
-                # cache row: lockstep decode advances EVERY slot and
-                # parking at 0 (the freed-slot convention) would let
-                # those writes clobber rows this prefill just wrote.
-                # Writes beyond max_len-1 clamp onto max_len-1, whose
-                # garbage is overwritten by the real write if the
-                # generation ever reaches it.
-                park = jnp.int32(self.gen.max_seq_len - 1)
+                # Grow the cache BEFORE parking: the windows write rows
+                # 0..len(prompt)-1 and the first decode write lands at
+                # len(prompt).  (The cache never shrinks while this
+                # prefill is in flight — see step().)
+                self._grow_for(len(request.prompt) + 1)
+                # Park the slot's frozen position at the last cache
+                # row: the fused decode freezes done slots but still
+                # rewrites their CURRENT row in lockstep, and parking
+                # at 0 (the freed-slot convention) would let that
+                # garbage clobber rows this prefill just wrote.  The
+                # park row is >= len(prompt), so if the generation ever
+                # reaches it the real decode write overwrites the
+                # garbage before that row is first attended.
+                park = jnp.int32(self._cache_len - 1)
                 self._positions = self._positions.at[
                     request.slot].set(park)
                 self._host_pos[request.slot] = int(park)
@@ -352,6 +455,7 @@ class ContinuousBatcher:
             slots = np.zeros((effective,), np.int32)
             temps = np.zeros((effective,), np.float32)
             top_ps = np.ones((effective,), np.float32)
+            limits = np.zeros((effective,), np.int32)
             default_temp = self.gen.temperature
             default_top_p = self.gen.top_p if self.gen.top_p else 1.0
             for i, request in enumerate(group):
@@ -363,15 +467,22 @@ class ContinuousBatcher:
                             else request.temperature)
                 top_ps[i] = (default_top_p if request.top_p is None
                              else request.top_p)
+                # Budget AFTER the first token the prefill samples.
+                limits[i] = request.max_new_tokens - 1
+            # Bucket contract: the (G, bucket) prefill writes rows
+            # 0..bucket-1 and each admitted row's first decode write
+            # lands at len(prompt) — grow before dispatch.
+            self._grow_for(max(bucket, int(lengths.max()) + 1))
             try:
-                (self._cache, self._token, self._positions,
-                 self._temp_row, self._top_p_row, firsts,
+                (self._cache, self._token, self._positions, self._done,
+                 self._limit, self._temp_row, self._top_p_row, firsts,
                  self._rng) = self._prefill_group(
                     self.params, jnp.asarray(tokens), self._cache,
                     jnp.asarray(lengths), jnp.asarray(slots),
-                    self._token, self._positions, self._temp_row,
-                    self._top_p_row, jnp.asarray(temps),
-                    jnp.asarray(top_ps), self._rng)
+                    self._token, self._positions, self._done,
+                    self._limit, self._temp_row, self._top_p_row,
+                    jnp.asarray(temps), jnp.asarray(top_ps),
+                    jnp.asarray(limits), self._rng)
                 self._host_temp[slots] = temps
                 self._host_top_p[slots] = top_ps
             except Exception:
@@ -401,9 +512,11 @@ class ContinuousBatcher:
             del self._active[req.slot]
         if req.slot is not None:
             self._free.append(req.slot)
-            # Freed slot decodes garbage until reused: park its position
-            # at 0 so lockstep writes land inside the (dead) cache.
+            # Freed slot: freeze it (done rows don't advance inside the
+            # fused decode) and park its position at 0 so its one dead
+            # lockstep write stays inside even the smallest bucket.
             self._positions = self._positions.at[req.slot].set(0)
+            self._done = self._done.at[req.slot].set(True)
             self._host_pos[req.slot] = 0
 
     def _advance_prefill(self) -> None:
@@ -445,13 +558,15 @@ class ContinuousBatcher:
                 else req.temperature)
         top_p = default_top_p if req.top_p is None else req.top_p
         try:
-            (self._token, self._positions, self._temp_row,
-             self._top_p_row, first, self._rng) = self._install_first(
+            (self._token, self._positions, self._done, self._limit,
+             self._temp_row, self._top_p_row, first,
+             self._rng) = self._install_first(
                 self.params, h_last, jnp.int32(end - 1 - start),
-                self._token, self._positions, self._temp_row,
-                self._top_p_row, jnp.int32(len(req.prompt)),
-                jnp.int32(req.slot), jnp.float32(temp),
-                jnp.float32(top_p), self._rng)
+                self._token, self._positions, self._done, self._limit,
+                self._temp_row, self._top_p_row,
+                jnp.int32(len(req.prompt)), jnp.int32(req.slot),
+                jnp.float32(temp), jnp.float32(top_p),
+                jnp.int32(req.max_new_tokens - 1), self._rng)
         except Exception:
             self._incremental = None
             req.prefill_pos = 0
@@ -484,26 +599,39 @@ class ContinuousBatcher:
         # Capacity from the host-side position mirror: reading
         # self._positions here would force one blocking device→host
         # transfer per tick on the serving hot path.
-        capacity = self.gen.max_seq_len - max(
-            int(self._host_pos[s]) for s in self._active)
-        n = max(1, min(n, capacity))
+        live_max = max(int(self._host_pos[s]) for s in self._active)
+        n = max(1, min(n, self.gen.max_seq_len - live_max))
+        # Bucket crossing: this chunk's deepest live write lands at row
+        # live_max + n - 1.  Shrinking (the live batch's contexts got
+        # small after long requests finished) is deferred while a
+        # chunked prefill is parked at the cache's last row.
+        target = self._cache_bucket_for(live_max + n)
+        if target > self._cache_len or (target < self._cache_len
+                                        and self._incremental is None):
+            self._migrate(target)
         all_greedy = not any(
             float(self._host_temp[s]) > 0.0 for s in self._active)
         nucleus = any(
             float(self._host_top_p[s]) < 1.0 for s in self._active)
         active_slots = len(self._active)
         chunk_start = time.perf_counter()
-        (toks, self._token, self._cache, self._positions,
-         self._rng) = self._decode(
+        (toks, self._token, self._cache, self._positions, self._done,
+         self._limit, self._rng) = self._decode(
             self.params, self._token, self._cache, self._positions,
-            self._temp_row, self._top_p_row, self._rng, n=n,
-            all_greedy=all_greedy, nucleus=nucleus)
-        # Decode advanced EVERY slot's device position by n (free slots
-        # decode garbage in lockstep); mirror that exactly.
-        self._host_pos += n
-        host = np.asarray(toks)  # barrier: honest chunk wall time
+            self._done, self._limit, self._temp_row, self._top_p_row,
+            self._rng, n=n, all_greedy=all_greedy, nucleus=nucleus)
+        # ONE transfer for the whole chunk (barrier: honest chunk wall
+        # time): the token block plus the control rows steering the
+        # next tick.  Positions come back exact — frozen slots did NOT
+        # advance, so no more += n mirror arithmetic.
+        host, host_pos, _ = engine_lib.host_fetch(
+            toks, self._positions, self._done)
+        self._host_pos = host_pos.astype(np.int64)
         chunk_dt = time.perf_counter() - chunk_start
         telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(chunk_dt)
+        telemetry_metrics.INFER_DECODE_BUCKET_CHUNKS.labels(
+            bucket=str(self._cache_len)).inc()
+        telemetry_metrics.INFER_DECODE_CACHE_ROWS.set(self._cache_len)
         if chunk_dt > 0:
             telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
                 n * active_slots / chunk_dt)
@@ -518,6 +646,8 @@ class ContinuousBatcher:
                     self._finish(req)
                     break
         telemetry_metrics.INFER_GENERATED_TOKENS.inc(appended)
+        telemetry_metrics.INFER_HOST_SYNCS_PER_TOKEN.set(
+            1.0 / max(appended, 1))
         telemetry_metrics.INFER_SLOT_OCCUPANCY.set(
             len(self._active) / self.gen.batch_size)
 

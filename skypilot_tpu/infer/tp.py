@@ -177,6 +177,18 @@ def cache_scale_sharding(mesh) -> NamedSharding:
     return NamedSharding(mesh, CACHE_SCALE_SPEC)
 
 
+def replicated_sharding(mesh) -> Optional[NamedSharding]:
+    """Fully-replicated NamedSharding for the scheduler's CONTROL ROWS
+    (feed token, positions, done, budget): the batcher device_puts
+    these explicitly so their layout is pinned from construction —
+    decode's replicated outputs then alias straight back into them
+    instead of round-tripping through a GSPMD reshard on the first
+    tick.  None when no mesh (plain single-device arrays)."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P())
+
+
 def replicate(x, mesh):
     """Constrain x to a fully-replicated layout (usable inside jit).
 

@@ -15,6 +15,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# jax >= 0.5 exposes shard_map at the top level; 0.4.x only ships the
+# experimental API with an older kwarg surface (auto= instead of
+# axis_names=, check_rep= instead of check_vma=).  One shim, imported
+# everywhere shard_map is used, translating the modern call signature.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, **kw):
+        if mesh is None:
+            # Partial manualization under an outer manual region (the
+            # axis_names-only form) has no 0.4.x equivalent.
+            raise NotImplementedError(
+                'shard_map without an explicit mesh requires jax >= 0.5')
+        # axis_names (partial manualization) is dropped: 0.4.x's auto=
+        # emits a PartitionId op CPU SPMD can't lower, so every axis goes
+        # manual — axes the specs never mention compute replicated
+        # instead of auto-sharded.  Same numbers, less parallelism.
+        if check_vma is not None:
+            kw['check_rep'] = check_vma
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+
 
 def psum_bench(mesh, axis_name: str = 'dp', payload_mb: float = 128.0,
                iters: int = 10, warmup: int = 3) -> Dict[str, float]:
@@ -29,7 +54,7 @@ def psum_bench(mesh, axis_name: str = 'dp', payload_mb: float = 128.0,
     x = jax.device_put(x, NamedSharding(mesh, P(axis_name, None)))
 
     def allreduce(arr):
-        return jax.shard_map(
+        return shard_map(
             lambda a: jax.lax.psum(a, axis_name),
             mesh=mesh, in_specs=P(axis_name, None),
             out_specs=P(axis_name, None))(arr)
@@ -58,7 +83,7 @@ def all_gather_bench(mesh, axis_name: str = 'fsdp',
     x = jax.device_put(x, NamedSharding(mesh, P(axis_name, None)))
 
     def gather(arr):
-        return jax.shard_map(
+        return shard_map(
             lambda a: jax.lax.all_gather(a, axis_name, tiled=True),
             mesh=mesh, in_specs=P(axis_name, None), out_specs=P(None, None),
         )(arr)

@@ -19,8 +19,9 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.parallel.collectives import shard_map
 
 StageFn = Callable[[Any, jax.Array], jax.Array]
 

@@ -137,7 +137,8 @@ def ring_attention(q, k, v, mesh=None, *, axis_name: str = 'sp',
         # out_specs check rejects a subset axis_names over a concrete mesh
         # whose remaining axes the specs never mention).
         kwargs = {'mesh': mesh}
-    return jax.shard_map(
+    from skypilot_tpu.parallel.collectives import shard_map
+    return shard_map(
         local,
         in_specs=(spec_q, spec_kv, spec_kv),
         out_specs=spec_q,

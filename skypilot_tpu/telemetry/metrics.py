@@ -95,6 +95,40 @@ INFER_GENERATED_TOKENS = prometheus_client.Counter(
     'Tokens returned to callers (post eos/max-token trim)',
     registry=REGISTRY)
 
+INFER_HOST_SYNCS = prometheus_client.Counter(
+    'skytpu_infer_host_syncs_total',
+    'Device→host transfers on the decode data path (engine.host_fetch '
+    'calls) — the sync-free streaming contract is O(1) per decode '
+    'chunk, not per token',
+    registry=REGISTRY)
+
+INFER_HOST_SYNCS_PER_TOKEN = prometheus_client.Gauge(
+    'skytpu_infer_host_syncs_per_token',
+    'Host syncs / generated tokens of the most recent generation or '
+    'scheduler tick (1.0 would mean a round-trip per token; fused '
+    'multi-step decode targets 1/decode_chunk)',
+    registry=REGISTRY)
+
+INFER_DECODE_CACHE_ROWS = prometheus_client.Gauge(
+    'skytpu_infer_decode_cache_rows',
+    'Position capacity (rows) of the live KV cache bucket the decode '
+    'loop is currently compiled against',
+    registry=REGISTRY)
+
+INFER_DECODE_BUCKET_CHUNKS = prometheus_client.Counter(
+    'skytpu_infer_decode_bucket_chunks_total',
+    'Decode chunks dispatched per cache-length bucket (bucket '
+    'occupancy: which compiled cache sizes actually serve traffic)',
+    ['bucket'],
+    registry=REGISTRY)
+
+INFER_CACHE_MIGRATIONS = prometheus_client.Counter(
+    'skytpu_infer_cache_migrations_total',
+    'KV cache bucket migrations (pad-grow or truncate-shrink of the '
+    'position axis) — each costs one cache copy on device',
+    ['direction'],
+    registry=REGISTRY)
+
 # ---- serve (serve/load_balancer.py, replica_managers.py, autoscalers.py)
 
 SERVE_REPLICA_REQUESTS = prometheus_client.Counter(

@@ -1,0 +1,210 @@
+"""Bucket-scaled decode: fused multi-step parity + sync-free streaming.
+
+CPU contracts of the bucketed decode data path (infer/engine.py,
+infer/serving.py):
+
+- the fused on-device decode chunk (fori_loop with in-loop sampling and
+  EOS/budget tracking) is TOKEN-IDENTICAL to the per-step reference
+  (decode_chunk=1) — greedy and temperature/top-k, bf16-free f32
+  configs so argmax ties cannot flip;
+- KV-cache bucket migrations mid-generation (pad-grow / truncate-shrink
+  of the position axis) never change the token stream;
+- host syncs are O(1) per decode CHUNK, counted by monkeypatching
+  engine.host_fetch — the single device→host transfer point.
+
+NOT slow-marked: tiny configs, this is the tier-1 lock on the decode
+rework.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine
+from skypilot_tpu.infer import llama_infer
+from skypilot_tpu.infer import tp as tp_lib
+from skypilot_tpu.infer.engine import Generator, GeneratorConfig
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.metrics import REGISTRY
+from skypilot_tpu.models import llama
+
+# f32: reduction-order drift across bucket shapes must not flip argmax.
+CFG = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=128,
+                        max_seq_len=64, dtype=jnp.float32, remat=False)
+
+PROMPTS = [[5, 9, 3, 7], [11, 2]]
+
+
+@pytest.fixture(scope='module')
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _generate(params, *, decode_chunk, cache_buckets, temperature=0.0,
+              top_k=None, kv_dtype=None, eos=None, mesh=None,
+              max_new=20, seed=3):
+    gen = Generator(params, CFG, GeneratorConfig(
+        max_seq_len=64, batch_size=2, prompt_buckets=[8],
+        temperature=temperature, top_k=top_k, eos_token=eos,
+        kv_cache_dtype=kv_dtype, cache_buckets=cache_buckets,
+        decode_chunk=decode_chunk), mesh=mesh)
+    return gen.generate(PROMPTS, max_new_tokens=max_new, seed=seed)
+
+
+# ---- resize_cache -------------------------------------------------------
+
+def test_resize_cache_grow_then_shrink_roundtrip():
+    cache = llama_infer.init_cache(CFG, 2, 16)
+    k0 = np.random.RandomState(0).randn(
+        *cache['k'].shape).astype(np.float32)
+    cache['k'] = jnp.asarray(k0, cache['k'].dtype)
+    grown = llama_infer.resize_cache(cache, 32)
+    assert grown['k'].shape[2] == 32
+    np.testing.assert_array_equal(np.asarray(grown['k'][:, :, :16]), k0)
+    np.testing.assert_array_equal(
+        np.asarray(grown['k'][:, :, 16:]), 0.0)
+    back = llama_infer.resize_cache(grown, 16)
+    assert back['k'].shape[2] == 16
+    np.testing.assert_array_equal(np.asarray(back['k']), k0)
+    # No-op resize returns the cache unchanged.
+    assert llama_infer.resize_cache(cache, 16) is cache
+
+
+def test_resize_cache_resizes_int8_scales():
+    cache = llama_infer.init_cache(CFG, 2, 16, kv_dtype='int8')
+    grown = llama_infer.resize_cache(cache, 32)
+    assert grown['k'].dtype == jnp.int8
+    assert grown['k_scale'].shape[2] == 32
+    assert grown['v_scale'].shape[2] == 32
+
+
+# ---- fused multi-step decode parity (lockstep Generator) ----------------
+
+def test_fused_chunk_matches_per_step_greedy(params):
+    ref = _generate(params, decode_chunk=1, cache_buckets=[64])
+    for chunk in (5, 32):
+        assert _generate(params, decode_chunk=chunk,
+                         cache_buckets=[64]) == ref
+
+
+def test_bucket_migration_does_not_change_tokens(params):
+    ref = _generate(params, decode_chunk=1, cache_buckets=[64])
+    grow0 = REGISTRY.get_sample_value(
+        'skytpu_infer_cache_migrations_total',
+        {'direction': 'grow'}) or 0.0
+    got = _generate(params, decode_chunk=5, cache_buckets=[16, 32, 64])
+    assert got == ref
+    grow1 = REGISTRY.get_sample_value(
+        'skytpu_infer_cache_migrations_total', {'direction': 'grow'})
+    # prompts fit bucket 16; 1 + 20 new tokens crosses into 32.
+    assert grow1 > grow0
+
+
+def test_fused_chunk_matches_per_step_sampled(params):
+    ref = _generate(params, decode_chunk=1, cache_buckets=[64],
+                    temperature=0.8, top_k=20)
+    for chunk in (5, 32):
+        for buckets in ([64], [16, 32, 64]):
+            assert _generate(params, decode_chunk=chunk,
+                             cache_buckets=buckets, temperature=0.8,
+                             top_k=20) == ref
+
+
+def test_fused_chunk_matches_per_step_int8_kv(params):
+    ref = _generate(params, decode_chunk=1, cache_buckets=[64],
+                    kv_dtype='int8')
+    got = _generate(params, decode_chunk=5, cache_buckets=[16, 32, 64],
+                    kv_dtype='int8')
+    assert got == ref
+
+
+def test_fused_chunk_eos_parity(params):
+    """EOS handling (freeze + fill emission) must trim identically."""
+    stream = _generate(params, decode_chunk=1, cache_buckets=[64])
+    eos = stream[0][7]   # force a mid-chunk stop on row 0
+    ref = _generate(params, decode_chunk=1, cache_buckets=[64], eos=eos)
+    got = _generate(params, decode_chunk=5, cache_buckets=[16, 32, 64],
+                    eos=eos)
+    assert got == ref
+    # Row 0 is trimmed at the FIRST occurrence of the eos token.
+    cut = stream[0].index(eos)
+    assert ref[0] == stream[0][:cut + 1]
+
+
+def test_fused_chunk_matches_per_step_tp_mesh(params):
+    mesh = tp_lib.make_tp_mesh(2)
+    ref = _generate(params, decode_chunk=1, cache_buckets=[64])
+    got = _generate(params, decode_chunk=5, cache_buckets=[16, 32, 64],
+                    mesh=mesh)
+    assert got == ref
+
+
+# ---- sync-free streaming: O(1) transfers per chunk ----------------------
+
+def test_generate_host_syncs_are_per_chunk(params, monkeypatch):
+    calls = []
+    real = engine.host_fetch
+
+    def counting(*arrays):
+        calls.append(len(arrays))
+        return real(*arrays)
+
+    monkeypatch.setattr(engine, 'host_fetch', counting)
+    max_new, chunk = 17, 8
+    out = _generate(params, decode_chunk=chunk, cache_buckets=[64],
+                    max_new=max_new)
+    assert all(len(row) == max_new for row in out)
+    # 1 fetch for the prefill-sampled first token + 1 PER CHUNK — never
+    # per token.
+    assert len(calls) == 1 + math.ceil((max_new - 1) / chunk)
+
+
+def test_batcher_host_syncs_one_per_tick(params, monkeypatch):
+    calls = []
+    real = engine.host_fetch
+
+    def counting(*arrays):
+        calls.append(len(arrays))
+        return real(*arrays)
+
+    monkeypatch.setattr(engine, 'host_fetch', counting)
+    b = ContinuousBatcher(params, CFG, GeneratorConfig(
+        max_seq_len=64, batch_size=2, prompt_buckets=[8],
+        temperature=0.0), decode_chunk=4)
+    b.submit([5, 9, 3], max_new_tokens=9)
+    b.step()   # admit + first decode tick
+    b.step()
+    assert len(calls) == 2
+
+
+# ---- bucketed ContinuousBatcher -----------------------------------------
+
+def test_batcher_bucketed_matches_fixed_bucket(params):
+    def run(cache_buckets):
+        b = ContinuousBatcher(params, CFG, GeneratorConfig(
+            max_seq_len=64, batch_size=2, prompt_buckets=[8, 32],
+            temperature=0.0, cache_buckets=cache_buckets),
+            decode_chunk=4)
+        rids = [b.submit(list(range(2, 22)), max_new_tokens=24),
+                b.submit([7, 3], max_new_tokens=12)]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    assert run([16, 32, 64]) == run([64])
+
+
+def test_batcher_shrinks_after_long_request_finishes(params):
+    b = ContinuousBatcher(params, CFG, GeneratorConfig(
+        max_seq_len=64, batch_size=2, prompt_buckets=[8, 32],
+        temperature=0.0, cache_buckets=[16, 64]), decode_chunk=4)
+    assert b._cache_len == 16
+    long_rid = b.submit(list(range(2, 22)), max_new_tokens=4)  # bucket 64
+    b.run_until_idle()
+    assert b._cache_len == 64 and b.is_done(long_rid)
+    short_rid = b.submit([7, 3], max_new_tokens=8)   # lives in bucket 16
+    b.run_until_idle()
+    assert b.is_done(short_rid)
+    assert b._cache_len == 16   # truncate-shrink happened mid-decode

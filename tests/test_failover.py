@@ -2,6 +2,10 @@
 test_failover.py, but against the mocked TPU REST API)."""
 import pytest
 
+# Every test here provisions through setup_gcp_authentication, which
+# generates an ssh keypair.
+pytest.importorskip('cryptography')
+
 from skypilot_tpu import Resources, exceptions
 from skypilot_tpu import config as config_lib
 from skypilot_tpu.provision import provisioner

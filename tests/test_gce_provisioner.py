@@ -291,6 +291,8 @@ def test_bootstrap_no_permission_names_permission(fake_compute):
 
 @pytest.fixture()
 def gcp_configured(fake_compute, monkeypatch, tmp_home):
+    # provision_with_failover generates an ssh keypair on first use.
+    pytest.importorskip('cryptography')
     monkeypatch.setattr(provisioner, '_setup_runtime',
                         lambda info, port, cluster_name: port)
     config_lib.set_nested(('gcp', 'project_id'), 'test-proj')

@@ -14,6 +14,7 @@ from tests.test_launch_e2e import iso_state  # noqa: F401
 # --- authentication ---
 
 def test_keypair_generation_idempotent(iso_state):  # noqa: F811
+    pytest.importorskip('cryptography')
     from skypilot_tpu import authentication
     priv, pub = authentication.get_or_generate_keys()
     assert os.path.exists(priv) and os.path.exists(pub)
@@ -29,6 +30,7 @@ def test_keypair_generation_idempotent(iso_state):  # noqa: F811
 
 
 def test_gcp_auth_injection(iso_state):  # noqa: F811
+    pytest.importorskip('cryptography')
     from skypilot_tpu import authentication
     config = {}
     authentication.setup_gcp_authentication(config)
