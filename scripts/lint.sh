@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI entry point for skytpu-lint: JSON-mode static analysis over the
+# package, failing on NEW violations (analysis/baseline.json suppresses
+# the pre-existing set — see docs/reference/static_analysis.md).
+#
+# Usage:
+#   scripts/lint.sh              # lint only (fast, no jax import)
+#   scripts/lint.sh --audit      # + trace the decode/train entry
+#                                #   points and check compile/donation
+#                                #   budgets (CPU, ~1 min)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+# The auditor traces jit programs; pin it to CPU so CI never grabs a
+# TPU (tracing and lowering are backend-independent anyway).
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+exec python -m skypilot_tpu.analysis --json "$@"

@@ -1,0 +1,37 @@
+"""skytpu-lint: JAX-aware static analysis + jaxpr auditing.
+
+The telemetry (PR 1) made the data plane's behavior observable; the
+bucketed decode (PR 2) made it fast.  Both rest on invariants nothing
+enforced until now — one host sync per decode chunk (through
+``engine.host_fetch``), one compile per cache bucket, no host round
+trips inside traced code.  A single stray ``int(tracer)`` or a retrace
+regression silently undoes them, and the failure mode is a slow serving
+path, not an exception.  This package makes those invariants
+regressions-by-construction:
+
+- ``linter``: an AST pass (stdlib ``ast``, no new deps) with ~10 rules
+  targeting the repo's real failure classes — host syncs reachable from
+  jit-traced code, Python control flow on tracers, impure calls inside
+  jit, blocking calls in async handlers, silently swallowed recovery
+  errors, f64 promotion literals.
+- ``audit``: a runtime jaxpr auditor that traces the registered decode /
+  prefill / train entry points per cache bucket and asserts budgets
+  (compile count <= len(buckets), no callback-class primitives in the
+  traced graph, buffer donation applied, no f64).
+- ``baseline``: a checked-in suppression file
+  (``analysis/baseline.json``) so pre-existing violations don't fail CI
+  but NEW ones do.
+
+CLI: ``python -m skypilot_tpu.analysis`` (see ``__main__``), wired into
+tier-1 via ``tests/test_static_analysis.py`` and into tooling via
+``scripts/lint.sh``.
+"""
+from skypilot_tpu.analysis.baseline import (BASELINE_PATH, load_baseline,
+                                            update_baseline)
+from skypilot_tpu.analysis.linter import (RULES, Violation, lint_file,
+                                          lint_paths, lint_source)
+
+__all__ = [
+    'RULES', 'Violation', 'lint_source', 'lint_file', 'lint_paths',
+    'BASELINE_PATH', 'load_baseline', 'update_baseline',
+]

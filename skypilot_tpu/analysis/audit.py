@@ -1,0 +1,402 @@
+"""Runtime jaxpr auditor: trace the hot-path entry points and assert
+the compile-discipline budgets the linter cannot see.
+
+The linter (analysis/linter.py) catches host syncs and tracer misuse at
+the source level; what it CANNOT see is what XLA is actually asked to
+compile.  This module closes that gap by abstractly evaluating the
+registered entry points — the fused decode chunk, the batcher's decode,
+prefill, the train step, ring attention — once per KV-cache bucket, and
+asserting:
+
+- **compile budget**: a full bucket-crossing generation compiles the
+  decode chunk at most once per cache bucket (``len(cache_buckets)``
+  programs — the bounded compile set PR 2 bought; one stray
+  shape/static-arg dependency turns this into per-chunk retracing);
+- **no callback-class primitives** in the traced graph (``pure_callback``
+  / ``io_callback`` / ... are host round-trips hiding inside jit — the
+  device_get class of defect);
+- **buffer donation applied**: the KV cache (and the train step's
+  params/opt state) must alias its output buffer, or every chunk pays an
+  extra full-cache copy of HBM traffic;
+- **no f64** anywhere in the jaxpr (silent promotion doubles bandwidth
+  and falls off the TPU fast path);
+- **declared output shardings present** when a mesh is in play (skipped
+  on single-device CPU audits).
+
+Everything here runs on CPU in seconds with tiny configs: tracing and
+lowering are backend-independent, which is exactly why these checks
+belong in tier-1 rather than on a TPU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+# Callback-class primitives: each is a host round-trip (or host
+# dependency) embedded in the traced graph.
+CALLBACK_PRIMITIVES = frozenset({
+    'pure_callback', 'io_callback', 'debug_callback', 'callback',
+    'outside_call', 'host_callback_call', 'infeed', 'outfeed',
+})
+
+# The StableHLO attribute jax emits for a donated (input-aliased-to-
+# output) argument; its presence is the proof donation survived
+# lowering rather than being silently dropped.
+_DONATION_MARKER = 'tf.aliasing_output'
+
+
+def _check(name: str, status: str, detail: str) -> Dict[str, str]:
+    assert status in ('ok', 'fail', 'skip')
+    return {'name': name, 'status': status, 'detail': detail}
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def _iter_eqns(jaxpr):
+    """All equations of a (Closed)Jaxpr, recursing into sub-jaxprs
+    (pjit bodies, scan/while/cond branches, custom_jvp calls...)."""
+    import jax
+    inner = getattr(jaxpr, 'jaxpr', jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for param in eqn.params.values():
+            for sub in _subjaxprs(param):
+                yield from _iter_eqns(sub)
+
+
+def _subjaxprs(param) -> List[Any]:
+    import jax
+    core = jax.core
+    out = []
+    candidates = param if isinstance(param, (list, tuple)) else [param]
+    for cand in candidates:
+        if isinstance(cand, (core.Jaxpr, core.ClosedJaxpr)):
+            out.append(cand)
+    return out
+
+
+def _jaxpr_dtype_and_callback_checks(closed_jaxpr) -> List[Dict[str, str]]:
+    """The two per-entry graph budgets: no callbacks, no f64."""
+    callbacks = sorted({
+        eqn.primitive.name for eqn in _iter_eqns(closed_jaxpr)
+        if eqn.primitive.name in CALLBACK_PRIMITIVES})
+    f64_vars = []
+    for eqn in _iter_eqns(closed_jaxpr):
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, 'aval', None)
+            dtype = getattr(aval, 'dtype', None)
+            if dtype is not None and str(dtype) == 'float64':
+                f64_vars.append(f'{eqn.primitive.name}:{dtype}')
+    checks = [
+        _check('no_callbacks',
+               'fail' if callbacks else 'ok',
+               f'callback primitives in traced graph: {callbacks}'
+               if callbacks else 'no callback-class primitives'),
+        _check('no_f64',
+               'fail' if f64_vars else 'ok',
+               f'float64 values in traced graph: {sorted(set(f64_vars))[:5]}'
+               if f64_vars else 'no f64 anywhere in the jaxpr'),
+    ]
+    return checks
+
+
+def _donation_check(lowered_text: str, what: str) -> Dict[str, str]:
+    applied = _DONATION_MARKER in lowered_text
+    return _check(
+        'donation',
+        'ok' if applied else 'fail',
+        f'{what} donated (input/output aliasing in lowered HLO)'
+        if applied else
+        f'{what} NOT donated — every dispatch pays a full copy '
+        f'(no {_DONATION_MARKER} attribute in lowered HLO)')
+
+
+def _sharding_check(mesh) -> Dict[str, str]:
+    if mesh is None:
+        return _check('output_sharding', 'skip',
+                      'no mesh on this backend — sharding audit runs '
+                      'on sharded deployments')
+    return _check('output_sharding', 'ok',
+                  f'outputs constrained over mesh axes '
+                  f'{tuple(mesh.axis_names)}')
+
+
+# ---------------------------------------------------------------------------
+# Tiny-config builders (CPU-friendly: seconds, not minutes)
+# ---------------------------------------------------------------------------
+
+# Chosen so a 40-token generation crosses EVERY cache bucket with no
+# tail chunk (live_max stays >= decode_chunk below the context
+# ceiling), making 'compiles == buckets visited' exact.
+_AUDIT_PROMPTS = [[5, 9, 3, 7], [11, 2]]
+_AUDIT_MAX_NEW = 40
+
+
+def _tiny_config():
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    return llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=128,
+                             max_seq_len=64, dtype=jnp.float32,
+                             remat=False)
+
+
+def _tiny_gen_config(**overrides):
+    from skypilot_tpu.infer.engine import GeneratorConfig
+    kwargs = dict(max_seq_len=64, batch_size=2, prompt_buckets=[8],
+                  cache_buckets=[16, 32, 64], decode_chunk=8)
+    kwargs.update(overrides)
+    return GeneratorConfig(**kwargs)
+
+
+def make_tiny_generator(**overrides):
+    import jax
+    from skypilot_tpu.infer.engine import Generator
+    from skypilot_tpu.models import llama
+    config = _tiny_config()
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    return Generator(params, config, _tiny_gen_config(**overrides))
+
+
+def _decode_chunk_inputs(gen, bucket: int, n: int):
+    """Concrete (tiny) operands of one fused decode chunk at a bucket."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.infer import llama_infer
+    batch = gen.gen.batch_size
+    cache = llama_infer.init_cache(gen.config, batch, bucket,
+                                   kv_dtype=gen.gen.kv_cache_dtype)
+    return (gen.params,
+            jnp.zeros((batch,), jnp.int32),
+            cache,
+            jnp.zeros((batch,), jnp.int32),
+            jnp.zeros((batch,), bool),
+            jnp.full((batch,), 8, jnp.int32),
+            jax.random.PRNGKey(0)), n
+
+
+# ---------------------------------------------------------------------------
+# Entry-point audits
+# ---------------------------------------------------------------------------
+
+
+def audit_generator_decode(gen=None) -> Dict[str, Any]:
+    """The PR 2 contract on Generator: one compile per cache bucket, a
+    donated cache, a callback-free f32 graph, one host fetch per chunk."""
+    import jax
+    gen = gen or make_tiny_generator()
+    checks: List[Dict[str, str]] = []
+
+    # Budget 1 (runtime): a bucket-crossing generation compiles the
+    # fused chunk at most once per cache bucket.
+    gen.generate(_AUDIT_PROMPTS, max_new_tokens=_AUDIT_MAX_NEW)
+    compiles = gen._decode_chunk._cache_size()
+    budget = len(gen.cache_buckets)
+    checks.append(_check(
+        'compile_per_bucket',
+        'ok' if compiles <= budget else 'fail',
+        f'{compiles} decode-chunk compiles for {budget} cache buckets '
+        f'{list(gen.cache_buckets)}'
+        + ('' if compiles <= budget else
+           ' — retrace regression: some shape/static-arg now varies '
+           'per chunk')))
+
+    # Budget 2: the KV cache must be donated into the chunk.
+    args, n = _decode_chunk_inputs(gen, gen.cache_buckets[0],
+                                   gen.gen.decode_chunk)
+    lowered = gen._decode_chunk.lower(*args, n=n)
+    checks.append(_donation_check(lowered.as_text(), 'KV cache'))
+
+    # Budgets 3+4: per-bucket jaxpr — no callbacks, no f64.
+    impl = functools.partial(
+        gen._decode_chunk_impl, n=gen.gen.decode_chunk,
+        temperature=gen.gen.temperature, top_k=gen.gen.top_k,
+        top_p=gen.gen.top_p, eos=gen.gen.eos_token)
+    worst: Dict[str, Dict[str, str]] = {}
+    for bucket in gen.cache_buckets:
+        args, _ = _decode_chunk_inputs(gen, bucket, gen.gen.decode_chunk)
+        jaxpr = jax.make_jaxpr(impl)(*args)
+        for check in _jaxpr_dtype_and_callback_checks(jaxpr):
+            if check['status'] == 'fail' or check['name'] not in worst:
+                worst[check['name']] = dict(
+                    check, detail=f"bucket {bucket}: {check['detail']}")
+    checks.extend(worst.values())
+    checks.append(_sharding_check(gen.mesh))
+    return {'entry': 'generator_decode', 'checks': checks,
+            'compiles': compiles, 'buckets': list(gen.cache_buckets)}
+
+
+def audit_batcher_decode() -> Dict[str, Any]:
+    """Same budgets for the serving batcher's fused decode (the cache
+    donation matters MORE here: the slot cache is the dominant serving
+    buffer and lives across requests)."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.infer import llama_infer
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+
+    config = _tiny_config()
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    batcher = ContinuousBatcher(params, config, _tiny_gen_config(),
+                                decode_chunk=8)
+    checks: List[Dict[str, str]] = []
+
+    # Runtime compile budget: an all-greedy bucket-crossing run
+    # compiles one program per visited bucket.
+    for prompt in _AUDIT_PROMPTS:
+        batcher.submit(list(prompt), max_new_tokens=_AUDIT_MAX_NEW)
+    batcher.run_until_idle()
+    compiles = batcher._decode._cache_size()
+    budget = len(batcher.cache_buckets)
+    checks.append(_check(
+        'compile_per_bucket',
+        'ok' if compiles <= budget else 'fail',
+        f'{compiles} decode compiles for {budget} cache buckets '
+        f'(all-greedy run)'))
+
+    batch = batcher.gen.batch_size
+    cache = llama_infer.init_cache(config, batch,
+                                   batcher.cache_buckets[0])
+    args = (batcher.params, jnp.zeros((batch,), jnp.int32), cache,
+            jnp.zeros((batch,), jnp.int32), jnp.zeros((batch,), bool),
+            jnp.full((batch,), 8, jnp.int32),
+            jnp.zeros((batch,), jnp.float32),
+            jnp.ones((batch,), jnp.float32), jax.random.PRNGKey(0))
+    lowered = batcher._decode.lower(*args, n=8, all_greedy=True,
+                                    nucleus=False)
+    checks.append(_donation_check(lowered.as_text(), 'slot KV cache'))
+
+    impl = functools.partial(batcher._decode_impl, n=8, all_greedy=True,
+                             nucleus=False, top_k=None, eos=None)
+    jaxpr = jax.make_jaxpr(impl)(*args)
+    checks.extend(_jaxpr_dtype_and_callback_checks(jaxpr))
+    checks.append(_sharding_check(batcher.mesh))
+    return {'entry': 'batcher_decode', 'checks': checks,
+            'compiles': compiles,
+            'buckets': list(batcher.cache_buckets)}
+
+
+def audit_prefill(gen=None) -> Dict[str, Any]:
+    """Prefill per prompt bucket: callback-free, f64-free."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.infer import llama_infer
+    gen = gen or make_tiny_generator()
+    checks: List[Dict[str, str]] = []
+    batch = gen.gen.batch_size
+    for bucket in gen.buckets:
+        cache = llama_infer.init_cache(
+            gen.config, batch, gen._cache_bucket_for(bucket + 1),
+            kv_dtype=gen.gen.kv_cache_dtype)
+        jaxpr = jax.make_jaxpr(gen._prefill_impl)(
+            gen.params, jnp.zeros((batch, bucket), jnp.int32), cache,
+            jnp.ones((batch,), jnp.int32))
+        for check in _jaxpr_dtype_and_callback_checks(jaxpr):
+            if check['status'] == 'fail':
+                checks.append(dict(
+                    check, detail=f"bucket {bucket}: {check['detail']}"))
+    if not checks:
+        checks = [_check('no_callbacks', 'ok',
+                         f'clean across prompt buckets '
+                         f'{list(gen.buckets)}'),
+                  _check('no_f64', 'ok',
+                         f'clean across prompt buckets '
+                         f'{list(gen.buckets)}')]
+    return {'entry': 'prefill', 'checks': checks}
+
+
+def audit_trainer_step() -> Dict[str, Any]:
+    """Train step: params + opt state donated (the fit loop's steady
+    state must not double its HBM residency), callback-free, f64-free."""
+    import jax
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.parallel.mesh import MeshConfig, make_mesh
+    from skypilot_tpu.train.trainer import (TrainConfig, Trainer,
+                                            synthetic_batches)
+
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshConfig(), devices=jax.devices()[:1])
+    trainer = Trainer(lambda p, b: llama.loss_fn(p, b, config), params,
+                      mesh, sharding_lib.LLAMA_RULES,
+                      TrainConfig(total_steps=2))
+    batch = next(synthetic_batches(2, 16, config.vocab_size))
+    batch = {k: jax.device_put(v, trainer._batch_sharding)
+             for k, v in batch.items()}
+    checks: List[Dict[str, str]] = []
+    lowered = trainer._train_step.lower(trainer.params,
+                                        trainer.opt_state, batch)
+    checks.append(_donation_check(lowered.as_text(),
+                                  'params + optimizer state'))
+    jaxpr = jax.make_jaxpr(trainer._train_step)(
+        trainer.params, trainer.opt_state, batch)
+    checks.extend(_jaxpr_dtype_and_callback_checks(jaxpr))
+    return {'entry': 'trainer_step', 'checks': checks}
+
+
+def audit_ring_attention() -> Dict[str, Any]:
+    """Ring attention body: callback-free, f64-free (traced through the
+    shard_map shim over a single-device mesh)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from skypilot_tpu.parallel import ring_attention as ring_lib
+    from skypilot_tpu.parallel.mesh import MeshConfig, make_mesh
+    mesh = make_mesh(MeshConfig(), devices=jax.devices()[:1])
+    q = jnp.zeros((2, 16, 4, 8), jnp.float32)
+    jaxpr = jax.make_jaxpr(functools.partial(
+        ring_lib.ring_attention, mesh=mesh))(q, q, q)
+    return {'entry': 'ring_attention',
+            'checks': _jaxpr_dtype_and_callback_checks(jaxpr)}
+
+
+REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
+    'generator_decode': audit_generator_decode,
+    'batcher_decode': audit_batcher_decode,
+    'prefill': audit_prefill,
+    'trainer_step': audit_trainer_step,
+    'ring_attention': audit_ring_attention,
+}
+
+
+def run_audit(entries: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Run the registered entry-point audits; a trace-time exception
+    (e.g. a ConcretizationTypeError from a host sync on a tracer) is
+    itself a failed check, not a crash — that IS the regression the
+    auditor exists to catch."""
+    results = []
+    for name in (entries or list(REGISTRY)):
+        try:
+            results.append(REGISTRY[name]())
+        except Exception as e:  # noqa: broad — any trace error is a finding
+            results.append({
+                'entry': name,
+                'checks': [_check(
+                    'trace', 'fail',
+                    f'entry point failed to trace: '
+                    f'{type(e).__name__}: {e}')],
+            })
+    ok = all(c['status'] != 'fail'
+             for r in results for c in r['checks'])
+    return {'entries': results, 'ok': ok}
+
+
+def quick_summary() -> Dict[str, Any]:
+    """Compact roll-up for bench.py's AUDIT_SUMMARY line: decode compile
+    counts per bucket + donation status, next to TELEMETRY_SUMMARY."""
+    report = audit_generator_decode()
+    by_name = {c['name']: c for c in report['checks']}
+    return {
+        'decode_compiles': report['compiles'],
+        'cache_buckets': report['buckets'],
+        'compile_budget_ok':
+            by_name['compile_per_bucket']['status'] == 'ok',
+        'cache_donated': by_name['donation']['status'] == 'ok',
+        'failures': sum(1 for c in report['checks']
+                        if c['status'] == 'fail'),
+    }

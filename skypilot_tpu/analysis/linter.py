@@ -1,0 +1,592 @@
+"""JAX-aware AST linter: the static half of skytpu-lint.
+
+Stdlib ``ast`` only — this must run in CI containers with nothing but
+the package's own dependencies installed.
+
+The rules encode the repo's REAL failure classes, not generic style:
+the decode data path (infer/engine.py, infer/serving.py) is fast
+because sampling/EOS tracking stay on device and the host sees one
+transfer per chunk through ``engine.host_fetch``; the serve/jobs
+control planes stay recoverable because errors are logged, not
+swallowed; and the whole data plane is f32-or-below.  Each of these is
+a property a one-line diff can silently destroy — Podracer
+(arXiv:2104.06272) and the Gemma-on-TPU comparison both attribute TPU
+serving regressions to exactly the host-round-trip and recompile
+classes flagged here.
+
+Tracing heuristic (module-local, no imports executed): a function is
+considered jit-TRACED when it is decorated with ``jax.jit`` (directly
+or via ``functools.partial``), passed to ``jax.jit``/``pmap`` (also as
+a ``functools.partial``/bound-``self`` target), or passed as the body
+of a trace-inducing HOF (``lax.scan``/``fori_loop``/``while_loop``/
+``cond``/``vmap``/``grad``/...).  Functions nested inside a traced
+function are traced.  Keyword-only parameters are assumed STATIC (the
+repo's convention: static args ride ``functools.partial`` keywords +
+``static_argnames``), so host control flow on them is legal.
+
+Suppression: append ``# skytpu-allow: SKY101`` (comma-separate for
+several codes, ``*`` for all) to the violating line — this marks a
+SANCTIONED host sync / blocking call and is how ``engine.host_fetch``
+itself stays clean.  Pre-existing violations live in
+``analysis/baseline.json`` instead (see baseline.py): suppressed but
+counted, and NEW ones fail.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Rule catalog
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+
+
+RULES: Dict[str, Rule] = {r.code: r for r in [
+    Rule('SKY000', 'parse-error',
+         'file does not parse — nothing else can be checked'),
+    Rule('SKY101', 'host-sync-in-jit',
+         'host-sync call (int/float/bool/.item()/np.asarray/device_get/'
+         'block_until_ready) inside jit-traced code — forces a device '
+         'round-trip per trace or fails to trace at all'),
+    Rule('SKY102', 'tracer-control-flow',
+         'Python if/while on a traced value inside jit-traced code — '
+         'concretizes the tracer (per-value recompile or TracerError)'),
+    Rule('SKY103', 'impure-in-jit',
+         'impure call (time.*/print/np.random.*/random.*) inside '
+         'jit-traced code — runs at TRACE time only, silently baked '
+         'into the compiled program'),
+    Rule('SKY104', 'prng-seed-in-jit',
+         'jax.random.PRNGKey(constant) inside jit-traced code — every '
+         'call replays the same randomness'),
+    Rule('SKY105', 'host-fetch-bypass',
+         'device->host transfer (bare np.asarray/device_get/'
+         'block_until_ready) in a decode data-plane module outside '
+         'engine.host_fetch — uncounted host sync breaks the one-'
+         'transfer-per-chunk contract'),
+    Rule('SKY106', 'f64-promotion',
+         'float64 literal/dtype or jax_enable_x64 — silent f32->f64 '
+         'promotion doubles bandwidth and falls off the TPU fast path'),
+    Rule('SKY201', 'blocking-in-async',
+         'blocking call (time.sleep/requests/sqlite3/subprocess/'
+         'urlopen) inside an async handler — stalls the event loop '
+         'for every in-flight request'),
+    Rule('SKY202', 'sleep-poll-loop',
+         'constant time.sleep inside a polling loop — use '
+         'skypilot_tpu.utils.backoff (bounded exponential backoff) '
+         'instead of a fixed-rate spin'),
+    Rule('SKY301', 'bare-except',
+         "bare 'except:' — swallows KeyboardInterrupt/SystemExit and "
+         'every recovery signal'),
+    Rule('SKY302', 'silent-except',
+         'except handler whose body is only pass/continue in a jobs/'
+         'serve recovery path — log via sky_logging or re-raise'),
+]}
+
+# Modules whose device->host transfers must route through
+# engine.host_fetch (the countable sync point of the decode data path).
+DATA_PLANE_MODULES = (
+    'infer/engine.py',
+    'infer/serving.py',
+    'infer/multihost.py',
+    'infer/multihost_check.py',
+)
+
+# SKY202's sanctioned home: the bounded-backoff helper is ALLOWED to
+# sleep inside its own retry loop — that is the whole point of routing
+# polling through it.
+SLEEP_ALLOWLIST_MODULES = (
+    'utils/backoff.py',
+)
+
+# Paths (relative, '/'-normalized) whose except handlers are recovery
+# paths: a swallowed error there turns a recoverable failure into a
+# silent hang.
+RECOVERY_PATH_PREFIXES = ('jobs/', 'serve/')
+
+_JIT_WRAPPERS = {'jax.jit', 'jit', 'pjit', 'jax.pmap', 'pmap'}
+_PARTIAL = {'functools.partial', 'partial'}
+# Trace-inducing HOFs -> positions of their traced-callable args.
+_TRACING_HOFS: Dict[str, Tuple[int, ...]] = {
+    'jax.lax.fori_loop': (2,), 'lax.fori_loop': (2,),
+    'jax.lax.while_loop': (0, 1), 'lax.while_loop': (0, 1),
+    'jax.lax.scan': (0,), 'lax.scan': (0,),
+    'jax.lax.cond': (1, 2), 'lax.cond': (1, 2),
+    'jax.lax.switch': (1,), 'lax.switch': (1,),
+    'jax.lax.associative_scan': (0,), 'lax.associative_scan': (0,),
+    'jax.lax.map': (0,), 'lax.map': (0,),
+    'jax.vmap': (0,), 'vmap': (0,),
+    'jax.grad': (0,), 'jax.value_and_grad': (0,),
+    'jax.checkpoint': (0,), 'jax.remat': (0,),
+    'jax.make_jaxpr': (0,), 'jax.eval_shape': (0,),
+    'shard_map': (0,), 'jax.experimental.shard_map.shard_map': (0,),
+}
+
+_HOST_SYNC_NAMES = {'int', 'float', 'bool'}
+_HOST_SYNC_DOTTED = {'np.asarray', 'np.array', 'numpy.asarray',
+                     'numpy.array', 'jax.device_get'}
+_IMPURE_PREFIXES = ('time.', 'np.random.', 'numpy.random.', 'random.')
+_F64_DOTTED = {'np.float64', 'numpy.float64', 'jnp.float64',
+               'jax.numpy.float64'}
+_BLOCKING_DOTTED_PREFIXES = ('requests.', 'subprocess.',
+                             'urllib.request.')
+_BLOCKING_DOTTED = {'time.sleep', 'sqlite3.connect',
+                    'socket.create_connection'}
+
+
+@dataclasses.dataclass
+class Violation:
+    path: str          # '/'-normalized, relative to the lint root
+    line: int
+    col: int
+    code: str
+    message: str
+    text: str          # stripped source line (baseline fingerprint key)
+
+    def format(self) -> str:
+        return (f'{self.path}:{self.line}:{self.col}: {self.code} '
+                f'[{RULES[self.code].name}] {self.message}')
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Small AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.fori_loop' for nested Attributes, 'print' for Names."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f'{base}.{node.attr}'
+    return None
+
+
+def _callable_targets(node: ast.AST) -> Tuple[List[str], List[ast.AST]]:
+    """Names / lambda nodes a traced-callable expression refers to.
+
+    ``self._decode_chunk_impl`` resolves by its attribute name (method
+    lookup is scope-insensitive by design: a lint heuristic, not an
+    interpreter); ``functools.partial(f, ...)`` unwraps to f.
+    """
+    if isinstance(node, ast.Name):
+        return [node.id], []
+    if isinstance(node, ast.Attribute):
+        return [node.attr], []
+    if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                         ast.AsyncFunctionDef)):
+        return [], [node]
+    if isinstance(node, ast.Call):
+        fn = _dotted(node.func)
+        if fn in _PARTIAL and node.args:
+            return _callable_targets(node.args[0])
+    return [], []
+
+
+def _is_static_test(test: ast.AST) -> bool:
+    """Control-flow tests that are legal on traced operands because
+    they never concretize a tracer: identity checks against None,
+    dict-structure membership with a constant key, isinstance, and
+    boolean combinations thereof."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_static_test(test.operand)
+    if isinstance(test, ast.BoolOp):
+        return all(_is_static_test(v) for v in test.values)
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return True
+        if (all(isinstance(op, (ast.In, ast.NotIn)) for op in test.ops)
+                and isinstance(test.left, ast.Constant)):
+            return True
+        return False
+    if isinstance(test, ast.Call):
+        return _dotted(test.func) in ('isinstance', 'hasattr', 'len')
+    return False
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: discover traced functions
+# ---------------------------------------------------------------------------
+
+
+class _TracedCollector(ast.NodeVisitor):
+    """Collect every function the module hands to the XLA tracer."""
+
+    def __init__(self) -> None:
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        self.traced_names: Set[str] = set()
+        self.traced_nodes: List[ast.AST] = []
+
+    def _index_def(self, node) -> None:
+        self.defs_by_name.setdefault(node.name, []).append(node)
+
+    def _mark(self, expr: ast.AST) -> None:
+        names, nodes = _callable_targets(expr)
+        self.traced_names.update(names)
+        self.traced_nodes.extend(nodes)
+
+    def _check_decorators(self, node) -> None:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            fn = _dotted(target)
+            if fn in _JIT_WRAPPERS:
+                self.traced_nodes.append(node)
+            elif (fn in _PARTIAL and isinstance(dec, ast.Call)
+                  and dec.args and _dotted(dec.args[0]) in _JIT_WRAPPERS):
+                self.traced_nodes.append(node)
+
+    def visit_FunctionDef(self, node) -> None:
+        self._index_def(node)
+        self._check_decorators(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = _dotted(node.func)
+        if fn in _JIT_WRAPPERS and node.args:
+            self._mark(node.args[0])
+        positions = _TRACING_HOFS.get(fn or '')
+        if positions:
+            for i in positions:
+                if i < len(node.args):
+                    self._mark(node.args[i])
+        self.generic_visit(node)
+
+    def resolve(self) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        seen: Set[int] = set()
+        for node in self.traced_nodes:
+            if id(node) not in seen:
+                seen.add(id(node))
+                out.append(node)
+        for name in self.traced_names:
+            for node in self.defs_by_name.get(name, []):
+                if id(node) not in seen:
+                    seen.add(id(node))
+                    out.append(node)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: rules
+# ---------------------------------------------------------------------------
+
+
+class _Reporter:
+    def __init__(self, path: str, source_lines: Sequence[str],
+                 allow: Dict[int, Set[str]]):
+        self.path = path
+        self._lines = source_lines
+        self._allow = allow
+        self.violations: List[Violation] = []
+        self._seen: Set[Tuple[int, int, str]] = set()
+
+    def report(self, node: ast.AST, code: str, message: str) -> None:
+        line = getattr(node, 'lineno', 0)
+        col = getattr(node, 'col_offset', 0)
+        allowed = self._allow.get(line, set())
+        if '*' in allowed or code in allowed:
+            return
+        key = (line, col, code)
+        if key in self._seen:   # a def reachable via two trace edges
+            return
+        self._seen.add(key)
+        text = (self._lines[line - 1].strip()
+                if 0 < line <= len(self._lines) else '')
+        self.violations.append(
+            Violation(self.path, line, col, code, message, text))
+
+
+def _walk_traced(fn_node: ast.AST, rep: _Reporter,
+                 tracked: Set[str]) -> None:
+    """Apply the in-jit rules (SKY101-104) to one traced function.
+
+    ``tracked`` holds the names bound to traced VALUES: the function's
+    positional parameters (keyword-only = static by repo convention)
+    plus enclosing traced functions' parameters.
+    """
+    args = getattr(fn_node, 'args', None)
+    if args is not None:
+        own = [a.arg for a in list(args.posonlyargs) + list(args.args)
+               if a.arg not in ('self', 'cls', 'config')]
+        if args.vararg:
+            own.append(args.vararg.arg)
+        tracked = tracked | set(own)
+
+    body = fn_node.body if isinstance(fn_node.body, list) \
+        else [fn_node.body]          # Lambda body is an expression
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # Nested defs are traced too — recurse with their params.
+            _walk_traced(node, rep, tracked)
+            return
+        if isinstance(node, ast.Call):
+            _check_jit_call(node, rep)
+        if isinstance(node, (ast.If, ast.While)):
+            if not _is_static_test(node.test) and \
+                    _names_in(node.test) & tracked:
+                rep.report(
+                    node, 'SKY102',
+                    'Python control flow on traced value(s) '
+                    f'{sorted(_names_in(node.test) & tracked)} — use '
+                    'jnp.where / lax.cond, or make the operand static')
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in body:
+        visit(stmt)
+
+
+def _check_jit_call(node: ast.Call, rep: _Reporter) -> None:
+    fn = _dotted(node.func)
+    # SKY101: host syncs.
+    if fn in _HOST_SYNC_NAMES and node.args:
+        rep.report(node, 'SKY101',
+                   f'{fn}() on a value inside jit-traced code forces a '
+                   'host sync (or TracerError) — keep it on device or '
+                   'fetch via engine.host_fetch outside the trace')
+    elif fn in _HOST_SYNC_DOTTED:
+        rep.report(node, 'SKY101',
+                   f'{fn}() inside jit-traced code is a device->host '
+                   'transfer — route results through engine.host_fetch '
+                   'outside the trace')
+    elif isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ('item', 'block_until_ready'):
+        rep.report(node, 'SKY101',
+                   f'.{node.func.attr}() inside jit-traced code is a '
+                   'host sync — keep the value on device')
+    # SKY103: impure calls.
+    if fn == 'print':
+        rep.report(node, 'SKY103',
+                   'print() inside jit-traced code runs at trace time '
+                   'only — use jax.debug.print for runtime output')
+    elif fn and fn.startswith(_IMPURE_PREFIXES):
+        rep.report(node, 'SKY103',
+                   f'{fn}() inside jit-traced code executes once at '
+                   'trace time and is baked into the compiled program')
+    # SKY104: constant PRNG seeds.
+    if fn in ('jax.random.PRNGKey', 'random.PRNGKey', 'jrandom.PRNGKey',
+              'jax.random.key') and node.args and \
+            isinstance(node.args[0], ast.Constant):
+        rep.report(node, 'SKY104',
+                   'PRNGKey(constant) inside jit-traced code replays '
+                   'identical randomness every call — thread the key '
+                   'in as an argument')
+
+
+class _ModuleRuleVisitor(ast.NodeVisitor):
+    """Module-wide rules: SKY105/106/201/202/301/302."""
+
+    def __init__(self, rep: _Reporter, path: str):
+        self.rep = rep
+        self.path = path
+        self.is_data_plane = path.endswith(DATA_PLANE_MODULES)
+        self.sleep_allowed = path.endswith(SLEEP_ALLOWLIST_MODULES)
+        parts = path.split('/')[:-1]
+        self.is_recovery = any(
+            f'{p}/' in RECOVERY_PATH_PREFIXES for p in parts)
+        self._async_depth = 0
+        self._loop_depth = 0
+        self._in_host_fetch = False
+
+    # -- scope tracking ---------------------------------------------------
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A sync def nested under an async handler is typically shipped
+        # to an executor thread — blocking there is legal.  host_fetch
+        # itself is THE sanctioned transfer point.
+        prev_async, self._async_depth = self._async_depth, 0
+        prev_hf = self._in_host_fetch
+        if node.name == 'host_fetch':
+            self._in_host_fetch = True
+        self.generic_visit(node)
+        self._async_depth = prev_async
+        self._in_host_fetch = prev_hf
+
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    # -- rules ------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = _dotted(node.func)
+        self._check_f64_call(node, fn)
+        if self.is_data_plane and not self._in_host_fetch:
+            self._check_host_fetch_bypass(node, fn)
+        if self._async_depth > 0:
+            self._check_blocking(node, fn)
+        elif (fn == 'time.sleep' and self._loop_depth > 0
+              and not self.sleep_allowed and node.args
+              and isinstance(node.args[0], ast.Constant)):
+            self.rep.report(
+                node, 'SKY202',
+                'constant time.sleep in a polling loop — use '
+                'skypilot_tpu.utils.backoff.Backoff (bounded '
+                'exponential backoff) so retries back off instead of '
+                'spinning at a fixed rate')
+        self.generic_visit(node)
+
+    def _check_f64_call(self, node: ast.Call, fn: Optional[str]) -> None:
+        if fn == 'jax.config.update' and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value == 'jax_enable_x64':
+            self.rep.report(node, 'SKY106',
+                            'jax_enable_x64 promotes the whole process '
+                            'to f64 — never in library code')
+        for kw in node.keywords:
+            if kw.arg == 'dtype' and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value in ('float64', 'double', 'f64'):
+                self.rep.report(node, 'SKY106',
+                                f'dtype={kw.value.value!r} — f64 has no '
+                                'TPU fast path and doubles bandwidth')
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == 'astype' and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value in ('float64', 'double', 'f64'):
+            self.rep.report(node, 'SKY106',
+                            '.astype to f64 — f64 has no TPU fast path')
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if _dotted(node) in _F64_DOTTED:
+            self.rep.report(node, 'SKY106',
+                            f'{_dotted(node)} literal — f64 has no TPU '
+                            'fast path and doubles bandwidth')
+        self.generic_visit(node)
+
+    def _check_host_fetch_bypass(self, node: ast.Call,
+                                 fn: Optional[str]) -> None:
+        bare_asarray = (fn in ('np.asarray', 'numpy.asarray',
+                               'np.array', 'numpy.array')
+                        and len(node.args) == 1 and not node.keywords)
+        if bare_asarray or fn == 'jax.device_get' or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == 'block_until_ready'):
+            self.rep.report(
+                node, 'SKY105',
+                'device->host transfer outside engine.host_fetch — the '
+                'decode data plane counts its syncs '
+                '(skytpu_infer_host_syncs_total); route this through '
+                'engine.host_fetch or mark it  # skytpu-allow: SKY105')
+
+    def _check_blocking(self, node: ast.Call,
+                        fn: Optional[str]) -> None:
+        blocking = (fn in _BLOCKING_DOTTED
+                    or (fn or '').startswith(_BLOCKING_DOTTED_PREFIXES))
+        if blocking:
+            self.rep.report(
+                node, 'SKY201',
+                f'{fn}() blocks the event loop inside an async handler '
+                '— await an async client, or run_in_executor/to_thread')
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.rep.report(node, 'SKY301',
+                            "bare 'except:' swallows KeyboardInterrupt/"
+                            'SystemExit — catch a concrete exception')
+        elif self.is_recovery and all(
+                isinstance(stmt, (ast.Pass, ast.Continue))
+                for stmt in node.body):
+            self.rep.report(
+                node, 'SKY302',
+                'recovery-path except handler swallows the error '
+                'silently — log via sky_logging or re-raise')
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def _allow_map(source: str) -> Dict[int, Set[str]]:
+    """lineno -> codes allowed by a `# skytpu-allow: ...` comment."""
+    allow: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        marker = 'skytpu-allow:'
+        pos = line.find(marker)
+        if pos < 0 or '#' not in line[:pos]:
+            continue
+        codes = {c.strip() for c in
+                 line[pos + len(marker):].split(',') if c.strip()}
+        if codes:
+            allow[i] = codes
+    return allow
+
+
+def lint_source(source: str, path: str = '<string>') -> List[Violation]:
+    path = path.replace(os.sep, '/')
+    lines = source.splitlines()
+    allow = _allow_map(source)
+    rep = _Reporter(path, lines, allow)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        rep.violations.append(Violation(
+            path, e.lineno or 0, e.offset or 0, 'SKY000',
+            f'file does not parse: {e.msg}', ''))
+        return rep.violations
+
+    collector = _TracedCollector()
+    collector.visit(tree)
+    for fn_node in collector.resolve():
+        _walk_traced(fn_node, rep, set())
+    _ModuleRuleVisitor(rep, path).visit(tree)
+    rep.violations.sort(key=lambda v: (v.line, v.col, v.code))
+    return rep.violations
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Violation]:
+    rel = os.path.relpath(path, root) if root else path
+    with open(path, 'r', encoding='utf-8') as f:
+        return lint_source(f.read(), rel)
+
+
+def lint_paths(paths: Iterable[str],
+               root: Optional[str] = None) -> List[Violation]:
+    """Lint every .py file under the given files/directories."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ('__pycache__', '.git', 'build',
+                                 'node_modules'))
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith('.py'))
+        elif p.endswith('.py'):
+            files.append(p)
+    out: List[Violation] = []
+    for f in files:
+        out.extend(lint_file(f, root=root))
+    return out

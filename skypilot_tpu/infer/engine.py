@@ -212,13 +212,17 @@ class Generator:
         # sampling + EOS/done tracking): ONE host fetch per chunk
         # instead of one per token — the per-token device→host sync
         # would dominate wall clock otherwise.  Compiled per
-        # (n, cache bucket) pair: a bounded set.
+        # (n, cache bucket) pair: a bounded set.  The KV cache is
+        # donated: the caller rebinds it from the returned tuple every
+        # chunk, so aliasing the buffers avoids holding two full caches
+        # live across each dispatch.
         self._decode_chunk = jax.jit(
             functools.partial(self._decode_chunk_impl,
                               temperature=gen_config.temperature,
                               top_k=gen_config.top_k,
                               top_p=gen_config.top_p,
                               eos=gen_config.eos_token),
+            donate_argnums=(2,),
             static_argnames=('n',))
         # Bucket migration: pad/truncate the cache's position axis on
         # device — one on-device copy, no host round-trip.  (Not

@@ -198,8 +198,14 @@ class ControlChannel:
     def connect(cls, host: str, port: int,
                 timeout_s: float = 120.0) -> 'ControlChannel':
         import time
+
+        from skypilot_tpu.utils import backoff as backoff_lib
         deadline = time.monotonic() + timeout_s
         last_err: Optional[Exception] = None
+        # Exponential backoff with jitter instead of a fixed 0.2s poll:
+        # every worker in the slice retries this rendezvous at once, and
+        # a constant interval keeps them hammering the head in lockstep.
+        retry = backoff_lib.Backoff(initial=0.2, cap=2.0)
         while time.monotonic() < deadline:
             try:
                 sock = socket.create_connection((host, port), timeout=5.0)
@@ -209,7 +215,7 @@ class ControlChannel:
                 return cls('worker', [sock])
             except OSError as e:  # head not listening yet
                 last_err = e
-                time.sleep(0.2)
+                retry.sleep()
         raise ConnectionError(
             f'control channel connect to {host}:{port} timed out: '
             f'{last_err}')

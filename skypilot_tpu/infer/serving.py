@@ -496,7 +496,9 @@ class ContinuousBatcher:
                     request.slot = None
                     self._queue.insert(0, request)
                 raise
-            firsts = np.asarray(firsts)
+            # ONE counted sync for the whole admitted group — the
+            # per-request int() below reads host memory, not device.
+            (firsts,) = engine_lib.host_fetch(firsts)
             for i, req in enumerate(group):
                 self._host_pos[req.slot] = len(req.prompt)
                 req.out.append(int(firsts[i]))
@@ -579,7 +581,10 @@ class ContinuousBatcher:
         self._host_top_p[req.slot] = top_p
         self._incremental = None
         eos = self.gen.eos_token
-        req.out.append(int(np.asarray(first)))
+        # Counted sync: the first sampled token is the one value the
+        # scheduler needs on host to test EOS/limit before promotion.
+        (first_host,) = engine_lib.host_fetch(first)
+        req.out.append(int(first_host))
         if (eos is not None and req.out[-1] == eos) or \
                 len(req.out) >= req.max_new_tokens:
             self._finish(req)

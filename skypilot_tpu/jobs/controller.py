@@ -96,8 +96,12 @@ class JobController:
             if record['status'] == ManagedJobStatus.CANCELLING:
                 try:
                     AgentClient(handle.agent_url()).cancel(None)
-                except requests.RequestException:
-                    pass
+                except requests.RequestException as e:
+                    # Teardown below kills the cluster either way, but an
+                    # unreachable agent during cancel is worth a trace.
+                    logger.warning(
+                        f'Job {self.job_id}: agent cancel request '
+                        f'failed (proceeding to teardown): {e}')
                 strategy.teardown()
                 self.table.set_status(self.job_id,
                                       ManagedJobStatus.CANCELLED)
@@ -218,8 +222,14 @@ class JobController:
             if record['status'] == ManagedJobStatus.CANCELLING:
                 try:
                     AgentClient(handle.agent_url()).cancel([cluster_job_id])
-                except requests.RequestException:
-                    pass
+                except requests.RequestException as e:
+                    # The slot is released either way, but the pooled
+                    # worker keeps running an uncancelled job if the
+                    # agent was unreachable — log it.
+                    logger.warning(
+                        f'Job {self.job_id}: agent cancel of cluster '
+                        f'job {cluster_job_id} failed (releasing slot '
+                        f'anyway): {e}')
                 table.release(pool_name, cluster)
                 self.table.set_status(self.job_id,
                                       ManagedJobStatus.CANCELLED)

@@ -228,8 +228,14 @@ def reconcile(name: str) -> None:
                     state_lib.get_cluster(w['cluster_name']) is not None:
                 try:
                     core_lib.down(w['cluster_name'])
-                except exceptions.SkyTpuError:
-                    pass
+                except exceptions.SkyTpuError as e:
+                    # Relaunch proceeds regardless, but a teardown that
+                    # keeps failing leaks a billed TPU VM — it must be
+                    # visible in the controller log.
+                    logger.warning(
+                        f'Pool {name!r}: teardown of failed worker '
+                        f'{worker_id} ({w["cluster_name"]}) failed, '
+                        f'relaunching anyway: {e}')
             _launch_worker(table, name, worker_id, pool['task_config'])
 
 

@@ -111,8 +111,12 @@ class SkyServeLoadBalancer:
                 logger.warning(f'Replica {url} failed mid-stream: {e}')
                 try:
                     await out.write_eof()
-                except (ConnectionError, RuntimeError):
-                    pass
+                except (ConnectionError, RuntimeError) as e:
+                    # Client hung up while we were closing the
+                    # truncated stream — nothing to recover, but keep
+                    # the trail next to the mid-stream warning above.
+                    logger.debug(f'Replica {url}: closing truncated '
+                                 f'stream failed: {e}')
                 return out
             status = '502'
             return web.Response(status=502,

@@ -1,0 +1,364 @@
+"""Tier-1 wiring for skytpu-lint (skypilot_tpu/analysis/).
+
+Three layers:
+
+1. **Rule units**: every linter rule fires on a known-bad snippet and
+   stays quiet on the sanctioned pattern next to it.
+2. **Package gate**: `skypilot_tpu/` lints clean against the checked-in
+   baseline, and the baseline itself can shrink but never grow.
+3. **Auditor**: the decode chunk compiles exactly once per cache bucket
+   and donates its KV cache — plus the NEGATIVE directions: a synthetic
+   ``int(tracer)`` in the decode body must surface as a lint violation
+   AND an audit failure, and an extra per-bucket recompile must breach
+   the compile budget.
+"""
+import os
+import textwrap
+
+import jax
+import pytest
+
+from skypilot_tpu.analysis import baseline as baseline_lib
+from skypilot_tpu.analysis import linter
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE_ROOT = os.path.join(REPO_ROOT, 'skypilot_tpu')
+
+
+def codes(source: str, path: str = 'infer/somefile.py'):
+    return [v.code for v in linter.lint_source(textwrap.dedent(source),
+                                               path)]
+
+
+# ---------------------------------------------------------------------------
+# 1. Rule units
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_in_jitted_function():
+    assert 'SKY101' in codes("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return int(x)
+    """)
+
+
+def test_host_sync_in_jit_call_target():
+    # jax.jit(f) marks f traced even without a decorator.
+    assert 'SKY101' in codes("""
+        import jax
+
+        def step(x):
+            return x.item()
+
+        step_fn = jax.jit(step)
+    """)
+
+
+def test_host_sync_in_fori_loop_body():
+    assert 'SKY101' in codes("""
+        from jax import lax
+        import numpy as np
+
+        def run(x):
+            def body(i, carry):
+                return np.asarray(carry)
+            return lax.fori_loop(0, 4, body, x)
+
+        import jax
+        run_fn = jax.jit(run)
+    """)
+
+
+def test_untraced_function_is_clean():
+    # Host code may int()/np.asarray() freely.
+    assert codes("""
+        import numpy as np
+
+        def host_side(x):
+            return int(np.asarray(x))
+    """, path='jobs/host.py') == []
+
+
+def test_tracer_control_flow():
+    assert 'SKY102' in codes("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+
+
+def test_static_control_flow_is_clean():
+    # kwonly params are static (repo convention: partial + static_argnames)
+    # and `is None` / isinstance tests never concretize a tracer.
+    assert codes("""
+        import jax
+
+        @jax.jit
+        def step(x, *, n):
+            if n > 2:
+                x = x + 1
+            if x is None:
+                return 0
+            if isinstance(x, dict):
+                return x['a']
+            return x
+    """) == []
+
+
+def test_impure_and_prng_in_jit():
+    got = codes("""
+        import jax, time
+
+        @jax.jit
+        def step(x):
+            time.monotonic()
+            print(x)
+            key = jax.random.PRNGKey(0)
+            return x
+    """)
+    assert got.count('SKY103') == 2 and 'SKY104' in got
+
+
+def test_f64_promotion():
+    got = codes("""
+        import numpy as np
+
+        def make(x):
+            a = np.zeros(3, dtype='float64')
+            b = np.float64(x)
+            return a, b
+    """)
+    assert got.count('SKY106') == 2
+
+
+def test_host_fetch_bypass_only_in_data_plane():
+    bad = """
+        import numpy as np
+
+        def drain(x):
+            return np.asarray(x)
+    """
+    assert 'SKY105' in codes(bad, path='infer/serving.py')
+    # Same code outside the decode data plane is fine...
+    assert 'SKY105' not in codes(bad, path='jobs/core.py')
+    # ...and host_fetch itself is THE sanctioned transfer point.
+    assert 'SKY105' not in codes("""
+        import numpy as np
+
+        def host_fetch(*arrays):
+            return tuple(np.asarray(a) for a in arrays)
+    """, path='infer/engine.py')
+
+
+def test_blocking_in_async_handler():
+    got = codes("""
+        import time, requests
+
+        async def handler(request):
+            time.sleep(1)
+            return requests.get('http://replica')
+    """, path='serve/load_balancer.py')
+    assert got.count('SKY201') == 2
+
+
+def test_sleep_poll_loop_and_backoff_allowlist():
+    bad = """
+        import time
+
+        def wait(pred):
+            while not pred():
+                time.sleep(0.2)
+    """
+    assert 'SKY202' in codes(bad, path='jobs/core.py')
+    # The bounded-backoff helper is the sanctioned home for this sleep.
+    assert 'SKY202' not in codes(bad, path='utils/backoff.py')
+
+
+def test_silent_except_only_on_recovery_paths():
+    bad = """
+        def recover():
+            try:
+                relaunch()
+            except ValueError:
+                pass
+    """
+    assert 'SKY302' in codes(bad, path='jobs/pool.py')
+    assert 'SKY302' not in codes(bad, path='infer/engine.py')
+    assert codes("""
+        def recover():
+            try:
+                relaunch()
+            except:
+                raise SystemExit
+    """, path='infer/engine.py') == ['SKY301']
+
+
+def test_inline_allow_suppresses():
+    assert codes("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return int(x)  # skytpu-allow: SKY101
+    """) == []
+
+
+def test_parse_error_is_a_finding():
+    assert codes('def broken(:\n') == ['SKY000']
+
+
+# ---------------------------------------------------------------------------
+# 2. Package gate + baseline discipline
+# ---------------------------------------------------------------------------
+
+
+def test_package_lints_clean_against_baseline():
+    violations = linter.lint_paths([PACKAGE_ROOT], root=REPO_ROOT)
+    baseline = baseline_lib.load_baseline()
+    new, _, _ = baseline_lib.diff_baseline(violations, baseline)
+    assert not new, ('NEW lint violations (fix them or, if sanctioned, '
+                     'mark "# skytpu-allow: <code>"):\n'
+                     + '\n'.join(v.format() for v in new))
+
+
+def test_baseline_must_not_grow():
+    # The suppression set may shrink (prune stale entries with
+    # --update-baseline after fixing) but NEVER grow: new violations
+    # must be fixed or inline-allowed, not baselined away.
+    assert len(baseline_lib.load_baseline()) <= 5
+
+
+def test_baseline_fingerprint_survives_line_drift():
+    src = 'import time\n\ndef f():\n    while True:\n        time.sleep(1)\n'
+    shifted = '# a new header comment\n' + src
+    (fp1, _), = baseline_lib.fingerprint_violations(
+        linter.lint_source(src, 'jobs/x.py'))
+    (fp2, _), = baseline_lib.fingerprint_violations(
+        linter.lint_source(shifted, 'jobs/x.py'))
+    assert fp1 == fp2
+
+
+# ---------------------------------------------------------------------------
+# 3. Auditor: budgets hold, and the negative directions really fail
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope='module')
+def audit_lib():
+    from skypilot_tpu.analysis import audit
+    return audit
+
+
+def test_decode_compiles_once_per_bucket_and_donates(audit_lib):
+    report = audit_lib.audit_generator_decode()
+    by_name = {c['name']: c for c in report['checks']}
+    # Exactly one compile per cache bucket for a bucket-crossing run —
+    # not merely <= budget: fewer would mean the run didn't cross.
+    assert report['compiles'] == len(report['buckets'])
+    assert by_name['compile_per_bucket']['status'] == 'ok'
+    assert by_name['donation']['status'] == 'ok', \
+        by_name['donation']['detail']
+    assert by_name['no_callbacks']['status'] == 'ok'
+    assert by_name['no_f64']['status'] == 'ok'
+
+
+def test_audit_run_is_green(audit_lib):
+    report = audit_lib.run_audit()
+    assert report['ok'], [
+        (e['entry'], c) for e in report['entries']
+        for c in e['checks'] if c['status'] == 'fail']
+
+
+def test_extra_recompile_breaches_budget(audit_lib):
+    # Simulate a retrace regression: warm the jit cache with a stray
+    # static n before the audited run.  The budget must catch it.
+    gen = audit_lib.make_tiny_generator()
+    args, _ = audit_lib._decode_chunk_inputs(gen, gen.cache_buckets[0], 3)
+    gen._decode_chunk(*args, n=3)
+    report = audit_lib.audit_generator_decode(gen)
+    by_name = {c['name']: c for c in report['checks']}
+    assert by_name['compile_per_bucket']['status'] == 'fail'
+
+
+def test_int_tracer_fails_audit(audit_lib, monkeypatch):
+    # A synthetic int(tracer) in the decode chunk: tracing raises
+    # ConcretizationTypeError, which the auditor reports as a failed
+    # check rather than crashing.
+    import functools
+
+    import jax as jax_lib
+
+    real_make = audit_lib.make_tiny_generator
+
+    def make_broken():
+        gen = real_make()
+        real_impl = gen._decode_chunk_impl
+
+        def bad_impl(params, token, cache, positions, done, limit, rng,
+                     *, n, temperature, top_k, top_p, eos):
+            int(token[0])  # the defect under test
+            return real_impl(params, token, cache, positions, done,
+                             limit, rng, n=n, temperature=temperature,
+                             top_k=top_k, top_p=top_p, eos=eos)
+
+        gen._decode_chunk = jax_lib.jit(
+            functools.partial(bad_impl, temperature=gen.gen.temperature,
+                              top_k=gen.gen.top_k, top_p=gen.gen.top_p,
+                              eos=gen.gen.eos_token),
+            donate_argnums=(2,), static_argnames=('n',))
+        return gen
+
+    monkeypatch.setattr(audit_lib, 'make_tiny_generator', make_broken)
+    report = audit_lib.run_audit(entries=['generator_decode'])
+    assert not report['ok']
+    (entry,) = report['entries']
+    fails = [c for c in entry['checks'] if c['status'] == 'fail']
+    assert fails and 'ConcretizationTypeError' in fails[0]['detail']
+
+
+def test_int_tracer_in_decode_source_is_lint_caught():
+    # The static half of the same defect: inject `int(token[0])` into
+    # the real engine source's decode-chunk body and lint it.
+    path = os.path.join(PACKAGE_ROOT, 'infer', 'engine.py')
+    with open(path, 'r', encoding='utf-8') as f:
+        lines = f.read().splitlines(keepends=True)
+    assert not [v for v in linter.lint_source(''.join(lines),
+                                              'infer/engine.py')
+                if v.code == 'SKY101'], 'engine.py must start clean'
+    anchor = next(i for i, ln in enumerate(lines)
+                  if 'def _decode_chunk_impl' in ln)
+    # Signature spans lines until the one ending in ':'.
+    body_at = next(i for i in range(anchor, len(lines))
+                   if lines[i].rstrip().endswith(':')) + 1
+    injected = ''.join(lines[:body_at]
+                       + ['        _bad = int(token[0])\n']
+                       + lines[body_at:])
+    got = [v for v in linter.lint_source(injected, 'infer/engine.py')
+           if v.code == 'SKY101']
+    assert got, 'injected int(tracer) in decode chunk must be flagged'
+
+
+def test_quick_summary_shape(audit_lib):
+    summary = audit_lib.quick_summary()
+    assert summary['compile_budget_ok'] and summary['cache_donated']
+    assert summary['failures'] == 0
+    assert summary['decode_compiles'] == len(summary['cache_buckets'])
+
+
+def test_cli_json_contract():
+    import json
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.analysis', '--json'],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report['ok'] and report['new'] == []
