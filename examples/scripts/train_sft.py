@@ -50,7 +50,10 @@ def main() -> None:
                              'MERGED full model (Orbax) here for '
                              'serving')
     parser.add_argument('--log-every', type=int, default=10)
-    parser.add_argument('--checkpoint-dir', default='')
+    parser.add_argument('--checkpoint-dir',
+                        default=os.environ.get(env_contract.CKPT_DIR, ''),
+                        help='checkpoint root (default: $SKYTPU_CKPT_DIR '
+                             'from the task envs)')
     parser.add_argument('--checkpoint-every', type=int, default=50)
     parser.add_argument('--resume', default='no', choices=['no', 'auto'])
     args = parser.parse_args()
@@ -137,18 +140,21 @@ def main() -> None:
         trainer = Trainer(base_loss, params, mesh,
                           rules, train_config)
 
-    if args.resume == 'auto' and args.checkpoint_dir:
-        import re
-        steps = []
-        if os.path.isdir(args.checkpoint_dir):
-            for d in os.listdir(args.checkpoint_dir):
-                m = re.fullmatch(r'step_(\d+)', d)
-                if m:
-                    steps.append(int(m.group(1)))
-        if steps:
-            trainer.restore_checkpoint(args.checkpoint_dir, max(steps))
-            if jax.process_index() == 0:
-                print(f'resumed from step {trainer.step}', flush=True)
+    if args.checkpoint_dir:
+        # Periodic saves run on a background writer (the step loop only
+        # pays for the device->host snapshot); SIGTERM (preemption
+        # notice) triggers one last blocking emergency save.
+        trainer.enable_checkpointing(
+            args.checkpoint_dir,
+            save_interval_steps=args.checkpoint_every,
+            keep_last=3)
+        # Resume on explicit --resume auto, or when the managed-jobs
+        # controller / gang driver injected the resume contract after a
+        # recovery (env_contract.RESUME_*).
+        if args.resume == 'auto' or env_contract.resume_target():
+            restored = trainer.restore_latest(args.checkpoint_dir)
+            if restored is not None and jax.process_index() == 0:
+                print(f'resumed from step {restored}', flush=True)
 
     batches = sft.sft_batches(args.data_file, encode, batch_size,
                               args.seq_len, eos_id=eos_id)
@@ -158,10 +164,9 @@ def main() -> None:
         if jax.process_index() == 0 and step % args.log_every == 0:
             print(f'step {step}: loss={float(metrics["loss"]):.4f}',
                   flush=True)
-        if args.checkpoint_dir and step % args.checkpoint_every == 0:
-            trainer.save_checkpoint(args.checkpoint_dir)
     if args.checkpoint_dir:
         trainer.save_checkpoint(args.checkpoint_dir)
+        trainer.wait_for_checkpoints(args.checkpoint_dir)
     if lora_state is not None and args.merge_save:
         from skypilot_tpu.train import lora as lora_lib
         base_params, lcfg = lora_state

@@ -150,6 +150,29 @@ def _rank_argv(host: Dict[str, Any], cmd: str, env: Dict[str, str],
     return (argv, None, None)
 
 
+def _resume_env_fallback(envs: Dict[str, str]) -> Dict[str, str]:
+    """Resume vars the controller could not fill in.
+
+    The managed-jobs controller injects SKYTPU_RESUME_* in _recover()
+    when the checkpoint root is visible from the controller host; when
+    it is only visible on-cluster (a mounted bucket path), the gang
+    driver resolves the last committed step here instead.  Returns {}
+    when the task declared no SKYTPU_CKPT_DIR, the controller already
+    filled the vars, or no committed checkpoint exists yet."""
+    if envs.get(env_contract.RESUME_STEP):
+        return {}
+    ckpt_dir = envs.get(env_contract.CKPT_DIR, '')
+    if not ckpt_dir:
+        return {}
+    try:
+        from skypilot_tpu import ckpt as ckpt_lib
+        return ckpt_lib.resume_envs(ckpt_dir)
+    except OSError as e:
+        print(f'driver: resume-env lookup in {ckpt_dir!r} failed: {e}',
+              file=sys.stderr)
+        return {}
+
+
 def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
              job_id: int) -> int:
     hosts: List[Dict[str, Any]] = spec['hosts']
@@ -190,6 +213,11 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             (p for p in range(start, start + 2000, 4)
              if all(_free(p + k) for k in range(3))), start)
 
+    # Resolved ONCE per gang (not per rank): every rank must agree on
+    # the resume step, and latest_step() could move if a rank raced a
+    # save against the scan.
+    resume_envs = _resume_env_fallback(spec.get('envs') or {})
+
     job_table.set_status(job_id, JobStatus.RUNNING)
     procs: List[Optional[subprocess.Popen]] = [None] * len(hosts)
     returncodes: List[Optional[int]] = [None] * len(hosts)
@@ -202,6 +230,8 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
             returncodes[rank] = 0
             return
         env = dict(spec.get('envs', {}))
+        for key, value in resume_envs.items():
+            env.setdefault(key, value)
         env.update(env_contract.make_env_vars(
             rank, node_ips,
             num_chips_per_node=int(spec.get('num_chips_per_node', 0)),

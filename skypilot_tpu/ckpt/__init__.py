@@ -1,0 +1,46 @@
+"""Preemption-aware checkpointing subsystem.
+
+Three layers (see docs/reference/checkpointing.md):
+
+- ``ckpt.format`` — the sharded on-disk format: per-array shard files,
+  a JSON manifest with SHA-256 content hashes, and an atomic
+  temp-dir-rename commit with a ``COMMITTED`` marker.
+- ``ckpt.writer`` — the bounded background writer of the async save
+  pipeline.
+- ``ckpt.manager`` — ``CheckpointManager``: interval saves, retention
+  GC, committed-only discovery, hash-verified restore with
+  walk-down-on-corruption, and the SIGTERM emergency-save hook.
+
+The managed-jobs resume contract (docs/jobs.md) also lives here:
+``resume_envs`` computes the ``SKYTPU_RESUME_*`` variables the
+controller/agent inject into a relaunched task so it resumes from the
+last *committed* step instead of restarting.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from skypilot_tpu.ckpt.format import (CorruptCheckpointError, latest_step,
+                                      scan_steps)
+from skypilot_tpu.ckpt.manager import CheckpointManager
+from skypilot_tpu.ckpt.writer import AsyncCheckpointWriter
+
+__all__ = ['AsyncCheckpointWriter', 'CheckpointManager',
+           'CorruptCheckpointError', 'latest_step', 'resume_envs',
+           'scan_steps']
+
+
+def resume_envs(ckpt_dir: Optional[str]) -> Dict[str, str]:
+    """The resume env vars for a task whose checkpoint root is
+    ``ckpt_dir`` (its ``SKYTPU_CKPT_DIR``).  Empty when the dir is
+    unset, not locally visible (e.g. a gs:// URI only mounted on the
+    cluster — the agent fills the vars in on-host instead), or holds no
+    committed checkpoint."""
+    from skypilot_tpu.utils import env_contract
+    if not ckpt_dir or '://' in ckpt_dir:
+        return {}
+    step = latest_step(ckpt_dir)
+    if step is None:
+        return {}
+    return {env_contract.RESUME_CKPT_PATH: ckpt_dir,
+            env_contract.RESUME_STEP: str(step)}

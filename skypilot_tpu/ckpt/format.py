@@ -1,0 +1,374 @@
+"""Sharded checkpoint on-disk format: per-array shards + hashed manifest.
+
+Layout of one committed step under a checkpoint root::
+
+    <root>/step_<N>/
+        arr_00000.npy            # one file per pytree leaf
+        arr_00001.npy
+        ...
+        manifest-p00000.json     # per-process shard manifest (hashes)
+        manifest.json            # merged manifest, written by process 0
+        COMMITTED                # commit marker
+
+Atomicity protocol: everything is written into ``<root>/.tmp.step_<N>``;
+the merged manifest and the ``COMMITTED`` marker land in the temp dir
+*before* the single ``os.rename`` to ``step_<N>``.  The rename is the
+one commit point — a crash at any earlier moment leaves only a
+``.tmp.*`` dir that discovery never trusts, so restore can never see a
+half-written checkpoint.  A ``step_<N>`` dir carrying a manifest but no
+marker (or vice versa) is treated as corrupt and skipped.
+
+Multihost: every process writes the leaves it owns (round-robin by leaf
+index) plus its own ``manifest-p<K>.json``; after the job-level barrier,
+process 0 merges the per-process manifests, writes the marker, and
+performs the commit rename.  Per-leaf SHA-256 content hashes in the
+manifest let restore detect bit rot / torn writes on any host.
+
+Legacy checkpoints: a ``step_<N>`` dir with neither manifest nor marker
+is an old Orbax checkpoint (Orbax's own tmp-dir naming guarantees a
+plain ``step_<N>`` is complete) — discovery reports it as committed with
+``fmt='orbax'`` and restore falls back to Orbax.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import shutil
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+MANIFEST = 'manifest.json'
+MARKER = 'COMMITTED'
+STEP_PREFIX = 'step_'
+TMP_PREFIX = '.tmp.'
+_STEP_RE = re.compile(r'step_(\d+)$')
+
+FORMAT_VERSION = 1
+
+# Chaos hook: tests install a callable(stage, path) that may raise to
+# simulate a crash/kill at a named point of the save protocol.  Stages,
+# in order: 'shard_written' (after each leaf file), 'process_manifest'
+# (after manifest-p<K>.json), 'pre_commit' (merged manifest + marker in
+# the temp dir, rename not yet issued), 'committed' (after the rename).
+_stage_hook: Optional[Callable[[str, str], None]] = None
+
+
+def set_stage_hook(hook: Optional[Callable[[str, str], None]]
+                   ) -> Optional[Callable[[str, str], None]]:
+    """Install a save-protocol chaos hook; returns the previous one."""
+    global _stage_hook
+    previous = _stage_hook
+    _stage_hook = hook
+    return previous
+
+
+def _stage(stage: str, path: str) -> None:
+    if _stage_hook is not None:
+        _stage_hook(stage, path)
+
+
+class CorruptCheckpointError(Exception):
+    """A step dir failed integrity checks (missing marker/manifest,
+    unparseable manifest, missing shard, or SHA-256 mismatch)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class StepInfo:
+    step: int
+    path: str
+    fmt: str  # 'sharded' | 'orbax'
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f'{STEP_PREFIX}{step}')
+
+
+def tmp_dir(root: str, step: int) -> str:
+    # Deterministic (no uuid): every process of a multihost save must
+    # agree on the staging dir.  Stale leftovers from a crashed save are
+    # removed by the next save of the same step / clean_stale_tmp.
+    return os.path.join(root, f'{TMP_PREFIX}{STEP_PREFIX}{step}')
+
+
+def _keystr(path) -> str:
+    import jax
+    return jax.tree_util.keystr(path)
+
+
+def flatten_with_keys(pytree) -> Tuple[List[Tuple[str, Any]], Any]:
+    """Flatten to [(keypath-string, leaf)] + treedef, in a stable order."""
+    import jax
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        pytree)
+    return ([(_keystr(path), leaf) for path, leaf in leaves_with_paths],
+            treedef)
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = path + '.part'
+    with open(tmp, 'wb') as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_process_shards(root: str, step: int, pytree,
+                         process_index: int = 0,
+                         process_count: int = 1) -> Dict[str, Any]:
+    """Write this process's leaves + per-process manifest into the temp
+    dir.  Leaves are assigned round-robin by flatten index, so a
+    multihost save spreads disk/GCS-fuse bandwidth across hosts.
+    Returns the per-process manifest dict (entries + bytes written)."""
+    staging = tmp_dir(root, step)
+    if process_index == 0:
+        # Process 0 owns staging lifecycle: clear a stale temp dir left
+        # by a crashed earlier save of this same step.
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+    os.makedirs(staging, exist_ok=True)
+    named_leaves, _ = flatten_with_keys(pytree)
+    entries = []
+    total_bytes = 0
+    for i, (key, leaf) in enumerate(named_leaves):
+        if i % process_count != process_index:
+            continue
+        arr = np.asarray(leaf)
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        data = buf.getvalue()
+        filename = f'arr_{i:05d}.npy'
+        _atomic_write_bytes(os.path.join(staging, filename), data)
+        _stage('shard_written', os.path.join(staging, filename))
+        entries.append({
+            'index': i,
+            'key': key,
+            'file': filename,
+            'sha256': hashlib.sha256(data).hexdigest(),
+            'dtype': str(arr.dtype),
+            'shape': list(arr.shape),
+            'bytes': len(data),
+        })
+        total_bytes += len(data)
+    process_manifest = {
+        'version': FORMAT_VERSION,
+        'step': step,
+        'process_index': process_index,
+        'process_count': process_count,
+        'num_leaves': len(named_leaves),
+        'entries': entries,
+        'bytes': total_bytes,
+    }
+    _atomic_write_bytes(
+        os.path.join(staging, f'manifest-p{process_index:05d}.json'),
+        json.dumps(process_manifest, indent=1).encode())
+    _stage('process_manifest', staging)
+    return process_manifest
+
+
+def commit(root: str, step: int, process_count: int = 1,
+           metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Process-0-only: merge per-process manifests, write marker, rename.
+
+    Callers must have passed the job-level barrier first (every process
+    finished write_process_shards).  Returns the committed dir path."""
+    staging = tmp_dir(root, step)
+    merged_entries: List[Dict[str, Any]] = []
+    num_leaves = None
+    for p in range(process_count):
+        path = os.path.join(staging, f'manifest-p{p:05d}.json')
+        if not os.path.exists(path):
+            raise CorruptCheckpointError(
+                f'commit of step {step}: missing shard manifest for '
+                f'process {p} (barrier violated or writer died)')
+        with open(path, 'r', encoding='utf-8') as f:
+            pm = json.load(f)
+        num_leaves = pm['num_leaves']
+        merged_entries.extend(pm['entries'])
+    merged_entries.sort(key=lambda e: e['index'])
+    if num_leaves is not None and len(merged_entries) != num_leaves:
+        raise CorruptCheckpointError(
+            f'commit of step {step}: {len(merged_entries)} shard entries '
+            f'for {num_leaves} leaves')
+    manifest = {
+        'version': FORMAT_VERSION,
+        'step': step,
+        'process_count': process_count,
+        'entries': merged_entries,
+        'bytes': sum(e['bytes'] for e in merged_entries),
+        'metadata': metadata or {},
+    }
+    _atomic_write_bytes(os.path.join(staging, MANIFEST),
+                        json.dumps(manifest, indent=1).encode())
+    # Marker BEFORE the rename: the rename is the single atomic commit
+    # point, and a committed dir always carries its marker.
+    _atomic_write_bytes(os.path.join(staging, MARKER), b'')
+    _stage('pre_commit', staging)
+    final = step_dir(root, step)
+    if os.path.isdir(final):
+        # Re-save of an existing step (e.g. emergency save racing the
+        # interval save): replace the old committed dir.
+        shutil.rmtree(final)
+    os.rename(staging, final)
+    _stage('committed', final)
+    return final
+
+
+def save_pytree(root: str, step: int, pytree,
+                process_index: int = 0, process_count: int = 1,
+                metadata: Optional[Dict[str, Any]] = None,
+                barrier: Optional[Callable[[], None]] = None
+                ) -> Optional[str]:
+    """Full save flow for one process.  Non-zero processes return after
+    writing their shards (None); process 0 commits and returns the
+    committed dir."""
+    os.makedirs(root, exist_ok=True)
+    write_process_shards(root, step, pytree, process_index, process_count)
+    if barrier is not None:
+        barrier()
+    if process_index != 0:
+        return None
+    return commit(root, step, process_count, metadata)
+
+
+def scan_steps(root: str) -> Tuple[List[StepInfo], List[str]]:
+    """Discover step dirs under root.
+
+    Returns (committed, corrupt_paths), committed sorted by step
+    ascending.  Committed means: our marker + manifest both present
+    (fmt='sharded'), or neither present (a completed legacy Orbax dir,
+    fmt='orbax' — Orbax stages into differently-named tmp dirs, so a
+    plain step_<N> is complete).  A dir with only one of the two is a
+    torn commit: reported corrupt, never trusted."""
+    committed: List[StepInfo] = []
+    corrupt: List[str] = []
+    if not os.path.isdir(root):
+        return committed, corrupt
+    for name in os.listdir(root):
+        match = _STEP_RE.fullmatch(name)
+        path = os.path.join(root, name)
+        if not match or not os.path.isdir(path):
+            continue
+        step = int(match.group(1))
+        has_marker = os.path.exists(os.path.join(path, MARKER))
+        has_manifest = os.path.exists(os.path.join(path, MANIFEST))
+        if has_marker and has_manifest:
+            committed.append(StepInfo(step, path, 'sharded'))
+        elif not has_marker and not has_manifest:
+            committed.append(StepInfo(step, path, 'orbax'))
+        else:
+            corrupt.append(path)
+    committed.sort(key=lambda info: info.step)
+    return committed, corrupt
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Newest committed step under root (None when there is none).
+    Uncommitted temp dirs and torn commits are invisible here."""
+    committed, _ = scan_steps(root)
+    return committed[-1].step if committed else None
+
+
+def load_manifest(root: str, step: int) -> Dict[str, Any]:
+    path = os.path.join(step_dir(root, step), MANIFEST)
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CorruptCheckpointError(
+            f'step {step}: unreadable manifest: {e}') from e
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """A dtype from its manifest string.  Extension dtypes (bfloat16,
+    float8_*) are not plain-numpy names; they resolve through ml_dtypes
+    (always present — jax depends on it)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def restore_pytree(root: str, step: int, template) -> Any:
+    """Load a sharded checkpoint as host numpy arrays shaped like
+    ``template``.  Every shard's SHA-256 is verified against the
+    manifest; any mismatch raises CorruptCheckpointError."""
+    import jax
+    directory = step_dir(root, step)
+    if not os.path.exists(os.path.join(directory, MARKER)):
+        raise CorruptCheckpointError(
+            f'step {step}: no {MARKER} marker — uncommitted or torn save')
+    manifest = load_manifest(root, step)
+    named_leaves, treedef = flatten_with_keys(template)
+    entries = manifest['entries']
+    if len(entries) != len(named_leaves):
+        raise CorruptCheckpointError(
+            f'step {step}: manifest has {len(entries)} arrays, template '
+            f'has {len(named_leaves)} leaves')
+    leaves = []
+    for (key, _), entry in zip(named_leaves, sorted(entries,
+                                                    key=lambda e: e['index'])):
+        if entry['key'] != key:
+            raise CorruptCheckpointError(
+                f'step {step}: manifest key {entry["key"]!r} does not '
+                f'match template leaf {key!r}')
+        path = os.path.join(directory, entry['file'])
+        try:
+            with open(path, 'rb') as f:
+                data = f.read()
+        except OSError as e:
+            raise CorruptCheckpointError(
+                f'step {step}: missing shard {entry["file"]}: {e}') from e
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != entry['sha256']:
+            raise CorruptCheckpointError(
+                f'step {step}: hash mismatch on {entry["file"]} '
+                f'(manifest {entry["sha256"][:12]}…, got {digest[:12]}…)')
+        arr = np.load(io.BytesIO(data), allow_pickle=False)
+        if str(arr.dtype) != entry['dtype']:
+            # The .npy header degrades extension dtypes (bfloat16,
+            # float8_*) to raw void bytes ('|V2'); the manifest keeps
+            # the true dtype — reinterpret the buffer.
+            try:
+                arr = arr.view(_resolve_dtype(entry['dtype']))
+            except (TypeError, ValueError, AttributeError) as e:
+                raise CorruptCheckpointError(
+                    f'step {step}: shard {entry["file"]} has dtype '
+                    f'{arr.dtype} but manifest says '
+                    f'{entry["dtype"]!r}: {e}') from e
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def remove_step(root: str, step: int) -> None:
+    path = step_dir(root, step)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+
+
+def clean_stale_tmp(root: str) -> List[str]:
+    """Remove leftover staging dirs from crashed saves.  Only safe when
+    no save is in flight (the manager calls it before a new save)."""
+    removed = []
+    if not os.path.isdir(root):
+        return removed
+    for name in os.listdir(root):
+        if name.startswith(TMP_PREFIX):
+            path = os.path.join(root, name)
+            try:
+                shutil.rmtree(path)
+                removed.append(path)
+            except OSError as e:
+                logger.warning(f'Could not remove stale checkpoint '
+                               f'staging dir {path}: {e}')
+    return removed

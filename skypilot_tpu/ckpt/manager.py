@@ -1,0 +1,351 @@
+"""CheckpointManager: interval/async saves, retention GC, discovery,
+emergency (preemption) saves.
+
+The manager owns one checkpoint root and composes the pieces:
+
+- ``save(step, pytree)`` — device→host snapshot on the caller thread,
+  then the sharded-format write + atomic commit either inline
+  (``blocking=True``) or on the bounded background writer.
+- ``should_save(step)`` — interval gate (``save_interval_steps``).
+- retention GC — after each commit (and only then), keep the newest
+  ``keep_last`` checkpoints plus every step divisible by ``keep_every``;
+  delete the rest.  GC runs post-commit on the writer thread, so a
+  failed save can never delete the checkpoints it was meant to replace.
+- ``latest_step()`` / ``restore_latest()`` — discovery that trusts only
+  committed dirs; restore verifies shard hashes and walks down to the
+  next older step on corruption (counted in
+  ``skytpu_ckpt_corrupt_skips_total``).
+- ``install_signal_handlers()`` — SIGTERM (and any maintenance signal
+  the caller picks, e.g. SIGUSR1 wired to a TPU maintenance-event
+  watcher) triggers one blocking emergency save of the state returned
+  by the registered provider, then chains to the previous handler so
+  normal termination semantics are preserved.
+
+Multihost: pass ``process_index``/``process_count`` (default: the JAX
+process grid when initialized) and every process writes its own shard
+files; process 0 runs the barrier, commits the manifest, and GCs.
+
+Orbax fallback: ``restore`` reads legacy ``step_<N>`` Orbax dirs (no
+manifest/marker) so pre-existing checkpoints stay restorable.
+"""
+from __future__ import annotations
+
+import os
+import signal as signal_module
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.ckpt import format as format_lib
+from skypilot_tpu.ckpt.writer import AsyncCheckpointWriter
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _metrics():
+    # Deferred: prometheus families live in telemetry; importing them
+    # lazily keeps `skypilot_tpu.ckpt.format` usable from the agent's
+    # light paths without dragging the whole telemetry layer in.
+    from skypilot_tpu.telemetry import metrics as telemetry_metrics
+    return telemetry_metrics
+
+
+def _default_process_grid() -> Tuple[int, int]:
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:  # pylint: disable=broad-except
+        return 0, 1
+
+
+def _snapshot(pytree):
+    """Device→host copy of every leaf (numpy), on the caller thread.
+
+    This is the synchronization point of an async save: it waits for the
+    step that produced the arrays and copies them out, after which the
+    train loop may donate/overwrite the device buffers freely."""
+    import jax
+    import numpy as np
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(jax.device_get(leaf)), pytree)
+
+
+class CheckpointManager:
+    """Manages the checkpoints of one training run under one root."""
+
+    def __init__(self,
+                 directory: str,
+                 save_interval_steps: int = 0,
+                 keep_last: Optional[int] = None,
+                 keep_every: Optional[int] = None,
+                 max_pending: int = 2,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 barrier: Optional[Callable[[], None]] = None):
+        if keep_last is not None and keep_last < 1:
+            raise ValueError(f'keep_last must be >= 1, got {keep_last}')
+        self.directory = directory
+        self.save_interval_steps = save_interval_steps
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        default_index, default_count = _default_process_grid()
+        self.process_index = (default_index if process_index is None
+                              else process_index)
+        self.process_count = (default_count if process_count is None
+                              else process_count)
+        self._barrier = barrier
+        self._writer = AsyncCheckpointWriter(
+            max_pending=max_pending,
+            depth_callback=self._set_queue_depth)
+        self._save_lock = threading.Lock()
+        self._state_provider: Optional[Callable[[], Tuple[int, Any]]] = None
+        self._prev_handlers: Dict[int, Any] = {}
+        self._in_emergency_save = False
+        self._last_saved_step: Optional[int] = None
+
+    @staticmethod
+    def _set_queue_depth(depth: int) -> None:
+        _metrics().CKPT_QUEUE_DEPTH.set(depth)
+
+    # -- interval gate -----------------------------------------------------
+    def should_save(self, step: int) -> bool:
+        if self.save_interval_steps <= 0:
+            return False
+        if step == self._last_saved_step:
+            return False
+        return step % self.save_interval_steps == 0
+
+    # -- save --------------------------------------------------------------
+    def save(self, step: int, pytree,
+             blocking: bool = False,
+             metadata: Optional[Dict[str, Any]] = None,
+             kind: Optional[str] = None) -> None:
+        """Checkpoint ``pytree`` as ``step``.
+
+        blocking=False: snapshot here, write/commit on the background
+        writer (the step loop keeps running).  blocking=True: the full
+        pipeline runs on the caller thread.  Either way the on-disk
+        commit is atomic (see ckpt/format.py)."""
+        metrics = _metrics()
+        kind = kind or ('blocking' if blocking else 'interval')
+        start = time.perf_counter()
+        host_tree = _snapshot(pytree)
+        snapshot_s = time.perf_counter() - start
+        metrics.CKPT_SAVE_SECONDS.labels(phase='snapshot').observe(
+            snapshot_s)
+        self._last_saved_step = step
+        if blocking:
+            self._write_and_commit(step, host_tree, metadata, kind)
+            metrics.CKPT_SAVE_SECONDS.labels(phase='blocking').observe(
+                time.perf_counter() - start)
+        else:
+            self._writer.submit(
+                lambda: self._write_and_commit(step, host_tree, metadata,
+                                               kind))
+
+    def wait_until_finished(self) -> None:
+        """Block until every queued async save has committed; re-raises
+        the first failure."""
+        self._writer.wait_until_finished()
+
+    def close(self) -> None:
+        self._writer.close()
+        self.uninstall_signal_handlers()
+
+    def _write_and_commit(self, step: int, host_tree,
+                          metadata: Optional[Dict[str, Any]],
+                          kind: str) -> None:
+        metrics = _metrics()
+        start = time.perf_counter()
+        with self._save_lock:
+            format_lib.clean_stale_tmp(self.directory)
+            committed = format_lib.save_pytree(
+                self.directory, step, host_tree,
+                process_index=self.process_index,
+                process_count=self.process_count,
+                metadata=dict(metadata or {}, kind=kind,
+                              time=time.time()),
+                barrier=self._barrier)
+            if committed is not None:
+                manifest = format_lib.load_manifest(self.directory, step)
+                metrics.CKPT_BYTES_WRITTEN.inc(manifest.get('bytes', 0))
+                metrics.CKPT_SAVES.labels(kind=kind).inc()
+                self._gc()
+        metrics.CKPT_SAVE_SECONDS.labels(phase='write').observe(
+            time.perf_counter() - start)
+        logger.debug(f'Checkpoint step {step} committed under '
+                     f'{self.directory} ({kind})')
+
+    # -- retention ---------------------------------------------------------
+    def _gc(self) -> None:
+        """Post-commit retention: keep the newest ``keep_last`` steps and
+        every ``keep_every`` multiple; delete other committed steps.
+        Only process 0 (the committer) GCs."""
+        if self.keep_last is None or self.process_index != 0:
+            return
+        committed, _ = format_lib.scan_steps(self.directory)
+        steps = [info.step for info in committed]
+        keep = set(steps[-self.keep_last:])
+        if self.keep_every:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        for info in committed:
+            if info.step in keep:
+                continue
+            try:
+                format_lib.remove_step(self.directory, info.step)
+                _metrics().CKPT_GC_DELETED.inc()
+            except OSError as e:
+                logger.warning(f'Checkpoint GC could not remove step '
+                               f'{info.step}: {e}')
+
+    # -- discovery / restore ----------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        """Newest committed step (uncommitted/torn dirs are skipped and
+        counted in skytpu_ckpt_corrupt_skips_total)."""
+        committed, corrupt = format_lib.scan_steps(self.directory)
+        if corrupt:
+            _metrics().CKPT_CORRUPT_SKIPS.inc(len(corrupt))
+            logger.warning(
+                f'Skipping {len(corrupt)} uncommitted/torn checkpoint '
+                f'dir(s) under {self.directory}: {corrupt}')
+        return committed[-1].step if committed else None
+
+    def all_steps(self) -> List[int]:
+        committed, _ = format_lib.scan_steps(self.directory)
+        return [info.step for info in committed]
+
+    def restore(self, step: int, template) -> Any:
+        """Restore one step as host numpy arrays shaped like template.
+        Sharded checkpoints are hash-verified; legacy Orbax dirs fall
+        back to the Orbax reader."""
+        info = self._step_info(step)
+        if info is None:
+            raise FileNotFoundError(
+                f'No committed checkpoint for step {step} under '
+                f'{self.directory}')
+        if info.fmt == 'orbax':
+            restored = self._restore_orbax(step, template)
+        else:
+            restored = format_lib.restore_pytree(self.directory, step,
+                                                 template)
+        _metrics().CKPT_RESTORES.inc()
+        return restored
+
+    def restore_latest(self, template) -> Optional[Tuple[int, Any]]:
+        """Restore the newest trustworthy checkpoint, walking down past
+        corrupt steps (each skip is logged + counted).  Returns
+        (step, pytree) or None when nothing restorable exists."""
+        metrics = _metrics()
+        committed, corrupt = format_lib.scan_steps(self.directory)
+        if corrupt:
+            metrics.CKPT_CORRUPT_SKIPS.inc(len(corrupt))
+            logger.warning(
+                f'Skipping {len(corrupt)} uncommitted/torn checkpoint '
+                f'dir(s) under {self.directory}: {corrupt}')
+        for info in reversed(committed):
+            try:
+                restored = self.restore(info.step, template)
+            except format_lib.CorruptCheckpointError as e:
+                metrics.CKPT_CORRUPT_SKIPS.inc()
+                logger.warning(f'Checkpoint step {info.step} failed '
+                               f'integrity checks, trying older: {e}')
+                continue
+            except Exception as e:  # pylint: disable=broad-except
+                # Orbax fallback can raise anything; a broken legacy
+                # dir must not block resume from an older good one.
+                metrics.CKPT_CORRUPT_SKIPS.inc()
+                logger.warning(f'Checkpoint step {info.step} '
+                               f'unrestorable, trying older: {e}')
+                continue
+            return info.step, restored
+        return None
+
+    def _step_info(self, step: int) -> Optional[format_lib.StepInfo]:
+        committed, _ = format_lib.scan_steps(self.directory)
+        for info in committed:
+            if info.step == step:
+                return info
+        return None
+
+    def _restore_orbax(self, step: int, template) -> Any:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(format_lib.step_dir(self.directory, step),
+                             template)
+
+    # -- emergency save ----------------------------------------------------
+    def register_state_provider(
+            self, provider: Callable[[], Tuple[int, Any]]) -> None:
+        """Register the callable the emergency path snapshots:
+        ``provider() -> (step, pytree)``."""
+        self._state_provider = provider
+
+    def install_signal_handlers(
+            self, signals: Tuple[int, ...] = (signal_module.SIGTERM,)
+    ) -> bool:
+        """Install the emergency-save hook; returns False when not on
+        the main thread (signal.signal is main-thread-only)."""
+        if self._state_provider is None:
+            raise RuntimeError('register_state_provider first')
+        try:
+            for sig in signals:
+                self._prev_handlers[sig] = signal_module.signal(
+                    sig, self._handle_signal)
+        except ValueError:
+            # Not the main thread: callers on worker threads (e.g. a
+            # managed-job monitor) simply don't get the hook.
+            logger.warning('Emergency-save signal hook skipped: not on '
+                           'the main thread')
+            self._prev_handlers.clear()
+            return False
+        return True
+
+    def uninstall_signal_handlers(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal_module.signal(sig, prev)
+            except (ValueError, TypeError, OSError) as e:
+                logger.debug(f'Could not restore handler for signal '
+                             f'{sig}: {e}')
+        self._prev_handlers.clear()
+
+    def _handle_signal(self, signum, frame) -> None:
+        if not self._in_emergency_save:
+            self._in_emergency_save = True
+            try:
+                self.emergency_save()
+            finally:
+                self._in_emergency_save = False
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal_module.SIG_DFL:
+            # Preserve termination semantics: re-deliver with the
+            # default handler so SIGTERM still terminates the process.
+            signal_module.signal(signum, signal_module.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    def emergency_save(self) -> Optional[int]:
+        """One blocking save of the provider's current state (skipped if
+        that step is already committed).  Returns the step saved."""
+        if self._state_provider is None:
+            return None
+        metrics = _metrics()
+        metrics.CKPT_EMERGENCY_SAVES.inc()
+        step, pytree = self._state_provider()
+        committed = set(self.all_steps())
+        if step in committed:
+            logger.info(f'Emergency save: step {step} already '
+                        f'committed; nothing to do')
+            return step
+        logger.info(f'Emergency save of step {step} to {self.directory}')
+        # Drain queued async saves first: their snapshots are older than
+        # ours, and the writer thread shares the save lock.
+        try:
+            self.wait_until_finished()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Pending async save failed during emergency '
+                           f'drain (continuing): {e}')
+        self.save(step, pytree, blocking=True, kind='emergency')
+        return step

@@ -262,12 +262,39 @@ class JobController:
                 self.table.set_status(self.job_id,
                                       ManagedJobStatus.RECOVERING)
                 self.table.bump_recovery(self.job_id)
+                self._propagate_resume_envs(task)
                 placed = _place()
                 if isinstance(placed, ManagedJobStatus):
                     return placed
                 cluster, cluster_job_id, handle = placed
                 self.table.set_cluster(self.job_id, cluster, cluster_job_id)
                 self.table.set_status(self.job_id, ManagedJobStatus.RUNNING)
+
+    def _propagate_resume_envs(self, task) -> None:
+        """Close the resume loop: if the task declared a checkpoint root
+        (SKYTPU_CKPT_DIR in its envs) that is visible from the
+        controller host, inject SKYTPU_RESUME_CKPT_PATH/_STEP pointing
+        at the last COMMITTED step, so the relaunched run resumes there
+        instead of restarting.  Roots only visible on-cluster (mounted
+        buckets) are handled by the agent driver's per-gang fallback
+        (agent/driver.py)."""
+        from skypilot_tpu import ckpt as ckpt_lib
+        from skypilot_tpu.utils import env_contract
+        ckpt_dir = task.envs.get(env_contract.CKPT_DIR, '')
+        if not ckpt_dir:
+            return
+        try:
+            resume = ckpt_lib.resume_envs(ckpt_dir)
+        except OSError as e:
+            logger.warning(f'Managed job {self.job_id}: could not scan '
+                           f'checkpoint dir {ckpt_dir!r} for resume: {e}')
+            return
+        if resume:
+            logger.info(
+                f'Managed job {self.job_id}: relaunch will resume from '
+                f'step {resume[env_contract.RESUME_STEP]} '
+                f'({resume[env_contract.RESUME_CKPT_PATH]})')
+            task.update_envs(resume)
 
     def _poll_cluster_job(self, handle, cluster_job_id
                           ) -> Optional[JobStatus]:
@@ -291,6 +318,7 @@ class JobController:
     def _recover(self, strategy):
         self.table.set_status(self.job_id, ManagedJobStatus.RECOVERING)
         self.table.bump_recovery(self.job_id)
+        self._propagate_resume_envs(strategy.task)
         try:
             cluster_job_id, handle = strategy.recover()
         except exceptions.ResourcesUnavailableError as e:

@@ -6,9 +6,16 @@ region/zone first, then failover elsewhere), EagerFailoverStrategyExecutor
 :720 (never retry the preempted zone — jump straight to the next cheapest),
 registered in JOBS_RECOVERY_STRATEGY_REGISTRY.
 
-The user-level checkpoint contract is unchanged from the reference
-(SURVEY.md §5.4): recipes mount a GCS bucket and resume from their latest
-Orbax checkpoint after recover() brings up a fresh slice.
+Checkpoint/resume contract (docs/jobs.md, docs/reference/checkpointing.md):
+the task declares its checkpoint root as ``SKYTPU_CKPT_DIR`` in its envs
+and checkpoints through ``skypilot_tpu.ckpt`` (atomic commits, so a save
+cut off by the preemption is invisible).  Before ``recover()`` relaunches,
+the controller (jobs/controller.py ``_propagate_resume_envs``) injects
+``SKYTPU_RESUME_CKPT_PATH`` / ``SKYTPU_RESUME_STEP`` — the last COMMITTED
+step per ``ckpt.latest_step()`` — into the task's envs; when the root is
+only visible on-cluster (a mounted bucket), the agent driver fills the
+same vars in per-gang instead.  The relaunched recipe resumes via
+``Trainer.restore_latest`` (or ``env_contract.resume_target()``).
 """
 from __future__ import annotations
 

@@ -58,6 +58,56 @@ TRAIN_STEPS = prometheus_client.Counter(
     'Train steps dispatched',
     registry=REGISTRY)
 
+# ---- ckpt (ckpt/manager.py, ckpt/writer.py) ----------------------------
+
+CKPT_SAVE_SECONDS = prometheus_client.Histogram(
+    'skytpu_ckpt_save_duration_seconds',
+    'Checkpoint save wall time; phase=snapshot is the caller-thread '
+    'device->host fetch (the only stall an async save imposes on the '
+    'step loop), phase=write is the background serialize+hash+commit, '
+    'phase=blocking is an end-to-end synchronous save',
+    ['phase'],
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60, 300),
+    registry=REGISTRY)
+
+CKPT_BYTES_WRITTEN = prometheus_client.Counter(
+    'skytpu_ckpt_bytes_written_total',
+    'Checkpoint shard + manifest bytes written to storage',
+    registry=REGISTRY)
+
+CKPT_QUEUE_DEPTH = prometheus_client.Gauge(
+    'skytpu_ckpt_async_queue_depth',
+    'Async checkpoint saves in flight (snapshots taken, bytes not yet '
+    'committed); bounded by the writer double-buffer',
+    registry=REGISTRY)
+
+CKPT_SAVES = prometheus_client.Counter(
+    'skytpu_ckpt_saves_total',
+    'Committed checkpoint saves, by kind (interval/blocking/emergency)',
+    ['kind'],
+    registry=REGISTRY)
+
+CKPT_RESTORES = prometheus_client.Counter(
+    'skytpu_ckpt_restores_total',
+    'Successful checkpoint restores',
+    registry=REGISTRY)
+
+CKPT_CORRUPT_SKIPS = prometheus_client.Counter(
+    'skytpu_ckpt_corrupt_skips_total',
+    'Checkpoint step dirs skipped by discovery/restore as untrustworthy '
+    '(uncommitted, torn commit, bad hash, unreadable manifest)',
+    registry=REGISTRY)
+
+CKPT_EMERGENCY_SAVES = prometheus_client.Counter(
+    'skytpu_ckpt_emergency_saves_total',
+    'Emergency saves triggered by SIGTERM/maintenance signals',
+    registry=REGISTRY)
+
+CKPT_GC_DELETED = prometheus_client.Counter(
+    'skytpu_ckpt_gc_deleted_total',
+    'Committed checkpoints deleted by retention GC (keep_last/keep_every)',
+    registry=REGISTRY)
+
 # ---- infer (infer/engine.py, infer/serving.py) -------------------------
 
 INFER_PREFILL_SECONDS = prometheus_client.Histogram(

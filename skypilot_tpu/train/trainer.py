@@ -1,4 +1,4 @@
-"""Sharded training loop: optax + pjit + orbax checkpointing.
+"""Sharded training loop: optax + pjit + async atomic checkpointing.
 
 The analog of what the reference delegates to torchtune/deepspeed in its
 recipes (llm/llama-3_1-finetuning): here it is a first-class library.  The
@@ -98,6 +98,8 @@ class Trainer:
         self._loss_fn = loss_fn
         self._batch_sharding = NamedSharding(mesh, batch_spec)
         self._train_step = self._build_train_step()
+        self._ckpt_managers: Dict[str, Any] = {}
+        self._auto_ckpt = None  # set by enable_checkpointing
 
     def _opt_state_shardings(self, param_sharding):
         """Adam mu/nu shard like params; scalar counts replicate."""
@@ -140,6 +142,13 @@ class Trainer:
             self.params, self.opt_state, batch)
         self.step += 1
         telemetry_metrics.TRAIN_STEPS.inc()
+        if self._auto_ckpt is not None and \
+                self._auto_ckpt.should_save(self.step):
+            # Async: the loop pays only the device→host snapshot (which
+            # also waits for this step's arrays); bytes hit disk on the
+            # writer thread while later steps run.
+            self._auto_ckpt.save(self.step, self._state_dict(),
+                                 blocking=False)
         if sync:
             jax.block_until_ready(metrics)
             telemetry_metrics.TRAIN_STEP_SECONDS.labels(phase='sync').observe(
@@ -218,21 +227,91 @@ class Trainer:
                            'mfu': out.get('mfu')})
         return out
 
-    # ---- checkpointing (Orbax; local path or gs:// URI) ------------------
-    def save_checkpoint(self, path: str) -> None:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(f'{path}/step_{self.step}',
-                   {'params': self.params, 'opt_state': self.opt_state},
-                   force=True)
-        ckptr.wait_until_finished()
+    # ---- checkpointing (skypilot_tpu.ckpt sharded format; legacy Orbax
+    # step dirs remain restorable through the manager's fallback) ----------
+    def checkpoint_manager(self, path: str, **manager_kwargs):
+        """The (cached) CheckpointManager for one checkpoint root."""
+        from skypilot_tpu import ckpt as ckpt_lib
+        manager = self._ckpt_managers.get(path)
+        if manager is None:
+            manager = ckpt_lib.CheckpointManager(path, **manager_kwargs)
+            self._ckpt_managers[path] = manager
+        return manager
+
+    def enable_checkpointing(self, path: str,
+                             save_interval_steps: int = 0,
+                             keep_last: Optional[int] = None,
+                             keep_every: Optional[int] = None,
+                             emergency_save: bool = True):
+        """Attach auto-checkpointing to the step loop: every
+        ``save_interval_steps`` steps run_step kicks off an ASYNC save
+        (the loop stalls only for the device→host snapshot), retention
+        GC applies keep_last/keep_every after each commit, and — with
+        emergency_save — SIGTERM triggers one blocking save before the
+        process dies (spot preemption notice, `skytpu cancel`).
+        Returns the manager."""
+        manager = self.checkpoint_manager(
+            path, save_interval_steps=save_interval_steps,
+            keep_last=keep_last, keep_every=keep_every)
+        manager.save_interval_steps = save_interval_steps
+        manager.keep_last = keep_last
+        manager.keep_every = keep_every
+        manager.register_state_provider(
+            lambda: (self.step, self._state_dict()))
+        if emergency_save:
+            manager.install_signal_handlers()
+        self._auto_ckpt = manager
+        return manager
+
+    def _state_dict(self):
+        return {'params': self.params, 'opt_state': self.opt_state}
+
+    def save_checkpoint(self, path: str, blocking: bool = True) -> None:
+        """Checkpoint params + optimizer state at the current step.
+
+        blocking=False returns after the device→host snapshot and lets
+        the background writer commit the bytes — call
+        ``wait_for_checkpoints`` (or rely on the atomic commit: an
+        unfinished save is simply invisible to restore)."""
+        self.checkpoint_manager(path).save(self.step, self._state_dict(),
+                                           blocking=blocking)
+
+    def wait_for_checkpoints(self, path: Optional[str] = None) -> None:
+        """Drain in-flight async saves (all roots, or one)."""
+        managers = ([self._ckpt_managers[path]] if path is not None
+                    else list(self._ckpt_managers.values()))
+        for manager in managers:
+            manager.wait_until_finished()
+
+    def _install_restored(self, step: int, restored) -> None:
+        # Host arrays from the sharded format go back to device with the
+        # live tree's shardings; Orbax-fallback restores already return
+        # device arrays (restore was template-driven) and device_put is
+        # then a no-op placement-wise.
+        def _put(template_leaf, value):
+            return jax.device_put(value, template_leaf.sharding)
+
+        self.params = jax.tree.map(_put, self.params, restored['params'])
+        self.opt_state = jax.tree.map(_put, self.opt_state,
+                                      restored['opt_state'])
+        self.step = step
 
     def restore_checkpoint(self, path: str, step: int) -> None:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.StandardCheckpointer()
-        restored = ckptr.restore(
-            f'{path}/step_{step}',
-            {'params': self.params, 'opt_state': self.opt_state})
-        self.params = restored['params']
-        self.opt_state = restored['opt_state']
-        self.step = step
+        """Restore an explicit step (sharded format, hash-verified; or a
+        legacy Orbax dir)."""
+        restored = self.checkpoint_manager(path).restore(
+            step, self._state_dict())
+        self._install_restored(step, restored)
+
+    def restore_latest(self, path: str) -> Optional[int]:
+        """Restore the newest COMMITTED checkpoint under ``path``,
+        skipping uncommitted/corrupt steps.  Returns the restored step,
+        or None when no trustworthy checkpoint exists (state is left
+        untouched)."""
+        result = self.checkpoint_manager(path).restore_latest(
+            self._state_dict())
+        if result is None:
+            return None
+        step, restored = result
+        self._install_restored(step, restored)
+        return step

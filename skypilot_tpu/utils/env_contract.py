@@ -21,7 +21,7 @@ which recipes derive MASTER_ADDR etc.) with a JAX/TPU-native contract:
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 # Kept for recipe compatibility with the reference (sky/skylet/constants.py:363-366).
 NODE_IPS = 'SKYPILOT_NODE_IPS'
@@ -41,6 +41,20 @@ COORDINATOR_PORT_DEFAULT = 8476
 MEGASCALE_COORDINATOR = 'MEGASCALE_COORDINATOR_ADDRESS'
 MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
 MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+
+# Checkpoint/resume contract (docs/jobs.md, docs/reference/checkpointing.md).
+# CKPT_DIR is USER-declared in the task's envs: the checkpoint root the
+# recipe writes to (skypilot_tpu.ckpt.CheckpointManager).  The other two
+# are SYSTEM-set on relaunch: the managed-jobs controller sets them in
+# _recover() when the root is visible from the controller host, and the
+# agent driver fills them in per-gang when the root is only visible
+# on-cluster (mounted bucket).  RESUME_STEP is always the last
+# *committed* step per ckpt.latest_step(); recipes read them via
+# resume_target() (or just call Trainer.restore_latest, which trusts
+# the on-disk commit markers directly).
+CKPT_DIR = 'SKYTPU_CKPT_DIR'
+RESUME_CKPT_PATH = 'SKYTPU_RESUME_CKPT_PATH'
+RESUME_STEP = 'SKYTPU_RESUME_STEP'
 
 
 def make_env_vars(node_rank: int,
@@ -79,6 +93,19 @@ def make_env_vars(node_rank: int,
         envs[MEGASCALE_NUM_SLICES] = str(num_slices)
         envs[MEGASCALE_SLICE_ID] = str(slice_id)
     return envs
+
+
+def resume_target() -> Optional[Tuple[str, int]]:
+    """The (checkpoint_dir, step) a relaunched task should resume from,
+    per the injected resume contract; None when not a resumed run."""
+    path = os.environ.get(RESUME_CKPT_PATH, '')
+    step = os.environ.get(RESUME_STEP, '')
+    if not path or not step:
+        return None
+    try:
+        return path, int(step)
+    except ValueError:
+        return None
 
 
 def reassert_jax_platforms() -> None:

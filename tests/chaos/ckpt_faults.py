@@ -1,0 +1,99 @@
+"""Fault injection for the checkpoint save protocol.
+
+Same philosophy as chaos_proxy.py (inject faults without touching
+subsystem code): ckpt/format.py exposes a stage hook that fires at each
+named point of the save protocol — these helpers install hooks that
+crash, or block, a save at an exact stage, plus on-disk corruption
+helpers (bit flips, garbage manifests) for the integrity checks.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterator, Optional
+
+from skypilot_tpu.ckpt import format as ckpt_format
+
+# Stages of one save, in protocol order (see ckpt/format.py):
+# everything before 'committed' happens pre-rename, so a crash there
+# must leave the checkpoint invisible.
+PRE_COMMIT_STAGES = ('shard_written', 'process_manifest', 'pre_commit')
+
+
+class SimulatedCrash(Exception):
+    """Raised by a crash hook to model the writer dying mid-save."""
+
+
+class CrashAtStage:
+    """Hook that raises SimulatedCrash the ``nth`` time ``stage`` fires."""
+
+    def __init__(self, stage: str, nth: int = 1):
+        self.stage = stage
+        self.nth = nth
+        self.fires = 0
+
+    def __call__(self, stage: str, path: str) -> None:
+        if stage != self.stage:
+            return
+        self.fires += 1
+        if self.fires == self.nth:
+            raise SimulatedCrash(f'killed at {stage}: {path}')
+
+
+class BlockAtStage:
+    """Hook that blocks (once) at ``stage`` until released — holds an
+    async save in flight so tests can observe the caller overlapping it."""
+
+    def __init__(self, stage: str, timeout: float = 30.0):
+        self.stage = stage
+        self.timeout = timeout
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self._fired = False
+
+    def __call__(self, stage: str, path: str) -> None:
+        if stage != self.stage or self._fired:
+            return
+        self._fired = True
+        self.entered.set()
+        if not self.release.wait(self.timeout):
+            raise TimeoutError(f'BlockAtStage never released at {stage}')
+
+
+@contextlib.contextmanager
+def stage_hook(hook) -> Iterator:
+    """Install a save-protocol hook for the duration of the block."""
+    prev = ckpt_format.set_stage_hook(hook)
+    try:
+        yield hook
+    finally:
+        ckpt_format.set_stage_hook(prev)
+
+
+def flip_bit(path: str, offset: int = -1) -> None:
+    """Flip one bit of a file (default: in its last byte) — models bit
+    rot / a torn write that the manifest SHA-256 must catch."""
+    with open(path, 'r+b') as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        pos = size + offset if offset < 0 else offset
+        f.seek(pos)
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ 0x01]))
+
+
+def first_shard(step_path: str) -> Optional[str]:
+    """Path of the first array shard inside a committed step dir."""
+    for name in sorted(os.listdir(step_path)):
+        if name.startswith('arr_') and name.endswith('.npy'):
+            return os.path.join(step_path, name)
+    return None
+
+
+def corrupt_manifest(step_path: str) -> None:
+    """Overwrite a committed step's manifest with garbage JSON."""
+    with open(os.path.join(step_path, ckpt_format.MANIFEST), 'w',
+              encoding='utf-8') as f:
+        f.write('{not json')

@@ -1,0 +1,429 @@
+"""Checkpoint subsystem (skypilot_tpu/ckpt/): format atomicity under
+injected crashes, hash-verified restore, the async writer, retention,
+multihost merge, emergency saves, and the managed-jobs resume contract.
+"""
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+from skypilot_tpu import ckpt as ckpt_lib
+from skypilot_tpu.ckpt import format as ckpt_format
+from skypilot_tpu.metrics import REGISTRY
+from skypilot_tpu.utils import env_contract
+from tests.chaos import ckpt_faults
+
+
+def _counter(name, **labels):
+    return REGISTRY.get_sample_value(name, labels or {}) or 0.0
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        'params': {'w': rng.normal(size=(4, 8)).astype(np.float32),
+                   'b': np.arange(8, dtype=np.float32) + seed},
+        'opt_state': {'mu': rng.normal(size=(4, 8)).astype(np.float32),
+                      'count': np.asarray(seed, dtype=np.int32)},
+    }
+
+
+def _assert_tree_equal(a, b):
+    import jax
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+def _manager(root, **kwargs):
+    kwargs.setdefault('process_index', 0)
+    kwargs.setdefault('process_count', 1)
+    return ckpt_lib.CheckpointManager(str(root), **kwargs)
+
+
+# -- format: roundtrip + atomicity ----------------------------------------
+
+
+def test_format_roundtrip(tmp_path):
+    tree = _tree(1)
+    committed = ckpt_format.save_pytree(str(tmp_path), 3, tree)
+    assert committed == str(tmp_path / 'step_3')
+    assert os.path.exists(os.path.join(committed, ckpt_format.MARKER))
+    restored = ckpt_format.restore_pytree(str(tmp_path), 3, _tree(0))
+    _assert_tree_equal(tree, restored)
+    assert ckpt_format.latest_step(str(tmp_path)) == 3
+
+
+def test_format_roundtrip_bfloat16(tmp_path):
+    """Extension dtypes survive the shard roundtrip: np.save degrades
+    bfloat16 to raw void bytes, so restore must re-view from the
+    manifest's dtype (real models checkpoint bf16 params)."""
+    import jax.numpy as jnp
+    tree = {'w': jnp.arange(8, dtype=jnp.bfloat16).reshape(2, 4),
+            'scale': jnp.asarray(0.5, dtype=jnp.bfloat16)}
+    ckpt_format.save_pytree(str(tmp_path), 1, tree)
+    restored = ckpt_format.restore_pytree(str(tmp_path), 1, tree)
+    assert restored['w'].dtype == jnp.bfloat16
+    assert restored['scale'].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(tree['w'], np.float32),
+        np.asarray(restored['w'], np.float32))
+
+
+@pytest.mark.parametrize('stage', ckpt_faults.PRE_COMMIT_STAGES)
+def test_crash_before_commit_is_invisible(tmp_path, stage):
+    """A save killed at ANY pre-rename point must leave latest_step on
+    the previous committed checkpoint — and the retried save succeeds."""
+    ckpt_format.save_pytree(str(tmp_path), 1, _tree(1))
+    with ckpt_faults.stage_hook(ckpt_faults.CrashAtStage(stage)):
+        with pytest.raises(ckpt_faults.SimulatedCrash):
+            ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
+    committed, corrupt = ckpt_format.scan_steps(str(tmp_path))
+    assert [info.step for info in committed] == [1]
+    assert corrupt == []          # tmp dirs are ignored, not "corrupt"
+    assert ckpt_format.latest_step(str(tmp_path)) == 1
+    # The crashed save left only staging litter; a retry commits fine.
+    ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
+    assert ckpt_format.latest_step(str(tmp_path)) == 2
+    assert not os.path.exists(ckpt_format.tmp_dir(str(tmp_path), 2))
+
+
+def test_crash_after_rename_is_durable(tmp_path):
+    """The rename is the commit point: dying right after it still
+    yields a fully trusted checkpoint."""
+    hook = ckpt_faults.CrashAtStage('committed')
+    with ckpt_faults.stage_hook(hook):
+        with pytest.raises(ckpt_faults.SimulatedCrash):
+            ckpt_format.save_pytree(str(tmp_path), 7, _tree(7))
+    assert ckpt_format.latest_step(str(tmp_path)) == 7
+    _assert_tree_equal(_tree(7),
+                       ckpt_format.restore_pytree(str(tmp_path), 7,
+                                                  _tree(0)))
+
+
+def test_torn_commit_skipped_and_counted(tmp_path):
+    """A step dir with a manifest but no marker (or vice versa) is a
+    torn commit: never trusted, counted in corrupt_skips."""
+    ckpt_format.save_pytree(str(tmp_path), 1, _tree(1))
+    ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
+    os.remove(str(tmp_path / 'step_2' / ckpt_format.MARKER))
+    before = _counter('skytpu_ckpt_corrupt_skips_total')
+    manager = _manager(tmp_path)
+    assert manager.latest_step() == 1
+    assert _counter('skytpu_ckpt_corrupt_skips_total') == before + 1
+
+
+def test_bit_flip_detected_by_hash(tmp_path):
+    """A flipped bit in a shard fails SHA-256 verification; restore
+    walks down to the previous committed step and counts the skip."""
+    ckpt_format.save_pytree(str(tmp_path), 1, _tree(1))
+    ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
+    shard = ckpt_faults.first_shard(str(tmp_path / 'step_2'))
+    ckpt_faults.flip_bit(shard)
+    with pytest.raises(ckpt_format.CorruptCheckpointError):
+        ckpt_format.restore_pytree(str(tmp_path), 2, _tree(0))
+    before = _counter('skytpu_ckpt_corrupt_skips_total')
+    manager = _manager(tmp_path)
+    step, restored = manager.restore_latest(_tree(0))
+    assert step == 1
+    _assert_tree_equal(_tree(1), restored)
+    assert _counter('skytpu_ckpt_corrupt_skips_total') == before + 1
+
+
+def test_corrupt_manifest_skipped(tmp_path):
+    ckpt_format.save_pytree(str(tmp_path), 1, _tree(1))
+    ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
+    ckpt_faults.corrupt_manifest(str(tmp_path / 'step_2'))
+    step, restored = _manager(tmp_path).restore_latest(_tree(0))
+    assert step == 1
+    _assert_tree_equal(_tree(1), restored)
+
+
+# -- manager: async pipeline ----------------------------------------------
+
+
+def test_async_save_overlaps_caller(tmp_path):
+    """save(blocking=False) returns after the snapshot; the write +
+    commit happens on the background writer while the caller keeps
+    going, and wait_until_finished drains to a committed checkpoint."""
+    manager = _manager(tmp_path)
+    block = ckpt_faults.BlockAtStage('shard_written')
+    with ckpt_faults.stage_hook(block):
+        manager.save(1, _tree(1), blocking=False)
+        # The writer is now blocked mid-save; the caller already has
+        # control back and the save is visible as in-flight.
+        assert block.entered.wait(10)
+        assert manager._writer.in_flight == 1
+        assert ckpt_format.latest_step(str(tmp_path)) is None
+        assert _counter('skytpu_ckpt_async_queue_depth') >= 1
+        block.release.set()
+        manager.wait_until_finished()
+    assert manager._writer.in_flight == 0
+    assert ckpt_format.latest_step(str(tmp_path)) == 1
+    assert _counter('skytpu_ckpt_async_queue_depth') == 0
+    manager.close()
+
+
+def test_async_writer_killed_mid_save(tmp_path):
+    """Chaos: the background writer dies mid-save.  The error surfaces
+    from wait_until_finished, and restore lands on the last COMMITTED
+    step — the half-written save is invisible."""
+    manager = _manager(tmp_path)
+    manager.save(1, _tree(1), blocking=True)
+    with ckpt_faults.stage_hook(ckpt_faults.CrashAtStage('shard_written')):
+        manager.save(2, _tree(2), blocking=False)
+        with pytest.raises(ckpt_faults.SimulatedCrash):
+            manager.wait_until_finished()
+    step, restored = manager.restore_latest(_tree(0))
+    assert step == 1
+    _assert_tree_equal(_tree(1), restored)
+    manager.close()
+
+
+def test_async_save_error_does_not_poison_writer(tmp_path):
+    """After a failed async save the writer keeps accepting jobs."""
+    manager = _manager(tmp_path)
+    with ckpt_faults.stage_hook(ckpt_faults.CrashAtStage('pre_commit')):
+        manager.save(1, _tree(1), blocking=False)
+        with pytest.raises(ckpt_faults.SimulatedCrash):
+            manager.wait_until_finished()
+    manager.save(2, _tree(2), blocking=False)
+    manager.wait_until_finished()
+    assert manager.latest_step() == 2
+    manager.close()
+
+
+def test_should_save_interval_gate(tmp_path):
+    manager = _manager(tmp_path, save_interval_steps=5)
+    assert [s for s in range(1, 16) if manager.should_save(s)] == [5, 10, 15]
+    manager.save(5, _tree(5), blocking=True)
+    assert not manager.should_save(5)      # dedupe after saving
+    assert _manager(tmp_path).should_save(0) is False
+    manager.close()
+
+
+def test_train_loop_advances_during_inflight_save(tmp_path):
+    """Trainer-level overlap: with auto-checkpointing on, run_step keeps
+    stepping while a save is held in flight on the writer thread; the
+    drain then commits every interval step."""
+    import jax
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import MeshConfig, make_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import Trainer, synthetic_batches
+
+    cfg = llama.LLAMA_DEBUG
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    trainer = Trainer(lambda p, b: llama.loss_fn(p, b, cfg), params,
+                      make_mesh(MeshConfig(dp=jax.device_count())),
+                      sharding_lib.LLAMA_RULES)
+    manager = trainer.enable_checkpointing(
+        str(tmp_path), save_interval_steps=1, emergency_save=False)
+    batch = next(synthetic_batches(jax.device_count(), 16, cfg.vocab_size))
+    block = ckpt_faults.BlockAtStage('shard_written')
+    try:
+        with ckpt_faults.stage_hook(block):
+            trainer.run_step(batch)            # kicks off async save of 1
+            assert block.entered.wait(10)
+            trainer.run_step(batch)            # loop advances regardless
+            assert trainer.step == 2
+            assert ckpt_format.latest_step(str(tmp_path)) is None
+            block.release.set()
+            trainer.wait_for_checkpoints()
+        assert manager.all_steps() == [1, 2]
+    finally:
+        manager.close()
+
+
+# -- retention ------------------------------------------------------------
+
+
+def test_retention_gc(tmp_path):
+    before = _counter('skytpu_ckpt_gc_deleted_total')
+    manager = _manager(tmp_path, keep_last=2, keep_every=10)
+    for step in (5, 10, 15, 20, 25):
+        manager.save(step, _tree(step), blocking=True)
+    # newest 2 (20, 25) + keep_every multiples (10, 20) survive.
+    assert manager.all_steps() == [10, 20, 25]
+    assert _counter('skytpu_ckpt_gc_deleted_total') == before + 2
+    manager.close()
+
+
+def test_gc_only_on_process_zero(tmp_path):
+    ckpt_format.save_pytree(str(tmp_path), 1, _tree(1))
+    ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
+    manager = _manager(tmp_path, keep_last=1, process_index=1,
+                       process_count=2)
+    manager._gc()
+    assert manager.all_steps() == [1, 2]   # non-committer never deletes
+    manager.close()
+
+
+# -- multihost ------------------------------------------------------------
+
+
+def test_multihost_merge(tmp_path):
+    """Two simulated processes: each writes its round-robin leaves; the
+    barrier runs process 1's writes before process 0 commits the merged
+    manifest.  Restore sees every leaf."""
+    tree = _tree(3)
+
+    def _barrier():
+        ckpt_format.write_process_shards(str(tmp_path), 1, tree,
+                                         process_index=1, process_count=2)
+
+    manager = _manager(tmp_path, process_index=0, process_count=2,
+                       barrier=_barrier)
+    manager.save(1, tree, blocking=True)
+    manifest = ckpt_format.load_manifest(str(tmp_path), 1)
+    assert manifest['process_count'] == 2
+    owners = {e['index'] % 2 for e in manifest['entries']}
+    assert owners == {0, 1}                # both processes contributed
+    _assert_tree_equal(tree,
+                       ckpt_format.restore_pytree(str(tmp_path), 1,
+                                                  _tree(0)))
+    manager.close()
+
+
+def test_multihost_commit_refuses_missing_process(tmp_path):
+    """A violated barrier (process 1 never wrote) must fail the commit,
+    not commit a half checkpoint."""
+    ckpt_format.write_process_shards(str(tmp_path), 1, _tree(1),
+                                     process_index=0, process_count=2)
+    with pytest.raises(ckpt_format.CorruptCheckpointError):
+        ckpt_format.commit(str(tmp_path), 1, process_count=2)
+    assert ckpt_format.latest_step(str(tmp_path)) is None
+
+
+def test_nonzero_process_does_not_commit(tmp_path):
+    assert ckpt_format.save_pytree(str(tmp_path), 1, _tree(1),
+                                   process_index=1,
+                                   process_count=2) is None
+    assert ckpt_format.latest_step(str(tmp_path)) is None
+
+
+# -- emergency save -------------------------------------------------------
+
+
+def test_emergency_save_on_sigterm(tmp_path):
+    """SIGTERM triggers one blocking save of the provider's state, then
+    chains to the previous handler."""
+    chained = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    manager = _manager(tmp_path)
+    try:
+        state = {'step': 7}
+        manager.register_state_provider(
+            lambda: (state['step'], _tree(state['step'])))
+        assert manager.install_signal_handlers() is True
+        before = _counter('skytpu_ckpt_emergency_saves_total')
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert manager.latest_step() == 7
+        assert chained == [signal.SIGTERM]
+        assert _counter('skytpu_ckpt_emergency_saves_total') == before + 1
+        assert _counter('skytpu_ckpt_saves_total',
+                        kind='emergency') >= 1
+        # Step already committed: a second signal is a no-op save.
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert manager.all_steps() == [7]
+    finally:
+        manager.close()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_install_signal_handlers_off_main_thread(tmp_path):
+    manager = _manager(tmp_path)
+    manager.register_state_provider(lambda: (1, _tree(1)))
+    results = []
+    thread = threading.Thread(
+        target=lambda: results.append(manager.install_signal_handlers()))
+    thread.start()
+    thread.join()
+    assert results == [False]
+    manager.close()
+
+
+# -- legacy Orbax fallback ------------------------------------------------
+
+
+def test_orbax_fallback_restore(tmp_path):
+    """A pre-existing Orbax step dir (no manifest/marker) is discovered
+    as committed and restored through the Orbax reader."""
+    ocp = pytest.importorskip('orbax.checkpoint')
+    tree = _tree(4)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(str(tmp_path / 'step_5'), tree)
+    ckptr.wait_until_finished()
+    manager = _manager(tmp_path)
+    assert manager.latest_step() == 5
+    step, restored = manager.restore_latest(_tree(0))
+    assert step == 5
+    _assert_tree_equal(tree, restored)
+    manager.close()
+
+
+# -- resume contract ------------------------------------------------------
+
+
+def test_resume_envs(tmp_path):
+    assert ckpt_lib.resume_envs('') == {}
+    assert ckpt_lib.resume_envs('gs://bucket/ckpts') == {}
+    assert ckpt_lib.resume_envs(str(tmp_path)) == {}   # nothing committed
+    ckpt_format.save_pytree(str(tmp_path), 1, _tree(1))
+    ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
+    # A torn step 3 must not become the resume target.
+    ckpt_format.save_pytree(str(tmp_path), 3, _tree(3))
+    os.remove(str(tmp_path / 'step_3' / ckpt_format.MARKER))
+    assert ckpt_lib.resume_envs(str(tmp_path)) == {
+        env_contract.RESUME_CKPT_PATH: str(tmp_path),
+        env_contract.RESUME_STEP: '2',
+    }
+
+
+def test_resume_target_parses_env(monkeypatch, tmp_path):
+    monkeypatch.delenv(env_contract.RESUME_CKPT_PATH, raising=False)
+    monkeypatch.delenv(env_contract.RESUME_STEP, raising=False)
+    assert env_contract.resume_target() is None
+    monkeypatch.setenv(env_contract.RESUME_CKPT_PATH, str(tmp_path))
+    monkeypatch.setenv(env_contract.RESUME_STEP, '42')
+    assert env_contract.resume_target() == (str(tmp_path), 42)
+    monkeypatch.setenv(env_contract.RESUME_STEP, 'nan')
+    assert env_contract.resume_target() is None
+
+
+def test_controller_propagates_resume_envs(tmp_path):
+    """The managed-jobs controller injects the resume vars into the task
+    it is about to relaunch, pointing at the last COMMITTED step."""
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import controller as controller_lib
+    ckpt_format.save_pytree(str(tmp_path), 4, _tree(4))
+    ckpt_format.save_pytree(str(tmp_path), 9, _tree(9))
+    # Uncommitted newer save: must not be the resume target.
+    ckpt_format.write_process_shards(str(tmp_path), 12, _tree(12))
+    task = task_lib.Task(run='python train.py',
+                         envs={env_contract.CKPT_DIR: str(tmp_path)})
+    stub = type('Stub', (), {'job_id': 1})()
+    controller_lib.JobController._propagate_resume_envs(stub, task)
+    assert task.envs[env_contract.RESUME_CKPT_PATH] == str(tmp_path)
+    assert task.envs[env_contract.RESUME_STEP] == '9'
+    # No checkpoint root declared: nothing injected.
+    bare = task_lib.Task(run='python train.py')
+    controller_lib.JobController._propagate_resume_envs(stub, bare)
+    assert env_contract.RESUME_STEP not in bare.envs
+
+
+def test_driver_resume_env_fallback(tmp_path):
+    """The gang driver fills the same vars when the controller could not
+    see the checkpoint root — and defers when they are already set."""
+    from skypilot_tpu.agent import driver as driver_lib
+    ckpt_format.save_pytree(str(tmp_path), 6, _tree(6))
+    envs = {env_contract.CKPT_DIR: str(tmp_path)}
+    assert driver_lib._resume_env_fallback(envs) == {
+        env_contract.RESUME_CKPT_PATH: str(tmp_path),
+        env_contract.RESUME_STEP: '6',
+    }
+    # Controller already injected: the driver defers to it.
+    assert driver_lib._resume_env_fallback(
+        {env_contract.CKPT_DIR: str(tmp_path),
+         env_contract.RESUME_STEP: '3'}) == {}
+    assert driver_lib._resume_env_fallback({}) == {}

@@ -88,6 +88,15 @@ def test_trainer_checkpoint_roundtrip(tmp_path):
     trainer.restore_checkpoint(str(tmp_path / 'ckpt'), step=1)
     after = jax.tree.map(np.asarray, trainer.params)
     jax.tree.map(np.testing.assert_allclose, before, after)
+    # restore_latest: saves at steps 1 and 2 exist after another save;
+    # the newest committed one wins and run_step continues from it.
+    trainer.run_step(batch)
+    trainer.save_checkpoint(str(tmp_path / 'ckpt'))
+    trainer.run_step(batch)
+    restored = trainer.restore_latest(str(tmp_path / 'ckpt'))
+    assert restored == 2
+    assert trainer.step == 2
+    assert trainer.restore_latest(str(tmp_path / 'empty')) is None
 
 
 def test_trainer_mu_dtype_bf16():
