@@ -19,10 +19,16 @@ half-written checkpoint.  A ``step_<N>`` dir carrying a manifest but no
 marker (or vice versa) is treated as corrupt and skipped.
 
 Multihost: every process writes the leaves it owns (round-robin by leaf
-index) plus its own ``manifest-p<K>.json``; after the job-level barrier,
-process 0 merges the per-process manifests, writes the marker, and
-performs the commit rename.  Per-leaf SHA-256 content hashes in the
-manifest let restore detect bit rot / torn writes on any host.
+index) plus its own ``manifest-p<K>.json``.  ``save_pytree`` runs a
+two-barrier protocol — process 0 removes stale staging dirs, barrier
+(nobody writes into a dir that is about to be cleaned), every process
+writes its shards, barrier, process 0 merges the per-process manifests,
+writes the marker, and performs the commit rename.  The barrier is a
+``Callable[[str], None]`` taking a per-phase tag (the manager defaults
+it to ``jax.experimental.multihost_utils.sync_global_devices``);
+multihost callers MUST supply one or peer shards can be lost mid-write.
+Per-leaf SHA-256 content hashes in the manifest let restore detect bit
+rot / torn writes on any host.
 
 Legacy checkpoints: a ``step_<N>`` dir with neither manifest nor marker
 is an old Orbax checkpoint (Orbax's own tmp-dir naming guarantees a
@@ -129,12 +135,10 @@ def write_process_shards(root: str, step: int, pytree,
     dir.  Leaves are assigned round-robin by flatten index, so a
     multihost save spreads disk/GCS-fuse bandwidth across hosts.
     Returns the per-process manifest dict (entries + bytes written)."""
+    # No rmtree here: peer processes may already be writing into the
+    # shared staging dir.  Stale leftovers are removed by process 0 in
+    # save_pytree, before the pre-write barrier releases any writer.
     staging = tmp_dir(root, step)
-    if process_index == 0:
-        # Process 0 owns staging lifecycle: clear a stale temp dir left
-        # by a crashed earlier save of this same step.
-        if os.path.isdir(staging):
-            shutil.rmtree(staging)
     os.makedirs(staging, exist_ok=True)
     named_leaves, _ = flatten_with_keys(pytree)
     entries = []
@@ -226,15 +230,33 @@ def commit(root: str, step: int, process_count: int = 1,
 def save_pytree(root: str, step: int, pytree,
                 process_index: int = 0, process_count: int = 1,
                 metadata: Optional[Dict[str, Any]] = None,
-                barrier: Optional[Callable[[], None]] = None
+                barrier: Optional[Callable[[str], None]] = None
                 ) -> Optional[str]:
     """Full save flow for one process.  Non-zero processes return after
     writing their shards (None); process 0 commits and returns the
-    committed dir."""
+    committed dir.
+
+    ``barrier(tag)`` is the job-level rendezvous; with ``process_count
+    > 1`` it is REQUIRED (the manager defaults it) — without it process
+    0 could clean staging dirs peers are writing, or commit before peer
+    shards land.  Protocol: p0 cleans stale staging, barrier('clean'),
+    everyone writes, barrier('write'), p0 commits."""
+    if process_count > 1 and barrier is None:
+        raise ValueError(
+            f'multihost save of step {step} (process_count='
+            f'{process_count}) requires a barrier: without one, commit '
+            f'and staging cleanup race the peer shard writes')
     os.makedirs(root, exist_ok=True)
+    if process_index == 0:
+        # Only the committer cleans, and only before the barrier below
+        # releases any process into writing — so a staging dir is never
+        # deleted while a peer writes into it.
+        clean_stale_tmp(root)
+    if barrier is not None:
+        barrier(f'skytpu_ckpt_clean_step{step}')
     write_process_shards(root, step, pytree, process_index, process_count)
     if barrier is not None:
-        barrier()
+        barrier(f'skytpu_ckpt_write_step{step}')
     if process_index != 0:
         return None
     return commit(root, step, process_count, metadata)
@@ -358,7 +380,8 @@ def remove_step(root: str, step: int) -> None:
 
 def clean_stale_tmp(root: str) -> List[str]:
     """Remove leftover staging dirs from crashed saves.  Only safe when
-    no save is in flight (the manager calls it before a new save)."""
+    no save is in flight: ``save_pytree`` calls it on process 0 before
+    the pre-write barrier releases any process into writing."""
     removed = []
     if not os.path.isdir(root):
         return removed

@@ -23,7 +23,11 @@ The manager owns one checkpoint root and composes the pieces:
 
 Multihost: pass ``process_index``/``process_count`` (default: the JAX
 process grid when initialized) and every process writes its own shard
-files; process 0 runs the barrier, commits the manifest, and GCs.
+files; process 0 commits the manifest and GCs.  When ``process_count >
+1`` and no ``barrier`` is supplied, the manager wires
+``jax.experimental.multihost_utils.sync_global_devices`` as the
+cross-process rendezvous — saves are never allowed to run barrier-less
+on multihost (see ckpt/format.py for the clean/write/commit protocol).
 
 Orbax fallback: ``restore`` reads legacy ``step_<N>`` Orbax dirs (no
 manifest/marker) so pre-existing checkpoints stay restorable.
@@ -59,6 +63,13 @@ def _default_process_grid() -> Tuple[int, int]:
         return 0, 1
 
 
+def _multihost_barrier(tag: str) -> None:
+    """Default multihost rendezvous: every process blocks until all
+    processes reach the same tagged point."""
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
 def _snapshot(pytree):
     """Device→host copy of every leaf (numpy), on the caller thread.
 
@@ -82,7 +93,8 @@ class CheckpointManager:
                  max_pending: int = 2,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 barrier: Optional[Callable[[], None]] = None):
+                 barrier: Optional[Callable[[str], None]] = None,
+                 max_consecutive_failures: int = 3):
         if keep_last is not None and keep_last < 1:
             raise ValueError(f'keep_last must be >= 1, got {keep_last}')
         self.directory = directory
@@ -94,15 +106,23 @@ class CheckpointManager:
                               else process_index)
         self.process_count = (default_count if process_count is None
                               else process_count)
+        if barrier is None and self.process_count > 1:
+            # Multihost saves must rendezvous or process 0's staging
+            # cleanup / commit races the peer shard writes.
+            barrier = _multihost_barrier
         self._barrier = barrier
+        self.max_consecutive_failures = max_consecutive_failures
         self._writer = AsyncCheckpointWriter(
             max_pending=max_pending,
             depth_callback=self._set_queue_depth)
         self._save_lock = threading.Lock()
+        self._save_lock_owner: Optional[threading.Thread] = None
         self._state_provider: Optional[Callable[[], Tuple[int, Any]]] = None
         self._prev_handlers: Dict[int, Any] = {}
         self._in_emergency_save = False
         self._last_saved_step: Optional[int] = None
+        self._consecutive_failures = 0
+        self._last_write_error: Optional[BaseException] = None
 
     @staticmethod
     def _set_queue_depth(depth: int) -> None:
@@ -126,7 +146,21 @@ class CheckpointManager:
         blocking=False: snapshot here, write/commit on the background
         writer (the step loop keeps running).  blocking=True: the full
         pipeline runs on the caller thread.  Either way the on-disk
-        commit is atomic (see ckpt/format.py)."""
+        commit is atomic (see ckpt/format.py).
+
+        Blocking saves surface write errors directly.  Async save
+        errors are re-raised from ``wait_until_finished()``; to keep a
+        persistently failing writer (disk full, dead bucket mount) from
+        silently eating every checkpoint of a long run, ``save`` itself
+        fails once ``max_consecutive_failures`` async saves in a row
+        have failed."""
+        if (not blocking and self._consecutive_failures
+                >= self.max_consecutive_failures):
+            raise RuntimeError(
+                f'{self._consecutive_failures} consecutive checkpoint '
+                f'saves under {self.directory} failed; refusing to '
+                f'queue more (last error: {self._last_write_error!r})'
+            ) from self._last_write_error
         metrics = _metrics()
         kind = kind or ('blocking' if blocking else 'interval')
         start = time.perf_counter()
@@ -156,22 +190,44 @@ class CheckpointManager:
     def _write_and_commit(self, step: int, host_tree,
                           metadata: Optional[Dict[str, Any]],
                           kind: str) -> None:
+        try:
+            self._do_write_and_commit(step, host_tree, metadata, kind)
+        except BaseException as e:
+            self._consecutive_failures += 1
+            self._last_write_error = e
+            if self._last_saved_step == step:
+                # The step was NOT durably saved; let a retry through
+                # should_save and keep latest-save bookkeeping honest.
+                self._last_saved_step = None
+            raise
+        self._consecutive_failures = 0
+
+    def _do_write_and_commit(self, step: int, host_tree,
+                             metadata: Optional[Dict[str, Any]],
+                             kind: str) -> None:
         metrics = _metrics()
         start = time.perf_counter()
         with self._save_lock:
-            format_lib.clean_stale_tmp(self.directory)
-            committed = format_lib.save_pytree(
-                self.directory, step, host_tree,
-                process_index=self.process_index,
-                process_count=self.process_count,
-                metadata=dict(metadata or {}, kind=kind,
-                              time=time.time()),
-                barrier=self._barrier)
-            if committed is not None:
-                manifest = format_lib.load_manifest(self.directory, step)
-                metrics.CKPT_BYTES_WRITTEN.inc(manifest.get('bytes', 0))
-                metrics.CKPT_SAVES.labels(kind=kind).inc()
-                self._gc()
+            self._save_lock_owner = threading.current_thread()
+            try:
+                # Stale-staging cleanup happens inside save_pytree, on
+                # process 0 only, before the pre-write barrier — never
+                # here, where it would race peer processes' writes.
+                committed = format_lib.save_pytree(
+                    self.directory, step, host_tree,
+                    process_index=self.process_index,
+                    process_count=self.process_count,
+                    metadata=dict(metadata or {}, kind=kind,
+                                  time=time.time()),
+                    barrier=self._barrier)
+                if committed is not None:
+                    manifest = format_lib.load_manifest(self.directory,
+                                                        step)
+                    metrics.CKPT_BYTES_WRITTEN.inc(manifest.get('bytes', 0))
+                    metrics.CKPT_SAVES.labels(kind=kind).inc()
+                    self._gc()
+            finally:
+                self._save_lock_owner = None
         metrics.CKPT_SAVE_SECONDS.labels(phase='write').observe(
             time.perf_counter() - start)
         logger.debug(f'Checkpoint step {step} committed under '
@@ -181,15 +237,19 @@ class CheckpointManager:
     def _gc(self) -> None:
         """Post-commit retention: keep the newest ``keep_last`` steps and
         every ``keep_every`` multiple; delete other committed steps.
-        Only process 0 (the committer) GCs."""
+        Only process 0 (the committer) GCs.  Legacy Orbax step dirs are
+        exempt: the manager only ever deletes checkpoints it wrote, so
+        enabling retention can't destroy a user's pre-existing Orbax
+        fallback checkpoints."""
         if self.keep_last is None or self.process_index != 0:
             return
         committed, _ = format_lib.scan_steps(self.directory)
-        steps = [info.step for info in committed]
+        sharded = [info for info in committed if info.fmt == 'sharded']
+        steps = [info.step for info in sharded]
         keep = set(steps[-self.keep_last:])
         if self.keep_every:
             keep.update(s for s in steps if s % self.keep_every == 0)
-        for info in committed:
+        for info in sharded:
             if info.step in keep:
                 continue
             try:
@@ -330,6 +390,14 @@ class CheckpointManager:
         """One blocking save of the provider's current state (skipped if
         that step is already committed).  Returns the step saved."""
         if self._state_provider is None:
+            return None
+        if self._save_lock_owner is threading.current_thread():
+            # The signal interrupted this very thread mid-save: the
+            # in-flight blocking save already covers the state, and
+            # waiting on the non-reentrant save lock we hold ourselves
+            # would deadlock until SIGKILL.
+            logger.info('Emergency save skipped: a blocking save on '
+                        'this thread is already in flight')
             return None
         metrics = _metrics()
         metrics.CKPT_EMERGENCY_SAVES.inc()

@@ -193,6 +193,31 @@ def test_async_save_error_does_not_poison_writer(tmp_path):
     manager.close()
 
 
+def test_persistent_writer_failure_fails_next_save(tmp_path):
+    """A persistently failing writer must not let training run to the
+    end with only log warnings: after max_consecutive_failures async
+    failures the next async save() raises, the failed step is cleared
+    from the dedupe bookkeeping (a retry is allowed through
+    should_save), and one success re-arms the breaker."""
+    manager = _manager(tmp_path, max_consecutive_failures=3)
+    for step in (1, 2, 3):
+        with ckpt_faults.stage_hook(
+                ckpt_faults.CrashAtStage('shard_written')):
+            manager.save(step, _tree(step), blocking=False)
+            with pytest.raises(ckpt_faults.SimulatedCrash):
+                manager.wait_until_finished()
+    assert manager._last_saved_step is None    # failed steps retryable
+    with pytest.raises(RuntimeError, match='consecutive'):
+        manager.save(4, _tree(4), blocking=False)
+    # Blocking saves surface their own errors inline, so they stay
+    # allowed — and a success resets the failure streak.
+    manager.save(5, _tree(5), blocking=True)
+    manager.save(6, _tree(6), blocking=False)
+    manager.wait_until_finished()
+    assert manager.all_steps() == [5, 6]
+    manager.close()
+
+
 def test_should_save_interval_gate(tmp_path):
     manager = _manager(tmp_path, save_interval_steps=5)
     assert [s for s in range(1, 16) if manager.should_save(s)] == [5, 10, 15]
@@ -249,6 +274,19 @@ def test_retention_gc(tmp_path):
     manager.close()
 
 
+def test_gc_preserves_legacy_orbax_dirs(tmp_path):
+    """Retention only deletes checkpoints the manager wrote: a
+    pre-existing Orbax step dir survives keep_last GC."""
+    legacy = tmp_path / 'step_2'
+    legacy.mkdir()
+    (legacy / 'payload').write_text('legacy orbax checkpoint')
+    manager = _manager(tmp_path, keep_last=1)
+    for step in (5, 6, 7):
+        manager.save(step, _tree(step), blocking=True)
+    assert manager.all_steps() == [2, 7]
+    manager.close()
+
+
 def test_gc_only_on_process_zero(tmp_path):
     ckpt_format.save_pytree(str(tmp_path), 1, _tree(1))
     ckpt_format.save_pytree(str(tmp_path), 2, _tree(2))
@@ -264,17 +302,22 @@ def test_gc_only_on_process_zero(tmp_path):
 
 def test_multihost_merge(tmp_path):
     """Two simulated processes: each writes its round-robin leaves; the
-    barrier runs process 1's writes before process 0 commits the merged
-    manifest.  Restore sees every leaf."""
+    pre-commit barrier runs process 1's writes before process 0 commits
+    the merged manifest.  Restore sees every leaf."""
     tree = _tree(3)
+    tags = []
 
-    def _barrier():
-        ckpt_format.write_process_shards(str(tmp_path), 1, tree,
-                                         process_index=1, process_count=2)
+    def _barrier(tag):
+        tags.append(tag)
+        if 'write' in tag:   # pre-commit rendezvous: peer writes land
+            ckpt_format.write_process_shards(str(tmp_path), 1, tree,
+                                             process_index=1,
+                                             process_count=2)
 
     manager = _manager(tmp_path, process_index=0, process_count=2,
                        barrier=_barrier)
     manager.save(1, tree, blocking=True)
+    assert tags == ['skytpu_ckpt_clean_step1', 'skytpu_ckpt_write_step1']
     manifest = ckpt_format.load_manifest(str(tmp_path), 1)
     assert manifest['process_count'] == 2
     owners = {e['index'] % 2 for e in manifest['entries']}
@@ -283,6 +326,58 @@ def test_multihost_merge(tmp_path):
                        ckpt_format.restore_pytree(str(tmp_path), 1,
                                                   _tree(0)))
     manager.close()
+
+
+def test_multihost_default_barrier_wired(tmp_path):
+    """process_count > 1 without an explicit barrier must get the real
+    cross-process rendezvous, never run barrier-less; single process
+    needs none.  The format layer refuses a barrier-less multihost save
+    outright."""
+    multi = _manager(tmp_path, process_index=0, process_count=2)
+    single = _manager(tmp_path)
+    assert multi._barrier is not None
+    assert single._barrier is None
+    with pytest.raises(ValueError, match='barrier'):
+        ckpt_format.save_pytree(str(tmp_path), 1, _tree(1),
+                                process_index=0, process_count=2)
+    multi.close()
+    single.close()
+
+
+def test_peer_shards_survive_staging_reuse(tmp_path):
+    """Process 0 must not wipe the shared staging dir: a peer that
+    reached the staging dir first already wrote its shards there, and
+    the commit must see them."""
+    tree = _tree(5)
+    ckpt_format.write_process_shards(str(tmp_path), 1, tree,
+                                     process_index=1, process_count=2)
+    staging = ckpt_format.tmp_dir(str(tmp_path), 1)
+    peer_files = set(os.listdir(staging))
+    assert peer_files                      # peer contributed shards
+    ckpt_format.write_process_shards(str(tmp_path), 1, tree,
+                                     process_index=0, process_count=2)
+    assert peer_files <= set(os.listdir(staging))
+    ckpt_format.commit(str(tmp_path), 1, process_count=2)
+    _assert_tree_equal(tree,
+                       ckpt_format.restore_pytree(str(tmp_path), 1,
+                                                  _tree(0)))
+
+
+def test_stale_staging_cleaned_before_writes(tmp_path):
+    """Stale staging dirs from crashed saves are removed by process 0
+    BEFORE the pre-write barrier releases anyone into writing — never
+    while a save is in flight."""
+    stale = ckpt_format.tmp_dir(str(tmp_path), 9)
+    os.makedirs(stale)
+    with open(os.path.join(stale, 'arr_00000.npy'), 'wb') as f:
+        f.write(b'leftover from a crashed save')
+
+    def _barrier(tag):
+        if 'clean' in tag:
+            assert not os.path.isdir(stale)   # cleaned before any write
+
+    ckpt_format.save_pytree(str(tmp_path), 10, _tree(10), barrier=_barrier)
+    assert ckpt_format.latest_step(str(tmp_path)) == 10
 
 
 def test_multihost_commit_refuses_missing_process(tmp_path):
@@ -297,8 +392,8 @@ def test_multihost_commit_refuses_missing_process(tmp_path):
 
 def test_nonzero_process_does_not_commit(tmp_path):
     assert ckpt_format.save_pytree(str(tmp_path), 1, _tree(1),
-                                   process_index=1,
-                                   process_count=2) is None
+                                   process_index=1, process_count=2,
+                                   barrier=lambda tag: None) is None
     assert ckpt_format.latest_step(str(tmp_path)) is None
 
 
@@ -326,6 +421,35 @@ def test_emergency_save_on_sigterm(tmp_path):
         # Step already committed: a second signal is a no-op save.
         os.kill(os.getpid(), signal.SIGTERM)
         assert manager.all_steps() == [7]
+    finally:
+        manager.close()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_sigterm_during_blocking_save_does_not_deadlock(tmp_path):
+    """SIGTERM landing while the main thread is INSIDE a blocking save
+    must not deadlock on the non-reentrant save lock: the handler skips
+    the emergency save (the in-flight save covers the state) and still
+    chains to the previous handler."""
+    chained = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    manager = _manager(tmp_path)
+    fired = []
+
+    def _kill_once(stage, path):
+        if stage == 'pre_commit' and not fired:
+            fired.append(stage)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        manager.register_state_provider(lambda: (99, _tree(99)))
+        assert manager.install_signal_handlers() is True
+        before = _counter('skytpu_ckpt_emergency_saves_total')
+        with ckpt_faults.stage_hook(_kill_once):
+            manager.save(7, _tree(7), blocking=True)
+        assert manager.all_steps() == [7]      # no emergency step 99
+        assert chained == [signal.SIGTERM]
+        assert _counter('skytpu_ckpt_emergency_saves_total') == before
     finally:
         manager.close()
         signal.signal(signal.SIGTERM, prev)
