@@ -309,6 +309,69 @@ def audit_prefill(gen=None) -> Dict[str, Any]:
     return {'entry': 'prefill', 'checks': checks}
 
 
+def audit_prefix_cache() -> Dict[str, Any]:
+    """The radix prefix cache's budgets (infer/prefix_cache.py): a
+    warm+cold bucket-crossing run keeps the decode compile budget, and
+    install_prefix adds at most one compile per cache bucket (slot and
+    position are traced operands — only the cache bucket shape keys the
+    compile).  The installed-block copy must donate the slot cache and
+    stay callback- and f64-free."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.infer import llama_infer, prefix_cache
+
+    gen = make_tiny_generator(prefix_cache_mb=4, prefix_block=8,
+                              prompt_buckets=[32])
+    checks: List[Dict[str, str]] = []
+
+    # Warm + cold runs sharing a 2-block head; the second run hits.
+    shared = [7, 3, 9, 1, 4, 6, 2, 8, 5, 11, 13, 12, 10, 14, 15, 16]
+    prompts = [shared + [21, 22], shared + [23]]
+    gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
+    gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
+    budget = len(gen.cache_buckets)
+
+    decode_compiles = gen._decode_chunk._cache_size()
+    checks.append(_check(
+        'decode_compile_per_bucket',
+        'ok' if decode_compiles <= budget else 'fail',
+        f'{decode_compiles} decode-chunk compiles for {budget} cache '
+        f'buckets across a cold+warm prefix-cache run'))
+
+    install_compiles = gen.prefix._install._cache_size()
+    checks.append(_check(
+        'install_compile_per_bucket',
+        'ok' if install_compiles <= budget else 'fail',
+        f'{install_compiles} install_prefix compiles for {budget} '
+        f'cache buckets'
+        + ('' if install_compiles <= budget else
+           ' — a slot/offset must have become static')))
+
+    hit = gen.prefix.hits > 0
+    checks.append(_check(
+        'warm_run_hits', 'ok' if hit else 'fail',
+        f'{gen.prefix.hits} hits / {gen.prefix.misses} misses, '
+        f'{gen.prefix.tokens_saved} prompt tokens saved'))
+
+    # Donation + jaxpr hygiene of the install copy itself.
+    batch = gen.gen.batch_size
+    cache = llama_infer.init_cache(gen.config, batch,
+                                   gen.cache_buckets[0],
+                                   kv_dtype=gen.gen.kv_cache_dtype)
+    block = {k: jnp.zeros((v.shape[0], gen.prefix.block) + v.shape[3:],
+                          v.dtype) for k, v in cache.items()}
+    lowered = gen.prefix._install.lower(cache, block, jnp.int32(0),
+                                        jnp.int32(0))
+    checks.append(_donation_check(lowered.as_text(), 'slot KV cache'))
+    jaxpr = jax.make_jaxpr(prefix_cache.install_prefix)(
+        cache, block, jnp.int32(0), jnp.int32(0))
+    checks.extend(_jaxpr_dtype_and_callback_checks(jaxpr))
+    return {'entry': 'prefix_cache', 'checks': checks,
+            'decode_compiles': decode_compiles,
+            'install_compiles': install_compiles,
+            'buckets': list(gen.cache_buckets)}
+
+
 def audit_trainer_step() -> Dict[str, Any]:
     """Train step: params + opt state donated (the fit loop's steady
     state must not double its HBM residency), callback-free, f64-free."""
@@ -359,6 +422,7 @@ REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'generator_decode': audit_generator_decode,
     'batcher_decode': audit_batcher_decode,
     'prefill': audit_prefill,
+    'prefix_cache': audit_prefix_cache,
     'trainer_step': audit_trainer_step,
     'ring_attention': audit_ring_attention,
 }

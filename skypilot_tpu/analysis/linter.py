@@ -98,6 +98,7 @@ DATA_PLANE_MODULES = (
     'infer/serving.py',
     'infer/multihost.py',
     'infer/multihost_check.py',
+    'infer/prefix_cache.py',
 )
 
 # SKY202's sanctioned home: the bounded-backoff helper is ALLOWED to

@@ -10,9 +10,11 @@ from skypilot_tpu.infer.multihost import (ControlChannel,
                                           MultiHostBatcher,
                                           make_replica_mesh,
                                           worker_loop)
+from skypilot_tpu.infer.prefix_cache import PrefixCache
 from skypilot_tpu.infer.sampling import sample_logits
 from skypilot_tpu.infer.serving import ContinuousBatcher
 
 __all__ = ['ContinuousBatcher', 'ControlChannel', 'DecodeState',
            'Generator', 'GeneratorConfig', 'MultiHostBatcher',
-           'make_replica_mesh', 'sample_logits', 'worker_loop']
+           'PrefixCache', 'make_replica_mesh', 'sample_logits',
+           'worker_loop']

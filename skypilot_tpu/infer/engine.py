@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from skypilot_tpu.infer import llama_infer, sampling
+from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
@@ -80,6 +80,19 @@ class GeneratorConfig:
     # chunk instead of one per token.  1 degenerates to a per-step
     # host loop (the parity-test reference).
     decode_chunk: int = 32
+    # Radix prefix KV cache (infer/prefix_cache.py): device-byte budget
+    # for cross-request reuse of shared prompt heads (system prompts,
+    # few-shot headers, multi-turn history).  Prompts that
+    # longest-prefix-match cached blocks skip prefill for the matched
+    # head — the blocks are installed device-to-device and only the
+    # suffix is prefilled.  None/0 = disabled.
+    prefix_cache_mb: Optional[float] = None
+    # Prefix-cache block granularity in tokens: prompts are cached and
+    # matched in prefix_block-sized chunks, and warm suffix prefill
+    # runs in windows of this size (or prefill_chunk when set), so the
+    # compile set stays bounded.  Align it with the common shared-head
+    # length; a block is only reusable wholesale.
+    prefix_block: int = 64
 
 
 def prepare_params(params, gen_config: 'GeneratorConfig'):
@@ -237,6 +250,18 @@ class Generator:
                 logits, rng, temperature=gen_config.temperature,
                 top_k=gen_config.top_k, top_p=gen_config.top_p),
             self.mesh))
+        # Radix prefix cache (None = disabled): a prompt that matches
+        # cached head blocks prefills only its suffix through the
+        # start-offset window path below; the matched blocks are
+        # installed device-to-device.  Window length is fixed at
+        # prefix_block so the compile set stays one per cache bucket.
+        self.prefix = prefix_cache.make_prefix_cache(gen_config)
+        if self.prefix is not None:
+            self._prefill_window = jax.jit(
+                lambda p, t, c, s, st: llama_infer.prefill_window(
+                    p, t, self.config, c, s, st),
+                donate_argnums=(2,))
+            self._window_logits = jax.jit(self._window_logits_impl)
 
     def _prefill_impl(self, params, tokens, cache, lengths):
         logits, cache = llama_infer.prefill(
@@ -248,6 +273,52 @@ class Generator:
         if self.mesh is None:
             return cache
         return tp_lib.constrain_cache(cache, self.mesh)
+
+    def _window_logits_impl(self, params, h_last, last_idx):
+        """Next-token logits (vocab,) f32 from a prefill window's
+        hidden rows at the prompt's last valid row."""
+        from skypilot_tpu.infer import quant
+        h = jax.lax.dynamic_index_in_dim(h_last, last_idx, 0,
+                                         keepdims=True)
+        return tp_lib.replicate(
+            quant.matmul(h, params['lm_head'], out_dtype=jnp.float32)[0],
+            self.mesh)
+
+    def _prefix_prefill(self, prompts, cache):
+        """Warm prefill: per row, install the longest-prefix-matched
+        blocks device-to-device, window-prefill only the suffix
+        (prefix_block-sized windows through the start-offset path), and
+        insert the prompt's own head blocks back into the trie.  All
+        dispatches are device-side; no host sync here (the caller's
+        first-token host_fetch is the barrier, same as the cold path).
+        Returns (logits (B, vocab), cache)."""
+        pc = self.prefix
+        blk = pc.block
+        batch = self.gen.batch_size
+        vocab = self.config.vocab_size
+        rows = []
+        for i, p in enumerate(prompts):
+            m = pc.match(p)
+            pc.commit(m)
+            cache = pc.install(cache, i, m)
+            h_last = None
+            last_start = start = m.tokens
+            while start < len(p):
+                end = min(start + blk, len(p))
+                window = np.zeros((blk,), np.int32)
+                window[:end - start] = np.asarray(p[start:end], np.int32)
+                h_last, cache = self._prefill_window(
+                    self.params, jnp.asarray(window), cache,
+                    jnp.int32(i), jnp.int32(start))
+                last_start = start
+                start = end
+            m.release()
+            rows.append(self._window_logits(
+                self.params, h_last, jnp.int32(len(p) - 1 - last_start)))
+            pc.insert(p, functools.partial(pc.extract, cache, i))
+        rows.extend(jnp.zeros((vocab,), jnp.float32)
+                    for _ in range(batch - len(prompts)))
+        return jnp.stack(rows), cache
 
     def _decode_chunk_impl(self, params, token, cache, positions, done,
                            limit, rng, *, n, temperature, top_k, top_p,
@@ -357,9 +428,15 @@ class Generator:
                       else tp_lib.cache_sharding(self.mesh)),
             kv_dtype=self.gen.kv_cache_dtype)
         prefill_start = time.perf_counter()
-        logits, cache = self._prefill(self.params, jnp.asarray(tokens),
-                                      cache=cache,
-                                      lengths=jnp.asarray(lens))
+        if self.prefix is not None:
+            # Prefix-cache path: per-row window prefill so matched head
+            # blocks can be skipped (and missed prompts still populate
+            # the trie for the next request sharing their head).
+            logits, cache = self._prefix_prefill(prompts, cache)
+        else:
+            logits, cache = self._prefill(self.params, jnp.asarray(tokens),
+                                          cache=cache,
+                                          lengths=jnp.asarray(lens))
         rng = jax.random.PRNGKey(seed)
         rng, sub = jax.random.split(rng)
         token = self._sample(logits, sub)

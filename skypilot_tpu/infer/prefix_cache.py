@@ -1,0 +1,292 @@
+"""Radix-style prefix KV cache: shared-prompt K/V reuse across requests.
+
+At serving scale most prompts share long heads — system prompts,
+few-shot headers, multi-turn history — yet a plain admission path
+re-prefills every prompt from token 0, paying full attention compute
+for K/V the engine already produced moments ago.  This module keeps a
+host-side trie (radix tree at fixed block granularity) over
+prompt-token prefixes whose nodes own **device-resident** K/V blocks.
+On admit, the engine longest-prefix-matches the prompt against the
+trie, installs the matched blocks into the slot's cache rows with a
+jitted copy, and prefills only the *suffix* via the existing
+``prefill_window`` start-offset path.  After prefill, the prompt's own
+head blocks are inserted device-to-device so the next request sharing
+the head hits.
+
+Why verbatim reuse is sound: the models here apply RoPE by *absolute*
+position before writing K into the cache, so a cached K/V block for
+tokens ``p[b*block : (b+1)*block]`` is exactly the tensor any later
+prompt with the same head needs at the same positions — no
+re-rotation, no position remapping.
+
+Design contracts (the rest of the engine relies on these):
+
+- **Blocks are standalone device arrays**, never views/aliases of a
+  slot cache.  ``extract`` materializes a copy (``dynamic_slice``)
+  and ``install`` copies back (``dynamic_update_slice``).  Bucket
+  migration (``resize_cache`` pad-grow/truncate-shrink) therefore
+  cannot corrupt cached blocks: there is nothing to invalidate or
+  re-home, and a block stays valid across any number of migrations of
+  the slot caches it was extracted from or installed into.
+- **Compile budget**: ``install``/``extract`` are jitted with the slot
+  and position as *traced* scalars, so the compile count is one per
+  (cache bucket shape x KV layout), matching the decode budget the
+  jaxpr auditor pins (see ``analysis/audit.py``).  The block length is
+  fixed per cache instance.
+- **No host syncs**: nothing here transfers device→host.  Byte
+  accounting uses array metadata (``.nbytes``); matching and trie
+  bookkeeping are pure host-side Python over prompt token lists.
+  (This module is on skytpu-lint's SKY105 decode data-plane list, so
+  an uncounted transfer added later fails lint.)
+- **Ref-counts**: ``match`` acquires a reference on every matched
+  node; LRU eviction skips nodes with live references, so a block
+  cannot be freed between match and install.  Callers must
+  ``release()`` the match once installed (or abandoned).
+- **Single-threaded**: like the batcher's scheduler loop, this class
+  is not thread-safe; all calls must come from the scheduler thread.
+
+Both KV layouts work unchanged: the block dict simply carries whatever
+keys the cache has — ``{'k', 'v'}`` for bf16/f32 caches, plus
+``{'k_scale', 'v_scale'}`` for int8-quantized K/V.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
+
+Block = Dict[str, jax.Array]
+
+
+def install_prefix(cache: Block, block: Block, slot, start) -> Block:
+    """Copy one cached block into ``cache[key][:, slot, start:start+B]``.
+
+    ``slot``/``start`` are traced int32 scalars so one compile serves
+    every slot and block offset; the compile set is keyed only by the
+    cache bucket shape (and layout).  Generic over cache keys: K/V are
+    rank-5 ``(L, batch, pos, kv_heads, head_dim)``, int8 scales rank-4
+    ``(L, batch, pos, kv_heads)`` — the update broadcasts a slot axis
+    into position 1 either way.
+    """
+    out = {}
+    for key, arr in cache.items():
+        upd = block[key].astype(arr.dtype)[:, None]
+        starts = (0, slot, start) + (0,) * (arr.ndim - 3)
+        out[key] = jax.lax.dynamic_update_slice(arr, upd, starts)
+    return out
+
+
+def extract_block(cache: Block, slot, start, *, block: int) -> Block:
+    """Materialize ``cache[key][:, slot, start:start+block]`` as new
+    device arrays (a copy — the result never aliases the slot cache)."""
+    out = {}
+    for key, arr in cache.items():
+        sizes = (arr.shape[0], 1, block) + tuple(arr.shape[3:])
+        starts = (0, slot, start) + (0,) * (arr.ndim - 3)
+        out[key] = jax.lax.dynamic_slice(arr, starts, sizes)[:, 0]
+    return out
+
+
+class _Node:
+    """One trie node: a block of tokens plus its device K/V arrays."""
+
+    __slots__ = ('key', 'parent', 'children', 'data', 'nbytes', 'refs',
+                 'last_used')
+
+    def __init__(self, key: Tuple[int, ...], parent: Optional['_Node'],
+                 data: Optional[Block] = None):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], _Node] = {}
+        self.data = data
+        self.nbytes = sum(a.nbytes for a in data.values()) if data else 0
+        self.refs = 0
+        self.last_used = 0
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of a longest-prefix match; holds references on the
+    matched nodes until ``release()``."""
+    tokens: int                   # matched prompt tokens (multiple of block)
+    nodes: List[_Node]
+    _cache: 'PrefixCache'
+    _released: bool = False
+
+    @property
+    def hit(self) -> bool:
+        return self.tokens > 0
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._cache._release_nodes(self.nodes)
+
+
+class PrefixCache:
+    """Host-side radix trie over prompt prefixes owning device K/V
+    blocks, with byte-budgeted LRU eviction and ref-count pinning."""
+
+    def __init__(self, block: int, capacity_bytes: int):
+        if block <= 0:
+            raise ValueError(f'prefix block must be positive, got {block}')
+        self.block = int(block)
+        self.capacity_bytes = int(capacity_bytes)
+        self._root = _Node((), None)
+        self._clock = 0
+        # Instance mirrors of the REGISTRY counters (the registry is
+        # process-global; tests and bench read per-cache deltas here).
+        self.hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+        self.bytes = 0
+        self.node_count = 0
+        # One compile per (cache bucket shape x layout): slot/start are
+        # traced, block length is fixed per instance.  Jitted through
+        # per-instance wrapper functions: jax.jit shares its trace
+        # cache across wrappers of the SAME function object, so jitting
+        # the module-level functions directly would make _cache_size()
+        # (the auditor's compile-budget probe) count every cache
+        # instance in the process.
+        def _install_fn(cache, block, slot, start):
+            return install_prefix(cache, block, slot, start)
+
+        def _extract_fn(cache, slot, start, *, block):
+            return extract_block(cache, slot, start, block=block)
+
+        self._install = jax.jit(_install_fn, donate_argnums=(0,))
+        self._extract = jax.jit(_extract_fn, static_argnames=('block',))
+
+    # -- matching ---------------------------------------------------------
+
+    def match(self, tokens: Sequence[int]) -> PrefixMatch:
+        """Longest-prefix match over full blocks, capped so at least one
+        suffix token remains (prefill of the suffix produces the logits
+        for the first sampled token).  Acquires a reference on each
+        matched node; pair with ``release()``.  Pure lookup — metrics
+        are recorded by ``commit()`` when the match is actually used."""
+        toks = tuple(int(t) for t in tokens)
+        max_blocks = max(0, (len(toks) - 1) // self.block)
+        nodes: List[_Node] = []
+        node = self._root
+        for b in range(max_blocks):
+            child = node.children.get(
+                toks[b * self.block:(b + 1) * self.block])
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+        for n in nodes:
+            n.refs += 1
+            self._touch(n)
+        return PrefixMatch(tokens=len(nodes) * self.block, nodes=nodes,
+                           _cache=self)
+
+    def commit(self, match: PrefixMatch) -> None:
+        """Record hit/miss + tokens-saved for a match the engine is
+        acting on (kept separate from ``match`` so a lookup that cannot
+        be admitted this tick does not skew the counters)."""
+        if match.hit:
+            self.hits += 1
+            self.tokens_saved += match.tokens
+            telemetry_metrics.INFER_PREFIX_HITS.inc()
+            telemetry_metrics.INFER_PREFIX_TOKENS_SAVED.inc(match.tokens)
+        else:
+            self.misses += 1
+            telemetry_metrics.INFER_PREFIX_MISSES.inc()
+
+    def install(self, cache: Block, slot: int, match: PrefixMatch) -> Block:
+        """Install the matched blocks into ``cache`` rows for ``slot``
+        (device-to-device; donates and returns the cache).  The caller
+        must have grown the cache to cover ``match.tokens`` positions."""
+        for i, node in enumerate(match.nodes):
+            cache = self._install(cache, node.data, jnp.int32(slot),
+                                  jnp.int32(i * self.block))
+        return cache
+
+    # -- insertion --------------------------------------------------------
+
+    def insert(self, tokens: Sequence[int],
+               extractor: Callable[[int], Block]) -> int:
+        """Insert ``tokens``' full blocks, calling ``extractor(start)``
+        only for blocks not already cached (device-to-device copy out of
+        the freshly prefilled slot rows).  Returns the number of new
+        blocks stored.  May evict LRU unreferenced blocks to hold the
+        byte budget — including, if the budget is very small, blocks
+        just inserted (newest-recency, so they go last)."""
+        toks = tuple(int(t) for t in tokens)
+        node = self._root
+        created = 0
+        for b in range(len(toks) // self.block):
+            key = toks[b * self.block:(b + 1) * self.block]
+            child = node.children.get(key)
+            if child is None:
+                data = extractor(b * self.block)
+                child = _Node(key, node, data)
+                node.children[key] = child
+                self.bytes += child.nbytes
+                self.node_count += 1
+                created += 1
+            self._touch(child)
+            node = child
+        if created:
+            telemetry_metrics.INFER_PREFIX_BYTES.set(self.bytes)
+            self._evict_to_budget()
+        return created
+
+    # -- internals --------------------------------------------------------
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.last_used = self._clock
+
+    def _release_nodes(self, nodes: List[_Node]) -> None:
+        for n in nodes:
+            n.refs -= 1
+            self._touch(n)
+
+    def _evict_to_budget(self) -> None:
+        """Evict LRU leaves (no children, no live refs) until under
+        budget.  Evicting a leaf may expose its parent as the next
+        candidate; interior nodes and referenced nodes are never
+        touched, so an in-flight match can always complete."""
+        while self.bytes > self.capacity_bytes:
+            victim = None
+            stack = list(self._root.children.values())
+            while stack:
+                n = stack.pop()
+                if n.children:
+                    stack.extend(n.children.values())
+                elif n.refs == 0 and (victim is None
+                                      or n.last_used < victim.last_used):
+                    victim = n
+            if victim is None:       # everything left is pinned
+                break
+            del victim.parent.children[victim.key]
+            self.bytes -= victim.nbytes
+            self.node_count -= 1
+            self.evictions += 1
+            telemetry_metrics.INFER_PREFIX_EVICTIONS.inc()
+            telemetry_metrics.INFER_PREFIX_BYTES.set(self.bytes)
+
+    def extract(self, cache: Block, slot: int, start: int) -> Block:
+        """Jitted block copy out of a slot's cache rows (see
+        ``extract_block``)."""
+        return self._extract(cache, jnp.int32(slot), jnp.int32(start),
+                             block=self.block)
+
+
+def make_prefix_cache(config) -> Optional[PrefixCache]:
+    """Build a PrefixCache from a GeneratorConfig, or None when
+    disabled (``prefix_cache_mb`` unset/0)."""
+    mb = getattr(config, 'prefix_cache_mb', None)
+    if not mb:
+        return None
+    block = int(getattr(config, 'prefix_block', 0) or 0)
+    return PrefixCache(block=block,
+                       capacity_bytes=int(float(mb) * 1024 * 1024))

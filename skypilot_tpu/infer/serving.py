@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.infer import engine as engine_lib
-from skypilot_tpu.infer import llama_infer, sampling
+from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
 from skypilot_tpu.models import llama
@@ -178,6 +178,12 @@ class ContinuousBatcher:
         self._install_first = jax.jit(functools.partial(
             self._install_first_impl, top_k=gen_config.top_k,
             eos=gen_config.eos_token))
+        # Radix prefix KV cache (None = disabled): admission
+        # longest-prefix-matches each prompt against previously served
+        # heads, installs matched blocks device-to-device, and prefills
+        # only the suffix through _prefill_window's start-offset path
+        # (see infer/prefix_cache.py for the reuse/compile contracts).
+        self._prefix = prefix_cache.make_prefix_cache(gen_config)
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
@@ -382,6 +388,13 @@ class ContinuousBatcher:
         return self.cache_buckets[-1]
 
     def _migrate(self, target: int) -> None:
+        """Resize the slot cache's position axis to `target` rows.
+        Prefix-cache composition: cached blocks are standalone device
+        arrays EXTRACTED (copied) from slot rows, never views of
+        self._cache, so a migration has nothing to invalidate or
+        re-home — blocks stay valid across any resize (the contract
+        infer/prefix_cache.py documents and test_prefix_cache.py's
+        migration parity locks)."""
         telemetry_metrics.INFER_CACHE_MIGRATIONS.labels(
             direction=('grow' if target > self._cache_len
                        else 'shrink')).inc()
@@ -406,15 +419,36 @@ class ContinuousBatcher:
         """Move queued requests into free slots: admission groups of up
         to _admit_group requests sharing a prompt bucket prefill in ONE
         dispatch (G sequential prefills would pay G tunnel round-trips
-        and G full forward launches)."""
+        and G full forward launches).
+
+        Scanning is by INDEX, not head-pop: while one long chunked
+        prefill is in flight, later short-prompt requests still admit
+        into the other free slots instead of queueing behind it
+        (head-of-line fix; the skipped long prompt keeps its queue
+        position).  With the prefix cache enabled, each candidate is
+        longest-prefix-matched first — a HIT installs the cached head
+        blocks and prefills only the suffix (_admit_prefix_hit); a
+        long prompt whose unmatched suffix fits prefill_chunk takes the
+        hit path instead of occupying the single incremental lane."""
         eos = self.gen.eos_token
 
         chunk_w = self.gen.prefill_chunk
-        while self._queue and self._free:
-            if chunk_w and len(self._queue[0].prompt) > chunk_w:
+        idx = 0
+        while self._free and idx < len(self._queue):
+            head = self._queue[idx]
+            match = (self._prefix.match(head.prompt)
+                     if self._prefix is not None else None)
+            suffix = len(head.prompt) - (match.tokens if match else 0)
+            if chunk_w and suffix > chunk_w:
                 if self._incremental is not None:
-                    break    # one long prefill in flight; FIFO waits
-                request = self._queue.pop(0)
+                    # One long prefill in flight: skip it, keep
+                    # scanning — shorter requests behind it can still
+                    # fill the other free slots.
+                    if match is not None:
+                        match.release()
+                    idx += 1
+                    continue
+                request = self._queue.pop(idx)
                 request.slot = self._free.pop(0)
                 self._observe_queue_wait(request)
                 self._incremental = request
@@ -423,6 +457,15 @@ class ContinuousBatcher:
                 # len(prompt).  (The cache never shrinks while this
                 # prefill is in flight — see step().)
                 self._grow_for(len(request.prompt) + 1)
+                if match is not None:
+                    self._prefix.commit(match)
+                    if match.hit:
+                        # Matched head installs device-to-device; the
+                        # incremental windows start at the suffix.
+                        self._cache = self._prefix.install(
+                            self._cache, request.slot, match)
+                        request.prefill_pos = match.tokens
+                    match.release()
                 # Park the slot's frozen position at the last cache
                 # row: the fused decode freezes done slots but still
                 # rewrites their CURRENT row in lockstep, and parking
@@ -436,17 +479,39 @@ class ContinuousBatcher:
                     request.slot].set(park)
                 self._host_pos[request.slot] = int(park)
                 continue
+            if match is not None and match.hit:
+                self._admit_prefix_hit(self._queue.pop(idx), match)
+                continue
+            if match is not None:
+                self._prefix.commit(match)    # counted miss
+                match.release()
+            # Grouped admission: consecutive same-bucket misses
+            # starting at idx (a hit or a long prompt ends the group —
+            # the outer loop re-examines it on the next iteration).
             group_size = self._admit_group
-            bucket = self._bucket_for(len(self._queue[0].prompt))
+            bucket = self._bucket_for(len(head.prompt))
             group: List[_Request] = []
-            while (self._queue and self._free
-                   and len(group) < group_size
-                   and self._bucket_for(len(self._queue[0].prompt))
-                   == bucket):
-                request = self._queue.pop(0)
-                request.slot = self._free.pop(0)
-                self._observe_queue_wait(request)
-                group.append(request)
+            request = self._queue.pop(idx)
+            request.slot = self._free.pop(0)
+            self._observe_queue_wait(request)
+            group.append(request)
+            while (idx < len(self._queue) and self._free
+                   and len(group) < group_size):
+                cand = self._queue[idx]
+                if self._bucket_for(len(cand.prompt)) != bucket or \
+                        (chunk_w and len(cand.prompt) > chunk_w):
+                    break
+                if self._prefix is not None:
+                    m = self._prefix.match(cand.prompt)
+                    if m.hit:
+                        m.release()
+                        break
+                    self._prefix.commit(m)
+                    m.release()
+                cand = self._queue.pop(idx)
+                cand.slot = self._free.pop(0)
+                self._observe_queue_wait(cand)
+                group.append(cand)
             # Exact group size: G ∈ {1..admit_group} — bounded compiles
             # per bucket, no padding-row FLOPs for trickle traffic.
             effective = len(group)
@@ -487,15 +552,24 @@ class ContinuousBatcher:
                 self._host_top_p[slots] = top_ps
             except Exception:
                 # A failed dispatch (fresh compile OOM, device error)
-                # must not leak the group: re-queue the requests at the
-                # front and return their slots, THEN surface the error
-                # (is_done would otherwise spin forever and the slots
-                # would shrink capacity permanently).
+                # must not leak the group: re-queue the requests at
+                # their scan position and return their slots, THEN
+                # surface the error (is_done would otherwise spin
+                # forever and the slots would shrink capacity
+                # permanently).
                 for request in reversed(group):
                     self._free.insert(0, request.slot)
                     request.slot = None
-                    self._queue.insert(0, request)
+                    self._queue.insert(idx, request)
                 raise
+            # Freshly prefilled heads become reusable for the next
+            # request sharing them — device-to-device block copies out
+            # of the slot rows; only not-yet-cached blocks are
+            # extracted.
+            if self._prefix is not None:
+                for req in group:
+                    self._prefix.insert(req.prompt, functools.partial(
+                        self._prefix.extract, self._cache, req.slot))
             # ONE counted sync for the whole admitted group — the
             # per-request int() below reads host memory, not device.
             (firsts,) = engine_lib.host_fetch(firsts)
@@ -507,6 +581,90 @@ class ContinuousBatcher:
                     self._finish(req)
                 else:
                     self._active[req.slot] = req
+
+    def _admit_prefix_hit(self, req: _Request,
+                          match: 'prefix_cache.PrefixMatch') -> None:
+        """Admit one prefix-HIT request: install the matched head
+        blocks device-to-device, window-prefill only the unmatched
+        suffix synchronously (prefill_chunk-sized windows, or
+        prefix_block when chunking is off — the suffix is short by
+        construction when prefill_chunk is set), then sample the first
+        token and promote to the decode batch.  The only host sync is
+        _complete_prefill's counted first-token fetch — identical to
+        every other admission route."""
+        req.slot = self._free.pop(0)
+        self._observe_queue_wait(req)
+        self._prefix.commit(match)
+        prompt = req.prompt
+        # Bucket contract: head blocks + suffix windows write rows
+        # 0..len(prompt)-1 and the first decode write lands at
+        # len(prompt) — grow before any dispatch.
+        self._grow_for(len(prompt) + 1)
+        w = self.gen.prefill_chunk or self._prefix.block
+        start = match.tokens
+        try:
+            self._cache = self._prefix.install(self._cache, req.slot,
+                                               match)
+        finally:
+            match.release()
+        try:
+            h_last = None
+            last_start = start
+            while start < len(prompt):
+                end = min(start + w, len(prompt))
+                window = np.zeros((w,), np.int32)
+                window[:end - start] = np.asarray(prompt[start:end],
+                                                  np.int32)
+                h_last, self._cache = self._prefill_window(
+                    self.params, jnp.asarray(window), self._cache,
+                    jnp.int32(req.slot), jnp.int32(start))
+                last_start = start
+                start = end
+            self._complete_prefill(req, h_last, last_start)
+        except Exception:
+            # Same contract as the other admission handlers: reclaim
+            # the slot and re-queue before surfacing the error.
+            self._free.insert(0, req.slot)
+            req.slot = None
+            self._queue.insert(0, req)
+            raise
+        self._prefix.insert(prompt, functools.partial(
+            self._prefix.extract, self._cache, req.slot))
+
+    def _complete_prefill(self, req: _Request, h_last,
+                          last_start: int) -> None:
+        """Finish a window-based prefill (incremental or prefix-hit):
+        sample the first token at the prompt's last valid window row,
+        install the slot's decode rows, and promote/finish the request.
+        Performs the admission's ONE counted host sync."""
+        default_temp = self.gen.temperature
+        default_top_p = self.gen.top_p if self.gen.top_p else 1.0
+        temp = (default_temp if req.temperature is None
+                else req.temperature)
+        top_p = default_top_p if req.top_p is None else req.top_p
+        (self._token, self._positions, self._done, self._limit,
+         self._temp_row, self._top_p_row, first,
+         self._rng) = self._install_first(
+            self.params, h_last,
+            jnp.int32(len(req.prompt) - 1 - last_start),
+            self._token, self._positions, self._done, self._limit,
+            self._temp_row, self._top_p_row,
+            jnp.int32(len(req.prompt)), jnp.int32(req.slot),
+            jnp.float32(temp), jnp.float32(top_p),
+            jnp.int32(req.max_new_tokens - 1), self._rng)
+        self._host_pos[req.slot] = len(req.prompt)
+        self._host_temp[req.slot] = temp
+        self._host_top_p[req.slot] = top_p
+        eos = self.gen.eos_token
+        # Counted sync: the first sampled token is the one value the
+        # scheduler needs on host to test EOS/limit before promotion.
+        (first_host,) = engine_lib.host_fetch(first)
+        req.out.append(int(first_host))
+        if (eos is not None and req.out[-1] == eos) or \
+                len(req.out) >= req.max_new_tokens:
+            self._finish(req)
+        else:
+            self._active[req.slot] = req
 
     def _finish(self, req: _Request) -> None:
         req.done = True
@@ -554,21 +712,8 @@ class ContinuousBatcher:
         req.prefill_pos = end
         if end < len(req.prompt):
             return
-        default_temp = self.gen.temperature
-        default_top_p = self.gen.top_p if self.gen.top_p else 1.0
-        temp = (default_temp if req.temperature is None
-                else req.temperature)
-        top_p = default_top_p if req.top_p is None else req.top_p
         try:
-            (self._token, self._positions, self._done, self._limit,
-             self._temp_row, self._top_p_row, first,
-             self._rng) = self._install_first(
-                self.params, h_last, jnp.int32(end - 1 - start),
-                self._token, self._positions, self._done, self._limit,
-                self._temp_row, self._top_p_row,
-                jnp.int32(len(req.prompt)), jnp.int32(req.slot),
-                jnp.float32(temp), jnp.float32(top_p),
-                jnp.int32(req.max_new_tokens - 1), self._rng)
+            self._complete_prefill(req, h_last, start)
         except Exception:
             self._incremental = None
             req.prefill_pos = 0
@@ -576,20 +721,10 @@ class ContinuousBatcher:
             req.slot = None
             self._queue.insert(0, req)
             raise
-        self._host_pos[req.slot] = len(req.prompt)
-        self._host_temp[req.slot] = temp
-        self._host_top_p[req.slot] = top_p
         self._incremental = None
-        eos = self.gen.eos_token
-        # Counted sync: the first sampled token is the one value the
-        # scheduler needs on host to test EOS/limit before promotion.
-        (first_host,) = engine_lib.host_fetch(first)
-        req.out.append(int(first_host))
-        if (eos is not None and req.out[-1] == eos) or \
-                len(req.out) >= req.max_new_tokens:
-            self._finish(req)
-        else:
-            self._active[req.slot] = req
+        if self._prefix is not None:
+            self._prefix.insert(req.prompt, functools.partial(
+                self._prefix.extract, self._cache, req.slot))
 
     def step(self) -> None:
         """One scheduler tick: admit queued requests, advance the

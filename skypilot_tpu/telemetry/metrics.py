@@ -179,6 +179,35 @@ INFER_CACHE_MIGRATIONS = prometheus_client.Counter(
     ['direction'],
     registry=REGISTRY)
 
+INFER_PREFIX_HITS = prometheus_client.Counter(
+    'skytpu_infer_prefix_hits_total',
+    'Admissions whose prompt longest-prefix-matched >=1 cached block '
+    'in the radix prefix KV cache (prefill skipped the matched head)',
+    registry=REGISTRY)
+
+INFER_PREFIX_MISSES = prometheus_client.Counter(
+    'skytpu_infer_prefix_misses_total',
+    'Admissions with the prefix cache enabled that matched no cached '
+    'block (full prefill from token 0)',
+    registry=REGISTRY)
+
+INFER_PREFIX_TOKENS_SAVED = prometheus_client.Counter(
+    'skytpu_infer_prefix_tokens_saved_total',
+    'Prompt tokens whose prefill compute was skipped because their K/V '
+    'was installed from the prefix cache instead',
+    registry=REGISTRY)
+
+INFER_PREFIX_EVICTIONS = prometheus_client.Counter(
+    'skytpu_infer_prefix_evictions_total',
+    'Prefix-cache blocks evicted by the byte-budget LRU '
+    '(prefix_cache_mb); ref-counted in-use blocks are never evicted',
+    registry=REGISTRY)
+
+INFER_PREFIX_BYTES = prometheus_client.Gauge(
+    'skytpu_infer_prefix_bytes',
+    'Device bytes currently pinned by prefix-cache K/V blocks',
+    registry=REGISTRY)
+
 # ---- serve (serve/load_balancer.py, replica_managers.py, autoscalers.py)
 
 SERVE_REPLICA_REQUESTS = prometheus_client.Counter(

@@ -213,6 +213,112 @@ def test_chunked_prefill_matches_whole_prompt():
         assert run(None, kv) == run(16, kv), kv
 
 
+def test_short_prompt_not_blocked_by_queued_long_prefill():
+    """Head-of-line regression: with the incremental-prefill lane busy
+    on one long prompt and ANOTHER long prompt queued ahead of a short
+    one, the short prompt must still admit into the free slot (the old
+    _admit only looked at the queue head, so the second long prompt
+    blocked everything behind it until the first prefill drained)."""
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gc = GeneratorConfig(max_seq_len=96, batch_size=2, temperature=0.0,
+                         prompt_buckets=[8, 64], prefill_chunk=8)
+    long1 = [((3 * i) % 500) + 1 for i in range(40)]
+    long2 = [((5 * i) % 500) + 1 for i in range(40)]
+    short = [3, 5]
+    solo = {}
+    for p, n in ((long1, 4), (long2, 4), (short, 12)):
+        g = ContinuousBatcher(params, config, gc)
+        r = g.submit(p, max_new_tokens=n)
+        g.run_until_idle()
+        solo[tuple(p)] = g.result(r)
+
+    b = ContinuousBatcher(params, config, gc, decode_chunk=2)
+    r1 = b.submit(long1, max_new_tokens=4)
+    r2 = b.submit(long2, max_new_tokens=4)
+    r3 = b.submit(short, max_new_tokens=12)
+    b._admit()
+    # long1 took the incremental lane; long2 cannot start — but it must
+    # not block short, which grabs the free slot and starts decoding.
+    assert b._incremental is not None and b._incremental.rid == r1
+    assert b.num_active == 1
+    assert [q.rid for q in b._queue] == [r2]
+    b.run_until_idle()
+    assert b.result(r1) == solo[tuple(long1)]
+    assert b.result(r2) == solo[tuple(long2)]
+    assert b.result(r3) == solo[tuple(short)]
+
+
+def test_prompt_equal_to_chunk_admits_grouped():
+    """Prompt length EXACTLY == prefill_chunk is not 'long': it admits
+    through the grouped single-dispatch path, never the incremental
+    lane, and matches the unchunked run."""
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    prompt = [((7 * i) % 500) + 1 for i in range(8)]
+
+    def run(chunk):
+        b = ContinuousBatcher(params, config, GeneratorConfig(
+            max_seq_len=64, batch_size=2, temperature=0.0,
+            prompt_buckets=[8, 64], prefill_chunk=chunk))
+        rid = b.submit(prompt, max_new_tokens=6)
+        b.step()
+        assert b._incremental is None, chunk
+        b.run_until_idle()
+        return b.result(rid)
+
+    assert run(8) == run(None)
+
+
+def test_prompt_at_bucket_boundary_chunked():
+    """Prompt length exactly == the largest prompt bucket AND an exact
+    multiple of prefill_chunk: no partial last window, bucket selection
+    lands on the boundary, greedy output matches unchunked."""
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    prompt = [((11 * i) % 500) + 1 for i in range(64)]
+
+    def run(chunk):
+        b = ContinuousBatcher(params, config, GeneratorConfig(
+            max_seq_len=96, batch_size=2, temperature=0.0,
+            prompt_buckets=[8, 64], prefill_chunk=chunk))
+        rid = b.submit(prompt, max_new_tokens=6)
+        b.run_until_idle()
+        return b.result(rid)
+
+    assert run(8) == run(None)
+
+
+def test_submit_mid_window_joins_without_corruption():
+    """A request submitted while an incremental prefill is mid-flight
+    (some windows written, more to go) admits into the free slot on the
+    next tick and both streams stay token-identical to solo runs."""
+    config = llama.LLAMA_DEBUG
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gc = GeneratorConfig(max_seq_len=96, batch_size=2, temperature=0.0,
+                         prompt_buckets=[8, 64], prefill_chunk=8)
+    long_prompt = [((3 * i) % 500) + 1 for i in range(40)]
+    short = [9, 4]
+    solo = {}
+    for p, n in ((long_prompt, 4), (short, 8)):
+        g = ContinuousBatcher(params, config, gc)
+        r = g.submit(p, max_new_tokens=n)
+        g.run_until_idle()
+        solo[tuple(p)] = g.result(r)
+
+    b = ContinuousBatcher(params, config, gc, decode_chunk=2)
+    r1 = b.submit(long_prompt, max_new_tokens=4)
+    b.step()
+    assert b._incremental is not None        # mid-prefill (window 1 of 5)
+    assert 0 < b._incremental.prefill_pos < len(long_prompt)
+    r2 = b.submit(short, max_new_tokens=8)   # arrives mid-window
+    b.step()
+    assert b.num_active == 1                 # short admitted immediately
+    b.run_until_idle()
+    assert b.result(r1) == solo[tuple(long_prompt)]
+    assert b.result(r2) == solo[tuple(short)]
+
+
 def test_chunked_prefill_interleaves_with_decode():
     """While a long prompt prefills window-by-window, an already-active
     short request keeps producing tokens — the whole point of chunked

@@ -1,0 +1,330 @@
+"""Radix prefix KV cache (infer/prefix_cache.py): shared-prompt K/V
+reuse across requests.
+
+Tier-1 locks on the PR-5 tentpole:
+
+- trie semantics: longest-prefix match over full blocks (capped so one
+  suffix token always remains), insert-once extraction, byte-budgeted
+  LRU eviction that never frees referenced or interior nodes;
+- install/extract are exact device-to-device copies — a trip through
+  the trie restores bit-identical cache rows, for both KV layouts;
+- warm/cold GREEDY PARITY: a prefix-cache hit must not change a single
+  token vs a cold run or a no-cache reference — at Generator and
+  ContinuousBatcher level, for bf16-free f32 + int8-KV layouts, across
+  a cache-bucket migration, and after evictions under a tiny budget;
+- the install compile set stays within one compile per cache bucket
+  (the PR-3 audit budget extended to the prefix path).
+
+NOT slow-marked: tiny configs; this is the tier-1 lock on the prefix
+cache rework.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer.engine import Generator, GeneratorConfig
+from skypilot_tpu.infer.prefix_cache import (PrefixCache, extract_block,
+                                             install_prefix,
+                                             make_prefix_cache)
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.models import llama
+
+# f32: reduction-order drift between the windowed-suffix and whole-prompt
+# prefill paths must not flip argmax.
+CFG = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=128,
+                        max_seq_len=64, dtype=jnp.float32, remat=False)
+
+# Two prompts sharing a 16-token head (= 2 prefix blocks of 8) with
+# distinct tails: the second row of the very first batch already hits.
+HEAD = [((5 * i) % 120) + 1 for i in range(16)]
+PROMPTS = [HEAD + [121, 122], HEAD + [123]]
+
+
+@pytest.fixture(scope='module')
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _gen_config(**kw):
+    base = dict(max_seq_len=64, batch_size=2, temperature=0.0,
+                prompt_buckets=[32])
+    base.update(kw)
+    return GeneratorConfig(**base)
+
+
+# ---- trie unit tests (no model) -----------------------------------------
+
+
+def _tiny_block(val):
+    """Extractor producing one 16-byte block: (L=1, block=4, 1, 1) f32."""
+    return lambda start: {'k': jnp.full((1, 4, 1, 1), float(val))}
+
+
+def test_match_caps_one_suffix_token():
+    """A full-prompt match would leave no suffix to prefill (and no
+    logits for the first sampled token): match must stop one token
+    short even when every block is cached."""
+    pc = PrefixCache(block=4, capacity_bytes=1 << 20)
+    toks = list(range(1, 9))                    # exactly 2 blocks
+    assert pc.insert(toks, _tiny_block(1)) == 2
+    m = pc.match(toks)                          # len 8 -> at most 1 block
+    assert m.tokens == 4
+    m.release()
+    m = pc.match(toks + [99])                   # one spare token: both
+    assert m.tokens == 8
+    m.release()
+    assert pc.match([1, 2, 3, 4]).tokens == 0   # len == block: no match
+
+
+def test_commit_separates_lookup_from_accounting():
+    """match() is a pure lookup; only commit() moves the hit/miss and
+    tokens-saved counters (an admission that cannot proceed this tick
+    releases its match without skewing the hit rate)."""
+    pc = PrefixCache(block=4, capacity_bytes=1 << 20)
+    pc.insert(list(range(8)), _tiny_block(1))
+    m = pc.match(list(range(8)) + [99])
+    assert (pc.hits, pc.misses, pc.tokens_saved) == (0, 0, 0)
+    pc.commit(m)
+    m.release()
+    assert (pc.hits, pc.misses, pc.tokens_saved) == (1, 0, 8)
+    m2 = pc.match([50, 51, 52, 53, 54])
+    pc.commit(m2)
+    m2.release()
+    assert (pc.hits, pc.misses) == (1, 1)
+
+
+def test_lru_eviction_skips_referenced_nodes():
+    """Byte budget for two 16-byte blocks: the LRU *unreferenced* leaf
+    goes first, and a block pinned by an in-flight match survives even
+    when it is the least recently used."""
+    pc = PrefixCache(block=4, capacity_bytes=32)
+    pc.insert([1, 2, 3, 4], _tiny_block(1))       # A
+    pc.insert([5, 6, 7, 8], _tiny_block(2))       # B
+    m_a = pc.match([1, 2, 3, 4, 0])               # pin + touch A
+    pc.insert([9, 10, 11, 12], _tiny_block(3))    # C -> evict LRU = B
+    assert pc.evictions == 1 and pc.bytes <= 32
+    miss = pc.match([5, 6, 7, 8, 0])
+    assert not miss.hit                            # B gone
+    miss.release()
+    still = pc.match([1, 2, 3, 4, 0])              # A pinned -> survived
+    assert still.hit
+    still.release()
+    m_a.release()
+
+    # Pinned nodes break the eviction loop rather than being freed:
+    # with budget for ONE block and A pinned, inserting D evicts D
+    # itself (newest recency, only unreferenced leaf) — never A.
+    pc2 = PrefixCache(block=4, capacity_bytes=16)
+    pc2.insert([1, 2, 3, 4], _tiny_block(1))
+    pin = pc2.match([1, 2, 3, 4, 0])
+    pc2.insert([13, 14, 15, 16], _tiny_block(4))
+    assert pc2.bytes <= 16
+    hit = pc2.match([1, 2, 3, 4, 0])
+    assert hit.hit
+    hit.release()
+    pin.release()
+
+
+def test_eviction_leaves_only_then_exposes_parent():
+    """Interior nodes are never evicted while they have children; once
+    the leaf goes, the parent becomes the next candidate."""
+    pc = PrefixCache(block=4, capacity_bytes=16)   # one block
+    pc.insert(list(range(1, 10)), _tiny_block(1))  # 2-block chain
+    # Over budget by one block: the LEAF (block 2) is evicted, the
+    # interior block-1 node stays.
+    assert pc.node_count == 1 and pc.bytes == 16
+    m = pc.match(list(range(1, 10)))
+    assert m.tokens == 4                           # block 1 still cached
+    m.release()
+    # A fresh insert re-exposes the budget: now block-1 (older) is a
+    # leaf and gets evicted for the newcomer.
+    pc.insert([90, 91, 92, 93], _tiny_block(2))
+    assert pc.bytes <= 16 and pc.evictions >= 2
+
+
+def test_extract_install_roundtrip_both_layouts():
+    """A block extracted from slot 1 and installed into slot 0 lands
+    bit-identical, for the bf16/f32 layout ({'k','v'}, rank 5) and the
+    int8 layout (+ rank-4 scale arrays); untouched rows stay zero."""
+    L, B, P, KV, HD, BLK = 2, 2, 32, 2, 4, 8
+    keys = jax.random.split(jax.random.PRNGKey(7), 4)
+    cache = {
+        'k': jax.random.normal(keys[0], (L, B, P, KV, HD)),
+        'v': jax.random.normal(keys[1], (L, B, P, KV, HD)),
+        'k_scale': jax.random.normal(keys[2], (L, B, P, KV)),
+        'v_scale': jax.random.normal(keys[3], (L, B, P, KV)),
+    }
+    pc = PrefixCache(block=BLK, capacity_bytes=1 << 20)
+    toks = list(range(1, 2 * BLK + 1))             # 2 full blocks
+    assert pc.insert(toks, functools.partial(pc.extract, cache, 1)) == 2
+    m = pc.match(toks + [99])
+    assert m.tokens == 2 * BLK
+    dst = {k: jnp.zeros_like(v) for k, v in cache.items()}
+    dst = pc.install(dst, 0, m)
+    m.release()
+    for key in cache:
+        np.testing.assert_array_equal(
+            np.asarray(dst[key][:, 0, :2 * BLK]),
+            np.asarray(cache[key][:, 1, :2 * BLK]), err_msg=key)
+        assert not np.asarray(dst[key][:, 0, 2 * BLK:]).any(), key
+        assert not np.asarray(dst[key][:, 1]).any(), key
+
+
+def test_blocks_survive_bucket_migration_of_source():
+    """Blocks are standalone copies: shrinking/growing the cache they
+    were extracted from cannot corrupt them (the _migrate composition
+    contract)."""
+    from skypilot_tpu.infer import llama_infer
+    cache = llama_infer.init_cache(CFG, 2, 32)
+    cache = {k: jnp.asarray(
+        np.random.RandomState(0).randn(*v.shape), v.dtype)
+        for k, v in cache.items()}
+    pc = PrefixCache(block=8, capacity_bytes=1 << 20)
+    toks = list(range(1, 17))
+    pc.insert(toks, functools.partial(pc.extract, cache, 1))
+    want = {k: np.asarray(v[:, 1, :16]) for k, v in cache.items()}
+    # Migrate the source cache down to 16 rows, then grow to 64: the
+    # trie's arrays must be unaffected.
+    cache = llama_infer.resize_cache(cache, 16)
+    cache = llama_infer.resize_cache(cache, 64)
+    del cache
+    m = pc.match(toks + [99])
+    dst = llama_infer.init_cache(CFG, 2, 32)
+    dst = pc.install(dst, 0, m)
+    m.release()
+    for key, ref in want.items():
+        np.testing.assert_array_equal(np.asarray(dst[key][:, 0, :16]),
+                                      ref, err_msg=key)
+
+
+def test_install_extract_jaxpr_is_pure_slicing():
+    """install_prefix/extract_block lower to dynamic-(update-)slice
+    only — no host callbacks, no gathers over the full cache."""
+    cache = {'k': jnp.zeros((2, 2, 32, 2, 4)),
+             'k_scale': jnp.zeros((2, 2, 32, 2))}
+    block = {'k': jnp.zeros((2, 8, 2, 4)), 'k_scale': jnp.zeros((2, 8, 2))}
+    jaxpr = str(jax.make_jaxpr(install_prefix)(
+        cache, block, jnp.int32(0), jnp.int32(0)))
+    assert 'dynamic_update_slice' in jaxpr and 'callback' not in jaxpr
+    jaxpr = str(jax.make_jaxpr(
+        functools.partial(extract_block, block=8))(
+            cache, jnp.int32(0), jnp.int32(0)))
+    assert 'dynamic_slice' in jaxpr and 'callback' not in jaxpr
+
+
+def test_make_prefix_cache_disabled_by_default():
+    assert make_prefix_cache(_gen_config()) is None
+    pc = make_prefix_cache(_gen_config(prefix_cache_mb=2, prefix_block=8))
+    assert pc is not None and pc.block == 8
+    assert pc.capacity_bytes == 2 * 1024 * 1024
+
+
+# ---- generator-level warm/cold parity -----------------------------------
+
+
+@pytest.mark.parametrize('kv', [None, 'int8'])
+def test_generator_warm_cold_parity(params, kv):
+    """Cold (trie empty), warm (every head block cached), and a
+    no-prefix-cache reference all emit IDENTICAL greedy tokens; the
+    warm run actually hit."""
+    ref = Generator(params, CFG, _gen_config(kv_cache_dtype=kv)).generate(
+        PROMPTS, max_new_tokens=12)
+    gen = Generator(params, CFG, _gen_config(
+        kv_cache_dtype=kv, prefix_cache_mb=4, prefix_block=8))
+    cold = gen.generate(PROMPTS, max_new_tokens=12)
+    hits_after_cold = gen.prefix.hits
+    warm = gen.generate(PROMPTS, max_new_tokens=12)
+    assert cold == ref
+    assert warm == ref
+    # Row 1 shares row 0's head even in the cold batch; the warm batch
+    # hits on every row.
+    assert hits_after_cold >= 1
+    assert gen.prefix.hits >= hits_after_cold + 2
+    assert gen.prefix.tokens_saved >= 16 * 2
+
+
+def test_generator_parity_across_bucket_migration(params):
+    """Generation long enough to migrate the KV cache across buckets
+    (32 -> 64) after prefix blocks were installed: installed rows must
+    survive the pad-grow like any other prefilled rows."""
+    kw = dict(cache_buckets=[16, 32, 64])
+    ref = Generator(params, CFG, _gen_config(**kw)).generate(
+        PROMPTS, max_new_tokens=40)
+    gen = Generator(params, CFG, _gen_config(
+        prefix_cache_mb=4, prefix_block=8, **kw))
+    cold = gen.generate(PROMPTS, max_new_tokens=40)
+    warm = gen.generate(PROMPTS, max_new_tokens=40)
+    assert cold == ref and warm == ref
+    assert gen.prefix.hits >= 3
+
+
+def test_generator_parity_after_eviction(params):
+    """A budget below one prompt's worth of blocks forces evictions
+    mid-stream; outputs stay correct (partial/empty matches simply
+    prefill more suffix)."""
+    # One 8-token f32 block of this config's cache is ~4 KiB; ~1.5
+    # blocks of budget guarantees evictions on every insert.
+    gen = Generator(params, CFG, _gen_config(
+        prefix_cache_mb=0.006, prefix_block=8))
+    ref = Generator(params, CFG, _gen_config()).generate(
+        PROMPTS, max_new_tokens=12)
+    for _ in range(3):
+        assert gen.generate(PROMPTS, max_new_tokens=12) == ref
+    assert gen.prefix.evictions > 0
+    assert gen.prefix.bytes <= gen.prefix.capacity_bytes
+
+
+def test_install_compile_budget(params):
+    """One install_prefix compile per cache bucket shape actually
+    reached — the PR-3 compile-budget discipline extended to the
+    prefix path (the jaxpr auditor pins the same bound)."""
+    gen = Generator(params, CFG, _gen_config(
+        prefix_cache_mb=4, prefix_block=8, cache_buckets=[16, 32, 64]))
+    gen.generate(PROMPTS, max_new_tokens=12)
+    gen.generate(PROMPTS, max_new_tokens=12)
+    assert gen.prefix._install._cache_size() <= len(gen.cache_buckets)
+
+
+# ---- batcher-level warm/cold parity -------------------------------------
+
+
+def _run_batch(b, prompts, max_new=8):
+    rids = [b.submit(p, max_new_tokens=max_new) for p in prompts]
+    b.run_until_idle()
+    return [b.result(r) for r in rids]
+
+
+@pytest.mark.parametrize('kv,chunk', [(None, None), (None, 8),
+                                      ('int8', None), ('int8', 8)])
+def test_batcher_warm_cold_parity(params, kv, chunk):
+    """Admission through the prefix-hit path (and the chunked
+    incremental path when prefill_chunk is set) is token-identical to
+    a no-cache batcher, cold and warm, both KV layouts."""
+    kw = dict(kv_cache_dtype=kv, prefill_chunk=chunk)
+    ref = _run_batch(
+        ContinuousBatcher(params, CFG, _gen_config(**kw)), PROMPTS)
+    b = ContinuousBatcher(params, CFG, _gen_config(
+        prefix_cache_mb=4, prefix_block=8, **kw))
+    cold = _run_batch(b, PROMPTS)
+    warm = _run_batch(b, PROMPTS)
+    assert cold == ref, (kv, chunk)
+    assert warm == ref, (kv, chunk)
+    assert b._prefix.hits >= 2
+    assert b._prefix.tokens_saved >= 32
+
+
+def test_batcher_parity_after_eviction(params):
+    """Tiny budget at the batcher level: inserts evict continuously,
+    outputs never change."""
+    ref = _run_batch(
+        ContinuousBatcher(params, CFG, _gen_config()), PROMPTS)
+    b = ContinuousBatcher(params, CFG, _gen_config(
+        prefix_cache_mb=0.006, prefix_block=8))
+    for _ in range(3):
+        assert _run_batch(b, PROMPTS) == ref
+    assert b._prefix.evictions > 0
+    assert b._prefix.bytes <= b._prefix.capacity_bytes
