@@ -2,12 +2,16 @@
 
 `Autoscaler` (:116) -> `_AutoscalerWithHysteresis` (:369) ->
 `RequestRateAutoscaler` (:455) -> `FallbackRequestRateAutoscaler` (:909,
-spot replicas + on-demand base/dynamic fallback).
+spot replicas + on-demand base/dynamic fallback).  `SLOAutoscaler`
+(this repo) scales on the telemetry the serve layer actually promises
+users — p99 TTFT vs an SLO target, queue depth, prefix-cache hit ratio
+— instead of raw QPS.
 
-The controller calls `collect_request_information` with load-balancer QPS
-reports and `generate_scaling_decisions` every `get_decision_interval()`
-seconds; decisions are SCALE_UP/SCALE_DOWN lists applied by the replica
-manager.
+The controller calls `collect_request_information` with load-balancer
+reports (request timestamps, plus `ttft_ms` / `queue_depth` /
+`prefix_hit_ratio` when the reporter has them) and
+`generate_scaling_decisions` every `get_decision_interval()` seconds;
+decisions are SCALE_UP/SCALE_DOWN lists applied by the replica manager.
 """
 from __future__ import annotations
 
@@ -93,6 +97,8 @@ class Autoscaler:
         if spec.base_ondemand_fallback_replicas is not None or \
                 spec.dynamic_ondemand_fallback or spec.spot_placer:
             return FallbackRequestRateAutoscaler(service_name, spec)
+        if spec.target_p99_ttft_ms is not None:
+            return SLOAutoscaler(service_name, spec)
         if spec.autoscaling_enabled:
             return RequestRateAutoscaler(service_name, spec)
         return FixedSizeAutoscaler(service_name, spec)
@@ -237,6 +243,9 @@ class RequestRateAutoscaler(_AutoscalerWithHysteresis):
         self.target_qps_per_replica = spec.target_qps_per_replica
         self.qps_window_size = QPS_WINDOW_SIZE_SECONDS
         self.request_timestamps: List[float] = []
+        # Earliest request ever seen: cold-start QPS must divide by the
+        # time traffic has actually been flowing, not the full window.
+        self._first_request_ts: Optional[float] = None
 
     def update_version(self, version: int, spec: 'ServiceSpec') -> None:
         super().update_version(version, spec)
@@ -246,7 +255,13 @@ class RequestRateAutoscaler(_AutoscalerWithHysteresis):
     def collect_request_information(
             self, request_data: Dict[str, Any]) -> None:
         """Consume a LB report: {'timestamps': [unix seconds, ...]}."""
-        self.request_timestamps.extend(request_data.get('timestamps', []))
+        incoming = request_data.get('timestamps', [])
+        if incoming:
+            earliest = min(incoming)
+            if self._first_request_ts is None or \
+                    earliest < self._first_request_ts:
+                self._first_request_ts = earliest
+        self.request_timestamps.extend(incoming)
         cutoff = time.time() - self.qps_window_size
         index = 0
         for index, ts in enumerate(self.request_timestamps):
@@ -257,9 +272,18 @@ class RequestRateAutoscaler(_AutoscalerWithHysteresis):
         self.request_timestamps = self.request_timestamps[index:]
 
     def current_qps(self) -> float:
-        cutoff = time.time() - self.qps_window_size
+        now = time.time()
+        cutoff = now - self.qps_window_size
         recent = [t for t in self.request_timestamps if t >= cutoff]
-        return len(recent) / self.qps_window_size
+        # Cold-start clamp: a service up for seconds has only seconds
+        # of traffic — dividing by the full window underestimates QPS
+        # by window/elapsed and suppresses the initial scale-up.  Floor
+        # at 1s so a single instantaneous burst doesn't read as
+        # infinite QPS.
+        window = float(self.qps_window_size)
+        if self._first_request_ts is not None:
+            window = min(window, max(now - self._first_request_ts, 1.0))
+        return len(recent) / window
 
     def _calculate_target_num_replicas(self) -> int:
         return math.ceil(self.current_qps() / self.target_qps_per_replica)
@@ -282,6 +306,146 @@ class RequestRateAutoscaler(_AutoscalerWithHysteresis):
         out = super().info()
         out['qps'] = round(self.current_qps(), 3)
         return out
+
+
+def _percentile(samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (exact, no interpolation — determinism
+    matters more than smoothness for SLO decisions)."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    rank = max(1, math.ceil(q * len(ordered)))
+    return ordered[rank - 1]
+
+
+# SLOAutoscaler defaults: queue depth a replica can hold before it
+# counts as pressure, and the pressure band that triggers scaling.
+DEFAULT_TARGET_QUEUE_DEPTH_PER_REPLICA = 4.0
+SLO_PRESSURE_CAP = 2.0          # max growth factor per decision
+SLO_DOWNSCALE_PRESSURE = 0.5    # scale down only below half capacity
+WARM_CACHE_HIT_RATIO = 0.5      # hit ratio above which down-steps slow
+
+
+class SLOAutoscaler(_AutoscalerWithHysteresis):
+    """Scale on the latency SLO, not on raw QPS.
+
+    Consumes the PR 1 telemetry stream via LB/simulator reports:
+
+    - ``ttft_ms``: per-request time-to-first-token samples since the
+      last report (the LB observes these at the first proxied body
+      chunk; the traffic simulator computes them in virtual time).
+    - ``queue_depth``: requests queued fleet-wide (admission backlog).
+    - ``prefix_hit_ratio``: fleet prefix-cache hit ratio (0..1).
+
+    Each decision pass computes a *pressure*::
+
+        pressure = max(p99_ttft / target_p99_ttft,
+                       queue_depth / (target * queue_per_replica))
+
+    and proposes ``ceil(target * clamp(pressure, 0, 2))`` replicas —
+    multiplicative like Kubernetes' HPA, so a 2x breach asks for 2x
+    capacity in one step instead of creeping one replica per interval.
+    `_AutoscalerWithHysteresis` still gates the move: a breach must
+    persist `upscale_delay_seconds` worth of consecutive decisions (and
+    a clear `downscale_delay_seconds`) before the fleet changes.
+
+    Cache-warmth conservatism: when ``prefix_hit_ratio`` is above
+    ``WARM_CACHE_HIT_RATIO`` the fleet's radix caches are doing real
+    work, and killing a replica cold-starts every session hashed onto
+    it — so scale-DOWN is limited to one replica per decision instead
+    of jumping to the computed target.
+
+    The TTFT sample window is one decision interval: samples are
+    consumed by the pass that reads them, so "sustained breach" means
+    N consecutive breached windows, not one stale spike replayed N
+    times.
+    """
+
+    # Bound on buffered samples between decisions (heavy open-loop
+    # bursts can report thousands per interval; p99 over 4096 is ample).
+    MAX_TTFT_SAMPLES = 4096
+
+    def __init__(self, service_name: str, spec: 'ServiceSpec') -> None:
+        super().__init__(service_name, spec)
+        assert spec.target_p99_ttft_ms is not None
+        self.target_p99_ttft_ms = float(spec.target_p99_ttft_ms)
+        self.target_queue_depth_per_replica = float(
+            spec.target_queue_depth_per_replica
+            or DEFAULT_TARGET_QUEUE_DEPTH_PER_REPLICA)
+        self._ttft_ms: List[float] = []
+        self._queue_depth = 0.0
+        self._prefix_hit_ratio: Optional[float] = None
+        self._last_p99_ttft_ms: Optional[float] = None
+
+    def update_version(self, version: int, spec: 'ServiceSpec') -> None:
+        super().update_version(version, spec)
+        if spec.target_p99_ttft_ms is not None:
+            self.target_p99_ttft_ms = float(spec.target_p99_ttft_ms)
+        if spec.target_queue_depth_per_replica is not None:
+            self.target_queue_depth_per_replica = float(
+                spec.target_queue_depth_per_replica)
+
+    def collect_request_information(
+            self, request_data: Dict[str, Any]) -> None:
+        self._ttft_ms.extend(
+            float(v) for v in request_data.get('ttft_ms', []))
+        if len(self._ttft_ms) > self.MAX_TTFT_SAMPLES:
+            self._ttft_ms = self._ttft_ms[-self.MAX_TTFT_SAMPLES:]
+        # Reporters send None for "no signal yet" (e.g. a fleet whose
+        # prefix caches saw no traffic): treat it as absent, not 0.0.
+        if request_data.get('queue_depth') is not None:
+            self._queue_depth = float(request_data['queue_depth'])
+        if request_data.get('prefix_hit_ratio') is not None:
+            self._prefix_hit_ratio = float(
+                request_data['prefix_hit_ratio'])
+
+    def _pressure(self) -> float:
+        p99 = _percentile(self._ttft_ms, 0.99)
+        self._last_p99_ttft_ms = p99
+        ttft_ratio = 0.0 if p99 is None else p99 / self.target_p99_ttft_ms
+        capacity = max(self.target_num_replicas, 1) * \
+            self.target_queue_depth_per_replica
+        queue_ratio = self._queue_depth / capacity
+        return min(max(ttft_ratio, queue_ratio), SLO_PRESSURE_CAP)
+
+    def _calculate_target_num_replicas(self) -> int:
+        pressure = self._pressure()
+        # Window = one decision interval: consume the samples.
+        self._ttft_ms = []
+        current = self.target_num_replicas
+        if pressure > 1.0:
+            return math.ceil(current * pressure)
+        if pressure >= SLO_DOWNSCALE_PRESSURE:
+            return current    # inside the SLO band: hold
+        desired = math.ceil(current * pressure / SLO_DOWNSCALE_PRESSURE)
+        if (self._prefix_hit_ratio or 0.0) >= WARM_CACHE_HIT_RATIO:
+            # Warm fleet: shed at most one replica per decision.
+            desired = max(desired, current - 1)
+        return desired
+
+    def info(self) -> Dict[str, Any]:
+        out = super().info()
+        out.update({
+            'target_p99_ttft_ms': self.target_p99_ttft_ms,
+            'last_p99_ttft_ms': self._last_p99_ttft_ms,
+            'queue_depth': self._queue_depth,
+            'prefix_hit_ratio': self._prefix_hit_ratio,
+        })
+        return out
+
+    def generate_scaling_decisions(
+            self, replicas: List[Dict[str, Any]]
+    ) -> List[AutoscalerDecision]:
+        self._apply_hysteresis()
+        target = self.get_final_target_num_replicas()
+        alive = [r for r in replicas if not r['status'].is_terminal()]
+        if len(alive) < target:
+            return self._record(_scale_up(target - len(alive)))
+        if len(alive) > target:
+            return self._record(_scale_down_ids(
+                select_replicas_to_scale_down(
+                    alive, len(alive) - target)))
+        return self._record([])
 
 
 class FallbackRequestRateAutoscaler(RequestRateAutoscaler):

@@ -47,11 +47,16 @@ class ServeController:
 
     # --- load balancer interface (reference: /controller/load_balancer_sync)
 
-    def lb_sync(self, request_timestamps: List[float]) -> List[str]:
-        """LB reports request timestamps; returns ready replica URLs."""
+    def lb_sync(self, request_timestamps: List[float],
+                report: Optional[Dict[str, Any]] = None) -> List[str]:
+        """LB reports request timestamps — plus, when it has them, SLO
+        telemetry (`ttft_ms` samples, `prefix_hit_ratio`) consumed by
+        SLOAutoscaler; returns ready replica URLs."""
+        data: Dict[str, Any] = {'timestamps': request_timestamps}
+        if report:
+            data.update(report)
         with self._lock:
-            self.autoscaler.collect_request_information(
-                {'timestamps': request_timestamps})
+            self.autoscaler.collect_request_information(data)
         return self.manager.ready_urls()
 
     # --- control loop ---

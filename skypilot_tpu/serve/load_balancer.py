@@ -10,10 +10,11 @@ shim around the same two calls.
 from __future__ import annotations
 
 import asyncio
+import json
 import threading
 import time
 import typing
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
@@ -39,6 +40,10 @@ class SkyServeLoadBalancer:
         self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
         self.sync_interval = sync_interval
         self.request_timestamps: List[float] = []
+        # Per-request TTFT samples (ms) observed at the first proxied
+        # body chunk; drained into the controller report each sync so
+        # SLOAutoscaler sees one decision interval's worth at a time.
+        self.ttft_ms_samples: List[float] = []
         self._ts_lock = threading.Lock()
         self._runner = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -51,17 +56,51 @@ class SkyServeLoadBalancer:
         with self._ts_lock:
             timestamps, self.request_timestamps = \
                 self.request_timestamps, []
-        ready = self.controller.lb_sync(timestamps)
+            ttfts, self.ttft_ms_samples = self.ttft_ms_samples, []
+        report: Dict[str, Any] = {}
+        if ttfts:
+            report['ttft_ms'] = ttfts
+        hits = getattr(self.policy, 'affinity_hits', None)
+        misses = getattr(self.policy, 'affinity_misses', None)
+        if hits is not None and (hits + misses) > 0:
+            report['prefix_hit_ratio'] = hits / (hits + misses)
+        ready = self.controller.lb_sync(timestamps, report or None)
         self.policy.set_ready_replicas(ready)
 
     # --- proxy ---
+
+    @staticmethod
+    def _request_context(body: bytes) -> Optional[Dict[str, Any]]:
+        """Extract routing context from a JSON request body: the
+        `prompt` (completions) or concatenated `messages` content
+        (chat) — what `prefix_affinity` fingerprints.  Non-JSON bodies
+        route context-free (least-load path)."""
+        if not body:
+            return None
+        try:
+            payload = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        prompt = payload.get('prompt')
+        if prompt is None and isinstance(payload.get('messages'), list):
+            prompt = ''.join(
+                str(m.get('content', '')) for m in payload['messages']
+                if isinstance(m, dict))
+        if isinstance(prompt, str) or (
+                isinstance(prompt, list) and
+                all(isinstance(t, int) for t in prompt)):
+            return {'prompt': prompt}
+        return None
 
     async def _handle(self, request):
         import aiohttp
         from aiohttp import web
         with self._ts_lock:
             self.request_timestamps.append(time.time())
-        url = self.policy.select_replica()
+        body = await request.read()
+        url = self.policy.select_replica(self._request_context(body))
         if url is None:
             # Cold start / stale set: resync before failing (a replica may
             # have become READY since the last interval sync).
@@ -70,7 +109,7 @@ class SkyServeLoadBalancer:
                     None, self.sync_once)
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(f'On-demand LB sync failed: {e}')
-            url = self.policy.select_replica()
+            url = self.policy.select_replica(self._request_context(body))
         if url is None:
             return web.Response(
                 status=503,
@@ -85,7 +124,7 @@ class SkyServeLoadBalancer:
                 async with sess.request(
                         request.method, target,
                         headers=request.headers.copy(),
-                        data=await request.read(),
+                        data=body,
                         allow_redirects=False) as resp:
                     headers = {k: v for k, v in resp.headers.items()
                                if k.lower() not in
@@ -97,7 +136,18 @@ class SkyServeLoadBalancer:
                     out = web.StreamResponse(status=resp.status,
                                              headers=headers)
                     await out.prepare(request)
+                    first_chunk = True
                     async for chunk in resp.content.iter_chunked(16384):
+                        if first_chunk:
+                            # TTFT: request in -> first body byte out.
+                            # Feeds the LB histogram and (via sync_once)
+                            # SLOAutoscaler's p99 window.
+                            first_chunk = False
+                            ttft = time.perf_counter() - start
+                            telemetry_metrics.SERVE_LB_TTFT_SECONDS \
+                                .observe(ttft)
+                            with self._ts_lock:
+                                self.ttft_ms_samples.append(ttft * 1000.0)
                         await out.write(chunk)
                     await out.write_eof()
                     return out

@@ -44,6 +44,11 @@ class ServiceSpec:
     max_replicas: Optional[int] = None
     num_overprovision: Optional[int] = None
     target_qps_per_replica: Optional[float] = None
+    # SLO-driven autoscaling (serve/autoscalers.py:SLOAutoscaler):
+    # scale on p99 time-to-first-token vs this target (ms) plus queue
+    # depth and prefix-cache hit ratio, instead of raw QPS.
+    target_p99_ttft_ms: Optional[float] = None
+    target_queue_depth_per_replica: Optional[float] = None
     upscale_delay_seconds: int = DEFAULT_UPSCALE_DELAY_SECONDS
     downscale_delay_seconds: int = DEFAULT_DOWNSCALE_DELAY_SECONDS
     base_ondemand_fallback_replicas: Optional[int] = None
@@ -62,16 +67,28 @@ class ServiceSpec:
             raise exceptions.InvalidServiceSpecError(
                 'max_replicas must be >= min_replicas; got '
                 f'min={self.min_replicas}, max={self.max_replicas}')
-        if self.target_qps_per_replica is not None:
+        if self.autoscaling_enabled:
             if self.max_replicas is None:
                 raise exceptions.InvalidServiceSpecError(
-                    'max_replicas must be set when target_qps_per_replica '
-                    'is set.')
+                    'max_replicas must be set when autoscaling '
+                    '(target_qps_per_replica or target_p99_ttft_ms) '
+                    'is enabled.')
         elif self.max_replicas is not None and \
                 self.max_replicas != self.min_replicas:
             raise exceptions.InvalidServiceSpecError(
                 'min_replicas != max_replicas requires '
-                'target_qps_per_replica to enable autoscaling.')
+                'target_qps_per_replica or target_p99_ttft_ms to '
+                'enable autoscaling.')
+        if self.target_p99_ttft_ms is not None and \
+                self.target_p99_ttft_ms <= 0:
+            raise exceptions.InvalidServiceSpecError(
+                f'target_p99_ttft_ms must be positive, got '
+                f'{self.target_p99_ttft_ms}')
+        if self.target_queue_depth_per_replica is not None and \
+                self.target_queue_depth_per_replica <= 0:
+            raise exceptions.InvalidServiceSpecError(
+                f'target_queue_depth_per_replica must be positive, got '
+                f'{self.target_queue_depth_per_replica}')
         from skypilot_tpu.serve import load_balancing_policies as lb
         if self.load_balancing_policy is not None and \
                 self.load_balancing_policy not in lb.LB_POLICIES:
@@ -88,7 +105,8 @@ class ServiceSpec:
 
     @property
     def autoscaling_enabled(self) -> bool:
-        return self.target_qps_per_replica is not None
+        return self.target_qps_per_replica is not None or \
+            self.target_p99_ttft_ms is not None
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -118,6 +136,12 @@ class ServiceSpec:
             target_qps_per_replica=(
                 float(policy['target_qps_per_replica'])
                 if 'target_qps_per_replica' in policy else None),
+            target_p99_ttft_ms=(
+                float(policy['target_p99_ttft_ms'])
+                if 'target_p99_ttft_ms' in policy else None),
+            target_queue_depth_per_replica=(
+                float(policy['target_queue_depth_per_replica'])
+                if 'target_queue_depth_per_replica' in policy else None),
             upscale_delay_seconds=int(
                 policy.get('upscale_delay_seconds',
                            DEFAULT_UPSCALE_DELAY_SECONDS)),
@@ -146,7 +170,8 @@ class ServiceSpec:
             probe['headers'] = self.readiness_headers
         policy: Dict[str, Any] = {'min_replicas': self.min_replicas}
         for key in ('max_replicas', 'num_overprovision',
-                    'target_qps_per_replica',
+                    'target_qps_per_replica', 'target_p99_ttft_ms',
+                    'target_queue_depth_per_replica',
                     'base_ondemand_fallback_replicas',
                     'dynamic_ondemand_fallback', 'spot_placer'):
             val = getattr(self, key)

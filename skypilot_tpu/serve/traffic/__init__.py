@@ -1,0 +1,37 @@
+"""Serve-traffic subsystem: prefix-affinity routing primitives, a seeded
+open-loop traffic generator, and a virtual-time fleet simulator.
+
+Three pillars (ROADMAP item 3, the "million-user" serve layer):
+
+- `hashring`: consistent hashing with bounded loads — the placement
+  primitive behind the `prefix_affinity` load-balancing policy
+  (serve/load_balancing_policies.py registers the policy itself).
+- `generator`: a fully seeded arrival-process generator (Poisson base
+  rate modulated by Gamma-length burst episodes, heavy-tailed
+  prompt/output lengths, a session model with shared prompt heads) —
+  no wall-clock dependence, so the same seed always yields the same
+  trace.
+- `simulator`: an open-loop fleet simulator where every replica is a
+  REAL `ContinuousBatcher` (CPU debug shapes) and time is virtual
+  (a deterministic token-cost model), emitting the SERVE_SUMMARY
+  fields: p50/p99 TTFT, TPOT, goodput-under-SLO, affinity and
+  prefix-cache hit ratios.
+
+`simulator` imports jax (via the inference engine); it is loaded
+lazily so `from skypilot_tpu.serve.traffic import generator` stays
+cheap on control-plane-only processes.
+"""
+from skypilot_tpu.serve.traffic.generator import (Arrival, TrafficConfig,
+                                                  generate_trace)
+from skypilot_tpu.serve.traffic.hashring import (ConsistentHashRing,
+                                                 stable_hash)
+
+__all__ = ['Arrival', 'ConsistentHashRing', 'FleetSimulator', 'SimConfig',
+           'TrafficConfig', 'generate_trace', 'stable_hash']
+
+
+def __getattr__(name):
+    if name in ('FleetSimulator', 'SimConfig'):
+        from skypilot_tpu.serve.traffic import simulator
+        return getattr(simulator, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,395 @@
+"""Open-loop virtual-time fleet simulator.
+
+Drives REAL `ContinuousBatcher` replicas (infer/serving.py) — the
+actual admission path, grouped prefill, radix prefix-cache install and
+lockstep decode all execute on CPU debug shapes — but accounts time
+with a deterministic token-cost model instead of the wall clock:
+
+    step_cost = step_overhead_s
+              + prefill_tokens * prefill_cost_per_token_s
+              + decode_tokens  * decode_cost_per_token_s
+
+where prefill/decode token counts are integer deltas observed from the
+batcher (prefix-cache `tokens_saved` shrinks the prefill charge — a
+warm head really is cheaper).  Wall-clock never enters the summary, so
+the same `TrafficConfig` seed and `SimConfig` always produce the same
+SERVE_SUMMARY, on any machine (the acceptance bar for `bench_serve`).
+
+Open-loop means arrivals are fixed in advance by the trace: an
+overloaded fleet builds queues (and its TTFT tail blows up) instead of
+throttling the generator — the regime where routing policy and
+autoscaling actually matter.
+
+The simulator routes through a real `LoadBalancingPolicy` (the object
+under test) and can optionally feed an `Autoscaler` with the same
+virtual-time reports the load balancer sends the controller
+(`ttft_ms` / `queue_depth` / `prefix_hit_ratio`), applying its
+SCALE_UP/SCALE_DOWN decisions as live replica churn.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.traffic.generator import (Arrival, TrafficConfig,
+                                                  generate_trace)
+
+
+@dataclasses.dataclass
+class SimConfig:
+    """Fleet + cost-model knobs (all time is VIRTUAL seconds)."""
+    policy: str = 'least_load'
+    num_replicas: int = 2
+    # SERVE_SUMMARY goodput counts completions whose TTFT met this SLO.
+    slo_ttft_s: float = 2.0
+    # Fleet scheduling quantum: arrivals dispatch and replicas catch up
+    # once per tick.  Smaller = finer TTFT resolution, more host loops.
+    tick_s: float = 0.25
+    # Token-cost model (the determinism contract: costs are charged
+    # from integer token-count deltas, never from the wall clock).
+    prefill_cost_per_token_s: float = 1e-3
+    decode_cost_per_token_s: float = 2e-3
+    step_overhead_s: float = 5e-3
+    # Replica engine shape (LLAMA_DEBUG scale, CPU-friendly).
+    batch_size: int = 4
+    max_seq_len: int = 256
+    decode_chunk: int = 4
+    prefix_cache_mb: Optional[float] = 4.0
+    prefix_block: int = 64
+    # prefix_affinity bounded-load factor (ignored by other policies).
+    load_factor: float = 1.25
+    model_seed: int = 0
+    # Seeds the tie-break RNG the policies use, so routing (and hence
+    # the whole summary) is reproducible.
+    route_seed: int = 0
+    max_ticks: int = 200_000
+
+    def __post_init__(self) -> None:
+        if self.num_replicas < 1:
+            raise ValueError(f'num_replicas must be >= 1, '
+                             f'got {self.num_replicas}')
+        if self.tick_s <= 0:
+            raise ValueError(f'tick_s must be positive, got {self.tick_s}')
+        for field in ('prefill_cost_per_token_s', 'decode_cost_per_token_s',
+                      'step_overhead_s'):
+            if getattr(self, field) < 0:
+                raise ValueError(f'{field} must be >= 0')
+
+
+@dataclasses.dataclass
+class _ReqRecord:
+    arrival_t: float
+    prompt_len: int
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+    out_len: int = 0
+
+
+class _ReplicaSim:
+    """One replica: a real ContinuousBatcher plus a virtual clock."""
+
+    def __init__(self, replica_id: int, url: str, batcher,
+                 cfg: SimConfig) -> None:
+        self.replica_id = replica_id
+        self.url = url
+        self.batcher = batcher
+        self.cfg = cfg
+        self.vclock = 0.0
+        self.draining = False
+        self.records: Dict[int, _ReqRecord] = {}
+        self.inflight: List[int] = []
+        # TTFT samples (virtual seconds) not yet reported fleet-side.
+        self.fresh_ttfts: List[float] = []
+
+    @property
+    def busy(self) -> bool:
+        return self.batcher.num_active > 0 or self.batcher.num_queued > 0
+
+    def submit(self, arrival: Arrival, now: float) -> None:
+        # An idle replica's clock has nothing to do before the request
+        # exists; work can never be charged to the past.
+        self.vclock = max(self.vclock, now)
+        rid = self.batcher.submit(arrival.prompt,
+                                  max_new_tokens=arrival.max_new_tokens)
+        self.records[rid] = _ReqRecord(arrival_t=arrival.t,
+                                       prompt_len=len(arrival.prompt))
+        self.inflight.append(rid)
+
+    def advance(self, now: float,
+                on_complete: Callable[['_ReplicaSim', int, _ReqRecord],
+                                      None]) -> None:
+        """Catch the replica up to fleet time `now`: step the batcher,
+        charging the cost model, while it has work and is behind."""
+        while self.busy and self.vclock <= now:
+            self._step_once(on_complete)
+
+    def _step_once(self, on_complete) -> None:
+        batcher = self.batcher
+        pre_out = {rid: len(batcher._requests[rid].out)
+                   for rid in self.inflight}
+        pc = batcher._prefix
+        pre_saved = pc.tokens_saved if pc is not None else 0
+        batcher.step()
+        saved_delta = (pc.tokens_saved - pre_saved) if pc is not None else 0
+        newly_first: List[int] = []
+        decode_tokens = 0
+        for rid in self.inflight:
+            out_len = len(batcher._requests[rid].out)
+            delta = out_len - pre_out[rid]
+            if pre_out[rid] == 0 and out_len > 0:
+                newly_first.append(rid)
+                delta -= 1    # the first token comes from the prefill
+            decode_tokens += delta
+        prefill_tokens = max(
+            0, sum(self.records[rid].prompt_len for rid in newly_first)
+            - saved_delta)
+        self.vclock += (self.cfg.step_overhead_s
+                        + prefill_tokens * self.cfg.prefill_cost_per_token_s
+                        + decode_tokens * self.cfg.decode_cost_per_token_s)
+        for rid in newly_first:
+            rec = self.records[rid]
+            rec.first_token_t = self.vclock
+            self.fresh_ttfts.append(self.vclock - rec.arrival_t)
+        still: List[int] = []
+        for rid in self.inflight:
+            if batcher.is_done(rid):
+                rec = self.records[rid]
+                rec.done_t = self.vclock
+                rec.out_len = len(batcher.result(rid))
+                on_complete(self, rid, rec)
+            else:
+                still.append(rid)
+        self.inflight = still
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    ordered = sorted(samples)
+    rank = max(1, math.ceil(q * len(ordered)))
+    return ordered[rank - 1]
+
+
+class FleetSimulator:
+    """Replica fleet + policy + trace -> deterministic SERVE_SUMMARY."""
+
+    def __init__(self, sim_cfg: Optional[SimConfig] = None,
+                 traffic_cfg: Optional[TrafficConfig] = None) -> None:
+        import jax
+
+        from skypilot_tpu.infer.engine import GeneratorConfig
+        from skypilot_tpu.models import llama
+
+        self.cfg = sim_cfg or SimConfig()
+        self.traffic = traffic_cfg or TrafficConfig()
+        self.model_config = llama.LLAMA_DEBUG
+        if self.traffic.vocab_size > self.model_config.vocab_size:
+            raise ValueError(
+                f'traffic vocab_size {self.traffic.vocab_size} exceeds '
+                f'model vocab_size {self.model_config.vocab_size}')
+        # ONE param tree shared read-only by every replica: per-replica
+        # weights would multiply host memory for no behavioral gain.
+        self.params = llama.init_params(
+            self.model_config, jax.random.PRNGKey(self.cfg.model_seed))
+        # eos_token=None: random debug weights would hit an arbitrary
+        # eos at a weight-dependent step; without one, every request
+        # emits exactly max_new_tokens — the cost model stays a pure
+        # function of the trace.
+        self.gen = GeneratorConfig(
+            max_seq_len=self.cfg.max_seq_len,
+            batch_size=self.cfg.batch_size,
+            temperature=0.0,
+            prefix_cache_mb=self.cfg.prefix_cache_mb,
+            prefix_block=self.cfg.prefix_block)
+        if self.cfg.policy == 'prefix_affinity':
+            self.policy: lb_policies.LoadBalancingPolicy = \
+                lb_policies.PrefixAffinityPolicy(
+                    prefix_block=self.cfg.prefix_block,
+                    load_factor=self.cfg.load_factor)
+        else:
+            self.policy = lb_policies.LoadBalancingPolicy.make(
+                self.cfg.policy)
+        self._ids = itertools.count(0)
+        self.replicas: List[_ReplicaSim] = []
+        self.retired: List[_ReplicaSim] = []
+        self.completed: List[_ReqRecord] = []
+        self.dropped = 0
+        self.scale_events: List[Any] = []
+        self._report_ttfts: List[float] = []
+        for _ in range(self.cfg.num_replicas):
+            self.add_replica()
+
+    # ---- fleet membership ------------------------------------------------
+    def add_replica(self) -> str:
+        from skypilot_tpu.infer.serving import ContinuousBatcher
+        rid = next(self._ids)
+        url = f'replica-{rid}'
+        batcher = ContinuousBatcher(self.params, self.model_config,
+                                    self.gen,
+                                    decode_chunk=self.cfg.decode_chunk)
+        self.replicas.append(_ReplicaSim(rid, url, batcher, self.cfg))
+        self._sync_policy()
+        return url
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Mark a replica DRAINING: it stops receiving new requests but
+        finishes its in-flight work, then retires once idle."""
+        for rep in self.replicas:
+            if rep.replica_id == replica_id and not rep.draining:
+                rep.draining = True
+                self._sync_policy()
+                return
+        raise ValueError(f'No live replica with id {replica_id}')
+
+    def _live(self) -> List[_ReplicaSim]:
+        return [r for r in self.replicas if not r.draining]
+
+    def _sync_policy(self) -> None:
+        self.policy.set_ready_replicas([r.url for r in self._live()])
+
+    # ---- run loop --------------------------------------------------------
+    def run(self, autoscaler=None) -> Dict[str, Any]:
+        """Play the trace to completion; returns the summary dict.
+
+        With `autoscaler`, every `get_decision_interval()` VIRTUAL
+        seconds the fleet sends it the same report shape the load
+        balancer sends the controller, then applies its decisions as
+        replica churn (scale-down drains; scale-up pays cold caches —
+        exactly the dynamics SLOAutoscaler's conservatism is about).
+        """
+        arrivals = generate_trace(self.traffic)
+        by_url = {r.url: r for r in self.replicas}
+        # Policy tie-breaks draw from the module RNG; pin it for the
+        # run (and restore after) so summaries are reproducible.
+        rng_state = random.getstate()
+        random.seed(self.cfg.route_seed)
+        try:
+            now = 0.0
+            idx = 0
+            next_decision = (float(autoscaler.get_decision_interval())
+                             if autoscaler is not None else None)
+            for tick in range(self.cfg.max_ticks):
+                if idx >= len(arrivals) and \
+                        not any(r.busy for r in self.replicas):
+                    break
+                now += self.cfg.tick_s
+                while idx < len(arrivals) and arrivals[idx].t <= now:
+                    self._dispatch(arrivals[idx], by_url)
+                    idx += 1
+                for rep in self.replicas:
+                    rep.advance(now, self._on_complete)
+                    self._report_ttfts.extend(rep.fresh_ttfts)
+                    rep.fresh_ttfts = []
+                for rep in [r for r in self.replicas
+                            if r.draining and not r.busy]:
+                    self.replicas.remove(rep)
+                    self.retired.append(rep)
+                if autoscaler is not None and now >= next_decision:
+                    self._autoscale_tick(autoscaler, now, by_url)
+                    next_decision = now + autoscaler.get_decision_interval()
+            else:
+                raise RuntimeError(
+                    f'Simulation exceeded max_ticks={self.cfg.max_ticks} '
+                    f'(fleet cannot drain the trace)')
+            return self.summary(makespan=now)
+        finally:
+            random.setstate(rng_state)
+
+    def _dispatch(self, arrival: Arrival,
+                  by_url: Dict[str, _ReplicaSim]) -> None:
+        url = self.policy.select_replica({'prompt': arrival.prompt})
+        if url is None:
+            raise RuntimeError('No ready replicas to route to')
+        self.policy.pre_execute_hook(url)
+        by_url[url].submit(arrival, now=arrival.t)
+
+    def _on_complete(self, rep: _ReplicaSim, rid: int,
+                     rec: _ReqRecord) -> None:
+        del rid  # identified by record
+        self.policy.post_execute_hook(rep.url)
+        self.completed.append(rec)
+
+    def _autoscale_tick(self, autoscaler, now: float,
+                        by_url: Dict[str, _ReplicaSim]) -> None:
+        autoscaler.collect_request_information({
+            'ttft_ms': [t * 1000.0 for t in self._report_ttfts],
+            'queue_depth': sum(r.batcher.num_queued
+                               for r in self._live()),
+            'prefix_hit_ratio': self.prefix_hit_ratio(),
+        })
+        self._report_ttfts = []
+        infos = [{'replica_id': r.replica_id,
+                  'status': ReplicaStatus.READY,
+                  'launched_at': r.replica_id,
+                  'is_spot': False} for r in self._live()]
+        from skypilot_tpu.serve.autoscalers import \
+            AutoscalerDecisionOperator
+        for decision in autoscaler.generate_scaling_decisions(infos):
+            if decision.operator is AutoscalerDecisionOperator.SCALE_UP:
+                url = self.add_replica()
+                by_url[url] = self.replicas[-1]
+            else:
+                self.remove_replica(decision.target)
+        self.scale_events.append(
+            {'t': round(now, 3), 'replicas': len(self._live())})
+
+    # ---- metrics ---------------------------------------------------------
+    def prefix_hit_ratio(self) -> Optional[float]:
+        hits = misses = 0
+        for rep in self.replicas + self.retired:
+            pc = rep.batcher._prefix
+            if pc is not None:
+                hits += pc.hits
+                misses += pc.misses
+        if hits + misses == 0:
+            return None
+        return hits / (hits + misses)
+
+    def summary(self, makespan: Optional[float] = None) -> Dict[str, Any]:
+        recs = self.completed
+        ttfts = [r.first_token_t - r.arrival_t for r in recs
+                 if r.first_token_t is not None]
+        tpots = [(r.done_t - r.first_token_t) / (r.out_len - 1)
+                 for r in recs
+                 if r.first_token_t is not None and r.out_len > 1]
+        span = makespan
+        if span is None:
+            span = max((r.done_t for r in recs if r.done_t is not None),
+                       default=0.0)
+        met = sum(1 for r in recs
+                  if r.first_token_t is not None and
+                  r.first_token_t - r.arrival_t <= self.cfg.slo_ttft_s)
+        hits = getattr(self.policy, 'affinity_hits', None)
+        misses = getattr(self.policy, 'affinity_misses', None)
+        affinity = None
+        if hits is not None and (hits + misses) > 0:
+            affinity = hits / (hits + misses)
+        tokens_saved = sum(
+            rep.batcher._prefix.tokens_saved
+            for rep in self.replicas + self.retired
+            if rep.batcher._prefix is not None)
+
+        def _round(value):
+            return None if value is None else round(value, 6)
+
+        return {
+            'policy': self.policy.name,
+            'requests': len(recs),
+            'makespan_s': _round(span),
+            'ttft_p50_ms': _round(
+                _percentile(ttfts, 0.50) * 1000 if ttfts else None),
+            'ttft_p99_ms': _round(
+                _percentile(ttfts, 0.99) * 1000 if ttfts else None),
+            'tpot_ms': _round(
+                sum(tpots) / len(tpots) * 1000 if tpots else None),
+            'goodput_rps': _round(met / span if span else 0.0),
+            'slo_attainment': _round(met / len(recs) if recs else None),
+            'affinity_hit_ratio': _round(affinity),
+            'prefix_hit_ratio': _round(self.prefix_hit_ratio()),
+            'prefix_tokens_saved': tokens_saved,
+            'replicas': len(self._live()),
+            'scale_events': self.scale_events,
+        }

@@ -235,6 +235,40 @@ SERVE_REPLICAS_READY = prometheus_client.Gauge(
     ['service'],
     registry=REGISTRY)
 
+SERVE_LB_SELECTIONS = prometheus_client.Counter(
+    'skytpu_serve_lb_selections_total',
+    'Replica selections made by the load-balancing policy, per policy '
+    'name (every select_replica that returned a replica)',
+    ['policy'],
+    registry=REGISTRY)
+
+SERVE_REPLICA_INFLIGHT = prometheus_client.Gauge(
+    'skytpu_serve_replica_inflight',
+    'In-flight requests per replica as the LB policy sees them '
+    '(pre/post execute hook accounting)',
+    ['replica'],
+    registry=REGISTRY)
+
+SERVE_AFFINITY_HITS = prometheus_client.Counter(
+    'skytpu_serve_affinity_hits_total',
+    'prefix_affinity selections that landed on the fingerprint\'s '
+    'consistent-hash primary owner (warm-cache routing preserved)',
+    registry=REGISTRY)
+
+SERVE_AFFINITY_MISSES = prometheus_client.Counter(
+    'skytpu_serve_affinity_misses_total',
+    'prefix_affinity selections diverted off the primary owner '
+    '(bounded-load fallback) or carrying no reusable prompt head',
+    registry=REGISTRY)
+
+SERVE_LB_TTFT_SECONDS = prometheus_client.Histogram(
+    'skytpu_serve_lb_ttft_seconds',
+    'Time to first response byte through the LB proxy (request in to '
+    'first body chunk out) — the latency the TTFT SLO is written '
+    'against',
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 60),
+    registry=REGISTRY)
+
 SERVE_AUTOSCALER_DECISIONS = prometheus_client.Counter(
     'skytpu_serve_autoscaler_decisions_total',
     'Autoscaler decisions emitted, per service and operator',

@@ -135,6 +135,8 @@ SERVICE_SCHEMA: Dict[str, Any] = {
                 'min_replicas': {'type': 'integer', 'minimum': 0},
                 'max_replicas': {'type': 'integer', 'minimum': 0},
                 'target_qps_per_replica': {'type': 'number'},
+                'target_p99_ttft_ms': {'type': 'number'},
+                'target_queue_depth_per_replica': {'type': 'number'},
                 'upscale_delay_seconds': {'type': 'number'},
                 'downscale_delay_seconds': {'type': 'number'},
                 'dynamic_ondemand_fallback': {'type': 'boolean'},
