@@ -137,6 +137,8 @@ class AgentClient:
         otherwise answer for the wrong cluster)."""
         deadline = time.time() + timeout
         last_err: Optional[Exception] = None
+        from skypilot_tpu.utils.backoff import Backoff
+        backoff = Backoff(initial=0.2, cap=2.0)
         while time.time() < deadline:
             try:
                 info = self.health()
@@ -152,7 +154,7 @@ class AgentClient:
                     return
             except requests.RequestException as e:
                 last_err = e
-            time.sleep(0.5)
+            backoff.sleep()
         raise exceptions.ClusterNotUpError(
             f'Agent at {self.base_url} not ready: {last_err}')
 

@@ -341,7 +341,11 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
     for t in threads:
         t.start()
 
-    # Monitor: first failure cancels the rest (gang semantics).
+    # Monitor: first failure cancels the rest (gang semantics).  The
+    # jittered backoff keeps kill latency low right after launch while
+    # decaying to a gentler steady-state poll.
+    from skypilot_tpu.utils.backoff import Backoff
+    monitor_backoff = Backoff(initial=0.05, cap=0.25)
     while any(t.is_alive() for t in threads):
         if failed_event.is_set():
             _KILL_INITIATED.set()
@@ -354,7 +358,7 @@ def run_gang(spec: Dict[str, Any], job_table: job_lib.JobTable,
                             pass
             _kill_in_container()
             break
-        time.sleep(0.2)
+        monitor_backoff.sleep()
     for t in threads:
         t.join(timeout=30)
     final = [(_CANCELLED_RC if rc is None else rc) for rc in returncodes]

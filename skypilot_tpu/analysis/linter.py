@@ -89,6 +89,11 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
     Rule('SKY302', 'silent-except',
          'except handler whose body is only pass/continue in a jobs/'
          'serve recovery path — log via sky_logging or re-raise'),
+    Rule('SKY303', 'unbounded-recovery-loop',
+         "'while True' recovery loop (recover/launch retried on "
+         'failure) without a Backoff or attempt bound in a jobs/serve '
+         'recovery path — a capacity stall spins forever instead of '
+         'surfacing a terminal failed-recovery status'),
 ]}
 
 # Modules whose device->host transfers must route through
@@ -432,9 +437,91 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
         self.generic_visit(node)
         self._loop_depth -= 1
 
-    visit_While = _visit_loop
+    def visit_While(self, node: ast.While) -> None:
+        if self.is_recovery:
+            self._check_unbounded_recovery_loop(node)
+        self._visit_loop(node)
+
     visit_For = _visit_loop
     visit_AsyncFor = _visit_loop
+
+    # -- SKY303: unbounded while-True recovery loops ----------------------
+    _RECOVERY_CALL_NAMES = {'launch', 'relaunch', '_launch_once'}
+
+    @staticmethod
+    def _walk_no_defs(node):
+        """Walk a statement's subtree, not descending into nested
+        function/class defs (their loops are their own scope)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            child = stack.pop()
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            yield child
+            stack.extend(ast.iter_child_nodes(child))
+
+    @classmethod
+    def _is_recovery_call(cls, node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        fn = _dotted(node.func) or ''
+        name = fn.rsplit('.', 1)[-1]
+        return 'recover' in name or name in cls._RECOVERY_CALL_NAMES
+
+    def _check_unbounded_recovery_loop(self, node: ast.While) -> None:
+        if not (isinstance(node.test, ast.Constant)
+                and node.test.value is True):
+            return
+        body_nodes = [n for stmt in node.body
+                      for n in [stmt, *self._walk_no_defs(stmt)]]
+        if not any(self._is_recovery_call(n) for n in body_nodes):
+            return
+        # Bounded if the loop references a backoff or an attempt/retry
+        # counter (the bound may live one call down, e.g. inside
+        # strategy.recover(), but then the loop names it).
+        for n in body_nodes:
+            ident = None
+            if isinstance(n, ast.Name):
+                ident = n.id
+            elif isinstance(n, ast.Attribute):
+                ident = n.attr
+            if ident is not None:
+                low = ident.lower()
+                if ('backoff' in low or 'attempt' in low
+                        or 'retries' in low or 'max_recovery' in low
+                        or 'deadline' in low):
+                    return
+        # Shape 1: recovery call inside a try whose except falls
+        # through (no raise/return/break) -> retries forever.
+        unbounded = False
+        for n in body_nodes:
+            if not isinstance(n, ast.Try):
+                continue
+            try_nodes = [m for stmt in n.body
+                         for m in [stmt, *self._walk_no_defs(stmt)]]
+            if not any(self._is_recovery_call(m) for m in try_nodes):
+                continue
+            for handler in n.handlers:
+                handler_nodes = [m for stmt in handler.body
+                                 for m in [stmt,
+                                           *self._walk_no_defs(stmt)]]
+                if not any(isinstance(m, (ast.Raise, ast.Return,
+                                          ast.Break))
+                           for m in handler_nodes):
+                    unbounded = True
+        # Shape 2: bare retry loop with no exit at all.
+        if not unbounded and not any(
+                isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                for n in body_nodes):
+            unbounded = True
+        if unbounded:
+            self.rep.report(
+                node, 'SKY303',
+                "'while True' retries recover/launch without a "
+                'Backoff or attempt bound — cap it with '
+                'max_recovery_attempts + utils.backoff.Backoff and '
+                'surface a terminal failed-recovery status')
 
     # -- rules ------------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:

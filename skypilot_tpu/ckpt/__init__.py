@@ -20,14 +20,15 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from skypilot_tpu.ckpt.format import (CorruptCheckpointError, latest_step,
+from skypilot_tpu.ckpt.format import (CorruptCheckpointError, even_row_shard,
+                                      latest_step, restore_pytree_resharded,
                                       scan_steps)
 from skypilot_tpu.ckpt.manager import CheckpointManager
 from skypilot_tpu.ckpt.writer import AsyncCheckpointWriter
 
 __all__ = ['AsyncCheckpointWriter', 'CheckpointManager',
-           'CorruptCheckpointError', 'latest_step', 'resume_envs',
-           'scan_steps']
+           'CorruptCheckpointError', 'even_row_shard', 'latest_step',
+           'restore_pytree_resharded', 'resume_envs', 'scan_steps']
 
 
 def resume_envs(ckpt_dir: Optional[str]) -> Dict[str, str]:
@@ -35,12 +36,25 @@ def resume_envs(ckpt_dir: Optional[str]) -> Dict[str, str]:
     ``ckpt_dir`` (its ``SKYTPU_CKPT_DIR``).  Empty when the dir is
     unset, not locally visible (e.g. a gs:// URI only mounted on the
     cluster — the agent fills the vars in on-host instead), or holds no
-    committed checkpoint."""
+    committed checkpoint.  Besides the path/step, the WRITER grid of
+    the resume step is published as ``SKYTPU_RESUME_TOPOLOGY`` so a
+    relaunch onto different (e.g. degraded) capacity knows the restore
+    must reshard."""
+    from skypilot_tpu.ckpt import format as format_lib
     from skypilot_tpu.utils import env_contract
     if not ckpt_dir or '://' in ckpt_dir:
         return {}
     step = latest_step(ckpt_dir)
     if step is None:
         return {}
-    return {env_contract.RESUME_CKPT_PATH: ckpt_dir,
+    envs = {env_contract.RESUME_CKPT_PATH: ckpt_dir,
             env_contract.RESUME_STEP: str(step)}
+    try:
+        manifest = format_lib.load_manifest(ckpt_dir, step)
+        envs[env_contract.RESUME_TOPOLOGY] = str(
+            int(manifest.get('process_count', 1)))
+    except CorruptCheckpointError:
+        # Legacy Orbax dirs carry no manifest; topology stays unknown
+        # and the restore side detects the grid from the data itself.
+        pass
+    return envs

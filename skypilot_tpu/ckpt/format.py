@@ -34,6 +34,19 @@ Legacy checkpoints: a ``step_<N>`` dir with neither manifest nor marker
 is an old Orbax checkpoint (Orbax's own tmp-dir naming guarantees a
 plain ``step_<N>`` is complete) — discovery reports it as committed with
 ``fmt='orbax'`` and restore falls back to Orbax.
+
+Manifest v2 (elastic resume): every entry additionally records the
+leaf's **global** shape and the index-slice of the global array this
+shard file covers (``slice``: per-dimension ``[start, stop)`` pairs),
+plus the writer process.  A leaf may therefore be split across several
+shard files (``shard_spec`` on the write path partitions axis 0 across
+processes), and restore assembles any requested window of the global
+array by reading ONLY the shard files that overlap it — so a checkpoint
+written by N processes restores under any M-process grid (grow, shrink,
+down-to-single-host).  v1 manifests carry no ``slice``/``global_shape``
+keys; each entry is read as a single full-coverage shard, so v1
+checkpoints (always whole-leaf round-robin) stay restorable on any
+grid.
 """
 from __future__ import annotations
 
@@ -58,13 +71,17 @@ STEP_PREFIX = 'step_'
 TMP_PREFIX = '.tmp.'
 _STEP_RE = re.compile(r'step_(\d+)$')
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 
 # Chaos hook: tests install a callable(stage, path) that may raise to
 # simulate a crash/kill at a named point of the save protocol.  Stages,
 # in order: 'shard_written' (after each leaf file), 'process_manifest'
 # (after manifest-p<K>.json), 'pre_commit' (merged manifest + marker in
 # the temp dir, rename not yet issued), 'committed' (after the rename).
+# The read side fires reshard stages too: 'reshard_planned' (window
+# computed, nothing read yet), 'reshard_shard_read' (after each shard
+# file), 'reshard_leaf_assembled' (after each leaf window is built),
+# 'reshard_restored' (whole tree assembled).
 _stage_hook: Optional[Callable[[str, str], None]] = None
 
 
@@ -128,12 +145,79 @@ def _atomic_write_bytes(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
+# ---- index-slice helpers (manifest v2) ----------------------------------
+#
+# A slice spec is a per-dimension list of [start, stop) pairs into the
+# leaf's GLOBAL array.  v1 entries carry no spec: they cover the whole
+# leaf.  A ``shard_spec`` callable decides, per (key, global_shape,
+# process), which window (if any) a process writes or wants back:
+#     shard_spec(key, global_shape, process_index, process_count)
+#         -> Optional[List[Tuple[int, int]]]
+# ``None`` means "no local window": on the write side the process skips
+# the leaf, on the read side the full (replicated) leaf is returned.
+
+SliceSpec = List[Tuple[int, int]]
+ShardSpecFn = Callable[[str, Tuple[int, ...], int, int],
+                       Optional[SliceSpec]]
+
+
+def full_slice(shape) -> SliceSpec:
+    return [(0, int(dim)) for dim in shape]
+
+
+def entry_global_shape(entry: Dict[str, Any]) -> List[int]:
+    """Global leaf shape; v1 entries store whole leaves, so their local
+    shape IS the global shape."""
+    return list(entry.get('global_shape', entry['shape']))
+
+
+def entry_slice(entry: Dict[str, Any]) -> SliceSpec:
+    """The [start, stop) window of the global array this shard file
+    covers (full coverage for v1 entries)."""
+    spec = entry.get('slice')
+    if spec is None:
+        return full_slice(entry['shape'])
+    return [(int(s), int(e)) for s, e in spec]
+
+
+def _elements(spec: SliceSpec) -> int:
+    n = 1
+    for start, stop in spec:
+        n *= max(0, stop - start)
+    return n
+
+
+def even_row_shard(key: str, global_shape, process_index: int,
+                   process_count: int) -> Optional[SliceSpec]:
+    """The canonical sharded layout: partition axis 0 evenly across the
+    process grid.  Leaves whose leading axis does not divide evenly are
+    written whole by one deterministic owner (replicated for readers).
+    Usable as ``shard_spec`` on both the write and read side."""
+    import zlib
+    if process_count <= 1:
+        return full_slice(global_shape)
+    shape = tuple(int(d) for d in global_shape)
+    if shape and shape[0] >= process_count and shape[0] % process_count == 0:
+        rows = shape[0] // process_count
+        spec = full_slice(shape)
+        spec[0] = (process_index * rows, (process_index + 1) * rows)
+        return spec
+    # Un-partitionable leaf: deterministic owner by key hash (stable
+    # across processes, unlike builtins.hash).
+    owner = zlib.crc32(key.encode()) % process_count
+    return full_slice(shape) if owner == process_index else None
+
+
 def write_process_shards(root: str, step: int, pytree,
                          process_index: int = 0,
-                         process_count: int = 1) -> Dict[str, Any]:
+                         process_count: int = 1,
+                         shard_spec: Optional[ShardSpecFn] = None
+                         ) -> Dict[str, Any]:
     """Write this process's leaves + per-process manifest into the temp
-    dir.  Leaves are assigned round-robin by flatten index, so a
-    multihost save spreads disk/GCS-fuse bandwidth across hosts.
+    dir.  Without ``shard_spec``, whole leaves are assigned round-robin
+    by flatten index (replicated layout) so a multihost save spreads
+    disk/GCS-fuse bandwidth across hosts; with one, each process writes
+    only its window of each leaf (sharded layout).
     Returns the per-process manifest dict (entries + bytes written)."""
     # No rmtree here: peer processes may already be writing into the
     # shared staging dir.  Stale leftovers are removed by process 0 in
@@ -144,13 +228,24 @@ def write_process_shards(root: str, step: int, pytree,
     entries = []
     total_bytes = 0
     for i, (key, leaf) in enumerate(named_leaves):
-        if i % process_count != process_index:
-            continue
         arr = np.asarray(leaf)
+        if shard_spec is None:
+            if i % process_count != process_index:
+                continue
+            window = full_slice(arr.shape)
+            filename = f'arr_{i:05d}.npy'
+        else:
+            window = shard_spec(key, arr.shape, process_index,
+                                process_count)
+            if window is None:
+                continue
+            # Per-process filename: several processes may each hold a
+            # window of the same leaf index.
+            filename = f'arr_{i:05d}-p{process_index:05d}.npy'
+        local = np.asarray(arr[tuple(slice(s, e) for s, e in window)])
         buf = io.BytesIO()
-        np.save(buf, arr, allow_pickle=False)
+        np.save(buf, local, allow_pickle=False)
         data = buf.getvalue()
-        filename = f'arr_{i:05d}.npy'
         _atomic_write_bytes(os.path.join(staging, filename), data)
         _stage('shard_written', os.path.join(staging, filename))
         entries.append({
@@ -159,7 +254,10 @@ def write_process_shards(root: str, step: int, pytree,
             'file': filename,
             'sha256': hashlib.sha256(data).hexdigest(),
             'dtype': str(arr.dtype),
-            'shape': list(arr.shape),
+            'shape': list(local.shape),
+            'global_shape': list(arr.shape),
+            'slice': [[s, e] for s, e in window],
+            'process': process_index,
             'bytes': len(data),
         })
         total_bytes += len(data)
@@ -177,6 +275,53 @@ def write_process_shards(root: str, step: int, pytree,
         json.dumps(process_manifest, indent=1).encode())
     _stage('process_manifest', staging)
     return process_manifest
+
+
+def _group_by_index(entries: List[Dict[str, Any]]
+                    ) -> Dict[int, List[Dict[str, Any]]]:
+    groups: Dict[int, List[Dict[str, Any]]] = {}
+    for entry in entries:
+        groups.setdefault(int(entry['index']), []).append(entry)
+    return groups
+
+
+def _validate_coverage(entries: List[Dict[str, Any]], num_leaves: int,
+                       step: int) -> None:
+    """Every leaf index 0..num_leaves-1 must be present, and each leaf's
+    shard windows must tile its global shape exactly (writer contract:
+    windows are disjoint, so covered-element count is a complete
+    check)."""
+    groups = _group_by_index(entries)
+    if set(groups) != set(range(num_leaves)):
+        missing = sorted(set(range(num_leaves)) - set(groups))
+        raise CorruptCheckpointError(
+            f'step {step}: shard entries cover leaves {sorted(groups)} '
+            f'but the tree has {num_leaves} leaves (missing {missing} — '
+            f'a writer process died or its shards were lost)')
+    for index, group in groups.items():
+        global_shape = entry_global_shape(group[0])
+        total = 1
+        for dim in global_shape:
+            total *= int(dim)
+        covered = 0
+        for entry in group:
+            if entry_global_shape(entry) != global_shape:
+                raise CorruptCheckpointError(
+                    f'step {step}: leaf {index} shards disagree on the '
+                    f'global shape ({entry_global_shape(entry)} vs '
+                    f'{global_shape})')
+            spec = entry_slice(entry)
+            for (start, stop), dim in zip(spec, global_shape):
+                if not 0 <= start < stop <= int(dim):
+                    raise CorruptCheckpointError(
+                        f'step {step}: leaf {index} shard '
+                        f'{entry["file"]} slice {spec} exceeds global '
+                        f'shape {global_shape}')
+            covered += _elements(spec)
+        if covered != total:
+            raise CorruptCheckpointError(
+                f'step {step}: leaf {index} shards cover {covered} of '
+                f'{total} elements — missing shard for a dead process?')
 
 
 def commit(root: str, step: int, process_count: int = 1,
@@ -198,11 +343,9 @@ def commit(root: str, step: int, process_count: int = 1,
             pm = json.load(f)
         num_leaves = pm['num_leaves']
         merged_entries.extend(pm['entries'])
-    merged_entries.sort(key=lambda e: e['index'])
-    if num_leaves is not None and len(merged_entries) != num_leaves:
-        raise CorruptCheckpointError(
-            f'commit of step {step}: {len(merged_entries)} shard entries '
-            f'for {num_leaves} leaves')
+    merged_entries.sort(key=lambda e: (e['index'], entry_slice(e)))
+    if num_leaves is not None:
+        _validate_coverage(merged_entries, num_leaves, step)
     manifest = {
         'version': FORMAT_VERSION,
         'step': step,
@@ -230,7 +373,8 @@ def commit(root: str, step: int, process_count: int = 1,
 def save_pytree(root: str, step: int, pytree,
                 process_index: int = 0, process_count: int = 1,
                 metadata: Optional[Dict[str, Any]] = None,
-                barrier: Optional[Callable[[str], None]] = None
+                barrier: Optional[Callable[[str], None]] = None,
+                shard_spec: Optional[ShardSpecFn] = None
                 ) -> Optional[str]:
     """Full save flow for one process.  Non-zero processes return after
     writing their shards (None); process 0 commits and returns the
@@ -254,7 +398,8 @@ def save_pytree(root: str, step: int, pytree,
         clean_stale_tmp(root)
     if barrier is not None:
         barrier(f'skytpu_ckpt_clean_step{step}')
-    write_process_shards(root, step, pytree, process_index, process_count)
+    write_process_shards(root, step, pytree, process_index, process_count,
+                         shard_spec=shard_spec)
     if barrier is not None:
         barrier(f'skytpu_ckpt_write_step{step}')
     if process_index != 0:
@@ -321,10 +466,137 @@ def _resolve_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
+def _read_entry_array(directory: str, step: int,
+                      entry: Dict[str, Any]) -> np.ndarray:
+    """Read one shard file, verify its SHA-256, and reinterpret the
+    manifest dtype (the .npy header degrades extension dtypes like
+    bfloat16 / float8_* to raw void bytes)."""
+    path = os.path.join(directory, entry['file'])
+    try:
+        with open(path, 'rb') as f:
+            data = f.read()
+    except OSError as e:
+        raise CorruptCheckpointError(
+            f'step {step}: missing shard {entry["file"]}: {e}') from e
+    digest = hashlib.sha256(data).hexdigest()
+    if digest != entry['sha256']:
+        raise CorruptCheckpointError(
+            f'step {step}: hash mismatch on {entry["file"]} '
+            f'(manifest {entry["sha256"][:12]}…, got {digest[:12]}…)')
+    arr = np.load(io.BytesIO(data), allow_pickle=False)
+    if str(arr.dtype) != entry['dtype']:
+        try:
+            arr = arr.view(_resolve_dtype(entry['dtype']))
+        except (TypeError, ValueError, AttributeError) as e:
+            raise CorruptCheckpointError(
+                f'step {step}: shard {entry["file"]} has dtype '
+                f'{arr.dtype} but manifest says '
+                f'{entry["dtype"]!r}: {e}') from e
+    if list(arr.shape) != list(entry['shape']):
+        raise CorruptCheckpointError(
+            f'step {step}: shard {entry["file"]} has shape '
+            f'{list(arr.shape)} but manifest says {entry["shape"]}')
+    return arr
+
+
+def _grouped_manifest_leaves(manifest: Dict[str, Any], named_leaves,
+                             step: int
+                             ) -> List[List[Dict[str, Any]]]:
+    """Manifest entries grouped per template leaf (in flatten order),
+    validating leaf count and key paths against the template."""
+    groups = _group_by_index(manifest['entries'])
+    if set(groups) != set(range(len(named_leaves))):
+        raise CorruptCheckpointError(
+            f'step {step}: manifest covers leaf indices '
+            f'{sorted(groups)}, template has {len(named_leaves)} leaves')
+    out = []
+    for i, (key, _) in enumerate(named_leaves):
+        group = sorted(groups[i], key=entry_slice)
+        for entry in group:
+            if entry['key'] != key:
+                raise CorruptCheckpointError(
+                    f'step {step}: manifest key {entry["key"]!r} does '
+                    f'not match template leaf {key!r}')
+        out.append(group)
+    return out
+
+
+def assemble_leaf_window(directory: str, step: int,
+                         entries: List[Dict[str, Any]],
+                         want: Optional[SliceSpec] = None,
+                         stats: Optional[Dict[str, int]] = None
+                         ) -> np.ndarray:
+    """Build one window of a leaf's global array, reading ONLY the
+    shard files that overlap it.  ``want=None`` means the full global
+    array.  Raises CorruptCheckpointError when the window is not fully
+    covered (e.g. the shard of a dead writer process is missing from
+    the manifest-visible files)."""
+    global_shape = entry_global_shape(entries[0])
+    if want is None:
+        want = full_slice(global_shape)
+    if len(want) != len(global_shape):
+        raise CorruptCheckpointError(
+            f'step {step}: requested window rank {len(want)} does not '
+            f'match leaf rank {len(global_shape)}')
+    dtype = _resolve_dtype(entries[0]['dtype'])
+    window_shape = tuple(stop - start for start, stop in want)
+    out = np.empty(window_shape, dtype=dtype)
+    covered = 0
+    for entry in entries:
+        spec = entry_slice(entry)
+        # Per-dim overlap between the wanted window and this shard.
+        overlap = [(max(ws, es), min(we, ee))
+                   for (ws, we), (es, ee) in zip(want, spec)]
+        if any(start >= stop for start, stop in overlap):
+            if stats is not None:
+                stats['files_skipped'] = stats.get('files_skipped', 0) + 1
+            continue
+        arr = _read_entry_array(directory, step, entry)
+        _stage('reshard_shard_read', os.path.join(directory,
+                                                  entry['file']))
+        if stats is not None:
+            stats['files_read'] = stats.get('files_read', 0) + 1
+            stats['bytes_read'] = (stats.get('bytes_read', 0) +
+                                   int(entry['bytes']))
+        dst = tuple(slice(start - ws, stop - ws)
+                    for (start, stop), (ws, _) in zip(overlap, want))
+        src = tuple(slice(start - es, stop - es)
+                    for (start, stop), (es, _) in zip(overlap, spec))
+        out[dst] = arr[src]
+        covered += _elements(overlap)
+    if covered != _elements(want):
+        raise CorruptCheckpointError(
+            f'step {step}: window {want} only covered for {covered} of '
+            f'{_elements(want)} elements — shard file(s) missing for '
+            f'part of the leaf (dead writer process?)')
+    return out
+
+
 def restore_pytree(root: str, step: int, template) -> Any:
     """Load a sharded checkpoint as host numpy arrays shaped like
-    ``template``.  Every shard's SHA-256 is verified against the
-    manifest; any mismatch raises CorruptCheckpointError."""
+    ``template``, assembling each leaf's FULL global array from
+    whatever shard layout wrote it (v1 whole-leaf or v2 windows).
+    Every shard's SHA-256 is verified against the manifest; any
+    mismatch raises CorruptCheckpointError."""
+    return restore_pytree_resharded(root, step, template)
+
+
+def restore_pytree_resharded(root: str, step: int, template,
+                             shard_spec: Optional[ShardSpecFn] = None,
+                             process_index: int = 0,
+                             process_count: int = 1,
+                             stats: Optional[Dict[str, int]] = None
+                             ) -> Any:
+    """Restore under a (possibly different) process grid.
+
+    For each template leaf, ``shard_spec(key, global_shape,
+    process_index, process_count)`` names the window of the global
+    array THIS process wants (``None`` → the full replicated leaf), and
+    only the overlapping shard files are read and hash-verified.
+    Without a ``shard_spec`` every leaf comes back global — the
+    topology-oblivious path used by single-host restore.  The read is
+    side-effect free: a crash at any reshard stage leaves the committed
+    step dirs untouched."""
     import jax
     directory = step_dir(root, step)
     if not os.path.exists(os.path.join(directory, MARKER)):
@@ -332,43 +604,22 @@ def restore_pytree(root: str, step: int, template) -> Any:
             f'step {step}: no {MARKER} marker — uncommitted or torn save')
     manifest = load_manifest(root, step)
     named_leaves, treedef = flatten_with_keys(template)
-    entries = manifest['entries']
-    if len(entries) != len(named_leaves):
-        raise CorruptCheckpointError(
-            f'step {step}: manifest has {len(entries)} arrays, template '
-            f'has {len(named_leaves)} leaves')
+    groups = _grouped_manifest_leaves(manifest, named_leaves, step)
+    _stage('reshard_planned', directory)
     leaves = []
-    for (key, _), entry in zip(named_leaves, sorted(entries,
-                                                    key=lambda e: e['index'])):
-        if entry['key'] != key:
-            raise CorruptCheckpointError(
-                f'step {step}: manifest key {entry["key"]!r} does not '
-                f'match template leaf {key!r}')
-        path = os.path.join(directory, entry['file'])
-        try:
-            with open(path, 'rb') as f:
-                data = f.read()
-        except OSError as e:
-            raise CorruptCheckpointError(
-                f'step {step}: missing shard {entry["file"]}: {e}') from e
-        digest = hashlib.sha256(data).hexdigest()
-        if digest != entry['sha256']:
-            raise CorruptCheckpointError(
-                f'step {step}: hash mismatch on {entry["file"]} '
-                f'(manifest {entry["sha256"][:12]}…, got {digest[:12]}…)')
-        arr = np.load(io.BytesIO(data), allow_pickle=False)
-        if str(arr.dtype) != entry['dtype']:
-            # The .npy header degrades extension dtypes (bfloat16,
-            # float8_*) to raw void bytes ('|V2'); the manifest keeps
-            # the true dtype — reinterpret the buffer.
-            try:
-                arr = arr.view(_resolve_dtype(entry['dtype']))
-            except (TypeError, ValueError, AttributeError) as e:
-                raise CorruptCheckpointError(
-                    f'step {step}: shard {entry["file"]} has dtype '
-                    f'{arr.dtype} but manifest says '
-                    f'{entry["dtype"]!r}: {e}') from e
-        leaves.append(arr)
+    for (key, _), group in zip(named_leaves, groups):
+        want = None
+        if shard_spec is not None:
+            want = shard_spec(key, entry_global_shape(group[0]),
+                              process_index, process_count)
+        leaves.append(assemble_leaf_window(directory, step, group,
+                                           want, stats))
+        _stage('reshard_leaf_assembled', directory)
+    if stats is not None:
+        stats['leaves'] = len(leaves)
+        stats['writer_process_count'] = int(
+            manifest.get('process_count', 1))
+    _stage('reshard_restored', directory)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

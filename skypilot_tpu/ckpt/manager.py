@@ -94,7 +94,8 @@ class CheckpointManager:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
                  barrier: Optional[Callable[[str], None]] = None,
-                 max_consecutive_failures: int = 3):
+                 max_consecutive_failures: int = 3,
+                 shard_spec: Optional[format_lib.ShardSpecFn] = None):
         if keep_last is not None and keep_last < 1:
             raise ValueError(f'keep_last must be >= 1, got {keep_last}')
         self.directory = directory
@@ -111,6 +112,10 @@ class CheckpointManager:
             # cleanup / commit races the peer shard writes.
             barrier = _multihost_barrier
         self._barrier = barrier
+        # Layout of saves AND the default restore window per process:
+        # None = replicated whole-leaf round-robin (the classic layout);
+        # e.g. format.even_row_shard = axis-0 partitioning per process.
+        self.shard_spec = shard_spec
         self.max_consecutive_failures = max_consecutive_failures
         self._writer = AsyncCheckpointWriter(
             max_pending=max_pending,
@@ -219,7 +224,8 @@ class CheckpointManager:
                     process_count=self.process_count,
                     metadata=dict(metadata or {}, kind=kind,
                                   time=time.time()),
-                    barrier=self._barrier)
+                    barrier=self._barrier,
+                    shard_spec=self.shard_spec)
                 if committed is not None:
                     manifest = format_lib.load_manifest(self.directory,
                                                         step)
@@ -275,10 +281,23 @@ class CheckpointManager:
         committed, _ = format_lib.scan_steps(self.directory)
         return [info.step for info in committed]
 
+    def writer_topology(self, step: int) -> Optional[int]:
+        """Process count of the grid that WROTE ``step`` (None for
+        legacy Orbax dirs, which carry no manifest)."""
+        info = self._step_info(step)
+        if info is None or info.fmt != 'sharded':
+            return None
+        manifest = format_lib.load_manifest(self.directory, step)
+        return int(manifest.get('process_count', 1))
+
     def restore(self, step: int, template) -> Any:
         """Restore one step as host numpy arrays shaped like template.
         Sharded checkpoints are hash-verified; legacy Orbax dirs fall
-        back to the Orbax reader."""
+        back to the Orbax reader.  When the checkpoint was written by a
+        different process grid than this manager's (or in a sharded
+        layout), the restore transparently goes through the resharding
+        path — a topology change can never make a committed checkpoint
+        unrestorable."""
         info = self._step_info(step)
         if info is None:
             raise FileNotFoundError(
@@ -287,9 +306,65 @@ class CheckpointManager:
         if info.fmt == 'orbax':
             restored = self._restore_orbax(step, template)
         else:
+            writer_count = self.writer_topology(step)
+            if (writer_count != self.process_count
+                    or self.shard_spec is not None):
+                return self.restore_resharded(step, template)
             restored = format_lib.restore_pytree(self.directory, step,
                                                  template)
         _metrics().CKPT_RESTORES.inc()
+        return restored
+
+    def restore_resharded(self, step: int, template,
+                          shard_spec: Optional[
+                              format_lib.ShardSpecFn] = None) -> Any:
+        """Restore ``step`` under THIS manager's process grid, whatever
+        grid wrote it.  Each leaf is loaded by global index-map: only
+        shard files overlapping this process's window (``shard_spec``,
+        default the manager's own; None → the full replicated leaf) are
+        read and hash-verified, then re-sliced to the current topology.
+        Works for any N→M process-count change — grow, shrink, or
+        down-to-single-host — in both sharded and replicated layouts."""
+        metrics = _metrics()
+        info = self._step_info(step)
+        if info is None:
+            raise FileNotFoundError(
+                f'No committed checkpoint for step {step} under '
+                f'{self.directory}')
+        if info.fmt == 'orbax':
+            # Legacy dirs hold whole leaves; the Orbax reader already
+            # returns global arrays for any grid.
+            restored = self._restore_orbax(step, template)
+            metrics.CKPT_RESTORES.inc()
+            return restored
+        stats: Dict[str, int] = {}
+        start = time.perf_counter()
+        restored = format_lib.restore_pytree_resharded(
+            self.directory, step, template,
+            shard_spec=shard_spec or self.shard_spec,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            stats=stats)
+        elapsed = time.perf_counter() - start
+        writer_count = int(stats.get('writer_process_count', 1))
+        if writer_count < self.process_count:
+            direction = 'grow'
+        elif writer_count > self.process_count:
+            direction = 'shrink'
+        else:
+            direction = 'same'
+        metrics.CKPT_RESHARD_RESTORES.labels(direction=direction).inc()
+        metrics.CKPT_RESHARD_SECONDS.observe(elapsed)
+        metrics.CKPT_RESHARD_BYTES_READ.inc(stats.get('bytes_read', 0))
+        metrics.CKPT_RESHARD_SHARDS_SKIPPED.inc(
+            stats.get('files_skipped', 0))
+        metrics.CKPT_RESTORES.inc()
+        logger.info(
+            f'Resharded restore of step {step}: writer grid '
+            f'{writer_count} -> reader grid {self.process_count} '
+            f'({direction}), {stats.get("files_read", 0)} shard(s) '
+            f'read / {stats.get("files_skipped", 0)} skipped, '
+            f'{stats.get("bytes_read", 0)} bytes in {elapsed:.3f}s')
         return restored
 
     def restore_latest(self, template) -> Optional[Tuple[int, Any]]:

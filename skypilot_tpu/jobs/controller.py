@@ -274,10 +274,11 @@ class JobController:
         """Close the resume loop: if the task declared a checkpoint root
         (SKYTPU_CKPT_DIR in its envs) that is visible from the
         controller host, inject SKYTPU_RESUME_CKPT_PATH/_STEP pointing
-        at the last COMMITTED step, so the relaunched run resumes there
-        instead of restarting.  Roots only visible on-cluster (mounted
-        buckets) are handled by the agent driver's per-gang fallback
-        (agent/driver.py)."""
+        at the last COMMITTED step — plus SKYTPU_RESUME_TOPOLOGY (the
+        grid that wrote it), so a relaunch onto degraded/different
+        capacity restores through the resharding path.  Roots only
+        visible on-cluster (mounted buckets) are handled by the agent
+        driver's per-gang fallback (agent/driver.py)."""
         from skypilot_tpu import ckpt as ckpt_lib
         from skypilot_tpu.utils import env_contract
         ckpt_dir = task.envs.get(env_contract.CKPT_DIR, '')
@@ -316,19 +317,66 @@ class JobController:
                                       for s in statuses.values())
 
     def _recover(self, strategy):
+        """Bounded elastic recovery: up to
+        ``strategy.max_recovery_attempts`` strategy attempts with
+        jittered exponential backoff between them, each attempt itself
+        trying same-region → anywhere → degraded capacity.  On
+        exhaustion the job lands in the TERMINAL
+        ``FAILED_NO_RESOURCE`` status with the last error surfaced —
+        never an unbounded retry-forever loop."""
+        from skypilot_tpu.telemetry import metrics as telemetry_metrics
+        from skypilot_tpu.utils import env_contract
+        from skypilot_tpu.utils.backoff import Backoff
         self.table.set_status(self.job_id, ManagedJobStatus.RECOVERING)
         self.table.bump_recovery(self.job_id)
         self._propagate_resume_envs(strategy.task)
-        try:
-            cluster_job_id, handle = strategy.recover()
-        except exceptions.ResourcesUnavailableError as e:
-            self.table.set_status(
-                self.job_id, ManagedJobStatus.FAILED_NO_RESOURCE, str(e))
-            return None, None
-        self.table.set_cluster(self.job_id, strategy.cluster_name,
-                               cluster_job_id)
-        self.table.set_status(self.job_id, ManagedJobStatus.RUNNING)
-        return cluster_job_id, handle
+        max_attempts = max(1, int(strategy.max_recovery_attempts))
+        backoff = Backoff(initial=self.poll_seconds,
+                          cap=30 * self.poll_seconds)
+        last_err: Optional[Exception] = None
+        for attempt in range(1, max_attempts + 1):
+            record = self.table.get(self.job_id)
+            if (record is not None and
+                    record['status'] == ManagedJobStatus.CANCELLING):
+                # A cancel raced the recovery: honor it instead of
+                # relaunching a cluster nobody wants.
+                self.table.set_status(self.job_id,
+                                      ManagedJobStatus.CANCELLED)
+                return None, None
+            telemetry_metrics.JOBS_RECOVERY_ATTEMPTS.inc()
+            try:
+                cluster_job_id, handle = strategy.recover()
+            except exceptions.ResourcesUnavailableError as e:
+                last_err = e
+                logger.warning(
+                    f'Managed job {self.job_id}: recovery attempt '
+                    f'{attempt}/{max_attempts} found no capacity: {e}')
+                if attempt < max_attempts:
+                    backoff.sleep()
+                continue
+            mode = strategy.last_recovery_mode or 'same_capacity'
+            outcome = ('degraded' if mode.startswith('degraded')
+                       else 'same_capacity')
+            telemetry_metrics.JOBS_ELASTIC_RESUME.labels(
+                outcome=outcome).inc()
+            topo = strategy.task.envs.get(env_contract.RESUME_TOPOLOGY)
+            logger.info(
+                f'Managed job {self.job_id}: recovered ({mode}) on '
+                f'attempt {attempt}/{max_attempts}'
+                + (f'; resume checkpoint written by a {topo}-process '
+                   f'grid — restore reshards if the new slice differs'
+                   if topo else ''))
+            self.table.set_cluster(self.job_id, strategy.cluster_name,
+                                   cluster_job_id)
+            self.table.set_status(self.job_id, ManagedJobStatus.RUNNING)
+            return cluster_job_id, handle
+        telemetry_metrics.JOBS_ELASTIC_RESUME.labels(
+            outcome='failed').inc()
+        self.table.set_status(
+            self.job_id, ManagedJobStatus.FAILED_NO_RESOURCE,
+            f'recovery failed after {max_attempts} attempt(s); '
+            f'last error: {last_err}')
+        return None, None
 
 
 class Scheduler:
@@ -411,10 +459,12 @@ class Scheduler:
 
     def wait_job(self, job_id: int, timeout: float = 300.0
                  ) -> ManagedJobStatus:
+        from skypilot_tpu.utils.backoff import Backoff
         deadline = time.time() + timeout
+        backoff = Backoff(initial=0.2, cap=2.0)
         while time.time() < deadline:
             record = self.table.get(job_id)
             if record and record['status'].is_terminal():
                 return record['status']
-            time.sleep(0.5)
+            backoff.sleep()
         raise TimeoutError(f'Managed job {job_id} still not terminal.')

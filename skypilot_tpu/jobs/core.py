@@ -214,13 +214,15 @@ def _local_tail_logs(job_id: int, follow: bool = True) -> int:
     record = table.get(job_id)
     if record is None:
         raise exceptions.JobNotFoundError(f'Managed job {job_id} not found.')
+    from skypilot_tpu.utils.backoff import Backoff
     deadline = time.time() + 120
+    backoff = Backoff(initial=0.5, cap=4.0)
     while record['cluster_name'] is None:
         if record['status'].is_terminal() or time.time() > deadline:
             print(f'Managed job {job_id}: {record["status"].value} '
                   f'({record.get("failure_reason") or "no logs"})')
             return 0
-        time.sleep(1.0)
+        backoff.sleep()
         record = table.get(job_id)
     cluster = record['cluster_name']
     if state_lib.get_cluster(cluster) is None:

@@ -19,7 +19,6 @@ same vars in per-gang instead.  The relaunched recipe resumes via
 """
 from __future__ import annotations
 
-import time
 from typing import List, Optional, Tuple
 
 from skypilot_tpu import exceptions
@@ -27,6 +26,9 @@ from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import state as state_lib
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import env_contract
+from skypilot_tpu.utils import tpu_utils
+from skypilot_tpu.utils.backoff import Backoff
 from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
 
 logger = sky_logging.init_logger(__name__)
@@ -34,6 +36,11 @@ logger = sky_logging.init_logger(__name__)
 DEFAULT_RECOVERY_STRATEGY = 'failover'
 MAX_LAUNCH_ATTEMPTS = 3
 LAUNCH_RETRY_GAP_SECONDS = 5
+# Controller-level bound: _recover gives up (terminal
+# FAILED_NO_RESOURCE, last error surfaced) after this many strategy
+# recover() attempts unless job_recovery.max_recovery_attempts says
+# otherwise.
+DEFAULT_MAX_RECOVERY_ATTEMPTS = 3
 
 
 class StrategyExecutor:
@@ -43,6 +50,21 @@ class StrategyExecutor:
         self.task = task
         self.cluster_name = cluster_name
         self.retry_count = 0
+        # How the LAST successful recover() placed the job:
+        # 'same_capacity' (same-region or anywhere, equivalent slice) or
+        # 'degraded:<accelerator>' (elastic resume onto a smaller slice).
+        self.last_recovery_mode: Optional[str] = None
+        jr = task.best_resources.job_recovery or {}
+        self.max_recovery_attempts = int(
+            jr.get('max_recovery_attempts', DEFAULT_MAX_RECOVERY_ATTEMPTS))
+        # Degraded-capacity recovery changes the slice the job runs on,
+        # which is only transparent when the task checkpoints through
+        # the elastic-resume contract — so it defaults to on exactly
+        # when SKYTPU_CKPT_DIR is declared.
+        allow = jr.get('allow_degraded')
+        if allow is None:
+            allow = bool((task.envs or {}).get(env_contract.CKPT_DIR))
+        self.allow_degraded = bool(allow)
 
     # -- shared machinery --------------------------------------------------
     def _launch_once(self, blocked_resources: Optional[List] = None
@@ -57,8 +79,11 @@ class StrategyExecutor:
         return job_id, handle
 
     def launch(self) -> Tuple[int, state_lib.ClusterHandle]:
-        """First launch: retry transient failures a few times."""
+        """First launch: retry transient failures a few times, with
+        jittered exponential backoff between attempts."""
         last: Optional[Exception] = None
+        backoff = Backoff(initial=LAUNCH_RETRY_GAP_SECONDS,
+                          cap=4 * LAUNCH_RETRY_GAP_SECONDS)
         for attempt in range(MAX_LAUNCH_ATTEMPTS):
             try:
                 return self._launch_once()
@@ -66,7 +91,8 @@ class StrategyExecutor:
                 last = e
                 logger.warning(f'Launch attempt {attempt + 1} found no '
                                f'resources: {e}')
-                time.sleep(LAUNCH_RETRY_GAP_SECONDS)
+                if attempt + 1 < MAX_LAUNCH_ATTEMPTS:
+                    backoff.sleep()
         raise exceptions.ResourcesUnavailableError(
             f'No resources after {MAX_LAUNCH_ATTEMPTS} launch attempts: '
             f'{last}')
@@ -82,6 +108,59 @@ class StrategyExecutor:
 
     def recover(self) -> Tuple[int, state_lib.ClusterHandle]:
         raise NotImplementedError
+
+    # -- degraded-capacity (elastic resume) --------------------------------
+    def _degraded_candidates(self) -> List[str]:
+        """Smaller valid slices of the task's TPU accelerator, largest
+        first — the ladder recovery walks when no equivalent capacity
+        exists anywhere.  Empty when the task has no TPU accelerator or
+        degraded recovery is disabled."""
+        if not self.allow_degraded:
+            return []
+        accels = self.task.best_resources.accelerators or {}
+        if not accels:
+            return []
+        name = next(iter(accels))
+        try:
+            spec = tpu_utils.parse_tpu_accelerator(name)
+        except exceptions.InvalidTaskError:
+            return []
+        if spec is None:
+            return []
+        valid = tpu_utils._VALID_COUNTS.get(spec.generation, ())
+        smaller = sorted((c for c in valid if c < spec.count),
+                         reverse=True)
+        return [f'tpu-{spec.generation}-{count}' for count in smaller]
+
+    def _launch_degraded(self) -> Tuple[int, state_lib.ClusterHandle]:
+        """Walk the smaller-slice ladder until one launches.  The
+        relaunched task's resume envs already carry
+        ``SKYTPU_RESUME_TOPOLOGY``, so the job re-shards its checkpoint
+        onto whatever grid this lands on."""
+        last: Optional[Exception] = None
+        for accel in self._degraded_candidates():
+            degraded = self.task.best_resources.copy(
+                accelerators=accel, region=None, zone=None)
+            try:
+                self.task.set_resources_chosen(degraded)
+                from skypilot_tpu import execution
+                job_id, handle = execution._execute(  # pylint: disable=protected-access
+                    self.task, self.cluster_name, execution.ALL_STAGES,
+                    detach_run=True)
+                assert job_id is not None
+                self.last_recovery_mode = f'degraded:{accel}'
+                logger.warning(
+                    f'Recovered {self.cluster_name} onto DEGRADED '
+                    f'capacity {accel}; elastic resume will reshard '
+                    f'the checkpoint onto the smaller grid')
+                return job_id, handle
+            except exceptions.ResourcesUnavailableError as e:
+                last = e
+                logger.info(f'Degraded capacity {accel} also '
+                            f'unavailable: {e}')
+        raise exceptions.ResourcesUnavailableError(
+            f'No degraded capacity either (ladder '
+            f'{self._degraded_candidates()}): {last}')
 
     @classmethod
     def make(cls, task: task_lib.Task, cluster_name: str
@@ -112,11 +191,23 @@ class FailoverStrategyExecutor(StrategyExecutor):
                     self.task, self.cluster_name, execution.ALL_STAGES,
                     detach_run=True)
                 assert job_id is not None
+                self.last_recovery_mode = 'same_capacity'
                 return job_id, handle
             except exceptions.ResourcesUnavailableError:
                 logger.info('Same-region recovery failed; failing over.')
-        # 2) Anywhere.
-        return self.launch()
+        # 2) Anywhere (equivalent slice, any zone/region).
+        try:
+            result = self.launch()
+            self.last_recovery_mode = 'same_capacity'
+            return result
+        except exceptions.ResourcesUnavailableError:
+            if not self._degraded_candidates():
+                raise
+            logger.info('No equivalent capacity anywhere; trying '
+                        'degraded slices (elastic resume).')
+        # 3) Degraded capacity: run on what exists instead of blocking
+        #    on identical capacity.
+        return self._launch_degraded()
 
     def _last_launched_resources(self) -> Optional[resources_lib.Resources]:
         record = state_lib.get_cluster(self.cluster_name)
@@ -141,4 +232,14 @@ class EagerFailoverStrategyExecutor(StrategyExecutor):
         if record is not None:
             self.blocked.append(record['handle'].launched_resources)
         self.teardown()
-        return self._launch_once(blocked_resources=self.blocked)
+        try:
+            job_id, handle = self._launch_once(
+                blocked_resources=self.blocked)
+            self.last_recovery_mode = 'same_capacity'
+            return job_id, handle
+        except exceptions.ResourcesUnavailableError:
+            if not self._degraded_candidates():
+                raise
+            logger.info('No equivalent capacity outside the blocklist; '
+                        'trying degraded slices (elastic resume).')
+        return self._launch_degraded()

@@ -240,7 +240,9 @@ def wait_instances(region: str, cluster_name: str,
     pc = provider_config or {}
     namespace = pc.get('namespace') or region or 'default'
     context = pc.get('context')
+    from skypilot_tpu.utils.backoff import Backoff
     deadline = time.time() + _POD_READY_TIMEOUT
+    backoff = Backoff(initial=1.0, cap=8.0)
     while time.time() < deadline:
         pods = _list_pods(cluster_name, namespace, context)
         phases = {name: p.get('status', {}).get('phase', 'Pending')
@@ -251,7 +253,7 @@ def wait_instances(region: str, cluster_name: str,
         if bad:
             raise exceptions.ProvisionerError(
                 f'Pods failed to start: {bad}')
-        time.sleep(2)
+        backoff.sleep()
     raise exceptions.ProvisionerError(
         f'Pods for {cluster_name!r} not Running after '
         f'{_POD_READY_TIMEOUT}s')

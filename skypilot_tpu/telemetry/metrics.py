@@ -108,6 +108,53 @@ CKPT_GC_DELETED = prometheus_client.Counter(
     'Committed checkpoints deleted by retention GC (keep_last/keep_every)',
     registry=REGISTRY)
 
+# ---- elastic resume (ckpt/manager.py restore_resharded,
+#      jobs/controller.py _recover) ---------------------------------------
+
+CKPT_RESHARD_RESTORES = prometheus_client.Counter(
+    'skytpu_ckpt_reshard_restores_total',
+    'Resharded (topology-crossing) checkpoint restores, by direction '
+    'relative to the writer grid: grow = more reader processes, '
+    'shrink = fewer (incl. down-to-single-host), same = equal grid '
+    'but windowed/sharded layout',
+    ['direction'],
+    registry=REGISTRY)
+
+CKPT_RESHARD_SECONDS = prometheus_client.Histogram(
+    'skytpu_ckpt_reshard_restore_duration_seconds',
+    'Wall time of one resharded restore: global index-map planning + '
+    'reading only the shard files overlapping this process window + '
+    'window assembly',
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 15, 60, 300),
+    registry=REGISTRY)
+
+CKPT_RESHARD_BYTES_READ = prometheus_client.Counter(
+    'skytpu_ckpt_reshard_bytes_read_total',
+    'Shard bytes read by resharded restores (only files overlapping '
+    'the requested windows are read)',
+    registry=REGISTRY)
+
+CKPT_RESHARD_SHARDS_SKIPPED = prometheus_client.Counter(
+    'skytpu_ckpt_reshard_shards_skipped_total',
+    'Shard files skipped by resharded restores because they do not '
+    'overlap this process window (the bandwidth elastic resume saves)',
+    registry=REGISTRY)
+
+JOBS_RECOVERY_ATTEMPTS = prometheus_client.Counter(
+    'skytpu_jobs_elastic_resume_attempts_total',
+    'Managed-job recovery attempts (each covers same-region, failover, '
+    'and degraded-capacity tries inside the strategy)',
+    registry=REGISTRY)
+
+JOBS_ELASTIC_RESUME = prometheus_client.Counter(
+    'skytpu_jobs_elastic_resume_total',
+    'Managed-job recovery outcomes: same_capacity (equivalent slice '
+    'relaunched), degraded (smaller slice / different zone via elastic '
+    'resume), failed (max_recovery_attempts exhausted -> terminal '
+    'FAILED_NO_RESOURCE)',
+    ['outcome'],
+    registry=REGISTRY)
+
 # ---- infer (infer/engine.py, infer/serving.py) -------------------------
 
 INFER_PREFILL_SECONDS = prometheus_client.Histogram(

@@ -307,9 +307,25 @@ class Trainer:
         """Restore the newest COMMITTED checkpoint under ``path``,
         skipping uncommitted/corrupt steps.  Returns the restored step,
         or None when no trustworthy checkpoint exists (state is left
-        untouched)."""
-        result = self.checkpoint_manager(path).restore_latest(
-            self._state_dict())
+        untouched).
+
+        Elastic resume: when the checkpoint was written by a different
+        process grid than this run's (preempted job relaunched onto
+        degraded/different capacity — see ``SKYTPU_RESUME_TOPOLOGY`` in
+        utils/env_contract.py), the manager transparently falls back to
+        ``restore_resharded``: each leaf is assembled from its global
+        index-map and re-sliced to the current topology, then installed
+        with the live tree's shardings like any other restore."""
+        from skypilot_tpu import sky_logging
+        from skypilot_tpu.utils import env_contract
+        manager = self.checkpoint_manager(path)
+        writer_grid = env_contract.resume_topology()
+        if writer_grid is not None and writer_grid != manager.process_count:
+            sky_logging.init_logger(__name__).info(
+                f'Elastic resume: checkpoint written by a '
+                f'{writer_grid}-process grid, this run has '
+                f'{manager.process_count}; restore will reshard')
+        result = manager.restore_latest(self._state_dict())
         if result is None:
             return None
         step, restored = result

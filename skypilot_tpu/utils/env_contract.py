@@ -55,6 +55,14 @@ MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
 CKPT_DIR = 'SKYTPU_CKPT_DIR'
 RESUME_CKPT_PATH = 'SKYTPU_RESUME_CKPT_PATH'
 RESUME_STEP = 'SKYTPU_RESUME_STEP'
+# RESUME_TOPOLOGY is SYSTEM-set alongside the path/step: the process
+# count of the grid that WROTE the resume step.  A relaunch need not
+# match it — elastic resume re-shards on restore
+# (CheckpointManager.restore_resharded), so the controller can recover
+# onto degraded/different capacity and the relaunched run compares this
+# value against its own grid to know the restore crossed a topology
+# change.
+RESUME_TOPOLOGY = 'SKYTPU_RESUME_TOPOLOGY'
 
 
 def make_env_vars(node_rank: int,
@@ -104,6 +112,18 @@ def resume_target() -> Optional[Tuple[str, int]]:
         return None
     try:
         return path, int(step)
+    except ValueError:
+        return None
+
+
+def resume_topology() -> Optional[int]:
+    """Process count of the grid that wrote the resume checkpoint
+    (``SKYTPU_RESUME_TOPOLOGY``); None when unset/unparseable.  Compare
+    against the current grid to detect an elastic (resharding)
+    resume."""
+    raw = os.environ.get(RESUME_TOPOLOGY, '')
+    try:
+        return int(raw) if raw else None
     except ValueError:
         return None
 

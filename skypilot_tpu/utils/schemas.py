@@ -60,6 +60,13 @@ _RESOURCES_SCHEMA: Dict[str, Any] = {
                        'properties': {
                            'strategy': {'anyOf': [{'type': 'string'}, {'type': 'null'}]},
                            'max_restarts_on_errors': {'type': 'integer', 'minimum': 0},
+                           # Elastic resume: bound on provisioning attempts per
+                           # recovery episode, and opt-in/out of the degraded-
+                           # capacity ladder (smaller TPU slice of the same
+                           # generation; defaults on iff the task declares
+                           # SKYTPU_CKPT_DIR, i.e. can actually resume).
+                           'max_recovery_attempts': {'type': 'integer', 'minimum': 1},
+                           'allow_degraded': {'type': 'boolean'},
                        }}]
         },
         'any_of': {'type': 'array', 'items': {'type': 'object'}},

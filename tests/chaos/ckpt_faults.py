@@ -20,6 +20,12 @@ from skypilot_tpu.ckpt import format as ckpt_format
 # must leave the checkpoint invisible.
 PRE_COMMIT_STAGES = ('shard_written', 'process_manifest', 'pre_commit')
 
+# Stages of one resharded RESTORE, in protocol order.  Reads are
+# side-effect free: a crash at any of these must leave the committed
+# step dirs intact so a retry (or walk-down) still succeeds.
+RESHARD_STAGES = ('reshard_planned', 'reshard_shard_read',
+                  'reshard_leaf_assembled', 'reshard_restored')
+
 
 class SimulatedCrash(Exception):
     """Raised by a crash hook to model the writer dying mid-save."""
@@ -97,3 +103,41 @@ def corrupt_manifest(step_path: str) -> None:
     with open(os.path.join(step_path, ckpt_format.MANIFEST), 'w',
               encoding='utf-8') as f:
         f.write('{not json')
+
+
+def drop_process_shards(step_path: str, process_index: int) -> int:
+    """Delete every shard file written by ``process_index`` — models a
+    writer host that died before its files were replicated/uploaded.
+    Returns the number of files removed (the manifest is left alone, so
+    the reader's coverage check is what must catch the hole)."""
+    import json
+    with open(os.path.join(step_path, ckpt_format.MANIFEST),
+              encoding='utf-8') as f:
+        manifest = json.load(f)
+    removed = 0
+    for entry in manifest['entries']:
+        if entry.get('process') == process_index:
+            path = os.path.join(step_path, entry['file'])
+            if os.path.exists(path):
+                os.remove(path)
+                removed += 1
+    return removed
+
+
+def v1_manifest_from(step_path: str) -> None:
+    """Rewrite a committed step's manifest as format v1: strip the v2
+    index-map keys (global_shape/slice/process) and stamp version 1 —
+    models a checkpoint written by a pre-elastic-resume release, which
+    the resharded reader must still load (each entry is then one whole
+    leaf)."""
+    import json
+    mpath = os.path.join(step_path, ckpt_format.MANIFEST)
+    with open(mpath, encoding='utf-8') as f:
+        manifest = json.load(f)
+    manifest['version'] = 1
+    for entry in manifest['entries']:
+        entry.pop('global_shape', None)
+        entry.pop('slice', None)
+        entry.pop('process', None)
+    with open(mpath, 'w', encoding='utf-8') as f:
+        json.dump(manifest, f)

@@ -501,6 +501,7 @@ def test_resume_envs(tmp_path):
     assert ckpt_lib.resume_envs(str(tmp_path)) == {
         env_contract.RESUME_CKPT_PATH: str(tmp_path),
         env_contract.RESUME_STEP: '2',
+        env_contract.RESUME_TOPOLOGY: '1',
     }
 
 
@@ -536,6 +537,367 @@ def test_controller_propagates_resume_envs(tmp_path):
     assert env_contract.RESUME_STEP not in bare.envs
 
 
+# -- elastic resume: resharded restore ------------------------------------
+
+
+def _grid_tree(seed=0):
+    """Leaves covering the reshard matrix: axis-0-shardable f32/bf16/int8
+    (first dim divisible by every grid in {1, 2, 4}) plus an
+    un-partitionable scalar that gets one crc-picked owner."""
+    import ml_dtypes
+    rng = np.random.default_rng(seed)
+    return {
+        'w': rng.normal(size=(8, 6)).astype(np.float32),
+        'emb': rng.normal(size=(8,)).astype(ml_dtypes.bfloat16),
+        'q': rng.integers(-128, 127, size=(4, 3), dtype=np.int64
+                          ).astype(np.int8),
+        'scale': np.float32(seed + 0.5),
+    }
+
+
+def _write_grid(root, step, tree, n):
+    """Commit ``tree`` at ``step`` as written by an ``n``-process grid,
+    axis-0 sharded (the real multihost layout elastic resume targets)."""
+    for p in range(n):
+        ckpt_format.write_process_shards(
+            str(root), step, tree, process_index=p, process_count=n,
+            shard_spec=ckpt_format.even_row_shard)
+    ckpt_format.commit(str(root), step, process_count=n)
+
+
+def _assert_bit_exact(got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    assert got.dtype == want.dtype
+    assert got.shape == want.shape
+    assert got.tobytes() == want.tobytes()
+
+
+@pytest.mark.parametrize('writers', [1, 2, 4])
+@pytest.mark.parametrize('readers', [1, 2, 4])
+def test_reshard_parity_any_grid_to_any_grid(tmp_path, writers, readers):
+    """A checkpoint written by N processes restores BIT-EXACTLY under M
+    processes for every (N, M) in {1,2,4}^2 — grow, shrink, and
+    down-to-single-host — across f32, bf16, and int8 leaves."""
+    tree = _grid_tree(writers)
+    _write_grid(tmp_path, 5, tree, writers)
+    # Whole-tree restore (e.g. a single-host debug session).
+    _assert_tree_equal(tree, ckpt_format.restore_pytree(
+        str(tmp_path), 5, _grid_tree(0)))
+    # Windowed restore: each reader pulls only its slice of the new
+    # grid; stitching the windows back together recovers every bit.
+    parts = []
+    for q in range(readers):
+        parts.append(ckpt_format.restore_pytree_resharded(
+            str(tmp_path), 5, _grid_tree(0),
+            shard_spec=ckpt_format.even_row_shard,
+            process_index=q, process_count=readers))
+    for key, want in tree.items():
+        want = np.asarray(want)
+        windows = [np.asarray(p[key]) for p in parts]
+        if want.ndim and want.shape[0] % readers == 0 \
+                and want.shape[0] >= readers:
+            _assert_bit_exact(np.concatenate(windows, axis=0)
+                              if readers > 1 else windows[0], want)
+        else:
+            # Un-partitionable leaf: every reader gets the full value.
+            for window in windows:
+                _assert_bit_exact(window, want)
+
+
+def test_reshard_reads_only_overlapping_shards(tmp_path):
+    """The point of the index-map: a 1-of-4 reader of a 4-writer grid
+    touches only the shard files overlapping its window, not all of
+    them."""
+    tree = _grid_tree(2)
+    _write_grid(tmp_path, 5, tree, 4)
+    stats = {}
+    ckpt_format.restore_pytree_resharded(
+        str(tmp_path), 5, _grid_tree(0),
+        shard_spec=ckpt_format.even_row_shard,
+        process_index=0, process_count=4, stats=stats)
+    assert stats['writer_process_count'] == 4
+    assert stats['files_skipped'] > 0
+    assert stats['files_read'] + stats['files_skipped'] >= stats['leaves']
+
+
+@pytest.mark.parametrize('stage', ckpt_faults.RESHARD_STAGES)
+def test_crash_at_any_reshard_stage_is_retryable(tmp_path, stage):
+    """Reads are side-effect free: a reader killed at ANY reshard stage
+    leaves the committed step untouched, so both a retry and the
+    manager's walk-down still succeed."""
+    tree = _grid_tree(3)
+    _write_grid(tmp_path, 3, tree, 2)
+    with ckpt_faults.stage_hook(ckpt_faults.CrashAtStage(stage)):
+        with pytest.raises(ckpt_faults.SimulatedCrash):
+            ckpt_format.restore_pytree_resharded(
+                str(tmp_path), 3, _grid_tree(0),
+                shard_spec=ckpt_format.even_row_shard,
+                process_index=0, process_count=2)
+    assert ckpt_format.latest_step(str(tmp_path)) == 3
+    manager = _manager(tmp_path)               # 1-process reader of 2
+    step, restored = manager.restore_latest(_grid_tree(0))
+    assert step == 3
+    _assert_tree_equal(tree, restored)
+    manager.close()
+
+
+def test_missing_shard_for_dead_process_walks_down(tmp_path):
+    """A writer host that died before its shard files landed leaves a
+    coverage hole: the resharded reader must refuse the step (never
+    fabricate data) and the manager walks down to the previous
+    committed step."""
+    _write_grid(tmp_path, 1, _grid_tree(1), 4)
+    _write_grid(tmp_path, 2, _grid_tree(2), 4)
+    removed = ckpt_faults.drop_process_shards(str(tmp_path / 'step_2'), 2)
+    assert removed > 0
+    with pytest.raises(ckpt_format.CorruptCheckpointError):
+        ckpt_format.restore_pytree(str(tmp_path), 2, _grid_tree(0))
+    manager = _manager(tmp_path)
+    step, restored = manager.restore_latest(_grid_tree(0))
+    assert step == 1
+    _assert_tree_equal(_grid_tree(1), restored)
+    manager.close()
+
+
+def test_walk_down_past_torn_resharded_step(tmp_path):
+    """Bit rot in the newest multi-writer step: the resharded restore
+    detects it via SHA-256 and the manager lands on the previous
+    committed step — same contract as the single-grid path."""
+    _write_grid(tmp_path, 1, _grid_tree(1), 4)
+    _write_grid(tmp_path, 2, _grid_tree(2), 4)
+    ckpt_faults.flip_bit(ckpt_faults.first_shard(str(tmp_path / 'step_2')))
+    manager = _manager(tmp_path)
+    step, restored = manager.restore_latest(_grid_tree(0))
+    assert step == 1
+    _assert_tree_equal(_grid_tree(1), restored)
+    manager.close()
+
+
+def test_v1_manifest_from_larger_grid_restores_anywhere(tmp_path):
+    """A pre-elastic-resume (v1) checkpoint written by a 2-process grid
+    — whole leaves round-robined, no index map — still restores under
+    any topology: v1 entries read as full-coverage single shards."""
+    tree = _grid_tree(4)
+    for p in range(2):
+        ckpt_format.write_process_shards(str(tmp_path), 3, tree,
+                                         process_index=p, process_count=2)
+    ckpt_format.commit(str(tmp_path), 3, process_count=2)
+    ckpt_faults.v1_manifest_from(str(tmp_path / 'step_3'))
+    manifest = ckpt_format.load_manifest(str(tmp_path), 3)
+    assert manifest['version'] == 1
+    _assert_tree_equal(tree, ckpt_format.restore_pytree(
+        str(tmp_path), 3, _grid_tree(0)))
+    windowed = ckpt_format.restore_pytree_resharded(
+        str(tmp_path), 3, _grid_tree(0),
+        shard_spec=ckpt_format.even_row_shard,
+        process_index=1, process_count=2)
+    _assert_bit_exact(windowed['w'], tree['w'][4:])
+
+
+def test_manager_reshard_metrics_and_routing(tmp_path):
+    """restore_latest on a manager whose grid differs from the writer's
+    routes through the resharding path and counts it (direction label,
+    bytes read)."""
+    tree = _grid_tree(6)
+    _write_grid(tmp_path, 4, tree, 2)
+    shrink = _counter('skytpu_ckpt_reshard_restores_total',
+                      direction='shrink')
+    bytes_before = _counter('skytpu_ckpt_reshard_bytes_read_total')
+    manager = _manager(tmp_path)
+    assert manager.writer_topology(4) == 2
+    step, restored = manager.restore_latest(_grid_tree(0))
+    assert step == 4
+    _assert_tree_equal(tree, restored)
+    assert _counter('skytpu_ckpt_reshard_restores_total',
+                    direction='shrink') == shrink + 1
+    assert _counter('skytpu_ckpt_reshard_bytes_read_total') > bytes_before
+    manager.close()
+
+
+def test_resume_topology_env(monkeypatch, tmp_path):
+    """resume_envs publishes the WRITER grid; env_contract parses it
+    back (garbage reads as unset, never crashes the trainer)."""
+    _write_grid(tmp_path, 2, _grid_tree(2), 2)
+    envs = ckpt_lib.resume_envs(str(tmp_path))
+    assert envs[env_contract.RESUME_TOPOLOGY] == '2'
+    monkeypatch.delenv(env_contract.RESUME_TOPOLOGY, raising=False)
+    assert env_contract.resume_topology() is None
+    monkeypatch.setenv(env_contract.RESUME_TOPOLOGY, '4')
+    assert env_contract.resume_topology() == 4
+    monkeypatch.setenv(env_contract.RESUME_TOPOLOGY, 'potato')
+    assert env_contract.resume_topology() is None
+
+
+def test_controller_propagates_resume_topology(tmp_path):
+    """The controller's relaunch envs carry the writer grid so the new
+    (possibly smaller) slice knows the restore must reshard."""
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import controller as controller_lib
+    _write_grid(tmp_path, 7, _grid_tree(7), 4)
+    task = task_lib.Task(run='python train.py',
+                         envs={env_contract.CKPT_DIR: str(tmp_path)})
+    stub = type('Stub', (), {'job_id': 1})()
+    controller_lib.JobController._propagate_resume_envs(stub, task)
+    assert task.envs[env_contract.RESUME_STEP] == '7'
+    assert task.envs[env_contract.RESUME_TOPOLOGY] == '4'
+
+
+# -- bounded recovery (jobs controller) -----------------------------------
+
+
+class _RecordingTable:
+    """JobsTable stand-in recording status transitions."""
+
+    def __init__(self):
+        self.statuses = []
+        self.cluster = None
+        self.recoveries = 0
+
+    def set_status(self, job_id, status, reason=None):
+        self.statuses.append((status, reason))
+
+    def bump_recovery(self, job_id):
+        self.recoveries += 1
+
+    def get(self, job_id):
+        from skypilot_tpu.jobs.state import ManagedJobStatus
+        return {'status': ManagedJobStatus.RECOVERING}
+
+    def set_cluster(self, job_id, cluster, cluster_job_id):
+        self.cluster = (cluster, cluster_job_id)
+
+
+def _stub_controller(table):
+    stub = type('Stub', (), {})()
+    stub.table = table
+    stub.job_id = 1
+    stub.poll_seconds = 0.01           # keeps the backoff sleeps tiny
+    stub._propagate_resume_envs = lambda task: None
+    return stub
+
+
+def test_recover_terminates_within_max_attempts(tmp_path):
+    """No capacity anywhere must NOT retry forever: _recover stops at
+    max_recovery_attempts and surfaces a terminal FAILED_NO_RESOURCE
+    with the last error in the reason."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import controller as controller_lib
+    from skypilot_tpu.jobs.state import ManagedJobStatus
+
+    class _NoCapacity:
+        task = task_lib.Task(run='x')
+        max_recovery_attempts = 3
+        last_recovery_mode = None
+        cluster_name = 'c'
+        calls = 0
+
+        def recover(self):
+            _NoCapacity.calls += 1
+            raise exceptions.ResourcesUnavailableError(
+                'every zone is out of v5e')
+
+    table = _RecordingTable()
+    before_failed = _counter('skytpu_jobs_elastic_resume_total',
+                             outcome='failed')
+    attempts_before = _counter('skytpu_jobs_elastic_resume_attempts_total')
+    result = controller_lib.JobController._recover(
+        _stub_controller(table), _NoCapacity())
+    assert result == (None, None)
+    assert _NoCapacity.calls == 3
+    status, reason = table.statuses[-1]
+    assert status == ManagedJobStatus.FAILED_NO_RESOURCE
+    assert status.is_terminal()
+    assert '3 attempt' in reason and 'every zone is out of v5e' in reason
+    assert _counter('skytpu_jobs_elastic_resume_total',
+                    outcome='failed') == before_failed + 1
+    assert _counter('skytpu_jobs_elastic_resume_attempts_total') == \
+        attempts_before + 3
+
+
+def test_recover_degraded_outcome_counted(tmp_path):
+    """A recovery that lands on a smaller slice reports outcome
+    'degraded' and sets the job RUNNING on the new cluster."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import controller as controller_lib
+    from skypilot_tpu.jobs.state import ManagedJobStatus
+
+    class _DegradedOnSecond:
+        task = task_lib.Task(run='x')
+        max_recovery_attempts = 5
+        last_recovery_mode = None
+        cluster_name = 'skytpu-job-1'
+        calls = 0
+
+        def recover(self):
+            _DegradedOnSecond.calls += 1
+            if _DegradedOnSecond.calls == 1:
+                raise exceptions.ResourcesUnavailableError('not yet')
+            _DegradedOnSecond.last_recovery_mode = 'degraded:tpu-v5e-8'
+            return 42, 'handle'
+
+    table = _RecordingTable()
+    before = _counter('skytpu_jobs_elastic_resume_total',
+                      outcome='degraded')
+    result = controller_lib.JobController._recover(
+        _stub_controller(table), _DegradedOnSecond())
+    assert result == (42, 'handle')
+    assert _DegradedOnSecond.calls == 2
+    assert table.cluster == ('skytpu-job-1', 42)
+    assert table.statuses[-1][0] == ManagedJobStatus.RUNNING
+    assert _counter('skytpu_jobs_elastic_resume_total',
+                    outcome='degraded') == before + 1
+
+
+def test_degraded_candidates_ladder():
+    """The degraded ladder walks smaller valid slices of the SAME
+    generation, largest first — and stays empty without the elastic
+    resume contract (no SKYTPU_CKPT_DIR) or without a TPU."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import recovery_strategy as rs
+
+    def _executor(accel, envs=None):
+        task = task_lib.Task(run='x', envs=envs or {})
+        task.set_resources(resources_lib.Resources(accelerators=accel))
+        return rs.FailoverStrategyExecutor(task, 'c')
+
+    ckpt_envs = {env_contract.CKPT_DIR: '/ckpts'}
+    ladder = _executor('tpu-v5e-16', ckpt_envs)._degraded_candidates()
+    assert ladder[0] == 'tpu-v5e-8'
+    assert ladder[-1] == 'tpu-v5e-1'
+    assert all(a.startswith('tpu-v5e-') for a in ladder)
+    # No checkpoint contract declared -> degraded recovery defaults OFF.
+    assert _executor('tpu-v5e-16')._degraded_candidates() == []
+    # Smallest slice already: nothing to degrade to.
+    assert _executor('tpu-v5e-1', ckpt_envs)._degraded_candidates() == []
+    # allow_degraded=True opts in explicitly even without the contract.
+    task = task_lib.Task(run='x')
+    task.set_resources(resources_lib.Resources(
+        accelerators='tpu-v5e-4',
+        job_recovery={'strategy': 'failover', 'allow_degraded': True}))
+    assert rs.FailoverStrategyExecutor(
+        task, 'c')._degraded_candidates() == ['tpu-v5e-1']
+
+
+def test_max_recovery_attempts_from_job_recovery():
+    """job_recovery.max_recovery_attempts flows task -> executor."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.jobs import recovery_strategy as rs
+    task = task_lib.Task(run='x')
+    task.set_resources(resources_lib.Resources(
+        job_recovery={'strategy': 'failover',
+                      'max_recovery_attempts': 7}))
+    executor = rs.StrategyExecutor.make(task, 'c')
+    assert executor.max_recovery_attempts == 7
+    bare = task_lib.Task(run='x')
+    bare.set_resources(resources_lib.Resources())
+    assert rs.StrategyExecutor.make(bare, 'c').max_recovery_attempts == \
+        rs.DEFAULT_MAX_RECOVERY_ATTEMPTS
+
+
 def test_driver_resume_env_fallback(tmp_path):
     """The gang driver fills the same vars when the controller could not
     see the checkpoint root — and defers when they are already set."""
@@ -545,6 +907,7 @@ def test_driver_resume_env_fallback(tmp_path):
     assert driver_lib._resume_env_fallback(envs) == {
         env_contract.RESUME_CKPT_PATH: str(tmp_path),
         env_contract.RESUME_STEP: '6',
+        env_contract.RESUME_TOPOLOGY: '1',
     }
     # Controller already injected: the driver defers to it.
     assert driver_lib._resume_env_fallback(

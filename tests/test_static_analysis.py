@@ -200,6 +200,66 @@ def test_silent_except_only_on_recovery_paths():
     """, path='infer/engine.py') == ['SKY301']
 
 
+def test_unbounded_recovery_loop_flagged():
+    """SKY303: a retry-forever recovery loop — while True around a
+    recover call whose except swallows the failure — on a jobs/serve
+    path is a finding; the same loop with a Backoff/attempt bound (or
+    off the recovery paths) is sanctioned."""
+    bad = """
+        def run(strategy):
+            while True:
+                try:
+                    strategy.recover()
+                except Exception:
+                    continue
+    """
+    assert 'SKY303' in codes(bad, path='jobs/controller.py')
+    assert 'SKY303' in codes(bad, path='serve/autoscaler.py')
+    # Not a recovery path: the rule stays quiet.
+    assert 'SKY303' not in codes(bad, path='infer/engine.py')
+    # A loop with no exit at all around a launch call is the same bug.
+    assert 'SKY303' in codes("""
+        def run(strategy):
+            while True:
+                strategy.launch()
+    """, path='jobs/controller.py')
+
+
+def test_bounded_recovery_loop_is_clean():
+    # Backoff-driven retries (the sanctioned shape) pass.
+    assert 'SKY303' not in codes("""
+        from skypilot_tpu.utils.backoff import Backoff
+
+        def run(strategy):
+            backoff = Backoff(initial=1.0, cap=30.0)
+            while True:
+                try:
+                    strategy.recover()
+                except Exception:
+                    backoff.sleep()
+    """, path='jobs/controller.py')
+    # An explicit attempt bound passes.
+    assert 'SKY303' not in codes("""
+        def run(strategy, max_recovery_attempts):
+            for attempt in range(max_recovery_attempts):
+                try:
+                    return strategy.recover()
+                except Exception:
+                    continue
+    """, path='jobs/controller.py')
+    # A monitor loop that RETURNS on outcomes is not a retry loop.
+    assert 'SKY303' not in codes("""
+        def monitor(strategy):
+            while True:
+                try:
+                    status = strategy.recover()
+                except Exception:
+                    return None
+                if status is not None:
+                    return status
+    """, path='jobs/controller.py')
+
+
 def test_inline_allow_suppresses():
     assert codes("""
         import jax
