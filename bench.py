@@ -287,9 +287,9 @@ def bench_decode(on_tpu: bool) -> dict:
                        int8_scales=False):
         """HBM bound at a given per-step KV context read.  ctx=avg_ctx
         is the IDEAL bound (cache reads tracking live context exactly);
-        ctx=<cache bucket rows> is the bound the bucketed engine can
-        actually reach — it streams the live BUCKET each step, not
-        max_len (post-bucketing) and not the exact live context."""
+        ctx=<streamed rows> is the bound the engine can actually reach —
+        pooled decode streams each slot's block-TABLE span
+        (table_width x block_size rows), not the exact live context."""
         if weights_dtype == 'int8':
             # matmul weights stream as int8 (+f32 per-out-channel
             # scales, <0.1% — folded into the int8 byte count); the
@@ -313,10 +313,6 @@ def bench_decode(on_tpu: bool) -> dict:
             GeneratorConfig(max_seq_len=prompt_len + max_new + 1,
                             batch_size=slots, temperature=0.0,
                             prompt_buckets=[prompt_len],
-                            # One cache bucket, sized to the workload:
-                            # these variants track the FUSION trend vs
-                            # r4; bucketed_vs_fixed isolates buckets.
-                            cache_buckets=[prompt_len + max_new + 1],
                             kv_cache_dtype=kv_cache_dtype,
                             weights_dtype=weights_dtype),
             decode_chunk=chunk)
@@ -359,31 +355,39 @@ def bench_decode(on_tpu: bool) -> dict:
                   ) if chunk_times else None
         kv_b = 1 if kv_cache_dtype == 'int8' else dtype_bytes
         scales = kv_cache_dtype == 'int8'
-        # Ideal bound (avg-context KV read) and the BUCKETED bound at
-        # the cache rows this variant's engine actually streams each
-        # step (these variants pin one workload-sized bucket).
+        # Ideal bound (avg-context KV read) and the STREAMED bound at
+        # the rows the data plane actually reads each step: pooled
+        # decode attends over each slot's block-table span
+        # (table_width x block_size); the legacy bucketed path reads
+        # the live cache bucket.
         bound = roofline_tok_s(kv_b, avg_ctx, weights_dtype, scales)
-        bucket_rows = prompt_len + max_new + 1
-        bucket_bound = roofline_tok_s(kv_b, bucket_rows, weights_dtype,
-                                      scales)
+        if batcher.pooled:
+            streamed_rows = batcher.table_width * batcher.block_size
+        else:
+            streamed_rows = prompt_len + max_new + 1
+        stream_bound = roofline_tok_s(kv_b, streamed_rows,
+                                      weights_dtype, scales)
         tok_s = generated / dt
-        return {
+        result = {
             'decode_tok_s': round(tok_s, 1),
             'steady_decode_tok_s': (round(steady, 1)
                                     if steady else None),
             'roofline_tok_s': round(bound, 1),
-            'roofline_bucket_tok_s': round(bucket_bound, 1),
+            'roofline_streamed_tok_s': round(stream_bound, 1),
             'roofline_pct': round(100 * tok_s / bound, 1),
             'steady_roofline_pct': (round(100 * steady / bound, 1)
                                     if steady else None),
-            'steady_bucket_roofline_pct': (
-                round(100 * steady / bucket_bound, 1)
+            'steady_streamed_roofline_pct': (
+                round(100 * steady / stream_bound, 1)
                 if steady else None),
             'latency_per_token_ms_p50': round(np.percentile(
                 per_token_ms, 50), 3) if per_token_ms else None,
             'latency_per_token_ms_p99': round(np.percentile(
                 per_token_ms, 99), 3) if per_token_ms else None,
         }
+        if batcher.pooled:
+            result['pool'] = batcher.pool.stats()
+        return result
 
     def steady_tok_s(gen_cfg, d_chunk, n_prompt, n_new):
         """Median pure-decode steady tok/s of one batcher config (the
@@ -416,76 +420,109 @@ def bench_decode(on_tpu: bool) -> dict:
         return (slots * d_chunk / np.median(times)) if times else None
 
     def measure_bucket_win():
-        """The tentpole's headline condition: steady decode tok/s of
-        length-bucketed KV caches vs the fixed-max_len cache when the
-        AVERAGE context is far below max_seq_len (the common serving
-        regime: a big context ceiling bought for the long tail, short
-        typical requests).  The fixed path streams max_len cache rows
-        every step; the bucketed path streams the live bucket."""
+        """LEGACY comparison (both arms pin decode_impl='inplace'):
+        steady decode tok/s of length-bucketed KV caches vs the
+        fixed-max_len cache when the AVERAGE context is far below
+        max_seq_len.  Kept for trend continuity — the pooled default
+        retired both arms (block tables stream only owned blocks, so
+        neither bucket migration nor the fixed-ceiling read exists on
+        the default path); `pooled_steady_tok_s` runs the SAME workload
+        on the pooled data plane for a direct three-way read."""
         if on_tpu:
             w_max, w_prompt, w_new, w_chunk = 2048, 128, 256, 64
         else:
             w_max, w_prompt, w_new, w_chunk = 128, 8, 16, 8
         base = dict(max_seq_len=w_max, batch_size=slots,
                     temperature=0.0, prompt_buckets=[w_prompt])
-        bucketed = steady_tok_s(GeneratorConfig(**base), w_chunk,
-                                w_prompt, w_new)
+        pooled = steady_tok_s(GeneratorConfig(**base), w_chunk,
+                              w_prompt, w_new)
+        bucketed = steady_tok_s(
+            GeneratorConfig(**base, decode_impl='inplace'), w_chunk,
+            w_prompt, w_new)
         fixed = steady_tok_s(
-            GeneratorConfig(**base, cache_buckets=[w_max]), w_chunk,
+            GeneratorConfig(**base, decode_impl='inplace',
+                            cache_buckets=[w_max]), w_chunk,
             w_prompt, w_new)
         return {
             'max_seq_len': w_max,
             'avg_context': w_prompt + w_new // 2,
+            'pooled_steady_tok_s': (round(pooled, 1)
+                                    if pooled else None),
             'bucketed_steady_tok_s': (round(bucketed, 1)
                                       if bucketed else None),
             'fixed_steady_tok_s': round(fixed, 1) if fixed else None,
             'speedup': (round(bucketed / fixed, 2)
                         if bucketed and fixed else None),
+            'pooled_vs_fixed_speedup': (round(pooled / fixed, 2)
+                                        if pooled and fixed else None),
         }
 
-    out = {
-        'slots': slots, 'max_new_tokens': max_new,
-        'params_b': round(config.num_params() / 1e9, 2),
+    def _migrations_total():
+        from skypilot_tpu.telemetry import metrics as telemetry_metrics
+        total = 0.0
+        for family in telemetry_metrics.INFER_CACHE_MIGRATIONS.collect():
+            for sample in family.samples:
+                if sample.name.endswith('_total'):
+                    total += sample.value
+        return total
+
+    # Migration counter delta across the pooled variants below MUST be
+    # 0: bucket migration does not exist on the block-pool data plane.
+    # Snapshot before/after so the legacy-pinned arms of
+    # bucketed_vs_fixed (which legitimately migrate) cannot pollute it.
+    mig0 = _migrations_total()
+    variants = {
         'bf16': measure(None),
         'int8_kv': measure('int8'),
         # Weight-only int8 + int8 KV: the full quantized serving config
         # (infer/quant.py) — the weight stream dominates decode bytes,
         # so this is where the roofline itself drops ~2x.
         'int8_w_kv': measure('int8', 'int8'),
-        # Length-bucketed cache vs fixed max_len at avg context ≪
-        # max_seq_len (target: ≥1.5x steady on TPU at avg ctx 256 vs
-        # ceiling 2048).
+    }
+    pooled_migrations = _migrations_total() - mig0
+
+    out = {
+        'slots': slots, 'max_new_tokens': max_new,
+        'params_b': round(config.num_params() / 1e9, 2),
+        **variants,
+        'pooled_path_cache_migrations': pooled_migrations,
+        # Legacy bucketed-vs-fixed comparison (both arms pin
+        # decode_impl='inplace') plus the pooled default on the same
+        # workload — see measure_bucket_win.
         'bucketed_vs_fixed': measure_bucket_win(),
         'method': f'continuous batching, {slots} slots x {max_new} '
-                  f'tokens, chunk {chunk}, greedy over 2 steady batches, decode_impl=inplace '
-                  f'(fori_loop + row-scatter cache: +30% over the r3 '
-                  f'layer-scan xs/ys decode); roofline = HBM bound on '
-                  f'(weights + KV read) per step x slots at '
+                  f'tokens, chunk {chunk}, greedy over 2 steady '
+                  f'batches, decode_impl=pooled (the default data '
+                  f'plane: paged attention over one block-pool KV '
+                  f'arena per layer, per-slot block tables as TRACED '
+                  f'operands — one decode program serves every '
+                  f'context length, no per-bucket compiles, no '
+                  f'grow/shrink cache migrations); roofline = HBM '
+                  f'bound on (weights + KV read) per step x slots at '
                   f'{hbm_bw/1e9:.0f} GB/s, quoted two ways: '
                   f'roofline_tok_s charges the IDEAL avg-context KV '
-                  f'read, roofline_bucket_tok_s charges the cache '
-                  f'BUCKET rows the engine actually streams each step '
-                  f'(post-bucketing it reads bucket-sized caches, not '
-                  f'max_len; these variants pin one workload-sized '
-                  f'bucket, so the bucket bound is the reachable one '
-                  f'and the avg-context bound is the bucketing '
-                  f'headroom); latency = pure-decode chunk wall / steps '
-                  f'(admission ticks excluded); int8_w_kv adds '
-                  f'weight-only int8 (per-out-channel scales) on top '
-                  f'of the int8 KV cache — its roofline charges int8 '
-                  f'matmul weights + model-dtype embed; '
-                  f'steady_decode_tok_s = slots x chunk / median '
-                  f'pure-decode chunk wall (the figure the roofline '
-                  f'bounds; decode_tok_s additionally pays prefill + '
-                  f'admission + host bookkeeping per batch); decode is '
-                  f'now the FUSED multi-step chunk (on-device sampling '
-                  f'+ eos/budget tracking, one host transfer per '
-                  f'chunk) over a length-BUCKETED kv cache — the main '
-                  f'variants pin cache_buckets to one bucket '
-                  f'(max_seq_len sized to the workload), so their '
-                  f'trend vs r4 isolates the fusion; bucketed_vs_fixed '
-                  f'isolates the bucket win at avg context << '
-                  f'max_seq_len',
+                  f'read, roofline_streamed_tok_s charges the rows '
+                  f'the data plane actually streams each step (the '
+                  f'per-slot block-TABLE span, table_width x '
+                  f'block_size; the old bucket-rows framing no '
+                  f'longer applies — there are no cache buckets on '
+                  f'the pooled path); latency = pure-decode chunk '
+                  f'wall / steps (admission ticks excluded); '
+                  f'int8_w_kv adds weight-only int8 (per-out-channel '
+                  f'scales) on top of the int8 KV cache — its '
+                  f'roofline charges int8 matmul weights + '
+                  f'model-dtype embed; steady_decode_tok_s = slots x '
+                  f'chunk / median pure-decode chunk wall (the '
+                  f'figure the roofline bounds; decode_tok_s '
+                  f'additionally pays prefill + admission + host '
+                  f'bookkeeping per batch); decode remains the FUSED '
+                  f'multi-step chunk (on-device sampling + '
+                  f'eos/budget tracking, one host transfer per '
+                  f'chunk); per-variant `pool` reports the arena '
+                  f'free-list stats at end of run; bucketed_vs_fixed '
+                  f'keeps the LEGACY inplace bucket comparison for '
+                  f'trend, with the pooled default run on the same '
+                  f'workload alongside',
     }
     # Back-compat top-level number for trend tracking across rounds.
     out['decode_tok_s'] = out['bf16']['decode_tok_s']
@@ -496,8 +533,9 @@ def bench_prefix_reuse(on_tpu: bool) -> dict:
     """Radix prefix-cache win (infer/prefix_cache.py): a batch of
     requests sharing a long system prompt, COLD (first sight of the
     prefix — every prompt prefills from token 0) vs WARM (the prefix
-    was cached by the previous batch — admission installs the matched
-    blocks device-to-device and prefills only the tail).
+    was cached by the previous batch — under the pooled default the
+    matched blocks SPLICE into the slot's block table by refcount,
+    zero KV device copies, and only the tail prefills).
 
     max_new_tokens=1 makes each run pure prefill + first token, so the
     batch wall time IS the prefill phase and batch completion means
@@ -585,8 +623,10 @@ def bench_prefix_reuse(on_tpu: bool) -> dict:
                   f'prefill_chunk=prefix_block={block}; cold = first '
                   f'sight of the head (chunked-window prefill from 0, '
                   f'inserts blocks), warm = next batch with the same '
-                  f'head (blocks install device-to-device, only the '
-                  f'tail prefills); ttft_s = submit-all to all first '
+                  f'head (pooled default: cached blocks splice into '
+                  f'the slot block table by refcount — ZERO KV device '
+                  f'copies, no install/extract — and only the tail '
+                  f'prefills); ttft_s = submit-all to all first '
                   f'tokens; compile warmup ran on a disjoint token '
                   f'range',
     }
@@ -820,6 +860,18 @@ def build_headline(tok_s: float, mfu: float, llama8b: dict,
             'launch_to_first_line_s'),
         'vs_baseline': round(tok_s / TARGET_TOKENS_PER_SEC_PER_CHIP, 3),
     }
+    if isinstance(decode, dict) and 'error' not in decode:
+        bf16 = decode.get('bf16')
+        pool_stats = bf16.get('pool') if isinstance(bf16, dict) else None
+        if isinstance(pool_stats, dict):
+            headline['pool'] = {
+                'blocks_total': pool_stats.get('blocks_total'),
+                'hwm': pool_stats.get('hwm'),
+                'table_appends': pool_stats.get('table_appends'),
+                'prefix_shares': pool_stats.get('prefix_shares'),
+                'pooled_path_cache_migrations':
+                    decode.get('pooled_path_cache_migrations'),
+            }
     if isinstance(prefix, dict):
         if 'error' in prefix:
             headline['prefix'] = {'error': str(prefix['error'])[:120]}
@@ -946,16 +998,20 @@ def main() -> None:
                   # Method changes recorded alongside numbers so trends
                   # stay interpretable (VERDICT r2 weak #7).
                   'method_notes': (
-                      'r4: blockwise cross-entropy (loss_chunk) on the '
-                      '1B (chunk 256) and 8B (chunk 512) configs; 8B '
-                      'extrapolation now bs=2x4096 (r3: bs=1 — the '
-                      'full logits no longer pin the HBM) with a '
-                      'retry-on-failed-cross-check guard; decode is '
-                      'the new in-place (fori+row-scatter) impl with '
-                      'roofline/latency reporting; timing + '
-                      'extrapolation method otherwise unchanged from '
-                      'r3 (chained SGD fori_loop, (1,2)-layer slope + '
-                      'head, matmul-params MFU convention)')},
+                      'decode now measures the pooled block-pool data '
+                      'plane by default (paged attention, traced block '
+                      'tables, zero-copy warm prefix splices) with '
+                      'roofline_streamed_tok_s replacing the retired '
+                      'bucket-rows bound; bucketed_vs_fixed pins '
+                      'decode_impl=inplace on both legacy arms for '
+                      'trend and adds the pooled number; earlier '
+                      'method history: r4 added blockwise '
+                      'cross-entropy (loss_chunk 256/512) and the 8B '
+                      'bs=2x4096 extrapolation with retry-on-failed-'
+                      'cross-check; timing + extrapolation otherwise '
+                      'unchanged from r3 (chained SGD fori_loop, '
+                      '(1,2)-layer slope + head, matmul-params MFU '
+                      'convention)')},
     }
     print(json.dumps(full))
     # Telemetry roll-up from the shared Prometheus registry the run just
@@ -1018,6 +1074,34 @@ def main() -> None:
         print('AUDIT_SUMMARY ' + json.dumps(audit_lib.quick_summary()))
     except Exception as e:  # pylint: disable=broad-except
         print('AUDIT_SUMMARY ' + json.dumps({'error': str(e)}))
+    # Block-pool roll-up for the pooled default data plane the decode
+    # benches exercised.  Gauges reflect the most recent pool publish;
+    # counters aggregate process-wide; pooled_path_cache_migrations is
+    # the migration-counter delta across ONLY the pooled decode
+    # variants (must be 0 — the legacy-pinned bucketed_vs_fixed arms
+    # are excluded from it by the snapshot in bench_decode).  Same
+    # tail-safe contract as the other summary lines.
+    try:
+        from skypilot_tpu.metrics import REGISTRY as _registry
+
+        def _pool_gauge(name):
+            return _registry.get_sample_value(name)
+
+        print('POOL_SUMMARY ' + json.dumps({
+            'blocks_total': _pool_gauge('skytpu_infer_pool_blocks_total'),
+            'blocks_live': _pool_gauge('skytpu_infer_pool_blocks_live'),
+            'blocks_free': _pool_gauge('skytpu_infer_pool_blocks_free'),
+            'pool_hwm': _pool_gauge('skytpu_infer_pool_hwm'),
+            'block_table_appends_total': _pool_gauge(
+                'skytpu_infer_pool_block_table_appends_total'),
+            'prefix_block_shares_total': _pool_gauge(
+                'skytpu_infer_pool_prefix_block_shares_total'),
+            'pooled_path_cache_migrations': decode.get(
+                'pooled_path_cache_migrations')
+                if isinstance(decode, dict) else None,
+        }))
+    except Exception as e:  # pylint: disable=broad-except
+        print('POOL_SUMMARY ' + json.dumps({'error': str(e)}))
     # Prefix-cache warm-vs-cold summary (its numbers were measured above
     # by bench_prefix_reuse) — its own tail-safe line so the speedup and
     # tokens_saved accounting survive any tail capture.

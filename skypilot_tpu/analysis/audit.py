@@ -162,11 +162,32 @@ def make_tiny_generator(**overrides):
 
 
 def _decode_chunk_inputs(gen, bucket: int, n: int):
-    """Concrete (tiny) operands of one fused decode chunk at a bucket."""
+    """Concrete (tiny) operands of one fused decode chunk.  Pooled
+    (default): the cache operand is the pool arena and a (B, T) block
+    table rides along — `bucket` is ignored because the pooled decode
+    has exactly one cache shape.  Legacy: a contiguous cache at the
+    given bucket."""
     import jax
     import jax.numpy as jnp
     from skypilot_tpu.infer import llama_infer
     batch = gen.gen.batch_size
+    if getattr(gen, 'pooled', False):
+        # A FRESH arena, not gen.pool.arena: the decode chunk donates
+        # its cache operand, so a caller that executes (not just
+        # lowers) these args would delete the generator's live arena.
+        from skypilot_tpu.infer import block_pool as block_pool_lib
+        arena = block_pool_lib.init_arena(
+            gen.config, gen.pool.n_blocks, gen.pool.block_size,
+            kv_dtype=gen.gen.kv_cache_dtype)
+        args = (gen.params,
+                jnp.zeros((batch,), jnp.int32),
+                arena,
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros((batch,), bool),
+                jnp.full((batch,), 8, jnp.int32),
+                jax.random.PRNGKey(0),
+                jnp.zeros((batch, gen.table_width), jnp.int32))
+        return args, n
     cache = llama_infer.init_cache(gen.config, batch, bucket,
                                    kv_dtype=gen.gen.kv_cache_dtype)
     return (gen.params,
@@ -184,49 +205,63 @@ def _decode_chunk_inputs(gen, bucket: int, n: int):
 
 
 def audit_generator_decode(gen=None) -> Dict[str, Any]:
-    """The PR 2 contract on Generator: one compile per cache bucket, a
-    donated cache, a callback-free f32 graph, one host fetch per chunk."""
+    """The decode compile contract on Generator: pooled (default) — at
+    most TWO decode programs ever (full chunk + context-ceiling tail;
+    block tables are traced operands so growth never re-keys the
+    compile); legacy — one compile per cache bucket.  Plus a donated
+    cache/arena and a callback-free f32 graph."""
     import jax
     gen = gen or make_tiny_generator()
+    pooled = getattr(gen, 'pooled', False)
     checks: List[Dict[str, str]] = []
 
-    # Budget 1 (runtime): a bucket-crossing generation compiles the
-    # fused chunk at most once per cache bucket.
+    # Budget 1 (runtime): a growing generation stays inside the
+    # decode-program budget.
     gen.generate(_AUDIT_PROMPTS, max_new_tokens=_AUDIT_MAX_NEW)
     compiles = gen._decode_chunk._cache_size()
-    budget = len(gen.cache_buckets)
+    budget = 2 if pooled else len(gen.cache_buckets)
     checks.append(_check(
         'compile_per_bucket',
         'ok' if compiles <= budget else 'fail',
-        f'{compiles} decode-chunk compiles for {budget} cache buckets '
-        f'{list(gen.cache_buckets)}'
+        (f'{compiles} decode-chunk compiles for a pooled budget of '
+         f'{budget} (full chunk + tail; block tables are traced '
+         f'operands)' if pooled else
+         f'{compiles} decode-chunk compiles for {budget} cache buckets '
+         f'{list(gen.cache_buckets)}')
         + ('' if compiles <= budget else
            ' — retrace regression: some shape/static-arg now varies '
            'per chunk')))
 
-    # Budget 2: the KV cache must be donated into the chunk.
+    # Budget 2: the KV cache/arena must be donated into the chunk.
     args, n = _decode_chunk_inputs(gen, gen.cache_buckets[0],
                                    gen.gen.decode_chunk)
     lowered = gen._decode_chunk.lower(*args, n=n)
-    checks.append(_donation_check(lowered.as_text(), 'KV cache'))
+    checks.append(_donation_check(
+        lowered.as_text(), 'pool arena' if pooled else 'KV cache'))
 
-    # Budgets 3+4: per-bucket jaxpr — no callbacks, no f64.
+    # Budgets 3+4: jaxpr hygiene — no callbacks, no f64 (legacy: once
+    # per cache bucket; pooled: the single arena shape).
     impl = functools.partial(
         gen._decode_chunk_impl, n=gen.gen.decode_chunk,
         temperature=gen.gen.temperature, top_k=gen.gen.top_k,
         top_p=gen.gen.top_p, eos=gen.gen.eos_token)
     worst: Dict[str, Dict[str, str]] = {}
-    for bucket in gen.cache_buckets:
-        args, _ = _decode_chunk_inputs(gen, bucket, gen.gen.decode_chunk)
+    shapes = ['arena'] if pooled else list(gen.cache_buckets)
+    for bucket in shapes:
+        args, _ = _decode_chunk_inputs(
+            gen, bucket if not pooled else gen.cache_buckets[0],
+            gen.gen.decode_chunk)
         jaxpr = jax.make_jaxpr(impl)(*args)
         for check in _jaxpr_dtype_and_callback_checks(jaxpr):
             if check['status'] == 'fail' or check['name'] not in worst:
                 worst[check['name']] = dict(
-                    check, detail=f"bucket {bucket}: {check['detail']}")
+                    check, detail=f"{bucket}: {check['detail']}")
     checks.extend(worst.values())
     checks.append(_sharding_check(gen.mesh))
     return {'entry': 'generator_decode', 'checks': checks,
-            'compiles': compiles, 'buckets': list(gen.cache_buckets)}
+            'compiles': compiles,
+            'buckets': (['arena'] if pooled
+                        else list(gen.cache_buckets))}
 
 
 def audit_batcher_decode() -> Dict[str, Any]:
@@ -245,30 +280,49 @@ def audit_batcher_decode() -> Dict[str, Any]:
                                 decode_chunk=8)
     checks: List[Dict[str, str]] = []
 
-    # Runtime compile budget: an all-greedy bucket-crossing run
-    # compiles one program per visited bucket.
+    # Runtime compile budget: pooled (default) — at most two decode
+    # programs for an all-greedy growing run (block tables are traced
+    # operands, so slot growth re-uploads a table instead of re-keying
+    # the compile); legacy — one program per visited bucket.
+    pooled = batcher.pooled
     for prompt in _AUDIT_PROMPTS:
         batcher.submit(list(prompt), max_new_tokens=_AUDIT_MAX_NEW)
     batcher.run_until_idle()
     compiles = batcher._decode._cache_size()
-    budget = len(batcher.cache_buckets)
+    budget = 2 if pooled else len(batcher.cache_buckets)
     checks.append(_check(
         'compile_per_bucket',
         'ok' if compiles <= budget else 'fail',
-        f'{compiles} decode compiles for {budget} cache buckets '
-        f'(all-greedy run)'))
+        (f'{compiles} decode compiles for a pooled budget of {budget} '
+         f'(all-greedy run)' if pooled else
+         f'{compiles} decode compiles for {budget} cache buckets '
+         f'(all-greedy run)')))
 
     batch = batcher.gen.batch_size
-    cache = llama_infer.init_cache(config, batch,
-                                   batcher.cache_buckets[0])
-    args = (batcher.params, jnp.zeros((batch,), jnp.int32), cache,
-            jnp.zeros((batch,), jnp.int32), jnp.zeros((batch,), bool),
-            jnp.full((batch,), 8, jnp.int32),
-            jnp.zeros((batch,), jnp.float32),
-            jnp.ones((batch,), jnp.float32), jax.random.PRNGKey(0))
+    if pooled:
+        cache = batcher._cache
+        tables = jnp.zeros((batch, batcher.table_width), jnp.int32)
+        args = (batcher.params, jnp.zeros((batch,), jnp.int32), cache,
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros((batch,), bool),
+                jnp.full((batch,), 8, jnp.int32),
+                jnp.zeros((batch,), jnp.float32),
+                jnp.ones((batch,), jnp.float32), jax.random.PRNGKey(0),
+                tables)
+    else:
+        cache = llama_infer.init_cache(config, batch,
+                                       batcher.cache_buckets[0])
+        args = (batcher.params, jnp.zeros((batch,), jnp.int32), cache,
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros((batch,), bool),
+                jnp.full((batch,), 8, jnp.int32),
+                jnp.zeros((batch,), jnp.float32),
+                jnp.ones((batch,), jnp.float32), jax.random.PRNGKey(0))
     lowered = batcher._decode.lower(*args, n=8, all_greedy=True,
                                     nucleus=False)
-    checks.append(_donation_check(lowered.as_text(), 'slot KV cache'))
+    checks.append(_donation_check(
+        lowered.as_text(),
+        'pool arena' if pooled else 'slot KV cache'))
 
     impl = functools.partial(batcher._decode_impl, n=8, all_greedy=True,
                              nucleus=False, top_k=None, eos=None)
@@ -277,24 +331,35 @@ def audit_batcher_decode() -> Dict[str, Any]:
     checks.append(_sharding_check(batcher.mesh))
     return {'entry': 'batcher_decode', 'checks': checks,
             'compiles': compiles,
-            'buckets': list(batcher.cache_buckets)}
+            'buckets': (['arena'] if pooled
+                        else list(batcher.cache_buckets))}
 
 
 def audit_prefill(gen=None) -> Dict[str, Any]:
-    """Prefill per prompt bucket: callback-free, f64-free."""
+    """Prefill per prompt bucket: callback-free, f64-free.  Pooled
+    (default): audits the scatter-into-arena prefill the engines
+    actually run; legacy: the contiguous-cache prefill."""
     import jax
     import jax.numpy as jnp
     from skypilot_tpu.infer import llama_infer
     gen = gen or make_tiny_generator()
     checks: List[Dict[str, str]] = []
     batch = gen.gen.batch_size
+    pooled = getattr(gen, 'pooled', False)
     for bucket in gen.buckets:
-        cache = llama_infer.init_cache(
-            gen.config, batch, gen._cache_bucket_for(bucket + 1),
-            kv_dtype=gen.gen.kv_cache_dtype)
-        jaxpr = jax.make_jaxpr(gen._prefill_impl)(
-            gen.params, jnp.zeros((batch, bucket), jnp.int32), cache,
-            jnp.ones((batch,), jnp.int32))
+        if pooled:
+            nb = -(-bucket // gen.block_size)
+            jaxpr = jax.make_jaxpr(gen._prefill_pooled_impl)(
+                gen.params, jnp.zeros((batch, bucket), jnp.int32),
+                gen.pool.arena, jnp.ones((batch,), jnp.int32),
+                jnp.zeros((batch, nb), jnp.int32))
+        else:
+            cache = llama_infer.init_cache(
+                gen.config, batch, gen._cache_bucket_for(bucket + 1),
+                kv_dtype=gen.gen.kv_cache_dtype)
+            jaxpr = jax.make_jaxpr(gen._prefill_impl)(
+                gen.params, jnp.zeros((batch, bucket), jnp.int32), cache,
+                jnp.ones((batch,), jnp.int32))
         for check in _jaxpr_dtype_and_callback_checks(jaxpr):
             if check['status'] == 'fail':
                 checks.append(dict(
@@ -310,16 +375,14 @@ def audit_prefill(gen=None) -> Dict[str, Any]:
 
 
 def audit_prefix_cache() -> Dict[str, Any]:
-    """The radix prefix cache's budgets (infer/prefix_cache.py): a
-    warm+cold bucket-crossing run keeps the decode compile budget, and
-    install_prefix adds at most one compile per cache bucket (slot and
-    position are traced operands — only the cache bucket shape keys the
-    compile).  The installed-block copy must donate the slot cache and
-    stay callback- and f64-free."""
-    import jax
-    import jax.numpy as jnp
-    from skypilot_tpu.infer import llama_infer, prefix_cache
-
+    """The radix prefix cache's budgets under the pooled data plane
+    (infer/prefix_cache.py block-id mode): a cold+warm run keeps the
+    pooled decode compile budget (<= 2 programs), the warm run HITS,
+    and the hit is a ZERO-COPY table splice — blocks are shared by
+    refcount, and the legacy install_prefix program is never compiled
+    (its jit cache must stay empty).  The legacy contiguous install
+    path keeps its own checks only when a non-pooled decode_impl is
+    audited explicitly."""
     gen = make_tiny_generator(prefix_cache_mb=4, prefix_block=8,
                               prompt_buckets=[32])
     checks: List[Dict[str, str]] = []
@@ -329,23 +392,14 @@ def audit_prefix_cache() -> Dict[str, Any]:
     prompts = [shared + [21, 22], shared + [23]]
     gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
     gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
-    budget = len(gen.cache_buckets)
+    budget = 2
 
     decode_compiles = gen._decode_chunk._cache_size()
     checks.append(_check(
         'decode_compile_per_bucket',
         'ok' if decode_compiles <= budget else 'fail',
-        f'{decode_compiles} decode-chunk compiles for {budget} cache '
-        f'buckets across a cold+warm prefix-cache run'))
-
-    install_compiles = gen.prefix._install._cache_size()
-    checks.append(_check(
-        'install_compile_per_bucket',
-        'ok' if install_compiles <= budget else 'fail',
-        f'{install_compiles} install_prefix compiles for {budget} '
-        f'cache buckets'
-        + ('' if install_compiles <= budget else
-           ' — a slot/offset must have become static')))
+        f'{decode_compiles} decode-chunk compiles for the pooled '
+        f'budget of {budget} across a cold+warm prefix-cache run'))
 
     hit = gen.prefix.hits > 0
     checks.append(_check(
@@ -353,23 +407,107 @@ def audit_prefix_cache() -> Dict[str, Any]:
         f'{gen.prefix.hits} hits / {gen.prefix.misses} misses, '
         f'{gen.prefix.tokens_saved} prompt tokens saved'))
 
-    # Donation + jaxpr hygiene of the install copy itself.
-    batch = gen.gen.batch_size
-    cache = llama_infer.init_cache(gen.config, batch,
-                                   gen.cache_buckets[0],
-                                   kv_dtype=gen.gen.kv_cache_dtype)
-    block = {k: jnp.zeros((v.shape[0], gen.prefix.block) + v.shape[3:],
-                          v.dtype) for k, v in cache.items()}
-    lowered = gen.prefix._install.lower(cache, block, jnp.int32(0),
-                                        jnp.int32(0))
-    checks.append(_donation_check(lowered.as_text(), 'slot KV cache'))
-    jaxpr = jax.make_jaxpr(prefix_cache.install_prefix)(
-        cache, block, jnp.int32(0), jnp.int32(0))
-    checks.extend(_jaxpr_dtype_and_callback_checks(jaxpr))
+    # Zero-copy contract: the warm hit must be a host-side refcount
+    # splice — prefix blocks shared through the pool, and the legacy
+    # device-copy install program never even compiled.
+    install_compiles = gen.prefix._install._cache_size()
+    shares = gen.pool.prefix_shares
+    checks.append(_check(
+        'zero_copy_splice',
+        'ok' if (install_compiles == 0 and shares > 0) else 'fail',
+        f'{shares} prefix block shares, {install_compiles} '
+        f'install_prefix compiles (must be 0: a warm hit is a table '
+        f'splice, not a device copy)'))
+    checks.append(_check(
+        'pool_refcount_invariant',
+        'ok' if (gen.pool.free_blocks() + gen.pool.live_blocks()
+                 == gen.pool.n_blocks - 1) else 'fail',
+        f'free {gen.pool.free_blocks()} + live {gen.pool.live_blocks()}'
+        f' == total {gen.pool.n_blocks} - garbage'))
     return {'entry': 'prefix_cache', 'checks': checks,
             'decode_compiles': decode_compiles,
             'install_compiles': install_compiles,
-            'buckets': list(gen.cache_buckets)}
+            'buckets': ['arena']}
+
+
+def audit_block_pool() -> Dict[str, Any]:
+    """The block-pool data plane's budgets (infer/block_pool.py, the
+    default): across a cold + warm + growth run (prefix-cache reuse,
+    then sequences growing across block boundaries) the decode chunk
+    compiles at most TWICE (full chunk + context-ceiling tail — block
+    tables are traced operands, growth re-uploads a table) and prefill
+    at most once per prompt bucket; the pool arena is donated through
+    both programs (`tf.aliasing_output` in the lowered HLO); the traced
+    graphs are callback-free and f64-free; and the host-side free list
+    balances (free + live == total - garbage) after every row's
+    release."""
+    import jax
+    import jax.numpy as jnp
+    gen = make_tiny_generator(prefix_cache_mb=4, prefix_block=8,
+                              prompt_buckets=[32])
+    checks: List[Dict[str, str]] = []
+
+    # Cold run populates the trie; warm run splices it; 40 new tokens
+    # grow every row across multiple block boundaries.
+    shared = [7, 3, 9, 1, 4, 6, 2, 8, 5, 11, 13, 12, 10, 14, 15, 16]
+    prompts = [shared + [21, 22], shared + [23]]
+    gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
+    gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
+
+    decode_compiles = gen._decode_chunk._cache_size()
+    checks.append(_check(
+        'decode_compile_budget',
+        'ok' if decode_compiles <= 2 else 'fail',
+        f'{decode_compiles} decode-chunk compiles across a cold+warm+'
+        f'growth run (budget 2: full chunk + tail; a regression here '
+        f'means block-table growth re-keyed the compile)'))
+
+    prefill_compiles = gen._prefill._cache_size()
+    prefill_budget = len(gen.buckets)
+    checks.append(_check(
+        'prefill_compile_budget',
+        'ok' if prefill_compiles <= prefill_budget else 'fail',
+        f'{prefill_compiles} pooled-prefill compiles for '
+        f'{prefill_budget} prompt buckets'))
+
+    # Arena donation through the decode chunk AND the scatter prefill.
+    args, n = _decode_chunk_inputs(gen, gen.cache_buckets[0],
+                                   gen.gen.decode_chunk)
+    lowered = gen._decode_chunk.lower(*args, n=n)
+    checks.append(_donation_check(lowered.as_text(),
+                                  'pool arena (decode chunk)'))
+    batch = gen.gen.batch_size
+    bucket = gen.buckets[0]
+    nb = -(-bucket // gen.block_size)
+    lowered_pf = gen._prefill.lower(
+        gen.params, jnp.zeros((batch, bucket), jnp.int32),
+        gen.pool.arena, jnp.ones((batch,), jnp.int32),
+        jnp.zeros((batch, nb), jnp.int32))
+    pf_check = _donation_check(lowered_pf.as_text(),
+                               'pool arena (scatter prefill)')
+    pf_check['name'] = 'prefill_donation'
+    checks.append(pf_check)
+
+    # Jaxpr hygiene of the pooled decode chunk.
+    impl = functools.partial(
+        gen._decode_chunk_impl, n=gen.gen.decode_chunk,
+        temperature=gen.gen.temperature, top_k=gen.gen.top_k,
+        top_p=gen.gen.top_p, eos=gen.gen.eos_token)
+    jaxpr = jax.make_jaxpr(impl)(*args)
+    checks.extend(_jaxpr_dtype_and_callback_checks(jaxpr))
+
+    stats = gen.pool.stats()
+    balanced = (stats['blocks_free'] + stats['blocks_live']
+                == stats['blocks_total'] - 1)
+    checks.append(_check(
+        'free_list_balance', 'ok' if balanced else 'fail',
+        f"free {stats['blocks_free']} + live {stats['blocks_live']} vs "
+        f"total {stats['blocks_total']} - garbage (live = trie-shared "
+        f"prefix blocks)"))
+    return {'entry': 'block_pool', 'checks': checks,
+            'decode_compiles': decode_compiles,
+            'prefill_compiles': prefill_compiles,
+            'pool': stats}
 
 
 def audit_trainer_step() -> Dict[str, Any]:
@@ -511,6 +649,7 @@ REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'batcher_decode': audit_batcher_decode,
     'prefill': audit_prefill,
     'prefix_cache': audit_prefix_cache,
+    'block_pool': audit_block_pool,
     'trainer_step': audit_trainer_step,
     'ckpt_reshard': audit_ckpt_reshard,
     'ring_attention': audit_ring_attention,

@@ -104,6 +104,7 @@ DATA_PLANE_MODULES = (
     'infer/multihost.py',
     'infer/multihost_check.py',
     'infer/prefix_cache.py',
+    'infer/block_pool.py',
 )
 
 # SKY202's sanctioned home: the bounded-backoff helper is ALLOWED to

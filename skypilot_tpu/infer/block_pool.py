@@ -1,0 +1,249 @@
+"""Device-resident block-pool KV allocator — the default decode data
+plane.
+
+One pooled K/V arena per layer replaces the three KV representations
+the serving stack used to carry (length-bucketed contiguous caches
+with jitted grow/shrink migrations, the `decode_impl='paged'` read
+path, and the prefix cache's standalone device blocks):
+
+- arena: k/v (L, NB, BS, KV, hd) — NB physical blocks of BS cache
+  rows each, one allocation for the process lifetime.  int8 caches add
+  (L, NB, BS, KV) f32 absmax scales.  Block 0 is a reserved GARBAGE
+  block: never allocated, never read (the decode length mask hides
+  every logical row a table does not really back), the write target
+  for unmapped table entries — pad rows and frozen slots scatter there
+  harmlessly instead of needing a branch.
+- free list + refcounts live on the HOST: allocation is list math, not
+  device work.  A sequence that outgrows its blocks appends ids from
+  the free list to its (host-mirrored) block table and re-uploads the
+  table — `resize_cache` bucket migrations disappear entirely.
+- refcount sharing is what makes a warm prefix hit free: a trie node
+  (prefix_cache.py pooled mode) and a live sequence reference the SAME
+  physical blocks; installing a cached prefix is a block-table splice
+  + refcount bump — zero install_prefix/extract_block device copies.
+  A block returns to the free list only when its refcount hits 0.
+
+The arena is a plain Cache dict so llama_infer's pooled kernels and
+the engines' jitted programs treat it exactly like the old cache
+pytree (donation included); this module owns only the host-side
+accounting.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
+
+Cache = Dict[str, "jnp.ndarray"]
+
+# Physical block 0 is the garbage sink: jnp.zeros'd at init, scribbled
+# over by pad/frozen-row writes, and excluded from allocation forever.
+GARBAGE_BLOCK = 0
+
+
+class PoolExhaustedError(RuntimeError):
+    """Raised when an allocation needs more blocks than the free list
+    holds.  The batcher treats this as admission backpressure (requests
+    stay queued); the lockstep Generator surfaces it with sizing
+    advice — neither path fabricates blocks or OOMs the device."""
+
+
+def init_arena(config: llama.LlamaConfig, n_blocks: int,
+               block_size: int, sharding=None,
+               kv_dtype: Optional[str] = None) -> Cache:
+    """Allocate the pooled arena: k/v (L, NB, BS, KV, hd) (+ scales for
+    int8).  Mirrors llama_infer.init_cache's dtype/sharding contract —
+    the tp CACHE_SPEC shards the KV-head axis, which sits at index 3 in
+    both the contiguous and pooled layouts, so the same NamedSharding
+    applies unchanged."""
+    shape = (config.n_layers, n_blocks, block_size, config.n_kv_heads,
+             config.head_dim)
+    kwargs = {} if sharding is None else {'device': sharding}
+    if kv_dtype is None:
+        return {'k': jnp.zeros(shape, config.dtype, **kwargs),
+                'v': jnp.zeros(shape, config.dtype, **kwargs)}
+    if kv_dtype != 'int8':
+        raise ValueError(f'kv_dtype must be None or "int8", '
+                         f'got {kv_dtype!r}')
+    scale_kwargs = {}
+    if sharding is not None:
+        from skypilot_tpu.infer import tp as tp_lib
+        scale_kwargs = {'device': tp_lib.cache_scale_sharding(
+            sharding.mesh)}
+    return {'k': jnp.zeros(shape, jnp.int8, **kwargs),
+            'v': jnp.zeros(shape, jnp.int8, **kwargs),
+            'k_scale': jnp.zeros(shape[:-1], jnp.float32,
+                                 **scale_kwargs),
+            'v_scale': jnp.zeros(shape[:-1], jnp.float32,
+                                 **scale_kwargs)}
+
+
+def block_nbytes(config: llama.LlamaConfig, block_size: int,
+                 kv_dtype: Optional[str] = None) -> int:
+    """Device bytes of ONE physical block across all layers (K + V,
+    plus scales for int8) — the unit for converting prefix_cache_mb
+    byte budgets into pool blocks."""
+    elem = (1 if kv_dtype == 'int8'
+            else jnp.dtype(config.dtype).itemsize)
+    n = (2 * config.n_layers * block_size * config.n_kv_heads
+         * config.head_dim * elem)
+    if kv_dtype == 'int8':
+        n += 2 * config.n_layers * block_size * config.n_kv_heads * 4
+    return n
+
+
+class BlockPool:
+    """Host-side accounting for the pooled arena: free list, refcounts,
+    admission reservations.
+
+    Determinism note (multihost): every method is pure host math driven
+    by the same admission decisions on every host, and the free list is
+    LIFO — all hosts therefore assign identical block ids and upload
+    identical tables, which is what keeps the pooled decode program's
+    operands consistent across the fleet without any coordination.
+    """
+
+    def __init__(self, config: llama.LlamaConfig, n_blocks: int,
+                 block_size: int, sharding=None,
+                 kv_dtype: Optional[str] = None):
+        if n_blocks < 2:
+            raise ValueError(f'pool needs >= 2 blocks (1 garbage + 1 '
+                             f'allocatable), got {n_blocks}')
+        if block_size < 1:
+            raise ValueError(f'block_size must be >= 1, '
+                             f'got {block_size}')
+        self.config = config
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.arena = init_arena(config, n_blocks, block_size,
+                                sharding=sharding, kv_dtype=kv_dtype)
+        # LIFO free list: most-recently-freed block reused first (warm
+        # in whatever cache hierarchy cares; also the simplest
+        # deterministic order).  Block 0 is never a member.
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._refs = np.zeros(n_blocks, np.int32)
+        self._refs[GARBAGE_BLOCK] = 1  # pinned forever
+        self._reserved = 0
+        self.hwm = 0
+        self.table_appends = 0
+        self.prefix_shares = 0
+        self._publish()
+
+    # -- introspection ---------------------------------------------------
+
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def live_blocks(self) -> int:
+        """Blocks with refcount > 0, excluding the garbage block."""
+        return self.n_blocks - 1 - len(self._free)
+
+    def available(self) -> int:
+        """Free blocks not spoken for by an admission reservation."""
+        return len(self._free) - self._reserved
+
+    def refcount(self, block_id: int) -> int:
+        return int(self._refs[block_id])
+
+    # -- reservations (admission backpressure) ---------------------------
+
+    def reserve(self, k: int) -> bool:
+        """Claim k free blocks for an in-flight admission without
+        assigning ids yet.  Returns False (no side effects) when the
+        pool cannot cover it — the caller backs off instead of
+        discovering exhaustion mid-prefill."""
+        if k > self.available():
+            return False
+        self._reserved += k
+        return True
+
+    def unreserve(self, k: int) -> None:
+        if k > self._reserved:
+            raise AssertionError(
+                f'unreserve({k}) exceeds outstanding reservation '
+                f'{self._reserved}')
+        self._reserved -= k
+
+    # -- allocation ------------------------------------------------------
+
+    def alloc(self, k: int, *, from_reservation: bool = False
+              ) -> List[int]:
+        """Pop k blocks off the free list (refcount 1 each).
+
+        from_reservation: the caller holds a prior reserve() covering
+        these blocks — the reservation is drawn down so available()
+        stays truthful for concurrent admissions."""
+        if k > len(self._free):
+            raise PoolExhaustedError(
+                f'KV block pool exhausted: need {k} blocks, '
+                f'{len(self._free)} free of {self.n_blocks} total '
+                f'(block_size={self.block_size}). Raise '
+                f'GeneratorConfig.pool_blocks or lower concurrency.')
+        if from_reservation:
+            if k > self._reserved:
+                raise AssertionError(
+                    f'alloc(from_reservation) of {k} exceeds '
+                    f'reservation {self._reserved}')
+            self._reserved -= k
+        ids = [self._free.pop() for _ in range(k)]
+        self._refs[ids] = 1
+        self.hwm = max(self.hwm, self.live_blocks())
+        if k:
+            self.table_appends += k
+            telemetry_metrics.INFER_POOL_TABLE_APPENDS.inc(k)
+        self._publish()
+        return ids
+
+    def share(self, ids: Sequence[int], *, prefix: bool = False) -> None:
+        """Bump refcounts — a second owner (trie node or sequence) now
+        references the same physical blocks.  This IS the warm-prefix
+        data path: where the contiguous design copied KV rows
+        (install_prefix/extract_block), the pool copies nothing."""
+        for b in ids:
+            if self._refs[b] <= 0:
+                raise AssertionError(
+                    f'share of unreferenced block {b}')
+            self._refs[b] += 1
+        if prefix and ids:
+            self.prefix_shares += len(ids)
+            telemetry_metrics.INFER_POOL_PREFIX_SHARES.inc(len(ids))
+
+    def release(self, ids: Sequence[int]) -> None:
+        """Drop one reference per id; blocks reaching refcount 0 return
+        to the free list.  Shared blocks (live sequence + trie node)
+        survive until BOTH owners release — eviction can never free a
+        block out from under a reader."""
+        for b in ids:
+            if b == GARBAGE_BLOCK:
+                raise AssertionError('release of the garbage block')
+            if self._refs[b] <= 0:
+                raise AssertionError(
+                    f'release of already-free block {b}')
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+        self._publish()
+
+    # -- telemetry -------------------------------------------------------
+
+    def _publish(self) -> None:
+        telemetry_metrics.INFER_POOL_BLOCKS_TOTAL.set(self.n_blocks)
+        telemetry_metrics.INFER_POOL_BLOCKS_LIVE.set(self.live_blocks())
+        telemetry_metrics.INFER_POOL_BLOCKS_FREE.set(len(self._free))
+        telemetry_metrics.INFER_POOL_HWM.set(self.hwm)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            'blocks_total': self.n_blocks,
+            'blocks_live': self.live_blocks(),
+            'blocks_free': len(self._free),
+            'reserved': self._reserved,
+            'hwm': self.hwm,
+            'block_size': self.block_size,
+            'table_appends': self.table_appends,
+            'prefix_shares': self.prefix_shares,
+        }

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer import block_pool as block_pool_lib
 from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.models import llama
@@ -50,15 +51,21 @@ class GeneratorConfig:
     # the weight-stream bytes that dominate the decode roofline and
     # the params' HBM footprint.  Composes with kv_cache_dtype and tp.
     weights_dtype: Optional[str] = None
-    # 'inplace' (default): fori_loop decode with row-level cache
-    # scatter (no per-layer full-slice write-back); 'scan': the layer
-    # scan with cache in xs/ys; 'paged': inplace's cache layout with
-    # attention done by the Pallas decode kernel (ops/decode_attention)
-    # reading the stacked — possibly int8 — cache directly, so no
-    # dequantized K/V copy is ever materialized.  Requires
-    # max_seq_len % 64 == 0 and head_dim % 128 == 0.  Same math,
-    # different HBM traffic — see llama_infer.decode_step_inplace.
-    decode_impl: str = 'inplace'
+    # 'pooled' (default): the block-pool data plane
+    # (infer/block_pool.py) — one shared K/V arena, per-sequence block
+    # tables as traced operands, paged attention reads, scatter-at-
+    # position writes.  No bucket migrations, no per-bucket compiles,
+    # warm prefix hits are copy-free table splices.
+    # Legacy escape hatches (DEPRECATED for serving; retained for
+    # parity oracles and perf re-measurement — the bucketed contiguous
+    # cache they imply will not grow new features):
+    # 'inplace': fori_loop decode with row-level cache scatter over the
+    # bucketed contiguous cache; 'scan': the layer scan with cache in
+    # xs/ys; 'paged': inplace's cache layout with attention done by the
+    # Pallas decode kernel (ops/decode_attention) — requires every
+    # cache bucket % 64 == 0 (validated at construction) and
+    # head_dim % 128 == 0.  Same math, different HBM traffic.
+    decode_impl: str = 'pooled'
     # Chunked prefill (ContinuousBatcher only): prompts LONGER than
     # this many tokens prefill in prefill_chunk-sized windows
     # interleaved with decode ticks, so one long prompt cannot stall
@@ -91,8 +98,67 @@ class GeneratorConfig:
     # matched in prefix_block-sized chunks, and warm suffix prefill
     # runs in windows of this size (or prefill_chunk when set), so the
     # compile set stays bounded.  Align it with the common shared-head
-    # length; a block is only reusable wholesale.
+    # length; a block is only reusable wholesale.  Under the pooled
+    # data plane it must be a multiple of kv_block_size (a trie node
+    # then maps to whole arena blocks — validated at construction).
     prefix_block: int = 64
+    # Pooled arena block size in cache rows (decode_impl='pooled').
+    # None → 64 capped at max_seq_len, snapped down to divide
+    # prefix_block when the prefix cache is enabled.  Larger blocks
+    # amortize per-block DMA setup; smaller blocks waste fewer rows per
+    # sequence tail (avg block_size/2 rows) and give the prefix cache
+    # finer sharing.
+    kv_block_size: Optional[int] = None
+    # Physical blocks in the pooled arena (including the reserved
+    # garbage block 0).  None → enough for every slot to reach
+    # max_seq_len plus the prefix cache's byte budget — the "cannot
+    # exhaust" sizing.  Set explicitly to trade HBM for admission
+    # backpressure under overcommit.
+    pool_blocks: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kv_block_size is not None and self.kv_block_size < 1:
+            raise ValueError(f'kv_block_size must be >= 1, got '
+                             f'{self.kv_block_size}')
+        if self.pool_blocks is not None and self.pool_blocks < 2:
+            raise ValueError(f'pool_blocks must be >= 2 (garbage block '
+                             f'+ 1), got {self.pool_blocks}')
+        if self.decode_impl == 'pooled':
+            bs = self.derive_block_size()
+            if self.prefix_cache_mb and self.prefix_block % bs:
+                raise ValueError(
+                    f'prefix_block={self.prefix_block} must be a '
+                    f'multiple of kv_block_size={bs} under the pooled '
+                    f'data plane (a trie node must map to whole arena '
+                    f'blocks); pick kv_block_size from the divisors of '
+                    f'prefix_block')
+        if self.decode_impl == 'paged':
+            # The Pallas paged kernel reads the cache in
+            # DEFAULT_BLOCK-row blocks: every cache bucket the decode
+            # loop can allocate must be a block multiple.  Checked HERE
+            # so a bad bucket list fails with the fix spelled out
+            # instead of deep inside kernel tracing.
+            from skypilot_tpu.ops import decode_attention as _da
+            bad = [b for b in derive_cache_buckets(self)
+                   if b % _da.DEFAULT_BLOCK]
+            if bad:
+                raise ValueError(
+                    f"decode_impl='paged' requires every cache bucket "
+                    f'to be a multiple of the kernel block '
+                    f'{_da.DEFAULT_BLOCK}, but cache_buckets derive to '
+                    f'{derive_cache_buckets(self)} (offending: {bad}). '
+                    f'Round the buckets up, or use the default pooled '
+                    f'data plane which has no bucket constraint.')
+
+    def derive_block_size(self) -> int:
+        """Resolved pooled-arena block size (kv_block_size default)."""
+        if self.kv_block_size is not None:
+            return self.kv_block_size
+        bs = min(64, self.max_seq_len)
+        if self.prefix_cache_mb and self.prefix_block:
+            import math
+            bs = math.gcd(bs, self.prefix_block)
+        return bs
 
 
 def prepare_params(params, gen_config: 'GeneratorConfig'):
@@ -220,7 +286,45 @@ class Generator:
             raise ValueError(f'decode_chunk must be >= 1, got '
                              f'{gen_config.decode_chunk}')
 
-        self._prefill = jax.jit(self._prefill_impl)
+        # Pooled data plane (default): ONE arena for the Generator's
+        # lifetime; per-row block tables are host-mirrored and uploaded
+        # only when they change.  The pool outlives individual
+        # generate() calls so prefix-cache trie nodes can keep blocks
+        # live across requests (warm hits are table splices).
+        self.pooled = gen_config.decode_impl == 'pooled'
+        self.pool = None
+        if self.pooled:
+            bs = gen_config.derive_block_size()
+            self.block_size = bs
+            self.table_width = -(-gen_config.max_seq_len // bs)
+            n_blocks = gen_config.pool_blocks
+            if n_blocks is None:
+                # "Cannot exhaust" sizing: every slot to max_seq_len,
+                # plus the prefix cache's whole byte budget, plus the
+                # garbage block.
+                n_blocks = 1 + gen_config.batch_size * self.table_width
+                if gen_config.prefix_cache_mb:
+                    n_blocks += int(
+                        gen_config.prefix_cache_mb * 1e6
+                        // block_pool_lib.block_nbytes(
+                            config, bs, gen_config.kv_cache_dtype)) + 1
+            self.pool = block_pool_lib.BlockPool(
+                config, n_blocks, bs,
+                sharding=(None if mesh is None
+                          else tp_lib.cache_sharding(mesh)),
+                kv_dtype=gen_config.kv_cache_dtype)
+            self._host_tables = np.zeros(
+                (gen_config.batch_size, self.table_width), np.int32)
+            self._row_blocks = [[] for _ in
+                                range(gen_config.batch_size)]
+            self._tables_dev = jnp.asarray(self._host_tables)
+            self._tables_dirty = False
+
+        if self.pooled:
+            self._prefill = jax.jit(self._prefill_pooled_impl,
+                                    donate_argnums=(2,))
+        else:
+            self._prefill = jax.jit(self._prefill_impl)
         # Fused multi-step decode (fori_loop over steps with in-loop
         # sampling + EOS/done tracking): ONE host fetch per chunk
         # instead of one per token — the per-token device→host sync
@@ -255,12 +359,24 @@ class Generator:
         # start-offset window path below; the matched blocks are
         # installed device-to-device.  Window length is fixed at
         # prefix_block so the compile set stays one per cache bucket.
-        self.prefix = prefix_cache.make_prefix_cache(gen_config)
+        self.prefix = prefix_cache.make_prefix_cache(
+            gen_config, pool=self.pool)
         if self.prefix is not None:
-            self._prefill_window = jax.jit(
-                lambda p, t, c, s, st: llama_infer.prefill_window(
-                    p, t, self.config, c, s, st),
-                donate_argnums=(2,))
+            if self.pooled:
+                # Pooled window prefill writes through the row's block
+                # table; a warm hit never calls install/extract — the
+                # matched blocks are spliced into the table on the
+                # host, zero device copies.
+                self._prefill_window = jax.jit(
+                    lambda p, t, c, tr, st:
+                    llama_infer.prefill_window_pooled(
+                        p, t, self.config, c, tr, st),
+                    donate_argnums=(2,))
+            else:
+                self._prefill_window = jax.jit(
+                    lambda p, t, c, s, st: llama_infer.prefill_window(
+                        p, t, self.config, c, s, st),
+                    donate_argnums=(2,))
             self._window_logits = jax.jit(self._window_logits_impl)
 
     def _prefill_impl(self, params, tokens, cache, lengths):
@@ -268,6 +384,26 @@ class Generator:
             params, tokens, config=self.config, cache=cache,
             lengths=lengths)
         return logits, self._constrain(cache)
+
+    def _prefill_pooled_impl(self, params, tokens, arena, lengths,
+                             tables_scatter):
+        """Cold prefill into the pooled arena: the contiguous prefill
+        runs into a jit-internal scratch cache (never materialized
+        outside the compiled program), then one blocked scatter moves
+        it into the rows' arena blocks (tables_scatter (B, nb)).  The
+        arena is donated — prefill cost stays one forward + one
+        cache-sized write, same as the contiguous path."""
+        batch, bucket = tokens.shape
+        nb = tables_scatter.shape[1]
+        scratch = llama_infer.init_cache(
+            self.config, batch, nb * self.block_size,
+            kv_dtype=self.gen.kv_cache_dtype)
+        logits, scratch = llama_infer.prefill(
+            params, tokens, config=self.config, cache=scratch,
+            lengths=lengths)
+        arena = llama_infer.scatter_prefill_pooled(
+            scratch, arena, tables_scatter)
+        return logits, self._constrain(arena)
 
     def _constrain(self, cache):
         if self.mesh is None:
@@ -300,29 +436,56 @@ class Generator:
         for i, p in enumerate(prompts):
             m = pc.match(p)
             pc.commit(m)
-            cache = pc.install(cache, i, m)
+            if self.pooled:
+                # Warm hit = host-side table splice: the matched trie
+                # nodes' arena blocks become the row's first table
+                # entries with a refcount bump — ZERO install/extract
+                # device copies.  Then own fresh blocks covering the
+                # un-matched prompt tail.
+                ids = pc.splice(m)
+                self._host_tables[i, :len(ids)] = ids
+                self._row_blocks[i].extend(ids)
+                need = -(-len(p) // self.block_size)
+                if need > len(ids):
+                    fresh = self.pool.alloc(need - len(ids))
+                    self._host_tables[i, len(ids):need] = fresh
+                    self._row_blocks[i].extend(fresh)
+                self._tables_dirty = True
+                table_row = jnp.asarray(self._host_tables[i])
+            else:
+                cache = pc.install(cache, i, m)
             h_last = None
             last_start = start = m.tokens
             while start < len(p):
                 end = min(start + blk, len(p))
                 window = np.zeros((blk,), np.int32)
                 window[:end - start] = np.asarray(p[start:end], np.int32)
-                h_last, cache = self._prefill_window(
-                    self.params, jnp.asarray(window), cache,
-                    jnp.int32(i), jnp.int32(start))
+                if self.pooled:
+                    h_last, cache = self._prefill_window(
+                        self.params, jnp.asarray(window), cache,
+                        table_row, jnp.int32(start))
+                else:
+                    h_last, cache = self._prefill_window(
+                        self.params, jnp.asarray(window), cache,
+                        jnp.int32(i), jnp.int32(start))
                 last_start = start
                 start = end
             m.release()
             rows.append(self._window_logits(
                 self.params, h_last, jnp.int32(len(p) - 1 - last_start)))
-            pc.insert(p, functools.partial(pc.extract, cache, i))
+            if self.pooled:
+                # Cache the prompt's head by SHARING the row's own
+                # blocks with new trie nodes — again no device copy.
+                pc.insert(p, blocks=self._row_blocks[i])
+            else:
+                pc.insert(p, functools.partial(pc.extract, cache, i))
         rows.extend(jnp.zeros((vocab,), jnp.float32)
                     for _ in range(batch - len(prompts)))
         return jnp.stack(rows), cache
 
     def _decode_chunk_impl(self, params, token, cache, positions, done,
-                           limit, rng, *, n, temperature, top_k, top_p,
-                           eos):
+                           limit, rng, tables=None, *, n, temperature,
+                           top_k, top_p, eos):
         """n fused decode steps fully on device (fori_loop): in-loop
         sampling (greedy or temperature/top-k/top-p via the shared
         Gumbel-max sampler) and per-row EOS/budget tracking, emitting a
@@ -332,7 +495,17 @@ class Generator:
         costing nothing extra) and they emit the fill token; `limit` is
         each row's remaining token budget, decremented only while
         live."""
-        decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
+        if self.gen.decode_impl == 'pooled':
+            # Block tables ride the closure as a TRACED operand: a
+            # sequence growing past its blocks re-uploads the (B, T)
+            # table, never changing the compiled shape — the whole
+            # bucket-migration compile family collapses to <= 2 decode
+            # programs (full chunk + context-ceiling tail).
+            def decode_fn(params, token, config, cache, positions):
+                return llama_infer.decode_step_pooled(
+                    params, token, config, cache, positions, tables)
+        else:
+            decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
         batch = token.shape[0]
         fill = jnp.int32(eos if eos is not None else 0)
 
@@ -366,6 +539,33 @@ class Generator:
         return (rep(jnp.swapaxes(toks, 0, 1)), token,
                 self._constrain(cache), rep(positions), rep(done),
                 limit, rng)
+
+    def _ensure_blocks(self, rows, host_positions, n) -> None:
+        """Grow block tables so every live row can write through
+        position + n - 1 this chunk: append ids from the free list to
+        the HOST table mirror (uploaded once per chunk if dirty).  This
+        is the pooled replacement for bucket-grow migrations — list
+        math and a (B, T) int32 upload, no cache copy, no recompile."""
+        for i in rows:
+            need = -(-(int(host_positions[i]) + n) // self.block_size)
+            need = min(need, self.table_width)
+            have = len(self._row_blocks[i])
+            if need > have:
+                ids = self.pool.alloc(need - have)
+                self._host_tables[i, have:need] = ids
+                self._row_blocks[i].extend(ids)
+                self._tables_dirty = True
+
+    def _release_rows(self) -> None:
+        """Drop every row's block references (shared prefix blocks
+        survive via the trie's own refcounts) and zero the table
+        mirrors so freed blocks can never be addressed again."""
+        for i in range(self.gen.batch_size):
+            if self._row_blocks[i]:
+                self.pool.release(self._row_blocks[i])
+                self._row_blocks[i] = []
+        self._host_tables[:] = 0
+        self._tables_dirty = True
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -416,27 +616,63 @@ class Generator:
             tokens[i, :len(p)] = np.asarray(p, np.int32)
             lens[i] = len(p)
 
-        # Bucketed cache: allocate at the smallest bucket covering the
-        # prefill write (bucket rows) and the first decode write
-        # (max prompt len + 1), NOT at max_seq_len — per-step attention
-        # HBM traffic scales with the live bucket.  Grows later as
-        # generations cross bucket boundaries.
-        cache_len = self._cache_bucket_for(max(bucket, max(lengths) + 1))
-        cache = llama_infer.init_cache(
-            self.config, batch, cache_len,
-            sharding=(None if self.mesh is None
-                      else tp_lib.cache_sharding(self.mesh)),
-            kv_dtype=self.gen.kv_cache_dtype)
         prefill_start = time.perf_counter()
-        if self.prefix is not None:
-            # Prefix-cache path: per-row window prefill so matched head
-            # blocks can be skipped (and missed prompts still populate
-            # the trie for the next request sharing their head).
-            logits, cache = self._prefix_prefill(prompts, cache)
+        if self.pooled:
+            # Pooled data plane: the arena already exists (pool, one
+            # process-lifetime allocation); prefill needs each row to
+            # own blocks covering the prompt bucket.  Per-step decode
+            # HBM traffic scales with LIVE context via the block-table
+            # kernel, so there is no cache_len to pick and nothing to
+            # migrate later.
+            cache_len = self.table_width * self.block_size
+            cache = self.pool.arena
+            try:
+                if self.prefix is not None:
+                    logits, cache = self._prefix_prefill(prompts, cache)
+                else:
+                    nb = -(-bucket // self.block_size)
+                    tables_scatter = np.zeros((batch, nb), np.int32)
+                    for i in range(batch):
+                        ids = self.pool.alloc(nb)
+                        self._host_tables[i, :nb] = ids
+                        self._row_blocks[i].extend(ids)
+                        tables_scatter[i] = ids
+                    self._tables_dirty = True
+                    logits, cache = self._prefill(
+                        self.params, jnp.asarray(tokens), cache,
+                        jnp.asarray(lens), jnp.asarray(tables_scatter))
+            except block_pool_lib.PoolExhaustedError:
+                # Nothing was dispatched: return the rows claimed so
+                # far so a sizing mistake cannot also leak blocks.
+                self._release_rows()
+                raise
+            # The arena was donated through prefill: rebind before any
+            # exit path can leave the pool pointing at a dead buffer.
+            self.pool.arena = cache
         else:
-            logits, cache = self._prefill(self.params, jnp.asarray(tokens),
-                                          cache=cache,
-                                          lengths=jnp.asarray(lens))
+            # Bucketed cache (legacy decode_impls): allocate at the
+            # smallest bucket covering the prefill write (bucket rows)
+            # and the first decode write (max prompt len + 1), NOT at
+            # max_seq_len — per-step attention HBM traffic scales with
+            # the live bucket.  Grows later as generations cross bucket
+            # boundaries.
+            cache_len = self._cache_bucket_for(
+                max(bucket, max(lengths) + 1))
+            cache = llama_infer.init_cache(
+                self.config, batch, cache_len,
+                sharding=(None if self.mesh is None
+                          else tp_lib.cache_sharding(self.mesh)),
+                kv_dtype=self.gen.kv_cache_dtype)
+            if self.prefix is not None:
+                # Prefix-cache path: per-row window prefill so matched
+                # head blocks can be skipped (and missed prompts still
+                # populate the trie for the next request sharing their
+                # head).
+                logits, cache = self._prefix_prefill(prompts, cache)
+            else:
+                logits, cache = self._prefill(
+                    self.params, jnp.asarray(tokens), cache=cache,
+                    lengths=jnp.asarray(lens))
         rng = jax.random.PRNGKey(seed)
         rng, sub = jax.random.split(rng)
         token = self._sample(logits, sub)
@@ -502,20 +738,33 @@ class Generator:
                     n = min(chunk, self.gen.max_seq_len - live_max)
                     if n <= 0:
                         break
-                    # Bucket crossing: this chunk's last write lands at
-                    # row live_max + n - 1 → migrate before dispatch.
-                    target = self._cache_bucket_for(live_max + n)
-                    if target != cache_len:
-                        telemetry_metrics.INFER_CACHE_MIGRATIONS.labels(
-                            direction=('grow' if target > cache_len
-                                       else 'shrink')).inc()
-                        cache = self._resize(cache, new_len=target)
-                        cache_len = target
+                    if self.pooled:
+                        # No migrations: growth is a free-list append
+                        # to the host tables, uploaded only on change.
+                        self._ensure_blocks(live, host_positions, n)
+                        if self._tables_dirty:
+                            self._tables_dev = jnp.asarray(
+                                self._host_tables)
+                            self._tables_dirty = False
+                        tables_arg = self._tables_dev
+                    else:
+                        # Bucket crossing: this chunk's last write
+                        # lands at row live_max + n - 1 → migrate
+                        # before dispatch.
+                        target = self._cache_bucket_for(live_max + n)
+                        if target != cache_len:
+                            telemetry_metrics.INFER_CACHE_MIGRATIONS \
+                                .labels(direction=(
+                                    'grow' if target > cache_len
+                                    else 'shrink')).inc()
+                            cache = self._resize(cache, new_len=target)
+                            cache_len = target
+                        tables_arg = None
                     chunk_start = time.perf_counter()
                     (toks, token, cache, positions, done_dev, limit_dev,
                      rng) = self._decode_chunk(
                          self.params, token, cache, positions, done_dev,
-                         limit_dev, rng, n=n)
+                         limit_dev, rng, tables_arg, n=n)
                     # ONE transfer for the whole chunk: token block +
                     # the control rows that steer the next iteration.
                     host_toks, host_positions, host_done = host_fetch(
@@ -534,6 +783,13 @@ class Generator:
                         break
             return [out[i] for i in range(len(prompts))]
         finally:
+            if self.pooled:
+                # Rebind the (donated) arena and return every row's
+                # blocks; blocks the trie shares stay live under its
+                # refcounts — the pool's free + live == total invariant
+                # holds between generate() calls.
+                self.pool.arena = cache
+                self._release_rows()
             if decode_seconds > 0:
                 telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
                     dispatched / decode_seconds)

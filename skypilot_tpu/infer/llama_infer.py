@@ -308,6 +308,145 @@ def prefill_window(params: llama.Params, tokens_w: jax.Array,
     return h[0], cache
 
 
+def scatter_prefill_pooled(small: Cache, arena: Cache,
+                           tables_scatter: jax.Array) -> Cache:
+    """Move a contiguous prefill cache into pooled arena blocks.
+
+    small: a (L, B, S, KV, hd) cache freshly filled by `prefill` (plus
+    (L, B, S, KV) scales when int8) — a jit-internal scratch, never
+    materialized outside the compiled prefill program.
+    arena: the pooled (L, NB, BS, KV, hd) arena.
+    tables_scatter: (B, nb) int32 with nb == ceil(S / BS) — the arena
+    blocks owned by each row for its first nb logical blocks.
+
+    S is padded up to a BS multiple first (pad rows land in owned
+    blocks above every row's true length, exactly like contiguous
+    prefill's pad region: invisible to the `slot <= position` mask and
+    overwritten by the first decode writes that reach them).  The
+    scatter is one blocked dynamic-update per key — prefill cost stays
+    one forward + one cache-sized write.
+    """
+    bs = arena['k'].shape[2]
+    s_len = small['k'].shape[2]
+    pad = (-s_len) % bs
+    nb = (s_len + pad) // bs
+    out = dict(arena)
+    for key, arr in small.items():
+        if pad:
+            widths = [(0, 0)] * arr.ndim
+            widths[2] = (0, pad)
+            arr = jnp.pad(arr, widths)
+        n_layers, batch = arr.shape[:2]
+        resh = arr.reshape((n_layers, batch, nb, bs) + arr.shape[3:])
+        out[key] = out[key].at[:, tables_scatter].set(resh)
+    return out
+
+
+def prefill_window_pooled(params: llama.Params, tokens_w: jax.Array,
+                          config: llama.LlamaConfig, cache: Cache,
+                          table_row: jax.Array, start: jax.Array
+                          ) -> Tuple[jax.Array, Cache]:
+    """prefill_window over the pooled arena: advance ONE sequence's
+    prefill by a fixed window, writing the window's K/V through its
+    block table.
+
+    cache: pooled (L, NB, BS, KV, hd) arena; table_row: (T,) int32 —
+    the sequence's block table.  Window rows whose logical index falls
+    past the table (only ever PAD rows of the final window — callers
+    allocate blocks covering the true prompt) are routed to the
+    reserved garbage block 0, never a live block.  The window attends
+    over the gathered (T*BS, KV, hd) logical view with the same
+    `key <= query position` mask as the contiguous version, so chunked
+    prefill stays token-identical to whole-prompt prefill (tested).
+    """
+    (w,) = tokens_w.shape
+    bs = cache['k'].shape[2]
+    (t_width,) = table_row.shape
+    s_len = t_width * bs
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, s_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, tokens_w[None], config)  # (1, W, d)
+    q_pos = start + jnp.arange(w, dtype=jnp.int32)          # (W,)
+    visible = jnp.arange(s_len)[None, :] <= q_pos[:, None]  # (W, S')
+    quantized = 'k_scale' in cache
+    dest = start + jnp.arange(w, dtype=jnp.int32)
+    blk_idx = dest // bs
+    # Out-of-table pad rows -> garbage block 0 (clamp first: the table
+    # lookup itself must stay in bounds).
+    blk = jnp.where(blk_idx >= t_width, 0,
+                    table_row[jnp.minimum(blk_idx, t_width - 1)])
+    off = dest % bs
+    group = config.n_heads // config.n_kv_heads
+    scale = config.head_dim ** -0.5
+
+    def body(i, carry):
+        h, cache = carry
+        layer_params = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
+                                                   keepdims=False),
+            params['layers'])
+        attn_p = layer_params['attn']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)       # (1, W, H/KV, hd)
+        q = rope_ops.apply_rope(q, cos, sin, positions=q_pos[None])
+        k = rope_ops.apply_rope(k, cos, sin, positions=q_pos[None])
+        if quantized:
+            k_q, k_s = _quantize_kv(k[0])
+            v_q, v_s = _quantize_kv(v[0])
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k_q),
+                v=cache['v'].at[i, blk, off].set(v_q),
+                k_scale=cache['k_scale'].at[i, blk, off].set(k_s),
+                v_scale=cache['v_scale'].at[i, blk, off].set(v_s))
+            k_layer = jax.lax.dynamic_index_in_dim(cache['k'], i, 0,
+                                                   False)
+            v_layer = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
+                                                   False)
+            ks_layer = jax.lax.dynamic_index_in_dim(
+                cache['k_scale'], i, 0, False)
+            vs_layer = jax.lax.dynamic_index_in_dim(
+                cache['v_scale'], i, 0, False)
+            k_slot = _dequantize(
+                k_layer[table_row].reshape(s_len, config.n_kv_heads,
+                                           config.head_dim),
+                ks_layer[table_row].reshape(s_len, config.n_kv_heads),
+                q.dtype)
+            v_slot = _dequantize(
+                v_layer[table_row].reshape(s_len, config.n_kv_heads,
+                                           config.head_dim),
+                vs_layer[table_row].reshape(s_len, config.n_kv_heads),
+                q.dtype)
+        else:
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k[0]),
+                v=cache['v'].at[i, blk, off].set(v[0]))
+            k_slot = jax.lax.dynamic_index_in_dim(
+                cache['k'], i, 0, False)[table_row].reshape(
+                    s_len, config.n_kv_heads, config.head_dim)
+            v_slot = jax.lax.dynamic_index_in_dim(
+                cache['v'], i, 0, False)[table_row].reshape(
+                    s_len, config.n_kv_heads, config.head_dim)
+        q_g = q[0].reshape(w, config.n_kv_heads, group, config.head_dim)
+        s = jnp.einsum('wkgd,skd->kgws', q_g, k_slot,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(visible[None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o = jnp.einsum('kgws,skd->wkgd', p, v_slot)
+        h = h + quant.matmul(o.reshape(1, w, -1), attn_p['wo'])
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                 eps=config.norm_eps)
+        h = h + _ffn(x, layer_params, config)
+        return (h, cache)
+
+    h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    return h[0], cache
+
+
 def encode(params: llama.Params, tokens: jax.Array,
            config: llama.LlamaConfig, lengths: jax.Array) -> jax.Array:
     """Mean-pooled final hidden states (B, d) over each row's valid
@@ -383,7 +522,12 @@ def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config,
 
 def get_decode_fn(impl: str):
     """Decode implementation by name — rejects unknown values so a typo
-    cannot silently select the slower path."""
+    cannot silently select the slower path.
+
+    Note 'pooled' (the default data plane) is dispatched by the engines
+    directly — decode_step_pooled takes a block-table operand the other
+    implementations don't — but it is accepted here so introspection
+    and validation treat the canonical name uniformly."""
     if impl == 'inplace':
         return decode_step_inplace
     if impl == 'scan':
@@ -392,9 +536,11 @@ def get_decode_fn(impl: str):
         return decode_step_unrolled
     if impl == 'paged':
         return decode_step_paged
+    if impl == 'pooled':
+        return decode_step_pooled
     raise ValueError(
-        f"decode_impl must be 'inplace', 'scan', 'unroll' or 'paged', "
-        f'got {impl!r}')
+        f"decode_impl must be 'pooled', 'inplace', 'scan', 'unroll' or "
+        f"'paged', got {impl!r}")
 
 
 def decode_step_inplace(params: llama.Params, token: jax.Array,
@@ -553,6 +699,121 @@ def decode_step_paged(params: llama.Params, token: jax.Array,
         x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                  eps=config.norm_eps)
         h = h + _ffn(x, layer_params, config)
+        return (h, cache)
+
+    h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    logits = quant.matmul(h[:, 0], params['lm_head'],
+                          out_dtype=jnp.float32)
+    return logits, cache
+
+
+def decode_step_pooled(params: llama.Params, token: jax.Array,
+                       config: llama.LlamaConfig, cache: Cache,
+                       positions: jax.Array, tables: jax.Array
+                       ) -> Tuple[jax.Array, Cache]:
+    """One-token step over the pooled block arena (the default data
+    plane, infer/block_pool.py).
+
+    cache: k/v (L, NB, BS, KV, hd) pooled arena (+ (L, NB, BS, KV) f32
+    scales when int8) — NB physical blocks shared by every slot.
+    tables: (B, T) int32 — tables[b, j] is the arena block holding slot
+    b's logical rows [j*BS, (j+1)*BS); unmapped entries are 0, the
+    reserved garbage block (never allocated, never read: the length
+    mask hides every logical row the table does not really back).
+
+    Write: the new K/V row scatters to (layer, tables[b, pos//BS],
+    pos % BS) — same ~rows-sized scatter as decode_step_inplace, the
+    arena riding the fori_loop carry so XLA updates it in place.
+    Read: on TPU the Pallas pooled kernel streams only each slot's live
+    blocks through the block table (traffic ~ live context, the whole
+    point of the pool); elsewhere a gather through the table
+    materializes the (B, T*BS, KV, hd) logical view and reuses
+    _token_attn_mlp — exact, portable, and what the CPU test suite
+    runs.  Both sides mask with `slot <= position`, so greedy parity
+    with decode_step_inplace is bit-exact (tested).
+
+    tables is a TRACED operand: growing a sequence appends free-list
+    blocks and re-uploads the table — no shape change, no recompile,
+    no resize_cache migration.
+    """
+    batch = token.shape[0]
+    bs = cache['k'].shape[2]
+    t_width = tables.shape[1]
+    s_len = t_width * bs
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, s_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, token, config)[:, None]  # (B, 1, d)
+    pos = positions[:, None].astype(jnp.int32)
+    slot = jnp.arange(s_len)[None, :]
+    visible = slot <= pos
+    quantized = 'k_scale' in cache
+    b_idx = jnp.arange(batch)
+    group = config.n_heads // config.n_kv_heads
+    use_kernel = (jax.default_backend() == 'tpu'
+                  and config.head_dim % 128 == 0)
+    blk = tables[b_idx, positions.astype(jnp.int32) // bs]   # (B,)
+    off = positions.astype(jnp.int32) % bs                   # (B,)
+
+    def body(i, carry):
+        h, cache = carry
+        layer_params = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
+                                                   keepdims=False),
+            params['layers'])
+        attn_p = layer_params['attn']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)
+        q = rope_ops.apply_rope(q, cos, sin, positions=pos)
+        k = rope_ops.apply_rope(k, cos, sin, positions=pos)
+        if quantized:
+            k_row, k_s_row = _quantize_kv(k[:, 0])
+            v_row, v_s_row = _quantize_kv(v[:, 0])
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k_row),
+                v=cache['v'].at[i, blk, off].set(v_row),
+                k_scale=cache['k_scale'].at[i, blk, off].set(k_s_row),
+                v_scale=cache['v_scale'].at[i, blk, off].set(v_s_row))
+        else:
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k[:, 0]),
+                v=cache['v'].at[i, blk, off].set(v[:, 0]))
+        if use_kernel:
+            q_r = q[:, 0].reshape(batch, config.n_kv_heads, group,
+                                  config.head_dim)
+            o = decode_attention_ops.decode_attention_pooled(
+                q_r, cache['k'], cache['v'], tables, i,
+                positions.astype(jnp.int32),
+                cache.get('k_scale'), cache.get('v_scale'))
+            h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
+            x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                     eps=config.norm_eps)
+            h = h + _ffn(x, layer_params, config)
+        else:
+            k_layer = jax.lax.dynamic_index_in_dim(cache['k'], i, 0,
+                                                   False)
+            v_layer = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
+                                                   False)
+            k_eff = k_layer[tables].reshape(
+                batch, s_len, config.n_kv_heads, config.head_dim)
+            v_eff = v_layer[tables].reshape(
+                batch, s_len, config.n_kv_heads, config.head_dim)
+            if quantized:
+                k_s = jax.lax.dynamic_index_in_dim(
+                    cache['k_scale'], i, 0, False)[tables].reshape(
+                        batch, s_len, config.n_kv_heads)
+                v_s = jax.lax.dynamic_index_in_dim(
+                    cache['v_scale'], i, 0, False)[tables].reshape(
+                        batch, s_len, config.n_kv_heads)
+            else:
+                k_s = v_s = None
+            h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff,
+                                visible, config, k_scale=k_s,
+                                v_scale=v_s)
         return (h, cache)
 
     h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))

@@ -92,18 +92,24 @@ def extract_block(cache: Block, slot, start, *, block: int) -> Block:
 
 
 class _Node:
-    """One trie node: a block of tokens plus its device K/V arrays."""
+    """One trie node: a block of tokens plus its K/V — standalone
+    device arrays (legacy contiguous mode) or a list of pool block ids
+    whose refcounts the node holds (pooled mode)."""
 
     __slots__ = ('key', 'parent', 'children', 'data', 'nbytes', 'refs',
                  'last_used')
 
     def __init__(self, key: Tuple[int, ...], parent: Optional['_Node'],
-                 data: Optional[Block] = None):
+                 data=None, nbytes: Optional[int] = None):
         self.key = key
         self.parent = parent
         self.children: Dict[Tuple[int, ...], _Node] = {}
         self.data = data
-        self.nbytes = sum(a.nbytes for a in data.values()) if data else 0
+        if nbytes is not None:
+            self.nbytes = nbytes
+        else:
+            self.nbytes = (sum(a.nbytes for a in data.values())
+                           if data else 0)
         self.refs = 0
         self.last_used = 0
 
@@ -131,11 +137,28 @@ class PrefixCache:
     """Host-side radix trie over prompt prefixes owning device K/V
     blocks, with byte-budgeted LRU eviction and ref-count pinning."""
 
-    def __init__(self, block: int, capacity_bytes: int):
+    def __init__(self, block: int, capacity_bytes: int, pool=None):
+        """pool: a block_pool.BlockPool — POOLED mode.  Nodes then hold
+        arena block IDS (with a refcount each) instead of owned device
+        arrays: install becomes a host-side table splice (`splice`),
+        insert shares the live row's blocks, and eviction returns ids
+        to the pool's free list when the last reference drops.  The
+        jitted install/extract copies below are never dispatched in
+        pooled mode — a warm hit costs zero device copies."""
         if block <= 0:
             raise ValueError(f'prefix block must be positive, got {block}')
         self.block = int(block)
         self.capacity_bytes = int(capacity_bytes)
+        self.pool = pool
+        if pool is not None:
+            if block % pool.block_size:
+                raise ValueError(
+                    f'prefix block {block} must be a multiple of the '
+                    f'pool block_size {pool.block_size}')
+            self._ids_per_node = block // pool.block_size
+            self._pool_block_nbytes = (
+                sum(a.nbytes for a in pool.arena.values())
+                // pool.n_blocks)
         self._root = _Node((), None)
         self._clock = 0
         # Instance mirrors of the REGISTRY counters (the registry is
@@ -203,22 +226,47 @@ class PrefixCache:
     def install(self, cache: Block, slot: int, match: PrefixMatch) -> Block:
         """Install the matched blocks into ``cache`` rows for ``slot``
         (device-to-device; donates and returns the cache).  The caller
-        must have grown the cache to cover ``match.tokens`` positions."""
+        must have grown the cache to cover ``match.tokens`` positions.
+        Legacy contiguous mode only — pooled engines use ``splice``."""
         for i, node in enumerate(match.nodes):
             cache = self._install(cache, node.data, jnp.int32(slot),
                                   jnp.int32(i * self.block))
         return cache
 
+    def splice(self, match: PrefixMatch) -> List[int]:
+        """POOLED-mode install: the flat arena block ids of the matched
+        nodes, refcount-bumped for the sequence about to reference them
+        through its block table.  This is the whole warm-hit data path
+        — pure host list math, zero device copies (each shared id
+        replaces one install_prefix dispatch of the legacy design).
+        The caller owns one release of every returned id (the engines
+        release rows wholesale at completion)."""
+        ids: List[int] = []
+        for node in match.nodes:
+            ids.extend(node.data)
+        self.pool.share(ids, prefix=True)
+        return ids
+
     # -- insertion --------------------------------------------------------
 
     def insert(self, tokens: Sequence[int],
-               extractor: Callable[[int], Block]) -> int:
-        """Insert ``tokens``' full blocks, calling ``extractor(start)``
-        only for blocks not already cached (device-to-device copy out of
-        the freshly prefilled slot rows).  Returns the number of new
-        blocks stored.  May evict LRU unreferenced blocks to hold the
-        byte budget — including, if the budget is very small, blocks
-        just inserted (newest-recency, so they go last)."""
+               extractor: Optional[Callable[[int], Block]] = None,
+               blocks: Optional[Sequence[int]] = None) -> int:
+        """Insert ``tokens``' full blocks into the trie.
+
+        Legacy contiguous mode: ``extractor(start)`` is called only for
+        blocks not already cached (device-to-device copy out of the
+        freshly prefilled slot rows).
+
+        Pooled mode: ``blocks`` is the live sequence's arena block id
+        list covering the prompt; a new trie node SHARES the ids
+        backing its token block (refcount bump, no copy) — the node
+        keeps them alive after the sequence completes.
+
+        Returns the number of new blocks stored.  May evict LRU
+        unreferenced blocks to hold the byte budget — including, if the
+        budget is very small, blocks just inserted (newest-recency, so
+        they go last)."""
         toks = tuple(int(t) for t in tokens)
         node = self._root
         created = 0
@@ -226,8 +274,17 @@ class PrefixCache:
             key = toks[b * self.block:(b + 1) * self.block]
             child = node.children.get(key)
             if child is None:
-                data = extractor(b * self.block)
-                child = _Node(key, node, data)
+                if self.pool is not None:
+                    lo = b * self._ids_per_node
+                    ids = list(blocks[lo:lo + self._ids_per_node])
+                    if len(ids) < self._ids_per_node:
+                        break  # prompt tail not fully backed; stop here
+                    self.pool.share(ids)
+                    child = _Node(key, node, ids,
+                                  nbytes=(len(ids)
+                                          * self._pool_block_nbytes))
+                else:
+                    child = _Node(key, node, extractor(b * self.block))
                 node.children[key] = child
                 self.bytes += child.nbytes
                 self.node_count += 1
@@ -250,29 +307,63 @@ class PrefixCache:
             n.refs -= 1
             self._touch(n)
 
+    def _lru_victim(self) -> Optional[_Node]:
+        """LRU leaf with no children and no live refs, or None when
+        everything left is pinned — interior nodes and referenced nodes
+        are never candidates, so an in-flight match can always
+        complete."""
+        victim = None
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n.refs == 0 and (victim is None
+                                  or n.last_used < victim.last_used):
+                victim = n
+        return victim
+
+    def _drop(self, victim: _Node) -> None:
+        del victim.parent.children[victim.key]
+        self.bytes -= victim.nbytes
+        self.node_count -= 1
+        self.evictions += 1
+        if self.pool is not None:
+            # The node's reference on its arena blocks drops; ids whose
+            # refcount hits 0 (no live sequence still reading them)
+            # return to the free list — NEVER while a sequence holds
+            # them (the pool refuses to free refcount > 0).
+            self.pool.release(victim.data)
+        telemetry_metrics.INFER_PREFIX_EVICTIONS.inc()
+        telemetry_metrics.INFER_PREFIX_BYTES.set(self.bytes)
+
     def _evict_to_budget(self) -> None:
-        """Evict LRU leaves (no children, no live refs) until under
-        budget.  Evicting a leaf may expose its parent as the next
-        candidate; interior nodes and referenced nodes are never
-        touched, so an in-flight match can always complete."""
+        """Evict LRU leaves until under the byte budget.  Evicting a
+        leaf may expose its parent as the next candidate."""
         while self.bytes > self.capacity_bytes:
-            victim = None
-            stack = list(self._root.children.values())
-            while stack:
-                n = stack.pop()
-                if n.children:
-                    stack.extend(n.children.values())
-                elif n.refs == 0 and (victim is None
-                                      or n.last_used < victim.last_used):
-                    victim = n
+            victim = self._lru_victim()
             if victim is None:       # everything left is pinned
                 break
-            del victim.parent.children[victim.key]
-            self.bytes -= victim.nbytes
-            self.node_count -= 1
-            self.evictions += 1
-            telemetry_metrics.INFER_PREFIX_EVICTIONS.inc()
-            telemetry_metrics.INFER_PREFIX_BYTES.set(self.bytes)
+            self._drop(victim)
+
+    def evict_for_pool(self, need_blocks: int) -> int:
+        """POOLED-mode admission pressure valve: evict LRU unreferenced
+        nodes until the pool could satisfy ``need_blocks`` more, or no
+        evictable node remains.  Only nodes whose blocks are not shared
+        with a live sequence actually free pool blocks (refcount 0);
+        shared nodes still leave the trie (their bytes no longer count
+        against the budget) but the blocks stay live until the sequence
+        completes.  Returns the number of nodes evicted."""
+        if self.pool is None:
+            return 0
+        evicted = 0
+        while self.pool.available() < need_blocks:
+            victim = self._lru_victim()
+            if victim is None:
+                break
+            self._drop(victim)
+            evicted += 1
+        return evicted
 
     def extract(self, cache: Block, slot: int, start: int) -> Block:
         """Jitted block copy out of a slot's cache rows (see
@@ -281,12 +372,15 @@ class PrefixCache:
                              block=self.block)
 
 
-def make_prefix_cache(config) -> Optional[PrefixCache]:
+def make_prefix_cache(config, pool=None) -> Optional[PrefixCache]:
     """Build a PrefixCache from a GeneratorConfig, or None when
-    disabled (``prefix_cache_mb`` unset/0)."""
+    disabled (``prefix_cache_mb`` unset/0).  ``pool``: the engine's
+    BlockPool for the pooled (copy-free) mode; None = legacy
+    standalone-block mode."""
     mb = getattr(config, 'prefix_cache_mb', None)
     if not mb:
         return None
     block = int(getattr(config, 'prefix_block', 0) or 0)
     return PrefixCache(block=block,
-                       capacity_bytes=int(float(mb) * 1024 * 1024))
+                       capacity_bytes=int(float(mb) * 1024 * 1024),
+                       pool=pool)

@@ -5,13 +5,19 @@ recipes (llm/vllm/service.yaml): requests join and leave the decode batch
 WITHOUT waiting for the whole batch to finish.  TPU-first adaptation —
 everything keeps a static shape so nothing recompiles at steady state:
 
-- The KV cache holds `batch_size` SLOTS (L, B, cache_len, KV, D), where
-  cache_len is the smallest LENGTH BUCKET covering the live batch's max
-  context (pad-migrated up / truncated down at bucket crossings, each
-  bucket one compiled decode shape) — per-step cache traffic scales
-  with live context, not max_seq_len.  A request occupies one slot from
-  prefill to eos/max-tokens, then the slot is immediately handed to the
-  next queued request.
+- KV lives in the block-pool data plane (infer/block_pool.py, the
+  default): one pooled arena for the process lifetime, each of the
+  `batch_size` SLOTS addressing its context through a per-slot block
+  table (a traced decode operand) — per-step cache traffic scales with
+  live context via the paged-attention kernel, growth is free-list
+  math instead of `resize_cache` migrations, and admission reserves a
+  request's worst-case block need up front so pool exhaustion is
+  BACKPRESSURE (the request stays queued), never a mid-decode error.
+  A request occupies one slot from prefill to eos/max-tokens, then the
+  slot (and its refcounted blocks) is immediately handed to the next
+  queued request.  The legacy decode_impls instead use a bucketed
+  contiguous slot cache (L, B, cache_len, KV, D) pad-migrated across
+  LENGTH BUCKETS at bucket crossings.
 - Queued requests are admitted in GROUPS: one bucketed prefill forward
   covers up to admit_group prompts and scatters each row into its slot
   (bounded compile set: group sizes × prompt buckets).  Sequential
@@ -43,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer import block_pool as block_pool_lib
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
 from skypilot_tpu.infer import tp as tp_lib
@@ -100,17 +107,62 @@ class ContinuousBatcher:
         self.cache_buckets = derive_cache_buckets(gen_config)
 
         batch = gen_config.batch_size
-        # Bucketed slot cache: starts at the SMALLEST bucket and
-        # pad-migrates up (truncates down) as admissions and live
-        # contexts cross bucket boundaries, so lockstep decode's
-        # per-step cache traffic tracks the live batch's max context,
-        # not max_seq_len.
-        self._cache_len = self.cache_buckets[0]
-        self._cache = llama_infer.init_cache(
-            config, batch, self._cache_len,
-            sharding=(None if mesh is None
-                      else tp_lib.cache_sharding(mesh)),
-            kv_dtype=gen_config.kv_cache_dtype)
+        # Pooled data plane (default): ONE process-lifetime arena; each
+        # SLOT addresses its context through a host-mirrored block
+        # table uploaded only when it changes.  Admission reserves a
+        # request's WORST-CASE block need up front, so the pool can
+        # only run out at admission time — which is backpressure (the
+        # request stays queued), never a mid-decode error.  The
+        # bucketed slot cache and its grow/shrink migrations below
+        # exist only for the legacy decode_impls.
+        self.pooled = gen_config.decode_impl == 'pooled'
+        self.pool = None
+        if self.pooled:
+            bs = gen_config.derive_block_size()
+            self.block_size = bs
+            self.table_width = -(-gen_config.max_seq_len // bs)
+            n_blocks = gen_config.pool_blocks
+            if n_blocks is None:
+                # "Cannot exhaust" sizing: every slot to max_seq_len,
+                # plus the prefix cache's byte budget, plus garbage.
+                n_blocks = 1 + batch * self.table_width
+                if gen_config.prefix_cache_mb:
+                    n_blocks += int(
+                        gen_config.prefix_cache_mb * 1e6
+                        // block_pool_lib.block_nbytes(
+                            config, bs,
+                            gen_config.kv_cache_dtype)) + 1
+            self.pool = block_pool_lib.BlockPool(
+                config, n_blocks, bs,
+                sharding=(None if mesh is None
+                          else tp_lib.cache_sharding(mesh)),
+                kv_dtype=gen_config.kv_cache_dtype)
+            self._cache = self.pool.arena
+            self._cache_len = self.table_width * bs
+            self._host_tables = np.zeros((batch, self.table_width),
+                                         np.int32)
+            self._slot_blocks: List[List[int]] = [
+                [] for _ in range(batch)]
+            # Worst-case block ceiling and outstanding reservation per
+            # slot: admission reserves ceil((len + budget)/bs) blocks,
+            # decode growth draws the reservation down block by block,
+            # and _finish returns the unused remainder.
+            self._slot_cap = np.zeros((batch,), np.int32)
+            self._slot_reserved = np.zeros((batch,), np.int32)
+            self._tables_dev = jnp.asarray(self._host_tables)
+            self._tables_dirty = False
+        else:
+            # Bucketed slot cache: starts at the SMALLEST bucket and
+            # pad-migrates up (truncates down) as admissions and live
+            # contexts cross bucket boundaries, so lockstep decode's
+            # per-step cache traffic tracks the live batch's max
+            # context, not max_seq_len.
+            self._cache_len = self.cache_buckets[0]
+            self._cache = llama_infer.init_cache(
+                config, batch, self._cache_len,
+                sharding=(None if mesh is None
+                          else tp_lib.cache_sharding(mesh)),
+                kv_dtype=gen_config.kv_cache_dtype)
         def _row(value):
             row_sh = tp_lib.replicated_sharding(mesh)
             return value if row_sh is None else jax.device_put(
@@ -153,10 +205,16 @@ class ContinuousBatcher:
         # in ONE dispatch (compiled per actual group size — at most
         # admit_group compiles per prompt bucket).
         self._admit_group = max(1, min(4, batch))
-        self._prefill_group = jax.jit(functools.partial(
-            self._prefill_group_impl, config=config,
-            eos=gen_config.eos_token), donate_argnums=(2,),
-            static_argnames=())
+        if self.pooled:
+            self._prefill_group = jax.jit(functools.partial(
+                self._prefill_group_pooled_impl, config=config,
+                eos=gen_config.eos_token), donate_argnums=(2,),
+                static_argnames=())
+        else:
+            self._prefill_group = jax.jit(functools.partial(
+                self._prefill_group_impl, config=config,
+                eos=gen_config.eos_token), donate_argnums=(2,),
+                static_argnames=())
         self._decode = jax.jit(functools.partial(
             self._decode_impl, top_k=gen_config.top_k,
             eos=gen_config.eos_token),
@@ -171,10 +229,20 @@ class ContinuousBatcher:
         # Chunked prefill (gen_config.prefill_chunk): one window of one
         # long prompt per scheduler tick, interleaved with decode.
         self._incremental: Optional[_Request] = None
-        self._prefill_window = jax.jit(
-            lambda p, t, c, s, st: llama_infer.prefill_window(
-                p, t, config, c, s, st),
-            donate_argnums=(2,))
+        if self.pooled:
+            # Window prefill writes through the slot's block table; the
+            # arena is donated, so every call site rebinds
+            # self._cache AND self.pool.arena from the result.
+            self._prefill_window = jax.jit(
+                lambda p, t, c, tr, st:
+                llama_infer.prefill_window_pooled(
+                    p, t, config, c, tr, st),
+                donate_argnums=(2,))
+        else:
+            self._prefill_window = jax.jit(
+                lambda p, t, c, s, st: llama_infer.prefill_window(
+                    p, t, config, c, s, st),
+                donate_argnums=(2,))
         self._install_first = jax.jit(functools.partial(
             self._install_first_impl, top_k=gen_config.top_k,
             eos=gen_config.eos_token))
@@ -183,7 +251,11 @@ class ContinuousBatcher:
         # heads, installs matched blocks device-to-device, and prefills
         # only the suffix through _prefill_window's start-offset path
         # (see infer/prefix_cache.py for the reuse/compile contracts).
-        self._prefix = prefix_cache.make_prefix_cache(gen_config)
+        # Under the pooled data plane the cache runs in BLOCK-ID mode:
+        # a hit is a host-side table splice with a refcount bump —
+        # zero install/extract device copies.
+        self._prefix = prefix_cache.make_prefix_cache(
+            gen_config, pool=self.pool)
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
@@ -229,9 +301,46 @@ class ContinuousBatcher:
         return (big_cache, token_row, pos_row, done_row, limit_row,
                 temp_row, top_p_row, firsts, rng)
 
+    def _prefill_group_pooled_impl(self, params, tokens, arena, lengths,
+                                   slots, tables_scatter, token_row,
+                                   pos_row, done_row, limit_row,
+                                   temp_row, top_p_row, temps, top_ps,
+                                   limits, rng, *, config, eos):
+        """Pooled variant of _prefill_group_impl: the group prefills
+        into a jit-internal scratch cache, then ONE blocked scatter
+        moves each row into its slot's arena blocks (tables_scatter
+        (G, nb), nb = ceil(bucket / block_size); entries past a short
+        prompt's own blocks point at the garbage block, so a row only
+        claims the blocks its tokens need).  The arena is donated."""
+        nb = tables_scatter.shape[1]
+        group = tokens.shape[0]
+        scratch = llama_infer.init_cache(
+            config, group, nb * self.block_size,
+            kv_dtype=self.gen.kv_cache_dtype)
+        logits, scratch = llama_infer.prefill(
+            params, tokens, config=config, cache=scratch,
+            lengths=lengths)
+        arena = llama_infer.scatter_prefill_pooled(
+            scratch, arena, tables_scatter)
+        arena = tp_lib.constrain_cache(arena, self.mesh)
+        rng, sub = jax.random.split(rng)
+        firsts = tp_lib.replicate(sampling.sample_logits_batched(
+            logits, sub, temps, top_ps, top_k=self.gen.top_k),
+            self.mesh)
+        first_done = ((firsts == eos) if eos is not None
+                      else jnp.zeros(firsts.shape, bool)) | (limits <= 0)
+        token_row = token_row.at[slots].set(firsts)
+        pos_row = pos_row.at[slots].set(lengths)
+        done_row = done_row.at[slots].set(first_done)
+        limit_row = limit_row.at[slots].set(limits)
+        temp_row = temp_row.at[slots].set(temps)
+        top_p_row = top_p_row.at[slots].set(top_ps)
+        return (arena, token_row, pos_row, done_row, limit_row,
+                temp_row, top_p_row, firsts, rng)
+
     def _decode_impl(self, params, token, cache, positions, done, limit,
-                     temp_row, top_p_row, rng, *, n, all_greedy,
-                     nucleus, top_k, eos):
+                     temp_row, top_p_row, rng, tables=None, *, n,
+                     all_greedy, nucleus, top_k, eos):
         # all_greedy (static): every active slot decodes greedily, so
         # the sampler is a plain argmax — no per-step vocab sort.  Two
         # compiled variants per cache bucket; the host picks from its
@@ -240,7 +349,17 @@ class ContinuousBatcher:
         # chunk.  Done slots FREEZE (position and feed token stop
         # advancing; their lockstep compute rewrites one dead cache row)
         # and emit the fill token, which the host absorber drops.
-        decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
+        if self.gen.decode_impl == 'pooled':
+            # Block tables ride the closure as a TRACED operand: a
+            # slot outgrowing its blocks re-uploads the (B, T) table,
+            # never changing the compiled shape — the bucket-migration
+            # compile family collapses to the (n, all_greedy, nucleus)
+            # variants alone.
+            def decode_fn(params, token, config, cache, positions):
+                return llama_infer.decode_step_pooled(
+                    params, token, config, cache, positions, tables)
+        else:
+            decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
         batch = token.shape[0]
         fill = jnp.int32(eos if eos is not None else 0)
 
@@ -404,10 +523,90 @@ class ContinuousBatcher:
     def _grow_for(self, rows: int) -> None:
         """Grow (never shrink) the cache to cover `rows` positions —
         admission's side of the bucket contract: prefill writes and the
-        admitted request's first decode write must land in-bucket."""
+        admitted request's first decode write must land in-bucket.
+        No-op under the pooled data plane: capacity is block-table
+        math, not a cache shape."""
+        if self.pooled:
+            return
         target = self._cache_bucket_for(rows)
         if target > self._cache_len:
             self._migrate(target)
+
+    # ---- pooled block accounting ----------------------------------------
+    def _pool_cap(self, req: _Request) -> int:
+        """Worst-case blocks the request can ever reference: prompt
+        plus its full token budget, capped at the table width."""
+        total = min(len(req.prompt) + req.max_new_tokens,
+                    self.gen.max_seq_len)
+        return min(-(-total // self.block_size), self.table_width)
+
+    def _pool_reserve(self, req: _Request, shared: int) -> bool:
+        """Claim the request's worst-case block need BEFORE it leaves
+        the queue (minus `shared` blocks a prefix hit contributes for
+        free).  Failure is ADMISSION BACKPRESSURE: the request stays
+        queued — no exception, no fabricated blocks — until finishing
+        requests return blocks; the prefix cache is pressured to evict
+        refcount-0 nodes first."""
+        need = self._pool_cap(req) - shared
+        if need > self.pool.available() and self._prefix is not None:
+            self._prefix.evict_for_pool(need)
+        return self.pool.reserve(need)
+
+    def _pool_bind_slot(self, req: _Request, shared_ids: List[int]
+                        ) -> None:
+        """Give an admitted request's slot its prompt blocks: the
+        prefix-shared head ids first (already refcount-bumped by
+        splice), then fresh blocks drawn from the admission
+        reservation, covering ceil(len(prompt)/bs) table entries."""
+        slot = req.slot
+        cap = self._pool_cap(req)
+        nb_prompt = min(-(-len(req.prompt) // self.block_size),
+                        self.table_width)
+        self._host_tables[slot, :len(shared_ids)] = shared_ids
+        self._slot_blocks[slot] = list(shared_ids)
+        fresh = self.pool.alloc(nb_prompt - len(shared_ids),
+                                from_reservation=True)
+        self._host_tables[slot, len(shared_ids):nb_prompt] = fresh
+        self._slot_blocks[slot].extend(fresh)
+        self._slot_cap[slot] = cap
+        self._slot_reserved[slot] = cap - nb_prompt
+        self._tables_dirty = True
+
+    def _pool_free_slot(self, slot: int) -> None:
+        """Return a slot's pool state: drop its block references
+        (prefix-shared blocks survive via the trie's own refcounts),
+        return any unused reservation, and zero the table row so the
+        freed slot's frozen lockstep write lands in the garbage block
+        and released ids can never be addressed through this row."""
+        if self._slot_blocks[slot]:
+            self.pool.release(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+        if self._slot_reserved[slot]:
+            self.pool.unreserve(int(self._slot_reserved[slot]))
+        self._slot_reserved[slot] = 0
+        self._slot_cap[slot] = 0
+        self._host_tables[slot] = 0
+        self._tables_dirty = True
+
+    def _ensure_slot_blocks(self, n: int) -> None:
+        """Grow each active slot's block table to cover this chunk's
+        deepest possible write (position + n - 1), capped at the slot's
+        reserved worst case — the pooled replacement for bucket-grow
+        migrations: free-list math plus one (B, T) int32 upload, no
+        cache copy, no recompile.  Draws down the admission
+        reservation, so it can never exhaust the pool mid-decode."""
+        for slot in self._active:
+            need = -(-(int(self._host_pos[slot]) + n)
+                     // self.block_size)
+            need = min(need, int(self._slot_cap[slot]))
+            have = len(self._slot_blocks[slot])
+            if need > have:
+                ids = self.pool.alloc(need - have,
+                                      from_reservation=True)
+                self._host_tables[slot, have:need] = ids
+                self._slot_blocks[slot].extend(ids)
+                self._slot_reserved[slot] -= need - have
+                self._tables_dirty = True
 
     @staticmethod
     def _observe_queue_wait(req: _Request) -> None:
@@ -448,6 +647,17 @@ class ContinuousBatcher:
                         match.release()
                     idx += 1
                     continue
+                shared = (match.tokens // self.block_size
+                          if (self.pooled and match is not None
+                              and match.hit) else 0)
+                if self.pooled and not self._pool_reserve(head, shared):
+                    # Pool backpressure: the long prompt keeps its
+                    # queue position; smaller requests behind it may
+                    # still fit.
+                    if match is not None:
+                        match.release()
+                    idx += 1
+                    continue
                 request = self._queue.pop(idx)
                 request.slot = self._free.pop(0)
                 self._observe_queue_wait(request)
@@ -457,7 +667,19 @@ class ContinuousBatcher:
                 # len(prompt).  (The cache never shrinks while this
                 # prefill is in flight — see step().)
                 self._grow_for(len(request.prompt) + 1)
-                if match is not None:
+                if self.pooled:
+                    ids: List[int] = []
+                    if match is not None:
+                        self._prefix.commit(match)
+                        if match.hit:
+                            # Matched head = host-side table splice
+                            # (refcount bump), zero device copies; the
+                            # incremental windows start at the suffix.
+                            ids = self._prefix.splice(match)
+                            request.prefill_pos = match.tokens
+                        match.release()
+                    self._pool_bind_slot(request, ids)
+                elif match is not None:
                     self._prefix.commit(match)
                     if match.hit:
                         # Matched head installs device-to-device; the
@@ -480,11 +702,21 @@ class ContinuousBatcher:
                 self._host_pos[request.slot] = int(park)
                 continue
             if match is not None and match.hit:
+                if self.pooled and not self._pool_reserve(
+                        head, match.tokens // self.block_size):
+                    match.release()
+                    idx += 1
+                    continue
                 self._admit_prefix_hit(self._queue.pop(idx), match)
                 continue
             if match is not None:
                 self._prefix.commit(match)    # counted miss
                 match.release()
+            if self.pooled and not self._pool_reserve(head, 0):
+                # Pool backpressure: leave the request queued at its
+                # scan position — finishing requests return blocks.
+                idx += 1
+                continue
             # Grouped admission: consecutive same-bucket misses
             # starting at idx (a hit or a long prompt ends the group —
             # the outer loop re-examines it on the next iteration).
@@ -508,6 +740,8 @@ class ContinuousBatcher:
                         break
                     self._prefix.commit(m)
                     m.release()
+                if self.pooled and not self._pool_reserve(cand, 0):
+                    break
                 cand = self._queue.pop(idx)
                 cand.slot = self._free.pop(0)
                 self._observe_queue_wait(cand)
@@ -539,37 +773,74 @@ class ContinuousBatcher:
             # lands at len(prompt) — grow before dispatch.
             self._grow_for(max(bucket, int(lengths.max()) + 1))
             try:
-                (self._cache, self._token, self._positions, self._done,
-                 self._limit, self._temp_row, self._top_p_row, firsts,
-                 self._rng) = self._prefill_group(
-                    self.params, jnp.asarray(tokens), self._cache,
-                    jnp.asarray(lengths), jnp.asarray(slots),
-                    self._token, self._positions, self._done,
-                    self._limit, self._temp_row, self._top_p_row,
-                    jnp.asarray(temps), jnp.asarray(top_ps),
-                    jnp.asarray(limits), self._rng)
+                if self.pooled:
+                    # Each row claims blocks for ITS prompt (drawn from
+                    # its admission reservation); tables_scatter pads
+                    # the bucket's remaining block columns with the
+                    # garbage block, so pad rows scatter harmlessly.
+                    nb = -(-bucket // self.block_size)
+                    tables_scatter = np.full(
+                        (effective, nb), block_pool_lib.GARBAGE_BLOCK,
+                        np.int32)
+                    for i, request in enumerate(group):
+                        self._pool_bind_slot(request, [])
+                        row = self._slot_blocks[request.slot]
+                        tables_scatter[i, :len(row)] = row
+                    (self._cache, self._token, self._positions,
+                     self._done, self._limit, self._temp_row,
+                     self._top_p_row, firsts,
+                     self._rng) = self._prefill_group(
+                        self.params, jnp.asarray(tokens), self._cache,
+                        jnp.asarray(lengths), jnp.asarray(slots),
+                        jnp.asarray(tables_scatter),
+                        self._token, self._positions, self._done,
+                        self._limit, self._temp_row, self._top_p_row,
+                        jnp.asarray(temps), jnp.asarray(top_ps),
+                        jnp.asarray(limits), self._rng)
+                    self.pool.arena = self._cache
+                else:
+                    (self._cache, self._token, self._positions,
+                     self._done, self._limit, self._temp_row,
+                     self._top_p_row, firsts,
+                     self._rng) = self._prefill_group(
+                        self.params, jnp.asarray(tokens), self._cache,
+                        jnp.asarray(lengths), jnp.asarray(slots),
+                        self._token, self._positions, self._done,
+                        self._limit, self._temp_row, self._top_p_row,
+                        jnp.asarray(temps), jnp.asarray(top_ps),
+                        jnp.asarray(limits), self._rng)
                 self._host_temp[slots] = temps
                 self._host_top_p[slots] = top_ps
             except Exception:
                 # A failed dispatch (fresh compile OOM, device error)
                 # must not leak the group: re-queue the requests at
-                # their scan position and return their slots, THEN
-                # surface the error (is_done would otherwise spin
-                # forever and the slots would shrink capacity
-                # permanently).
+                # their scan position, return their slots (and their
+                # pool blocks/reservations), THEN surface the error
+                # (is_done would otherwise spin forever and the slots
+                # would shrink capacity permanently).
                 for request in reversed(group):
+                    if self.pooled:
+                        self._pool_free_slot(request.slot)
                     self._free.insert(0, request.slot)
                     request.slot = None
                     self._queue.insert(idx, request)
                 raise
             # Freshly prefilled heads become reusable for the next
-            # request sharing them — device-to-device block copies out
-            # of the slot rows; only not-yet-cached blocks are
-            # extracted.
+            # request sharing them.  Pooled: new trie nodes SHARE the
+            # rows' own blocks (refcount bump, zero device copies);
+            # legacy: device-to-device block copies out of the slot
+            # rows (only not-yet-cached blocks are extracted).
             if self._prefix is not None:
                 for req in group:
-                    self._prefix.insert(req.prompt, functools.partial(
-                        self._prefix.extract, self._cache, req.slot))
+                    if self.pooled:
+                        self._prefix.insert(
+                            req.prompt,
+                            blocks=self._slot_blocks[req.slot])
+                    else:
+                        self._prefix.insert(
+                            req.prompt, functools.partial(
+                                self._prefix.extract, self._cache,
+                                req.slot))
             # ONE counted sync for the whole admitted group — the
             # per-request int() below reads host memory, not device.
             (firsts,) = engine_lib.host_fetch(firsts)
@@ -602,11 +873,22 @@ class ContinuousBatcher:
         self._grow_for(len(prompt) + 1)
         w = self.gen.prefill_chunk or self._prefix.block
         start = match.tokens
-        try:
-            self._cache = self._prefix.install(self._cache, req.slot,
-                                               match)
-        finally:
-            match.release()
+        if self.pooled:
+            # The matched head is a host-side table splice (refcount
+            # bump) — ZERO install/extract device copies; only the
+            # suffix touches the device, via the windowed prefill.
+            try:
+                ids = self._prefix.splice(match)
+            finally:
+                match.release()
+            self._pool_bind_slot(req, ids)
+            table_row = jnp.asarray(self._host_tables[req.slot])
+        else:
+            try:
+                self._cache = self._prefix.install(self._cache,
+                                                   req.slot, match)
+            finally:
+                match.release()
         try:
             h_last = None
             last_start = start
@@ -615,21 +897,38 @@ class ContinuousBatcher:
                 window = np.zeros((w,), np.int32)
                 window[:end - start] = np.asarray(prompt[start:end],
                                                   np.int32)
-                h_last, self._cache = self._prefill_window(
-                    self.params, jnp.asarray(window), self._cache,
-                    jnp.int32(req.slot), jnp.int32(start))
+                if self.pooled:
+                    h_last, self._cache = self._prefill_window(
+                        self.params, jnp.asarray(window), self._cache,
+                        table_row, jnp.int32(start))
+                    self.pool.arena = self._cache
+                else:
+                    h_last, self._cache = self._prefill_window(
+                        self.params, jnp.asarray(window), self._cache,
+                        jnp.int32(req.slot), jnp.int32(start))
                 last_start = start
                 start = end
+            if self.pooled:
+                # Share the slot's blocks into the trie BEFORE
+                # completion: a max_new=1 request finishes inside
+                # _complete_prefill, and _finish releases the slot's
+                # block references — inserting first keeps the prompt
+                # cached (the trie's own refcounts hold the blocks).
+                self._prefix.insert(prompt,
+                                    blocks=self._slot_blocks[req.slot])
             self._complete_prefill(req, h_last, last_start)
         except Exception:
             # Same contract as the other admission handlers: reclaim
             # the slot and re-queue before surfacing the error.
+            if self.pooled:
+                self._pool_free_slot(req.slot)
             self._free.insert(0, req.slot)
             req.slot = None
             self._queue.insert(0, req)
             raise
-        self._prefix.insert(prompt, functools.partial(
-            self._prefix.extract, self._cache, req.slot))
+        if not self.pooled:
+            self._prefix.insert(prompt, functools.partial(
+                self._prefix.extract, self._cache, req.slot))
 
     def _complete_prefill(self, req: _Request, h_last,
                           last_start: int) -> None:
@@ -672,9 +971,18 @@ class ContinuousBatcher:
             del self._active[req.slot]
         if req.slot is not None:
             self._free.append(req.slot)
+            if self.pooled:
+                # Release the slot's block references (prefix-shared
+                # blocks stay live under the trie's refcounts), return
+                # the unused reservation, and zero the table row —
+                # the frozen slot's lockstep write now lands in the
+                # garbage block.
+                self._pool_free_slot(req.slot)
             # Freed slot: freeze it (done rows don't advance inside the
             # fused decode) and park its position at 0 so its one dead
-            # lockstep write stays inside even the smallest bucket.
+            # lockstep write stays inside even the smallest bucket
+            # (pooled: row 0 routes through the zeroed table to the
+            # garbage block).
             self._positions = self._positions.at[req.slot].set(0)
             self._done = self._done.at[req.slot].set(True)
             self._host_pos[req.slot] = 0
@@ -693,9 +1001,16 @@ class ContinuousBatcher:
         window[:end - start] = np.asarray(req.prompt[start:end],
                                           np.int32)
         try:
-            h_last, self._cache = self._prefill_window(
-                self.params, jnp.asarray(window), self._cache,
-                jnp.int32(req.slot), jnp.int32(start))
+            if self.pooled:
+                h_last, self._cache = self._prefill_window(
+                    self.params, jnp.asarray(window), self._cache,
+                    jnp.asarray(self._host_tables[req.slot]),
+                    jnp.int32(start))
+                self.pool.arena = self._cache
+            else:
+                h_last, self._cache = self._prefill_window(
+                    self.params, jnp.asarray(window), self._cache,
+                    jnp.int32(req.slot), jnp.int32(start))
         except Exception:
             # Same contract as the grouped-admission handler: a failed
             # dispatch must not leak the slot or leave _incremental set
@@ -705,6 +1020,8 @@ class ContinuousBatcher:
             # slot's cache rows are rewritten wholesale anyway.
             self._incremental = None
             req.prefill_pos = 0
+            if self.pooled:
+                self._pool_free_slot(req.slot)
             self._free.insert(0, req.slot)
             req.slot = None
             self._queue.insert(0, req)
@@ -713,16 +1030,26 @@ class ContinuousBatcher:
         if end < len(req.prompt):
             return
         try:
+            if self.pooled and self._prefix is not None:
+                # Insert BEFORE completion: a max_new=1 request
+                # finishes inside _complete_prefill and _finish drops
+                # the slot's block references — sharing first keeps
+                # the freshly prefilled prompt cached under the
+                # trie's own refcounts.
+                self._prefix.insert(req.prompt,
+                                    blocks=self._slot_blocks[req.slot])
             self._complete_prefill(req, h_last, start)
         except Exception:
             self._incremental = None
             req.prefill_pos = 0
+            if self.pooled:
+                self._pool_free_slot(req.slot)
             self._free.insert(0, req.slot)
             req.slot = None
             self._queue.insert(0, req)
             raise
         self._incremental = None
-        if self._prefix is not None:
+        if self._prefix is not None and not self.pooled:
             self._prefix.insert(req.prompt, functools.partial(
                 self._prefix.extract, self._cache, req.slot))
 
@@ -741,14 +1068,27 @@ class ContinuousBatcher:
         # transfer per tick on the serving hot path.
         live_max = max(int(self._host_pos[s]) for s in self._active)
         n = max(1, min(n, self.gen.max_seq_len - live_max))
-        # Bucket crossing: this chunk's deepest live write lands at row
-        # live_max + n - 1.  Shrinking (the live batch's contexts got
-        # small after long requests finished) is deferred while a
-        # chunked prefill is parked at the cache's last row.
-        target = self._cache_bucket_for(live_max + n)
-        if target > self._cache_len or (target < self._cache_len
-                                        and self._incremental is None):
-            self._migrate(target)
+        if self.pooled:
+            # No migrations: growth is a free-list append to the host
+            # block tables, uploaded only on change.  Per-step cache
+            # traffic already tracks live context through the tables.
+            self._ensure_slot_blocks(n)
+            if self._tables_dirty:
+                self._tables_dev = jnp.asarray(self._host_tables)
+                self._tables_dirty = False
+            tables_arg = self._tables_dev
+        else:
+            # Bucket crossing: this chunk's deepest live write lands at
+            # row live_max + n - 1.  Shrinking (the live batch's
+            # contexts got small after long requests finished) is
+            # deferred while a chunked prefill is parked at the cache's
+            # last row.
+            target = self._cache_bucket_for(live_max + n)
+            if target > self._cache_len or (target < self._cache_len
+                                            and self._incremental
+                                            is None):
+                self._migrate(target)
+            tables_arg = None
         all_greedy = not any(
             float(self._host_temp[s]) > 0.0 for s in self._active)
         nucleus = any(
@@ -759,7 +1099,12 @@ class ContinuousBatcher:
          self._limit, self._rng) = self._decode(
             self.params, self._token, self._cache, self._positions,
             self._done, self._limit, self._temp_row, self._top_p_row,
-            self._rng, n=n, all_greedy=all_greedy, nucleus=nucleus)
+            self._rng, tables_arg, n=n, all_greedy=all_greedy,
+            nucleus=nucleus)
+        if self.pooled:
+            # The arena was donated through the chunk: rebind the
+            # pool's handle before anything else can observe it.
+            self.pool.arena = self._cache
         # ONE transfer for the whole chunk (barrier: honest chunk wall
         # time): the token block plus the control rows steering the
         # next tick.  Positions come back exact — frozen slots did NOT

@@ -198,6 +198,104 @@ def decode_attention(q: jax.Array, k_cache: jax.Array,
     )(layer_arr, pos_arr, maxblk, *operands)
 
 
+def _pooled_attn_kernel(layer_ref, pos_ref, maxblk_ref, tbl_ref, *args,
+                        **kwargs):
+    # The block table is consumed entirely by the index maps; the body
+    # is the contiguous kernel's, verbatim — online softmax over blocks
+    # with the logical index j masking validity, regardless of WHICH
+    # physical arena block the DMA fetched.
+    del tbl_ref
+    _decode_attn_kernel(layer_ref, pos_ref, maxblk_ref, *args, **kwargs)
+
+
+def decode_attention_pooled(q: jax.Array, k_arena: jax.Array,
+                            v_arena: jax.Array, tables: jax.Array,
+                            layer: jax.Array, positions: jax.Array,
+                            k_scale: Optional[jax.Array] = None,
+                            v_scale: Optional[jax.Array] = None,
+                            *, interpret: Optional[bool] = None
+                            ) -> jax.Array:
+    """Single-token GQA attention over a pooled block arena.
+
+    Identical math to :func:`decode_attention`, but the KV cache is a
+    shared block pool rather than per-slot contiguous rows:
+
+    q: (B, KV, G, hd) current-token queries (post-rope).
+    k_arena/v_arena: (L, NB, BS, KV, hd) pooled arena — NB physical
+       blocks of BS rows each, shared by every slot.  int8 when
+       k_scale/v_scale (L, NB, BS, KV) f32 are given.
+    tables: (B, T) int32 block table — tables[b, j] is the physical
+       arena block holding slot b's logical rows [j*BS, (j+1)*BS).
+    layer: int32 scalar; positions: (B,) int32 current cache row.
+
+    The grid walks LOGICAL blocks (B, T); the kv index map translates
+    j -> tables[b, j] via scalar prefetch, clamped to the slot's last
+    valid logical block so trailing grid steps revisit the same
+    physical block and Pallas skips their DMAs — traffic is per-slot
+    live context, independent of T.
+
+    Returns (B, KV, G, hd) in q.dtype.
+    """
+    n_layers, n_blocks, bs, kv_heads, head_dim = k_arena.shape
+    batch, t_width = tables.shape
+    group = q.shape[2]
+    rows = kv_heads * group
+    if head_dim % 128:
+        raise ValueError(f'head_dim {head_dim} must be a multiple of '
+                         f'128 for the TPU decode kernel')
+    quantized = k_scale is not None
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    pos_arr = positions.astype(jnp.int32)
+    maxblk = jnp.minimum(pos_arr // bs, t_width - 1)
+    tbl_arr = tables.astype(jnp.int32)
+
+    def q_map(b, j, layer_s, pos_s, mb_s, tbl_s):
+        del j, layer_s, pos_s, mb_s, tbl_s
+        return (b, 0, 0, 0)
+
+    def kv_map(b, j, layer_s, pos_s, mb_s, tbl_s):
+        del pos_s
+        return (layer_s[0], tbl_s[b, jnp.minimum(j, mb_s[b])], 0, 0, 0)
+
+    def scale_map(b, j, layer_s, pos_s, mb_s, tbl_s):
+        del pos_s
+        return (layer_s[0], tbl_s[b, jnp.minimum(j, mb_s[b])], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, kv_heads, group, head_dim), q_map),
+        pl.BlockSpec((1, 1, bs, kv_heads, head_dim), kv_map),
+        pl.BlockSpec((1, 1, bs, kv_heads, head_dim), kv_map),
+    ]
+    operands = [q, k_arena, v_arena]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, bs, kv_heads), scale_map),
+                     pl.BlockSpec((1, 1, bs, kv_heads), scale_map)]
+        operands += [k_scale, v_scale]
+
+    kernel = functools.partial(
+        _pooled_attn_kernel, block=bs, kv_heads=kv_heads,
+        group=group, head_dim=head_dim, quantized=quantized)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(batch, t_width),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, kv_heads, group, head_dim), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, head_dim), jnp.float32),
+        ])
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, kv_heads, group, head_dim), q.dtype),
+        interpret=interpret,
+    )(layer_arr, pos_arr, maxblk, tbl_arr, *operands)
+
+
 def reference_decode_attention(q: jax.Array, k_layer: jax.Array,
                                v_layer: jax.Array,
                                positions: jax.Array) -> jax.Array:

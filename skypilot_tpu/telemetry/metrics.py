@@ -255,6 +255,46 @@ INFER_PREFIX_BYTES = prometheus_client.Gauge(
     'Device bytes currently pinned by prefix-cache K/V blocks',
     registry=REGISTRY)
 
+# ---- infer block pool (infer/block_pool.py) ----------------------------
+
+INFER_POOL_BLOCKS_TOTAL = prometheus_client.Gauge(
+    'skytpu_infer_pool_blocks_total',
+    'Physical KV blocks in the pooled arena (including the reserved '
+    'garbage block 0)',
+    registry=REGISTRY)
+
+INFER_POOL_BLOCKS_LIVE = prometheus_client.Gauge(
+    'skytpu_infer_pool_blocks_live',
+    'Arena blocks currently referenced by >=1 sequence block table or '
+    'prefix-cache node (refcount > 0)',
+    registry=REGISTRY)
+
+INFER_POOL_BLOCKS_FREE = prometheus_client.Gauge(
+    'skytpu_infer_pool_blocks_free',
+    'Arena blocks on the free list (allocatable; free + live + 1 '
+    'garbage == total at all times)',
+    registry=REGISTRY)
+
+INFER_POOL_HWM = prometheus_client.Gauge(
+    'skytpu_infer_pool_hwm',
+    'High-water mark of live arena blocks since pool creation — the '
+    'number to size pool_blocks against',
+    registry=REGISTRY)
+
+INFER_POOL_TABLE_APPENDS = prometheus_client.Counter(
+    'skytpu_infer_pool_block_table_appends_total',
+    'Blocks appended to sequence block tables from the free list (the '
+    'pooled replacement for bucket grow migrations: an append is a '
+    'table write, not a cache copy)',
+    registry=REGISTRY)
+
+INFER_POOL_PREFIX_SHARES = prometheus_client.Counter(
+    'skytpu_infer_pool_prefix_block_shares_total',
+    'Refcount shares of arena blocks between prefix-cache nodes and '
+    'live sequences (each share replaces an install/extract device '
+    'copy of one block)',
+    registry=REGISTRY)
+
 # ---- serve (serve/load_balancer.py, replica_managers.py, autoscalers.py)
 
 SERVE_REPLICA_REQUESTS = prometheus_client.Counter(

@@ -1,0 +1,338 @@
+"""Block-pool KV data plane (infer/block_pool.py + the pooled default
+engines).
+
+What must hold:
+- pooled decode is bit-exact with the legacy inplace path (greedy and
+  sampled, model dtype f32 and bf16, bf16 and int8 KV) at both the
+  lockstep Generator and the ContinuousBatcher level;
+- a warm prefix hit is a block-table splice: ZERO install/extract
+  device copies, host_syncs_per_token unchanged vs the cold batch;
+- free-list exhaustion is admission BACKPRESSURE (requests stay
+  queued; nothing OOMs, nothing fabricates blocks) and the lockstep
+  Generator surfaces it with sizing advice;
+- eviction under pool pressure returns refcount-0 blocks only —
+  blocks shared with a live sequence never reach the free list;
+- interleaved short/long traffic (fragmentation soak) ends with
+  free + live == total - 1 (the pinned garbage block);
+- cache_migrations_total stays at 0 under pooled decode — bucket
+  migration does not exist on the default data plane;
+- the pooled Pallas kernel matches the masked-einsum oracle through a
+  scattered block table (interpret mode, head_dim 128).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import prefix_cache as pc_mod
+from skypilot_tpu.infer.block_pool import (BlockPool, GARBAGE_BLOCK,
+                                           PoolExhaustedError)
+from skypilot_tpu.infer.engine import Generator, GeneratorConfig
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.metrics import REGISTRY
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import decode_attention as da
+
+CFG = llama.LlamaConfig(vocab_size=256, d_model=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=128,
+                        max_seq_len=128, dtype=jnp.float32)
+PROMPTS = [[5, 9, 3, 7], [11, 2]]
+
+
+@pytest.fixture(scope='module')
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _gc(**kw):
+    base = dict(max_seq_len=128, batch_size=2, temperature=0.0,
+                prompt_buckets=[16, 32])
+    base.update(kw)
+    return GeneratorConfig(**base)
+
+
+def _migrations_total():
+    total = 0.0
+    for direction in ('grow', 'shrink'):
+        total += REGISTRY.get_sample_value(
+            'skytpu_infer_cache_migrations_total',
+            {'direction': direction}) or 0.0
+    return total
+
+
+# ---- pool accounting (pure host math, no device work) -------------------
+
+def test_pool_accounting_guards():
+    pool = BlockPool(CFG, 4, 8)
+    ids = pool.alloc(2)
+    assert GARBAGE_BLOCK not in ids
+    with pytest.raises(PoolExhaustedError):
+        pool.alloc(2)                      # only 1 free
+    assert pool.reserve(2) is False        # no side effects on failure
+    assert pool.available() == 1
+    assert pool.reserve(1) and pool.available() == 0
+    pool.unreserve(1)
+    with pytest.raises(AssertionError):
+        pool.release([GARBAGE_BLOCK])
+    pool.release(ids)
+    with pytest.raises(AssertionError):
+        pool.release([ids[0]])             # double free
+    with pytest.raises(AssertionError):
+        pool.share([ids[0]])               # share of a free block
+    assert pool.free_blocks() + pool.live_blocks() == pool.n_blocks - 1
+
+
+def test_eviction_returns_only_unreferenced_blocks():
+    """evict_for_pool frees refcount-0 blocks only: a node whose blocks
+    are shared with a live sequence leaves the trie, but its blocks stay
+    live until the sequence releases them."""
+    pool = BlockPool(CFG, 9, 8)            # 8 allocatable
+    pc = pc_mod.PrefixCache(block=8, capacity_bytes=1 << 30, pool=pool)
+    # Sequence A prefilled a 32-token prompt, its blocks were inserted,
+    # then A completed: the trie is the only remaining owner.
+    a_ids = pool.alloc(4)
+    assert pc.insert(list(range(100, 132)), blocks=a_ids) == 4
+    pool.release(a_ids)
+    # Sequence B inserted the same way but is STILL LIVE (refcount 2).
+    b_ids = pool.alloc(4)
+    assert pc.insert(list(range(200, 232)), blocks=b_ids) == 4
+    assert pool.available() == 0
+    # Evict far more than exists: every unpinned node drops, but only
+    # A's blocks (refcount 0 after the node release) reach the free
+    # list — B's are held by the live sequence.
+    pc.evict_for_pool(100)
+    assert pool.free_blocks() == 4
+    assert all(pool.refcount(b) == 1 for b in b_ids)
+    assert all(pool.refcount(b) == 0 for b in a_ids)
+    # B completes: its blocks come home and the ledger balances.
+    pool.release(b_ids)
+    assert pool.live_blocks() == 0
+    assert pool.free_blocks() + pool.live_blocks() == pool.n_blocks - 1
+
+
+# ---- pooled Pallas kernel vs oracle, through a scattered table ----------
+
+def _arena(quantized, seed=1):
+    lay, nb, bs, kv, group, hd, batch = 2, 7, 64, 2, 2, 128, 2
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (batch, kv, group, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (lay, nb, bs, kv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (lay, nb, bs, kv, hd), jnp.float32)
+    if not quantized:
+        return q, k, v, None, None
+    sk = jnp.maximum(jnp.max(jnp.abs(k), axis=-1), 1e-8) / 127.0
+    sv = jnp.maximum(jnp.max(jnp.abs(v), axis=-1), 1e-8) / 127.0
+    k_q = jnp.round(k / sk[..., None]).astype(jnp.int8)
+    v_q = jnp.round(v / sv[..., None]).astype(jnp.int8)
+    return q, k_q, v_q, sk.astype(jnp.float32), sv.astype(jnp.float32)
+
+
+@pytest.mark.parametrize('quantized', [False, True])
+def test_pooled_kernel_matches_reference(quantized):
+    q, k, v, sk, sv = _arena(quantized)
+    # Scattered, non-monotonic tables; slot 1's tail entries are the
+    # garbage block — its position keeps them masked.
+    tables = jnp.asarray([[3, 6, 1], [5, GARBAGE_BLOCK, GARBAGE_BLOCK]],
+                         jnp.int32)
+    positions = jnp.asarray([150, 40], jnp.int32)
+    layer = 1
+    out = da.decode_attention_pooled(q, k, v, tables, layer, positions,
+                                     sk, sv, interpret=True)
+    # Oracle: gather each slot's logical rows contiguously, dequantize,
+    # and run the masked-einsum reference.
+    if quantized:
+        k_f = k.astype(jnp.float32) * sk[..., None]
+        v_f = v.astype(jnp.float32) * sv[..., None]
+    else:
+        k_f, v_f = k, v
+    bs = k.shape[2]
+    k_gather = k_f[layer][tables].reshape(2, tables.shape[1] * bs,
+                                          *k_f.shape[3:])
+    v_gather = v_f[layer][tables].reshape(2, tables.shape[1] * bs,
+                                          *v_f.shape[3:])
+    ref = da.reference_decode_attention(q, k_gather, v_gather, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pooled_kernel_ignores_unmapped_blocks():
+    """Arena blocks a slot's table does not reference (including the
+    garbage block) must not influence its output."""
+    q, k, v, _, _ = _arena(False)
+    tables = jnp.asarray([[2, 4, GARBAGE_BLOCK]], jnp.int32)[:1]
+    q1 = q[:1]
+    positions = jnp.asarray([100], jnp.int32)
+    out1 = da.decode_attention_pooled(q1, k, v, tables, 0, positions,
+                                      interpret=True)
+    # Poison every block the table does not map, plus the rows of the
+    # mapped blocks beyond the position mask.
+    unmapped = [b for b in range(k.shape[1]) if b not in (2, 4)]
+    k2 = k.at[:, unmapped].set(1e4)
+    v2 = v.at[:, unmapped].set(-1e4)
+    k2 = k2.at[:, 4, 37:].set(1e4)       # rows past pos 100 (= 64 + 36)
+    v2 = v2.at[:, 4, 37:].set(-1e4)
+    out2 = da.decode_attention_pooled(q1, k2, v2, tables, 0, positions,
+                                      interpret=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---- lockstep Generator parity ------------------------------------------
+
+@pytest.mark.parametrize('model_dtype,kv_dtype', [
+    ('float32', None),
+    ('float32', 'int8'),
+    ('bfloat16', None),
+    ('bfloat16', 'int8'),
+])
+def test_generator_pooled_matches_inplace(model_dtype, kv_dtype):
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=128,
+                            max_seq_len=64, dtype=model_dtype)
+    p = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(impl):
+        g = Generator(p, cfg, GeneratorConfig(
+            max_seq_len=64, batch_size=2, prompt_buckets=[8],
+            temperature=0.0, eos_token=None, kv_cache_dtype=kv_dtype,
+            decode_impl=impl, decode_chunk=5))
+        return g.generate(PROMPTS, max_new_tokens=20, seed=3)
+
+    assert run('pooled') == run('inplace')
+
+
+def test_generator_pooled_matches_inplace_sampled():
+    cfg = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=128,
+                            max_seq_len=64, dtype=jnp.float32)
+    p = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(impl):
+        g = Generator(p, cfg, GeneratorConfig(
+            max_seq_len=64, batch_size=2, prompt_buckets=[8],
+            temperature=0.8, top_k=20, eos_token=None,
+            kv_cache_dtype='int8', decode_impl=impl, decode_chunk=5))
+        return g.generate(PROMPTS, max_new_tokens=20, seed=7)
+
+    assert run('pooled') == run('inplace')
+
+
+def test_generator_pool_exhaustion_is_actionable():
+    """A lockstep batch the pool cannot hold raises PoolExhaustedError
+    with sizing advice — no OOM, no fabricated blocks."""
+    p = llama.init_params(CFG, jax.random.PRNGKey(0))
+    g = Generator(p, CFG, _gc(pool_blocks=2, kv_block_size=64))
+    with pytest.raises(PoolExhaustedError, match='pool_blocks'):
+        g.generate(PROMPTS, max_new_tokens=20)
+    # The failed admission returned everything it took.
+    st = g.pool.stats()
+    assert st['blocks_live'] == 0 and st['reserved'] == 0
+
+
+# ---- ContinuousBatcher parity + pool invariants -------------------------
+
+@pytest.mark.parametrize('kv_dtype', [None, 'int8'])
+def test_batcher_pooled_matches_inplace(params, kv_dtype):
+    prompts = [[5, 6, 7], [9, 10, 11, 12]]
+
+    def run(impl):
+        b = ContinuousBatcher(params, CFG, _gc(decode_impl=impl,
+                                               kv_cache_dtype=kv_dtype))
+        rids = [b.submit(p, max_new_tokens=12) for p in prompts]
+        b.run_until_idle()
+        return b, [b.result(r) for r in rids]
+
+    pooled_b, pooled_out = run('pooled')
+    _, ref_out = run('inplace')
+    assert pooled_out == ref_out
+    st = pooled_b.pool.stats()
+    assert st['blocks_live'] == 0 and st['reserved'] == 0
+    assert st['blocks_free'] == st['blocks_total'] - 1
+
+
+def test_batcher_warm_prefix_hit_zero_copies(params):
+    """A warm prefix hit under pooled decode must not dispatch a single
+    install_prefix/extract_block device copy, and the per-token host
+    sync budget must match the cold batch."""
+    mig0 = _migrations_total()
+    b = ContinuousBatcher(params, CFG, _gc(
+        prefix_cache_mb=1.0, prefix_block=16,
+        prompt_buckets=[16, 32, 64]))
+    head = list(range(2, 34))              # two prefix blocks
+    r = b.submit(head + [40, 41], max_new_tokens=8)
+    b.run_until_idle()
+    cold = b.result(r)
+    cold_syncs = REGISTRY.get_sample_value(
+        'skytpu_infer_host_syncs_per_token')
+
+    def boom(*a, **k):
+        raise AssertionError('KV device copy on the pooled warm path')
+
+    shares0 = b.pool.prefix_shares
+    orig = pc_mod.install_prefix, pc_mod.extract_block
+    pc_mod.install_prefix, pc_mod.extract_block = boom, boom
+    try:
+        r = b.submit(head + [40, 41], max_new_tokens=8)
+        b.run_until_idle()
+        warm = b.result(r)
+    finally:
+        pc_mod.install_prefix, pc_mod.extract_block = orig
+    warm_syncs = REGISTRY.get_sample_value(
+        'skytpu_infer_host_syncs_per_token')
+    assert warm == cold
+    assert b._prefix.hits == 1
+    # The jitted install wrapper exists but was never compiled/called.
+    assert b._prefix._install._cache_size() == 0
+    assert b.pool.prefix_shares > shares0
+    assert warm_syncs == cold_syncs
+    assert _migrations_total() == mig0     # no bucket migrations exist
+
+
+def test_batcher_exhaustion_backpressure(params):
+    """Free-list exhaustion keeps requests QUEUED (no exception, no
+    fabricated blocks); they admit as finished sequences free blocks."""
+    b = ContinuousBatcher(params, CFG, _gc(
+        batch_size=3, kv_block_size=64,
+        pool_blocks=3))                    # garbage + 2 allocatable
+    r1 = b.submit([1, 2, 3], max_new_tokens=30)
+    r2 = b.submit([4, 5, 6], max_new_tokens=30)
+    r3 = b.submit([7, 8, 9], max_new_tokens=4)
+    b.step()
+    # Three slots exist, but the pool covers two requests: r3 is held
+    # back by the block reservation, not by slot count.
+    assert b.num_active == 2 and b.num_queued == 1
+    b.run_until_idle()
+    for r in (r1, r2, r3):
+        assert b.result(r) is not None
+    st = b.pool.stats()
+    assert st['blocks_live'] == 0 and st['reserved'] == 0
+    assert st['blocks_free'] == st['blocks_total'] - 1
+
+
+def test_batcher_fragmentation_soak(params):
+    """Interleaved short/long requests over several waves: no leak, no
+    stranded reservation — free + live == total - 1 at the end, and
+    the default path performed zero cache migrations."""
+    mig0 = _migrations_total()
+    b = ContinuousBatcher(params, CFG, _gc(
+        batch_size=4, kv_block_size=16, pool_blocks=24))
+    rng = np.random.RandomState(0)
+    for wave in range(4):
+        rids = []
+        for i in range(4):
+            if (wave + i) % 2:
+                p = [int(t) for t in rng.randint(1, 200, size=3 + i)]
+                n = 4 + 8 * ((wave + i) % 3)
+            else:
+                p = [int(t) for t in rng.randint(1, 200, size=20 + i)]
+                n = 30
+            rids.append(b.submit(p, max_new_tokens=n))
+        b.run_until_idle()
+        for r in rids:
+            assert b.result(r) is not None
+    st = b.pool.stats()
+    assert st['blocks_live'] == 0 and st['reserved'] == 0
+    assert st['blocks_free'] == st['blocks_total'] - 1
+    assert st['hwm'] <= st['blocks_total'] - 1
+    assert st['table_appends'] > 0
+    assert _migrations_total() == mig0

@@ -45,12 +45,12 @@ def params():
 
 def _generate(params, *, decode_chunk, cache_buckets, temperature=0.0,
               top_k=None, kv_dtype=None, eos=None, mesh=None,
-              max_new=20, seed=3):
+              max_new=20, seed=3, decode_impl='pooled'):
     gen = Generator(params, CFG, GeneratorConfig(
         max_seq_len=64, batch_size=2, prompt_buckets=[8],
         temperature=temperature, top_k=top_k, eos_token=eos,
         kv_cache_dtype=kv_dtype, cache_buckets=cache_buckets,
-        decode_chunk=decode_chunk), mesh=mesh)
+        decode_chunk=decode_chunk, decode_impl=decode_impl), mesh=mesh)
     return gen.generate(PROMPTS, max_new_tokens=max_new, seed=seed)
 
 
@@ -91,11 +91,15 @@ def test_fused_chunk_matches_per_step_greedy(params):
 
 
 def test_bucket_migration_does_not_change_tokens(params):
-    ref = _generate(params, decode_chunk=1, cache_buckets=[64])
+    # Legacy data plane: bucket migration exists only under
+    # decode_impl='inplace' (the pooled default never migrates).
+    ref = _generate(params, decode_chunk=1, cache_buckets=[64],
+                    decode_impl='inplace')
     grow0 = REGISTRY.get_sample_value(
         'skytpu_infer_cache_migrations_total',
         {'direction': 'grow'}) or 0.0
-    got = _generate(params, decode_chunk=5, cache_buckets=[16, 32, 64])
+    got = _generate(params, decode_chunk=5, cache_buckets=[16, 32, 64],
+                    decode_impl='inplace')
     assert got == ref
     grow1 = REGISTRY.get_sample_value(
         'skytpu_infer_cache_migrations_total', {'direction': 'grow'})
@@ -200,9 +204,12 @@ def test_batcher_bucketed_matches_fixed_bucket(params):
 
 
 def test_batcher_shrinks_after_long_request_finishes(params):
+    # Legacy data plane: truncate-shrink only exists under
+    # decode_impl='inplace' (the pooled default has no cache buckets).
     b = ContinuousBatcher(params, CFG, GeneratorConfig(
         max_seq_len=64, batch_size=2, prompt_buckets=[8, 32],
-        temperature=0.0, cache_buckets=[16, 64]), decode_chunk=4)
+        temperature=0.0, cache_buckets=[16, 64],
+        decode_impl='inplace'), decode_chunk=4)
     assert b._cache_len == 16
     long_rid = b.submit(list(range(2, 22)), max_new_tokens=4)  # bucket 64
     b.run_until_idle()

@@ -337,11 +337,16 @@ def test_audit_run_is_green(audit_lib):
 
 
 def test_extra_recompile_breaches_budget(audit_lib):
-    # Simulate a retrace regression: warm the jit cache with a stray
-    # static n before the audited run.  The budget must catch it.
+    # Simulate a retrace regression: warm the jit cache with stray
+    # static n values before the audited run.  Two strays, because the
+    # pooled budget allows 2 programs total (full chunk + context-
+    # ceiling tail) and the audited run itself only exercises one — a
+    # regression that retraces per step blows past it either way.
     gen = audit_lib.make_tiny_generator()
-    args, _ = audit_lib._decode_chunk_inputs(gen, gen.cache_buckets[0], 3)
-    gen._decode_chunk(*args, n=3)
+    for stray_n in (3, 5):
+        args, _ = audit_lib._decode_chunk_inputs(
+            gen, gen.cache_buckets[0], stray_n)
+        gen._decode_chunk(*args, n=stray_n)
     report = audit_lib.audit_generator_decode(gen)
     by_name = {c['name']: c for c in report['checks']}
     assert by_name['compile_per_bucket']['status'] == 'fail'
@@ -362,10 +367,11 @@ def test_int_tracer_fails_audit(audit_lib, monkeypatch):
         real_impl = gen._decode_chunk_impl
 
         def bad_impl(params, token, cache, positions, done, limit, rng,
-                     *, n, temperature, top_k, top_p, eos):
+                     tables=None, *, n, temperature, top_k, top_p, eos):
             int(token[0])  # the defect under test
             return real_impl(params, token, cache, positions, done,
-                             limit, rng, n=n, temperature=temperature,
+                             limit, rng, tables, n=n,
+                             temperature=temperature,
                              top_k=top_k, top_p=top_p, eos=eos)
 
         gen._decode_chunk = jax_lib.jit(
