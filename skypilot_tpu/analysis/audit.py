@@ -510,6 +510,74 @@ def audit_block_pool() -> Dict[str, Any]:
             'pool': stats}
 
 
+def audit_spec_decode() -> Dict[str, Any]:
+    """Speculative decoding's compile contract (infer/spec_decode.py):
+    the draft shape is a FIXED (batch, spec_k), so the verify chunk is
+    exactly ONE extra program next to the pooled decode budget — across
+    a cold + warm run the verify jit cache must hold a single entry and
+    the decode chunk must stay within its usual <= 2 (the adaptive
+    policy's sequential fallback reuses those same programs).  The
+    arena must be donated through the verify forward, and the traced
+    accept/verify graph must be callback-free and f64-free."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.infer import block_pool as block_pool_lib
+    gen = make_tiny_generator(spec_k=3)
+    checks: List[Dict[str, str]] = []
+
+    # Repetitive prompts keep the n-gram drafter on the verify path;
+    # cold + warm runs must not grow either jit cache past budget.
+    prompts = [[5, 6, 7, 5, 6, 7, 5, 6], [9, 9, 9, 9]]
+    gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
+    gen.generate(prompts, max_new_tokens=_AUDIT_MAX_NEW)
+    verify_compiles = gen._verify_chunk._cache_size()
+    checks.append(_check(
+        'verify_compile_budget',
+        'ok' if verify_compiles <= 1 else 'fail',
+        f'{verify_compiles} verify-chunk compiles across a cold+warm '
+        f'run (budget 1: the (batch, spec_k) draft shape is fixed, so '
+        f'speculation adds exactly one program)'))
+    decode_compiles = gen._decode_chunk._cache_size()
+    checks.append(_check(
+        'decode_compile_budget',
+        'ok' if decode_compiles <= 2 else 'fail',
+        f'{decode_compiles} sequential decode-chunk compiles beside '
+        f'the verify program (pooled budget 2: full chunk + tail)'))
+
+    # Arena donation through the verify forward: the window writes
+    # candidate K/V in place, so a dropped donation would copy the
+    # whole arena every speculative chunk.
+    batch = gen.gen.batch_size
+    arena = block_pool_lib.init_arena(
+        gen.config, gen.pool.n_blocks, gen.pool.block_size,
+        kv_dtype=gen.gen.kv_cache_dtype)
+    args = (gen.params,
+            jnp.zeros((batch,), jnp.int32),
+            arena,
+            jnp.zeros((batch,), jnp.int32),
+            jnp.zeros((batch,), bool),
+            jnp.full((batch,), 8, jnp.int32),
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, gen.table_width), jnp.int32),
+            jnp.zeros((batch, gen.gen.spec_k), jnp.int32))
+    lowered = gen._verify_chunk.lower(*args)
+    checks.append(_donation_check(lowered.as_text(),
+                                  'pool arena (verify chunk)'))
+
+    # Jaxpr hygiene of the fused verify + accept/rollback graph.
+    impl = functools.partial(
+        gen._verify_chunk_impl, temperature=gen.gen.temperature,
+        top_k=gen.gen.top_k, top_p=gen.gen.top_p,
+        eos=gen.gen.eos_token)
+    jaxpr = jax.make_jaxpr(impl)(*args)
+    checks.extend(_jaxpr_dtype_and_callback_checks(jaxpr))
+    checks.append(_sharding_check(gen.mesh))
+    return {'entry': 'spec_decode', 'checks': checks,
+            'verify_compiles': verify_compiles,
+            'decode_compiles': decode_compiles,
+            'buckets': ['arena']}
+
+
 def audit_trainer_step() -> Dict[str, Any]:
     """Train step: params + opt state donated (the fit loop's steady
     state must not double its HBM residency), callback-free, f64-free."""
@@ -650,6 +718,7 @@ REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'prefill': audit_prefill,
     'prefix_cache': audit_prefix_cache,
     'block_pool': audit_block_pool,
+    'spec_decode': audit_spec_decode,
     'trainer_step': audit_trainer_step,
     'ckpt_reshard': audit_ckpt_reshard,
     'ring_attention': audit_ring_attention,

@@ -105,6 +105,7 @@ DATA_PLANE_MODULES = (
     'infer/multihost_check.py',
     'infer/prefix_cache.py',
     'infer/block_pool.py',
+    'infer/spec_decode.py',
 )
 
 # SKY202's sanctioned home: the bounded-backoff helper is ALLOWED to

@@ -149,6 +149,35 @@ class BlockPool:
     def refcount(self, block_id: int) -> int:
         return int(self._refs[block_id])
 
+    def check_invariant(self) -> None:
+        """Assert the pool's conservation law: every non-garbage block
+        is either free or referenced (free + live == n_blocks - 1),
+        refcounts are non-negative, the free list holds no duplicates
+        and no referenced ids, and reservations never exceed the free
+        list.  Cheap host math — tests call this around operations that
+        must NOT move blocks (e.g. speculative-decode rollback, which
+        is pure cursor math)."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError('free list contains duplicate ids')
+        if GARBAGE_BLOCK in free:
+            raise AssertionError('garbage block on the free list')
+        referenced = int(np.sum(self._refs[1:] > 0))
+        if referenced + len(self._free) != self.n_blocks - 1:
+            raise AssertionError(
+                f'block conservation violated: referenced={referenced} '
+                f'free={len(self._free)} total={self.n_blocks}')
+        if np.any(self._refs < 0):
+            raise AssertionError('negative refcount')
+        for b in free:
+            if self._refs[b] != 0:
+                raise AssertionError(
+                    f'free block {b} has refcount {self._refs[b]}')
+        if self._reserved > len(self._free):
+            raise AssertionError(
+                f'reservation {self._reserved} exceeds free list '
+                f'{len(self._free)}')
+
     # -- reservations (admission backpressure) ---------------------------
 
     def reserve(self, k: int) -> bool:

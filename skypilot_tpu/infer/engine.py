@@ -25,6 +25,7 @@ import numpy as np
 
 from skypilot_tpu.infer import block_pool as block_pool_lib
 from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
+from skypilot_tpu.infer import spec_decode as spec_decode_lib
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
@@ -115,8 +116,26 @@ class GeneratorConfig:
     # exhaust" sizing.  Set explicitly to trade HBM for admission
     # backpressure under overcommit.
     pool_blocks: Optional[int] = None
+    # Speculative decoding (infer/spec_decode.py): draft spec_k tokens
+    # per slot with the host-side n-gram drafter and verify all
+    # spec_k + 1 positions in ONE batched forward through the pooled
+    # plane.  0 = off.  Greedy output is bit-exact vs spec_k=0; at
+    # temperature > 0 the rejection-sampling accept preserves the
+    # target distribution.  Requires decode_impl='pooled'.
+    spec_k: int = 0
 
     def __post_init__(self):
+        if self.spec_k < 0:
+            raise ValueError(f'spec_k must be >= 0, got {self.spec_k}')
+        if self.spec_k and self.decode_impl != 'pooled':
+            raise ValueError(
+                f"spec_k={self.spec_k} requires the pooled data plane "
+                f"(decode_impl='pooled'); the legacy "
+                f"'{self.decode_impl}' plane has no verify-window path")
+        if self.spec_k and self.spec_k + 1 >= self.max_seq_len:
+            raise ValueError(
+                f'spec_k={self.spec_k} leaves no room for a verify '
+                f'window inside max_seq_len={self.max_seq_len}')
         if self.kv_block_size is not None and self.kv_block_size < 1:
             raise ValueError(f'kv_block_size must be >= 1, got '
                              f'{self.kv_block_size}')
@@ -354,6 +373,21 @@ class Generator:
                 logits, rng, temperature=gen_config.temperature,
                 top_k=gen_config.top_k, top_p=gen_config.top_p),
             self.mesh))
+        # Speculative decoding (spec_k > 0, pooled only): ONE extra
+        # compiled program — the verify window has a fixed (B, k+1)
+        # shape, so the decode compile budget grows by exactly one.
+        self._drafter = None
+        if self.pooled and gen_config.spec_k:
+            self._drafter = spec_decode_lib.NgramDrafter(
+                gen_config.batch_size, gen_config.spec_k)
+            self._spec_policy = spec_decode_lib.SpecPolicy()
+            self._verify_chunk = jax.jit(
+                functools.partial(self._verify_chunk_impl,
+                                  temperature=gen_config.temperature,
+                                  top_k=gen_config.top_k,
+                                  top_p=gen_config.top_p,
+                                  eos=gen_config.eos_token),
+                donate_argnums=(2,))
         # Radix prefix cache (None = disabled): a prompt that matches
         # cached head blocks prefills only its suffix through the
         # start-offset window path below; the matched blocks are
@@ -540,6 +574,44 @@ class Generator:
                 self._constrain(cache), rep(positions), rep(done),
                 limit, rng)
 
+    def _verify_chunk_impl(self, params, token, cache, positions, done,
+                           limit, rng, tables, draft, *, temperature,
+                           top_k, top_p, eos):
+        """One speculative draft-verify chunk fully on device: feed the
+        last committed token plus the k host-drafted proposals through
+        the W = k+1 verify forward, pick the target's token at every
+        window position (argmax, or the rejection-sampling draw for
+        temperature > 0), and commit the matching prefix with the
+        sequential chunk's exact eos/limit semantics
+        (spec_decode.accept_window).  Exactly one host fetch per chunk,
+        same as the sequential path — but a chunk now yields
+        `committed` (1..k+1) tokens per live row."""
+        fill = jnp.int32(eos if eos is not None else 0)
+        tokens_w = jnp.concatenate([token[:, None], draft], axis=1)
+        logits, cache = llama_infer.decode_verify_pooled(
+            params, tokens_w, self.config, cache, positions, tables)
+        rng, sub = jax.random.split(rng)
+        if temperature == 0.0:
+            targets, accepts = sampling.spec_accept_greedy(logits, draft)
+        else:
+            batch = token.shape[0]
+            t_row = jnp.full((batch,), temperature, jnp.float32)
+            p_row = jnp.full((batch,),
+                             top_p if top_p is not None else 1.0,
+                             jnp.float32)
+            targets, accepts = sampling.spec_accept_sampled(
+                logits, draft, sub, t_row, p_row, top_k=top_k,
+                nucleus=top_p is not None and 0.0 < top_p < 1.0)
+        (emitted, token, positions, done, limit,
+         committed) = spec_decode_lib.accept_window(
+             targets, accepts, done, limit, positions, token,
+             eos=eos, fill=fill)
+
+        def rep(x):
+            return tp_lib.replicate(x, self.mesh)
+        return (rep(emitted), token, self._constrain(cache),
+                rep(positions), rep(done), limit, rep(committed), rng)
+
     def _ensure_blocks(self, rows, host_positions, n) -> None:
         """Grow block tables so every live row can write through
         position + n - 1 this chunk: append ids from the free list to
@@ -687,11 +759,17 @@ class Generator:
         out: List[List[int]] = [[] for _ in range(batch)]
         done = [False] * batch
 
-        def _absorb(host_tokens: np.ndarray) -> bool:
+        def _absorb(host_tokens: np.ndarray,
+                    counts: Optional[np.ndarray] = None) -> bool:
             """Append a (B, n) host chunk, trimming at eos.  True = all
-            requested rows finished."""
+            requested rows finished.  counts (spec chunks): only the
+            first counts[i] columns of row i are COMMITTED tokens — the
+            rest are rejected-tail fill and must not be absorbed."""
             for i in range(len(prompts)):
-                for t in host_tokens[i]:
+                row = host_tokens[i]
+                if counts is not None:
+                    row = row[:int(counts[i])]
+                for t in row:
                     if done[i] or len(out[i]) >= max_new:
                         break
                     out[i].append(int(t))
@@ -699,6 +777,19 @@ class Generator:
                         done[i] = True
             return all(done[i] or len(out[i]) >= max_new
                        for i in range(len(prompts)))
+
+        if self._drafter is not None:
+            # Seed each slot's n-gram table from its prompt, plus the
+            # radix trie's cached continuation of that prompt (tokens
+            # another request already decoded after the shared head) —
+            # shared-prompt traffic drafts from the cached future on
+            # its very first chunk.
+            for i, p in enumerate(prompts):
+                cont = (self.prefix.cached_continuation(
+                    p, self.gen.max_seq_len)
+                    if self.prefix is not None else ())
+                self._drafter.reset(i, p, cont)
+                self._drafter.observe(i, [int(first_host[i])])
 
         # Device-side per-row decode state: done rows FREEZE inside the
         # fused chunk (pad rows start done; a first-token eos finishes a
@@ -735,9 +826,64 @@ class Generator:
                     # decode shape beats saving the overshot steps.  A
                     # smaller chunk only near the context ceiling.
                     live_max = max(int(host_positions[i]) for i in live)
+                    win = self.gen.spec_k + 1
+                    if (self._drafter is not None
+                            and live_max + win <= self.gen.max_seq_len
+                            and self._spec_policy.should_speculate()):
+                        # Draft-verify chunk: k host-drafted proposals,
+                        # ONE W=k+1 verify forward, still exactly one
+                        # counted host fetch — but up to k+1 committed
+                        # tokens per row, so syncs-per-token improves
+                        # with acceptance.  The adaptive policy backs
+                        # off to the plain fused chunk when the stream
+                        # stops drafting well.
+                        self._ensure_blocks(live, host_positions, win)
+                        if self._tables_dirty:
+                            self._tables_dev = jnp.asarray(
+                                self._host_tables)
+                            self._tables_dirty = False
+                        draft = self._drafter.propose_batch(live, batch)
+                        chunk_start = time.perf_counter()
+                        (toks, token, cache, positions, done_dev,
+                         limit_dev, committed_dev,
+                         rng) = self._verify_chunk(
+                             self.params, token, cache, positions,
+                             done_dev, limit_dev, rng, self._tables_dev,
+                             jnp.asarray(draft))
+                        (host_toks, host_positions, host_done,
+                         host_committed) = host_fetch(
+                             toks, positions, done_dev, committed_dev)
+                        syncs += 1
+                        chunk_dt = time.perf_counter() - chunk_start
+                        telemetry_metrics.INFER_DECODE_CHUNK_SECONDS \
+                            .observe(chunk_dt)
+                        decode_seconds += chunk_dt
+                        accepted = sum(max(int(host_committed[i]) - 1, 0)
+                                       for i in live)
+                        proposed = self.gen.spec_k * len(live)
+                        self._spec_policy.record(accepted, proposed)
+                        telemetry_metrics.INFER_SPEC_PROPOSED.inc(
+                            proposed)
+                        telemetry_metrics.INFER_SPEC_ACCEPTED.inc(
+                            accepted)
+                        telemetry_metrics.INFER_SPEC_ACCEPT_RATE.observe(
+                            accepted / max(proposed, 1))
+                        dispatched += sum(int(host_committed[i])
+                                          for i in live)
+                        for i in live:
+                            c = int(host_committed[i])
+                            if c:
+                                self._drafter.observe(
+                                    i, host_toks[i, :c])
+                        if _absorb(host_toks, host_committed):
+                            break
+                        continue
                     n = min(chunk, self.gen.max_seq_len - live_max)
                     if n <= 0:
                         break
+                    if self._drafter is not None:
+                        prev_pos = {i: int(host_positions[i])
+                                    for i in live}
                     if self.pooled:
                         # No migrations: growth is a free-list append
                         # to the host tables, uploaded only on change.
@@ -779,6 +925,16 @@ class Generator:
                         cache_len)
                     decode_seconds += chunk_dt
                     dispatched += n * len(prompts)
+                    if self._drafter is not None:
+                        # Keep the n-gram history current through the
+                        # sequential fallback chunks too: the valid
+                        # prefix of each row is its position delta.
+                        for i in live:
+                            delta = (int(host_positions[i])
+                                     - prev_pos[i])
+                            if delta > 0:
+                                self._drafter.observe(
+                                    i, host_toks[i, :delta])
                     if _absorb(host_toks):
                         break
             return [out[i] for i in range(len(prompts))]
@@ -797,3 +953,6 @@ class Generator:
             telemetry_metrics.INFER_GENERATED_TOKENS.inc(total)
             telemetry_metrics.INFER_HOST_SYNCS_PER_TOKEN.set(
                 syncs / max(total, 1))
+            if self._drafter is not None:
+                telemetry_metrics.INFER_SPEC_TOKENS_PER_SYNC.set(
+                    total / max(syncs, 1))

@@ -16,6 +16,7 @@ like the params so one lax.scan drives both.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -501,20 +502,28 @@ def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config,
     batch = h.shape[0]
     attn_p = layer_params['attn']
     group = config.n_heads // config.n_kv_heads
-    q_g = q.reshape(batch, 1, config.n_kv_heads, group, config.head_dim)
+    w = q.shape[1]
+    q_g = q.reshape(batch, w, config.n_kv_heads, group, config.head_dim)
     scale = config.head_dim ** -0.5
     s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff.astype(q.dtype),
                    preferred_element_type=jnp.float32) * scale
     if k_scale is not None:
         # (B, S, KV) -> (B, KV, 1, 1, S) onto the score block.
         s = s * jnp.swapaxes(k_scale, 1, 2)[:, :, None, None, :]
-    s = jnp.where(visible[:, None, None, None, :], s, -1e30)
+    # visible is (B, S) for the single-token path (every query sees the
+    # same prefix) or (B, W, S) for the speculative verify window
+    # (window row w additionally sees the draft rows before it).
+    if visible.ndim == 2:
+        mask = visible[:, None, None, None, :]    # -> (B, 1, 1, 1, S)
+    else:
+        mask = visible[:, None, None, :, :]       # -> (B, 1, 1, W, S)
+    s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
         p = p * jnp.swapaxes(v_scale, 1, 2)[:, :, None, None, :]
     p = p.astype(q.dtype)
     o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff.astype(q.dtype))
-    h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
+    h = h + quant.matmul(o.reshape(batch, w, -1), attn_p['wo'])
     x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                              eps=config.norm_eps)
     return h + _ffn(x, layer_params, config)
@@ -529,12 +538,25 @@ def get_decode_fn(impl: str):
     implementations don't — but it is accepted here so introspection
     and validation treat the canonical name uniformly."""
     if impl == 'inplace':
+        # Stays warning-free: 'inplace' is the pinned trend baseline the
+        # r1->rN bench comparisons are anchored on.
         return decode_step_inplace
     if impl == 'scan':
+        warnings.warn(
+            "decode_impl='scan' is deprecated and will be removed once "
+            "a hardware bench confirms parity; use the default "
+            "decode_impl='pooled' block-pool data plane instead.",
+            DeprecationWarning, stacklevel=2)
         return decode_step
     if impl == 'unroll':
         return decode_step_unrolled
     if impl == 'paged':
+        warnings.warn(
+            "decode_impl='paged' is deprecated and will be removed once "
+            "a hardware bench confirms parity; use the default "
+            "decode_impl='pooled' block-pool data plane instead (same "
+            "length-aware reads, plus shared-arena block tables).",
+            DeprecationWarning, stacklevel=2)
         return decode_step_paged
     if impl == 'pooled':
         return decode_step_pooled
@@ -821,6 +843,122 @@ def decode_step_pooled(params: llama.Params, token: jax.Array,
     logits = quant.matmul(h[:, 0], params['lm_head'],
                           out_dtype=jnp.float32)
     return logits, cache
+
+
+def decode_verify_pooled(params: llama.Params, tokens: jax.Array,
+                         config: llama.LlamaConfig, cache: Cache,
+                         positions: jax.Array, tables: jax.Array
+                         ) -> Tuple[jax.Array, Cache]:
+    """Speculative VERIFY step over the pooled arena: score a window of
+    W = spec_k + 1 tokens per slot in one batched forward.
+
+    tokens: (B, W) int32 — tokens[:, 0] is each slot's last committed
+    token (the one sequential decode would feed next) and tokens[:, 1:]
+    are the drafter's k proposals.  positions: (B,) int32 — the cache
+    row of tokens[:, 0]; window column w lands at row positions + w.
+
+    Per layer, all W rows' K/V scatter through the block table FIRST,
+    then every window query attends with the per-row causal mask
+    `slot <= positions + w` — a query sees its own row and the draft
+    prefix before it but never the speculative tail after it, so the
+    logits at every accepted position (and at the first mismatch) are
+    bit-identical to W sequential :func:`decode_step_pooled` calls.
+    Rejected rows need no cleanup: rewinding `positions` host-side hides
+    them behind the same mask and the next chunk overwrites them in
+    place — the block-table free list is never touched (the rollback
+    contract of infer/spec_decode.py).
+
+    Returns ((B, W, vocab) f32 logits, cache).
+    """
+    batch, win = tokens.shape
+    bs = cache['k'].shape[2]
+    t_width = tables.shape[1]
+    s_len = t_width * bs
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, s_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    h = llama.embed_tokens(params, tokens, config)       # (B, W, d)
+    pos0 = positions.astype(jnp.int32)
+    pos_w = pos0[:, None] + jnp.arange(win, dtype=jnp.int32)  # (B, W)
+    slot = jnp.arange(s_len)[None, None, :]
+    visible = slot <= pos_w[:, :, None]                  # (B, W, S)
+    quantized = 'k_scale' in cache
+    b_idx = jnp.arange(batch)[:, None]
+    group = config.n_heads // config.n_kv_heads
+    use_kernel = (jax.default_backend() == 'tpu'
+                  and config.head_dim % 128 == 0)
+    blk_idx = pos_w // bs
+    # Rows past the table (the engines reserve window slack, so only a
+    # defensive boundary case) go to the garbage block 0, never live.
+    blk = jnp.where(blk_idx >= t_width, 0,
+                    tables[b_idx, jnp.minimum(blk_idx, t_width - 1)])
+    off = pos_w % bs                                     # (B, W)
+
+    def body(i, carry):
+        h, cache = carry
+        layer_params = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
+                                                   keepdims=False),
+            params['layers'])
+        attn_p = layer_params['attn']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)                # (B, W, H/KV, hd)
+        q = rope_ops.apply_rope(q, cos, sin, positions=pos_w)
+        k = rope_ops.apply_rope(k, cos, sin, positions=pos_w)
+        if quantized:
+            k_row, k_s_row = _quantize_kv(k)
+            v_row, v_s_row = _quantize_kv(v)
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k_row),
+                v=cache['v'].at[i, blk, off].set(v_row),
+                k_scale=cache['k_scale'].at[i, blk, off].set(k_s_row),
+                v_scale=cache['v_scale'].at[i, blk, off].set(v_s_row))
+        else:
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k),
+                v=cache['v'].at[i, blk, off].set(v))
+        if use_kernel:
+            q_w = q.reshape(batch, win, config.n_kv_heads, group,
+                            config.head_dim)
+            o = decode_attention_ops.decode_window_attention_pooled(
+                q_w, cache['k'], cache['v'], tables, i, pos0,
+                cache.get('k_scale'), cache.get('v_scale'))
+            h = h + quant.matmul(o.reshape(batch, win, -1),
+                                 attn_p['wo'])
+            x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                     eps=config.norm_eps)
+            h = h + _ffn(x, layer_params, config)
+        else:
+            k_layer = jax.lax.dynamic_index_in_dim(cache['k'], i, 0,
+                                                   False)
+            v_layer = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
+                                                   False)
+            k_eff = k_layer[tables].reshape(
+                batch, s_len, config.n_kv_heads, config.head_dim)
+            v_eff = v_layer[tables].reshape(
+                batch, s_len, config.n_kv_heads, config.head_dim)
+            if quantized:
+                k_s = jax.lax.dynamic_index_in_dim(
+                    cache['k_scale'], i, 0, False)[tables].reshape(
+                        batch, s_len, config.n_kv_heads)
+                v_s = jax.lax.dynamic_index_in_dim(
+                    cache['v_scale'], i, 0, False)[tables].reshape(
+                        batch, s_len, config.n_kv_heads)
+            else:
+                k_s = v_s = None
+            h = _token_attn_mlp(h, layer_params, q, k_eff, v_eff,
+                                visible, config, k_scale=k_s,
+                                v_scale=v_s)
+        return (h, cache)
+
+    h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    logits = quant.matmul(h.reshape(batch * win, -1), params['lm_head'],
+                          out_dtype=jnp.float32)
+    return logits.reshape(batch, win, -1), cache
 
 
 def decode_step_unrolled(params: llama.Params, token: jax.Array,

@@ -247,6 +247,42 @@ class PrefixCache:
         self.pool.share(ids, prefix=True)
         return ids
 
+    def cached_continuation(self, tokens: Sequence[int],
+                            limit: int) -> List[int]:
+        """Up to ``limit`` CACHED tokens that followed ``tokens`` in an
+        earlier request — read straight off the trie's child keys (the
+        node keys ARE token blocks), so shared-prompt traffic can seed
+        the speculative n-gram drafter with the continuation other
+        requests already decoded.  Pure host walk: no refcounts taken,
+        no recency touch, no device work.  Ties between sibling
+        continuations resolve to the most recently used child.
+        Returns [] when the trie diverges from ``tokens`` (a stale
+        continuation would only waste draft slots)."""
+        toks = tuple(int(t) for t in tokens)
+        node = self._root
+        depth = 0
+        while len(toks) - depth >= self.block:
+            child = node.children.get(toks[depth:depth + self.block])
+            if child is None:
+                return []
+            node = child
+            depth += self.block
+        rem = toks[depth:]
+        out: List[int] = []
+        while len(out) < limit:
+            best = None
+            for key, child in node.children.items():
+                if key[:len(rem)] != rem:
+                    continue
+                if best is None or child.last_used > best.last_used:
+                    best = child
+            if best is None:
+                break
+            out.extend(best.key[len(rem):])
+            rem = ()
+            node = best
+        return out[:limit]
+
     # -- insertion --------------------------------------------------------
 
     def insert(self, tokens: Sequence[int],

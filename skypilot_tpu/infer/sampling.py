@@ -75,6 +75,67 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
     return gumbel_argmax(logits, rng)
 
 
+def _accept_prefix_len(targets: jax.Array, draft: jax.Array) -> jax.Array:
+    """targets (B, W) int32 target tokens (one per verify position),
+    draft (B, k) int32 proposed tokens, W == k + 1.  Returns (B,) int32:
+    the number of LEADING draft tokens the target agrees with.
+
+    Position i of the verify window conditions on draft token i+1 having
+    been fed as input, so draft[:, i] is checked against targets[:, i]
+    (the target's choice for the same position) and acceptance stops at
+    the first mismatch — the cumprod keeps only the matching prefix.
+    """
+    match = (draft == targets[:, :-1]).astype(jnp.int32)   # (B, k)
+    return jnp.sum(jnp.cumprod(match, axis=-1), axis=-1)
+
+
+def spec_accept_greedy(logits: jax.Array,
+                       draft: jax.Array) -> tuple:
+    """Greedy exact-match speculative acceptance.
+
+    logits (B, W, vocab) f32 — verify logits at the W = k+1 window
+    positions; draft (B, k) int32 — the drafter's proposals.  Returns
+    (targets (B, W) int32, accepts (B,) int32).  targets[b, :a+1] is
+    the committed token run for slot b (a = accepts[b]): the accepted
+    draft tokens ARE the target argmaxes at those positions, and the
+    position after the matching prefix emits the target's own argmax —
+    so the emitted stream is bit-exact with sequential greedy decode.
+    """
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return targets, _accept_prefix_len(targets, draft)
+
+
+def spec_accept_sampled(logits: jax.Array, draft: jax.Array,
+                        rng: jax.Array, temperature: jax.Array,
+                        top_p: jax.Array,
+                        top_k: Optional[int] = None,
+                        nucleus: bool = True) -> tuple:
+    """Distribution-preserving speculative acceptance for sampled rows.
+
+    The n-gram drafter is DETERMINISTIC (a point-mass proposal q), so
+    the Leviathan accept/reject scheme collapses to something exact and
+    simple: draw the target's own token y_i ~ p_i at every window
+    position with an independent per-position key, accept draft token
+    d_i while y_{i-1} == d_i, and emit y at the first mismatch.
+    P(accept d) = p(d) = min(1, p/q)·q mass, and the emitted token on
+    rejection is distributed as p restricted to tokens != d renormalized
+    — exactly the residual distribution — so every committed token is an
+    unbiased draw from the target model's distribution.
+
+    logits (B, W, vocab); draft (B, k); temperature/top_p (B,) per-row
+    params (temperature 0 rows fall back to argmax inside
+    :func:`sample_logits_batched`).  Returns (targets, accepts) like
+    :func:`spec_accept_greedy`.
+    """
+    w = logits.shape[1]
+    keys = jax.random.split(rng, w)
+    targets = jnp.stack(
+        [sample_logits_batched(logits[:, i], keys[i], temperature,
+                               top_p, top_k=top_k, nucleus=nucleus)
+         for i in range(w)], axis=1)
+    return targets, _accept_prefix_len(targets, draft)
+
+
 def sample_logits_batched(logits: jax.Array, rng: jax.Array,
                           temperature: jax.Array, top_p: jax.Array,
                           top_k: Optional[int] = None,

@@ -52,6 +52,7 @@ import numpy as np
 from skypilot_tpu.infer import block_pool as block_pool_lib
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
+from skypilot_tpu.infer import spec_decode as spec_decode_lib
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
 from skypilot_tpu.models import llama
@@ -256,6 +257,23 @@ class ContinuousBatcher:
         # zero install/extract device copies.
         self._prefix = prefix_cache.make_prefix_cache(
             gen_config, pool=self.pool)
+        # Speculative decoding (gen_config.spec_k > 0, pooled only —
+        # __post_init__ enforces the pairing): a host-side n-gram
+        # drafter proposes k tokens per slot, ONE verify forward scores
+        # the k+1 window, and the jitted accept step commits the
+        # matching prefix.  Fixed (batch, k) draft shape: the verify
+        # adds a fixed small compile budget next to _decode's
+        # (n, all_greedy, nucleus) family.
+        self._drafter = None
+        if self.pooled and gen_config.spec_k:
+            self._drafter = spec_decode_lib.NgramDrafter(
+                batch, gen_config.spec_k)
+            self._spec_policy = spec_decode_lib.SpecPolicy()
+            self._verify = jax.jit(functools.partial(
+                self._verify_impl, top_k=gen_config.top_k,
+                eos=gen_config.eos_token),
+                donate_argnums=(2,),
+                static_argnames=('all_greedy', 'nucleus'))
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
@@ -400,6 +418,41 @@ class ContinuousBatcher:
         return (rep(jnp.swapaxes(toks, 0, 1)), token, cache,
                 rep(positions), rep(done), limit, rng)
 
+    def _verify_impl(self, params, token, cache, positions, done, limit,
+                     temp_row, top_p_row, rng, tables, draft, *,
+                     all_greedy, nucleus, top_k, eos):
+        """Speculative chunk: score the k+1 candidate window (last
+        committed token + the host drafter's k proposals) in ONE
+        batched forward, then commit the accepted prefix with the
+        sequential chunk's exact per-token semantics (accept_window).
+        Rejected rows are pure cursor rollback — positions simply
+        never advance over them; the pooled plane's masks hide the
+        stale K/V and the next chunk overwrites it in place."""
+        tokens_w = jnp.concatenate([token[:, None], draft], axis=1)
+        logits, cache = llama_infer.decode_verify_pooled(
+            params, tokens_w, self.config, cache, positions, tables)
+        rng, sub = jax.random.split(rng)
+        if all_greedy:
+            # Greedy acceptance is BIT-EXACT: an accepted draft token
+            # IS the target argmax at its position.
+            targets, accepts = sampling.spec_accept_greedy(
+                logits, draft)
+        else:
+            targets, accepts = sampling.spec_accept_sampled(
+                logits, draft, sub, temp_row, top_p_row, top_k=top_k,
+                nucleus=nucleus)
+        fill = jnp.int32(eos if eos is not None else 0)
+        (emitted, token, positions, done, limit,
+         committed) = spec_decode_lib.accept_window(
+            targets, accepts, done, limit, positions, token,
+            eos=eos, fill=fill)
+        cache = tp_lib.constrain_cache(cache, self.mesh)
+
+        def rep(x):
+            return tp_lib.replicate(x, self.mesh)
+        return (rep(emitted), token, cache, rep(positions), rep(done),
+                limit, rep(committed), rng)
+
     def _install_first_impl(self, params, h_last, last_idx, token_row,
                             pos_row, done_row, limit_row, temp_row,
                             top_p_row, length, slot, temp, top_p, limit,
@@ -535,8 +588,12 @@ class ContinuousBatcher:
     # ---- pooled block accounting ----------------------------------------
     def _pool_cap(self, req: _Request) -> int:
         """Worst-case blocks the request can ever reference: prompt
-        plus its full token budget, capped at the table width."""
-        total = min(len(req.prompt) + req.max_new_tokens,
+        plus its full token budget — plus spec_k rows of verify-window
+        slack when speculation is on (the window writes candidate K/V
+        at positions pos..pos+k before knowing how many commit) —
+        capped at the table width."""
+        slack = self.gen.spec_k if self._drafter is not None else 0
+        total = min(len(req.prompt) + req.max_new_tokens + slack,
                     self.gen.max_seq_len)
         return min(-(-total // self.block_size), self.table_width)
 
@@ -847,6 +904,12 @@ class ContinuousBatcher:
             for i, req in enumerate(group):
                 self._host_pos[req.slot] = len(req.prompt)
                 req.out.append(int(firsts[i]))
+                if self._drafter is not None:
+                    cont = (self._prefix.cached_continuation(
+                        req.prompt, self.gen.max_seq_len)
+                        if self._prefix is not None else ())
+                    self._drafter.reset(req.slot, req.prompt, cont)
+                    self._drafter.observe(req.slot, [int(firsts[i])])
                 if (eos is not None and req.out[-1] == eos) or \
                         len(req.out) >= req.max_new_tokens:
                     self._finish(req)
@@ -959,6 +1022,12 @@ class ContinuousBatcher:
         # scheduler needs on host to test EOS/limit before promotion.
         (first_host,) = engine_lib.host_fetch(first)
         req.out.append(int(first_host))
+        if self._drafter is not None:
+            cont = (self._prefix.cached_continuation(
+                req.prompt, self.gen.max_seq_len)
+                if self._prefix is not None else ())
+            self._drafter.reset(req.slot, req.prompt, cont)
+            self._drafter.observe(req.slot, [int(first_host)])
         if (eos is not None and req.out[-1] == eos) or \
                 len(req.out) >= req.max_new_tokens:
             self._finish(req)
@@ -1053,6 +1122,87 @@ class ContinuousBatcher:
             self._prefix.insert(req.prompt, functools.partial(
                 self._prefix.extract, self._cache, req.slot))
 
+    def _step_spec(self) -> None:
+        """One draft-verify chunk over all active slots: the host
+        drafter proposes spec_k tokens per slot (zero device work), one
+        verify forward scores the k+1 window, and the accept step
+        commits each lane's agreeing prefix.  Still exactly ONE counted
+        host sync — acceptance is free tokens-per-sync.  Rejected
+        candidates are cursor rollback only: positions never advance
+        over them, so block tables, refcounts and the free list are
+        untouched."""
+        win = self.gen.spec_k + 1
+        # The window writes candidate K/V at rows pos..pos+k before the
+        # accept decision — cover the deepest one (reservation slack
+        # from _pool_cap guarantees the draw can't exhaust the pool).
+        self._ensure_slot_blocks(win)
+        if self._tables_dirty:
+            self._tables_dev = jnp.asarray(self._host_tables)
+            self._tables_dirty = False
+        all_greedy = not any(
+            float(self._host_temp[s]) > 0.0 for s in self._active)
+        nucleus = any(
+            float(self._host_top_p[s]) < 1.0 for s in self._active)
+        live = list(self._active)
+        draft = self._drafter.propose_batch(live, self.gen.batch_size)
+        chunk_start = time.perf_counter()
+        (toks, self._token, self._cache, self._positions, self._done,
+         self._limit, committed_dev, self._rng) = self._verify(
+            self.params, self._token, self._cache, self._positions,
+            self._done, self._limit, self._temp_row, self._top_p_row,
+            self._rng, self._tables_dev, jnp.asarray(draft),
+            all_greedy=all_greedy, nucleus=nucleus)
+        # The arena was donated through the verify: rebind the pool's
+        # handle before anything else can observe it.
+        self.pool.arena = self._cache
+        # ONE transfer for the whole chunk: emitted window rows plus
+        # the control rows and each lane's committed count (the host
+        # absorbs exactly that prefix — fill rows past it are rejected
+        # tail, NOT tokens).
+        host, host_pos, _, host_committed = engine_lib.host_fetch(
+            toks, self._positions, self._done, committed_dev)
+        self._host_pos = host_pos.astype(np.int64)
+        chunk_dt = time.perf_counter() - chunk_start
+        telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(chunk_dt)
+        telemetry_metrics.INFER_DECODE_BUCKET_CHUNKS.labels(
+            bucket=str(self._cache_len)).inc()
+        telemetry_metrics.INFER_DECODE_CACHE_ROWS.set(self._cache_len)
+        # Draft scoreboard: committed - 1 of each lane's tokens were
+        # drafter proposals (the +1 is the target's own token at the
+        # first mismatch / window end).
+        accepted = sum(max(int(host_committed[s]) - 1, 0)
+                       for s in live)
+        proposed = self.gen.spec_k * len(live)
+        self._spec_policy.record(accepted, proposed)
+        telemetry_metrics.INFER_SPEC_PROPOSED.inc(proposed)
+        telemetry_metrics.INFER_SPEC_ACCEPTED.inc(accepted)
+        telemetry_metrics.INFER_SPEC_ACCEPT_RATE.observe(
+            accepted / max(proposed, 1))
+        eos = self.gen.eos_token
+        appended = 0
+        for slot, req in list(self._active.items()):
+            c = int(host_committed[slot])
+            if c > 0:
+                self._drafter.observe(
+                    slot, [int(t) for t in host[slot, :c]])
+            for t in host[slot, :c]:
+                req.out.append(int(t))
+                appended += 1
+                if (eos is not None and req.out[-1] == eos) or \
+                        len(req.out) >= req.max_new_tokens:
+                    self._finish(req)
+                    break
+        if chunk_dt > 0:
+            telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
+                appended / chunk_dt)
+        telemetry_metrics.INFER_GENERATED_TOKENS.inc(appended)
+        telemetry_metrics.INFER_HOST_SYNCS_PER_TOKEN.set(
+            1.0 / max(appended, 1))
+        telemetry_metrics.INFER_SPEC_TOKENS_PER_SYNC.set(
+            float(appended))
+        telemetry_metrics.INFER_SLOT_OCCUPANCY.set(
+            len(self._active) / self.gen.batch_size)
+
     def step(self) -> None:
         """One scheduler tick: admit queued requests, advance the
         in-flight chunked prefill by one window, then one decode chunk
@@ -1067,7 +1217,14 @@ class ContinuousBatcher:
         # self._positions here would force one blocking device→host
         # transfer per tick on the serving hot path.
         live_max = max(int(self._host_pos[s]) for s in self._active)
+        if self._drafter is not None and \
+                live_max + self.gen.spec_k + 1 <= self.gen.max_seq_len \
+                and self._spec_policy.should_speculate():
+            self._step_spec()
+            return
         n = max(1, min(n, self.gen.max_seq_len - live_max))
+        prev_pos = ({s: int(self._host_pos[s]) for s in self._active}
+                    if self._drafter is not None else None)
         if self.pooled:
             # No migrations: growth is a free-list append to the host
             # block tables, uploaded only on change.  Per-step cache
@@ -1112,6 +1269,15 @@ class ContinuousBatcher:
         host, host_pos, _ = engine_lib.host_fetch(
             toks, self._positions, self._done)
         self._host_pos = host_pos.astype(np.int64)
+        if prev_pos is not None:
+            # Sequential ticks still feed the drafter: the emitted rows'
+            # first (new_pos - old_pos) entries are the slot's real
+            # tokens this chunk (fill follows once the lane froze).
+            for slot in list(self._active):
+                delta = int(self._host_pos[slot]) - prev_pos[slot]
+                if delta > 0:
+                    self._drafter.observe(
+                        slot, [int(t) for t in host[slot, :delta]])
         chunk_dt = time.perf_counter() - chunk_start
         telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(chunk_dt)
         telemetry_metrics.INFER_DECODE_BUCKET_CHUNKS.labels(

@@ -49,7 +49,8 @@ DEFAULT_BLOCK = 64
 
 def _decode_attn_kernel(layer_ref, pos_ref, maxblk_ref, q_ref, k_ref,
                         v_ref, *rest, block: int, kv_heads: int,
-                        group: int, head_dim: int, quantized: bool):
+                        group: int, head_dim: int, quantized: bool,
+                        window: int = 1):
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -58,7 +59,11 @@ def _decode_attn_kernel(layer_ref, pos_ref, maxblk_ref, q_ref, k_ref,
     b = pl.program_id(0)
     j = pl.program_id(1)
     nblk = pl.num_programs(1)
-    rows = kv_heads * group
+    # Row layout is kv-major, then window, then group: row =
+    # kv*(W*G) + w*G + g, so each per-kv-head dot below slices a
+    # CONTIGUOUS (W*G, hd) strip and the W=1 case reduces to the
+    # original single-token kernel bit-for-bit.
+    rows = kv_heads * window * group
     scale = head_dim ** -0.5
 
     @pl.when(j == 0)
@@ -69,23 +74,27 @@ def _decode_attn_kernel(layer_ref, pos_ref, maxblk_ref, q_ref, k_ref,
 
     pos = pos_ref[b]
 
-    @pl.when(j * block <= pos)
+    @pl.when(j * block <= pos + (window - 1))
     def _step():
         q = q_ref[0].astype(jnp.float32).reshape(rows, head_dim)
         k = k_ref[0, 0]                          # (block, KV, hd)
         v = v_ref[0, 0]
-        # Key index visible iff <= pos (pos = the CURRENT token's cache
-        # row, already written by the caller).
+        # Key index visible to window row w iff <= pos + w (pos = the
+        # cache row of the window's FIRST query; the caller has already
+        # written all W rows, and each query must see itself plus the
+        # draft prefix before it but not the speculative tail after).
         idx = j * block + jax.lax.broadcasted_iota(
             jnp.int32, (1, block), 1)
-        valid = idx <= pos                       # (1, block)
+        w_idx = (jax.lax.broadcasted_iota(
+            jnp.int32, (rows, 1), 0) % (window * group)) // group
+        valid = idx <= pos + w_idx               # (rows, block)
         s_parts = []
         for kv in range(kv_heads):
             kh = k[:, kv, :].astype(jnp.float32)
             if quantized:
                 kh = kh * ks_ref[0, 0][:, kv:kv + 1]
             s_parts.append(jax.lax.dot_general(
-                q[kv * group:(kv + 1) * group], kh,
+                q[kv * window * group:(kv + 1) * window * group], kh,
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32))
         s = jnp.concatenate(s_parts, axis=0) * scale   # (rows, block)
@@ -103,7 +112,7 @@ def _decode_attn_kernel(layer_ref, pos_ref, maxblk_ref, q_ref, k_ref,
             if quantized:
                 vh = vh * vs_ref[0, 0][:, kv:kv + 1]
             pv_parts.append(jax.lax.dot_general(
-                p[kv * group:(kv + 1) * group], vh,
+                p[kv * window * group:(kv + 1) * window * group], vh,
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))
         acc_scr[:] = acc_scr[:] * corr + jnp.concatenate(pv_parts, 0)
@@ -111,8 +120,8 @@ def _decode_attn_kernel(layer_ref, pos_ref, maxblk_ref, q_ref, k_ref,
     @pl.when(j == nblk - 1)
     def _finalize():
         o = acc_scr[:] / l_scr[:, :1]
-        o_ref[0] = o.reshape(kv_heads, group, head_dim).astype(
-            o_ref.dtype)
+        o_ref[0] = o.reshape(kv_heads, window * group,
+                             head_dim).astype(o_ref.dtype)
 
 
 def decode_attention(q: jax.Array, k_cache: jax.Array,
@@ -294,6 +303,125 @@ def decode_attention_pooled(q: jax.Array, k_arena: jax.Array,
             (batch, kv_heads, group, head_dim), q.dtype),
         interpret=interpret,
     )(layer_arr, pos_arr, maxblk, tbl_arr, *operands)
+
+
+def decode_window_attention_pooled(q: jax.Array, k_arena: jax.Array,
+                                   v_arena: jax.Array,
+                                   tables: jax.Array, layer: jax.Array,
+                                   positions: jax.Array,
+                                   k_scale: Optional[jax.Array] = None,
+                                   v_scale: Optional[jax.Array] = None,
+                                   *, interpret: Optional[bool] = None
+                                   ) -> jax.Array:
+    """W-query speculative-verify attention over the pooled arena.
+
+    Same arena/table contract as :func:`decode_attention_pooled`, but q
+    carries a WINDOW of W query positions per slot:
+
+    q: (B, W, KV, G, hd) post-rope queries — window row w sits at cache
+       row positions[b] + w, and the caller has already scattered all W
+       rows' K/V into the arena (writes-before-attend: row w's own K/V
+       lives in the block it attends to, matching sequential decode).
+    positions: (B,) int32 — cache row of the window's FIRST query.
+
+    Each window row masks keys at `index <= positions + w`, so the
+    speculative tail AFTER a row is invisible to it — the per-row
+    attention output is bit-identical to running W sequential
+    single-token steps, which is what makes greedy draft-verify exact.
+    W = 1 degenerates to :func:`decode_attention_pooled`.
+
+    Returns (B, W, KV, G, hd) in q.dtype.
+    """
+    n_layers, n_blocks, bs, kv_heads, head_dim = k_arena.shape
+    batch, win, _, group, _ = q.shape
+    rows = kv_heads * win * group
+    if head_dim % 128:
+        raise ValueError(f'head_dim {head_dim} must be a multiple of '
+                         f'128 for the TPU decode kernel')
+    quantized = k_scale is not None
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    pos_arr = positions.astype(jnp.int32)
+    batch_t, t_width = tables.shape
+    # The LAST window row is the deepest reader.
+    maxblk = jnp.minimum((pos_arr + (win - 1)) // bs, t_width - 1)
+    tbl_arr = tables.astype(jnp.int32)
+
+    # Kernel row layout: kv-major, then window, then group.
+    q_rows = jnp.transpose(q, (0, 2, 1, 3, 4)).reshape(
+        batch, kv_heads, win * group, head_dim)
+
+    def q_map(b, j, layer_s, pos_s, mb_s, tbl_s):
+        del j, layer_s, pos_s, mb_s, tbl_s
+        return (b, 0, 0, 0)
+
+    def kv_map(b, j, layer_s, pos_s, mb_s, tbl_s):
+        del pos_s
+        return (layer_s[0], tbl_s[b, jnp.minimum(j, mb_s[b])], 0, 0, 0)
+
+    def scale_map(b, j, layer_s, pos_s, mb_s, tbl_s):
+        del pos_s
+        return (layer_s[0], tbl_s[b, jnp.minimum(j, mb_s[b])], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, kv_heads, win * group, head_dim), q_map),
+        pl.BlockSpec((1, 1, bs, kv_heads, head_dim), kv_map),
+        pl.BlockSpec((1, 1, bs, kv_heads, head_dim), kv_map),
+    ]
+    operands = [q_rows, k_arena, v_arena]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, bs, kv_heads), scale_map),
+                     pl.BlockSpec((1, 1, bs, kv_heads), scale_map)]
+        operands += [k_scale, v_scale]
+
+    kernel = functools.partial(
+        _pooled_attn_kernel, block=bs, kv_heads=kv_heads,
+        group=group, head_dim=head_dim, quantized=quantized,
+        window=win)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(batch, t_width),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, kv_heads, win * group, head_dim),
+                               q_map),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, head_dim), jnp.float32),
+        ])
+    o = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, kv_heads, win * group, head_dim), q.dtype),
+        interpret=interpret,
+    )(layer_arr, pos_arr, maxblk, tbl_arr, *operands)
+    return jnp.transpose(
+        o.reshape(batch, kv_heads, win, group, head_dim),
+        (0, 2, 1, 3, 4))
+
+
+def reference_decode_window_attention(q: jax.Array, k_layer: jax.Array,
+                                      v_layer: jax.Array,
+                                      positions: jax.Array
+                                      ) -> jax.Array:
+    """Plain-XLA oracle for :func:`decode_window_attention_pooled` over
+    a gathered (B, S, KV, hd) layer slice.  q: (B, W, KV, G, hd);
+    window row w masks keys at index <= positions + w."""
+    batch, win, kv_heads, group, head_dim = q.shape
+    s_len = k_layer.shape[1]
+    scale = head_dim ** -0.5
+    s = jnp.einsum('bwkgd,bskd->bwkgs', q.astype(jnp.float32),
+                   k_layer.astype(jnp.float32)) * scale
+    visible = (jnp.arange(s_len)[None, None, :]
+               <= (positions[:, None]
+                   + jnp.arange(win)[None, :])[:, :, None])  # (B, W, S)
+    s = jnp.where(visible[:, :, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum('bwkgs,bskd->bwkgd', p,
+                   v_layer.astype(jnp.float32))
+    return o.astype(q.dtype)
 
 
 def reference_decode_attention(q: jax.Array, k_layer: jax.Array,

@@ -295,6 +295,35 @@ INFER_POOL_PREFIX_SHARES = prometheus_client.Counter(
     'copy of one block)',
     registry=REGISTRY)
 
+# ---- infer speculative decoding (infer/spec_decode.py) -----------------
+
+INFER_SPEC_PROPOSED = prometheus_client.Counter(
+    'skytpu_infer_spec_proposed_tokens_total',
+    'Draft tokens proposed by the speculative n-gram drafter (spec_k '
+    'per live slot per verify chunk)',
+    registry=REGISTRY)
+
+INFER_SPEC_ACCEPTED = prometheus_client.Counter(
+    'skytpu_infer_spec_accepted_tokens_total',
+    'Draft tokens the target model accepted (each one is a decode '
+    'token produced WITHOUT its own sequential forward)',
+    registry=REGISTRY)
+
+INFER_SPEC_ACCEPT_RATE = prometheus_client.Histogram(
+    'skytpu_infer_spec_chunk_accept_rate',
+    'Per-verify-chunk draft acceptance rate (accepted / proposed '
+    'across live slots); the adaptive SpecPolicy gates speculation on '
+    'an EMA of this',
+    buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+    registry=REGISTRY)
+
+INFER_SPEC_TOKENS_PER_SYNC = prometheus_client.Gauge(
+    'skytpu_infer_spec_tokens_per_host_sync',
+    'Committed tokens per counted host_fetch of the last generation '
+    'or tick with speculation enabled (the inverse of '
+    'host_syncs_per_token; rises with acceptance)',
+    registry=REGISTRY)
+
 # ---- serve (serve/load_balancer.py, replica_managers.py, autoscalers.py)
 
 SERVE_REPLICA_REQUESTS = prometheus_client.Counter(

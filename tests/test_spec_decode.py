@@ -1,0 +1,347 @@
+"""Speculative decoding (infer/spec_decode.py): bit-exactness of the
+draft-verify path against sequential decode, distribution preservation
+for sampled rows, rollback block-pool accounting, the
+one-host-sync-per-chunk contract, and the verify compile budget.
+
+Host-level units (drafter, policy, accept/rollback math) run in tier-1;
+model-level end-to-end checks are marked slow like their peers in
+test_infer.py / test_continuous_batching.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import sampling
+from skypilot_tpu.infer import spec_decode
+from skypilot_tpu.infer.engine import Generator, GeneratorConfig
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.metrics import REGISTRY
+from skypilot_tpu.models import llama
+
+CFG_F32 = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=128,
+                            max_seq_len=64, dtype=jnp.float32)
+CFG_BF16 = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=128,
+                             max_seq_len=64, dtype=jnp.bfloat16)
+
+# Repetitive prompts so the n-gram drafter gets real acceptance (and
+# therefore real rollbacks at the repetition boundaries).
+PROMPTS = [[5, 6, 7, 5, 6, 7, 5, 6], [9, 9, 9, 9]]
+
+
+@pytest.fixture(scope='module')
+def params_f32():
+    return llama.init_params(CFG_F32, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope='module')
+def params_bf16():
+    return llama.init_params(CFG_BF16, jax.random.PRNGKey(0))
+
+
+def _gen_config(spec, **kw):
+    base = dict(max_seq_len=64, batch_size=2, temperature=0.0,
+                decode_impl='pooled', decode_chunk=4, spec_k=spec,
+                prefix_cache_mb=1, prefix_block=8)
+    base.update(kw)
+    return GeneratorConfig(**base)
+
+
+def _accept_delta():
+    return (REGISTRY.get_sample_value(
+                'skytpu_infer_spec_accepted_tokens_total') or 0.0,
+            REGISTRY.get_sample_value(
+                'skytpu_infer_spec_proposed_tokens_total') or 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Host-level units (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_drafter_ngram_repetitive():
+    d = spec_decode.NgramDrafter(1, 3)
+    d.reset(0, [4, 5, 6, 4, 5, 6, 4, 5])
+    assert d.propose(0) == [6, 4, 5]
+
+
+def test_drafter_golden_future_replay_and_divergence():
+    d = spec_decode.NgramDrafter(1, 4)
+    d.reset(0, [1, 2, 3], continuation=[7, 8, 9, 7, 8, 9, 7, 8])
+    # Verbatim replay while the stream matches the cached continuation.
+    assert d.propose(0) == [7, 8, 9, 7]
+    d.observe(0, [7, 8])
+    assert d.propose(0) == [9, 7, 8, 9]
+    # First divergence drops the future for good...
+    d.observe(0, [5])
+    assert d._future[0] == []
+    # ...and the n-gram backoff still drafts a full-k window.
+    assert len(d.propose(0)) == 4
+
+
+def test_drafter_batch_masks_dead_slots():
+    d = spec_decode.NgramDrafter(3, 2)
+    d.reset(1, [4, 5, 4, 5])
+    draft = d.propose_batch([1], 3)
+    assert draft.shape == (3, 2)
+    assert list(draft[1]) == [4, 5]
+    assert draft[0].sum() == 0 and draft[2].sum() == 0
+
+
+def test_policy_backs_off_after_one_bad_chunk_then_probes():
+    p = spec_decode.SpecPolicy()
+    assert p.should_speculate()          # starts optimistic
+    p.record(0, 12)                      # one near-zero chunk
+    assert p.ema < p.threshold
+    assert p.should_speculate()          # first low-EMA call is a probe
+    for _ in range(p.probe_period):      # then sequential until re-probe
+        assert not p.should_speculate()
+    assert p.should_speculate()
+
+
+def test_policy_tolerates_one_mediocre_chunk():
+    p = spec_decode.SpecPolicy()
+    p.record(6, 12)                      # rate 0.5 in a good stream
+    assert p.ema >= p.threshold
+    assert p.should_speculate()
+
+
+def test_accept_prefix_len():
+    targets = jnp.array([[1, 2, 3, 9], [4, 5, 6, 7], [8, 0, 0, 0]],
+                        jnp.int32)
+    draft = jnp.array([[1, 2, 5], [4, 5, 6], [9, 0, 0]], jnp.int32)
+    got = sampling._accept_prefix_len(targets, draft)
+    assert list(np.asarray(got)) == [2, 3, 0]
+
+
+def test_accept_window_commit_rollback_eos_limit():
+    targets = jnp.array([[10, 11, 12, 13],
+                         [20, 21, 22, 23],
+                         [30, 31, 32, 33],
+                         [40, 41, 42, 43]], jnp.int32)
+    accepts = jnp.array([2, 0, 3, 3], jnp.int32)
+    done = jnp.array([False, False, False, True])
+    limit = jnp.array([10, 10, 2, 10], jnp.int32)
+    positions = jnp.array([5, 7, 3, 9], jnp.int32)
+    token = jnp.array([1, 2, 3, 4], jnp.int32)
+    emitted, token, positions, done, limit, committed = (
+        spec_decode.accept_window(targets, accepts, done, limit,
+                                  positions, token, eos=20,
+                                  fill=jnp.int32(0)))
+    # Row 0: 2 accepted drafts + the correction token commit.
+    # Row 1: correction token only (accepts=0), and it is EOS -> done.
+    # Row 2: limit=2 stops the lane after two commits despite accepts=3.
+    # Row 3: dead lane frozen entirely.
+    assert list(np.asarray(committed)) == [3, 1, 2, 0]
+    assert list(np.asarray(positions)) == [8, 8, 5, 9]
+    assert list(np.asarray(token)) == [12, 20, 31, 4]
+    assert list(np.asarray(done)) == [False, True, True, True]
+    assert list(np.asarray(emitted[0])) == [10, 11, 12, 0]
+    assert list(np.asarray(emitted[1])) == [20, 0, 0, 0]
+    assert list(np.asarray(emitted[2])) == [30, 31, 0, 0]
+    assert list(np.asarray(emitted[3])) == [0, 0, 0, 0]
+
+
+def test_spec_targets_independent_of_draft():
+    """The sampled accept draws the target's token at every window
+    position from the target distribution alone — the draft gates only
+    the accepted-prefix length, never the sampled values."""
+    rng = jax.random.PRNGKey(7)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 16))
+    ones = jnp.ones((2,), jnp.float32)
+    t_a, _ = sampling.spec_accept_sampled(
+        logits, jnp.zeros((2, 3), jnp.int32), rng, ones, ones)
+    t_b, _ = sampling.spec_accept_sampled(
+        logits, jnp.full((2, 3), 9, jnp.int32), rng, ones, ones)
+    assert np.array_equal(np.asarray(t_a), np.asarray(t_b))
+
+
+def test_spec_accept_sampled_matches_target_distribution():
+    """Monte Carlo: the first committed token's marginal equals the
+    target softmax (the distribution-preservation contract)."""
+    vocab, n = 8, 2000
+    logits = jax.random.normal(jax.random.PRNGKey(3), (1, 2, vocab))
+    ones = jnp.ones((1,), jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(11), n)
+    draft = jnp.zeros((1, 1), jnp.int32)
+
+    def draw(key):
+        targets, _ = sampling.spec_accept_sampled(
+            logits, draft, key, ones, ones)
+        return targets[0, 0]
+
+    toks = np.asarray(jax.vmap(draw)(keys))
+    emp = np.bincount(toks, minlength=vocab) / n
+    want = np.asarray(jax.nn.softmax(logits[0, 0]))
+    assert np.abs(emp - want).sum() < 0.1
+
+
+def test_spec_k_config_validation():
+    with pytest.raises(ValueError):
+        GeneratorConfig(spec_k=-1)
+    with pytest.raises(ValueError):
+        GeneratorConfig(spec_k=3, decode_impl='inplace')
+    with pytest.raises(ValueError):
+        GeneratorConfig(spec_k=63, max_seq_len=64, decode_impl='pooled')
+
+
+# ---------------------------------------------------------------------------
+# Model-level end-to-end (slow, CPU debug shapes)
+# ---------------------------------------------------------------------------
+
+def _seeded_spec_gen(params, cfg, gc, prompts, ref):
+    """Spec-on generator whose radix trie already holds each prompt's
+    greedy continuation, so admission hands the drafter a golden future
+    and the verify/accept/rollback path really runs."""
+    g = Generator(params, cfg, gc)
+    g.generate([p + o for p, o in zip(prompts, ref)], max_new_tokens=1)
+    return g
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('kv_dtype', [None, 'int8'])
+@pytest.mark.parametrize('dtype_name', ['f32', 'bf16'])
+def test_generator_greedy_parity(dtype_name, kv_dtype, request):
+    """Spec-on greedy output is BIT-EXACT vs spec-off — per param dtype
+    (f32/bf16) and KV dtype (model/bf16 vs quantized int8)."""
+    cfg = CFG_F32 if dtype_name == 'f32' else CFG_BF16
+    params = request.getfixturevalue(f'params_{dtype_name}')
+    ref = Generator(params, cfg, _gen_config(0, kv_cache_dtype=kv_dtype)
+                    ).generate(PROMPTS, max_new_tokens=20)
+    g1 = _seeded_spec_gen(params, cfg,
+                          _gen_config(3, kv_cache_dtype=kv_dtype),
+                          PROMPTS, ref)
+    a0, p0 = _accept_delta()
+    out = g1.generate(PROMPTS, max_new_tokens=20)
+    a1, p1 = _accept_delta()
+    assert out == ref
+    assert p1 > p0 and a1 > a0   # the spec path actually ran + accepted
+
+
+@pytest.mark.slow
+def test_batcher_greedy_parity_with_slot_reuse(params_f32):
+    """Spec-on ContinuousBatcher matches spec-off token-for-token,
+    including a request admitted by slot handoff (3 requests, 2 slots)
+    and a prefix-hit re-submission of an earlier prompt."""
+    prompts = PROMPTS + [[5, 6, 7, 5, 6, 7, 5, 6], [1, 2, 3, 4]]
+
+    def run(spec):
+        b = ContinuousBatcher(params_f32, CFG_F32, _gen_config(spec))
+        rids = [b.submit(p, max_new_tokens=16) for p in prompts]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    ref = run(0)
+    a0, p0 = _accept_delta()
+    assert run(3) == ref
+    a1, p1 = _accept_delta()
+    assert p1 > p0 and a1 > a0
+
+
+@pytest.mark.slow
+def test_spec_k_zero_is_noop(params_f32):
+    g = Generator(params_f32, CFG_F32, _gen_config(0))
+    b = ContinuousBatcher(params_f32, CFG_F32, _gen_config(0))
+    assert g._drafter is None and b._drafter is None
+    assert not hasattr(g, '_verify_chunk') or g.gen.spec_k == 0
+
+
+@pytest.mark.slow
+def test_sampled_spec_preserves_distribution(params_f32):
+    """Statistical check at the engine level: with temperature>0 the
+    first decode-committed token has the same distribution spec-on and
+    spec-off (committed tokens are unbiased draws from the target)."""
+    prompt = [5, 6, 7, 5, 6, 7, 5, 6]
+    seeds = 60
+
+    def hist(spec):
+        gc = _gen_config(spec, batch_size=4, temperature=1.0, top_k=8)
+        g = Generator(params_f32, CFG_F32, gc)
+        counts = np.zeros(CFG_F32.vocab_size)
+        for seed in range(seeds):
+            outs = g.generate([prompt] * 4, max_new_tokens=2, seed=seed)
+            for o in outs:
+                counts[o[1]] += 1
+        return counts / counts.sum()
+
+    h_off = hist(0)
+    a0, p0 = _accept_delta()
+    h_on = hist(3)
+    _, p1 = _accept_delta()
+    assert p1 > p0                       # speculation really happened
+    assert np.abs(h_on - h_off).sum() < 0.35
+
+
+@pytest.mark.slow
+def test_rollback_pool_accounting_exact(params_f32):
+    """Rollback is pure cursor math: the free list and refcounts after a
+    spec-on run are indistinguishable from the spec-off run, the pool
+    invariant (free + live == n_blocks - 1, no duplicate free ids, no
+    refcount drift) holds after EVERY step, and prefix-cache shares
+    survive rejected tails (one request is a prefix-hit resubmission)."""
+    prompts = PROMPTS + [[5, 6, 7, 5, 6, 7, 5, 6], [1, 2, 3, 4]]
+
+    def drive(spec):
+        b = ContinuousBatcher(params_f32, CFG_F32, _gen_config(spec))
+        rids = [b.submit(p, max_new_tokens=12) for p in prompts]
+        for _ in range(400):
+            if b.num_active == 0 and b.num_queued == 0:
+                break
+            b.step()
+            b.pool.check_invariant()
+        b.pool.check_invariant()
+        return b, [b.result(r) for r in rids]
+
+    b0, out0 = drive(0)
+    b1, out1 = drive(3)
+    assert out1 == out0
+    assert len(b1.pool._free) == len(b0.pool._free)
+    assert (sorted(b1.pool._refs.tolist())
+            == sorted(b0.pool._refs.tolist()))
+
+
+@pytest.mark.slow
+def test_spec_host_sync_budget(params_f32):
+    """A verify chunk costs exactly ONE counted host_fetch, like a
+    sequential chunk: with win == decode_chunk and a fully seeded
+    drafter, spec-on uses no more syncs than spec-off for the same
+    token stream."""
+    def count(gen, prompts, n):
+        calls = [0]
+        orig = engine_lib.host_fetch
+
+        def counting(*arrays):
+            calls[0] += 1
+            return orig(*arrays)
+
+        engine_lib.host_fetch = counting
+        try:
+            out = gen.generate(prompts, max_new_tokens=n)
+        finally:
+            engine_lib.host_fetch = orig
+        return out, calls[0]
+
+    g0 = Generator(params_f32, CFG_F32, _gen_config(0))
+    ref, syncs_off = count(g0, PROMPTS, 16)
+    g1 = _seeded_spec_gen(params_f32, CFG_F32, _gen_config(3),
+                          PROMPTS, ref)
+    out, syncs_on = count(g1, PROMPTS, 16)
+    assert out == ref
+    assert syncs_on <= syncs_off
+
+
+@pytest.mark.slow
+def test_verify_compile_budget(params_f32):
+    """One verify program, and the sequential decode budget (<=2) is
+    not disturbed by speculation — across spec chunks, fallback chunks,
+    and a second workload."""
+    g = _seeded_spec_gen(
+        params_f32, CFG_F32, _gen_config(3), PROMPTS,
+        Generator(params_f32, CFG_F32, _gen_config(0)).generate(
+            PROMPTS, max_new_tokens=16))
+    g.generate(PROMPTS, max_new_tokens=16)
+    g.generate([[44, 45], [46, 47, 48]], max_new_tokens=8)  # cold drafter
+    assert g._verify_chunk._cache_size() <= 1
+    assert g._decode_chunk._cache_size() <= 2
