@@ -204,15 +204,21 @@ def bench_allreduce() -> dict:
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    n = len(jax.devices())
+    from skypilot_tpu.parallel.mesh import ici_order
+
+    devices = ici_order(jax.devices())
+    n = len(devices)
     if n < 2:
         return {'ranks': n,
                 'skipped': 'single chip: psum needs >1 device '
                            '(run examples/allreduce_bench.yaml on a '
                            'slice for the ICI number)'}
-    payload_mb = 256 if jax.devices()[0].platform == 'tpu' else 8
+    payload_mb = 256 if devices[0].platform == 'tpu' else 8
     n_elem = payload_mb * (1 << 20) // 4
-    mesh = Mesh(np.array(jax.devices()), ('x',))
+    # ici_order arranges ranks along a serpentine walk of the ICI grid,
+    # so the ring the 1-axis mesh implies hops only between physical
+    # neighbors (Cloud-Collectives-style rank reordering).
+    mesh = Mesh(np.array(devices), ('x',))
     x = jax.device_put(jnp.ones((n, n_elem // n), jnp.float32),
                        NamedSharding(mesh, P('x', None)))
     iters = 20
@@ -244,6 +250,195 @@ def bench_allreduce() -> dict:
         out['suspect'] = ('exceeds physical bandwidth — loop likely '
                           'folded; do not trust')
     return out
+
+
+def bench_allgather() -> dict:
+    """all-gather algbw/busbw over the same ici_order'ed ring as
+    bench_allreduce.  Each chained iteration gathers the full payload
+    then keeps only its own shard back (dynamic_slice at axis_index),
+    so the program is shape-stable and chainable through fori_loop
+    while still moving every byte over the interconnect.  busbw uses
+    the ring all-gather model, (n-1)/n of algbw."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from skypilot_tpu.parallel.mesh import ici_order
+
+    devices = ici_order(jax.devices())
+    n = len(devices)
+    if n < 2:
+        return {'ranks': n, 'skipped': 'single chip: all-gather needs '
+                                       '>1 device'}
+    payload_mb = 256 if devices[0].platform == 'tpu' else 8
+    n_elem = payload_mb * (1 << 20) // 4
+    mesh = Mesh(np.array(devices), ('x',))
+    x = jax.device_put(jnp.ones((n, n_elem // n), jnp.float32),
+                       NamedSharding(mesh, P('x', None)))
+    iters = 20
+    rt = _roundtrip_baseline()
+
+    from skypilot_tpu.parallel.collectives import shard_map
+
+    def one(v):
+        def per_shard(s):
+            g = jax.lax.all_gather(s, 'x', tiled=True)
+            i = jax.lax.axis_index('x')
+            return jax.lax.dynamic_slice_in_dim(g, i * s.shape[0],
+                                                s.shape[0])
+        return shard_map(per_shard, mesh=mesh, in_specs=P('x', None),
+                         out_specs=P('x', None))(v)
+
+    @jax.jit
+    def run(v):
+        v = jax.lax.fori_loop(0, iters, lambda i, c: one(c), v)
+        return jnp.sum(v[..., :1])
+
+    dt = _time_chained(run, x, iters, rt)
+    bytes_total = x.size * 4
+    algbw = bytes_total / dt / 1e9
+    busbw = algbw * ((n - 1) / n)
+    out = {'ranks': n, 'payload_mb': payload_mb,
+           'algbw_gbps': round(algbw, 2), 'busbw_gbps': round(busbw, 2),
+           'time_ms': round(dt * 1e3, 3)}
+    if algbw > 10_000:
+        out['suspect'] = ('exceeds physical bandwidth — loop likely '
+                          'folded; do not trust')
+    return out
+
+
+def _mesh_bench_payload() -> dict:
+    """Mesh numbers measured in THIS process (needs >= 2 jax devices):
+    allreduce + allgather algbw/busbw over the ici_order'ed ring, plus
+    sharded pooled decode tok/s/chip on a make_tp_mesh mesh against the
+    single-device pooled baseline.  bench_mesh() decides WHERE this
+    body runs — in-process on a real slice, or in a respawned child
+    with forced host-platform CPU devices on single-device CI."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import GeneratorConfig
+    from skypilot_tpu.infer import tp as tp_lib
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.telemetry import metrics as telemetry_metrics
+
+    n = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == 'tpu'
+    allreduce = bench_allreduce()
+    allgather = bench_allgather()
+
+    # Sharded pooled decode over the whole slice as one tp group.  The
+    # CPU config keeps every partitioned dim divisible by tp degrees up
+    # to 8 (d_model 128, n_heads 8; n_kv_heads 2 + tpq overshard).
+    if on_tpu:
+        config = llama.LLAMA_1B
+        slots, prompt_len, max_new, chunk = 8, 32, 64, 32
+    else:
+        config = llama.LlamaConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=8,
+            n_kv_heads=2, d_ff=256, max_seq_len=256,
+            dtype=jnp.float32)
+        slots, prompt_len, max_new, chunk = 4, 8, 24, 8
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gen_cfg = GeneratorConfig(max_seq_len=prompt_len + max_new + 1,
+                              batch_size=slots, temperature=0.0,
+                              prompt_buckets=[prompt_len])
+
+    def tok_s(mesh):
+        batcher = ContinuousBatcher(params, config, gen_cfg,
+                                    decode_chunk=chunk, mesh=mesh)
+
+        def run_batch():
+            prompts = [[(7 * (i + 1)) % config.vocab_size] * prompt_len
+                       for i in range(slots)]
+            rids = [batcher.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            batcher.run_until_idle()
+            return sum(len(batcher.result(r)) for r in rids)
+
+        run_batch()                       # compile warmup (discarded)
+        t0 = time.perf_counter()
+        generated = run_batch()
+        return generated / (time.perf_counter() - t0)
+
+    mesh = tp_lib.make_tp_mesh(n, n_kv_heads=config.n_kv_heads)
+    sharded = tok_s(mesh)
+    single = tok_s(None)
+    # Collective/partition overhead share: perfect tp scaling would cut
+    # the fixed batch's wall clock by n, so the shortfall fraction
+    # 1 - t_ideal/t_mesh = 1 - sharded/(n * single) estimates the time
+    # spent in collectives + partition bookkeeping per decode chunk.
+    # Clamped to [0, 1]; on forced host-platform devices every "chip"
+    # shares the same cores, so the share reads pessimistically high —
+    # usable as a relative regression signal only (flagged by
+    # virtual_devices below).
+    share = (max(0.0, min(1.0, 1.0 - sharded / (n * single)))
+             if single else None)
+    if share is not None:
+        telemetry_metrics.INFER_MESH_COLLECTIVE_TIME_SHARE.set(share)
+
+    out = {
+        'ranks': n,
+        'mesh_axes': dict(zip(mesh.axis_names,
+                              [int(s) for s in mesh.devices.shape])),
+        'allreduce': allreduce,
+        'allgather': allgather,
+        'sharded_decode_tok_s_chip': round(sharded / n, 1),
+        'single_device_decode_tok_s': round(single, 1),
+        'collective_time_share_est':
+            None if share is None else round(share, 3),
+    }
+    if not on_tpu:
+        # Forced host-platform devices: the "interconnect" is shared
+        # host memory, so bandwidth numbers exercise the code path, not
+        # the fabric.
+        out['virtual_devices'] = True
+    return out
+
+
+def bench_mesh() -> dict:
+    """Topology-aware mesh bench.  With >= 2 devices it runs in-process
+    (real ICI on a slice).  On a single CPU device it respawns THIS
+    file with --mesh-child under XLA_FLAGS=--xla_force_host_platform_
+    device_count=N (N from SKYTPU_CPU_DEVICES, default 4) so CI always
+    produces a number instead of a permanent `skipped`.  A single real
+    accelerator stays honestly skipped: forcing virtual devices there
+    would fabricate an ICI figure."""
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _mesh_bench_payload()
+    if jax.devices()[0].platform != 'cpu':
+        return {'ranks': 1,
+                'skipped': 'single accelerator chip: run '
+                           'examples/allreduce_bench.yaml on a slice '
+                           'for the ICI numbers'}
+    n_child = int(os.environ.get('SKYTPU_CPU_DEVICES', '0') or 0) or 4
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['SKYTPU_CPU_DEVICES'] = str(n_child)
+    env['XLA_FLAGS'] = (
+        env.get('XLA_FLAGS', '')
+        + f' --xla_force_host_platform_device_count={n_child}').strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), '--mesh-child'],
+        capture_output=True, text=True, env=env, timeout=1200,
+        check=False)
+    for line in (proc.stdout or '').splitlines():
+        if line.startswith('MESH_CHILD_RESULT '):
+            out = json.loads(line[len('MESH_CHILD_RESULT '):])
+            out['via'] = (f'respawned child with {n_child} forced '
+                          'host-platform CPU devices '
+                          '(SKYTPU_CPU_DEVICES knob)')
+            return out
+    tail = ((proc.stderr or '') + (proc.stdout or ''))[-300:]
+    return {'error': f'mesh child produced no result: {tail}'}
 
 
 def bench_decode(on_tpu: bool) -> dict:
@@ -972,7 +1167,7 @@ def bench_launch_latency() -> dict:
 def build_headline(tok_s: float, mfu: float, llama8b: dict,
                    decode: dict, latency: dict, *,
                    prefix: dict = None, serve: dict = None,
-                   spec: dict = None) -> dict:
+                   spec: dict = None, mesh: dict = None) -> dict:
     """Compact tail-safe summary of every north-star number (VERDICT r4
     weak #1: the full JSON's leading metrics fell out of the driver's
     tail capture — this dict is printed LAST as `BENCH_HEADLINE {...}`
@@ -1051,6 +1246,24 @@ def build_headline(tok_s: float, mfu: float, llama8b: dict,
                     'high_acceptance', {}).get('accept_rate'),
                 'greedy_parity': spec.get('greedy_parity'),
             }
+    if isinstance(mesh, dict):
+        if 'error' in mesh:
+            headline['mesh'] = {'error': str(mesh['error'])[:120]}
+        elif 'skipped' in mesh:
+            headline['mesh'] = {'skipped': str(mesh['skipped'])[:120]}
+        else:
+            headline['mesh'] = {
+                'ranks': mesh.get('ranks'),
+                'allreduce_busbw_gbps': mesh.get(
+                    'allreduce', {}).get('busbw_gbps'),
+                'allgather_busbw_gbps': mesh.get(
+                    'allgather', {}).get('busbw_gbps'),
+                'sharded_decode_tok_s_chip': mesh.get(
+                    'sharded_decode_tok_s_chip'),
+                'collective_time_share_est': mesh.get(
+                    'collective_time_share_est'),
+                'virtual_devices': mesh.get('virtual_devices', False),
+            }
     if 'suspect' in llama8b:
         headline['llama_8b_suspect'] = llama8b['suspect']
     if 'error' in llama8b:
@@ -1116,6 +1329,15 @@ def main() -> None:
     serve = _safe(bench_serve, on_tpu)
     spec = _safe(bench_spec, on_tpu)
     allreduce = _safe(bench_allreduce)
+    mesh_bench = _safe(bench_mesh)
+    if 'skipped' in allreduce and isinstance(
+            mesh_bench.get('allreduce'), dict):
+        # The mesh bench's child process measured a real multi-device
+        # allreduce (forced host-platform CPU devices) — publish those
+        # numbers instead of a permanent `skipped`, annotated with how
+        # they were obtained.
+        allreduce = dict(mesh_bench['allreduce'],
+                         via=mesh_bench.get('via', 'bench_mesh'))
     latency = _safe(bench_launch_latency)
 
     mesh = make_mesh(MeshConfig(fsdp=n_chips))
@@ -1154,6 +1376,7 @@ def main() -> None:
                   'serve': serve,
                   'spec_decode': spec,
                   'allreduce': allreduce,
+                  'mesh': mesh_bench,
                   'launch_latency': latency,
                   # Method changes recorded alongside numbers so trends
                   # stay interpretable (VERDICT r2 weak #7).
@@ -1272,6 +1495,9 @@ def main() -> None:
     # Speculative-decoding summary (high-acceptance speedup + the
     # adversarial fallback check) — tail-safe line, same contract.
     print('SPEC_SUMMARY ' + json.dumps(spec))
+    # Mesh summary (ici-ordered collective bandwidths + sharded pooled
+    # decode tok/s/chip) — tail-safe line, same contract.
+    print('MESH_SUMMARY ' + json.dumps(mesh_bench))
     # HEADLINE line LAST: the driver records only the output TAIL, and in
     # r4 the full JSON grew enough that its leading headline metrics fell
     # out of the captured window (VERDICT r4 weak #1).  This compact
@@ -1280,8 +1506,15 @@ def main() -> None:
     # JSON above remains the authoritative detailed artifact.
     print('BENCH_HEADLINE ' + json.dumps(
         build_headline(tok_s, mfu, llama8b, decode, latency,
-                       prefix=prefix_reuse, serve=serve, spec=spec)))
+                       prefix=prefix_reuse, serve=serve, spec=spec,
+                       mesh=mesh_bench)))
 
 
 if __name__ == '__main__':
-    main()
+    import sys as _sys
+    if '--mesh-child' in _sys.argv:
+        # Respawned by bench_mesh() with forced host-platform devices:
+        # run ONLY the mesh payload and emit it on a parseable line.
+        print('MESH_CHILD_RESULT ' + json.dumps(_mesh_bench_payload()))
+    else:
+        main()

@@ -1,4 +1,10 @@
-"""psum allreduce bench over the slice's ICI mesh (runs on every host)."""
+"""psum allreduce bench over the slice's ICI mesh (runs on every host).
+
+With --sharded-decode it additionally serves a short greedy batch
+through the POOLED decode plane sharded over the whole slice
+(infer/multihost.make_replica_mesh) — the collective numbers next to
+the serving throughput they bound.
+"""
 import argparse
 import json
 
@@ -7,10 +13,60 @@ import _bootstrap  # noqa: F401  (source-checkout sys.path shim)
 from skypilot_tpu.utils import env_contract
 
 
+def _sharded_decode_bench() -> dict:
+    """Pooled sharded decode tok/s/chip over the replica mesh.
+
+    Every host runs the identical scripted workload, so the batcher's
+    host-side scheduling (pure deterministic math, infer/block_pool.py)
+    stays in lockstep across processes without a control channel.
+    """
+    import time
+
+    import jax
+    from skypilot_tpu.infer import multihost, multihost_check
+    from skypilot_tpu.infer import tp as tp_lib
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+
+    jax_minor = tuple(int(v) for v in jax.__version__.split('.')[:2])
+    if (jax.process_count() > 1 and jax.devices()[0].platform == 'cpu'
+            and jax_minor < (0, 5)):
+        # 0.4.x XLA: no CPU cross-process collectives — the emulated
+        # multi-host topology can't run the sharded program.
+        return {'skipped': f'jax {jax.__version__}: CPU multiprocess '
+                           'collectives need jax >= 0.5'}
+    n = jax.device_count()
+    config = multihost_check._model(n)
+    mesh = multihost.make_replica_mesh(n_kv_heads=config.n_kv_heads)
+    params = tp_lib.init_sharded_params(config, jax.random.PRNGKey(0),
+                                        mesh)
+    batcher = ContinuousBatcher(params, config,
+                                multihost_check._gen_config(), mesh=mesh)
+
+    def run_batch():
+        rids = [batcher.submit(p, max_new_tokens=multihost_check.MAX_NEW)
+                for p in multihost_check.PROMPTS]
+        batcher.run_until_idle()
+        return sum(len(batcher.result(r)) for r in rids)
+
+    run_batch()                          # compile warmup (discarded)
+    t0 = time.perf_counter()
+    generated = run_batch()
+    dt = time.perf_counter() - t0
+    return {'ranks': n, 'generated_tokens': generated,
+            'decode_tok_s': round(generated / dt, 1),
+            'decode_tok_s_chip': round(generated / dt / n, 2),
+            'mesh_axes': dict(zip(mesh.axis_names,
+                                  [int(s) for s in mesh.devices.shape]))}
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--payload-mb', type=float, default=256)
     parser.add_argument('--iters', type=int, default=20)
+    parser.add_argument('--sharded-decode', action='store_true',
+                        help='also serve a short batch through the '
+                             'pooled decode plane sharded over the '
+                             'whole slice')
     args = parser.parse_args()
 
     env_contract.initialize_from_env()
@@ -22,6 +78,9 @@ def main() -> None:
     mesh = make_mesh(MeshConfig(dp=n))
     result = collectives.psum_bench(mesh, 'dp', payload_mb=args.payload_mb,
                                     iters=args.iters)
+    if args.sharded_decode:
+        result = {'allreduce': result,
+                  'sharded_decode': _sharded_decode_bench()}
     if jax.process_index() == 0:
         print(json.dumps(result))
 

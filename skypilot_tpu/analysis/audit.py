@@ -42,7 +42,14 @@ CALLBACK_PRIMITIVES = frozenset({
 # The StableHLO attribute jax emits for a donated (input-aliased-to-
 # output) argument; its presence is the proof donation survived
 # lowering rather than being silently dropped.
-_DONATION_MARKER = 'tf.aliasing_output'
+# Donation is spelled differently in the two lowering pipelines:
+# single-device lowerings carry `tf.aliasing_output` on the donated
+# argument; GSPMD (num_partitions > 1) lowerings carry
+# `jax.buffer_donor` instead (the compiled module's header then shows
+# the concrete input_output_alias pairs).  Either one means the arena
+# aliases in place.
+_DONATION_MARKERS = ('tf.aliasing_output', 'jax.buffer_donor')
+_DONATION_MARKER = _DONATION_MARKERS[0]
 
 
 def _check(name: str, status: str, detail: str) -> Dict[str, str]:
@@ -104,14 +111,14 @@ def _jaxpr_dtype_and_callback_checks(closed_jaxpr) -> List[Dict[str, str]]:
 
 
 def _donation_check(lowered_text: str, what: str) -> Dict[str, str]:
-    applied = _DONATION_MARKER in lowered_text
+    applied = any(m in lowered_text for m in _DONATION_MARKERS)
     return _check(
         'donation',
         'ok' if applied else 'fail',
         f'{what} donated (input/output aliasing in lowered HLO)'
         if applied else
         f'{what} NOT donated — every dispatch pays a full copy '
-        f'(no {_DONATION_MARKER} attribute in lowered HLO)')
+        f'(none of {_DONATION_MARKERS} in lowered HLO)')
 
 
 def _sharding_check(mesh) -> Dict[str, str]:
@@ -152,13 +159,14 @@ def _tiny_gen_config(**overrides):
     return GeneratorConfig(**kwargs)
 
 
-def make_tiny_generator(**overrides):
+def make_tiny_generator(mesh=None, **overrides):
     import jax
     from skypilot_tpu.infer.engine import Generator
     from skypilot_tpu.models import llama
     config = _tiny_config()
     params = llama.init_params(config, jax.random.PRNGKey(0))
-    return Generator(params, config, _tiny_gen_config(**overrides))
+    return Generator(params, config, _tiny_gen_config(**overrides),
+                     mesh=mesh)
 
 
 def _decode_chunk_inputs(gen, bucket: int, n: int):
@@ -712,6 +720,165 @@ def audit_ring_attention() -> Dict[str, Any]:
             'checks': _jaxpr_dtype_and_callback_checks(jaxpr)}
 
 
+def _hlo_computation_bodies(compiled_text: str) -> Dict[str, List[str]]:
+    """Split post-partitioner HLO text into {computation header: body
+    lines}.  Computations open with an unindented `name (...) -> ... {`
+    header and close with a bare `}` — the format `compile().as_text()`
+    has emitted for years; a format change degrades the collective
+    counts to zero, which the caller reports as a failed parse, not a
+    silent pass."""
+    bodies: Dict[str, List[str]] = {}
+    current = None
+    for line in compiled_text.splitlines():
+        if line and not line[0].isspace() and \
+                line.rstrip().endswith('{'):
+            current = line.strip()
+            bodies[current] = []
+        elif line.strip() == '}':
+            current = None
+        elif current is not None:
+            bodies[current].append(line.strip())
+    return bodies
+
+
+def audit_mesh_decode() -> Dict[str, Any]:
+    """The SHARDED pooled decode contract, checked on a 2-chip ('tp',
+    'tpq') debug mesh against the post-SPMD-partitioner HLO (collectives
+    only exist after partitioning — the lowered StableHLO carries just
+    sharding annotations):
+
+    - compile budget: the mesh does not re-key the decode jit — still
+      <= 2 programs across a bucket-crossing generation;
+    - arena donation survives sharding;
+    - megatron collective budget: the ROLLED layer-loop body contains
+      exactly 2 all-reduces (1 post-attn + 1 post-MLP) and no
+      computation exceeds that — a third psum per layer means some op
+      (scatter write, pooled attention, sampling) silently went
+      cross-shard;
+    - no all-gather of the full arena: paged attention must read the
+      LOCAL head shard, never rematerialize (L, NB, BS, KV, hd).
+    """
+    import re
+
+    import jax
+    import numpy as np
+    from skypilot_tpu.infer import tp as tp_lib
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {'entry': 'mesh_decode', 'checks': [_check(
+            'mesh', 'skip',
+            f'needs >= 2 devices, have {len(devices)} — force CPU '
+            f'devices via SKYTPU_CPU_DEVICES/'
+            f'--xla_force_host_platform_device_count')]}
+    config = _tiny_config()
+    mesh = tp_lib.make_tp_mesh(2, n_kv_heads=config.n_kv_heads,
+                               devices=devices[:2])
+    gen = make_tiny_generator(mesh=mesh)
+    checks: List[Dict[str, str]] = []
+
+    # Budget 1: same <= 2 decode programs as the single-chip audit.
+    gen.generate(_AUDIT_PROMPTS, max_new_tokens=_AUDIT_MAX_NEW)
+    compiles = gen._decode_chunk._cache_size()
+    checks.append(_check(
+        'compile_per_bucket',
+        'ok' if compiles <= 2 else 'fail',
+        f'{compiles} decode-chunk compiles on the 2-chip mesh for the '
+        f'pooled budget of 2'
+        + ('' if compiles <= 2 else
+           ' — sharding re-keys the decode program')))
+
+    # Lower+compile ONE chunk with operands placed exactly as the
+    # engine places them (the jit has no explicit in_shardings, so the
+    # partitioned program exists only for sharded concrete operands).
+    args, n = _decode_chunk_inputs(gen, gen.cache_buckets[0],
+                                   gen.gen.decode_chunk)
+    (params, token, arena, positions, done, limit, rng, tables) = args
+    arena = {k: jax.device_put(
+        v, tp_lib.cache_scale_sharding(mesh) if k.endswith('_scale')
+        else tp_lib.cache_sharding(mesh))
+        for k, v in arena.items()}
+    rep = tp_lib.replicated_sharding(mesh)
+    args = (params, jax.device_put(token, rep), arena,
+            jax.device_put(positions, rep), jax.device_put(done, rep),
+            jax.device_put(limit, rep), jax.device_put(rng, rep),
+            jax.device_put(tables, rep))
+    lowered = gen._decode_chunk.lower(*args, n=n)
+    checks.append(_donation_check(lowered.as_text(),
+                                  'sharded pool arena'))
+    hlo = lowered.compile().as_text()
+
+    # Budget 3: megatron all-reduce count.  Count ACTIVATION-SIZED
+    # all-reduces (result >= batch * d_model elements: the (B, 1, d)
+    # residual updates after wo and w_down) per computation and divide
+    # by how many layer bodies the computation holds — XLA sometimes
+    # unrolls the tiny 2-layer loop into one computation, so the raw
+    # count is 2 x n_layers there.  Tiny norm-stat reductions (the
+    # (B, 1) rms-norm partial means XLA emits when it keeps activations
+    # d-sharded — megatron's sequence-parallel trade, bytes ~ batch)
+    # are reported but NOT budgeted: the budget exists to catch a third
+    # activation-wide psum sneaking into the layer, not to outlaw an
+    # 8-byte stat combine.
+    act_elems = gen.gen.batch_size * gen.config.d_model
+    bodies = _hlo_computation_bodies(hlo)
+
+    def _ar_sizes(body):
+        sizes = []
+        for ln in body:
+            if re.search(r'\ball-reduce(-start)?\(', ln):
+                m = re.search(r'=\s*\(?\w+\[([0-9,]*)\]', ln)
+                dims = ([int(d) for d in m.group(1).split(',') if d]
+                        if m else [])
+                sizes.append(int(np.prod(dims)) if dims else 1)
+        return sizes
+
+    big_by_comp = {h.split(' ')[0]: [s for s in _ar_sizes(b)
+                                     if s >= act_elems]
+                   for h, b in bodies.items()}
+    big_by_comp = {k: v for k, v in big_by_comp.items() if v}
+    small_total = sum(
+        1 for b in bodies.values() for s in _ar_sizes(b)
+        if s < act_elems)
+    worst = max((len(v) for v in big_by_comp.values()), default=0)
+    per_layer = worst
+    # An unrolled layer loop concentrates n_layers bodies in one
+    # computation; the per-layer rate is what the rule bounds.
+    if worst and worst % gen.config.n_layers == 0 and worst > 2:
+        per_layer = worst // gen.config.n_layers
+    if not bodies:
+        checks.append(_check(
+            'collective_budget', 'fail',
+            'could not parse computations out of compiled HLO — '
+            'format change?'))
+    else:
+        checks.append(_check(
+            'collective_budget',
+            'ok' if per_layer == 2 else 'fail',
+            f'{per_layer} activation-sized all-reduces per layer '
+            f'(megatron rule: exactly 2 — 1 post-attn + 1 post-MLP); '
+            f'busiest computation: {worst}, norm-stat all-reduces '
+            f'(< {act_elems} elements, unbudgeted): {small_total}'))
+
+    # Budget 4: no all-gather may rebuild the full arena (paged reads
+    # stay on the local KV-head shard).
+    arena_elems = int(np.prod(gen.pool.arena['k'].shape))
+    biggest = 0
+    for line in hlo.splitlines():
+        if re.search(r'\ball-gather(-start)?\(', line):
+            for dims in re.findall(r'\w+\[([0-9,]+)\]', line):
+                elems = int(np.prod([int(d) for d in
+                                     dims.split(',')]))
+                biggest = max(biggest, elems)
+    checks.append(_check(
+        'no_arena_allgather',
+        'ok' if biggest < arena_elems else 'fail',
+        f'largest all-gather in the partitioned decode moves '
+        f'{biggest} elements (full arena would be {arena_elems})'))
+    return {'entry': 'mesh_decode', 'checks': checks,
+            'compiles': compiles,
+            'allreduce_per_layer': per_layer}
+
+
 REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'generator_decode': audit_generator_decode,
     'batcher_decode': audit_batcher_decode,
@@ -719,6 +886,7 @@ REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'prefix_cache': audit_prefix_cache,
     'block_pool': audit_block_pool,
     'spec_decode': audit_spec_decode,
+    'mesh_decode': audit_mesh_decode,
     'trainer_step': audit_trainer_step,
     'ckpt_reshard': audit_ckpt_reshard,
     'ring_attention': audit_ring_attention,

@@ -121,6 +121,7 @@ class BlockPool:
         self.block_size = int(block_size)
         self.arena = init_arena(config, n_blocks, block_size,
                                 sharding=sharding, kv_dtype=kv_dtype)
+        self._sharded = sharding is not None
         # LIFO free list: most-recently-freed block reused first (warm
         # in whatever cache hierarchy cares; also the simplest
         # deterministic order).  Block 0 is never a member.
@@ -264,6 +265,12 @@ class BlockPool:
         telemetry_metrics.INFER_POOL_BLOCKS_LIVE.set(self.live_blocks())
         telemetry_metrics.INFER_POOL_BLOCKS_FREE.set(len(self._free))
         telemetry_metrics.INFER_POOL_HWM.set(self.hwm)
+        if self._sharded:
+            # Block ids are global (the arena shards KV heads, never
+            # the num_blocks axis), so every tp shard holds a head
+            # slice of exactly the live set.
+            telemetry_metrics.INFER_MESH_POOL_BLOCKS_PER_SHARD.set(
+                self.live_blocks())
 
     def stats(self) -> Dict[str, int]:
         return {

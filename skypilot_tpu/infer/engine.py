@@ -287,14 +287,19 @@ class Generator:
     def __init__(self, params: llama.Params, config: llama.LlamaConfig,
                  gen_config: GeneratorConfig = GeneratorConfig(),
                  mesh=None):
-        """mesh: optional 1-axis ('tp',) jax.sharding.Mesh (see infer/tp.py)
-        — params/KV cache are megatron-sharded over it so models larger
-        than one chip's HBM can serve; decode math is unchanged (GSPMD
-        partitions the same jitted functions)."""
+        """mesh: optional ('tp','tpq') — or ('dp','tp','tpq') —
+        jax.sharding.Mesh from tp_lib.make_tp_mesh (see infer/tp.py) —
+        params/KV cache/pooled arena are megatron-sharded over it so
+        models larger than one chip's HBM can serve; decode math is
+        unchanged (GSPMD partitions the same jitted functions, and the
+        pooled Pallas kernel runs per KV-head shard under shard_map)."""
         self.mesh = mesh
         if mesh is not None:
             tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
+            for axis, size in tp_lib.mesh_axis_sizes(mesh).items():
+                telemetry_metrics.INFER_MESH_DEVICES.labels(
+                    axis=axis).set(size)
         validate_context(gen_config, config)
         self.params = prepare_params(params, gen_config)
         self.config = config
@@ -537,7 +542,8 @@ class Generator:
             # programs (full chunk + context-ceiling tail).
             def decode_fn(params, token, config, cache, positions):
                 return llama_infer.decode_step_pooled(
-                    params, token, config, cache, positions, tables)
+                    params, token, config, cache, positions, tables,
+                    mesh=self.mesh)
         else:
             decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
         batch = token.shape[0]
@@ -589,7 +595,8 @@ class Generator:
         fill = jnp.int32(eos if eos is not None else 0)
         tokens_w = jnp.concatenate([token[:, None], draft], axis=1)
         logits, cache = llama_infer.decode_verify_pooled(
-            params, tokens_w, self.config, cache, positions, tables)
+            params, tokens_w, self.config, cache, positions, tables,
+            mesh=self.mesh)
         rng, sub = jax.random.split(rng)
         if temperature == 0.0:
             targets, accepts = sampling.spec_accept_greedy(logits, draft)

@@ -732,8 +732,8 @@ def decode_step_paged(params: llama.Params, token: jax.Array,
 
 def decode_step_pooled(params: llama.Params, token: jax.Array,
                        config: llama.LlamaConfig, cache: Cache,
-                       positions: jax.Array, tables: jax.Array
-                       ) -> Tuple[jax.Array, Cache]:
+                       positions: jax.Array, tables: jax.Array,
+                       mesh=None) -> Tuple[jax.Array, Cache]:
     """One-token step over the pooled block arena (the default data
     plane, infer/block_pool.py).
 
@@ -758,6 +758,13 @@ def decode_step_pooled(params: llama.Params, token: jax.Array,
     tables is a TRACED operand: growing a sequence appends free-list
     blocks and re-uploads the table — no shape change, no recompile,
     no resize_cache migration.
+
+    mesh: optional ('dp','tp','tpq') / ('tp','tpq') serving mesh.  The
+    only place it is consulted is the Pallas kernel call, which wraps
+    itself in shard_map to run per KV-head shard; everything else
+    (scatter write, gather fallback, megatron matmuls) is plain GSPMD
+    over the sharded operands — the K/V scatter needs no collective
+    because the kv-head axis is sharded but never a scatter dim.
     """
     batch = token.shape[0]
     bs = cache['k'].shape[2]
@@ -810,7 +817,8 @@ def decode_step_pooled(params: llama.Params, token: jax.Array,
             o = decode_attention_ops.decode_attention_pooled(
                 q_r, cache['k'], cache['v'], tables, i,
                 positions.astype(jnp.int32),
-                cache.get('k_scale'), cache.get('v_scale'))
+                cache.get('k_scale'), cache.get('v_scale'),
+                mesh=mesh)
             h = h + quant.matmul(o.reshape(batch, 1, -1), attn_p['wo'])
             x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                                      eps=config.norm_eps)
@@ -847,8 +855,8 @@ def decode_step_pooled(params: llama.Params, token: jax.Array,
 
 def decode_verify_pooled(params: llama.Params, tokens: jax.Array,
                          config: llama.LlamaConfig, cache: Cache,
-                         positions: jax.Array, tables: jax.Array
-                         ) -> Tuple[jax.Array, Cache]:
+                         positions: jax.Array, tables: jax.Array,
+                         mesh=None) -> Tuple[jax.Array, Cache]:
     """Speculative VERIFY step over the pooled arena: score a window of
     W = spec_k + 1 tokens per slot in one batched forward.
 
@@ -925,7 +933,8 @@ def decode_verify_pooled(params: llama.Params, tokens: jax.Array,
                             config.head_dim)
             o = decode_attention_ops.decode_window_attention_pooled(
                 q_w, cache['k'], cache['v'], tables, i, pos0,
-                cache.get('k_scale'), cache.get('v_scale'))
+                cache.get('k_scale'), cache.get('v_scale'),
+                mesh=mesh)
             h = h + quant.matmul(o.reshape(batch, win, -1),
                                  attn_p['wo'])
             x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],

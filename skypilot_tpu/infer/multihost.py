@@ -70,24 +70,32 @@ def initialize_from_env(timeout_s: Optional[int] = None) -> dict:
 
 
 def make_replica_mesh(tp: Optional[int] = None,
-                      n_kv_heads: Optional[int] = None):
-    """('tp', 'tpq') mesh over ALL devices of the replica — every chip
-    of every host (contrast infer/tp.py:make_tp_mesh, which stays within
-    jax.local_devices() for single-host serving).  n_kv_heads enables
-    the GQA overshard axis when the replica has more chips than the
-    model has KV heads (infer/tp.py:INFER_TP_RULES).  Requires
-    jax.distributed to be initialized on every host first."""
+                      n_kv_heads: Optional[int] = None, dp: int = 1):
+    """('tp', 'tpq') — or ('dp', 'tp', 'tpq') when dp > 1 — mesh over
+    ALL devices of the replica — every chip of every host (contrast
+    infer/tp.py:make_tp_mesh, which stays within jax.local_devices()
+    for single-host serving).  n_kv_heads enables the GQA overshard
+    axis when the replica has more chips than the model has KV heads
+    (infer/tp.py:INFER_TP_RULES); dp splits batch slots over replica
+    blocks of tp chips each.  Requires jax.distributed to be
+    initialized on every host first.
+
+    Devices are rank-reordered along the ICI torus (parallel/mesh.py
+    ici_order) — on a real pod slice jax enumerates chips host-major,
+    which is not a neighbor walk, and the multi-host replica is exactly
+    where the megatron psums would otherwise pay multi-hop ICI."""
     import jax
     from skypilot_tpu.infer import tp as tp_lib
-    devices = jax.devices()
-    tp = tp or len(devices)
-    if tp != len(devices):
+    from skypilot_tpu.parallel.mesh import ici_order
+    devices = ici_order(jax.devices())
+    tp = tp or len(devices) // max(dp, 1)
+    if dp * tp != len(devices):
         # A strict subset would leave some hosts' chips idle but still
         # participating in nothing — reject rather than half-use a slice.
         raise ValueError(
-            f'multi-host replica must use every chip: tp={tp} but the '
-            f'replica has {len(devices)} devices')
-    return tp_lib._tp_mesh_from_devices(devices, tp, n_kv_heads)
+            f'multi-host replica must use every chip: dp={dp} x tp={tp} '
+            f'but the replica has {len(devices)} devices')
+    return tp_lib._tp_mesh_from_devices(devices, tp, n_kv_heads, dp=dp)
 
 
 # ---------------------------------------------------------------------------

@@ -41,8 +41,12 @@ def _model(num_devices: int):
 
 def _gen_config():
     from skypilot_tpu.infer import GeneratorConfig
+    # decode_impl pinned explicitly (it IS the default): the check's
+    # contract is the POOLED plane's sharded decode across hosts —
+    # arena KV-head-sharded over the global mesh, block tables
+    # replicated host state — not merely raw psum plumbing.
     return GeneratorConfig(max_seq_len=64, batch_size=2, temperature=0.0,
-                           prompt_buckets=[16])
+                           prompt_buckets=[16], decode_impl='pooled')
 
 
 def baseline_decode() -> List[List[int]]:

@@ -83,13 +83,18 @@ class ContinuousBatcher:
     def __init__(self, params: llama.Params, config: llama.LlamaConfig,
                  gen_config: GeneratorConfig = GeneratorConfig(),
                  decode_chunk: int = 8, mesh=None):
-        """mesh: optional 1-axis ('tp',) mesh (infer/tp.py) — params and
-        the slot cache are megatron-sharded so serving capacity scales
-        with the tp degree instead of one chip's HBM."""
+        """mesh: optional ('tp','tpq') — or ('dp','tp','tpq') — mesh
+        from tp_lib.make_tp_mesh (infer/tp.py) — params and the slot
+        cache/pooled arena are megatron-sharded so serving capacity
+        scales with the tp degree instead of one chip's HBM; with a dp
+        axis, batch slots additionally split across replica blocks."""
         self.mesh = mesh
         if mesh is not None:
             tp_lib.validate_mesh(config, mesh)
             params = tp_lib.shard_params(params, mesh)
+            for axis, size in tp_lib.mesh_axis_sizes(mesh).items():
+                telemetry_metrics.INFER_MESH_DEVICES.labels(
+                    axis=axis).set(size)
         from skypilot_tpu.infer.engine import (derive_buckets,
                                                derive_cache_buckets,
                                                prepare_params,
@@ -169,6 +174,13 @@ class ContinuousBatcher:
             return value if row_sh is None else jax.device_put(
                 value, row_sh)
 
+        def _slot_row(value):
+            # Per-slot SAMPLING rows may split over a dp axis (the
+            # control rows above must not — see tp_lib.slot_sharding).
+            row_sh = tp_lib.slot_sharding(mesh, batch)
+            return value if row_sh is None else jax.device_put(
+                value, row_sh)
+
         self._token = _row(jnp.zeros((batch,), jnp.int32))
         self._positions = _row(jnp.zeros((batch,), jnp.int32))
         # Device-side decode state: done rows FREEZE inside the fused
@@ -180,9 +192,9 @@ class ContinuousBatcher:
         # Per-SLOT sampling params (device operands of the decode
         # program — one compile serves every request mix); host mirror
         # of "any non-greedy slot" picks the cheap all-greedy program.
-        self._temp_row = _row(jnp.full((batch,), gen_config.temperature,
-                                       jnp.float32))
-        self._top_p_row = _row(jnp.full(
+        self._temp_row = _slot_row(jnp.full(
+            (batch,), gen_config.temperature, jnp.float32))
+        self._top_p_row = _slot_row(jnp.full(
             (batch,), gen_config.top_p if gen_config.top_p else 1.0,
             jnp.float32))
         self._host_temp = np.full((batch,), gen_config.temperature,
@@ -375,7 +387,8 @@ class ContinuousBatcher:
             # variants alone.
             def decode_fn(params, token, config, cache, positions):
                 return llama_infer.decode_step_pooled(
-                    params, token, config, cache, positions, tables)
+                    params, token, config, cache, positions, tables,
+                    mesh=self.mesh)
         else:
             decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
         batch = token.shape[0]
@@ -430,7 +443,8 @@ class ContinuousBatcher:
         stale K/V and the next chunk overwrites it in place."""
         tokens_w = jnp.concatenate([token[:, None], draft], axis=1)
         logits, cache = llama_infer.decode_verify_pooled(
-            params, tokens_w, self.config, cache, positions, tables)
+            params, tokens_w, self.config, cache, positions, tables,
+            mesh=self.mesh)
         rng, sub = jax.random.split(rng)
         if all_greedy:
             # Greedy acceptance is BIT-EXACT: an accepted draft token

@@ -75,6 +75,22 @@ CACHE_SPEC = P(None, None, None, 'tp', None)
 # int8-cache scales (L, B, max_len, KV_heads): same kv-head sharding.
 CACHE_SCALE_SPEC = P(None, None, None, 'tp')
 
+# Pooled-plane specs (infer/block_pool.py).  The block-pool arena
+# (L, num_blocks, block_size, KV_heads, head_dim) keeps the kv-head axis
+# at index 3 — the SAME position as the contiguous cache — so the one
+# CACHE_SPEC covers both planes and cache_sharding()/constrain_cache()
+# need no layout switch.  Spelled out here so the contract is explicit:
+POOL_ARENA_SPEC = CACHE_SPEC
+# int8 arena scales (L, num_blocks, block_size, KV_heads):
+POOL_ARENA_SCALE_SPEC = CACHE_SCALE_SPEC
+# Block tables (B, t_width) and every other piece of pool state the
+# HOST allocator owns (free list, refcounts, slot→sequence map) are
+# REPLICATED: block ids are indices into the arena's unsharded
+# num_blocks axis, identical on every chip, and the allocator runs on
+# the host — sharding them would buy nothing and cost a gather on the
+# kernel's scalar-prefetch path.
+TABLE_SPEC = P()
+
 
 def tp_factors(config, tp: int):
     """(tp_kv, tp_q): KV-head sharding degree and the GQA overshard
@@ -83,17 +99,34 @@ def tp_factors(config, tp: int):
     return tp_kv, tp // max(tp_kv, 1)
 
 
+def mesh_axis_sizes(mesh) -> dict:
+    """{axis_name: size} for a mesh (helper shared by validate_mesh /
+    slot_sharding / telemetry)."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def dp_degree(mesh) -> int:
+    """Size of the mesh's 'dp' axis (1 when absent or mesh is None)."""
+    if mesh is None:
+        return 1
+    return mesh_axis_sizes(mesh).get('dp', 1)
+
+
 def validate_mesh(config, mesh) -> None:
-    """Mesh/model agreement: the 'tp' axis must equal the model's KV
-    sharding degree (a mesh built without n_kv_heads on a GQA model
-    would try to split the KV cache too finely)."""
-    validate_tp(config, mesh.size)
-    tp_kv, _ = tp_factors(config, mesh.size)
-    if dict(zip(mesh.axis_names, mesh.devices.shape)).get('tp') != tp_kv:
+    """Mesh/model agreement: after dividing out any 'dp' (replica) axis,
+    the 'tp' axis must equal the model's KV sharding degree (a mesh
+    built without n_kv_heads on a GQA model would try to split the KV
+    cache too finely)."""
+    sizes = mesh_axis_sizes(mesh)
+    dp = sizes.get('dp', 1)
+    tp_total = mesh.size // max(dp, 1)
+    validate_tp(config, tp_total)
+    tp_kv, _ = tp_factors(config, tp_total)
+    if sizes.get('tp') != tp_kv:
         raise ValueError(
-            f"mesh tp axis {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+            f'mesh tp axis {sizes} '
             f'does not match the model: need tp={tp_kv} x tpq='
-            f'{mesh.size // tp_kv} for n_kv_heads={config.n_kv_heads} — '
+            f'{tp_total // tp_kv} for n_kv_heads={config.n_kv_heads} — '
             f'build the mesh with make_tp_mesh(tp, n_kv_heads=...)')
 
 
@@ -119,30 +152,52 @@ def validate_tp(config, tp: int) -> None:
             + ', '.join(problems))
 
 
-def _tp_mesh_from_devices(devices, tp: int, n_kv_heads: Optional[int]):
+def _tp_mesh_from_devices(devices, tp: int, n_kv_heads: Optional[int],
+                          dp: int = 1):
     import jax
     import numpy as np
     tp_kv = min(tp, n_kv_heads) if n_kv_heads else tp
     if tp % max(tp_kv, 1):
         raise ValueError(f'tp={tp} not a multiple of tp_kv={tp_kv}')
     tp_q = tp // max(tp_kv, 1)
+    if dp <= 1:
+        # Keep the 2-axis shape when there is no data parallelism:
+        # existing callers (and jit caches keyed on mesh identity) see
+        # exactly the pre-dp mesh.
+        return jax.sharding.Mesh(
+            np.asarray(devices[:tp]).reshape(tp_kv, tp_q), ('tp', 'tpq'))
+    # dp OUTERMOST: each dp replica is a contiguous block of tp devices,
+    # so the per-token megatron psums stay inside a replica's ICI
+    # neighborhood and only the (rare) batch-axis collectives span
+    # replicas.
     return jax.sharding.Mesh(
-        np.asarray(devices[:tp]).reshape(tp_kv, tp_q), ('tp', 'tpq'))
+        np.asarray(devices[:dp * tp]).reshape(dp, tp_kv, tp_q),
+        ('dp', 'tp', 'tpq'))
 
 
-def make_tp_mesh(tp: int, n_kv_heads: Optional[int] = None, devices=None):
-    """('tp', 'tpq') mesh over the first tp local devices (local: a
-    serving replica shards within its own host's ICI neighborhood —
-    jax.devices() would include other hosts' non-addressable chips on a
-    multi-host slice and device_put would fail).  n_kv_heads: the
-    model's KV-head count — when tp exceeds it, the extra parallelism
-    goes to the 'tpq' GQA overshard axis (see INFER_TP_RULES)."""
+def make_tp_mesh(tp: int, n_kv_heads: Optional[int] = None, devices=None,
+                 dp: int = 1):
+    """('tp', 'tpq') mesh — or ('dp', 'tp', 'tpq') when dp > 1 — over
+    the first dp*tp local devices (local: a serving replica shards
+    within its own host's ICI neighborhood — jax.devices() would include
+    other hosts' non-addressable chips on a multi-host slice and
+    device_put would fail).  n_kv_heads: the model's KV-head count —
+    when tp exceeds it, the extra parallelism goes to the 'tpq' GQA
+    overshard axis (see INFER_TP_RULES).  dp: batch-slot data
+    parallelism for pooled decode — params and arena stay replicated
+    across dp blocks while slot rows split over them.
+
+    Devices default to jax.local_devices() reordered along the ICI
+    torus (parallel/mesh.py ici_order) so ring collectives walk
+    physical neighbors; pass `devices` explicitly to pin an order."""
     import jax
     if devices is None:
-        devices = jax.local_devices()
-    if len(devices) < tp:
-        raise ValueError(f'tp={tp} but only {len(devices)} devices')
-    return _tp_mesh_from_devices(devices, tp, n_kv_heads)
+        from skypilot_tpu.parallel.mesh import ici_order
+        devices = ici_order(jax.local_devices())
+    if len(devices) < dp * tp:
+        raise ValueError(
+            f'dp={dp} x tp={tp} but only {len(devices)} devices')
+    return _tp_mesh_from_devices(devices, tp, n_kv_heads, dp=dp)
 
 
 def shard_params(params, mesh):
@@ -186,6 +241,29 @@ def replicated_sharding(mesh) -> Optional[NamedSharding]:
     tick.  None when no mesh (plain single-device arrays)."""
     if mesh is None:
         return None
+    return NamedSharding(mesh, P())
+
+
+def slot_sharding(mesh, batch: Optional[int] = None) -> \
+        Optional[NamedSharding]:
+    """Sharding for per-slot (batch,)-shaped SAMPLING rows (temperature,
+    top-p): P('dp') when the mesh has a dp axis of size > 1 that divides
+    the batch, else fully replicated.
+
+    Scope is deliberately narrow.  The scheduler's CONTROL rows (feed
+    token, positions, done, budget) stay replicated even under dp —
+    they are host-read every chunk (the multihost determinism contract,
+    see replicate()) and they flow output→input across decode chunks,
+    so a sharding flip between ticks would recompile the decode jit and
+    blow the ≤2-compile budget.  Sampling rows are pure per-slot
+    operands: sharding them over dp keeps each replica's sampling math
+    local without touching the host-sync path."""
+    if mesh is None:
+        return None
+    sizes = mesh_axis_sizes(mesh)
+    dp = sizes.get('dp', 1)
+    if dp > 1 and (batch is None or batch % dp == 0):
+        return NamedSharding(mesh, P('dp'))
     return NamedSharding(mesh, P())
 
 

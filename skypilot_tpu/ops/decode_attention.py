@@ -217,13 +217,59 @@ def _pooled_attn_kernel(layer_ref, pos_ref, maxblk_ref, tbl_ref, *args,
     _decode_attn_kernel(layer_ref, pos_ref, maxblk_ref, *args, **kwargs)
 
 
+def _shard_pooled_call(call, mesh, q, k_arena, v_arena, tables, layer,
+                       positions, k_scale, v_scale, *, window: bool):
+    """Run a pooled decode-attention entry point per-shard under
+    shard_map on a ('dp','tp','tpq') (or ('tp','tpq')) mesh.
+
+    Per-shard the call sees the LOCAL shapes — kv_heads/tp_kv KV heads,
+    group/tp_q query heads per KV head, batch/dp slots — and runs the
+    unmodified kernel on them; attention math is complete per shard
+    (each shard holds the full arena rows for exactly its KV heads, and
+    the GQA overshard keeps every q-head next to its KV head), so no
+    collective is needed inside, and none is emitted.  The block table
+    and positions are replicated over tp/tpq (block ids index the
+    UNSHARDED num_blocks axis; see infer/tp.py TABLE_SPEC) and split
+    over dp with the slot rows.
+    """
+    from jax.sharding import PartitionSpec as P
+    from skypilot_tpu.parallel.collectives import shard_map
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = 'dp' if sizes.get('dp', 1) > 1 else None
+    tp = 'tp' if sizes.get('tp', 1) > 1 else None
+    tpq = 'tpq' if sizes.get('tpq', 1) > 1 else None
+    if window:
+        q_spec = P(dp, None, tp, tpq, None)      # (B, W, KV, G, hd)
+    else:
+        q_spec = P(dp, tp, tpq, None)            # (B, KV, G, hd)
+    arena_spec = P(None, None, None, tp, None)   # (L, NB, BS, KV, hd)
+    scale_spec = P(None, None, None, tp)         # (L, NB, BS, KV)
+    specs = [q_spec, arena_spec, arena_spec, P(dp, None), P(), P(dp)]
+    args = [q, k_arena, v_arena, tables.astype(jnp.int32),
+            jnp.asarray(layer, jnp.int32), positions.astype(jnp.int32)]
+    if k_scale is not None:
+        specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+
+    def per_shard(*ops):
+        if k_scale is not None:
+            qq, ka, va, tbl, lyr, pos, ks, vs = ops
+        else:
+            (qq, ka, va, tbl, lyr, pos), ks, vs = ops, None, None
+        return call(qq, ka, va, tbl, lyr, pos, ks, vs)
+
+    return shard_map(per_shard, mesh=mesh, in_specs=tuple(specs),
+                     out_specs=q_spec, check_vma=False)(*args)
+
+
 def decode_attention_pooled(q: jax.Array, k_arena: jax.Array,
                             v_arena: jax.Array, tables: jax.Array,
                             layer: jax.Array, positions: jax.Array,
                             k_scale: Optional[jax.Array] = None,
                             v_scale: Optional[jax.Array] = None,
-                            *, interpret: Optional[bool] = None
-                            ) -> jax.Array:
+                            *, interpret: Optional[bool] = None,
+                            mesh=None) -> jax.Array:
     """Single-token GQA attention over a pooled block arena.
 
     Identical math to :func:`decode_attention`, but the KV cache is a
@@ -243,8 +289,18 @@ def decode_attention_pooled(q: jax.Array, k_arena: jax.Array,
     physical block and Pallas skips their DMAs — traffic is per-slot
     live context, independent of T.
 
+    mesh: an optional ('dp','tp','tpq') / ('tp','tpq') mesh — the call
+    is wrapped in shard_map so each device runs this kernel on its own
+    KV-head (and dp slot) shard; see :func:`_shard_pooled_call`.
+
     Returns (B, KV, G, hd) in q.dtype.
     """
+    if mesh is not None and mesh.size > 1:
+        return _shard_pooled_call(
+            functools.partial(decode_attention_pooled,
+                              interpret=interpret),
+            mesh, q, k_arena, v_arena, tables, layer, positions,
+            k_scale, v_scale, window=False)
     n_layers, n_blocks, bs, kv_heads, head_dim = k_arena.shape
     batch, t_width = tables.shape
     group = q.shape[2]
@@ -311,8 +367,8 @@ def decode_window_attention_pooled(q: jax.Array, k_arena: jax.Array,
                                    positions: jax.Array,
                                    k_scale: Optional[jax.Array] = None,
                                    v_scale: Optional[jax.Array] = None,
-                                   *, interpret: Optional[bool] = None
-                                   ) -> jax.Array:
+                                   *, interpret: Optional[bool] = None,
+                                   mesh=None) -> jax.Array:
     """W-query speculative-verify attention over the pooled arena.
 
     Same arena/table contract as :func:`decode_attention_pooled`, but q
@@ -332,6 +388,12 @@ def decode_window_attention_pooled(q: jax.Array, k_arena: jax.Array,
 
     Returns (B, W, KV, G, hd) in q.dtype.
     """
+    if mesh is not None and mesh.size > 1:
+        return _shard_pooled_call(
+            functools.partial(decode_window_attention_pooled,
+                              interpret=interpret),
+            mesh, q, k_arena, v_arena, tables, layer, positions,
+            k_scale, v_scale, window=True)
     n_layers, n_blocks, bs, kv_heads, head_dim = k_arena.shape
     batch, win, _, group, _ = q.shape
     rows = kv_heads * win * group

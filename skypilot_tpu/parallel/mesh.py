@@ -31,6 +31,69 @@ import numpy as np
 AXES: Tuple[str, ...] = ('pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
 
 
+def device_coords(dev) -> Optional[Tuple[int, ...]]:
+    """Physical ICI coordinates of a TPU device, or None for devices
+    that have no torus position (CPU/GPU/virtual test devices)."""
+    coords = getattr(dev, 'coords', None)
+    if coords is None:
+        return None
+    try:
+        return tuple(int(c) for c in coords)
+    except (TypeError, ValueError):
+        return None
+
+
+def ici_order(devices: Sequence) -> list:
+    """Rank-reordering pass (Cloud Collectives): return `devices` sorted
+    along a serpentine (boustrophedon) walk of their ICI torus
+    coordinates, so CONSECUTIVE ranks are physical ICI neighbors.
+
+    jax enumerates devices host-major (by task, then local index), which
+    on a pod slice is NOT a neighbor walk of the torus — a ring
+    collective built from enumeration order pays multi-hop ICI latency
+    on the wrap links.  The serpentine walk reverses direction on every
+    row/plane, so rank r and rank r+1 always sit one ICI hop apart on a
+    full box (the same property the paper's rank reordering restores
+    for NCCL rings).
+
+    Devices without coordinates (CPU/virtual meshes in tests and dry
+    runs) and duplicate/partial coordinate sets are returned unchanged —
+    the reorder is a physical-locality optimization, never a
+    correctness requirement.
+    """
+    coords = [device_coords(d) for d in devices]
+    # Uniqueness key includes the core index: megacore chips (two
+    # TensorCores per chip, e.g. v4) share chip coords across cores.
+    ids = [None if c is None
+           else c + (getattr(d, 'core_on_chip', 0),)
+           for c, d in zip(coords, devices)]
+    if (not ids or any(i is None for i in ids)
+            or len(set(ids)) != len(ids)):
+        return list(devices)
+    ndim = max(len(c) for c in coords)
+    coords = [c + (0,) * (ndim - len(c)) for c in coords]
+    maxes = [max(c[i] for c in coords) for i in range(ndim)]
+
+    def snake_key(idx: int):
+        c = coords[idx]
+        # Outermost axis last in `coords` (TPU coords are (x, y, z):
+        # walk z planes, snake y rows inside a plane, snake x inside a
+        # row).  Each inner axis reverses whenever the walk index over
+        # the outer axes is odd — the generalized boustrophedon.
+        key = []
+        walk = 0
+        for i in reversed(range(ndim)):
+            v = c[i] if walk % 2 == 0 else maxes[i] - c[i]
+            key.append(v)
+            walk = walk * (maxes[i] + 1) + v
+        # v2/v3 expose two TensorCores per chip: keep them adjacent.
+        key.append(getattr(devices[idx], 'core_on_chip', 0))
+        return tuple(key)
+
+    order = sorted(range(len(devices)), key=snake_key)
+    return [devices[i] for i in order]
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1

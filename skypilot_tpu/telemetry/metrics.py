@@ -295,6 +295,31 @@ INFER_POOL_PREFIX_SHARES = prometheus_client.Counter(
     'copy of one block)',
     registry=REGISTRY)
 
+# ---- infer serving mesh (infer/tp.py, ops/decode_attention.py) ---------
+
+INFER_MESH_DEVICES = prometheus_client.Gauge(
+    'skytpu_infer_mesh_devices',
+    'Serving-mesh axis sizes (axis = dp | tp | tpq); set at engine '
+    'construction, absent on single-chip engines',
+    ['axis'],
+    registry=REGISTRY)
+
+INFER_MESH_COLLECTIVE_TIME_SHARE = prometheus_client.Gauge(
+    'skytpu_infer_mesh_collective_time_share',
+    'Estimated fraction of a sharded decode chunk spent in collectives '
+    '(1 - single-device time / mesh time per token, clamped to [0, 1]; '
+    'measured by bench_mesh, an efficiency complement rather than a '
+    'per-op trace)',
+    registry=REGISTRY)
+
+INFER_MESH_POOL_BLOCKS_PER_SHARD = prometheus_client.Gauge(
+    'skytpu_infer_mesh_pool_blocks_live_per_shard',
+    'Live arena blocks each tp shard holds a KV-head slice of (block '
+    'ids are global — sharding splits heads, not blocks — so this '
+    'equals blocks_live; exported only for sharded pools so per-shard '
+    'HBM dashboards need no join against the mesh shape)',
+    registry=REGISTRY)
+
 # ---- infer speculative decoding (infer/spec_decode.py) -----------------
 
 INFER_SPEC_PROPOSED = prometheus_client.Counter(
