@@ -250,7 +250,13 @@ def build_generator(model_size: str, max_seq_len: int, temperature: float,
         temperature=temperature, eos_token=eos,
         kv_cache_dtype=kv_cache_dtype,
         weights_dtype=weights_dtype,
-        prefill_chunk=prefill_chunk), mesh=mesh)
+        prefill_chunk=prefill_chunk), mesh=mesh,
+        # Admission bound: beyond a few generations' worth of queued
+        # work, submit() raises a retryable PoolExhaustedError that the
+        # HTTP layer maps to 503 + Retry-After so the LB diverts —
+        # better than entering a queue the request would sit in for
+        # seconds while the client times out anyway.
+        max_queue=4 * batch_size)
     return gen, config, tokenizer
 
 
@@ -291,6 +297,8 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
     import uuid
 
     from aiohttp import web
+
+    from skypilot_tpu.infer import block_pool as block_pool_lib
 
     def _finish_reason(out):
         return 'stop' if (eos_token is not None and out
@@ -467,6 +475,21 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
             rid, ev = await asyncio.to_thread(
                 driver.submit, prompt_ids, opts['max_tokens'],
                 opts['temperature'], opts['top_p'])
+        except block_pool_lib.PoolExhaustedError as e:
+            # retry_after_s set -> transient exhaustion: retryable 503
+            # with Retry-After (the LB diverts to another replica).
+            # None -> the request can NEVER fit the pool: a 400, since
+            # retrying it anywhere is futile.
+            if e.retry_after_s is None:
+                return web.json_response(
+                    {'error': {'message': str(e),
+                               'type': 'invalid_request_error'}},
+                    status=400)
+            return web.json_response(
+                {'error': {'message': str(e),
+                           'type': 'overloaded_error'}}, status=503,
+                headers={'Retry-After':
+                         str(max(1, int(e.retry_after_s + 0.999)))})
         except ValueError as e:
             return web.json_response(
                 {'error': {'message': str(e),
@@ -725,6 +748,8 @@ def main() -> int:
 
     from aiohttp import web
 
+    from skypilot_tpu.infer import block_pool as block_pool_lib
+
     async def health(request):
         return web.json_response({'status': 'ok',
                                   'model': args.model_size})
@@ -769,6 +794,15 @@ def main() -> int:
             # across whole decode chunks — never block the event loop.
             rid, ev = await asyncio.to_thread(driver.submit, prompt_ids,
                                               max_new)
+        except block_pool_lib.PoolExhaustedError as e:
+            # Transient exhaustion -> retryable 503 + Retry-After (LB
+            # diverts); a request that can never fit the pool -> 400.
+            if e.retry_after_s is None:
+                return web.json_response({'error': str(e)}, status=400)
+            return web.json_response(
+                {'error': str(e)}, status=503,
+                headers={'Retry-After':
+                         str(max(1, int(e.retry_after_s + 0.999)))})
         except ValueError as e:
             return web.json_response({'error': str(e)}, status=400)
         try:

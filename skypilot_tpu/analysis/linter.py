@@ -94,6 +94,12 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
          'failure) without a Backoff or attempt bound in a jobs/serve '
          'recovery path — a capacity stall spins forever instead of '
          'surfacing a terminal failed-recovery status'),
+    Rule('SKY304', 'replica-removal-without-cleanup',
+         'replica removed from a membership collection in a jobs/'
+         'serve path without hashring/health/breaker cleanup in the '
+         'same function — the consistent-hash ring keeps routing '
+         'sessions at the dead replica and the circuit breaker leaks '
+         'its per-replica state'),
 ]}
 
 # Modules whose device->host transfers must route through
@@ -402,7 +408,7 @@ def _check_jit_call(node: ast.Call, rep: _Reporter) -> None:
 
 
 class _ModuleRuleVisitor(ast.NodeVisitor):
-    """Module-wide rules: SKY105/106/201/202/301/302."""
+    """Module-wide rules: SKY105/106/201/202/301-304."""
 
     def __init__(self, rep: _Reporter, path: str):
         self.rep = rep
@@ -419,6 +425,8 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
     # -- scope tracking ---------------------------------------------------
     def visit_AsyncFunctionDef(self, node) -> None:
         self._async_depth += 1
+        if self.is_recovery:
+            self._check_replica_cleanup(node)
         self.generic_visit(node)
         self._async_depth -= 1
 
@@ -430,6 +438,8 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
         prev_hf = self._in_host_fetch
         if node.name == 'host_fetch':
             self._in_host_fetch = True
+        if self.is_recovery:
+            self._check_replica_cleanup(node)
         self.generic_visit(node)
         self._async_depth = prev_async
         self._in_host_fetch = prev_hf
@@ -524,6 +534,54 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
                 'Backoff or attempt bound — cap it with '
                 'max_recovery_attempts + utils.backoff.Backoff and '
                 'surface a terminal failed-recovery status')
+
+    # -- SKY304: replica removal without routing-state cleanup ------------
+    # Identifier substrings that mark the function as ALSO tearing
+    # down routing state (hashring arcs, health/breaker records) or
+    # delegating to a helper that does (`_sync_policy`).
+    _CLEANUP_HINTS = ('ring', 'health', 'breaker', 'sync_policy')
+
+    def _check_replica_cleanup(self, node) -> None:
+        """A function that drops a replica from a membership
+        collection (`*replica*.pop/remove/discard(...)` or
+        `del *replica*[...]`) must, in the SAME function, touch the
+        routing state that referenced it — otherwise the hashring
+        keeps owning arcs for a dead URL and the circuit breaker
+        leaks its per-replica record.  Cleanup is recognized by any
+        identifier containing one of _CLEANUP_HINTS (nested defs are
+        their own scope and don't count)."""
+        removals: List[ast.AST] = []
+        idents: Set[str] = set()
+        for n in self._walk_no_defs(node):
+            if isinstance(n, ast.Name):
+                idents.add(n.id.lower())
+            elif isinstance(n, ast.Attribute):
+                idents.add(n.attr.lower())
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ('pop', 'remove', 'discard'):
+                target = _dotted(n.func.value) or ''
+                if 'replica' in target.lower():
+                    removals.append(n)
+            elif isinstance(n, ast.Delete):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            'replica' in (_dotted(tgt.value)
+                                          or '').lower():
+                        removals.append(n)
+        if not removals:
+            return
+        if any(hint in ident for ident in idents
+               for hint in self._CLEANUP_HINTS):
+            return
+        for n in removals:
+            self.rep.report(
+                n, 'SKY304',
+                'replica removed from membership without hashring/'
+                'health cleanup in the same function — also remove '
+                'its ring arcs and breaker/health state (or call the '
+                'policy-sync helper that does), or mark a sanctioned '
+                'site  # skytpu-allow: SKY304')
 
     # -- rules ------------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:

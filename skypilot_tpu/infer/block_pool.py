@@ -49,7 +49,18 @@ class PoolExhaustedError(RuntimeError):
     """Raised when an allocation needs more blocks than the free list
     holds.  The batcher treats this as admission backpressure (requests
     stay queued); the lockstep Generator surfaces it with sizing
-    advice — neither path fabricates blocks or OOMs the device."""
+    advice — neither path fabricates blocks or OOMs the device.
+
+    `retry_after_s`, when set, is retry advice for the serving path:
+    the replica expects capacity back in roughly that long, and the
+    HTTP layer surfaces it as a retryable 503 + Retry-After instead of
+    an opaque error (the LB diverts on it rather than retry-storming
+    this replica)."""
+
+    def __init__(self, *args,
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 def init_arena(config: llama.LlamaConfig, n_blocks: int,

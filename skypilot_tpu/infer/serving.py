@@ -43,7 +43,7 @@ import dataclasses
 import functools
 import itertools
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -82,12 +82,20 @@ class ContinuousBatcher:
 
     def __init__(self, params: llama.Params, config: llama.LlamaConfig,
                  gen_config: GeneratorConfig = GeneratorConfig(),
-                 decode_chunk: int = 8, mesh=None):
+                 decode_chunk: int = 8, mesh=None,
+                 max_queue: Optional[int] = None):
         """mesh: optional ('tp','tpq') — or ('dp','tp','tpq') — mesh
         from tp_lib.make_tp_mesh (infer/tp.py) — params and the slot
         cache/pooled arena are megatron-sharded so serving capacity
         scales with the tp degree instead of one chip's HBM; with a dp
-        axis, batch slots additionally split across replica blocks."""
+        axis, batch slots additionally split across replica blocks.
+
+        max_queue: admission backpressure bound — submit() raises
+        PoolExhaustedError (with Retry-After advice) once this many
+        requests are already waiting, instead of queueing without
+        limit.  None (default) keeps the unbounded library behavior;
+        the HTTP serving path sets it so overload surfaces as a
+        retryable 503 the load balancer can divert on."""
         self.mesh = mesh
         if mesh is not None:
             tp_lib.validate_mesh(config, mesh)
@@ -109,6 +117,9 @@ class ContinuousBatcher:
         self.config = config
         self.gen = gen_config
         self.decode_chunk = decode_chunk
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f'max_queue must be >= 1, got {max_queue}')
+        self.max_queue = max_queue
         self.buckets = derive_buckets(gen_config)
         self.cache_buckets = derive_cache_buckets(gen_config)
 
@@ -519,11 +530,32 @@ class ContinuousBatcher:
             raise ValueError(
                 f'Prompt length {len(prompt)} exceeds the largest '
                 f'prompt bucket {self.buckets[-1]}')
+        if self.max_queue is not None and self.num_queued >= self.max_queue:
+            # Admission backpressure as a SYNCHRONOUS, retryable
+            # signal: the HTTP layer maps this to 503 + Retry-After
+            # and the LB diverts — the request never enters a queue it
+            # would sit in for several decode generations.
+            raise block_pool_lib.PoolExhaustedError(
+                f'Admission queue full ({self.num_queued} waiting, '
+                f'max_queue={self.max_queue}); retry later or on '
+                f'another replica.',
+                retry_after_s=max(1.0, 0.25 * self.num_queued))
         req = _Request(next(self._ids), list(prompt),
                        min(max_new_tokens,
                            self.gen.max_seq_len - len(prompt)),
                        temperature=temperature, top_p=top_p,
                        submitted_at=time.perf_counter())
+        if self.pooled and self._pool_cap(req) > self.pool.n_blocks - 1:
+            # This request can NEVER be admitted — its worst-case block
+            # need exceeds the whole pool.  Failing at submit (with the
+            # sizing advice) beats queueing it forever.
+            raise block_pool_lib.PoolExhaustedError(
+                f'Request needs {self._pool_cap(req)} blocks '
+                f'(prompt {len(req.prompt)} + budget '
+                f'{req.max_new_tokens}) but the pool holds only '
+                f'{self.pool.n_blocks - 1} allocatable blocks '
+                f'(block_size={self.block_size}). Raise '
+                f'GeneratorConfig.pool_blocks or shorten the request.')
         self._requests[req.rid] = req
         self._queue.append(req)
         return req.rid
@@ -547,6 +579,75 @@ class ContinuousBatcher:
             raise ValueError(f'Request {rid} still in flight')
         del self._requests[rid]
         return req.out
+
+    # ---- failover / drain hooks -----------------------------------------
+    def cancel(self, rid: int) -> List[int]:
+        """Abort a request wherever it lives (queued, mid-chunked-
+        prefill, or decoding) and release everything it holds; returns
+        the tokens generated so far.  Pool blocks go back to the free
+        list exactly as in a natural finish (`BlockPool.check_invariant`
+        holds afterwards) and the rid is forgotten.  This is the serve
+        plane's failover/fencing hook: a drained or healed replica
+        cancels sessions whose journal ownership moved elsewhere."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise ValueError(f'Unknown request {rid}')
+        out = list(req.out)
+        if req.done:
+            del self._requests[rid]
+            return out
+        if req in self._queue:
+            self._queue.remove(req)
+            del self._requests[rid]
+            return out
+        if self._incremental is req:
+            # Mirror _advance_prefill's abort contract: clear the lane,
+            # free the slot (front of the list — it is the warmest),
+            # and drop any pool state the partial prefill bound.
+            self._incremental = None
+            req.prefill_pos = 0
+            if self.pooled:
+                self._pool_free_slot(req.slot)
+            self._free.insert(0, req.slot)
+            req.slot = None
+            del self._requests[rid]
+            return out
+        # Active decode slot: _finish frees the slot + blocks and
+        # freezes the row like any completed request.
+        self._finish(req)
+        del self._requests[rid]
+        return out
+
+    def export_session(self, rid: int) -> Dict[str, Any]:
+        """Snapshot everything needed to resume this request on
+        another replica: re-submit `prompt + out` as the new prompt
+        with `max_new_tokens - len(out)` budget and greedy decode
+        continues bit-exact at the first token this replica never
+        produced."""
+        req = self._requests[rid]
+        return {
+            'prompt': list(req.prompt),
+            'out': list(req.out),
+            'max_new_tokens': req.max_new_tokens,
+            'temperature': req.temperature,
+            'top_p': req.top_p,
+            'done': req.done,
+        }
+
+    def drain_sessions(self) -> List[Dict[str, Any]]:
+        """Preemption-notice handoff: between decode chunks, export
+        then cancel every in-flight request, returning the session
+        specs in submission order for re-admission elsewhere.  The
+        batcher is left idle with every pool block released."""
+        specs = []
+        for rid in sorted(self._requests):
+            if self._requests[rid].done:
+                continue
+            spec = self.export_session(rid)
+            spec['rid'] = rid
+            self.cancel(rid)
+            specs.append(spec)
+        return specs
 
     @property
     def num_active(self) -> int:

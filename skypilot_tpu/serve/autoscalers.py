@@ -80,6 +80,19 @@ def select_replicas_to_scale_down(
     return [r['replica_id'] for r in nonterminal[:n]]
 
 
+def alive_capacity(replicas: List[Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+    """Replicas that count as serving capacity: not in a terminal
+    state and not draining.  A replica the chaos layer (or a spot
+    preemption) killed reports terminal — FAILED/PREEMPTED — and so
+    becomes capacity to REPLACE (alive < target triggers scale-up),
+    never load to absorb; a replica draining toward retirement is
+    still finishing in-flight sessions but must not mask a capacity
+    deficit either."""
+    return [r for r in replicas
+            if not r['status'].is_terminal() and not r.get('draining')]
+
+
 class Autoscaler:
     """Abstract autoscaler over a service's replica set."""
 
@@ -163,7 +176,7 @@ class FixedSizeAutoscaler(Autoscaler):
             self, replicas: List[Dict[str, Any]]
     ) -> List[AutoscalerDecision]:
         target = self.get_final_target_num_replicas()
-        alive = [r for r in replicas if not r['status'].is_terminal()]
+        alive = alive_capacity(replicas)
         if len(alive) < target:
             return self._record(_scale_up(target - len(alive)))
         if len(alive) > target:
@@ -293,7 +306,7 @@ class RequestRateAutoscaler(_AutoscalerWithHysteresis):
     ) -> List[AutoscalerDecision]:
         self._apply_hysteresis()
         target = self.get_final_target_num_replicas()
-        alive = [r for r in replicas if not r['status'].is_terminal()]
+        alive = alive_capacity(replicas)
         if len(alive) < target:
             return self._record(_scale_up(target - len(alive)))
         if len(alive) > target:
@@ -438,7 +451,7 @@ class SLOAutoscaler(_AutoscalerWithHysteresis):
     ) -> List[AutoscalerDecision]:
         self._apply_hysteresis()
         target = self.get_final_target_num_replicas()
-        alive = [r for r in replicas if not r['status'].is_terminal()]
+        alive = alive_capacity(replicas)
         if len(alive) < target:
             return self._record(_scale_up(target - len(alive)))
         if len(alive) > target:
@@ -483,7 +496,7 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     ) -> List[AutoscalerDecision]:
         self._apply_hysteresis()
         target = self.get_final_target_num_replicas()
-        alive = [r for r in replicas if not r['status'].is_terminal()]
+        alive = alive_capacity(replicas)
         spot = [r for r in alive if r['is_spot']]
         ondemand = [r for r in alive if not r['is_spot']]
         num_ready_spot = sum(

@@ -6,6 +6,22 @@ timestamps -> receive the fresh ready-replica set (reference's
 _sync_with_controller loop).  The controller here is in-process
 (`ServeController.lb_sync`); a remote-controller mode only needs an HTTP
 shim around the same two calls.
+
+Failure handling (serve/failover.py primitives):
+
+- Every request outcome feeds a per-replica `CircuitBreaker`:
+  `failure_threshold` consecutive connection failures open the
+  replica's circuit and it stops receiving traffic; while OPEN, the
+  next request whose half-open probe is due becomes the trial that
+  closes (success) or re-opens (failure) it on a backoff schedule.
+- A replica answering 503 + Retry-After (admission backpressure,
+  `PoolExhaustedError` upstream) is COOLED DOWN for the advised time
+  and the request diverts to another replica — never retry-stormed.
+- A connection error BEFORE the response stream starts retries on a
+  different replica (the failed one is excluded from re-selection); an
+  error MID-stream truncates honestly — bytes already reached the
+  client, and the HTTP proxy holds no token journal to replay from
+  (the virtual-time simulator demonstrates journal-replay failover).
 """
 from __future__ import annotations
 
@@ -14,9 +30,10 @@ import json
 import threading
 import time
 import typing
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import failover as failover_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
 
@@ -26,6 +43,10 @@ if typing.TYPE_CHECKING:
 logger = sky_logging.init_logger(__name__)
 
 LB_CONTROLLER_SYNC_INTERVAL_SECONDS = 20.0
+# One request tries at most this many distinct replicas before giving
+# up: the original pick plus failover re-picks on connection errors or
+# backpressure diverts.
+LB_MAX_ROUTE_ATTEMPTS = 3
 
 
 class SkyServeLoadBalancer:
@@ -38,6 +59,9 @@ class SkyServeLoadBalancer:
         self.controller = controller
         self.port = port
         self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
+        # Per-replica health: consecutive-failure circuit breaker with
+        # backoff-scheduled half-open probes (serve/failover.py).
+        self.health = failover_lib.CircuitBreaker()
         self.sync_interval = sync_interval
         self.request_timestamps: List[float] = []
         # Per-request TTFT samples (ms) observed at the first proxied
@@ -65,7 +89,14 @@ class SkyServeLoadBalancer:
         if hits is not None and (hits + misses) > 0:
             report['prefix_hit_ratio'] = hits / (hits + misses)
         ready = self.controller.lb_sync(timestamps, report or None)
-        self.policy.set_ready_replicas(ready)
+        # Health state for replicas that left the fleet goes with them;
+        # the policy only ever sees replicas the breaker lets route
+        # (OPEN circuits whose probe is due stay in — the next live
+        # request is the half-open trial).
+        self.health.observe_members(ready)
+        self.policy.set_ready_replicas(
+            self.health.routable(ready, time.time(),
+                                 include_probes=True))
 
     # --- proxy ---
 
@@ -94,13 +125,37 @@ class SkyServeLoadBalancer:
             return {'prompt': prompt}
         return None
 
+    @staticmethod
+    def _retry_after_s(value: Optional[str]) -> float:
+        """Parse a Retry-After header (seconds form); 1s when absent
+        or malformed — divert now, come back soon."""
+        try:
+            return max(0.0, float(value))
+        except (TypeError, ValueError):
+            return 1.0
+
+    def _pick(self, context: Optional[Dict[str, Any]],
+              exclude: Set[str]) -> Optional[str]:
+        """Select a routable replica: the policy's choice, re-checked
+        against the breaker at request time (circuits open mid-
+        interval, after the last `set_ready_replicas`).  Vetoed picks
+        join `exclude` so the policy walks to its next candidate."""
+        now = time.time()
+        while True:
+            url = self.policy.select_replica(context, exclude=exclude)
+            if url is None or url in self.health.routable(
+                    [url], now, include_probes=True):
+                return url
+            exclude.add(url)
+
     async def _handle(self, request):
-        import aiohttp
         from aiohttp import web
         with self._ts_lock:
             self.request_timestamps.append(time.time())
         body = await request.read()
-        url = self.policy.select_replica(self._request_context(body))
+        context = self._request_context(body)
+        exclude: Set[str] = set()
+        url = self._pick(context, exclude)
         if url is None:
             # Cold start / stale set: resync before failing (a replica may
             # have become READY since the last interval sync).
@@ -109,11 +164,58 @@ class SkyServeLoadBalancer:
                     None, self.sync_once)
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning(f'On-demand LB sync failed: {e}')
-            url = self.policy.select_replica(self._request_context(body))
-        if url is None:
+            url = self._pick(context, exclude)
+        retry_after: Optional[float] = None
+        last_error: Optional[str] = None
+        for _ in range(LB_MAX_ROUTE_ATTEMPTS):
+            if url is None:
+                break
+            kind, value = await self._proxy_attempt(request, body, url)
+            if kind == 'response':
+                return value
+            exclude.add(url)
+            if kind == 'backpressure':
+                # The replica is healthy but full: divert, don't
+                # retry-storm it (it is cooling down in the breaker).
+                retry_after = (value if retry_after is None
+                               else min(retry_after, value))
+                telemetry_metrics.SERVE_FAILOVER_BACKPRESSURE_DIVERTS \
+                    .inc()
+                logger.info(f'Replica {url} backpressured '
+                            f'(Retry-After {value:.1f}s); diverting')
+            else:   # unreachable before any byte streamed
+                last_error = value
+                logger.warning(f'Replica {url} unreachable before '
+                               f'streaming ({value}); retrying '
+                               f'on another replica')
+            url = self._pick(context, exclude)
+        if retry_after is not None:
+            # Every candidate advertised backpressure: surface the
+            # soonest advised retry so clients back off instead of
+            # hammering a saturated fleet.
             return web.Response(
                 status=503,
-                text='No ready replicas. Use "serve status" to check.')
+                headers={'Retry-After':
+                         str(max(1, int(retry_after + 0.999)))},
+                text='All replicas at capacity; retry later.')
+        if last_error is not None:
+            return web.Response(
+                status=502,
+                text=f'Replica(s) unreachable: {last_error}')
+        return web.Response(
+            status=503,
+            text='No ready replicas. Use "serve status" to check.')
+
+    async def _proxy_attempt(self, request, body: bytes, url: str):
+        """Proxy one attempt to `url`.  Returns ('response', resp) when
+        the request is answered (including an honestly-truncated
+        stream), ('backpressure', retry_after_s) on a 503 divert, or
+        ('unreachable', error) when the replica failed before the
+        response started — the only case that is safe to retry
+        elsewhere without risking duplicated output."""
+        import aiohttp
+        from aiohttp import web
+        now = time.time()
         self.policy.pre_execute_hook(url)
         out = None
         start = time.perf_counter()
@@ -126,6 +228,17 @@ class SkyServeLoadBalancer:
                         headers=request.headers.copy(),
                         data=body,
                         allow_redirects=False) as resp:
+                    if resp.status == 503:
+                        # Admission backpressure (PoolExhaustedError
+                        # upstream): retryable by design.
+                        status = '503'
+                        retry_s = self._retry_after_s(
+                            resp.headers.get('Retry-After'))
+                        self.health.note_backpressure(url, now, retry_s)
+                        return ('backpressure', retry_s)
+                    # The replica answered: reachable, circuit-wise
+                    # healthy even if the app-level status is an error.
+                    self.health.note_success(url)
                     headers = {k: v for k, v in resp.headers.items()
                                if k.lower() not in
                                ('transfer-encoding', 'content-length')}
@@ -150,27 +263,29 @@ class SkyServeLoadBalancer:
                                 self.ttft_ms_samples.append(ttft * 1000.0)
                         await out.write(chunk)
                     await out.write_eof()
-                    return out
+                    return ('response', out)
         except aiohttp.ClientError as e:
             telemetry_metrics.SERVE_REPLICA_ERRORS.labels(replica=url).inc()
+            self.health.note_failure(url, now)
             if out is not None:
                 # Replica died MID-stream: the status line already went
                 # out, so a 502 response is impossible — end the stream
                 # (client sees truncation, which is the truth).
                 status = 'truncated'
+                telemetry_metrics.SERVE_FAILOVER_SESSIONS.labels(
+                    outcome='truncated_stream').inc()
                 logger.warning(f'Replica {url} failed mid-stream: {e}')
                 try:
                     await out.write_eof()
-                except (ConnectionError, RuntimeError) as e:
+                except (ConnectionError, RuntimeError) as e2:
                     # Client hung up while we were closing the
                     # truncated stream — nothing to recover, but keep
                     # the trail next to the mid-stream warning above.
                     logger.debug(f'Replica {url}: closing truncated '
-                                 f'stream failed: {e}')
-                return out
+                                 f'stream failed: {e2}')
+                return ('response', out)
             status = '502'
-            return web.Response(status=502,
-                                text=f'Replica {url} unreachable: {e}')
+            return ('unreachable', str(e))
         finally:
             self.policy.post_execute_hook(url)
             telemetry_metrics.SERVE_REPLICA_REQUESTS.labels(

@@ -15,7 +15,7 @@ import collections
 import math
 import random
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Set, Union
 
 from skypilot_tpu.serve.traffic import hashring
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
@@ -55,8 +55,13 @@ class LoadBalancingPolicy:
     def set_ready_replicas(self, ready_replicas: List[str]) -> None:
         raise NotImplementedError
 
-    def select_replica(self, context: Optional[Dict[str, Any]] = None
+    def select_replica(self, context: Optional[Dict[str, Any]] = None,
+                       exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
+        """Pick a replica for one request.  `exclude` removes replicas
+        from consideration for THIS selection only (the LB's failover
+        retry loop passes the replicas that already failed the request,
+        so a retry never lands back on the same one)."""
         raise NotImplementedError
 
     def _count_selection(self, url: Optional[str]) -> None:
@@ -91,15 +96,20 @@ class RoundRobinPolicy(LoadBalancingPolicy, name='round_robin'):
             self.ready_replicas = replicas
             self.index = 0
 
-    def select_replica(self, context: Optional[Dict[str, Any]] = None
+    def select_replica(self, context: Optional[Dict[str, Any]] = None,
+                       exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self.lock:
             if not self.ready_replicas:
                 return None
-            url = self.ready_replicas[self.index]
-            self.index = (self.index + 1) % len(self.ready_replicas)
-            self._count_selection(url)
-            return url
+            # At most one full cycle: every candidate excluded -> None.
+            for _ in range(len(self.ready_replicas)):
+                url = self.ready_replicas[self.index]
+                self.index = (self.index + 1) % len(self.ready_replicas)
+                if not exclude or url not in exclude:
+                    self._count_selection(url)
+                    return url
+            return None
 
 
 class _InflightTrackingPolicy(LoadBalancingPolicy):
@@ -125,15 +135,17 @@ class _InflightTrackingPolicy(LoadBalancingPolicy):
     def _members_changed(self) -> None:
         pass
 
-    def _least_loaded(self) -> Optional[str]:
+    def _least_loaded(self, exclude: Optional[Set[str]] = None
+                      ) -> Optional[str]:
         """Minimum in-flight load; ties broken RANDOMLY — `min` alone
         always returns the first list entry, so every scale-up burst
         would pile onto one replica until its hooks register load."""
-        if not self.ready_replicas:
+        candidates = [u for u in self.ready_replicas
+                      if not exclude or u not in exclude]
+        if not candidates:
             return None
-        min_load = min(self.load_map.get(u, 0)
-                       for u in self.ready_replicas)
-        ties = [u for u in self.ready_replicas
+        min_load = min(self.load_map.get(u, 0) for u in candidates)
+        ties = [u for u in candidates
                 if self.load_map.get(u, 0) == min_load]
         return random.choice(ties)
 
@@ -155,10 +167,11 @@ class LeastLoadPolicy(_InflightTrackingPolicy, name='least_load',
                       default=True):
     """Route to the replica with the fewest in-flight requests."""
 
-    def select_replica(self, context: Optional[Dict[str, Any]] = None
+    def select_replica(self, context: Optional[Dict[str, Any]] = None,
+                       exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self.lock:
-            url = self._least_loaded()
+            url = self._least_loaded(exclude)
             self._count_selection(url)
             return url
 
@@ -236,33 +249,39 @@ class PrefixAffinityPolicy(_InflightTrackingPolicy,
         self.affinity_hits += 1
         telemetry_metrics.SERVE_AFFINITY_HITS.inc()
 
-    def select_replica(self, context: Optional[Dict[str, Any]] = None
+    def select_replica(self, context: Optional[Dict[str, Any]] = None,
+                       exclude: Optional[Set[str]] = None
                        ) -> Optional[str]:
         with self.lock:
-            if not self.ready_replicas:
+            candidates = [u for u in self.ready_replicas
+                          if not exclude or u not in exclude]
+            if not candidates:
                 return None
             fp = self.fingerprint((context or {}).get('prompt'))
             if fp is None:
-                url = self._least_loaded()
+                url = self._least_loaded(exclude)
                 self._miss()
                 self._count_selection(url)
                 return url
-            total = sum(self.load_map.get(u, 0)
-                        for u in self.ready_replicas)
+            total = sum(self.load_map.get(u, 0) for u in candidates)
             bound = math.ceil(self.load_factor * (total + 1)
-                              / len(self.ready_replicas))
+                              / len(candidates))
             primary = None
             chosen = None
             for url in self.ring.owners(fp):
                 if primary is None:
+                    # The true owner, even when excluded: a retry that
+                    # must divert off it still counts as a miss.
                     primary = url
+                if exclude and url in exclude:
+                    continue
                 if self.load_map.get(url, 0) < bound:
                     chosen = url
                     break
             if chosen is None:
                 # Every owner over bound (can't happen with the ceil
                 # bound unless load_map is stale) — least-load fallback.
-                chosen = self._least_loaded()
+                chosen = self._least_loaded(exclude)
             if chosen == primary:
                 self._hit()
             else:

@@ -215,8 +215,12 @@ class ReplicaManager:
             statuses = provision_api.query_instances(
                 handle.cluster_info.cloud, cluster_name,
                 handle.cluster_info.provider_config)
-        except Exception:  # pylint: disable=broad-except
-            return False  # can't tell; don't declare preemption
+        except Exception as e:  # pylint: disable=broad-except
+            # Can't tell; don't declare preemption — but say so, or a
+            # broken provider API looks identical to a healthy fleet.
+            logger.warning(f'Preemption check for {cluster_name} '
+                           f'failed (treating as not preempted): {e}')
+            return False
         return not statuses or any(s != 'running'
                                    for s in statuses.values())
 

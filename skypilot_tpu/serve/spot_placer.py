@@ -10,7 +10,6 @@ from __future__ import annotations
 import collections
 import dataclasses
 import enum
-import random
 from typing import Any, Dict, List, Optional
 
 SPOT_PLACERS: Dict[str, type] = {}
@@ -70,6 +69,10 @@ class SpotPlacer:
         self.location2status: Dict[Location, LocationStatus] = \
             collections.OrderedDict(
                 (loc, LocationStatus.ACTIVE) for loc in locations)
+        # Lifetime preemption tally; survives the all-preempted hedge
+        # reset so retries still prefer the historically calmest zone.
+        self.preempt_counts: Dict[Location, int] = \
+            collections.defaultdict(int)
 
     def __init_subclass__(cls, name: str, default: bool = False):
         SPOT_PLACERS[name] = cls
@@ -99,6 +102,7 @@ class SpotPlacer:
 
     def set_preempted(self, location: Location) -> None:
         self.location2status[location] = LocationStatus.PREEMPTED
+        self.preempt_counts[location] += 1
 
     def active_locations(self) -> List[Location]:
         return [loc for loc, st in self.location2status.items()
@@ -126,4 +130,11 @@ class DynamicFallbackSpotPlacer(SpotPlacer, name=SPOT_HEDGE_PLACER,
         min_count = min((counts.get(loc, 0) for loc in active), default=0)
         candidates = [loc for loc in active
                       if counts.get(loc, 0) == min_count]
-        return random.choice(candidates)
+        # Deterministic tie-break: fewest lifetime preemptions, then
+        # catalog order.  The old `random.choice` both perturbed the
+        # process-global RNG (the traffic simulator pins it for
+        # byte-identical replays) and could re-pick a flappy zone over
+        # a calm one on a coin flip.
+        return min(candidates,
+                   key=lambda loc: (self.preempt_counts[loc],
+                                    list(self.location2status).index(loc)))

@@ -65,6 +65,36 @@ class ConsistentHashRing:
         self._owners = [m for _, m in pairs]
         self._members = sorted(set(members))
 
+    def add_member(self, member: str) -> None:
+        """Insert one member's vnodes in place.  Equivalent to
+        `set_members(members + [member])` — vnode positions are a pure
+        function of the name — but O(vnodes log n) instead of a full
+        rebuild; existing members' arcs are untouched except where the
+        new vnodes split them (the bounded ~1/n remap)."""
+        if member in self._members:
+            return
+        for i in range(self.vnodes):
+            point = stable_hash(f'{member}#{i}')
+            idx = bisect.bisect_left(self._points, point)
+            self._points.insert(idx, point)
+            self._owners.insert(idx, member)
+        bisect.insort(self._members, member)
+
+    def remove_member(self, member: str) -> None:
+        """Remove one member's vnodes in place — the replica-death
+        path.  Only keys on the departed arcs remap (each to the next
+        surviving vnode clockwise); every other key keeps its owner,
+        so survivors' prefix caches stay warm.  Unknown members are a
+        no-op: death detection can race a drain that already rebuilt
+        the ring."""
+        if member not in self._members:
+            return
+        keep = [(p, o) for p, o in zip(self._points, self._owners)
+                if o != member]
+        self._points = [p for p, _ in keep]
+        self._owners = [o for _, o in keep]
+        self._members.remove(member)
+
     def primary(self, key_hash: int) -> str:
         """The member owning `key_hash` (first vnode clockwise)."""
         if not self._points:

@@ -25,6 +25,32 @@ under test) and can optionally feed an `Autoscaler` with the same
 virtual-time reports the load balancer sends the controller
 (`ttft_ms` / `queue_depth` / `prefix_hit_ratio`), applying its
 SCALE_UP/SCALE_DOWN decisions as live replica churn.
+
+Chaos mode (`chaos_cfg`): a seeded fault schedule kills, preempts
+(with notice), stalls, or partitions replicas at virtual-time points,
+and the simulator plays its own load balancer's failure-handling role
+with the REAL primitives from `serve/failover.py`:
+
+- Detection is honest: a probe pass observes only reachability
+  (kill/partition fail it) and a progress watchdog catches stalls —
+  `failure_threshold` consecutive bad probes open the replica's
+  circuit, removing it from routing; half-open probes on the
+  `utils/backoff.py` schedule let a healed replica rejoin.
+- Every token is journaled in a `SessionJournal` AT DELIVERY (a
+  partitioned replica's computed-but-undelivered tokens are never
+  committed).  When a circuit opens, its open sessions are re-admitted
+  on survivors by deterministic replay — prompt + committed tokens
+  re-prefilled, budget shrunk to the un-delivered remainder — so
+  greedy sessions are bit-exact with a fault-free run and no token is
+  dropped or duplicated (`session_outputs()` is the witness).
+- A preemption notice drains the replica and hands its sessions off
+  between decode chunks via the same cancel/replay path.
+- Replica death reports to the autoscaler as a terminal FAILED info:
+  dead capacity is REPLACED (scale-up), never averaged into load.
+
+With `chaos_cfg=None` the extra machinery is inert and the simulator
+is behaviorally identical (same RNG draws, same cost charges, same
+summary) to the pre-chaos implementation.
 """
 from __future__ import annotations
 
@@ -32,12 +58,69 @@ import dataclasses
 import itertools
 import math
 import random
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set
 
+from skypilot_tpu.serve import failover as failover_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.traffic.generator import (Arrival, TrafficConfig,
                                                   generate_trace)
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
+from skypilot_tpu.utils.backoff import Backoff
+
+FAULT_KINDS = ('kill', 'preempt', 'stall', 'partition')
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault against one replica (virtual time).
+
+    kinds:
+      kill      — the replica vanishes without notice (spot loss, host
+                  death).  Detected by failed probes; sessions fail
+                  over by replay; the autoscaler sees FAILED capacity.
+      preempt   — preemption WITH notice: the replica drains and its
+                  sessions hand off to survivors between decode
+                  chunks.  No detection latency.
+      stall     — the replica stops making progress for `duration_s`
+                  but still answers probes (wedged device, GC pause).
+                  Only the progress watchdog catches it.
+      partition — the replica keeps computing but nothing it produces
+                  is delivered for `duration_s` (network fault).
+                  Probes fail; the journal's at-delivery commit rule
+                  is what keeps its zombie tokens out of the stream.
+    """
+    t: float
+    kind: str
+    replica: int
+    duration_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f'kind must be one of {FAULT_KINDS}, '
+                             f'got {self.kind!r}')
+        if self.kind in ('stall', 'partition') and self.duration_s <= 0:
+            raise ValueError(f'{self.kind} fault needs duration_s > 0')
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    """Fault schedule + detection knobs (virtual seconds)."""
+    events: List[FaultEvent] = dataclasses.field(default_factory=list)
+    # Consecutive failed probes before a circuit opens.
+    failure_threshold: int = 3
+    # Progress watchdog: a replica with in-flight work that advances
+    # nothing for this long counts as a failed probe.
+    stall_timeout_s: float = 1.5
+    # Half-open probe schedule for OPEN circuits.
+    probe_backoff_initial_s: float = 0.5
+    probe_backoff_cap_s: float = 8.0
+
+    def __post_init__(self) -> None:
+        if self.failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1')
+        if self.stall_timeout_s <= 0:
+            raise ValueError('stall_timeout_s must be positive')
 
 
 @dataclasses.dataclass
@@ -90,6 +173,19 @@ class _ReqRecord:
     out_len: int = 0
 
 
+@dataclasses.dataclass
+class _SessionState:
+    """Fleet-side per-session bookkeeping (tokens live in the
+    journal; this holds timing + the rid fence)."""
+    rec: _ReqRecord
+    # The batcher request id currently authorized to deliver for this
+    # session.  Together with the journal's replica field it fences
+    # zombies: a delivery is accepted only from (owner url, owner rid).
+    rid: int
+    fault_detect_t: Optional[float] = None
+    refirst_t: Optional[float] = None
+
+
 class _ReplicaSim:
     """One replica: a real ContinuousBatcher plus a virtual clock."""
 
@@ -101,34 +197,62 @@ class _ReplicaSim:
         self.cfg = cfg
         self.vclock = 0.0
         self.draining = False
-        self.records: Dict[int, _ReqRecord] = {}
+        # Chaos state (inert without a ChaosConfig).
+        self.alive = True
+        self.stalled_until = 0.0
+        self.partitioned_until = 0.0
+        self.last_progress_t = 0.0
         self.inflight: List[int] = []
-        # TTFT samples (virtual seconds) not yet reported fleet-side.
-        self.fresh_ttfts: List[float] = []
+        # Requests that finished while partitioned: done in the
+        # batcher, but their tail tokens were never delivered.  They
+        # stay resident until the partition heals (flush) or the
+        # session is failed over (discard).
+        self.parked: List[int] = []
+        self.rid_sid: Dict[int, int] = {}
+        self.rid_plen: Dict[int, int] = {}
+        # Per-rid count of output tokens already committed downstream.
+        # Deliveries suppressed by a partition leave this lagging, so
+        # the backlog flushes (is not lost) when the link heals.
+        self.delivered_upto: Dict[int, int] = {}
 
     @property
     def busy(self) -> bool:
         return self.batcher.num_active > 0 or self.batcher.num_queued > 0
 
-    def submit(self, arrival: Arrival, now: float) -> None:
+    def stalled(self, now: float) -> bool:
+        return now < self.stalled_until
+
+    def partitioned(self, now: float) -> bool:
+        return now < self.partitioned_until
+
+    def submit(self, prompt: List[int], max_new_tokens: int, sid: int,
+               now: float) -> int:
         # An idle replica's clock has nothing to do before the request
         # exists; work can never be charged to the past.
         self.vclock = max(self.vclock, now)
-        rid = self.batcher.submit(arrival.prompt,
-                                  max_new_tokens=arrival.max_new_tokens)
-        self.records[rid] = _ReqRecord(arrival_t=arrival.t,
-                                       prompt_len=len(arrival.prompt))
+        rid = self.batcher.submit(prompt, max_new_tokens=max_new_tokens)
+        self.rid_sid[rid] = sid
+        self.rid_plen[rid] = len(prompt)
         self.inflight.append(rid)
+        return rid
 
-    def advance(self, now: float,
-                on_complete: Callable[['_ReplicaSim', int, _ReqRecord],
-                                      None]) -> None:
+    def advance(self, now: float, deliver, complete) -> None:
         """Catch the replica up to fleet time `now`: step the batcher,
-        charging the cost model, while it has work and is behind."""
+        charging the cost model, while it has work and is behind.  A
+        dead replica is gone; a stalled one is frozen in place (its
+        vclock resumes at the stall's end)."""
+        if not self.alive or self.stalled(now):
+            return
+        if self.stalled_until:
+            self.vclock = max(self.vclock, self.stalled_until)
         while self.busy and self.vclock <= now:
-            self._step_once(on_complete)
+            self._step_once(deliver, complete)
+        self.last_progress_t = now
 
-    def _step_once(self, on_complete) -> None:
+    def _step_once(self, deliver: Callable[['_ReplicaSim', int, float],
+                                           None],
+                   complete: Callable[['_ReplicaSim', int, float],
+                                      bool]) -> None:
         batcher = self.batcher
         pre_out = {rid: len(batcher._requests[rid].out)
                    for rid in self.inflight}
@@ -146,25 +270,30 @@ class _ReplicaSim:
                 delta -= 1    # the first token comes from the prefill
             decode_tokens += delta
         prefill_tokens = max(
-            0, sum(self.records[rid].prompt_len for rid in newly_first)
+            0, sum(self.rid_plen[rid] for rid in newly_first)
             - saved_delta)
         self.vclock += (self.cfg.step_overhead_s
                         + prefill_tokens * self.cfg.prefill_cost_per_token_s
                         + decode_tokens * self.cfg.decode_cost_per_token_s)
-        for rid in newly_first:
-            rec = self.records[rid]
-            rec.first_token_t = self.vclock
-            self.fresh_ttfts.append(self.vclock - rec.arrival_t)
+        for rid in self.inflight:
+            if len(batcher._requests[rid].out) > pre_out[rid]:
+                deliver(self, rid, self.vclock)
         still: List[int] = []
         for rid in self.inflight:
             if batcher.is_done(rid):
-                rec = self.records[rid]
-                rec.done_t = self.vclock
-                rec.out_len = len(batcher.result(rid))
-                on_complete(self, rid, rec)
+                if complete(self, rid, self.vclock):
+                    batcher.result(rid)
+                    self._drop_rid(rid)
+                else:
+                    self.parked.append(rid)
             else:
                 still.append(rid)
         self.inflight = still
+
+    def _drop_rid(self, rid: int) -> None:
+        self.rid_sid.pop(rid, None)
+        self.rid_plen.pop(rid, None)
+        self.delivered_upto.pop(rid, None)
 
 
 def _percentile(samples: List[float], q: float) -> float:
@@ -177,7 +306,8 @@ class FleetSimulator:
     """Replica fleet + policy + trace -> deterministic SERVE_SUMMARY."""
 
     def __init__(self, sim_cfg: Optional[SimConfig] = None,
-                 traffic_cfg: Optional[TrafficConfig] = None) -> None:
+                 traffic_cfg: Optional[TrafficConfig] = None,
+                 chaos_cfg: Optional[ChaosConfig] = None) -> None:
         import jax
 
         from skypilot_tpu.infer.engine import GeneratorConfig
@@ -185,6 +315,7 @@ class FleetSimulator:
 
         self.cfg = sim_cfg or SimConfig()
         self.traffic = traffic_cfg or TrafficConfig()
+        self.chaos = chaos_cfg
         self.model_config = llama.LLAMA_DEBUG
         if self.traffic.vocab_size > self.model_config.vocab_size:
             raise ValueError(
@@ -213,12 +344,41 @@ class FleetSimulator:
             self.policy = lb_policies.LoadBalancingPolicy.make(
                 self.cfg.policy)
         self._ids = itertools.count(0)
+        self._now = 0.0
         self.replicas: List[_ReplicaSim] = []
         self.retired: List[_ReplicaSim] = []
+        self.dead: List[_ReplicaSim] = []
+        self._by_url: Dict[str, _ReplicaSim] = {}
         self.completed: List[_ReqRecord] = []
         self.dropped = 0
         self.scale_events: List[Any] = []
         self._report_ttfts: List[float] = []
+        # Session plane: the journal is the exactly-once source of
+        # truth for delivered tokens; _sessions holds timing + fences.
+        self.journal = failover_lib.SessionJournal()
+        self._sessions: Dict[int, _SessionState] = {}
+        self._lost: Set[int] = set()
+        self.sessions_recovered = 0
+        self.sessions_handed_off = 0
+        self.replayed_tokens = 0
+        self.invariant_checks = 0
+        self._failover_latencies: List[float] = []
+        self.fault_log: List[Dict[str, Any]] = []
+        self._breaker: Optional[failover_lib.CircuitBreaker] = None
+        self._pending_faults: List[FaultEvent] = []
+        if chaos_cfg is not None:
+            # jitter=0: the probe schedule must be a pure function of
+            # the failure sequence, and the breaker must not draw from
+            # the route-seeded RNG stream (which would perturb routing
+            # tie-breaks and break no-chaos/chaos bit-exactness).
+            self._breaker = failover_lib.CircuitBreaker(
+                failure_threshold=chaos_cfg.failure_threshold,
+                backoff_factory=lambda: Backoff(
+                    initial=chaos_cfg.probe_backoff_initial_s,
+                    cap=chaos_cfg.probe_backoff_cap_s,
+                    jitter=0.0))
+            self._pending_faults = sorted(chaos_cfg.events,
+                                          key=lambda e: e.t)
         for _ in range(self.cfg.num_replicas):
             self.add_replica()
 
@@ -230,7 +390,10 @@ class FleetSimulator:
         batcher = ContinuousBatcher(self.params, self.model_config,
                                     self.gen,
                                     decode_chunk=self.cfg.decode_chunk)
-        self.replicas.append(_ReplicaSim(rid, url, batcher, self.cfg))
+        rep = _ReplicaSim(rid, url, batcher, self.cfg)
+        rep.last_progress_t = self._now
+        self.replicas.append(rep)
+        self._by_url[url] = rep
         self._sync_policy()
         return url
 
@@ -244,11 +407,30 @@ class FleetSimulator:
                 return
         raise ValueError(f'No live replica with id {replica_id}')
 
+    def _retire(self, rep: _ReplicaSim) -> None:
+        """A drained replica leaves the fleet; its ring arcs and
+        breaker state leave with it (the SKY304 pairing)."""
+        self.replicas.remove(rep)
+        self._by_url.pop(rep.url, None)
+        self.retired.append(rep)
+        if self._breaker is not None:
+            self._breaker.forget(rep.url)
+        self._sync_policy()
+
     def _live(self) -> List[_ReplicaSim]:
         return [r for r in self.replicas if not r.draining]
 
+    def _routable(self) -> List[_ReplicaSim]:
+        if self._breaker is None:
+            return self._live()
+        return [r for r in self._live()
+                if not self._breaker.is_open(r.url)]
+
     def _sync_policy(self) -> None:
-        self.policy.set_ready_replicas([r.url for r in self._live()])
+        urls = [r.url for r in self._live()]
+        if self._breaker is not None:
+            urls = self._breaker.routable(urls, self._now)
+        self.policy.set_ready_replicas(urls)
 
     # ---- run loop --------------------------------------------------------
     def run(self, autoscaler=None) -> Dict[str, Any]:
@@ -261,7 +443,6 @@ class FleetSimulator:
         exactly the dynamics SLOAutoscaler's conservatism is about).
         """
         arrivals = generate_trace(self.traffic)
-        by_url = {r.url: r for r in self.replicas}
         # Policy tie-breaks draw from the module RNG; pin it for the
         # run (and restore after) so summaries are reproducible.
         rng_state = random.getstate()
@@ -269,26 +450,30 @@ class FleetSimulator:
         try:
             now = 0.0
             idx = 0
+            pending = list(self._pending_faults)
             next_decision = (float(autoscaler.get_decision_interval())
                              if autoscaler is not None else None)
             for tick in range(self.cfg.max_ticks):
-                if idx >= len(arrivals) and \
-                        not any(r.busy for r in self.replicas):
+                if idx >= len(arrivals) and self._settled():
                     break
                 now += self.cfg.tick_s
+                self._now = now
+                while pending and pending[0].t <= now:
+                    self._apply_fault(pending.pop(0), now)
                 while idx < len(arrivals) and arrivals[idx].t <= now:
-                    self._dispatch(arrivals[idx], by_url)
+                    self._dispatch(arrivals[idx], idx)
                     idx += 1
-                for rep in self.replicas:
-                    rep.advance(now, self._on_complete)
-                    self._report_ttfts.extend(rep.fresh_ttfts)
-                    rep.fresh_ttfts = []
+                for rep in list(self.replicas):
+                    rep.advance(now, self._deliver, self._complete)
+                if self.chaos is not None:
+                    for rep in list(self.replicas):
+                        self._flush_parked(rep, now)
+                    self._probe_tick(now)
                 for rep in [r for r in self.replicas
                             if r.draining and not r.busy]:
-                    self.replicas.remove(rep)
-                    self.retired.append(rep)
+                    self._retire(rep)
                 if autoscaler is not None and now >= next_decision:
-                    self._autoscale_tick(autoscaler, now, by_url)
+                    self._autoscale_tick(autoscaler, now)
                     next_decision = now + autoscaler.get_decision_interval()
             else:
                 raise RuntimeError(
@@ -298,39 +483,298 @@ class FleetSimulator:
         finally:
             random.setstate(rng_state)
 
-    def _dispatch(self, arrival: Arrival,
-                  by_url: Dict[str, _ReplicaSim]) -> None:
+    def _settled(self) -> bool:
+        if self.chaos is None:
+            return not any(r.busy for r in self.replicas)
+        # A partitioned zombie can stay busy after every session it
+        # computes for has been failed over — the journal, not the
+        # batchers, says when the trace is truly served.
+        return all(self.journal.record(sid).done
+                   for sid in self._sessions)
+
+    def _dispatch(self, arrival: Arrival, sid: int) -> None:
         url = self.policy.select_replica({'prompt': arrival.prompt})
         if url is None:
             raise RuntimeError('No ready replicas to route to')
         self.policy.pre_execute_hook(url)
-        by_url[url].submit(arrival, now=arrival.t)
+        rep = self._by_url[url]
+        rid = rep.submit(arrival.prompt, arrival.max_new_tokens, sid,
+                         now=arrival.t)
+        # The journal's budget is the batcher's post-clamp budget, so
+        # replay_spec() knows exactly how many tokens remain owed.
+        budget = min(arrival.max_new_tokens,
+                     self.cfg.max_seq_len - len(arrival.prompt))
+        self.journal.open(sid, arrival.prompt, budget, url)
+        self._sessions[sid] = _SessionState(
+            rec=_ReqRecord(arrival_t=arrival.t,
+                           prompt_len=len(arrival.prompt)),
+            rid=rid)
 
-    def _on_complete(self, rep: _ReplicaSim, rid: int,
-                     rec: _ReqRecord) -> None:
-        del rid  # identified by record
+    # ---- delivery plane --------------------------------------------------
+    def _owns(self, rep: _ReplicaSim, rid: int, sid: int) -> bool:
+        rec = self.journal.record(sid)
+        return (rec.replica == rep.url and not rec.done
+                and self._sessions[sid].rid == rid)
+
+    def _deliver(self, rep: _ReplicaSim, rid: int, t: float) -> None:
+        sid = rep.rid_sid[rid]
+        if not self._owns(rep, rid, sid):
+            return      # zombie: ownership moved at failover
+        if rep.partitioned(t):
+            return      # computed, NOT delivered; backlog flushes at heal
+        self._commit_fresh(rep, rid, sid, t)
+
+    def _commit_fresh(self, rep: _ReplicaSim, rid: int, sid: int,
+                      t: float) -> None:
+        """Commit every output token of `rid` not yet delivered."""
+        out = rep.batcher._requests[rid].out
+        base = rep.delivered_upto.get(rid, 0)
+        fresh = out[base:]
+        if not fresh:
+            return
+        rep.delivered_upto[rid] = len(out)
+        self.journal.commit(sid, fresh)
+        st = self._sessions[sid]
+        if st.rec.first_token_t is None:
+            st.rec.first_token_t = t
+            self._report_ttfts.append(t - st.rec.arrival_t)
+        if st.fault_detect_t is not None and st.refirst_t is None:
+            st.refirst_t = t
+            lat = t - st.fault_detect_t
+            self._failover_latencies.append(lat)
+            telemetry_metrics.SERVE_FAILOVER_LATENCY_SECONDS.observe(lat)
+
+    def _complete(self, rep: _ReplicaSim, rid: int, t: float) -> bool:
+        """Returns True when the replica may discard the request; False
+        parks it (finished behind a partition — the tail is undelivered
+        and must survive until heal or failover)."""
+        sid = rep.rid_sid[rid]
+        if not self._owns(rep, rid, sid):
+            return True     # zombie: consume and discard
+        if rep.partitioned(t):
+            return False
         self.policy.post_execute_hook(rep.url)
-        self.completed.append(rec)
+        self._finish_session(sid, t)
+        return True
 
-    def _autoscale_tick(self, autoscaler, now: float,
-                        by_url: Dict[str, _ReplicaSim]) -> None:
+    def _finish_session(self, sid: int, t: float) -> None:
+        rec = self.journal.close(sid)
+        st = self._sessions[sid]
+        st.rec.done_t = t
+        st.rec.out_len = len(rec.committed)
+        self.completed.append(st.rec)
+
+    def _flush_parked(self, rep: _ReplicaSim, now: float) -> None:
+        """Deliver the tails of requests that finished behind a now-
+        healed partition: delayed, not lost."""
+        if not rep.parked or rep.partitioned(now):
+            return
+        for rid in rep.parked:
+            sid = rep.rid_sid[rid]
+            if self._owns(rep, rid, sid):
+                self._commit_fresh(rep, rid, sid, now)
+                self.policy.post_execute_hook(rep.url)
+                self._finish_session(sid, now)
+            rep.batcher.result(rid)
+            rep._drop_rid(rid)
+        rep.parked = []
+
+    # ---- chaos: faults, detection, failover ------------------------------
+    def _apply_fault(self, ev: FaultEvent, now: float) -> None:
+        telemetry_metrics.SERVE_CHAOS_FAULTS.labels(kind=ev.kind).inc()
+        rep = next((r for r in self.replicas
+                    if r.replica_id == ev.replica), None)
+        self.fault_log.append({'t': round(ev.t, 3), 'kind': ev.kind,
+                               'replica': ev.replica,
+                               'applied': rep is not None})
+        if rep is None:
+            return      # already dead/retired: fault lands on a ghost
+        if ev.kind == 'kill':
+            rep.alive = False
+        elif ev.kind == 'stall':
+            rep.stalled_until = max(rep.stalled_until,
+                                    now + ev.duration_s)
+        elif ev.kind == 'partition':
+            rep.partitioned_until = max(rep.partitioned_until,
+                                        now + ev.duration_s)
+        else:   # preempt, WITH notice: drain + immediate clean handoff
+            if rep.draining:
+                return
+            rep.draining = True
+            self._sync_policy()
+            self._handoff(rep, now)
+
+    def _probe_tick(self, now: float) -> None:
+        """Per-tick health pass.  Probes observe reachability only
+        (alive + not partitioned); the watchdog infers stalls from lack
+        of progress.  The breaker turns consecutive failures into
+        circuit opens and schedules half-open heal probes."""
+        assert self._breaker is not None
+        for rep in list(self.replicas):
+            if rep.draining:
+                continue
+            url = rep.url
+            reachable = rep.alive and not rep.partitioned(now)
+            wd_stalled = bool(rep.inflight) and (
+                now - rep.last_progress_t > self.chaos.stall_timeout_s)
+            if self._breaker.is_open(url):
+                if not self._breaker.probe_due(url, now):
+                    continue
+                if not rep.alive:
+                    # The half-open probe found the host gone for
+                    # good: confirmed death, stop probing.
+                    self._fail_replica(rep, now)
+                elif not rep.partitioned(now) and not rep.stalled(now):
+                    # The probe is an end-to-end canary; a replica
+                    # that is reachable AND unfrozen passes it.
+                    self._breaker.note_success(url)
+                    self._heal_replica(rep, now)
+                else:
+                    self._breaker.note_failure(url, now)
+                continue
+            if reachable and not wd_stalled:
+                self._breaker.note_success(url)
+            elif self._breaker.note_failure(url, now):
+                self.fault_log.append({'t': round(now, 3),
+                                       'event': 'circuit_open',
+                                       'replica': rep.replica_id})
+                self._fail_replica(rep, now)
+
+    def _fail_replica(self, rep: _ReplicaSim, now: float) -> None:
+        """The replica's circuit opened (or its death was confirmed):
+        remove it from routing and replay its open sessions on
+        survivors.  Dead replicas leave the fleet entirely — ring arcs
+        and breaker state removed together — and report as terminal
+        FAILED capacity to the autoscaler."""
+        if not rep.alive and rep in self.replicas:
+            self.replicas.remove(rep)
+            self._by_url.pop(rep.url, None)
+            self.dead.append(rep)
+            self._breaker.forget(rep.url)
+        self._sync_policy()
+        if rep.alive and not rep.partitioned(now):
+            # Stalled-but-reachable: cancel its zombie work now.  A
+            # partitioned replica is unreachable — its zombies are
+            # fenced by journal ownership and cancelled at heal.
+            self._fence(rep, now)
+        for sid in sorted(self.journal.sessions_on(rep.url)):
+            self._replay_session(sid, now, planned=False)
+        self._check_survivor_invariants()
+
+    def _heal_replica(self, rep: _ReplicaSim, now: float) -> None:
+        """A half-open probe succeeded: flush any delivery backlog the
+        partition held up, cancel decodes whose sessions moved on, and
+        rejoin the routing set."""
+        self._fence(rep, now)
+        self._sync_policy()
+        self.fault_log.append({'t': round(now, 3), 'event': 'heal',
+                               'replica': rep.replica_id})
+
+    def _fence(self, rep: _ReplicaSim, now: float) -> None:
+        """Clear everything resident on `rep`: flush parked tails that
+        are still deliverable, discard the rest, cancel in-flight work
+        (block release `check_invariant`-verified)."""
+        self._flush_parked(rep, now)
+        for rid in rep.parked:
+            # Still parked => still partitioned: the tail was never
+            # delivered and its session replays elsewhere.
+            rep.batcher.result(rid)
+            rep._drop_rid(rid)
+        rep.parked = []
+        for rid in list(rep.inflight):
+            if rid in rep.batcher._requests:
+                rep.batcher.cancel(rid)
+            rep._drop_rid(rid)
+        rep.inflight = []
+        if rep.batcher.pooled:
+            rep.batcher.pool.check_invariant()
+            self.invariant_checks += 1
+
+    def _handoff(self, rep: _ReplicaSim, now: float) -> None:
+        """Preemption notice: move every open session to a survivor
+        between decode chunks — cancel on the source (frees its
+        blocks), replay prompt+committed on the target."""
+        sids = sorted(self.journal.sessions_on(rep.url))
+        self._fence(rep, now)
+        for sid in sids:
+            self._replay_session(sid, now, planned=True)
+        self._check_survivor_invariants()
+
+    def _replay_session(self, sid: int, now: float,
+                        planned: bool) -> None:
+        """Re-admit one session on a survivor, resuming at the first
+        un-delivered token (exactly-once: the journal's committed
+        prefix becomes part of the replayed prompt)."""
+        st = self._sessions[sid]
+        st.fault_detect_t = now
+        st.refirst_t = None
+        spec = self.journal.replay_spec(sid)
+        if spec is None:
+            # Every budgeted token was already delivered — only the
+            # completion event died with the replica.
+            self._finish_session(sid, now)
+            return
+        url = self.policy.select_replica({'prompt': spec['prompt']})
+        if url is None:
+            self._lost.add(sid)
+            self.journal.close(sid)
+            telemetry_metrics.SERVE_FAILOVER_SESSIONS.labels(
+                outcome='lost').inc()
+            return
+        self.policy.pre_execute_hook(url)
+        rep = self._by_url[url]
+        rid = rep.submit(spec['prompt'], spec['max_new_tokens'], sid,
+                         now=now)
+        self.journal.reassign(sid, url)
+        st.rid = rid
+        replayed = len(self.journal.record(sid).committed)
+        self.replayed_tokens += replayed
+        if replayed:
+            telemetry_metrics.SERVE_FAILOVER_REPLAYED_TOKENS.inc(replayed)
+        if planned:
+            self.sessions_handed_off += 1
+            outcome = 'handed_off'
+        else:
+            self.sessions_recovered += 1
+            outcome = 'recovered'
+        telemetry_metrics.SERVE_FAILOVER_SESSIONS.labels(
+            outcome=outcome).inc()
+
+    def _check_survivor_invariants(self) -> None:
+        for rep in self.replicas:
+            if rep.batcher.pooled:
+                rep.batcher.pool.check_invariant()
+                self.invariant_checks += 1
+
+    # ---- autoscaling -----------------------------------------------------
+    def _autoscale_tick(self, autoscaler, now: float) -> None:
         autoscaler.collect_request_information({
             'ttft_ms': [t * 1000.0 for t in self._report_ttfts],
             'queue_depth': sum(r.batcher.num_queued
-                               for r in self._live()),
+                               for r in self._routable()),
             'prefix_hit_ratio': self.prefix_hit_ratio(),
         })
         self._report_ttfts = []
-        infos = [{'replica_id': r.replica_id,
-                  'status': ReplicaStatus.READY,
-                  'launched_at': r.replica_id,
-                  'is_spot': False} for r in self._live()]
+        infos = []
+        for r in self.replicas:
+            status = ReplicaStatus.READY
+            if self._breaker is not None and self._breaker.is_open(r.url):
+                status = ReplicaStatus.NOT_READY
+            infos.append({'replica_id': r.replica_id, 'status': status,
+                          'launched_at': r.replica_id, 'is_spot': False,
+                          'draining': r.draining})
+        # Dead replicas report terminal: capacity to REPLACE (the
+        # autoscaler sees alive < target and scales up), never load to
+        # absorb.
+        infos.extend({'replica_id': r.replica_id,
+                      'status': ReplicaStatus.FAILED,
+                      'launched_at': r.replica_id, 'is_spot': False}
+                     for r in self.dead)
         from skypilot_tpu.serve.autoscalers import \
             AutoscalerDecisionOperator
         for decision in autoscaler.generate_scaling_decisions(infos):
             if decision.operator is AutoscalerDecisionOperator.SCALE_UP:
-                url = self.add_replica()
-                by_url[url] = self.replicas[-1]
+                self.add_replica()
             else:
                 self.remove_replica(decision.target)
         self.scale_events.append(
@@ -347,6 +791,13 @@ class FleetSimulator:
         if hits + misses == 0:
             return None
         return hits / (hits + misses)
+
+    def session_outputs(self) -> Dict[int, List[int]]:
+        """Committed (delivered) tokens per session — the exactly-once
+        witness: a chaos run's outputs must equal the fault-free run's
+        bit for bit (greedy decode; no duplicates, no gaps)."""
+        return {sid: list(self.journal.record(sid).committed)
+                for sid in self._sessions}
 
     def summary(self, makespan: Optional[float] = None) -> Dict[str, Any]:
         recs = self.completed
@@ -375,7 +826,7 @@ class FleetSimulator:
         def _round(value):
             return None if value is None else round(value, 6)
 
-        return {
+        out = {
             'policy': self.policy.name,
             'requests': len(recs),
             'makespan_s': _round(span),
@@ -393,3 +844,19 @@ class FleetSimulator:
             'replicas': len(self._live()),
             'scale_events': self.scale_events,
         }
+        if self.chaos is not None:
+            lat = self._failover_latencies
+            out['chaos'] = {
+                'faults': self.fault_log,
+                'circuit_opens': self._breaker.opens_total,
+                'sessions_recovered': self.sessions_recovered,
+                'sessions_handed_off': self.sessions_handed_off,
+                'sessions_lost': len(self._lost),
+                'replayed_tokens': self.replayed_tokens,
+                'failover_p50_ms': _round(
+                    _percentile(lat, 0.50) * 1000 if lat else None),
+                'failover_p99_ms': _round(
+                    _percentile(lat, 0.99) * 1000 if lat else None),
+                'invariant_checks': self.invariant_checks,
+            }
+        return out

@@ -416,6 +416,54 @@ SERVE_AUTOSCALER_DECISIONS = prometheus_client.Counter(
     ['service', 'operator'],
     registry=REGISTRY)
 
+# ---- serve failover (serve/failover.py, traffic/simulator.py chaos)
+
+SERVE_FAILOVER_SESSIONS = prometheus_client.Counter(
+    'skytpu_serve_failover_sessions_total',
+    'Sessions moved off a failed or draining replica, by outcome: '
+    'recovered (replayed on a survivor after a circuit opened), '
+    'handed_off (drained cleanly on preemption notice), lost (no '
+    'survivor to replay on), truncated_stream (LB mid-stream failure '
+    'with bytes already delivered — ended truncated)',
+    ['outcome'],
+    registry=REGISTRY)
+
+SERVE_FAILOVER_LATENCY_SECONDS = prometheus_client.Histogram(
+    'skytpu_serve_failover_latency_seconds',
+    'Fault detection (circuit open) to the first replayed token '
+    'delivered on the survivor, per recovered session',
+    buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 120),
+    registry=REGISTRY)
+
+SERVE_FAILOVER_REPLAYED_TOKENS = prometheus_client.Counter(
+    'skytpu_serve_failover_replayed_tokens_total',
+    'Committed tokens re-prefilled on a survivor during session '
+    'replay (the exactly-once resume cost; warm prefix hits shrink '
+    'the actual prefill charge)',
+    registry=REGISTRY)
+
+SERVE_FAILOVER_CIRCUIT_TRANSITIONS = prometheus_client.Counter(
+    'skytpu_serve_failover_circuit_transitions_total',
+    'Circuit-breaker transitions per replica and new state (open = '
+    'consecutive-failure threshold tripped, closed = half-open probe '
+    'succeeded)',
+    ['replica', 'state'],
+    registry=REGISTRY)
+
+SERVE_FAILOVER_BACKPRESSURE_DIVERTS = prometheus_client.Counter(
+    'skytpu_serve_failover_backpressure_diverts_total',
+    'Requests diverted to another replica after a 503 + Retry-After '
+    '(admission backpressure honored instead of retry-storming the '
+    'full replica)',
+    registry=REGISTRY)
+
+SERVE_CHAOS_FAULTS = prometheus_client.Counter(
+    'skytpu_serve_chaos_faults_total',
+    'Faults injected by the chaos layer, per kind '
+    '(kill / preempt / stall / partition)',
+    ['kind'],
+    registry=REGISTRY)
+
 
 def record_autoscaler_decisions(service_name: str,
                                 decisions: List[Any]) -> None:

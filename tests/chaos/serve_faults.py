@@ -1,0 +1,67 @@
+"""Seeded fault-plan generation for the serve-plane chaos layer.
+
+Same philosophy as chaos_proxy.py / ckpt_faults.py (inject faults
+without touching subsystem code): the FleetSimulator takes a
+`ChaosConfig` of virtual-time `FaultEvent`s; these helpers draw
+reproducible plans from a seed so every chaos test and `bench.py
+--bench chaos` arm is byte-replayable.
+
+The draw uses its own `numpy.random.RandomState(seed)` — NEVER the
+process-global `random` module, which the simulator pins to its route
+seed for bit-exact replays.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from skypilot_tpu.serve.traffic.simulator import FAULT_KINDS, FaultEvent
+
+
+def draw_fault_plan(seed: int, duration_s: float, num_replicas: int,
+                    n_faults: int = 2,
+                    kinds: Optional[Sequence[str]] = None,
+                    min_duration_s: float = 1.0,
+                    max_duration_s: float = 4.0) -> List[FaultEvent]:
+    """Draw `n_faults` faults on distinct replicas at distinct times.
+
+    Times land in the middle (15%..70%) of the trace so every fault
+    hits live traffic and leaves virtual time for recovery; replicas
+    are sampled without replacement so one plan never double-kills a
+    replica (the acceptance scenario: kill one, preempt another).
+    """
+    if kinds is None:
+        kinds = FAULT_KINDS
+    bad = [k for k in kinds if k not in FAULT_KINDS]
+    if bad:
+        raise ValueError(f'unknown fault kinds: {bad}')
+    if n_faults > num_replicas:
+        raise ValueError(f'cannot draw {n_faults} faults over '
+                         f'{num_replicas} replicas without doubling up')
+    rng = np.random.RandomState(seed)
+    replicas = rng.choice(num_replicas, size=n_faults, replace=False)
+    times = sorted(rng.uniform(0.15 * duration_s, 0.70 * duration_s)
+                   for _ in range(n_faults))
+    events = []
+    for t, rep in zip(times, replicas):
+        kind = kinds[int(rng.randint(len(kinds)))]
+        duration = 0.0
+        if kind in ('stall', 'partition'):
+            duration = float(rng.uniform(min_duration_s, max_duration_s))
+        events.append(FaultEvent(t=float(t), kind=kind,
+                                 replica=int(rep), duration_s=duration))
+    return events
+
+
+def kill_and_preempt_plan(duration_s: float,
+                          kill_replica: int = 0,
+                          preempt_replica: int = 1) -> List[FaultEvent]:
+    """The acceptance scenario, at fixed fractions of the trace: kill
+    one replica mid-burst (35%), preempt-with-notice another (55%)."""
+    return [
+        FaultEvent(t=0.35 * duration_s, kind='kill',
+                   replica=kill_replica),
+        FaultEvent(t=0.55 * duration_s, kind='preempt',
+                   replica=preempt_replica),
+    ]

@@ -92,8 +92,11 @@ def test_request_rate_autoscaler_hysteresis():
     # threshold = 40s / 20s interval = 2 consecutive over-target passes.
     assert a.scale_up_threshold == 2
     now = time.time()
+    # ~3 qps sustained for LONGER than the QPS window, so the
+    # cold-start clamp (denominator = min(window, elapsed)) uses the
+    # full window: 177 in-window samples / 60 s = 2.95 qps.
     a.collect_request_information(
-        {'timestamps': [now - i * 0.2 for i in range(180)]})  # 3 qps
+        {'timestamps': [now - i * 0.34 for i in range(180)]})
     a.generate_scaling_decisions(_fake_replicas(1))
     assert a.target_num_replicas == 1  # one pass: not yet
     decisions = a.generate_scaling_decisions(_fake_replicas(1))

@@ -1,0 +1,356 @@
+"""Serve-plane chaos tolerance (serve/failover.py +
+serve/traffic/simulator.py chaos mode): circuit-breaking detection,
+exactly-once session failover, preemption-notice handoff, and the
+autoscaler treating dead replicas as capacity to replace.
+
+All simulator tests run in VIRTUAL time on the seeded trace — no
+sleeps, no wall-clock dependence — and the chaos runs must reproduce
+the fault-free run's session outputs bit for bit (greedy decode).
+Expensive fleet runs share one module-scoped fixture.
+"""
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.infer import block_pool as block_pool_lib
+from skypilot_tpu.serve import autoscalers as asc
+from skypilot_tpu.serve import failover as failover_lib
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.traffic import generator as gen
+from skypilot_tpu.serve.traffic.simulator import (ChaosConfig,
+                                                  FaultEvent,
+                                                  FleetSimulator,
+                                                  SimConfig)
+from tests.chaos import serve_faults
+
+
+# --- fault plans ------------------------------------------------------------
+
+def test_fault_event_validation():
+    with pytest.raises(ValueError):
+        FaultEvent(t=1.0, kind='explode', replica=0)
+    with pytest.raises(ValueError):
+        FaultEvent(t=1.0, kind='stall', replica=0)   # needs duration
+    with pytest.raises(ValueError):
+        ChaosConfig(failure_threshold=0)
+    FaultEvent(t=1.0, kind='partition', replica=0, duration_s=2.0)
+
+
+def test_draw_fault_plan_seeded_and_distinct():
+    a = serve_faults.draw_fault_plan(7, 20.0, 4, n_faults=3)
+    b = serve_faults.draw_fault_plan(7, 20.0, 4, n_faults=3)
+    assert a == b
+    assert a != serve_faults.draw_fault_plan(8, 20.0, 4, n_faults=3)
+    assert len({e.replica for e in a}) == 3          # no double-kills
+    assert all(0.15 * 20.0 <= e.t <= 0.70 * 20.0 for e in a)
+    assert all(e.t <= n.t for e, n in zip(a, a[1:]))
+    with pytest.raises(ValueError):
+        serve_faults.draw_fault_plan(1, 20.0, 2, n_faults=3)
+    with pytest.raises(ValueError):
+        serve_faults.draw_fault_plan(1, 20.0, 4, kinds=['nope'])
+
+
+# --- circuit breaker --------------------------------------------------------
+
+def test_breaker_opens_after_consecutive_failures():
+    cb = failover_lib.CircuitBreaker(failure_threshold=3)
+    assert cb.note_failure('r0', now=0.0) is False
+    assert cb.note_failure('r0', now=1.0) is False
+    # A success in between resets the consecutive count.
+    cb.note_success('r0')
+    assert cb.note_failure('r0', now=2.0) is False
+    assert cb.note_failure('r0', now=3.0) is False
+    assert cb.note_failure('r0', now=4.0) is True    # threshold: opens
+    assert cb.is_open('r0')
+    assert cb.opens_total == 1
+    assert cb.routable(['r0', 'r1'], now=4.0) == ['r1']
+
+
+def test_breaker_half_open_probe_backoff_and_heal():
+    cb = failover_lib.CircuitBreaker(failure_threshold=1)
+    cb.note_failure('r0', now=0.0)
+    assert cb.is_open('r0')
+    # Probe gated on the backoff schedule (initial 0.5s, jitter 0).
+    assert not cb.probe_due('r0', now=0.4)
+    assert cb.probe_due('r0', now=0.5)
+    # Failed probe: stays open, delay grows (0.5 -> 1.0).
+    assert cb.note_failure('r0', now=0.5) is False
+    assert not cb.probe_due('r0', now=1.4)
+    assert cb.probe_due('r0', now=1.5)
+    # Successful probe closes the circuit and reports the heal.
+    assert cb.note_success('r0') is True
+    assert not cb.is_open('r0')
+    assert cb.routable(['r0'], now=1.6) == ['r0']
+
+
+def test_breaker_backpressure_cools_down_without_counting_failure():
+    cb = failover_lib.CircuitBreaker(failure_threshold=1)
+    cb.note_backpressure('r0', now=0.0, retry_after_s=2.0)
+    # Cooled down, NOT failed: excluded now, back after the advice,
+    # and the circuit never opened.
+    assert cb.routable(['r0'], now=1.0) == []
+    assert cb.routable(['r0'], now=2.0) == ['r0']
+    assert not cb.is_open('r0')
+    assert cb.opens_total == 0
+
+
+def test_breaker_forget_and_observe_members():
+    cb = failover_lib.CircuitBreaker(failure_threshold=1)
+    cb.note_failure('r0', now=0.0)
+    cb.forget('r0')
+    assert not cb.is_open('r0')          # state left with the replica
+    cb.note_failure('r1', now=0.0)
+    cb.observe_members(['r2'])
+    assert cb.snapshot() == {}
+
+
+# --- session journal --------------------------------------------------------
+
+def test_journal_exactly_once_replay_spec():
+    j = failover_lib.SessionJournal()
+    j.open('s', prompt=[1, 2, 3], max_new_tokens=10, replica='r0')
+    j.commit('s', [7, 8])
+    j.commit('s', [9])
+    spec = j.replay_spec('s')
+    # Resume at the first un-delivered token: prompt+committed as the
+    # new prompt, the un-delivered remainder as the new budget.
+    assert spec['prompt'] == [1, 2, 3, 7, 8, 9]
+    assert spec['max_new_tokens'] == 7
+    j.reassign('s', 'r1')
+    assert j.record('s').replica == 'r1'
+    assert j.record('s').failovers == 1
+    assert j.sessions_on('r0') == []
+    assert j.sessions_on('r1') == ['s']
+    # Budget exhausted -> nothing to replay (only the completion event
+    # was lost).
+    j.commit('s', [0] * 7)
+    assert j.replay_spec('s') is None
+    j.close('s')
+    assert j.sessions_on('r1') == []
+    with pytest.raises(ValueError):
+        j.commit('s', [1])
+    with pytest.raises(ValueError):
+        j.open('s', [1], 1, 'r0')
+
+
+# --- autoscaler: dead replicas are capacity to replace ----------------------
+
+def test_alive_capacity_excludes_terminal_and_draining():
+    replicas = [
+        {'replica_id': 1, 'status': ReplicaStatus.READY,
+         'launched_at': 1.0, 'is_spot': False},
+        {'replica_id': 2, 'status': ReplicaStatus.FAILED,
+         'launched_at': 2.0, 'is_spot': False},
+        {'replica_id': 3, 'status': ReplicaStatus.READY,
+         'launched_at': 3.0, 'is_spot': False, 'draining': True},
+    ]
+    alive = asc.alive_capacity(replicas)
+    assert [r['replica_id'] for r in alive] == [1]
+    # A fixed-size fleet of 3 with one dead and one draining must
+    # launch 2 replacements, not absorb the load on the survivor.
+    a = asc.Autoscaler.from_spec('svc', ServiceSpec(min_replicas=3))
+    ups = a.generate_scaling_decisions(replicas)
+    assert len(ups) == 2
+    assert all(d.operator is asc.AutoscalerDecisionOperator.SCALE_UP
+               for d in ups)
+
+
+# --- batcher failover hooks (tiny jax model) --------------------------------
+
+from skypilot_tpu.models import llama  # noqa: E402
+
+_CFG = llama.LlamaConfig(vocab_size=256, d_model=64, n_layers=2,
+                         n_heads=4, n_kv_heads=2, d_ff=128,
+                         max_seq_len=128, dtype=jnp.float32)
+
+
+@pytest.fixture(scope='module')
+def tiny_params():
+    import jax
+    return llama.init_params(_CFG, jax.random.PRNGKey(0))
+
+
+def _batcher(params, max_queue=None, decode_chunk=2, **kw):
+    from skypilot_tpu.infer.engine import GeneratorConfig
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    base = dict(max_seq_len=128, batch_size=2, temperature=0.0,
+                prompt_buckets=[16, 32])
+    base.update(kw)
+    return ContinuousBatcher(params, _CFG, GeneratorConfig(**base),
+                             decode_chunk=decode_chunk,
+                             max_queue=max_queue)
+
+
+def test_export_cancel_replay_bit_exact(tiny_params):
+    """The failover primitive: export mid-decode, cancel (blocks all
+    released), replay prompt+out elsewhere -> bit-exact vs unfaulted."""
+    ref_b = _batcher(tiny_params)
+    ref_rid = ref_b.submit([5, 6, 7], max_new_tokens=12)
+    ref_b.run_until_idle()
+    ref = ref_b.result(ref_rid)
+
+    victim = _batcher(tiny_params)
+    rid = victim.submit([5, 6, 7], max_new_tokens=12)
+    for _ in range(3):
+        victim.step()
+    spec = victim.export_session(rid)
+    assert not spec['done'] and 0 < len(spec['out']) < 12
+    got = victim.cancel(rid)
+    assert got == spec['out']
+    if victim.pooled:
+        victim.pool.check_invariant()    # fencing released every block
+    assert victim.num_active == 0 and victim.num_queued == 0
+
+    survivor = _batcher(tiny_params)
+    new_rid = survivor.submit(
+        spec['prompt'] + spec['out'],
+        max_new_tokens=spec['max_new_tokens'] - len(spec['out']))
+    survivor.run_until_idle()
+    assert spec['out'] + survivor.result(new_rid) == ref
+
+
+def test_drain_sessions_hands_off_cleanly(tiny_params):
+    b = _batcher(tiny_params)
+    r1 = b.submit([3, 4, 5], max_new_tokens=8)
+    r2 = b.submit([9, 10], max_new_tokens=8)
+    b.step()
+    specs = b.drain_sessions()
+    assert [s['rid'] for s in specs] == [r1, r2]
+    assert b.num_active == 0 and b.num_queued == 0
+    if b.pooled:
+        b.pool.check_invariant()
+
+
+def test_max_queue_backpressure_raises_retryable(tiny_params):
+    b = _batcher(tiny_params, batch_size=1, max_queue=1)
+    b.submit([1, 2], max_new_tokens=4)   # fills the admission queue
+    with pytest.raises(block_pool_lib.PoolExhaustedError) as ei:
+        b.submit([5, 6], max_new_tokens=4)
+    # Retryable: carries Retry-After advice for the 503 mapping.
+    assert ei.value.retry_after_s is not None
+    assert ei.value.retry_after_s >= 1.0
+    b.run_until_idle()
+
+
+# --- chaos fleet runs (module-shared, virtual time) -------------------------
+
+_TRAFFIC = dict(seed=11, duration_s=10.0, base_rps=8.0, num_sessions=8,
+                num_heads=6, head_tokens=64, session_share=0.85)
+_SIM = dict(num_replicas=3, batch_size=2, decode_chunk=4, slo_ttft_s=1.5,
+            prefill_cost_per_token_s=4e-3, prefix_cache_mb=0.25)
+
+_KILL_PREEMPT = [FaultEvent(t=3.5, kind='kill', replica=0),
+                 FaultEvent(t=5.5, kind='preempt', replica=1)]
+_STALL_PARTITION = [
+    FaultEvent(t=2.0, kind='stall', replica=0, duration_s=5.0),
+    FaultEvent(t=3.0, kind='partition', replica=1, duration_s=4.0)]
+
+
+def _run(policy, events=None):
+    chaos = None
+    if events is not None:
+        chaos = ChaosConfig(events=list(events))
+    sim = FleetSimulator(SimConfig(policy=policy, **_SIM),
+                         gen.TrafficConfig(**_TRAFFIC), chaos)
+    summary = sim.run()
+    return sim, summary
+
+
+@pytest.fixture(scope='module')
+def chaos_runs():
+    """Five runs on ONE contended trace: fault-free baselines for both
+    policies, kill+preempt twice (determinism), stall+partition once."""
+    base_sim, base = _run('least_load')
+    kp_sim, kp = _run('least_load', _KILL_PREEMPT)
+    _, kp2 = _run('least_load', _KILL_PREEMPT)
+    pa_sim, _ = _run('prefix_affinity')
+    sp_sim, sp = _run('prefix_affinity', _STALL_PARTITION)
+    return {
+        'base': base, 'base_outputs': base_sim.session_outputs(),
+        'kp': kp, 'kp_outputs': kp_sim.session_outputs(), 'kp2': kp2,
+        'kp_sim': kp_sim,
+        'pa_outputs': pa_sim.session_outputs(),
+        'sp': sp, 'sp_outputs': sp_sim.session_outputs(),
+        'sp_sim': sp_sim,
+    }
+
+
+def test_chaos_inert_when_config_absent(chaos_runs):
+    # The no-chaos path must not even report a chaos section — the
+    # parity contract with pre-chaos summaries.
+    assert 'chaos' not in chaos_runs['base']
+
+
+def test_kill_preempt_all_sessions_complete_bit_exact(chaos_runs):
+    base, kp = chaos_runs['base'], chaos_runs['kp']
+    # 100% of sessions completed despite losing 2 of 3 replicas...
+    assert kp['requests'] == base['requests'] > 0
+    assert kp['chaos']['sessions_lost'] == 0
+    # ...with zero lost/duplicated tokens: greedy replay is bit-exact
+    # against the fault-free run, session by session.
+    assert chaos_runs['kp_outputs'] == chaos_runs['base_outputs']
+    assert kp['chaos']['sessions_recovered'] > 0     # kill -> replayed
+    assert kp['chaos']['sessions_handed_off'] > 0    # preempt -> drained
+    assert kp['chaos']['circuit_opens'] == 1         # only the kill
+
+
+def test_kill_preempt_failover_metrics_reported(chaos_runs):
+    c = chaos_runs['kp']['chaos']
+    assert c['failover_p99_ms'] is not None
+    assert c['failover_p99_ms'] >= c['failover_p50_ms'] > 0
+    assert c['replayed_tokens'] >= 0
+    # BlockPool.check_invariant ran on every survivor at each fence.
+    assert c['invariant_checks'] > 0
+    kinds = [e['kind'] for e in c['faults'] if 'kind' in e]
+    assert kinds == ['kill', 'preempt']
+    assert any(e.get('event') == 'circuit_open' for e in c['faults'])
+
+
+def test_kill_removes_replica_preempt_drains(chaos_runs):
+    sim = chaos_runs['kp_sim']
+    assert [r.replica_id for r in sim.dead] == [0]       # killed
+    urls = {r.url for r in sim.replicas}
+    assert 'replica-0' not in urls
+    assert 'replica-1' not in urls                       # drained out
+    assert any(r.replica_id == 1 for r in sim.retired)
+
+
+def test_chaos_summary_deterministic(chaos_runs):
+    assert chaos_runs['kp'] == chaos_runs['kp2']
+
+
+def test_stall_partition_heal_and_bit_exact(chaos_runs):
+    sp = chaos_runs['sp']
+    # Transient faults: delayed delivery is fine, lost/duplicated is
+    # not — outputs still match the fault-free prefix_affinity run.
+    assert chaos_runs['sp_outputs'] == chaos_runs['pa_outputs']
+    assert sp['chaos']['sessions_lost'] == 0
+    # Both replicas healed and rejoined the ring.
+    heals = [e for e in sp['chaos']['faults']
+             if e.get('event') == 'heal']
+    assert len(heals) == 2
+    urls = {r.url for r in chaos_runs['sp_sim'].replicas}
+    assert {'replica-0', 'replica-1'} <= urls
+
+
+def test_autoscaler_replaces_killed_replica(monkeypatch):
+    # A fixed-size fleet of 2 loses one replica mid-trace: the dead
+    # replica reports FAILED (terminal) and the autoscaler launches a
+    # replacement instead of absorbing its load on the survivor.
+    # Decision cadence tightened so a decision lands inside the short
+    # virtual trace (still deterministic: virtual time, not wall).
+    monkeypatch.setattr(asc, 'DECISION_INTERVAL_SECONDS', 2)
+    traffic = gen.TrafficConfig(seed=3, duration_s=8.0, base_rps=3.0,
+                                num_sessions=4, num_heads=2)
+    sim = FleetSimulator(
+        SimConfig(policy='least_load', num_replicas=2, batch_size=2,
+                  decode_chunk=4, prefix_cache_mb=None),
+        traffic,
+        ChaosConfig(events=[FaultEvent(t=2.0, kind='kill', replica=0)]))
+    autoscaler = asc.Autoscaler.from_spec(
+        'sim', ServiceSpec(min_replicas=2))
+    summary = sim.run(autoscaler=autoscaler)
+    assert [r.replica_id for r in sim.dead] == [0]
+    assert summary['replicas'] == 2          # replacement launched
+    assert summary['chaos']['sessions_lost'] == 0
+    assert any(r.replica_id >= 2 for r in sim.replicas)

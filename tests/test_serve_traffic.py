@@ -62,6 +62,46 @@ def test_ring_leave_only_remaps_departed_keys():
             assert a == b   # survivors keep their arcs
 
 
+def test_ring_remove_member_matches_full_rebuild():
+    # The replica-death path: in-place removal must land every key
+    # exactly where a rebuild without the member would — the two code
+    # paths (death vs drain/resync) may never disagree on ownership.
+    members = [f'r{i}' for i in range(6)]
+    dead = hashring.ConsistentHashRing()
+    dead.set_members(members)
+    dead.remove_member('r2')
+    rebuilt = hashring.ConsistentHashRing()
+    rebuilt.set_members([m for m in members if m != 'r2'])
+    keys = [hashring.stable_hash(f'prompt-{i}') for i in range(1000)]
+    assert [dead.primary(k) for k in keys] == \
+        [rebuilt.primary(k) for k in keys]
+    assert dead.members == rebuilt.members
+    dead.remove_member('r2')            # unknown member: no-op
+    assert dead.members == rebuilt.members
+
+
+def test_ring_death_remap_bounded_and_affinity_recovers():
+    # Kill one of 6 members: only the departed arcs remap (~1/6 of
+    # keys), each to the next surviving vnode; when the replica heals
+    # and rejoins, every key returns to its original owner — the
+    # affinity-recovery property that keeps prefix caches warm across
+    # a kill + heal cycle.
+    ring = hashring.ConsistentHashRing()
+    members = [f'r{i}' for i in range(6)]
+    ring.set_members(members)
+    keys = [hashring.stable_hash(f'prompt-{i}') for i in range(2000)]
+    before = [ring.primary(k) for k in keys]
+    ring.remove_member('r3')
+    after = [ring.primary(k) for k in keys]
+    moved = sum(1 for b, a in zip(before, after) if b != a)
+    assert 0 < moved / len(keys) < 0.35     # bounded, not a reshuffle
+    for b, a in zip(before, after):
+        if b != 'r3':
+            assert a == b                   # survivors keep their arcs
+    ring.add_member('r3')
+    assert [ring.primary(k) for k in keys] == before
+
+
 # --- traffic generator ------------------------------------------------------
 
 def test_trace_seeded_and_sorted():

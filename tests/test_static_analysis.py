@@ -260,6 +260,68 @@ def test_bounded_recovery_loop_is_clean():
     """, path='jobs/controller.py')
 
 
+def test_replica_removal_without_cleanup():
+    """SKY304: dropping a replica from a membership collection on a
+    jobs/serve path without touching ring/health/breaker state in the
+    same function leaves the hashring routing at a dead URL."""
+    bad = """
+        class Fleet:
+            def kill(self, rep):
+                self.replicas.remove(rep)
+    """
+    assert 'SKY304' in codes(bad, path='serve/manager.py')
+    assert 'SKY304' in codes(bad, path='jobs/pool.py')
+    # Off the recovery paths: the rule stays quiet.
+    assert 'SKY304' not in codes(bad, path='infer/engine.py')
+    # pop / del forms are the same bug.
+    assert 'SKY304' in codes("""
+        def evict(replica_map, url):
+            replica_map.pop(url)
+    """, path='serve/manager.py')
+    assert 'SKY304' in codes("""
+        def evict(replicas_by_url, url):
+            del replicas_by_url[url]
+    """, path='serve/manager.py')
+
+
+def test_replica_removal_with_cleanup_is_clean():
+    # Ring/health/breaker teardown in the same function sanctions it.
+    assert 'SKY304' not in codes("""
+        class Fleet:
+            def kill(self, rep):
+                self.replicas.remove(rep)
+                self.ring.remove_member(rep.url)
+                self.breaker.forget(rep.url)
+    """, path='serve/manager.py')
+    # Delegating to the policy-sync helper counts too.
+    assert 'SKY304' not in codes("""
+        class Fleet:
+            def kill(self, rep):
+                self.replicas.remove(rep)
+                self._sync_policy()
+    """, path='serve/manager.py')
+    # Collections that aren't replica membership are not the rule's
+    # business; cleanup inside a nested def is its own scope and
+    # does NOT sanction the outer removal.
+    assert 'SKY304' not in codes("""
+        def trim(queue):
+            queue.pop(0)
+    """, path='serve/manager.py')
+    assert 'SKY304' in codes("""
+        class Fleet:
+            def kill(self, rep):
+                self.replicas.remove(rep)
+                def later():
+                    self.ring.remove_member(rep.url)
+    """, path='serve/manager.py')
+    # The explicit allow marker works for sanctioned sites.
+    assert 'SKY304' not in codes("""
+        class Fleet:
+            def kill(self, rep):
+                self.replicas.remove(rep)  # skytpu-allow: SKY304
+    """, path='serve/manager.py')
+
+
 def test_inline_allow_suppresses():
     assert codes("""
         import jax
