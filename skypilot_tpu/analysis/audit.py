@@ -586,6 +586,82 @@ def audit_spec_decode() -> Dict[str, Any]:
             'buckets': ['arena']}
 
 
+def audit_fused_step() -> Dict[str, Any]:
+    """Chunked-prefill piggyback budgets (infer/serving.py): the fused
+    prefill+decode program pads its prefill lane to a FIXED fuse_budget
+    width, so across a mixed-length all-greedy run its jit cache must
+    stay within the same <= 2 family the plain decode chunk gets (the
+    (n, all_greedy, nucleus) variants alone — the ROADMAP acceptance
+    hook for the piggyback scheduler).  The pool arena must be donated
+    through the fused chunk, and the traced graph must be
+    callback-free and f64-free."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.infer import block_pool as block_pool_lib
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+
+    config = _tiny_config()
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    gen_config = _tiny_gen_config(batch_size=4, prompt_buckets=[8, 32],
+                                  prefill_chunk=8, fuse_budget=6)
+    batcher = ContinuousBatcher(params, config, gen_config,
+                                decode_chunk=4)
+    checks: List[Dict[str, str]] = []
+
+    # Short prompts fill decode slots first; the long prompt then rides
+    # the incremental lane, so its windows piggyback on their chunks.
+    for prompt in _AUDIT_PROMPTS:
+        batcher.submit(list(prompt), max_new_tokens=_AUDIT_MAX_NEW)
+    batcher.submit(list(range(2, 26)), max_new_tokens=8)
+    batcher.run_until_idle()
+    fused_steps = batcher._fuse_policy.stats.steps
+    checks.append(_check(
+        'fused_steps_ran', 'ok' if fused_steps > 0 else 'fail',
+        f'{fused_steps} fused steps during the mixed-length run (the '
+        f'piggyback gate must engage, or the audit pins nothing)'))
+    compiles = batcher._fused._cache_size()
+    checks.append(_check(
+        'fused_compile_budget',
+        'ok' if compiles <= 2 else 'fail',
+        f'{compiles} fused-step compiles for a budget of 2 (fixed '
+        f'fuse_budget padding keys the shape off (n, all_greedy, '
+        f'nucleus) alone; all-greedy run)'))
+
+    # Arena donation through the fused chunk: prefill scatter + n
+    # decode iterations write the arena in place — a dropped donation
+    # would copy the dominant serving buffer every fused tick.
+    batch = gen_config.batch_size
+    arena = block_pool_lib.init_arena(
+        config, batcher.pool.n_blocks, batcher.pool.block_size,
+        kv_dtype=gen_config.kv_cache_dtype)
+    args = (batcher.params,
+            jnp.zeros((batch,), jnp.int32),
+            arena,
+            jnp.zeros((batch,), jnp.int32),
+            jnp.zeros((batch,), bool),
+            jnp.full((batch,), 8, jnp.int32),
+            jnp.zeros((batch,), jnp.float32),
+            jnp.ones((batch,), jnp.float32), jax.random.PRNGKey(0),
+            jnp.zeros((batch, batcher.table_width), jnp.int32),
+            jnp.zeros((gen_config.fuse_budget,), jnp.int32),
+            jnp.zeros((batcher.table_width,), jnp.int32),
+            jnp.int32(0))
+    lowered = batcher._fused.lower(*args, n=4, all_greedy=True,
+                                   nucleus=False)
+    checks.append(_donation_check(lowered.as_text(),
+                                  'pool arena (fused step)'))
+
+    impl = functools.partial(batcher._fused_impl, n=4, all_greedy=True,
+                             nucleus=False, top_k=None, eos=None)
+    jaxpr = jax.make_jaxpr(impl)(*args)
+    checks.extend(_jaxpr_dtype_and_callback_checks(jaxpr))
+    checks.append(_sharding_check(batcher.mesh))
+    return {'entry': 'fused_step', 'checks': checks,
+            'compiles': compiles, 'fused_steps': fused_steps,
+            'buckets': ['arena']}
+
+
 def audit_trainer_step() -> Dict[str, Any]:
     """Train step: params + opt state donated (the fit loop's steady
     state must not double its HBM residency), callback-free, f64-free."""
@@ -886,6 +962,7 @@ REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'prefix_cache': audit_prefix_cache,
     'block_pool': audit_block_pool,
     'spec_decode': audit_spec_decode,
+    'fused_step': audit_fused_step,
     'mesh_decode': audit_mesh_decode,
     'trainer_step': audit_trainer_step,
     'ckpt_reshard': audit_ckpt_reshard,

@@ -112,6 +112,7 @@ DATA_PLANE_MODULES = (
     'infer/prefix_cache.py',
     'infer/block_pool.py',
     'infer/spec_decode.py',
+    'infer/fuse.py',
 )
 
 # SKY202's sanctioned home: the bounded-backoff helper is ALLOWED to

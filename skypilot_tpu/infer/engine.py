@@ -123,8 +123,35 @@ class GeneratorConfig:
     # temperature > 0 the rejection-sampling accept preserves the
     # target distribution.  Requires decode_impl='pooled'.
     spec_k: int = 0
+    # Chunked-prefill piggyback (ContinuousBatcher, pooled plane):
+    # total token columns of a fused step's FIRST forward — each active
+    # decode slot contributes its single-token column and the in-flight
+    # chunked prompt contributes up to (fuse_budget - active) prompt
+    # tokens, so a burst of long cold prompts rides the decode steps
+    # instead of stealing whole ticks from them (Sarathi-style hybrid
+    # batching).  The chunk lane is padded to exactly fuse_budget wide,
+    # so the fused program is ONE extra compiled shape.  Requires the
+    # pooled data plane and prefill_chunk (the incremental prefill lane
+    # it piggybacks).  None = off: dedicated prefill windows.
+    fuse_budget: Optional[int] = None
 
     def __post_init__(self):
+        if self.fuse_budget is not None:
+            if self.fuse_budget < 1:
+                raise ValueError(f'fuse_budget must be >= 1, got '
+                                 f'{self.fuse_budget}')
+            if self.decode_impl != 'pooled':
+                raise ValueError(
+                    f"fuse_budget={self.fuse_budget} requires the "
+                    f"pooled data plane (decode_impl='pooled'); the "
+                    f"legacy '{self.decode_impl}' plane has no fused "
+                    f'prefill+decode path')
+            if self.prefill_chunk is None:
+                raise ValueError(
+                    f'fuse_budget={self.fuse_budget} piggybacks the '
+                    f'chunked-prefill lane; set prefill_chunk (the '
+                    f'threshold above which prompts prefill '
+                    f'incrementally) to enable it')
         if self.spec_k < 0:
             raise ValueError(f'spec_k must be >= 0, got {self.spec_k}')
         if self.spec_k and self.decode_impl != 'pooled':

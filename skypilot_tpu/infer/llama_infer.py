@@ -853,6 +853,183 @@ def decode_step_pooled(params: llama.Params, token: jax.Array,
     return logits, cache
 
 
+def fused_step_pooled(params: llama.Params, token: jax.Array,
+                      config: llama.LlamaConfig, cache: Cache,
+                      positions: jax.Array, tables: jax.Array,
+                      pf_tokens: jax.Array, pf_table_row: jax.Array,
+                      pf_start: jax.Array, mesh=None
+                      ) -> Tuple[jax.Array, jax.Array, Cache]:
+    """Fused prefill+decode step over the pooled arena (chunked-prefill
+    piggyback): ONE forward carries the decode batch's single-token
+    columns AND a fixed-width chunk of an in-flight prompt.
+
+    token (B,) / positions (B,) / tables (B, T): exactly
+    :func:`decode_step_pooled`'s decode contract.
+    pf_tokens (F,): the piggybacked prompt chunk (F is static — the
+    batcher pads every chunk to its fuse budget so the fused program
+    compiles once).  pf_table_row (T,): the prefill slot's block table
+    row; pf_start: int32 scalar — the chunk's first cache row.  Pad
+    tokens beyond the real chunk land at rows >= the true end: their
+    K/V go through the same table routing (garbage block 0 when past
+    the table) but sit above every later query's `slot <= position`
+    mask until the next real chunk overwrites them — the same
+    invisibility argument as prefill_window_pooled's pad rows.
+
+    All B+F rows run one _qkv/rope/scatter per layer; the read side
+    keeps the two populations' exact unfused numerics — decode rows
+    take the single-token path (kernel or raw-int8 gather with
+    scale-after-dot), prefill rows take the chunked-window path (kernel
+    window lane or dequantize-then-dot) — so greedy decode output and
+    the chunk's hidden states are both bit-identical to the dedicated
+    two-step schedule (tested).  The prefill lane samples nothing: its
+    post-final-norm hidden states are returned for the batcher to run
+    `_install_first` on when the LAST chunk lands.
+
+    Returns (decode logits (B, vocab) f32, chunk hiddens (F, d), cache).
+    """
+    batch = token.shape[0]
+    fuse = pf_tokens.shape[0]
+    bs = cache['k'].shape[2]
+    t_width = tables.shape[1]
+    s_len = t_width * bs
+    cos, sin = rope_ops.rope_frequencies(
+        config.head_dim, s_len, config.rope_theta,
+        scaling=config.rope_scaling_dict)
+    all_tokens = jnp.concatenate([token, pf_tokens])
+    h = llama.embed_tokens(params, all_tokens, config)[:, None]
+    pf_pos = (jnp.asarray(pf_start, jnp.int32)
+              + jnp.arange(fuse, dtype=jnp.int32))           # (F,)
+    pos_full = jnp.concatenate([positions.astype(jnp.int32), pf_pos])
+    pos = pos_full[:, None]                                  # (B+F, 1)
+    slot = jnp.arange(s_len)[None, :]
+    dec_visible = slot <= positions[:, None].astype(jnp.int32)
+    pf_visible = slot <= pf_pos[:, None]                     # (F, S')
+    quantized = 'k_scale' in cache
+    b_idx = jnp.arange(batch)
+    group = config.n_heads // config.n_kv_heads
+    scale = config.head_dim ** -0.5
+    use_kernel = (jax.default_backend() == 'tpu'
+                  and config.head_dim % 128 == 0)
+    # Scatter targets for all B+F rows, hoisted out of the layer loop:
+    # decode rows through their tables, chunk rows through the prefill
+    # slot's row (out-of-table pad rows -> garbage block 0).
+    dec_blk = tables[b_idx, positions.astype(jnp.int32) // bs]
+    pf_blk_idx = pf_pos // bs
+    pf_blk = jnp.where(pf_blk_idx >= t_width, 0,
+                       pf_table_row[jnp.minimum(pf_blk_idx,
+                                                t_width - 1)])
+    blk = jnp.concatenate([dec_blk, pf_blk])                 # (B+F,)
+    off = pos_full % bs                                      # (B+F,)
+
+    def body(i, carry):
+        h, cache = carry
+        layer_params = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
+                                                   keepdims=False),
+            params['layers'])
+        attn_p = layer_params['attn']
+        x = rmsnorm_ops.rms_norm(h, layer_params['ln1'],
+                                 eps=config.norm_eps)
+        q, k, v = _qkv(x, attn_p, config)        # (B+F, 1, H/KV, hd)
+        q = rope_ops.apply_rope(q, cos, sin, positions=pos)
+        k = rope_ops.apply_rope(k, cos, sin, positions=pos)
+        if quantized:
+            k_row, k_s_row = _quantize_kv(k[:, 0])
+            v_row, v_s_row = _quantize_kv(v[:, 0])
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k_row),
+                v=cache['v'].at[i, blk, off].set(v_row),
+                k_scale=cache['k_scale'].at[i, blk, off].set(k_s_row),
+                v_scale=cache['v_scale'].at[i, blk, off].set(v_s_row))
+        else:
+            cache = dict(
+                cache,
+                k=cache['k'].at[i, blk, off].set(k[:, 0]),
+                v=cache['v'].at[i, blk, off].set(v[:, 0]))
+        if use_kernel:
+            q_dec = q[:batch, 0].reshape(batch, config.n_kv_heads,
+                                         group, config.head_dim)
+            q_pf = q[batch:, 0].reshape(fuse, config.n_kv_heads,
+                                        group, config.head_dim)
+            o_dec, o_pf = decode_attention_ops.fused_step_attention_pooled(
+                q_dec, q_pf, cache['k'], cache['v'], tables,
+                pf_table_row, i, positions.astype(jnp.int32),
+                jnp.asarray(pf_start, jnp.int32),
+                cache.get('k_scale'), cache.get('v_scale'), mesh=mesh)
+            o = jnp.concatenate([o_dec, o_pf])
+            h = h + quant.matmul(o.reshape(batch + fuse, 1, -1),
+                                 attn_p['wo'])
+            x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
+                                     eps=config.norm_eps)
+            h = h + _ffn(x, layer_params, config)
+        else:
+            k_layer = jax.lax.dynamic_index_in_dim(cache['k'], i, 0,
+                                                   False)
+            v_layer = jax.lax.dynamic_index_in_dim(cache['v'], i, 0,
+                                                   False)
+            # Decode rows: the single-token read path of
+            # decode_step_pooled (raw int8 + scale-after-dot).
+            k_eff = k_layer[tables].reshape(
+                batch, s_len, config.n_kv_heads, config.head_dim)
+            v_eff = v_layer[tables].reshape(
+                batch, s_len, config.n_kv_heads, config.head_dim)
+            if quantized:
+                ks_layer = jax.lax.dynamic_index_in_dim(
+                    cache['k_scale'], i, 0, False)
+                vs_layer = jax.lax.dynamic_index_in_dim(
+                    cache['v_scale'], i, 0, False)
+                k_s = ks_layer[tables].reshape(
+                    batch, s_len, config.n_kv_heads)
+                v_s = vs_layer[tables].reshape(
+                    batch, s_len, config.n_kv_heads)
+            else:
+                k_s = v_s = None
+            h_dec = _token_attn_mlp(h[:batch], layer_params, q[:batch],
+                                    k_eff, v_eff, dec_visible, config,
+                                    k_scale=k_s, v_scale=v_s)
+            # Prefill rows: the chunked-window read path of
+            # prefill_window_pooled (dequantize-then-dot) — keeping
+            # each lane's unfused numerics is what makes the fused
+            # schedule bit-exact against the dedicated one.
+            if quantized:
+                k_slot = _dequantize(
+                    k_layer[pf_table_row].reshape(
+                        s_len, config.n_kv_heads, config.head_dim),
+                    ks_layer[pf_table_row].reshape(
+                        s_len, config.n_kv_heads), q.dtype)
+                v_slot = _dequantize(
+                    v_layer[pf_table_row].reshape(
+                        s_len, config.n_kv_heads, config.head_dim),
+                    vs_layer[pf_table_row].reshape(
+                        s_len, config.n_kv_heads), q.dtype)
+            else:
+                k_slot = k_layer[pf_table_row].reshape(
+                    s_len, config.n_kv_heads, config.head_dim)
+                v_slot = v_layer[pf_table_row].reshape(
+                    s_len, config.n_kv_heads, config.head_dim)
+            q_g = q[batch:, 0].reshape(fuse, config.n_kv_heads, group,
+                                       config.head_dim)
+            s = jnp.einsum('wkgd,skd->kgws', q_g, k_slot,
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(pf_visible[None, None, :, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+            o = jnp.einsum('kgws,skd->wkgd', p, v_slot)
+            h_pf = h[batch:] + quant.matmul(
+                o.reshape(fuse, 1, -1), attn_p['wo'])
+            x_pf = rmsnorm_ops.rms_norm(h_pf, layer_params['ln2'],
+                                        eps=config.norm_eps)
+            h_pf = h_pf + _ffn(x_pf, layer_params, config)
+            h = jnp.concatenate([h_dec, h_pf])
+        return (h, cache)
+
+    h, cache = jax.lax.fori_loop(0, config.n_layers, body, (h, cache))
+    h = rmsnorm_ops.rms_norm(h, params['final_norm'], eps=config.norm_eps)
+    logits = quant.matmul(h[:batch, 0], params['lm_head'],
+                          out_dtype=jnp.float32)
+    return logits, h[batch:, 0], cache
+
+
 def decode_verify_pooled(params: llama.Params, tokens: jax.Array,
                          config: llama.LlamaConfig, cache: Cache,
                          positions: jax.Array, tables: jax.Array,

@@ -51,6 +51,7 @@ import numpy as np
 
 from skypilot_tpu.infer import block_pool as block_pool_lib
 from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import fuse as fuse_lib
 from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
 from skypilot_tpu.infer import spec_decode as spec_decode_lib
 from skypilot_tpu.infer import tp as tp_lib
@@ -73,6 +74,9 @@ class _Request:
     # Chunked prefill: tokens of the prompt already written to the
     # slot cache (0 while queued; == len(prompt) when ready to decode).
     prefill_pos: int = 0
+    # Fused steps that carried one of this prompt's chunks (piggyback
+    # path); 0 means every window ran as a dedicated prefill step.
+    fused_chunks: int = 0
     # Wall time of submit(); admission observes the queue wait.
     submitted_at: float = 0.0
 
@@ -297,6 +301,24 @@ class ContinuousBatcher:
                 eos=gen_config.eos_token),
                 donate_argnums=(2,),
                 static_argnames=('all_greedy', 'nucleus'))
+        # Chunked-prefill piggyback (gen_config.fuse_budget, pooled
+        # only — __post_init__ enforces the pairing): while a long
+        # prompt's chunked prefill is in flight AND slots are decoding,
+        # the tick dispatches ONE fused program whose first forward
+        # carries the decode token columns plus a FIXED fuse_budget-wide
+        # prefill lane (real chunk padded to that width), then n-1 plain
+        # decode iterations — so the decode batch still advances
+        # decode_chunk tokens per tick and the compiled-shape family
+        # stays the (n, all_greedy, nucleus) variants, same as _decode.
+        self._fuse_policy = None
+        if self.pooled and gen_config.fuse_budget:
+            self._fuse_policy = fuse_lib.FusePolicy(
+                gen_config.fuse_budget)
+            self._fused = jax.jit(functools.partial(
+                self._fused_impl, top_k=gen_config.top_k,
+                eos=gen_config.eos_token),
+                donate_argnums=(2,),
+                static_argnames=('n', 'all_greedy', 'nucleus'))
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
@@ -441,6 +463,84 @@ class ContinuousBatcher:
             return tp_lib.replicate(x, self.mesh)
         return (rep(jnp.swapaxes(toks, 0, 1)), token, cache,
                 rep(positions), rep(done), limit, rng)
+
+    def _fused_impl(self, params, token, cache, positions, done, limit,
+                    temp_row, top_p_row, rng, tables, pf_tokens,
+                    pf_table_row, pf_start, *, n, all_greedy, nucleus,
+                    top_k, eos):
+        """Fused prefill+decode chunk: iteration 0 is ONE forward over
+        the decode slots' token columns PLUS a fuse_budget-wide prefill
+        lane (the in-flight prompt's next chunk, zero-padded to the
+        fixed width) — prefill tokens scatter K/V into their slot's
+        pool blocks while decode rows gather through their tables;
+        iterations 1..n-1 are the plain lockstep decode body, so the
+        chunk commits exactly decode_chunk tokens like _decode_impl.
+        Decode-row semantics are BIT-EXACT vs _decode_impl: the rng
+        split sequence, sampler, and freeze/EOS/budget updates are the
+        same code, and the prefill lane touches only the incremental
+        slot's blocks (which no decode row's table references).  The
+        prefill lane samples NOTHING — its last-chunk hiddens ride back
+        for _complete_prefill's _install_first, same as a dedicated
+        window."""
+        batch = token.shape[0]
+        fill = jnp.int32(eos if eos is not None else 0)
+
+        def commit(i, sub, logits, token, positions, done, limit,
+                   toks):
+            # Verbatim _decode_impl per-iteration commit: sample, emit
+            # fill on frozen rows, budget/EOS tracking, freeze.
+            if all_greedy:
+                nxt = sampling.sample_logits(logits, sub,
+                                             temperature=0.0)
+            else:
+                nxt = sampling.sample_logits_batched(
+                    logits, sub, temp_row, top_p_row, top_k=top_k,
+                    nucleus=nucleus)
+            live = jnp.logical_not(done)
+            emit = jnp.where(live, nxt, fill)
+            limit = limit - live.astype(jnp.int32)
+            hit_eos = ((nxt == eos) if eos is not None
+                       else jnp.zeros_like(done))
+            done = done | (live & (hit_eos | (limit <= 0)))
+            positions = positions + live.astype(jnp.int32)
+            token = jnp.where(live, nxt, token)
+            toks = toks.at[i].set(emit)
+            return token, positions, done, limit, toks
+
+        toks = jnp.zeros((n, batch), jnp.int32)
+        # Iteration 0 — the fused forward.  rng splits BEFORE the
+        # forward exactly as _decode_impl's body does; the split
+        # sequence depends only on rng, so the decode rows' sampling
+        # stream is identical to the unfused chunk's.
+        rng, sub = jax.random.split(rng)
+        logits, h_pf, cache = llama_infer.fused_step_pooled(
+            params, token, self.config, cache, positions, tables,
+            pf_tokens, pf_table_row, pf_start, mesh=self.mesh)
+        token, positions, done, limit, toks = commit(
+            0, sub, logits, token, positions, done, limit, toks)
+
+        def body(i, carry):
+            token, cache, positions, done, limit, rng, toks = carry
+            rng, sub = jax.random.split(rng)
+            logits, cache = llama_infer.decode_step_pooled(
+                params, token, self.config, cache, positions, tables,
+                mesh=self.mesh)
+            token, positions, done, limit, toks = commit(
+                i, sub, logits, token, positions, done, limit, toks)
+            return (token, cache, positions, done, limit, rng, toks)
+
+        token, cache, positions, done, limit, rng, toks = \
+            jax.lax.fori_loop(
+                1, n, body,
+                (token, cache, positions, done, limit, rng, toks))
+        cache = tp_lib.constrain_cache(cache, self.mesh)
+
+        def rep(x):
+            return tp_lib.replicate(x, self.mesh)
+        # h_pf is NOT replicated — it feeds _install_first exactly like
+        # _prefill_window's hiddens do.
+        return (rep(jnp.swapaxes(toks, 0, 1)), token, cache,
+                rep(positions), rep(done), limit, rng, h_pf)
 
     def _verify_impl(self, params, token, cache, positions, done, limit,
                      temp_row, top_p_row, rng, tables, draft, *,
@@ -1137,6 +1237,12 @@ class ContinuousBatcher:
         # scheduler needs on host to test EOS/limit before promotion.
         (first_host,) = engine_lib.host_fetch(first)
         req.out.append(int(first_host))
+        if req.submitted_at:
+            # TTFT split cold-vs-fused: did any of this prompt's
+            # windows piggyback on a decode chunk?
+            telemetry_metrics.INFER_FUSE_TTFT.labels(
+                mode=('fused' if req.fused_chunks else 'cold')
+            ).observe(time.perf_counter() - req.submitted_at)
         if self._drafter is not None:
             cont = (self._prefix.cached_continuation(
                 req.prompt, self.gen.max_seq_len)
@@ -1237,6 +1343,126 @@ class ContinuousBatcher:
             self._prefix.insert(req.prompt, functools.partial(
                 self._prefix.extract, self._cache, req.slot))
 
+    def _step_fused(self, n: int) -> None:
+        """One fused prefill+decode chunk (pooled, fuse_budget set, an
+        incremental prefill in flight AND slots decoding): the decode
+        batch advances n tokens with step()'s exact semantics while the
+        fused program's first forward also carries one chunk of the
+        in-flight prompt, sized by the leftover-budget policy and
+        padded to the fixed fuse_budget width (pad rows scatter K/V at
+        positions past the chunk's end — rows the visibility masks hide
+        and the next chunk overwrites, so they are never attended).
+        Still ONE counted host sync for the chunk; the final chunk adds
+        _complete_prefill's counted first-token fetch, exactly like a
+        dedicated final window."""
+        req = self._incremental
+        start = req.prefill_pos
+        fb = self.gen.fuse_budget
+        chunk = self._fuse_policy.chunk(len(req.prompt) - start,
+                                        len(self._active))
+        end = start + chunk
+        window = np.zeros((fb,), np.int32)
+        window[:chunk] = np.asarray(req.prompt[start:end], np.int32)
+        prev_pos = ({s: int(self._host_pos[s]) for s in self._active}
+                    if self._drafter is not None else None)
+        self._ensure_slot_blocks(n)
+        if self._tables_dirty:
+            self._tables_dev = jnp.asarray(self._host_tables)
+            self._tables_dirty = False
+        all_greedy = not any(
+            float(self._host_temp[s]) > 0.0 for s in self._active)
+        nucleus = any(
+            float(self._host_top_p[s]) < 1.0 for s in self._active)
+        active_slots = len(self._active)
+        chunk_start = time.perf_counter()
+        try:
+            (toks, self._token, self._cache, self._positions,
+             self._done, self._limit, self._rng, h_pf) = self._fused(
+                self.params, self._token, self._cache, self._positions,
+                self._done, self._limit, self._temp_row,
+                self._top_p_row, self._rng, self._tables_dev,
+                jnp.asarray(window),
+                jnp.asarray(self._host_tables[req.slot]),
+                jnp.int32(start), n=n, all_greedy=all_greedy,
+                nucleus=nucleus)
+        except Exception:
+            # _advance_prefill's abort contract: a failed dispatch must
+            # not leak the slot or leave _incremental set (restart from
+            # zero on re-queue — the slot's blocks are rewritten
+            # wholesale anyway).  NOTE the decode rows also rode this
+            # dispatch; the driver treats an engine error as a replica
+            # fault either way (serve/chaos handles failover).
+            self._incremental = None
+            req.prefill_pos = 0
+            self._pool_free_slot(req.slot)
+            self._free.insert(0, req.slot)
+            req.slot = None
+            self._queue.insert(0, req)
+            raise
+        # The arena was donated through the fused chunk: rebind the
+        # pool's handle before anything else can observe it.
+        self.pool.arena = self._cache
+        # ONE transfer for the whole fused chunk — identical budget to
+        # the plain decode tick.
+        host, host_pos, _ = engine_lib.host_fetch(
+            toks, self._positions, self._done)
+        self._host_pos = host_pos.astype(np.int64)
+        if prev_pos is not None:
+            for slot in list(self._active):
+                delta = int(self._host_pos[slot]) - prev_pos[slot]
+                if delta > 0:
+                    self._drafter.observe(
+                        slot, [int(t) for t in host[slot, :delta]])
+        chunk_dt = time.perf_counter() - chunk_start
+        req.prefill_pos = end
+        req.fused_chunks += 1
+        self._fuse_policy.record_fused(chunk)
+        telemetry_metrics.INFER_FUSE_STEPS.inc()
+        telemetry_metrics.INFER_FUSE_PREFILL_TOKENS.inc(chunk)
+        telemetry_metrics.INFER_FUSE_BUDGET_UTILIZATION.set(
+            self._fuse_policy.utilization(chunk))
+        telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(chunk_dt)
+        telemetry_metrics.INFER_DECODE_BUCKET_CHUNKS.labels(
+            bucket=str(self._cache_len)).inc()
+        telemetry_metrics.INFER_DECODE_CACHE_ROWS.set(self._cache_len)
+        if chunk_dt > 0:
+            telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
+                n * active_slots / chunk_dt)
+        eos = self.gen.eos_token
+        appended = 0
+        for slot, r in list(self._active.items()):
+            for t in host[slot]:
+                r.out.append(int(t))
+                appended += 1
+                if (eos is not None and r.out[-1] == eos) or \
+                        len(r.out) >= r.max_new_tokens:
+                    self._finish(r)
+                    break
+        telemetry_metrics.INFER_GENERATED_TOKENS.inc(appended)
+        telemetry_metrics.INFER_HOST_SYNCS_PER_TOKEN.set(
+            1.0 / max(appended, 1))
+        telemetry_metrics.INFER_SLOT_OCCUPANCY.set(
+            len(self._active) / self.gen.batch_size)
+        if end < len(req.prompt):
+            return
+        # Final chunk: the prompt's last token rode the fused lane —
+        # sample the first token off its hidden row and promote,
+        # exactly as a dedicated final window would.
+        try:
+            if self._prefix is not None:
+                self._prefix.insert(req.prompt,
+                                    blocks=self._slot_blocks[req.slot])
+            self._complete_prefill(req, h_pf, start)
+        except Exception:
+            self._incremental = None
+            req.prefill_pos = 0
+            self._pool_free_slot(req.slot)
+            self._free.insert(0, req.slot)
+            req.slot = None
+            self._queue.insert(0, req)
+            raise
+        self._incremental = None
+
     def _step_spec(self) -> None:
         """One draft-verify chunk over all active slots: the host
         drafter proposes spec_k tokens per slot (zero device work), one
@@ -1320,10 +1546,25 @@ class ContinuousBatcher:
 
     def step(self) -> None:
         """One scheduler tick: admit queued requests, advance the
-        in-flight chunked prefill by one window, then one decode chunk
-        for all active slots."""
+        in-flight chunked prefill by one window (or piggyback it onto
+        the decode chunk when fusing is on), then one decode chunk for
+        all active slots."""
         self._admit()
-        self._advance_prefill()
+        # Fuse gate: an in-flight chunked prefill AND a live decode
+        # batch to piggyback on.  With no decode batch, a dedicated
+        # window is strictly better (no padded decode rows to carry);
+        # fused ticks also SUPPRESS speculation — while a cold prompt
+        # is in flight, TTFT is the binding metric, and a verify window
+        # cannot carry the prefill lane.  Speculation resumes the tick
+        # after the prefill completes.
+        fused = (self._fuse_policy is not None
+                 and self._incremental is not None
+                 and bool(self._active))
+        if not fused:
+            if self._fuse_policy is not None and \
+                    self._incremental is not None:
+                self._fuse_policy.record_dedicated()
+            self._advance_prefill()
         if not self._active:
             telemetry_metrics.INFER_SLOT_OCCUPANCY.set(0.0)
             return
@@ -1332,12 +1573,15 @@ class ContinuousBatcher:
         # self._positions here would force one blocking device→host
         # transfer per tick on the serving hot path.
         live_max = max(int(self._host_pos[s]) for s in self._active)
-        if self._drafter is not None and \
+        if not fused and self._drafter is not None and \
                 live_max + self.gen.spec_k + 1 <= self.gen.max_seq_len \
                 and self._spec_policy.should_speculate():
             self._step_spec()
             return
         n = max(1, min(n, self.gen.max_seq_len - live_max))
+        if fused:
+            self._step_fused(n)
+            return
         prev_pos = ({s: int(self._host_pos[s]) for s in self._active}
                     if self._drafter is not None else None)
         if self.pooled:

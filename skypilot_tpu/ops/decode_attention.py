@@ -464,6 +464,89 @@ def decode_window_attention_pooled(q: jax.Array, k_arena: jax.Array,
         (0, 2, 1, 3, 4))
 
 
+def fused_step_attention_pooled(q_dec: jax.Array, q_pf: jax.Array,
+                                k_arena: jax.Array, v_arena: jax.Array,
+                                tables: jax.Array,
+                                pf_table_row: jax.Array,
+                                layer: jax.Array, positions: jax.Array,
+                                pf_start: jax.Array,
+                                k_scale: Optional[jax.Array] = None,
+                                v_scale: Optional[jax.Array] = None,
+                                *, interpret: Optional[bool] = None,
+                                mesh=None
+                                ) -> Tuple[jax.Array, jax.Array]:
+    """Attention for the fused prefill+decode step.
+
+    One batcher step carries two query populations against the SAME
+    pooled arena (the caller has already scattered this step's K/V for
+    both):
+
+    q_dec: (B, KV, G, hd) — the decoding slots' single-token queries,
+       exactly :func:`decode_attention_pooled`'s contract (positions
+       (B,) is each slot's current cache row, tables (B, T) its block
+       table).
+    q_pf: (F, KV, G, hd) — up to `fuse_budget` piggybacked prefill
+       queries of ONE chunked prompt at consecutive cache rows
+       pf_start .. pf_start+F-1, gathering through that slot's single
+       table row pf_table_row (T,).  pf_start: int32 scalar.
+
+    The prefill lane is the PR 9 window kernel wearing a different hat:
+    a chunk of F consecutive prompt positions has exactly the verify
+    window's visibility (`index <= pf_start + f`), so it rides
+    :func:`decode_window_attention_pooled` as one batch row with
+    window=F — the chunk's KV stream is DMA'd once for all F queries
+    instead of re-gathered per token, which is where the fused step's
+    bandwidth win over F sequential steps comes from.  No new kernel
+    math is introduced; both lanes reuse the audited online-softmax
+    body.
+
+    Under a dp-sharded mesh the single prefill lane is replicated
+    across dp rows (each dp shard computes the same small window; row 0
+    is kept) — the lane is one slot and cannot be split like the decode
+    batch.
+
+    Returns (o_dec (B, KV, G, hd), o_pf (F, KV, G, hd)) in q dtype.
+    """
+    o_dec = decode_attention_pooled(
+        q_dec, k_arena, v_arena, tables, layer, positions,
+        k_scale, v_scale, interpret=interpret, mesh=mesh)
+    fuse = q_pf.shape[0]
+    t_width = pf_table_row.shape[0]
+    q_w = q_pf[None]                             # (1, F, KV, G, hd)
+    tbl_w = pf_table_row[None].astype(jnp.int32)
+    pos_w = jnp.asarray(pf_start, jnp.int32).reshape(1)
+    dp = 1
+    if mesh is not None and mesh.size > 1:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        dp = sizes.get('dp', 1)
+        if dp > 1:
+            q_w = jnp.broadcast_to(q_w, (dp, fuse) + q_pf.shape[1:])
+            tbl_w = jnp.broadcast_to(tbl_w, (dp, t_width))
+            pos_w = jnp.broadcast_to(pos_w, (dp,))
+    o_pf = decode_window_attention_pooled(
+        q_w, k_arena, v_arena, tbl_w, layer, pos_w,
+        k_scale, v_scale, interpret=interpret, mesh=mesh)
+    return o_dec, o_pf[0]
+
+
+def reference_fused_step_attention(q_dec: jax.Array, k_dec: jax.Array,
+                                   v_dec: jax.Array,
+                                   positions: jax.Array,
+                                   q_pf: jax.Array, k_pf: jax.Array,
+                                   v_pf: jax.Array, pf_start
+                                   ) -> Tuple[jax.Array, jax.Array]:
+    """Plain-XLA oracle for :func:`fused_step_attention_pooled` over
+    gathered layer slices: k_dec/v_dec (B, S, KV, hd) are the decode
+    slots' views, k_pf/v_pf (S, KV, hd) the prefill slot's.  The decode
+    lane is single-token decode attention; the prefill lane is one
+    window-attention row at consecutive positions from pf_start."""
+    o_dec = reference_decode_attention(q_dec, k_dec, v_dec, positions)
+    o_pf = reference_decode_window_attention(
+        q_pf[None], k_pf[None], v_pf[None],
+        jnp.asarray(pf_start, jnp.int32).reshape(1))
+    return o_dec, o_pf[0]
+
+
 def reference_decode_window_attention(q: jax.Array, k_layer: jax.Array,
                                       v_layer: jax.Array,
                                       positions: jax.Array

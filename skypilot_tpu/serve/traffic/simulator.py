@@ -144,6 +144,16 @@ class SimConfig:
     decode_chunk: int = 4
     prefix_cache_mb: Optional[float] = 4.0
     prefix_block: int = 64
+    # Chunked prefill + piggyback (None = off, the batcher defaults):
+    # prefill_chunk routes long prompts through the incremental lane;
+    # fuse_budget additionally piggybacks their windows onto decode
+    # chunks.  Fused tokens are charged INLINE at the fused rate (None
+    # = the dedicated prefill rate) and subtracted from the completion
+    # charge, so the sweep can price the piggyback's better overlap
+    # before committing kernel time.
+    prefill_chunk: Optional[int] = None
+    fuse_budget: Optional[int] = None
+    fused_prefill_cost_per_token_s: Optional[float] = None
     # prefix_affinity bounded-load factor (ignored by other policies).
     load_factor: float = 1.25
     model_seed: int = 0
@@ -162,6 +172,14 @@ class SimConfig:
                       'step_overhead_s'):
             if getattr(self, field) < 0:
                 raise ValueError(f'{field} must be >= 0')
+        if self.fused_prefill_cost_per_token_s is not None and \
+                self.fused_prefill_cost_per_token_s < 0:
+            raise ValueError(
+                'fused_prefill_cost_per_token_s must be >= 0')
+        if self.fuse_budget is not None and self.prefill_chunk is None:
+            raise ValueError(
+                'fuse_budget requires prefill_chunk (the piggyback '
+                'rides the incremental chunked-prefill lane)')
 
 
 @dataclasses.dataclass
@@ -214,6 +232,10 @@ class _ReplicaSim:
         # Deliveries suppressed by a partition leave this lagging, so
         # the backlog flushes (is not lost) when the link heals.
         self.delivered_upto: Dict[int, int] = {}
+        # Per-rid prompt tokens already charged INLINE by fused steps:
+        # subtracted from the completion-time prefill charge so fused
+        # tokens are never billed twice.
+        self.fused_tokens: Dict[int, int] = {}
 
     @property
     def busy(self) -> bool:
@@ -258,8 +280,31 @@ class _ReplicaSim:
                    for rid in self.inflight}
         pc = batcher._prefix
         pre_saved = pc.tokens_saved if pc is not None else 0
+        fp = getattr(batcher, '_fuse_policy', None)
+        pre_fused = fp.stats.prefill_tokens if fp is not None else 0
+        inc_before = batcher._incremental
         batcher.step()
         saved_delta = (pc.tokens_saved - pre_saved) if pc is not None else 0
+        # Fused piggyback accounting: chunk tokens a fused step carried
+        # this tick are charged INLINE (at the fused rate) and banked
+        # per rid, then subtracted from that rid's completion charge.
+        # The owning request is the incremental lane's occupant — after
+        # the step if the prefill is still in flight, before it if this
+        # tick's chunk completed it (a one-tick admit+complete has
+        # neither; its whole charge stays inline).
+        fused_delta = (fp.stats.prefill_tokens - pre_fused
+                       if fp is not None else 0)
+        inline_only = 0
+        if fused_delta:
+            inc_owner = (batcher._incremental
+                         if batcher._incremental is not None
+                         else inc_before)
+            if inc_owner is not None:
+                self.fused_tokens[inc_owner.rid] = (
+                    self.fused_tokens.get(inc_owner.rid, 0)
+                    + fused_delta)
+            else:
+                inline_only = fused_delta
         newly_first: List[int] = []
         decode_tokens = 0
         for rid in self.inflight:
@@ -270,11 +315,17 @@ class _ReplicaSim:
                 delta -= 1    # the first token comes from the prefill
             decode_tokens += delta
         prefill_tokens = max(
-            0, sum(self.rid_plen[rid] for rid in newly_first)
-            - saved_delta)
+            0, sum(self.rid_plen[rid] - self.fused_tokens.pop(rid, 0)
+                   for rid in newly_first)
+            - saved_delta - inline_only)
+        fused_cost = (self.cfg.fused_prefill_cost_per_token_s
+                      if self.cfg.fused_prefill_cost_per_token_s
+                      is not None
+                      else self.cfg.prefill_cost_per_token_s)
         self.vclock += (self.cfg.step_overhead_s
                         + prefill_tokens * self.cfg.prefill_cost_per_token_s
-                        + decode_tokens * self.cfg.decode_cost_per_token_s)
+                        + decode_tokens * self.cfg.decode_cost_per_token_s
+                        + fused_delta * fused_cost)
         for rid in self.inflight:
             if len(batcher._requests[rid].out) > pre_out[rid]:
                 deliver(self, rid, self.vclock)
@@ -294,6 +345,7 @@ class _ReplicaSim:
         self.rid_sid.pop(rid, None)
         self.rid_plen.pop(rid, None)
         self.delivered_upto.pop(rid, None)
+        self.fused_tokens.pop(rid, None)
 
 
 def _percentile(samples: List[float], q: float) -> float:
@@ -334,7 +386,9 @@ class FleetSimulator:
             batch_size=self.cfg.batch_size,
             temperature=0.0,
             prefix_cache_mb=self.cfg.prefix_cache_mb,
-            prefix_block=self.cfg.prefix_block)
+            prefix_block=self.cfg.prefix_block,
+            prefill_chunk=self.cfg.prefill_chunk,
+            fuse_budget=self.cfg.fuse_budget)
         if self.cfg.policy == 'prefix_affinity':
             self.policy: lb_policies.LoadBalancingPolicy = \
                 lb_policies.PrefixAffinityPolicy(

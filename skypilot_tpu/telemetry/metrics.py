@@ -349,6 +349,37 @@ INFER_SPEC_TOKENS_PER_SYNC = prometheus_client.Gauge(
     'host_syncs_per_token; rises with acceptance)',
     registry=REGISTRY)
 
+# ---- infer chunked-prefill piggyback (infer/fuse.py, serving.py) -------
+
+INFER_FUSE_STEPS = prometheus_client.Counter(
+    'skytpu_infer_fuse_steps_total',
+    'Fused prefill+decode chunks dispatched (one chunked-prefill '
+    'window piggybacked onto a lockstep decode chunk)',
+    registry=REGISTRY)
+
+INFER_FUSE_PREFILL_TOKENS = prometheus_client.Counter(
+    'skytpu_infer_fuse_prefill_tokens_total',
+    'Real prompt tokens carried by fused steps\' prefill lanes '
+    '(excludes the fixed fuse_budget padding)',
+    registry=REGISTRY)
+
+INFER_FUSE_BUDGET_UTILIZATION = prometheus_client.Gauge(
+    'skytpu_infer_fuse_budget_utilization_ratio',
+    'Fraction of the last fused step\'s fuse_budget-wide prefill lane '
+    'carrying real prompt tokens (chronically low: lower fuse_budget '
+    'or raise decode_chunk)',
+    registry=REGISTRY)
+
+INFER_FUSE_TTFT = prometheus_client.Histogram(
+    'skytpu_infer_fuse_ttft_seconds',
+    'Submit-to-first-token latency of chunked prefills, split by '
+    'whether any window piggybacked on a decode chunk (fused) or '
+    'every window ran dedicated (cold)',
+    ['mode'],
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+             60),
+    registry=REGISTRY)
+
 # ---- serve (serve/load_balancer.py, replica_managers.py, autoscalers.py)
 
 SERVE_REPLICA_REQUESTS = prometheus_client.Counter(

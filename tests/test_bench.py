@@ -45,7 +45,12 @@ def test_headline_contains_every_north_star_number():
                               'steady_decode_tok_s': 5043.0,
                               'roofline_pct': 32.0,
                               'steady_roofline_pct': 40.8}},
-        latency={'launch_to_first_line_s': 6.08})
+        latency={'launch_to_first_line_s': 6.08},
+        fuse={'dedicated': {'ttft_p99_ms': 1304.0},
+              'fused': {'ttft_p99_ms': 1150.4},
+              'ttft_p99_delta_pct': -11.78,
+              'tpot_regression_pct': -19.31,
+              'piggybacked_tokens': 818})
     assert h['llama_1b_tok_s_chip'] == 12345.6
     assert h['llama_1b_mfu_pct'] == 58.5
     assert h['llama_8b_tok_s_chip'] == 2358.0
@@ -56,6 +61,11 @@ def test_headline_contains_every_north_star_number():
         assert v['e2e_tok_s'] and v['steady_tok_s']
         assert v['roofline_pct'] and v['steady_roofline_pct']
     assert h['launch_to_first_line_s'] == 6.08
+    assert h['fuse']['ttft_p99_dedicated_ms'] == 1304.0
+    assert h['fuse']['ttft_p99_fused_ms'] == 1150.4
+    assert h['fuse']['ttft_p99_delta_pct'] == -11.78
+    assert h['fuse']['tpot_regression_pct'] == -19.31
+    assert h['fuse']['piggybacked_tokens'] == 818
     assert 'llama_8b_suspect' not in h
     # Round-trips through a single JSON line (the tail contract).
     import json
@@ -67,9 +77,11 @@ def test_headline_contains_every_north_star_number():
 def test_headline_surfaces_suberrors():
     h = bench.build_headline(
         tok_s=1.0, mfu=0.1, llama8b={'error': 'x' * 500},
-        decode={'error': 'y' * 500}, latency=None)
+        decode={'error': 'y' * 500}, latency=None,
+        fuse={'error': 'z' * 500})
     assert len(h['llama_8b_error']) == 120
     assert len(h['decode']['error']) == 120
+    assert len(h['fuse']['error']) == 120
     assert h['launch_to_first_line_s'] is None
     h2 = bench.build_headline(
         tok_s=1.0, mfu=0.1, llama8b={}, decode={},

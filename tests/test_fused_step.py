@@ -1,0 +1,401 @@
+"""Chunked-prefill piggyback (infer/fuse.py + the fused step in
+llama_infer/serving).
+
+What must hold:
+- the fused attention op matches the plain-XLA oracle through a
+  scattered block table (interpret mode, both KV dtypes);
+- fused_step_pooled is BIT-EXACT against the dedicated two-step
+  schedule (decode_step_pooled then prefill_window_pooled): decode
+  logits, chunk hiddens, and the arena itself, over f32/bf16 params
+  and bf16/int8 KV;
+- ContinuousBatcher greedy output with fuse_budget on is BIT-EXACT vs
+  fuse off across the same dtype grid, including coexistence with
+  speculative decoding and prefix-hit admission;
+- the pool invariant holds after EVERY fused step and the fuse metric
+  families move;
+- a fused tick costs no more counted host_fetch syncs than the
+  dedicated schedule;
+- the fused program compiles within its <=2 budget (fixed fuse-budget
+  padding);
+- config validation: fuse_budget needs the pooled plane and
+  prefill_chunk, at engine and simulator level.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import block_pool as block_pool_lib
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import fuse as fuse_lib
+from skypilot_tpu.infer import llama_infer
+from skypilot_tpu.infer.engine import GeneratorConfig
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.metrics import REGISTRY
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import decode_attention as da
+
+CFG_F32 = llama.LlamaConfig(vocab_size=97, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=64, dtype=jnp.float32)
+CFG_BF16 = llama.LlamaConfig(vocab_size=97, d_model=32, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=64,
+                             max_seq_len=64, dtype=jnp.bfloat16)
+
+
+@pytest.fixture(scope='module')
+def params_f32():
+    return llama.init_params(CFG_F32, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope='module')
+def params_bf16():
+    return llama.init_params(CFG_BF16, jax.random.PRNGKey(0))
+
+
+def _gc(fuse, **kw):
+    base = dict(max_seq_len=64, batch_size=4, temperature=0.0,
+                prefill_chunk=4, fuse_budget=(6 if fuse else None),
+                prefix_cache_mb=0.0)
+    base.update(kw)
+    return GeneratorConfig(**base)
+
+
+def _prompts():
+    """Three short prompts (decode batch) + one long prompt (the
+    chunked-prefill lane the fused step piggybacks)."""
+    rng = np.random.RandomState(7)
+    short = [rng.randint(1, 97, size=5).tolist() for _ in range(3)]
+    long_p = rng.randint(1, 97, size=33).tolist()
+    return short + [long_p]
+
+
+def _metric(name, labels=None):
+    return REGISTRY.get_sample_value(name, labels) or 0.0
+
+
+# ---------------------------------------------------------------------------
+# FusePolicy + config validation (host-level units)
+# ---------------------------------------------------------------------------
+
+def test_policy_chunk_fills_leftover_budget():
+    p = fuse_lib.FusePolicy(8)
+    assert p.chunk(100, 3) == 5      # leftover budget
+    assert p.chunk(2, 3) == 2        # clamped to remaining prompt
+    assert p.chunk(100, 8) == 1      # saturated batch still drips
+    assert p.chunk(100, 0) == 8      # never wider than the lane
+    assert p.chunk(0, 2) == 0        # nothing left to piggyback
+
+
+def test_policy_utilization_and_counters():
+    p = fuse_lib.FusePolicy(8)
+    assert p.utilization(4) == 0.5
+    p.record_fused(5)
+    p.record_fused(3)
+    p.record_dedicated()
+    assert p.stats.steps == 2
+    assert p.stats.prefill_tokens == 8
+    assert p.stats.dedicated_windows == 1
+
+
+def test_fuse_budget_validation():
+    with pytest.raises(ValueError, match='fuse_budget'):
+        fuse_lib.FusePolicy(0)
+    with pytest.raises(ValueError, match='fuse_budget'):
+        _gc(True, fuse_budget=0)
+    with pytest.raises(ValueError, match='pooled'):
+        _gc(True, decode_impl='inplace')
+    with pytest.raises(ValueError, match='prefill_chunk'):
+        _gc(True, prefill_chunk=None)
+    _gc(False)  # off is always valid
+
+
+def test_sim_config_fuse_validation():
+    from skypilot_tpu.serve.traffic.simulator import SimConfig
+    with pytest.raises(ValueError, match='prefill_chunk'):
+        SimConfig(policy='least_load', num_replicas=1, batch_size=2,
+                  fuse_budget=8)
+    with pytest.raises(ValueError, match='fused_prefill_cost'):
+        SimConfig(policy='least_load', num_replicas=1, batch_size=2,
+                  prefill_chunk=8, fuse_budget=8,
+                  fused_prefill_cost_per_token_s=-1.0)
+    SimConfig(policy='least_load', num_replicas=1, batch_size=2,
+              prefill_chunk=8, fuse_budget=8)
+
+
+# ---------------------------------------------------------------------------
+# Fused attention op vs oracle (interpret mode, scattered tables)
+# ---------------------------------------------------------------------------
+
+def _arena(quantized, seed=1):
+    lay, nb, bs, kv, group, hd, batch, fuse = 2, 8, 64, 2, 2, 128, 2, 4
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (batch, kv, group, hd), jnp.float32)
+    q_pf = jax.random.normal(ks[3], (fuse, kv, group, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (lay, nb, bs, kv, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (lay, nb, bs, kv, hd), jnp.float32)
+    if not quantized:
+        return q, q_pf, k, v, None, None
+    sk = jnp.maximum(jnp.max(jnp.abs(k), axis=-1), 1e-8) / 127.0
+    sv = jnp.maximum(jnp.max(jnp.abs(v), axis=-1), 1e-8) / 127.0
+    k_q = jnp.round(k / sk[..., None]).astype(jnp.int8)
+    v_q = jnp.round(v / sv[..., None]).astype(jnp.int8)
+    return q, q_pf, k_q, v_q, sk.astype(jnp.float32), \
+        sv.astype(jnp.float32)
+
+
+@pytest.mark.parametrize('quantized', [False, True])
+def test_fused_attention_matches_reference(quantized):
+    """Both lanes of the fused op — decode rows through scattered
+    tables, the prefill window through its own row — match the
+    plain-XLA oracle over gathered logical views."""
+    q, q_pf, k, v, sk, sv = _arena(quantized)
+    tables = jnp.asarray([[3, 6, 1], [5, 0, 0]], jnp.int32)
+    pf_row = jnp.asarray([2, 4, 7], jnp.int32)
+    positions = jnp.asarray([150, 40], jnp.int32)
+    pf_start = jnp.int32(70)
+    layer = 1
+    o_dec, o_pf = da.fused_step_attention_pooled(
+        q, q_pf, k, v, tables, pf_row, layer, positions, pf_start,
+        sk, sv, interpret=True)
+    if quantized:
+        k_f = k.astype(jnp.float32) * sk[..., None]
+        v_f = v.astype(jnp.float32) * sv[..., None]
+    else:
+        k_f, v_f = k, v
+    bs = k.shape[2]
+    s_len = tables.shape[1] * bs
+    k_dec = k_f[layer][tables].reshape(2, s_len, *k_f.shape[3:])
+    v_dec = v_f[layer][tables].reshape(2, s_len, *v_f.shape[3:])
+    k_pf = k_f[layer][pf_row].reshape(s_len, *k_f.shape[3:])
+    v_pf = v_f[layer][pf_row].reshape(s_len, *v_f.shape[3:])
+    r_dec, r_pf = da.reference_fused_step_attention(
+        q, k_dec, v_dec, positions, q_pf, k_pf, v_pf, pf_start)
+    np.testing.assert_allclose(np.asarray(o_dec), np.asarray(r_dec),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(o_pf), np.asarray(r_pf),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Model level: fused step vs the dedicated two-step schedule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('model_dtype,kv_dtype', [
+    ('float32', None),
+    ('float32', 'int8'),
+    ('bfloat16', None),
+    ('bfloat16', 'int8'),
+])
+def test_model_fused_step_matches_dedicated(model_dtype, kv_dtype,
+                                            request):
+    """One fused forward == decode_step_pooled + prefill_window_pooled,
+    BIT-EXACT: decode logits, chunk hiddens, and every non-garbage
+    arena block (the fused read side keeps each lane's unfused
+    numerics by construction)."""
+    cfg = CFG_F32 if model_dtype == 'float32' else CFG_BF16
+    params = request.getfixturevalue(
+        'params_f32' if model_dtype == 'float32' else 'params_bf16')
+    cache = block_pool_lib.init_arena(cfg, 10, 8, kv_dtype=kv_dtype)
+    tables = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    pf_row = jnp.asarray([5, 6, 7, 0], jnp.int32)
+    rng = np.random.RandomState(3)
+    # Seed two decoding slots with 10-token contexts and the piggyback
+    # slot with its first 8-token chunk.
+    for row, start in ((tables[0], 0), (tables[1], 0)):
+        toks = jnp.asarray(rng.randint(1, 97, size=10), jnp.int32)
+        _, cache = llama_infer.prefill_window_pooled(
+            params, toks, cfg, cache, row, jnp.int32(start))
+    first = jnp.asarray(rng.randint(1, 97, size=8), jnp.int32)
+    _, cache = llama_infer.prefill_window_pooled(
+        params, first, cfg, cache, pf_row, jnp.int32(0))
+    token = jnp.asarray([11, 22], jnp.int32)
+    positions = jnp.asarray([10, 10], jnp.int32)
+    # The chunk under test: 4 real tokens padded to a 6-wide lane.
+    chunk = np.zeros((6,), np.int32)
+    chunk[:4] = rng.randint(1, 97, size=4)
+    chunk = jnp.asarray(chunk)
+    pf_start = jnp.int32(8)
+
+    logits_d, cache_d = llama_infer.decode_step_pooled(
+        params, token, cfg, cache, positions, tables)
+    h_ref, cache_d = llama_infer.prefill_window_pooled(
+        params, chunk, cfg, cache_d, pf_row, pf_start)
+    logits_f, h_pf, cache_f = llama_infer.fused_step_pooled(
+        params, token, cfg, cache, positions, tables, chunk, pf_row,
+        pf_start)
+
+    assert np.array_equal(np.asarray(logits_f), np.asarray(logits_d))
+    assert np.array_equal(np.asarray(h_pf), np.asarray(h_ref))
+    for name in cache_f:
+        got = np.asarray(cache_f[name][:, 1:])
+        want = np.asarray(cache_d[name][:, 1:])
+        assert np.array_equal(got, want), name
+
+
+# ---------------------------------------------------------------------------
+# Batcher level: greedy parity, coexistence, invariants, budgets
+# ---------------------------------------------------------------------------
+
+def _run_batcher(params, cfg, fuse, max_new=8, **kw):
+    b = ContinuousBatcher(params, cfg, _gc(fuse, **kw), decode_chunk=3)
+    rids = [b.submit(p, max_new_tokens=max_new) for p in _prompts()]
+    b.run_until_idle()
+    return b, [b.result(r) for r in rids]
+
+
+@pytest.mark.parametrize('model_dtype,kv_dtype', [
+    ('float32', None),
+    ('float32', 'int8'),
+    ('bfloat16', None),
+    ('bfloat16', 'int8'),
+])
+def test_batcher_fused_greedy_parity(model_dtype, kv_dtype, request):
+    """Greedy output with fuse_budget on is BIT-EXACT vs fuse off —
+    short prompts riding decode while the long prompt's chunks fuse."""
+    cfg = CFG_F32 if model_dtype == 'float32' else CFG_BF16
+    params = request.getfixturevalue(
+        'params_f32' if model_dtype == 'float32' else 'params_bf16')
+    _, ref = _run_batcher(params, cfg, False, kv_cache_dtype=kv_dtype)
+    b, out = _run_batcher(params, cfg, True, kv_cache_dtype=kv_dtype)
+    assert out == ref
+    assert b._fuse_policy.stats.steps > 0       # fusion really ran
+    assert b._fuse_policy.stats.prefill_tokens > 0
+
+
+def test_fused_coexists_with_spec_decode(params_f32):
+    """spec_k + fuse_budget together: fused ticks suppress the verify
+    path, speculation resumes after the prompt lands, and greedy
+    output stays identical to fuse-off."""
+    p0 = _metric('skytpu_infer_spec_proposed_tokens_total')
+    _, ref = _run_batcher(params_f32, CFG_F32, False, spec_k=2,
+                          max_new=10)
+    b, out = _run_batcher(params_f32, CFG_F32, True, spec_k=2,
+                          max_new=10)
+    assert out == ref
+    assert b._fuse_policy.stats.steps > 0
+    # The drafter still worked (before/after the fused window).
+    assert _metric('skytpu_infer_spec_proposed_tokens_total') > p0
+
+
+def test_fused_coexists_with_prefix_hits(params_f32):
+    """A warm prefix-hit admission of the long prompt fuses its
+    remaining suffix; output matches fuse-off token-for-token."""
+    prompts = _prompts()
+    prompts.append(prompts[3])      # resubmit the long prompt: warm hit
+
+    def run(fuse):
+        b = ContinuousBatcher(params_f32, CFG_F32,
+                              _gc(fuse, prefix_cache_mb=0.5,
+                                  prefix_block=8), decode_chunk=3)
+        rids = [b.submit(p, max_new_tokens=8) for p in prompts]
+        b.run_until_idle()
+        return b, [b.result(r) for r in rids]
+
+    h0 = _metric('skytpu_infer_prefix_hits_total')
+    _, ref = run(False)
+    b, out = run(True)
+    assert out == ref
+    assert b._fuse_policy.stats.steps > 0
+    assert _metric('skytpu_infer_prefix_hits_total') > h0
+
+
+def test_fused_pool_invariant_every_step_and_metrics(params_f32):
+    """The block-pool ledger balances after EVERY fused step, and the
+    skytpu_infer_fuse_* families move by exactly the policy's
+    counters."""
+    s0 = _metric('skytpu_infer_fuse_steps_total')
+    t0 = _metric('skytpu_infer_fuse_prefill_tokens_total')
+    f0 = _metric('skytpu_infer_fuse_ttft_seconds_count',
+                 {'mode': 'fused'})
+    b = ContinuousBatcher(params_f32, CFG_F32, _gc(True),
+                          decode_chunk=3)
+    rids = [b.submit(p, max_new_tokens=8) for p in _prompts()]
+    for _ in range(400):
+        if b.num_active == 0 and b.num_queued == 0:
+            break
+        b.step()
+        b.pool.check_invariant()
+    b.pool.check_invariant()
+    assert all(b.result(r) for r in rids)
+    st = b._fuse_policy.stats
+    assert st.steps > 0 and st.prefill_tokens > 0
+    assert _metric('skytpu_infer_fuse_steps_total') - s0 == st.steps
+    assert (_metric('skytpu_infer_fuse_prefill_tokens_total') - t0
+            == st.prefill_tokens)
+    # The long prompt's TTFT was observed under mode='fused'.
+    assert _metric('skytpu_infer_fuse_ttft_seconds_count',
+                   {'mode': 'fused'}) > f0
+    assert 0.0 < _metric(
+        'skytpu_infer_fuse_budget_utilization_ratio') <= 1.0
+
+
+def test_fused_host_sync_budget(params_f32):
+    """Fusing prefill into decode steps never costs MORE counted
+    host_fetch syncs than the dedicated schedule for the same
+    workload (each fused tick keeps the one-fetch contract)."""
+    def count(fuse):
+        calls = [0]
+        orig = engine_lib.host_fetch
+
+        def counting(*arrays):
+            calls[0] += 1
+            return orig(*arrays)
+
+        engine_lib.host_fetch = counting
+        try:
+            _, out = _run_batcher(params_f32, CFG_F32, fuse)
+        finally:
+            engine_lib.host_fetch = orig
+        return out, calls[0]
+
+    ref, syncs_off = count(False)
+    out, syncs_on = count(True)
+    assert out == ref
+    assert syncs_on <= syncs_off
+
+
+def test_fused_compile_budget(params_f32):
+    """Fixed fuse-budget padding keys the fused program on shape alone:
+    across chunks of every real width and two workloads it stays
+    within the <=2 compile budget, without disturbing the sequential
+    decode budget."""
+    b = ContinuousBatcher(params_f32, CFG_F32, _gc(True),
+                          decode_chunk=3)
+    rids = [b.submit(p, max_new_tokens=8) for p in _prompts()]
+    b.run_until_idle()
+    rng = np.random.RandomState(11)
+    more = [b.submit(rng.randint(1, 97, size=21).tolist(),
+                     max_new_tokens=6) for _ in range(2)]
+    b.run_until_idle()
+    assert all(b.result(r) for r in rids + more)
+    assert b._fuse_policy.stats.steps > 0
+    assert b._fused._cache_size() <= 2
+    assert b._decode._cache_size() <= 2
+
+
+def test_simulator_banks_fused_tokens():
+    """The virtual-time fleet charges fused tokens inline and banks
+    them per request — a fused run completes the trace with real
+    piggybacked tokens on the replicas' policies."""
+    from skypilot_tpu.serve.traffic import generator as gen
+    from skypilot_tpu.serve.traffic.simulator import (FleetSimulator,
+                                                      SimConfig)
+    traffic = gen.TrafficConfig(seed=5, duration_s=6.0, base_rps=1.5,
+                                num_sessions=2, num_heads=2,
+                                head_tokens=24, singleton_median=48,
+                                max_prompt_tokens=96, out_median=8)
+    sim = FleetSimulator(
+        SimConfig(policy='least_load', num_replicas=1, batch_size=2,
+                  decode_chunk=4, prefill_cost_per_token_s=4e-3,
+                  decode_cost_per_token_s=2e-3, max_seq_len=128,
+                  prefill_chunk=8, fuse_budget=12,
+                  fused_prefill_cost_per_token_s=1e-3),
+        traffic)
+    summary = sim.run()
+    assert summary['requests'] > 0
+    fused_tokens = sum(
+        rep.batcher._fuse_policy.stats.prefill_tokens
+        for rep in sim.replicas + sim.retired
+        if getattr(rep.batcher, '_fuse_policy', None) is not None)
+    assert fused_tokens > 0
