@@ -299,6 +299,7 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
     from aiohttp import web
 
     from skypilot_tpu.infer import block_pool as block_pool_lib
+    from skypilot_tpu.telemetry import trace as trace_lib
 
     def _finish_reason(out):
         return 'stop' if (eos_token is not None and out
@@ -472,9 +473,14 @@ def attach_openai_routes(app, driver, config, tokenizer, *,
         created = int(time.time())
         rid_str = ('chatcmpl-' if chat else 'cmpl-') + uuid.uuid4().hex[:24]
         try:
-            rid, ev = await asyncio.to_thread(
-                driver.submit, prompt_ids, opts['max_tokens'],
-                opts['temperature'], opts['top_p'])
+            # Bind the LB's trace id before submit: asyncio.to_thread
+            # copies the contextvar context, so the batcher's lifecycle
+            # spans for this request carry the end-to-end id.
+            with trace_lib.trace_scope(
+                    request.headers.get(trace_lib.TRACE_HEADER)):
+                rid, ev = await asyncio.to_thread(
+                    driver.submit, prompt_ids, opts['max_tokens'],
+                    opts['temperature'], opts['top_p'])
         except block_pool_lib.PoolExhaustedError as e:
             # retry_after_s set -> transient exhaustion: retryable 503
             # with Retry-After (the LB diverts to another replica).
@@ -749,6 +755,7 @@ def main() -> int:
     from aiohttp import web
 
     from skypilot_tpu.infer import block_pool as block_pool_lib
+    from skypilot_tpu.telemetry import trace as trace_lib
 
     async def health(request):
         return web.json_response({'status': 'ok',
@@ -792,8 +799,12 @@ def main() -> int:
         try:
             # to_thread: submit takes the scheduler lock, which is held
             # across whole decode chunks — never block the event loop.
-            rid, ev = await asyncio.to_thread(driver.submit, prompt_ids,
-                                              max_new)
+            # trace_scope copies into the thread via to_thread's
+            # context copy, keying this request's lifecycle spans.
+            with trace_lib.trace_scope(
+                    request.headers.get(trace_lib.TRACE_HEADER)):
+                rid, ev = await asyncio.to_thread(driver.submit,
+                                                  prompt_ids, max_new)
         except block_pool_lib.PoolExhaustedError as e:
             # Transient exhaustion -> retryable 503 + Retry-After (LB
             # diverts); a request that can never fit the pool -> 400.

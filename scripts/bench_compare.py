@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Diff two bench artifacts and fail on headline regressions.
+
+Usage:
+    scripts/bench_compare.py OLD.json NEW.json [--threshold 5.0]
+
+Each argument is either a driver bench artifact ``BENCH_*.json``
+(``{n, cmd, rc, tail, parsed}`` — the headline is recovered from the
+last ``BENCH_HEADLINE {...}`` line in the tail) or a raw headline JSON
+dict.  Headline throughput fields must not drop, and latency fields
+must not rise, by more than the threshold (percent); any such move
+prints as a REGRESSION and the exit code is 1 — wired into
+scripts/lint.sh as an optional CI gate whenever two artifacts exist.
+
+Fields absent from either side (a sub-bench errored, or an older round
+predates the field) are reported as skipped, never failed: a new metric
+must not break the gate on the first round that adds it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Headline fields compared, as (dotted path, higher_is_better).
+# Throughput: a drop is a regression.  Latency: a rise is a regression.
+FIELDS: Tuple[Tuple[str, bool], ...] = (
+    ('llama_1b_tok_s_chip', True),
+    ('llama_8b_tok_s_chip', True),
+    ('decode.bf16.e2e_tok_s', True),
+    ('decode.bf16.steady_tok_s', True),
+    ('decode.int8_kv.e2e_tok_s', True),
+    ('decode.int8_kv.steady_tok_s', True),
+    ('decode.int8_w_kv.e2e_tok_s', True),
+    ('decode.int8_w_kv.steady_tok_s', True),
+    ('launch_to_first_line_s', False),
+    ('serve.affinity_ttft_p99_ms', False),
+    ('serve.least_load_ttft_p99_ms', False),
+    ('fuse.ttft_p99_fused_ms', False),
+    ('chaos.failover_p99_added_latency_ms', False),
+)
+
+_HEADLINE_RE = re.compile(r'^BENCH_HEADLINE (\{.*\})\s*$', re.M)
+
+
+def load_headline(path: str) -> Dict[str, Any]:
+    """Headline dict from a driver artifact (tail scrape) or a raw
+    headline JSON file."""
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f'{path}: expected a JSON object')
+    tail = data.get('tail')
+    if isinstance(tail, str):
+        matches = _HEADLINE_RE.findall(tail)
+        if not matches:
+            raise ValueError(
+                f'{path}: driver artifact has no BENCH_HEADLINE line '
+                'in its tail (run truncated before the headline?)')
+        return json.loads(matches[-1])
+    return data
+
+
+def _lookup(headline: Dict[str, Any], dotted: str) -> Optional[float]:
+    node: Any = headline
+    for part in dotted.split('.'):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    return node if isinstance(node, (int, float)) else None
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            threshold_pct: float) -> Tuple[List[str], List[str]]:
+    """Returns (report lines, regression lines)."""
+    lines: List[str] = []
+    regressions: List[str] = []
+    for dotted, higher_better in FIELDS:
+        a, b = _lookup(old, dotted), _lookup(new, dotted)
+        if a is None or b is None or a == 0:
+            lines.append(f'  {dotted}: skipped (old={a} new={b})')
+            continue
+        delta_pct = 100.0 * (b - a) / abs(a)
+        direction = 'tok/s' if higher_better else 'latency'
+        regressed = (delta_pct < -threshold_pct if higher_better
+                     else delta_pct > threshold_pct)
+        mark = 'REGRESSION' if regressed else 'ok'
+        line = (f'  {dotted} ({direction}): {a} -> {b} '
+                f'({delta_pct:+.2f}%) {mark}')
+        lines.append(line)
+        if regressed:
+            regressions.append(line.strip())
+    return lines, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument('old', help='baseline bench artifact / headline')
+    parser.add_argument('new', help='candidate bench artifact / headline')
+    parser.add_argument('--threshold', type=float, default=5.0,
+                        help='regression threshold in percent '
+                             '(default 5.0)')
+    args = parser.parse_args(argv)
+    try:
+        old = load_headline(args.old)
+        new = load_headline(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f'bench_compare: {e}', file=sys.stderr)
+        return 2
+    lines, regressions = compare(old, new, args.threshold)
+    print(f'bench_compare {args.old} -> {args.new} '
+          f'(threshold {args.threshold}%)')
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f'{len(regressions)} regression(s) beyond '
+              f'{args.threshold}%:', file=sys.stderr)
+        for line in regressions:
+            print(f'  {line}', file=sys.stderr)
+        return 1
+    print('no regressions beyond threshold')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

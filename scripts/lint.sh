@@ -16,4 +16,24 @@ cd "$(dirname "$0")/.."
 # TPU (tracing and lowering are backend-independent anyway).
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-exec python -m skypilot_tpu.analysis --json "$@"
+python -m skypilot_tpu.analysis --json "$@"
+
+# Optional bench-regression gate: when the driver has left at least two
+# bench artifacts, diff the newest pair of headlines — >5% drops on
+# throughput (or rises on latency) fail the lint step.
+benches=()
+for f in BENCH_*.json; do
+  [ -e "$f" ] && benches+=("$f")
+done
+# Exit 1 = real regression (fail CI); exit 2 = artifacts not
+# comparable (e.g. a pre-headline round) — skip, don't fail.
+if [ "${#benches[@]}" -ge 2 ]; then
+  rc=0
+  python scripts/bench_compare.py \
+    "${benches[${#benches[@]}-2]}" "${benches[${#benches[@]}-1]}" || rc=$?
+  if [ "$rc" -eq 1 ]; then
+    exit 1
+  elif [ "$rc" -ne 0 ]; then
+    echo "bench_compare: skipped (artifacts not comparable)" >&2
+  fi
+fi

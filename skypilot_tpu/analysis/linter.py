@@ -100,6 +100,12 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
          'same function — the consistent-hash ring keeps routing '
          'sessions at the dead replica and the circuit breaker leaks '
          'its per-replica state'),
+    Rule('SKY401', 'metric-family-outside-registry',
+         'Prometheus metric family (Counter/Gauge/Histogram/Summary/'
+         'Info) instantiated outside telemetry/metrics.py — families '
+         'must live in the shared-registry module so the metrics<->docs '
+         'parity test sees every skytpu_* name and a re-import cannot '
+         'collide on duplicate registration'),
 ]}
 
 # Modules whose device->host transfers must route through
@@ -121,6 +127,21 @@ DATA_PLANE_MODULES = (
 SLEEP_ALLOWLIST_MODULES = (
     'utils/backoff.py',
 )
+
+# SKY401's sanctioned homes: the shared-registry modules where every
+# metric family must be defined (telemetry/metrics.py owns the skytpu_*
+# families; metrics/utils.py owns the REGISTRY itself plus the legacy
+# skytpu_api_* families).
+METRIC_MODULE_ALLOWLIST = (
+    'telemetry/metrics.py',
+    'metrics/utils.py',
+)
+
+# Constructor names that create a Prometheus metric family.  A bare
+# name only counts with a `registry=` kwarg — `collections.Counter`
+# and a plain `Counter(...)` mapping must never fire this rule.
+_METRIC_FAMILY_NAMES = ('Counter', 'Gauge', 'Histogram', 'Summary',
+                        'Info')
 
 # Paths (relative, '/'-normalized) whose except handlers are recovery
 # paths: a swallowed error there turns a recoverable failure into a
@@ -416,6 +437,7 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
         self.path = path
         self.is_data_plane = path.endswith(DATA_PLANE_MODULES)
         self.sleep_allowed = path.endswith(SLEEP_ALLOWLIST_MODULES)
+        self.metrics_allowed = path.endswith(METRIC_MODULE_ALLOWLIST)
         parts = path.split('/')[:-1]
         self.is_recovery = any(
             f'{p}/' in RECOVERY_PATH_PREFIXES for p in parts)
@@ -588,6 +610,8 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         fn = _dotted(node.func)
         self._check_f64_call(node, fn)
+        if not self.metrics_allowed:
+            self._check_metric_family(node, fn)
         if self.is_data_plane and not self._in_host_fetch:
             self._check_host_fetch_bypass(node, fn)
         if self._async_depth > 0:
@@ -630,6 +654,27 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
                             f'{_dotted(node)} literal — f64 has no TPU '
                             'fast path and doubles bandwidth')
         self.generic_visit(node)
+
+    def _check_metric_family(self, node: ast.Call,
+                             fn: Optional[str]) -> None:
+        """SKY401: a metric-family constructor outside the registry
+        modules.  Fires on dotted `prometheus_client.Counter(...)`
+        regardless of kwargs, and on a bare `Counter(...)` ONLY when a
+        `registry=` kwarg marks it as a Prometheus constructor —
+        `collections.Counter(...)` never matches either shape."""
+        if not fn or fn.rsplit('.', 1)[-1] not in _METRIC_FAMILY_NAMES:
+            return
+        dotted_prom = fn.startswith('prometheus_client.')
+        bare_with_registry = '.' not in fn and any(
+            kw.arg == 'registry' for kw in node.keywords)
+        if dotted_prom or bare_with_registry:
+            self.rep.report(
+                node, 'SKY401',
+                f'{fn}() creates a metric family outside '
+                'telemetry/metrics.py — define it there (shared '
+                'REGISTRY, one home the metrics<->docs parity test '
+                'walks) and import it, or mark a sanctioned site  '
+                '# skytpu-allow: SKY401')
 
     def _check_host_fetch_bypass(self, node: ast.Call,
                                  fn: Optional[str]) -> None:

@@ -58,6 +58,9 @@ from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
 from skypilot_tpu.models import llama
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
+from skypilot_tpu.telemetry import spans as spans_lib
+from skypilot_tpu.telemetry import steplog
+from skypilot_tpu.telemetry import trace as trace_lib
 
 
 @dataclasses.dataclass
@@ -79,6 +82,12 @@ class _Request:
     fused_chunks: int = 0
     # Wall time of submit(); admission observes the queue wait.
     submitted_at: float = 0.0
+    # Lifecycle tracing: the trace id propagated from the LB (the
+    # X-Skytpu-Trace-Id header -> trace contextvar) at submit time, and
+    # the submit instant on the SPAN clock (wall by default, the
+    # replica vclock under the fleet simulator) for the queue_wait span.
+    trace_id: Optional[str] = None
+    submitted_span_at: float = 0.0
 
 
 class ContinuousBatcher:
@@ -87,7 +96,9 @@ class ContinuousBatcher:
     def __init__(self, params: llama.Params, config: llama.LlamaConfig,
                  gen_config: GeneratorConfig = GeneratorConfig(),
                  decode_chunk: int = 8, mesh=None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 span_buffer: Optional[spans_lib.SpanBuffer] = None,
+                 span_clock=None):
         """mesh: optional ('tp','tpq') — or ('dp','tp','tpq') — mesh
         from tp_lib.make_tp_mesh (infer/tp.py) — params and the slot
         cache/pooled arena are megatron-sharded so serving capacity
@@ -99,7 +110,13 @@ class ContinuousBatcher:
         requests are already waiting, instead of queueing without
         limit.  None (default) keeps the unbounded library behavior;
         the HTTP serving path sets it so overload surfaces as a
-        retryable 503 the load balancer can divert on."""
+        retryable 503 the load balancer can divert on.
+
+        span_buffer/span_clock: lifecycle-span sink and its clock.
+        None (default) records into the module-wide wall-clock buffer
+        gated by spans.enabled(); the fleet simulator injects a
+        per-replica buffer whose clock reads the replica vclock, which
+        is what makes exported serve traces byte-deterministic."""
         self.mesh = mesh
         if mesh is not None:
             tp_lib.validate_mesh(config, mesh)
@@ -319,6 +336,54 @@ class ContinuousBatcher:
                 eos=gen_config.eos_token),
                 donate_argnums=(2,),
                 static_argnames=('n', 'all_greedy', 'nucleus'))
+        # Step-phase attribution (always on — a handful of host-timer
+        # reads per tick) and lifecycle spans (gated: _spans_on()).
+        self._profiler = spans_lib.StepProfiler()
+        self._span_buf = span_buffer
+        self._span_clock = span_clock or time.time
+
+    # ---- tracing ---------------------------------------------------------
+    def _spans_on(self) -> bool:
+        return self._span_buf is not None or spans_lib.enabled()
+
+    def _span(self, name: str, t0: float, t1: float,
+              req: Optional[_Request] = None,
+              trace_id: Optional[str] = None, **attrs) -> None:
+        # NOT `or`: an empty SpanBuffer is falsy (__len__ == 0) and
+        # would silently fall through to the module default.
+        buf = (self._span_buf if self._span_buf is not None
+               else spans_lib.default_buffer())
+        if req is not None and trace_id is None:
+            trace_id = req.trace_id
+        buf.record(name, t0, t1, trace_id=trace_id,
+                   request_id=req.rid if req is not None else None,
+                   **attrs)
+
+    def _fetch(self, *arrays):
+        """engine host_fetch under the host_fetch phase — the blocking
+        device→host syncs are the step's dominant wait and must not be
+        charged to whatever phase dispatched them."""
+        with self._profiler.phase('host_fetch'):
+            return engine_lib.host_fetch(*arrays)
+
+    def _finish_step_profile(self) -> None:
+        profiler = self._profiler
+        phases = profiler.finish()
+        if not phases:
+            return
+        wall = profiler.last_wall
+        for name, seconds in phases.items():
+            telemetry_metrics.INFER_STEP_PHASE_SECONDS.labels(
+                phase=name).observe(seconds)
+            if wall > 0:
+                telemetry_metrics.INFER_STEP_UTILIZATION.labels(
+                    phase=name).set(seconds / wall)
+        if steplog.enabled():
+            steplog.write({
+                'kind': 'infer_step_phases',
+                'wall_s': round(wall, 9),
+                'phases': {k: round(v, 9) for k, v in phases.items()},
+            })
 
     # ---- jitted pieces ---------------------------------------------------
     def _prefill_group_impl(self, params, tokens, big_cache, lengths,
@@ -635,16 +700,25 @@ class ContinuousBatcher:
             # signal: the HTTP layer maps this to 503 + Retry-After
             # and the LB diverts — the request never enters a queue it
             # would sit in for several decode generations.
+            retry_s = max(1.0, 0.25 * self.num_queued)
+            if self._spans_on():
+                now = self._span_clock()
+                self._span('admission.backpressure', now, now,
+                           trace_id=trace_lib.get_trace_id(),
+                           retry_after_s=retry_s)
             raise block_pool_lib.PoolExhaustedError(
                 f'Admission queue full ({self.num_queued} waiting, '
                 f'max_queue={self.max_queue}); retry later or on '
                 f'another replica.',
-                retry_after_s=max(1.0, 0.25 * self.num_queued))
+                retry_after_s=retry_s)
         req = _Request(next(self._ids), list(prompt),
                        min(max_new_tokens,
                            self.gen.max_seq_len - len(prompt)),
                        temperature=temperature, top_p=top_p,
-                       submitted_at=time.perf_counter())
+                       submitted_at=time.perf_counter(),
+                       trace_id=trace_lib.get_trace_id())
+        if self._spans_on():
+            req.submitted_span_at = self._span_clock()
         if self.pooled and self._pool_cap(req) > self.pool.n_blocks - 1:
             # This request can NEVER be admitted — its worst-case block
             # need exceeds the whole pool.  Failing at submit (with the
@@ -880,11 +954,13 @@ class ContinuousBatcher:
                 self._slot_reserved[slot] -= need - have
                 self._tables_dirty = True
 
-    @staticmethod
-    def _observe_queue_wait(req: _Request) -> None:
+    def _observe_queue_wait(self, req: _Request) -> None:
         if req.submitted_at:
             telemetry_metrics.INFER_QUEUE_WAIT_SECONDS.observe(
                 time.perf_counter() - req.submitted_at)
+        if self._spans_on() and req.submitted_span_at:
+            self._span('queue_wait', req.submitted_span_at,
+                       self._span_clock(), req=req)
 
     def _admit(self) -> None:
         """Move queued requests into free slots: admission groups of up
@@ -928,6 +1004,10 @@ class ContinuousBatcher:
                     # still fit.
                     if match is not None:
                         match.release()
+                    if self._spans_on():
+                        now = self._span_clock()
+                        self._span('admission.backpressure_retry',
+                                   now, now, req=head)
                     idx += 1
                     continue
                 request = self._queue.pop(idx)
@@ -972,11 +1052,19 @@ class ContinuousBatcher:
                 self._positions = self._positions.at[
                     request.slot].set(park)
                 self._host_pos[request.slot] = int(park)
+                if self._spans_on():
+                    now = self._span_clock()
+                    self._span('admit', now, now, req=request,
+                               mode='chunked')
                 continue
             if match is not None and match.hit:
                 if self.pooled and not self._pool_reserve(
                         head, match.tokens // self.block_size):
                     match.release()
+                    if self._spans_on():
+                        now = self._span_clock()
+                        self._span('admission.backpressure_retry',
+                                   now, now, req=head)
                     idx += 1
                     continue
                 self._admit_prefix_hit(self._queue.pop(idx), match)
@@ -987,6 +1075,10 @@ class ContinuousBatcher:
             if self.pooled and not self._pool_reserve(head, 0):
                 # Pool backpressure: leave the request queued at its
                 # scan position — finishing requests return blocks.
+                if self._spans_on():
+                    now = self._span_clock()
+                    self._span('admission.backpressure_retry',
+                               now, now, req=head)
                 idx += 1
                 continue
             # Grouped admission: consecutive same-bucket misses
@@ -1043,6 +1135,8 @@ class ContinuousBatcher:
             # Bucket contract: the (G, bucket) prefill writes rows
             # 0..bucket-1 and each admitted row's first decode write
             # lands at len(prompt) — grow before dispatch.
+            admit_t0 = (self._span_clock() if self._spans_on()
+                        else 0.0)
             self._grow_for(max(bucket, int(lengths.max()) + 1))
             try:
                 if self.pooled:
@@ -1058,29 +1152,35 @@ class ContinuousBatcher:
                         self._pool_bind_slot(request, [])
                         row = self._slot_blocks[request.slot]
                         tables_scatter[i, :len(row)] = row
-                    (self._cache, self._token, self._positions,
-                     self._done, self._limit, self._temp_row,
-                     self._top_p_row, firsts,
-                     self._rng) = self._prefill_group(
-                        self.params, jnp.asarray(tokens), self._cache,
-                        jnp.asarray(lengths), jnp.asarray(slots),
-                        jnp.asarray(tables_scatter),
-                        self._token, self._positions, self._done,
-                        self._limit, self._temp_row, self._top_p_row,
-                        jnp.asarray(temps), jnp.asarray(top_ps),
-                        jnp.asarray(limits), self._rng)
+                    with self._profiler.phase('prefill'):
+                        (self._cache, self._token, self._positions,
+                         self._done, self._limit, self._temp_row,
+                         self._top_p_row, firsts,
+                         self._rng) = self._prefill_group(
+                            self.params, jnp.asarray(tokens),
+                            self._cache,
+                            jnp.asarray(lengths), jnp.asarray(slots),
+                            jnp.asarray(tables_scatter),
+                            self._token, self._positions, self._done,
+                            self._limit, self._temp_row,
+                            self._top_p_row,
+                            jnp.asarray(temps), jnp.asarray(top_ps),
+                            jnp.asarray(limits), self._rng)
                     self.pool.arena = self._cache
                 else:
-                    (self._cache, self._token, self._positions,
-                     self._done, self._limit, self._temp_row,
-                     self._top_p_row, firsts,
-                     self._rng) = self._prefill_group(
-                        self.params, jnp.asarray(tokens), self._cache,
-                        jnp.asarray(lengths), jnp.asarray(slots),
-                        self._token, self._positions, self._done,
-                        self._limit, self._temp_row, self._top_p_row,
-                        jnp.asarray(temps), jnp.asarray(top_ps),
-                        jnp.asarray(limits), self._rng)
+                    with self._profiler.phase('prefill'):
+                        (self._cache, self._token, self._positions,
+                         self._done, self._limit, self._temp_row,
+                         self._top_p_row, firsts,
+                         self._rng) = self._prefill_group(
+                            self.params, jnp.asarray(tokens),
+                            self._cache,
+                            jnp.asarray(lengths), jnp.asarray(slots),
+                            self._token, self._positions, self._done,
+                            self._limit, self._temp_row,
+                            self._top_p_row,
+                            jnp.asarray(temps), jnp.asarray(top_ps),
+                            jnp.asarray(limits), self._rng)
                 self._host_temp[slots] = temps
                 self._host_top_p[slots] = top_ps
             except Exception:
@@ -1115,7 +1215,14 @@ class ContinuousBatcher:
                                 req.slot))
             # ONE counted sync for the whole admitted group — the
             # per-request int() below reads host memory, not device.
-            (firsts,) = engine_lib.host_fetch(firsts)
+            (firsts,) = self._fetch(firsts)
+            if self._spans_on():
+                now = self._span_clock()
+                for req in group:
+                    self._span('admit', admit_t0, now, req=req,
+                               mode='cold', group=effective)
+                    self._span('prefill_chunk', admit_t0, now, req=req,
+                               start=0, end=len(req.prompt))
             for i, req in enumerate(group):
                 self._host_pos[req.slot] = len(req.prompt)
                 req.out.append(int(firsts[i]))
@@ -1143,6 +1250,8 @@ class ContinuousBatcher:
         every other admission route."""
         req.slot = self._free.pop(0)
         self._observe_queue_wait(req)
+        hit_t0 = self._span_clock() if self._spans_on() else 0.0
+        shared_tokens = match.tokens
         self._prefix.commit(match)
         prompt = req.prompt
         # Bucket contract: head blocks + suffix windows write rows
@@ -1175,15 +1284,23 @@ class ContinuousBatcher:
                 window = np.zeros((w,), np.int32)
                 window[:end - start] = np.asarray(prompt[start:end],
                                                   np.int32)
+                w0 = (self._span_clock() if self._spans_on()
+                      else 0.0)
                 if self.pooled:
-                    h_last, self._cache = self._prefill_window(
-                        self.params, jnp.asarray(window), self._cache,
-                        table_row, jnp.int32(start))
+                    with self._profiler.phase('prefill'):
+                        h_last, self._cache = self._prefill_window(
+                            self.params, jnp.asarray(window),
+                            self._cache, table_row, jnp.int32(start))
                     self.pool.arena = self._cache
                 else:
-                    h_last, self._cache = self._prefill_window(
-                        self.params, jnp.asarray(window), self._cache,
-                        jnp.int32(req.slot), jnp.int32(start))
+                    with self._profiler.phase('prefill'):
+                        h_last, self._cache = self._prefill_window(
+                            self.params, jnp.asarray(window),
+                            self._cache, jnp.int32(req.slot),
+                            jnp.int32(start))
+                if self._spans_on():
+                    self._span('prefill_chunk', w0, self._span_clock(),
+                               req=req, start=start, end=end)
                 last_start = start
                 start = end
             if self.pooled:
@@ -1195,6 +1312,10 @@ class ContinuousBatcher:
                 self._prefix.insert(prompt,
                                     blocks=self._slot_blocks[req.slot])
             self._complete_prefill(req, h_last, last_start)
+            if self._spans_on():
+                self._span('admit', hit_t0, self._span_clock(),
+                           req=req, mode='prefix_hit',
+                           shared_tokens=shared_tokens)
         except Exception:
             # Same contract as the other admission handlers: reclaim
             # the slot and re-queue before surfacing the error.
@@ -1219,23 +1340,24 @@ class ContinuousBatcher:
         temp = (default_temp if req.temperature is None
                 else req.temperature)
         top_p = default_top_p if req.top_p is None else req.top_p
-        (self._token, self._positions, self._done, self._limit,
-         self._temp_row, self._top_p_row, first,
-         self._rng) = self._install_first(
-            self.params, h_last,
-            jnp.int32(len(req.prompt) - 1 - last_start),
-            self._token, self._positions, self._done, self._limit,
-            self._temp_row, self._top_p_row,
-            jnp.int32(len(req.prompt)), jnp.int32(req.slot),
-            jnp.float32(temp), jnp.float32(top_p),
-            jnp.int32(req.max_new_tokens - 1), self._rng)
+        with self._profiler.phase('prefill'):
+            (self._token, self._positions, self._done, self._limit,
+             self._temp_row, self._top_p_row, first,
+             self._rng) = self._install_first(
+                self.params, h_last,
+                jnp.int32(len(req.prompt) - 1 - last_start),
+                self._token, self._positions, self._done, self._limit,
+                self._temp_row, self._top_p_row,
+                jnp.int32(len(req.prompt)), jnp.int32(req.slot),
+                jnp.float32(temp), jnp.float32(top_p),
+                jnp.int32(req.max_new_tokens - 1), self._rng)
         self._host_pos[req.slot] = len(req.prompt)
         self._host_temp[req.slot] = temp
         self._host_top_p[req.slot] = top_p
         eos = self.gen.eos_token
         # Counted sync: the first sampled token is the one value the
         # scheduler needs on host to test EOS/limit before promotion.
-        (first_host,) = engine_lib.host_fetch(first)
+        (first_host,) = self._fetch(first)
         req.out.append(int(first_host))
         if req.submitted_at:
             # TTFT split cold-vs-fused: did any of this prompt's
@@ -1257,6 +1379,10 @@ class ContinuousBatcher:
 
     def _finish(self, req: _Request) -> None:
         req.done = True
+        if self._spans_on():
+            now = self._span_clock()
+            self._span('delivery', now, now, req=req,
+                       tokens=len(req.out))
         if req.slot is not None and req.slot in self._active:
             del self._active[req.slot]
         if req.slot is not None:
@@ -1290,17 +1416,20 @@ class ContinuousBatcher:
         window = np.zeros((w,), np.int32)
         window[:end - start] = np.asarray(req.prompt[start:end],
                                           np.int32)
+        w0 = self._span_clock() if self._spans_on() else 0.0
         try:
             if self.pooled:
-                h_last, self._cache = self._prefill_window(
-                    self.params, jnp.asarray(window), self._cache,
-                    jnp.asarray(self._host_tables[req.slot]),
-                    jnp.int32(start))
+                with self._profiler.phase('prefill'):
+                    h_last, self._cache = self._prefill_window(
+                        self.params, jnp.asarray(window), self._cache,
+                        jnp.asarray(self._host_tables[req.slot]),
+                        jnp.int32(start))
                 self.pool.arena = self._cache
             else:
-                h_last, self._cache = self._prefill_window(
-                    self.params, jnp.asarray(window), self._cache,
-                    jnp.int32(req.slot), jnp.int32(start))
+                with self._profiler.phase('prefill'):
+                    h_last, self._cache = self._prefill_window(
+                        self.params, jnp.asarray(window), self._cache,
+                        jnp.int32(req.slot), jnp.int32(start))
         except Exception:
             # Same contract as the grouped-admission handler: a failed
             # dispatch must not leak the slot or leave _incremental set
@@ -1317,6 +1446,9 @@ class ContinuousBatcher:
             self._queue.insert(0, req)
             raise
         req.prefill_pos = end
+        if self._spans_on():
+            self._span('prefill_chunk', w0, self._span_clock(),
+                       req=req, start=start, end=end)
         if end < len(req.prompt):
             return
         try:
@@ -1367,24 +1499,29 @@ class ContinuousBatcher:
                     if self._drafter is not None else None)
         self._ensure_slot_blocks(n)
         if self._tables_dirty:
-            self._tables_dev = jnp.asarray(self._host_tables)
+            with self._profiler.phase('upload'):
+                self._tables_dev = jnp.asarray(self._host_tables)
             self._tables_dirty = False
         all_greedy = not any(
             float(self._host_temp[s]) > 0.0 for s in self._active)
         nucleus = any(
             float(self._host_top_p[s]) < 1.0 for s in self._active)
         active_slots = len(self._active)
+        tick_t0 = self._span_clock() if self._spans_on() else 0.0
         chunk_start = time.perf_counter()
         try:
-            (toks, self._token, self._cache, self._positions,
-             self._done, self._limit, self._rng, h_pf) = self._fused(
-                self.params, self._token, self._cache, self._positions,
-                self._done, self._limit, self._temp_row,
-                self._top_p_row, self._rng, self._tables_dev,
-                jnp.asarray(window),
-                jnp.asarray(self._host_tables[req.slot]),
-                jnp.int32(start), n=n, all_greedy=all_greedy,
-                nucleus=nucleus)
+            with self._profiler.phase('fused'):
+                (toks, self._token, self._cache, self._positions,
+                 self._done, self._limit, self._rng,
+                 h_pf) = self._fused(
+                    self.params, self._token, self._cache,
+                    self._positions,
+                    self._done, self._limit, self._temp_row,
+                    self._top_p_row, self._rng, self._tables_dev,
+                    jnp.asarray(window),
+                    jnp.asarray(self._host_tables[req.slot]),
+                    jnp.int32(start), n=n, all_greedy=all_greedy,
+                    nucleus=nucleus)
         except Exception:
             # _advance_prefill's abort contract: a failed dispatch must
             # not leak the slot or leave _incremental set (restart from
@@ -1404,8 +1541,12 @@ class ContinuousBatcher:
         self.pool.arena = self._cache
         # ONE transfer for the whole fused chunk — identical budget to
         # the plain decode tick.
-        host, host_pos, _ = engine_lib.host_fetch(
+        host, host_pos, _ = self._fetch(
             toks, self._positions, self._done)
+        if self._spans_on():
+            self._span('fused_tick', tick_t0, self._span_clock(),
+                       req=req, prefill_chunk=chunk, n=n,
+                       slots=active_slots)
         self._host_pos = host_pos.astype(np.int64)
         if prev_pos is not None:
             for slot in list(self._active):
@@ -1478,21 +1619,33 @@ class ContinuousBatcher:
         # from _pool_cap guarantees the draw can't exhaust the pool).
         self._ensure_slot_blocks(win)
         if self._tables_dirty:
-            self._tables_dev = jnp.asarray(self._host_tables)
+            with self._profiler.phase('upload'):
+                self._tables_dev = jnp.asarray(self._host_tables)
             self._tables_dirty = False
         all_greedy = not any(
             float(self._host_temp[s]) > 0.0 for s in self._active)
         nucleus = any(
             float(self._host_top_p[s]) < 1.0 for s in self._active)
         live = list(self._active)
-        draft = self._drafter.propose_batch(live, self.gen.batch_size)
+        spans_on = self._spans_on()
+        d0 = self._span_clock() if spans_on else 0.0
+        with self._profiler.phase('spec_draft'):
+            draft = self._drafter.propose_batch(live,
+                                                self.gen.batch_size)
+        if spans_on:
+            self._span('spec_draft', d0, self._span_clock(),
+                       k=self.gen.spec_k, slots=len(live))
+        v0 = self._span_clock() if spans_on else 0.0
         chunk_start = time.perf_counter()
-        (toks, self._token, self._cache, self._positions, self._done,
-         self._limit, committed_dev, self._rng) = self._verify(
-            self.params, self._token, self._cache, self._positions,
-            self._done, self._limit, self._temp_row, self._top_p_row,
-            self._rng, self._tables_dev, jnp.asarray(draft),
-            all_greedy=all_greedy, nucleus=nucleus)
+        with self._profiler.phase('spec_verify'):
+            (toks, self._token, self._cache, self._positions,
+             self._done,
+             self._limit, committed_dev, self._rng) = self._verify(
+                self.params, self._token, self._cache, self._positions,
+                self._done, self._limit, self._temp_row,
+                self._top_p_row,
+                self._rng, self._tables_dev, jnp.asarray(draft),
+                all_greedy=all_greedy, nucleus=nucleus)
         # The arena was donated through the verify: rebind the pool's
         # handle before anything else can observe it.
         self.pool.arena = self._cache
@@ -1500,8 +1653,11 @@ class ContinuousBatcher:
         # the control rows and each lane's committed count (the host
         # absorbs exactly that prefix — fill rows past it are rejected
         # tail, NOT tokens).
-        host, host_pos, _, host_committed = engine_lib.host_fetch(
+        host, host_pos, _, host_committed = self._fetch(
             toks, self._positions, self._done, committed_dev)
+        if spans_on:
+            self._span('spec_verify', v0, self._span_clock(),
+                       k=self.gen.spec_k, slots=len(live))
         self._host_pos = host_pos.astype(np.int64)
         chunk_dt = time.perf_counter() - chunk_start
         telemetry_metrics.INFER_DECODE_CHUNK_SECONDS.observe(chunk_dt)
@@ -1548,8 +1704,21 @@ class ContinuousBatcher:
         """One scheduler tick: admit queued requests, advance the
         in-flight chunked prefill by one window (or piggyback it onto
         the decode chunk when fusing is on), then one decode chunk for
-        all active slots."""
-        self._admit()
+        all active slots.
+
+        Every tick runs under the StepProfiler: phase times land in
+        skytpu_infer_step_phase_seconds / _utilization even when the
+        tick raises (the profiler finishes in the finally — a failed
+        dispatch still accounts for the time it burned)."""
+        self._profiler.start()
+        try:
+            self._step_inner()
+        finally:
+            self._finish_step_profile()
+
+    def _step_inner(self) -> None:
+        with self._profiler.phase('admit'):
+            self._admit()
         # Fuse gate: an in-flight chunked prefill AND a live decode
         # batch to piggyback on.  With no decode batch, a dedicated
         # window is strictly better (no padded decode rows to carry);
@@ -1590,7 +1759,8 @@ class ContinuousBatcher:
             # traffic already tracks live context through the tables.
             self._ensure_slot_blocks(n)
             if self._tables_dirty:
-                self._tables_dev = jnp.asarray(self._host_tables)
+                with self._profiler.phase('upload'):
+                    self._tables_dev = jnp.asarray(self._host_tables)
                 self._tables_dirty = False
             tables_arg = self._tables_dev
         else:
@@ -1610,13 +1780,18 @@ class ContinuousBatcher:
         nucleus = any(
             float(self._host_top_p[s]) < 1.0 for s in self._active)
         active_slots = len(self._active)
+        spans_on = self._spans_on()
+        c0 = self._span_clock() if spans_on else 0.0
         chunk_start = time.perf_counter()
-        (toks, self._token, self._cache, self._positions, self._done,
-         self._limit, self._rng) = self._decode(
-            self.params, self._token, self._cache, self._positions,
-            self._done, self._limit, self._temp_row, self._top_p_row,
-            self._rng, tables_arg, n=n, all_greedy=all_greedy,
-            nucleus=nucleus)
+        with self._profiler.phase('decode'):
+            (toks, self._token, self._cache, self._positions,
+             self._done,
+             self._limit, self._rng) = self._decode(
+                self.params, self._token, self._cache, self._positions,
+                self._done, self._limit, self._temp_row,
+                self._top_p_row,
+                self._rng, tables_arg, n=n, all_greedy=all_greedy,
+                nucleus=nucleus)
         if self.pooled:
             # The arena was donated through the chunk: rebind the
             # pool's handle before anything else can observe it.
@@ -1625,8 +1800,11 @@ class ContinuousBatcher:
         # time): the token block plus the control rows steering the
         # next tick.  Positions come back exact — frozen slots did NOT
         # advance, so no more += n mirror arithmetic.
-        host, host_pos, _ = engine_lib.host_fetch(
+        host, host_pos, _ = self._fetch(
             toks, self._positions, self._done)
+        if spans_on:
+            self._span('decode_chunk', c0, self._span_clock(),
+                       n=n, slots=active_slots)
         self._host_pos = host_pos.astype(np.int64)
         if prev_pos is not None:
             # Sequential ticks still feed the drafter: the emitted rows'

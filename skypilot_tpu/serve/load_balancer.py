@@ -35,7 +35,10 @@ from typing import Any, Dict, List, Optional, Set
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import failover as failover_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
+from skypilot_tpu.telemetry import spans as spans_lib
+from skypilot_tpu.telemetry import trace as trace_lib
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu.serve.controller import ServeController
@@ -68,6 +71,9 @@ class SkyServeLoadBalancer:
         # body chunk; drained into the controller report each sync so
         # SLOAutoscaler sees one decision interval's worth at a time.
         self.ttft_ms_samples: List[float] = []
+        # TTFT SLO burn-rate windows, exported as
+        # skytpu_serve_slo_burn_rate{window} each controller sync.
+        self.slo = slo_lib.SLOMonitor()
         self._ts_lock = threading.Lock()
         self._runner = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -88,6 +94,7 @@ class SkyServeLoadBalancer:
         misses = getattr(self.policy, 'affinity_misses', None)
         if hits is not None and (hits + misses) > 0:
             report['prefix_hit_ratio'] = hits / (hits + misses)
+        self.slo.export(time.time())
         ready = self.controller.lb_sync(timestamps, report or None)
         # Health state for replicas that left the fleet goes with them;
         # the policy only ever sees replicas the breaker lets route
@@ -153,9 +160,19 @@ class SkyServeLoadBalancer:
         with self._ts_lock:
             self.request_timestamps.append(time.time())
         body = await request.read()
+        # One trace id per end-to-end request: honor the caller's
+        # X-Skytpu-Trace-Id or mint one; _proxy_attempt forwards it so
+        # the replica's batcher spans join this LB's flame row.
+        trace_id = (request.headers.get(trace_lib.TRACE_HEADER)
+                    or trace_lib.new_trace_id())
         context = self._request_context(body)
         exclude: Set[str] = set()
+        sel_t0 = time.time()
         url = self._pick(context, exclude)
+        if spans_lib.enabled():
+            spans_lib.record('lb.select', sel_t0, time.time(),
+                             trace_id=trace_id, replica=url,
+                             policy=self.policy.name)
         if url is None:
             # Cold start / stale set: resync before failing (a replica may
             # have become READY since the last interval sync).
@@ -170,7 +187,8 @@ class SkyServeLoadBalancer:
         for _ in range(LB_MAX_ROUTE_ATTEMPTS):
             if url is None:
                 break
-            kind, value = await self._proxy_attempt(request, body, url)
+            kind, value = await self._proxy_attempt(request, body, url,
+                                                    trace_id)
             if kind == 'response':
                 return value
             exclude.add(url)
@@ -206,7 +224,8 @@ class SkyServeLoadBalancer:
             status=503,
             text='No ready replicas. Use "serve status" to check.')
 
-    async def _proxy_attempt(self, request, body: bytes, url: str):
+    async def _proxy_attempt(self, request, body: bytes, url: str,
+                             trace_id: Optional[str] = None):
         """Proxy one attempt to `url`.  Returns ('response', resp) when
         the request is answered (including an honestly-truncated
         stream), ('backpressure', retry_after_s) on a 503 divert, or
@@ -220,12 +239,17 @@ class SkyServeLoadBalancer:
         out = None
         start = time.perf_counter()
         status = 'error'
+        headers_out = request.headers.copy()
+        if trace_id is not None:
+            # Propagate the request's trace id so the replica's
+            # batcher spans correlate with this proxy span.
+            headers_out[trace_lib.TRACE_HEADER] = trace_id
         try:
             target = url + str(request.rel_url)
             async with aiohttp.ClientSession(auto_decompress=False) as sess:
                 async with sess.request(
                         request.method, target,
-                        headers=request.headers.copy(),
+                        headers=headers_out,
                         data=body,
                         allow_redirects=False) as resp:
                     if resp.status == 503:
@@ -261,6 +285,7 @@ class SkyServeLoadBalancer:
                                 .observe(ttft)
                             with self._ts_lock:
                                 self.ttft_ms_samples.append(ttft * 1000.0)
+                                self.slo.observe_ttft(ttft, time.time())
                         await out.write(chunk)
                     await out.write_eof()
                     return ('response', out)
@@ -292,6 +317,10 @@ class SkyServeLoadBalancer:
                 replica=url, status=status).inc()
             telemetry_metrics.SERVE_REPLICA_SECONDS.labels(
                 replica=url).observe(time.perf_counter() - start)
+            if spans_lib.enabled():
+                spans_lib.record('lb.proxy', now, time.time(),
+                                 trace_id=trace_id, replica=url,
+                                 status=status)
 
     async def _sync_loop(self):
         while True:

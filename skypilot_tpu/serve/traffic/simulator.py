@@ -62,13 +62,23 @@ from typing import Any, Callable, Dict, List, Optional, Set
 
 from skypilot_tpu.serve import failover as failover_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.traffic.generator import (Arrival, TrafficConfig,
                                                   generate_trace)
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
+from skypilot_tpu.telemetry import spans as spans_lib
+from skypilot_tpu.telemetry import trace as trace_lib
 from skypilot_tpu.utils.backoff import Backoff
 
 FAULT_KINDS = ('kill', 'preempt', 'stall', 'partition')
+
+
+def _session_trace_id(sid: int) -> str:
+    """Deterministic per-session trace id (the LB header analogue):
+    the trace a sim run exports must be byte-identical per seed, so
+    ids derive from the session index, not uuid4."""
+    return f'{sid:016x}'
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,6 +140,9 @@ class SimConfig:
     num_replicas: int = 2
     # SERVE_SUMMARY goodput counts completions whose TTFT met this SLO.
     slo_ttft_s: float = 2.0
+    # Per-token decode-cadence target for the SLO burn-rate monitor
+    # (None = TPOT signal disabled; TTFT always uses slo_ttft_s).
+    slo_tpot_s: Optional[float] = None
     # Fleet scheduling quantum: arrivals dispatch and replicas catch up
     # once per tick.  Smaller = finer TTFT resolution, more host loops.
     tick_s: float = 0.25
@@ -208,11 +221,15 @@ class _ReplicaSim:
     """One replica: a real ContinuousBatcher plus a virtual clock."""
 
     def __init__(self, replica_id: int, url: str, batcher,
-                 cfg: SimConfig) -> None:
+                 cfg: SimConfig,
+                 span_buf: Optional[spans_lib.SpanBuffer] = None) -> None:
         self.replica_id = replica_id
         self.url = url
         self.batcher = batcher
         self.cfg = cfg
+        # The batcher records its spans here on THIS replica's virtual
+        # clock (fixed pid = replica_id + 1; pid 0 is the sim plane).
+        self.span_buf = span_buf
         self.vclock = 0.0
         self.draining = False
         # Chaos state (inert without a ChaosConfig).
@@ -252,7 +269,11 @@ class _ReplicaSim:
         # An idle replica's clock has nothing to do before the request
         # exists; work can never be charged to the past.
         self.vclock = max(self.vclock, now)
-        rid = self.batcher.submit(prompt, max_new_tokens=max_new_tokens)
+        # The trace scope is the sim's stand-in for the LB's
+        # X-Skytpu-Trace-Id header: the batcher stamps its spans with
+        # the ambient trace id at submit.
+        with trace_lib.trace_scope(_session_trace_id(sid)):
+            rid = self.batcher.submit(prompt, max_new_tokens=max_new_tokens)
         self.rid_sid[rid] = sid
         self.rid_plen[rid] = len(prompt)
         self.inflight.append(rid)
@@ -399,6 +420,13 @@ class FleetSimulator:
                 self.cfg.policy)
         self._ids = itertools.count(0)
         self._now = 0.0
+        # Sim-plane spans (routing, session completion, failover) land
+        # on pid 0; replica batchers get their own per-vclock buffers.
+        self._span_buf = spans_lib.SpanBuffer(pid=0, tid=0,
+                                              clock=lambda: self._now)
+        self.slo = slo_lib.SLOMonitor(slo_lib.SLOConfig(
+            ttft_target_s=self.cfg.slo_ttft_s,
+            tpot_target_s=self.cfg.slo_tpot_s))
         self.replicas: List[_ReplicaSim] = []
         self.retired: List[_ReplicaSim] = []
         self.dead: List[_ReplicaSim] = []
@@ -441,10 +469,19 @@ class FleetSimulator:
         from skypilot_tpu.infer.serving import ContinuousBatcher
         rid = next(self._ids)
         url = f'replica-{rid}'
+        # The batcher's span clock reads the replica's vclock, so the
+        # spans it emits are virtual-time (hence deterministic per
+        # seed).  `cell` breaks the construction cycle: the clock must
+        # exist before the batcher, the batcher before the replica.
+        span_buf = spans_lib.SpanBuffer(pid=rid + 1, tid=0)
+        cell: List[_ReplicaSim] = []
         batcher = ContinuousBatcher(self.params, self.model_config,
                                     self.gen,
-                                    decode_chunk=self.cfg.decode_chunk)
-        rep = _ReplicaSim(rid, url, batcher, self.cfg)
+                                    decode_chunk=self.cfg.decode_chunk,
+                                    span_buffer=span_buf,
+                                    span_clock=lambda: cell[0].vclock)
+        rep = _ReplicaSim(rid, url, batcher, self.cfg, span_buf=span_buf)
+        cell.append(rep)
         rep.last_progress_t = self._now
         self.replicas.append(rep)
         self._by_url[url] = rep
@@ -552,6 +589,9 @@ class FleetSimulator:
             raise RuntimeError('No ready replicas to route to')
         self.policy.pre_execute_hook(url)
         rep = self._by_url[url]
+        self._span_buf.record('lb.select', arrival.t, arrival.t,
+                              trace_id=_session_trace_id(sid),
+                              replica=url, policy=self.policy.name)
         rid = rep.submit(arrival.prompt, arrival.max_new_tokens, sid,
                          now=arrival.t)
         # The journal's budget is the batcher's post-clamp budget, so
@@ -592,11 +632,15 @@ class FleetSimulator:
         if st.rec.first_token_t is None:
             st.rec.first_token_t = t
             self._report_ttfts.append(t - st.rec.arrival_t)
+            self.slo.observe_ttft(t - st.rec.arrival_t, now=t)
         if st.fault_detect_t is not None and st.refirst_t is None:
             st.refirst_t = t
             lat = t - st.fault_detect_t
             self._failover_latencies.append(lat)
             telemetry_metrics.SERVE_FAILOVER_LATENCY_SECONDS.observe(lat)
+            self._span_buf.record('failover.resume', t, t,
+                                  trace_id=_session_trace_id(sid),
+                                  latency_s=lat)
 
     def _complete(self, rep: _ReplicaSim, rid: int, t: float) -> bool:
         """Returns True when the replica may discard the request; False
@@ -617,6 +661,12 @@ class FleetSimulator:
         st.rec.done_t = t
         st.rec.out_len = len(rec.committed)
         self.completed.append(st.rec)
+        if st.rec.first_token_t is not None and st.rec.out_len > 1:
+            tpot = (t - st.rec.first_token_t) / (st.rec.out_len - 1)
+            self.slo.observe_tpot(tpot, now=t)
+        self._span_buf.record('session.complete', t, t,
+                              trace_id=_session_trace_id(sid),
+                              tokens=st.rec.out_len)
 
     def _flush_parked(self, rep: _ReplicaSim, now: float) -> None:
         """Deliver the tails of requests that finished behind a now-
@@ -762,6 +812,9 @@ class FleetSimulator:
         st = self._sessions[sid]
         st.fault_detect_t = now
         st.refirst_t = None
+        self._span_buf.record('failover.detect', now, now,
+                              trace_id=_session_trace_id(sid),
+                              planned=planned)
         spec = self.journal.replay_spec(sid)
         if spec is None:
             # Every budgeted token was already delivered — only the
@@ -782,6 +835,9 @@ class FleetSimulator:
         self.journal.reassign(sid, url)
         st.rid = rid
         replayed = len(self.journal.record(sid).committed)
+        self._span_buf.record('failover.replay', now, now,
+                              trace_id=_session_trace_id(sid),
+                              replayed=replayed, target=url)
         self.replayed_tokens += replayed
         if replayed:
             telemetry_metrics.SERVE_FAILOVER_REPLAYED_TOKENS.inc(replayed)
@@ -835,6 +891,26 @@ class FleetSimulator:
             {'t': round(now, 3), 'replicas': len(self._live())})
 
     # ---- metrics ---------------------------------------------------------
+    def export_trace(self, path: str) -> int:
+        """Merge the sim-plane spans and EVERY replica's spans — live,
+        retired, and dead (a killed replica's prefill/decode spans are
+        part of the story) — into one Perfetto trace at `path`.  All
+        timestamps are virtual and pids are fixed, so a fresh-path
+        export is byte-identical for the same seeds.  Returns the
+        event count written."""
+        extra: List[Dict[str, Any]] = []
+        for rep in self.replicas + self.retired + self.dead:
+            if rep.span_buf is not None:
+                extra.extend(rep.span_buf.events())
+        return self._span_buf.export(path, extra_events=extra)
+
+    def span_count(self) -> int:
+        """Spans captured across the sim plane and all replicas."""
+        return len(self._span_buf) + sum(
+            len(rep.span_buf)
+            for rep in self.replicas + self.retired + self.dead
+            if rep.span_buf is not None)
+
     def prefix_hit_ratio(self) -> Optional[float]:
         hits = misses = 0
         for rep in self.replicas + self.retired:
@@ -880,6 +956,7 @@ class FleetSimulator:
         def _round(value):
             return None if value is None else round(value, 6)
 
+        burn = self.slo.export(self._now)
         out = {
             'policy': self.policy.name,
             'requests': len(recs),
@@ -892,6 +969,8 @@ class FleetSimulator:
                 sum(tpots) / len(tpots) * 1000 if tpots else None),
             'goodput_rps': _round(met / span if span else 0.0),
             'slo_attainment': _round(met / len(recs) if recs else None),
+            'slo_burn_fast': _round(burn['fast']),
+            'slo_burn_slow': _round(burn['slow']),
             'affinity_hit_ratio': _round(affinity),
             'prefix_hit_ratio': _round(self.prefix_hit_ratio()),
             'prefix_tokens_saved': tokens_saved,

@@ -495,6 +495,35 @@ SERVE_CHAOS_FAULTS = prometheus_client.Counter(
     ['kind'],
     registry=REGISTRY)
 
+# ---- step-phase attribution + SLO burn (telemetry/spans.py, serve/slo.py)
+
+INFER_STEP_PHASE_SECONDS = prometheus_client.Histogram(
+    'skytpu_infer_step_phase_seconds',
+    'Host time one batcher step() spent in each exclusive phase '
+    '(admit / prefill / fused / spec_draft / spec_verify / decode / '
+    'host_fetch / upload); phases sum to ~step wall time, so the '
+    'per-phase rate() ratio is the step-time breakdown',
+    ['phase'],
+    buckets=(1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5),
+    registry=REGISTRY)
+
+INFER_STEP_UTILIZATION = prometheus_client.Gauge(
+    'skytpu_infer_step_utilization',
+    'Fraction of the last step() wall time attributed to each phase '
+    '(instantaneous view of the same breakdown as '
+    'skytpu_infer_step_phase_seconds)',
+    ['phase'],
+    registry=REGISTRY)
+
+SERVE_SLO_BURN_RATE = prometheus_client.Gauge(
+    'skytpu_serve_slo_burn_rate',
+    'SRE-style error-budget burn rate per rolling window (fast/slow): '
+    'violating_fraction / (1 - objective) over the window; 1.0 burns '
+    'the budget exactly at the SLO rate, sustained >>1 is page '
+    'material',
+    ['window'],
+    registry=REGISTRY)
+
 
 def record_autoscaler_decisions(service_name: str,
                                 decisions: List[Any]) -> None:

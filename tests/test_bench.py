@@ -89,6 +89,68 @@ def test_headline_surfaces_suberrors():
     assert h2['launch_latency_error'] == 'timeout'
 
 
+def test_trace_summary_rolls_up_phases_and_serve_trace():
+    from skypilot_tpu.telemetry import metrics as telemetry_metrics
+    telemetry_metrics.INFER_STEP_PHASE_SECONDS.labels(
+        phase='decode').observe(0.3)
+    telemetry_metrics.INFER_STEP_PHASE_SECONDS.labels(
+        phase='prefill').observe(0.1)
+    out = bench.trace_summary(
+        decode={'span_overhead': {'span_overhead_pct': 1.4}},
+        serve={'trace': {'path': '/tmp/t.json', 'events': 10,
+                         'spans_captured': 10, 'requests_traced': 3,
+                         'full_chain_requests': 3, 'chain_ok': True},
+               'prefix_affinity': {'slo_burn_fast': 1.5,
+                                   'slo_burn_slow': 0.5}})
+    assert out['chain_ok'] is True
+    assert out['spans_captured'] == 10 and out['trace_events'] == 10
+    assert out['requests_traced'] == 3
+    assert out['full_chain_requests'] == 3
+    assert out['trace_path'] == '/tmp/t.json'
+    assert out['span_overhead_pct'] == 1.4
+    assert out['slo_burn_fast'] == 1.5 and out['slo_burn_slow'] == 0.5
+    # Shares are normalized over whatever the registry accumulated
+    # this process (other tests may have stepped batchers too).
+    shares = out['step_phase_shares']
+    assert shares and 0.99 < sum(shares.values()) < 1.01
+    assert out['step_phase_seconds_total'] > 0
+    # Tail contract: one JSON line.
+    import json
+    line = 'TRACE_SUMMARY ' + json.dumps(out)
+    assert '\n' not in line and json.loads(line.split(' ', 1)[1]) == out
+
+
+def test_trace_summary_tolerates_errored_subbenches():
+    out = bench.trace_summary(decode={'error': 'x'}, serve={'error': 'y'})
+    assert out['spans_captured'] is None
+    assert out['chain_ok'] is None
+    assert out['span_overhead_pct'] is None
+    assert out['slo_burn_fast'] is None
+
+
+def test_headline_carries_trace_block():
+    trace = {'step_phase_shares': {'decode': 0.6, 'prefill': 0.4},
+             'step_phase_seconds_total': 2.5, 'spans_captured': 12,
+             'trace_events': 12, 'trace_path': '/tmp/t.json',
+             'requests_traced': 4, 'full_chain_requests': 4,
+             'chain_ok': True, 'span_overhead_pct': 0.9,
+             'slo_burn_fast': 2.0, 'slo_burn_slow': 1.0}
+    h = bench.build_headline(tok_s=1.0, mfu=0.1, llama8b={},
+                             decode={}, latency=None, trace=trace)
+    assert h['trace'] == {
+        'step_phase_shares': {'decode': 0.6, 'prefill': 0.4},
+        'spans_captured': 12, 'full_chain_requests': 4,
+        'span_overhead_pct': 0.9,
+        'slo_burn_fast': 2.0, 'slo_burn_slow': 1.0}
+    h2 = bench.build_headline(tok_s=1.0, mfu=0.1, llama8b={},
+                              decode={}, latency=None,
+                              trace={'error': 'boom' * 100})
+    assert len(h2['trace']['error']) == 120
+    h3 = bench.build_headline(tok_s=1.0, mfu=0.1, llama8b={},
+                              decode={}, latency=None)
+    assert 'trace' not in h3
+
+
 @pytest.mark.slow
 def test_8b_extrapolation_reports_check_and_convention():
     out = bench.bench_8b_extrapolated(on_tpu=False)

@@ -1,0 +1,120 @@
+"""Metrics ↔ docs parity: docs/observability.md is the dashboard
+contract, so it must list EXACTLY the data-plane families the shared
+registry exports (both directions), and its benchmark summary-line
+catalogue must match what bench.py actually prints.  A new family or
+summary line without a doc row — or a doc row for a family that no
+longer exists — fails here, not in a design review six months later.
+"""
+import pathlib
+import re
+
+import skypilot_tpu.telemetry.metrics  # noqa: F401  (registers families)
+from skypilot_tpu.metrics import REGISTRY
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+_DOC = _REPO / 'docs' / 'observability.md'
+
+# Control-plane families live in the API-server / agent doc sections,
+# not the data-plane table this test audits.
+_EXEMPT_PREFIXES = ('skytpu_api_', 'skytpu_agent_')
+
+_NAME_RE = re.compile(r'`(skytpu_[a-z0-9_]+)(?:\{[^}]*\})?`')
+_SUMMARY_RE = re.compile(r'\b([A-Z][A-Z_]*_SUMMARY)\b')
+
+
+def _doc_text():
+    return _DOC.read_text(encoding='utf-8')
+
+
+def _metric_table():
+    """Rows of the data-plane family table (from its header to the
+    first non-table line)."""
+    lines = _doc_text().splitlines()
+    start = lines.index('| family | type | what |')
+    rows = []
+    for line in lines[start + 2:]:
+        if not line.startswith('|'):
+            break
+        rows.append(line)
+    assert rows, 'family table is empty'
+    return rows
+
+
+def _documented_names():
+    """Family names claimed by the table — the backticked skytpu_*
+    names in each row's FIRST cell (a row may name several families;
+    later cells may reference other families)."""
+    names = set()
+    for row in _metric_table():
+        first_cell = row.split('|')[1]
+        found = _NAME_RE.findall(first_cell)
+        assert found, f'table row without a backticked family: {row!r}'
+        names.update(found)
+    return names
+
+
+def _registry_families():
+    """{family name: type} for data-plane skytpu_* families."""
+    fams = {}
+    for family in REGISTRY.collect():
+        if not family.name.startswith('skytpu_'):
+            continue
+        if family.name.startswith(_EXEMPT_PREFIXES):
+            continue
+        fams[family.name] = family.type
+    assert len(fams) >= 50, 'registry import lost families?'
+    return fams
+
+
+def test_every_registry_family_is_documented():
+    documented = _documented_names()
+    missing = []
+    for name, kind in _registry_families().items():
+        # collect() strips `_total` from counter FAMILY names while the
+        # exposition (and the doc) keeps it on the sample name.
+        candidates = {name, name + '_total'} if kind == 'counter' \
+            else {name}
+        if not candidates & documented:
+            missing.append(name)
+    assert not missing, (
+        f'registry families missing a docs/observability.md row: '
+        f'{sorted(missing)}')
+
+
+def test_every_documented_family_exists_in_registry():
+    fams = _registry_families()
+    known = set(fams)
+    known |= {n + '_total' for n, kind in fams.items()
+              if kind == 'counter'}
+    stale = sorted(_documented_names() - known)
+    assert not stale, (
+        f'docs/observability.md documents families the registry no '
+        f'longer exports: {stale}')
+
+
+# --- benchmark summary lines ------------------------------------------------
+
+def _documented_summaries():
+    """Summary tokens named in the 'Benchmark summary lines' section
+    (up to the next ## heading)."""
+    text = _doc_text()
+    start = text.index('### Benchmark summary lines')
+    end = text.index('\n## ', start)
+    return set(_SUMMARY_RE.findall(text[start:end]))
+
+
+def _bench_summaries():
+    source = (_REPO / 'bench.py').read_text(encoding='utf-8')
+    return set(re.findall(r"print\('([A-Z][A-Z_]*_SUMMARY) ", source))
+
+
+def test_bench_summary_lines_match_docs_both_ways():
+    documented = _documented_summaries()
+    emitted = _bench_summaries()
+    assert emitted, 'bench.py emits no summary lines?'
+    assert emitted - documented == set(), (
+        f'bench.py summary lines undocumented in the Benchmark summary '
+        f'lines section: {sorted(emitted - documented)}')
+    assert documented - emitted == set(), (
+        f'docs describe summary lines bench.py no longer prints: '
+        f'{sorted(documented - emitted)}')

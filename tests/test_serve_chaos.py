@@ -262,13 +262,13 @@ def chaos_runs():
     policies, kill+preempt twice (determinism), stall+partition once."""
     base_sim, base = _run('least_load')
     kp_sim, kp = _run('least_load', _KILL_PREEMPT)
-    _, kp2 = _run('least_load', _KILL_PREEMPT)
+    kp2_sim, kp2 = _run('least_load', _KILL_PREEMPT)
     pa_sim, _ = _run('prefix_affinity')
     sp_sim, sp = _run('prefix_affinity', _STALL_PARTITION)
     return {
         'base': base, 'base_outputs': base_sim.session_outputs(),
         'kp': kp, 'kp_outputs': kp_sim.session_outputs(), 'kp2': kp2,
-        'kp_sim': kp_sim,
+        'kp_sim': kp_sim, 'kp2_sim': kp2_sim,
         'pa_outputs': pa_sim.session_outputs(),
         'sp': sp, 'sp_outputs': sp_sim.session_outputs(),
         'sp_sim': sp_sim,
@@ -331,6 +331,55 @@ def test_stall_partition_heal_and_bit_exact(chaos_runs):
     assert len(heals) == 2
     urls = {r.url for r in chaos_runs['sp_sim'].replicas}
     assert {'replica-0', 'replica-1'} <= urls
+
+
+def test_failover_leaves_span_breadcrumb_trail(chaos_runs, tmp_path):
+    """A killed replica's interrupted sessions must be reconstructable
+    from the exported timeline: failover.detect -> failover.replay ->
+    failover.resume in time order on the victim session's trace row."""
+    import json
+    sim = chaos_runs['kp_sim']
+    path = tmp_path / 'chaos_trace.json'
+    exported = sim.export_trace(str(path))
+    assert exported == sim.span_count() > 0
+    with open(path, encoding='utf-8') as f:
+        events = json.load(f)['traceEvents']
+    per_trace = {}
+    for e in events:
+        tid = (e.get('args') or {}).get('trace_id')
+        if tid:
+            per_trace.setdefault(tid, []).append(e)
+    chain = ('failover.detect', 'failover.replay', 'failover.resume')
+    full_chains = 0
+    for tid, evs in per_trace.items():
+        names = {e['name'] for e in evs}
+        if 'failover.resume' not in names:
+            continue
+        # A resumed session always shows the whole breadcrumb trail...
+        assert set(chain) <= names, (tid, sorted(names))
+        # ...in causal order.
+        first_ts = {n: min(e['ts'] for e in evs if e['name'] == n)
+                    for n in chain}
+        assert (first_ts['failover.detect']
+                <= first_ts['failover.replay']
+                <= first_ts['failover.resume']), (tid, first_ts)
+        # The replay re-prefills prompt + committed on the survivor.
+        replay = next(e for e in evs
+                      if e['name'] == 'failover.replay')
+        assert replay['args']['replayed'] >= 0
+        full_chains += 1
+    assert full_chains > 0
+    assert full_chains >= chaos_runs['kp']['chaos']['sessions_recovered']
+
+
+def test_chaos_trace_export_byte_deterministic(chaos_runs, tmp_path):
+    """Virtual clocks + fixed pids: two runs of the same seeded chaos
+    scenario export byte-identical Perfetto files to fresh paths."""
+    a, b = tmp_path / 'a.json', tmp_path / 'b.json'
+    chaos_runs['kp_sim'].export_trace(str(a))
+    chaos_runs['kp2_sim'].export_trace(str(b))
+    raw = a.read_bytes()
+    assert raw and raw == b.read_bytes()
 
 
 def test_autoscaler_replaces_killed_replica(monkeypatch):

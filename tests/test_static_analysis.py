@@ -322,6 +322,57 @@ def test_replica_removal_with_cleanup_is_clean():
     """, path='serve/manager.py')
 
 
+def test_metric_family_outside_registry():
+    """SKY401: a Prometheus family constructed anywhere but the
+    shared-registry modules — dotted prometheus_client form, or a bare
+    name with a registry= kwarg."""
+    assert 'SKY401' in codes("""
+        import prometheus_client
+
+        REQS = prometheus_client.Counter('skytpu_lb_requests_total',
+                                         'requests')
+    """, path='serve/load_balancer.py')
+    assert 'SKY401' in codes("""
+        from prometheus_client import Gauge
+        from skypilot_tpu.metrics import REGISTRY
+
+        DEPTH = Gauge('skytpu_lb_queue_depth', 'depth',
+                      registry=REGISTRY)
+    """, path='serve/load_balancer.py')
+    # The registry modules are the sanctioned homes.
+    sanctioned = """
+        from prometheus_client import Histogram
+        from skypilot_tpu.metrics import REGISTRY
+
+        H = Histogram('skytpu_x_seconds', 'x', registry=REGISTRY)
+    """
+    assert 'SKY401' not in codes(sanctioned, path='telemetry/metrics.py')
+    assert 'SKY401' not in codes(sanctioned, path='metrics/utils.py')
+    # The allow marker sanctions a one-off site.
+    assert 'SKY401' not in codes("""
+        from prometheus_client import Gauge
+
+        G = Gauge('skytpu_y', 'y', registry=None)  # skytpu-allow: SKY401
+    """, path='serve/load_balancer.py')
+
+
+def test_metric_family_rule_ignores_collections_counter():
+    # collections.Counter / a bare Counter without registry= are the
+    # stdlib multiset, not a metric family — never flagged.
+    assert 'SKY401' not in codes("""
+        import collections
+
+        def tally(xs):
+            return collections.Counter(xs)
+    """, path='serve/spot_placer.py')
+    assert 'SKY401' not in codes("""
+        from collections import Counter
+
+        def tally(xs):
+            return Counter(xs)
+    """, path='analysis/baseline.py')
+
+
 def test_inline_allow_suppresses():
     assert codes("""
         import jax
