@@ -315,6 +315,8 @@ def _mesh_bench_payload() -> dict:
     single-device pooled baseline.  bench_mesh() decides WHERE this
     body runs — in-process on a real slice, or in a respawned child
     with forced host-platform CPU devices on single-device CI."""
+    import os
+
     import jax
     import jax.numpy as jnp
 
@@ -331,53 +333,105 @@ def _mesh_bench_payload() -> dict:
 
     # Sharded pooled decode over the whole slice as one tp group.  The
     # CPU config keeps every partitioned dim divisible by tp degrees up
-    # to 8 (d_model 128, n_heads 8; n_kv_heads 2 + tpq overshard).
+    # to 8 (d_model 256, n_heads 8, n_kv_heads 4 + tpq overshard).
+    # Sized up from the original toy config deliberately: on forced
+    # host-platform devices every collective is an n-thread rendezvous
+    # with a fixed ~0.1 ms cost, so a tiny model measures pure
+    # rendezvous and the share estimate pins near 1.0 regardless of
+    # schedule.  d_model 256 / d_ff 1024 / 8 slots / 48 new tokens
+    # give the matmuls enough work that schedule differences (sync
+    # GSPMD vs the manual overlap region) are visible in the share.
     if on_tpu:
         config = llama.LLAMA_1B
         slots, prompt_len, max_new, chunk = 8, 32, 64, 32
     else:
         config = llama.LlamaConfig(
-            vocab_size=512, d_model=128, n_layers=2, n_heads=8,
-            n_kv_heads=2, d_ff=256, max_seq_len=256,
+            vocab_size=512, d_model=256, n_layers=2, n_heads=8,
+            n_kv_heads=4, d_ff=1024, max_seq_len=256,
             dtype=jnp.float32)
-        slots, prompt_len, max_new, chunk = 4, 8, 24, 8
+        slots, prompt_len, max_new, chunk = 8, 8, 48, 8
     params = llama.init_params(config, jax.random.PRNGKey(0))
     gen_cfg = GeneratorConfig(max_seq_len=prompt_len + max_new + 1,
                               batch_size=slots, temperature=0.0,
                               prompt_buckets=[prompt_len])
+    prompts = [[(7 * (i + 1)) % config.vocab_size] * prompt_len
+               for i in range(slots)]
 
-    def tok_s(mesh):
-        batcher = ContinuousBatcher(params, config, gen_cfg,
+    def run_batch(batcher):
+        rids = [batcher.submit(p, max_new_tokens=max_new)
+                for p in prompts]
+        batcher.run_until_idle()
+        return [batcher.result(r) for r in rids]
+
+    def measure(gc, mesh):
+        """(tok/s, elapsed_s, outputs) — outputs from the timed run so
+        the parity assertion below covers exactly what was timed."""
+        batcher = ContinuousBatcher(params, config, gc,
                                     decode_chunk=chunk, mesh=mesh)
+        # TWO warmup batches (discarded): the arena is donated through
+        # prefill/decode, so batch 1's inputs carry the constrained
+        # post-step sharding and compile a second variant — timing the
+        # second batch would charge a ~1 s XLA compile to "decode".
+        run_batch(batcher)
+        run_batch(batcher)
+        best_rate, best_dt, outs = 0.0, 0.0, None
+        for _ in range(3):     # best-of-3: host scheduler noise swamps
+            t0 = time.perf_counter()    # a single ~30 ms batch
+            o = run_batch(batcher)
+            dt = time.perf_counter() - t0
+            rate = sum(len(x) for x in o) / dt
+            if rate > best_rate:
+                best_rate, best_dt, outs = rate, dt, o
+        return best_rate, best_dt, outs
 
-        def run_batch():
-            prompts = [[(7 * (i + 1)) % config.vocab_size] * prompt_len
-                       for i in range(slots)]
-            rids = [batcher.submit(p, max_new_tokens=max_new)
-                    for p in prompts]
-            batcher.run_until_idle()
-            return sum(len(batcher.result(r)) for r in rids)
+    import dataclasses as _dc
 
-        run_batch()                       # compile warmup (discarded)
-        t0 = time.perf_counter()
-        generated = run_batch()
-        return generated / (time.perf_counter() - t0)
-
+    from skypilot_tpu.infer.engine import resolve_overlap
     mesh = tp_lib.make_tp_mesh(n, n_kv_heads=config.n_kv_heads)
-    sharded = tok_s(mesh)
-    single = tok_s(None)
+    cfg_sync = _dc.replace(gen_cfg, overlap_collectives=False)
+    cfg_ovl = _dc.replace(gen_cfg, overlap_collectives=True)
+    chunks = resolve_overlap(params, config, cfg_ovl, mesh)
+    sync_rate, sync_dt, sync_out = measure(cfg_sync, mesh)
+    ovl_rate, ovl_dt, ovl_out = measure(cfg_ovl, mesh)
+    # Bit-exactness gate BEFORE any number is reported: the overlapped
+    # schedule's fixed mesh-rank accumulation order must reproduce the
+    # sync path's greedy token ids exactly — a perf number from a
+    # diverging decode would be meaningless.
+    if sync_out != ovl_out:
+        raise AssertionError(
+            'overlapped sharded decode diverged from the sync path '
+            f'(chunks={chunks}); refusing to report throughput')
+    single, _, _ = measure(gen_cfg, None)
     # Collective/partition overhead share: perfect tp scaling would cut
-    # the fixed batch's wall clock by n, so the shortfall fraction
-    # 1 - t_ideal/t_mesh = 1 - sharded/(n * single) estimates the time
-    # spent in collectives + partition bookkeeping per decode chunk.
-    # Clamped to [0, 1]; on forced host-platform devices every "chip"
-    # shares the same cores, so the share reads pessimistically high —
-    # usable as a relative regression signal only (flagged by
-    # virtual_devices below).
-    share = (max(0.0, min(1.0, 1.0 - sharded / (n * single)))
-             if single else None)
+    # the fixed batch's wall clock by the ACHIEVABLE parallelism p, so
+    # the shortfall fraction 1 - t_ideal/t_mesh = 1 - sharded/(p *
+    # single) estimates the time spent in collectives + partition
+    # bookkeeping per decode chunk.  On real chips p = n.  On forced
+    # host-platform devices the n "chips" timeshare the host's physical
+    # cores, so the best any schedule can do is p = min(n, cores) —
+    # charging the hypothetical n x ideal there would saturate the
+    # estimate at 1 - cores/n regardless of schedule (the seed's
+    # pinned-at-~1.0 number on a small host).  Clamped to [0, 1];
+    # virtual-device runs are flagged below and only comparable at
+    # equal ideal_parallelism (bench_compare checks).
+    p = n if on_tpu else max(1, min(n, os.cpu_count() or n))
+
+    def share_of(rate):
+        return (max(0.0, min(1.0, 1.0 - rate / (p * single)))
+                if single else None)
+
+    share_sync = share_of(sync_rate)
+    share = share_of(ovl_rate)     # serving default = overlapped path
+    hidden = None
     if share is not None:
         telemetry_metrics.INFER_MESH_COLLECTIVE_TIME_SHARE.set(share)
+        telemetry_metrics.INFER_MESH_COLLECTIVE_SECONDS.labels(
+            mode='overlapped').inc(share * ovl_dt)
+        telemetry_metrics.INFER_MESH_COLLECTIVE_SECONDS.labels(
+            mode='sync').inc(share_sync * sync_dt)
+        if share_sync:
+            hidden = max(0.0, min(1.0, 1.0 - share / share_sync))
+            telemetry_metrics.INFER_MESH_OVERLAP_RATIO.set(hidden)
 
     out = {
         'ranks': n,
@@ -385,16 +439,32 @@ def _mesh_bench_payload() -> dict:
                               [int(s) for s in mesh.devices.shape])),
         'allreduce': allreduce,
         'allgather': allgather,
-        'sharded_decode_tok_s_chip': round(sharded / n, 1),
+        'sharded_decode_tok_s_chip': round(ovl_rate / n, 1),
         'single_device_decode_tok_s': round(single, 1),
         'collective_time_share_est':
             None if share is None else round(share, 3),
+        'overlap': {
+            'chunks': chunks,
+            'sharded_decode_tok_s_chip_sync': round(sync_rate / n, 1),
+            'sharded_decode_tok_s_chip_overlapped':
+                round(ovl_rate / n, 1),
+            'collective_time_share_sync':
+                None if share_sync is None else round(share_sync, 3),
+            'collective_time_share_overlapped':
+                None if share is None else round(share, 3),
+            'hidden_comm_ratio':
+                None if hidden is None else round(hidden, 3),
+            'parity': 'bit-exact',
+        },
     }
     if not on_tpu:
         # Forced host-platform devices: the "interconnect" is shared
         # host memory, so bandwidth numbers exercise the code path, not
-        # the fabric.
+        # the fabric.  ideal_parallelism records the p the share was
+        # normalized against — shares from hosts with different core
+        # counts are not comparable (bench_compare skips them).
         out['virtual_devices'] = True
+        out['ideal_parallelism'] = p
     return out
 
 
@@ -1579,6 +1649,7 @@ def build_headline(tok_s: float, mfu: float, llama8b: dict,
                     'sharded_decode_tok_s_chip'),
                 'collective_time_share_est': mesh.get(
                     'collective_time_share_est'),
+                'overlap': mesh.get('overlap'),
                 'virtual_devices': mesh.get('virtual_devices', False),
             }
     if isinstance(trace, dict):

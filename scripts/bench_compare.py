@@ -40,7 +40,37 @@ FIELDS: Tuple[Tuple[str, bool], ...] = (
     ('serve.least_load_ttft_p99_ms', False),
     ('fuse.ttft_p99_fused_ms', False),
     ('chaos.failover_p99_added_latency_ms', False),
+    # Mesh serving plane: sharded decode throughput must not drop and
+    # the collective-overhead share must not rise.  Compared only when
+    # BOTH artifacts carry a mesh block from the same fabric kind (see
+    # _mesh_comparable) — real-ICI vs forced-host-device numbers are
+    # different experiments, not a regression.
+    ('mesh.sharded_decode_tok_s_chip', True),
+    ('mesh.collective_time_share_est', False),
+    ('mesh.overlap.sharded_decode_tok_s_chip_sync', True),
 )
+
+
+def _mesh_comparable(old: Dict[str, Any], new: Dict[str, Any]
+                     ) -> Optional[str]:
+    """None when mesh fields may be compared, else the skip reason."""
+    a, b = old.get('mesh'), new.get('mesh')
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return 'mesh block missing on one side'
+    if 'error' in a or 'skipped' in a or 'error' in b or 'skipped' in b:
+        return 'mesh bench errored/skipped on one side'
+    if a.get('virtual_devices', False) != b.get('virtual_devices', False):
+        return 'virtual_devices mismatch (real ICI vs emulated)'
+    if a.get('ranks') != b.get('ranks'):
+        return (f'rank count changed ({a.get("ranks")} -> '
+                f'{b.get("ranks")})')
+    if a.get('ideal_parallelism') != b.get('ideal_parallelism'):
+        # Virtual-device shares are normalized against min(ranks,
+        # host cores); different hosts are different experiments.
+        return (f'ideal_parallelism changed '
+                f'({a.get("ideal_parallelism")} -> '
+                f'{b.get("ideal_parallelism")})')
+    return None
 
 _HEADLINE_RE = re.compile(r'^BENCH_HEADLINE (\{.*\})\s*$', re.M)
 
@@ -77,7 +107,11 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     """Returns (report lines, regression lines)."""
     lines: List[str] = []
     regressions: List[str] = []
+    mesh_skip = _mesh_comparable(old, new)
     for dotted, higher_better in FIELDS:
+        if dotted.startswith('mesh.') and mesh_skip is not None:
+            lines.append(f'  {dotted}: skipped ({mesh_skip})')
+            continue
         a, b = _lookup(old, dotted), _lookup(new, dotted)
         if a is None or b is None or a == 0:
             lines.append(f'  {dotted}: skipped (old={a} new={b})')

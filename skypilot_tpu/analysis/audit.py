@@ -867,19 +867,24 @@ def audit_mesh_decode() -> Dict[str, Any]:
     # Lower+compile ONE chunk with operands placed exactly as the
     # engine places them (the jit has no explicit in_shardings, so the
     # partitioned program exists only for sharded concrete operands).
-    args, n = _decode_chunk_inputs(gen, gen.cache_buckets[0],
-                                   gen.gen.decode_chunk)
-    (params, token, arena, positions, done, limit, rng, tables) = args
-    arena = {k: jax.device_put(
-        v, tp_lib.cache_scale_sharding(mesh) if k.endswith('_scale')
-        else tp_lib.cache_sharding(mesh))
-        for k, v in arena.items()}
-    rep = tp_lib.replicated_sharding(mesh)
-    args = (params, jax.device_put(token, rep), arena,
-            jax.device_put(positions, rep), jax.device_put(done, rep),
-            jax.device_put(limit, rep), jax.device_put(rng, rep),
-            jax.device_put(tables, rep))
-    lowered = gen._decode_chunk.lower(*args, n=n)
+    def _sharded_chunk_lowering(g):
+        args, n = _decode_chunk_inputs(g, g.cache_buckets[0],
+                                       g.gen.decode_chunk)
+        (params, token, arena, positions, done, limit, rng,
+         tables) = args
+        arena = {k: jax.device_put(
+            v, tp_lib.cache_scale_sharding(mesh) if k.endswith('_scale')
+            else tp_lib.cache_sharding(mesh))
+            for k, v in arena.items()}
+        rep = tp_lib.replicated_sharding(mesh)
+        args = (params, jax.device_put(token, rep), arena,
+                jax.device_put(positions, rep),
+                jax.device_put(done, rep),
+                jax.device_put(limit, rep), jax.device_put(rng, rep),
+                jax.device_put(tables, rep))
+        return g._decode_chunk.lower(*args, n=n)
+
+    lowered = _sharded_chunk_lowering(gen)
     checks.append(_donation_check(lowered.as_text(),
                                   'sharded pool arena'))
     hlo = lowered.compile().as_text()
@@ -950,9 +955,63 @@ def audit_mesh_decode() -> Dict[str, Any]:
         'ok' if biggest < arena_elems else 'fail',
         f'largest all-gather in the partitioned decode moves '
         f'{biggest} elements (full arena would be {arena_elems})'))
+
+    # Budget 5: speculative verify on the mesh — the overlap region
+    # must not re-key the verify program either (same 1-program budget
+    # as the single-chip spec audit; draft shape is fixed).
+    spec = make_tiny_generator(mesh=mesh, spec_k=3)
+    spec_prompts = [[5, 6, 7, 5, 6, 7, 5, 6], [9, 9, 9, 9]]
+    spec.generate(spec_prompts, max_new_tokens=_AUDIT_MAX_NEW)
+    spec.generate(spec_prompts, max_new_tokens=_AUDIT_MAX_NEW)
+    verify_compiles = spec._verify_chunk._cache_size()
+    checks.append(_check(
+        'verify_compile_budget',
+        'ok' if verify_compiles <= 1 else 'fail',
+        f'{verify_compiles} verify-chunk compiles on the 2-chip mesh '
+        f'across a cold+warm run (budget 1)'))
+
+    # Budget 6: ring-chunked overlap lowering.  Force overlap_chunks=2
+    # and pin the per-layer collective count in the partitioned HLO:
+    # every activation combine decomposes into ppermute chains
+    # (collective-permute), so the layer body must hold ZERO
+    # activation-sized all-reduces and exactly
+    # combines_per_layer * chunks * ring_hops collective-permutes —
+    # one extra means a combine silently fell back to GSPMD, one
+    # fewer means a chunk was dropped.
+    ring_chunks = 2
+    hops = sum(int(s) - 1 for s in mesh.devices.shape)  # per ring pass
+    expected_cp = 2 * ring_chunks * hops                # 2 combines
+    gen2 = make_tiny_generator(mesh=mesh, overlap_collectives=True,
+                               overlap_chunks=ring_chunks)
+    hlo2 = _sharded_chunk_lowering(gen2).compile().as_text()
+    bodies2 = _hlo_computation_bodies(hlo2)
+    big_ar2 = {k: [s for s in _ar_sizes(b) if s >= act_elems]
+               for k, b in bodies2.items()}
+    worst_ar2 = max((len(v) for v in big_ar2.values()), default=0)
+
+    def _cp_count(body):
+        return sum(1 for ln in body
+                   if re.search(r'\bcollective-permute(-start)?\(', ln))
+
+    worst_cp = max((_cp_count(b) for b in bodies2.values()), default=0)
+    per_layer_cp = worst_cp
+    if worst_cp and worst_cp % gen2.config.n_layers == 0 \
+            and worst_cp > expected_cp:
+        per_layer_cp = worst_cp // gen2.config.n_layers
+    ring_ok = worst_ar2 == 0 and per_layer_cp == expected_cp
+    checks.append(_check(
+        'ring_collective_pin',
+        'ok' if ring_ok else 'fail',
+        f'chunks={ring_chunks} lowering: {per_layer_cp} '
+        f'collective-permutes per layer (expected {expected_cp} = '
+        f'2 combines x {ring_chunks} chunks x {hops} ring hops), '
+        f'{worst_ar2} activation-sized all-reduces in the layer body '
+        f'(expected 0 — every combine must ride the ring)'))
     return {'entry': 'mesh_decode', 'checks': checks,
             'compiles': compiles,
-            'allreduce_per_layer': per_layer}
+            'allreduce_per_layer': per_layer,
+            'verify_compiles': verify_compiles,
+            'ring_collective_permutes_per_layer': per_layer_cp}
 
 
 REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {

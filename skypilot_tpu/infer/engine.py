@@ -123,6 +123,25 @@ class GeneratorConfig:
     # temperature > 0 the rejection-sampling accept preserves the
     # target distribution.  Requires decode_impl='pooled'.
     spec_k: int = 0
+    # Communication/compute overlap for mesh-sharded decode (pooled
+    # plane, mesh.size > 1): route the layer stack through ONE manual
+    # shard_map region where the megatron combines are ring-pipelined
+    # into the next matmuls (llama_infer._pooled_layers_overlapped)
+    # instead of GSPMD's back-to-back synchronous psums — the fix for
+    # PR 10's collective_time_share_est = 0.997.  None = auto: ON
+    # whenever supported (pooled plane, dense MLP, unquantized
+    # weights, mesh.size > 1).  True = require it (ValueError when
+    # unsupported); False = always the sync GSPMD path.  Greedy decode
+    # output is bit-exact vs the sync path at overlap_chunks=1 and
+    # token-exact at larger chunk counts (fixed mesh-rank accumulation
+    # order, independent of chunking).
+    overlap_collectives: Optional[bool] = None
+    # Ring-pipeline chunk count for the overlapped combines.  None =
+    # auto: min(model shards, d_model // 256) floored at 1 — each
+    # chunk keeps >= 256 combine columns so per-hop latency cannot
+    # dominate, and tiny models degrade to 1 (synchronous in-region
+    # psums, the no-op pipeline).
+    overlap_chunks: Optional[int] = None
     # Chunked-prefill piggyback (ContinuousBatcher, pooled plane):
     # total token columns of a fused step's FIRST forward — each active
     # decode slot contributes its single-token column and the in-flight
@@ -152,6 +171,15 @@ class GeneratorConfig:
                     f'chunked-prefill lane; set prefill_chunk (the '
                     f'threshold above which prompts prefill '
                     f'incrementally) to enable it')
+        if self.overlap_chunks is not None and self.overlap_chunks < 1:
+            raise ValueError(f'overlap_chunks must be >= 1, got '
+                             f'{self.overlap_chunks}')
+        if self.overlap_collectives and self.decode_impl != 'pooled':
+            raise ValueError(
+                f"overlap_collectives=True requires the pooled data "
+                f"plane (decode_impl='pooled'); the legacy "
+                f"'{self.decode_impl}' plane has no manual-region "
+                f'layer stack')
         if self.spec_k < 0:
             raise ValueError(f'spec_k must be >= 0, got {self.spec_k}')
         if self.spec_k and self.decode_impl != 'pooled':
@@ -223,6 +251,47 @@ def prepare_params(params, gen_config: 'GeneratorConfig'):
                          f'got {gen_config.weights_dtype!r}')
     from skypilot_tpu.infer import quant
     return quant.quantize_weights(params)
+
+
+def resolve_overlap(params, config, gen_config: 'GeneratorConfig',
+                    mesh) -> Optional[int]:
+    """Resolved ring-pipeline chunk count for the overlapped decode
+    path, or None for the synchronous GSPMD path.  Shared by Generator
+    and ContinuousBatcher so the two engines gate identically.
+
+    Supported = pooled data plane, mesh.size > 1, dense MLP (the MoE
+    block's expert dispatch has its own collective schedule), and
+    unquantized weights (the chunked combine slices weight matrices
+    along d_model; int8 per-out-channel scale tuples don't slice).
+    overlap_collectives=None auto-enables exactly when supported;
+    True raises on the first unsupported condition so a requested
+    overlap can never silently fall back."""
+    want = gen_config.overlap_collectives
+    if want is False:
+        return None
+    reasons = []
+    if mesh is None or mesh.size == 1:
+        reasons.append('mesh.size > 1 required')
+    if gen_config.decode_impl != 'pooled':
+        reasons.append("decode_impl='pooled' required")
+    if gen_config.weights_dtype is not None:
+        reasons.append('unquantized weights required')
+    if params is not None and 'moe' in params.get('layers', {}):
+        reasons.append('dense MLP required (MoE layers present)')
+    if reasons:
+        if want:
+            raise ValueError(
+                'overlap_collectives=True is unsupported here: '
+                + '; '.join(reasons))
+        return None
+    if gen_config.overlap_chunks is not None:
+        return int(gen_config.overlap_chunks)
+    sizes = tp_lib.mesh_axis_sizes(mesh)
+    n_model = sizes.get('tp', 1) * sizes.get('tpq', 1)
+    # Each ring chunk keeps >= 256 combine columns so per-hop dispatch
+    # latency cannot dominate the hidden matmul slice; more chunks than
+    # model shards adds hops without hiding anything new.
+    return max(1, min(n_model, config.d_model // 256))
 
 
 def validate_context(gen_config: 'GeneratorConfig', model_config) -> None:
@@ -331,6 +400,7 @@ class Generator:
         self.params = prepare_params(params, gen_config)
         self.config = config
         self.gen = gen_config
+        self.overlap = resolve_overlap(params, config, gen_config, mesh)
         self.buckets = derive_buckets(gen_config)
         self.cache_buckets = derive_cache_buckets(gen_config)
         if gen_config.decode_chunk < 1:
@@ -570,7 +640,7 @@ class Generator:
             def decode_fn(params, token, config, cache, positions):
                 return llama_infer.decode_step_pooled(
                     params, token, config, cache, positions, tables,
-                    mesh=self.mesh)
+                    mesh=self.mesh, overlap=self.overlap)
         else:
             decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
         batch = token.shape[0]
@@ -623,7 +693,7 @@ class Generator:
         tokens_w = jnp.concatenate([token[:, None], draft], axis=1)
         logits, cache = llama_infer.decode_verify_pooled(
             params, tokens_w, self.config, cache, positions, tables,
-            mesh=self.mesh)
+            mesh=self.mesh, overlap=self.overlap)
         rng, sub = jax.random.split(rng)
         if temperature == 0.0:
             targets, accepts = sampling.spec_accept_greedy(logits, draft)
@@ -838,6 +908,16 @@ class Generator:
             limit0[i] = max_new - 1
         done_dev = jnp.asarray(host_done)
         limit_dev = jnp.asarray(limit0)
+        if self.mesh is not None:
+            # Commit the small per-row state to the mesh's replicated
+            # sharding up front: the first decode/verify chunk would
+            # otherwise see SingleDeviceSharding operands while every
+            # later chunk sees the replicated outputs of its
+            # predecessor — one wasted compile per program family.
+            rep = tp_lib.replicated_sharding(self.mesh)
+            positions, done_dev, limit_dev, rng = (
+                jax.device_put(x, rep)
+                for x in (positions, done_dev, limit_dev, rng))
 
         # First token came from prefill; the rest stream in fused
         # on-device chunks (bounded (chunk, cache bucket) compile set).

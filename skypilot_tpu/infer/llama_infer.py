@@ -484,12 +484,14 @@ def encode(params: llama.Params, tokens: jax.Array,
     return pooled.astype(jnp.float32)
 
 
-def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config,
-                    k_scale=None, v_scale=None):
-    """Per-token GQA attention + MLP residual block AFTER the cache
-    update — the math shared verbatim by all three decode
-    implementations (scan / inplace / unrolled), so a numerics fix
-    lands in one place.
+def _token_attention(q_g, k_eff, v_eff, visible, scale,
+                     k_scale=None, v_scale=None):
+    """Masked GQA attention core: q_g (B, W, KV, G, hd) grouped
+    queries against k_eff/v_eff (B, S, KV, hd) cache views.  Shape-
+    polymorphic over the head counts, which is what lets the
+    overlapped decode path run it per KV-head shard inside a manual
+    region with the LOCAL counts — the same bytes-in-registers math as
+    the replicated call.
 
     int8 cache path (k_scale/v_scale (B, S, KV) given): k_eff/v_eff are
     the RAW int8 cache slices and the per-token absmax scales are
@@ -498,14 +500,10 @@ def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config,
     dequantized (B, S, KV, hd) copy of the layer's cache per step.
     Scale-after-matmul is exact (the scale is constant over the
     contracted hd axis), and it is what closes the int8_w_kv roofline
-    gap: the dominant decode read stays int8 bytes end-to-end."""
-    batch = h.shape[0]
-    attn_p = layer_params['attn']
-    group = config.n_heads // config.n_kv_heads
-    w = q.shape[1]
-    q_g = q.reshape(batch, w, config.n_kv_heads, group, config.head_dim)
-    scale = config.head_dim ** -0.5
-    s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff.astype(q.dtype),
+    gap: the dominant decode read stays int8 bytes end-to-end.
+
+    Returns o (B, W, KV, G, hd) in q dtype."""
+    s = jnp.einsum('bqkgd,bskd->bkgqs', q_g, k_eff.astype(q_g.dtype),
                    preferred_element_type=jnp.float32) * scale
     if k_scale is not None:
         # (B, S, KV) -> (B, KV, 1, 1, S) onto the score block.
@@ -521,12 +519,332 @@ def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config,
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
         p = p * jnp.swapaxes(v_scale, 1, 2)[:, :, None, None, :]
-    p = p.astype(q.dtype)
-    o = jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff.astype(q.dtype))
+    p = p.astype(q_g.dtype)
+    return jnp.einsum('bkgqs,bskd->bqkgd', p, v_eff.astype(q_g.dtype))
+
+
+def _token_attn_mlp(h, layer_params, q, k_eff, v_eff, visible, config,
+                    k_scale=None, v_scale=None):
+    """Per-token GQA attention + MLP residual block AFTER the cache
+    update — the math shared verbatim by all three decode
+    implementations (scan / inplace / unrolled), so a numerics fix
+    lands in one place.  The attention core lives in
+    :func:`_token_attention`; this wrapper owns the residual adds the
+    overlapped path replaces with ring-pipelined combines."""
+    batch = h.shape[0]
+    attn_p = layer_params['attn']
+    group = config.n_heads // config.n_kv_heads
+    w = q.shape[1]
+    q_g = q.reshape(batch, w, config.n_kv_heads, group, config.head_dim)
+    o = _token_attention(q_g, k_eff, v_eff, visible,
+                         config.head_dim ** -0.5,
+                         k_scale=k_scale, v_scale=v_scale)
     h = h + quant.matmul(o.reshape(batch, w, -1), attn_p['wo'])
     x = rmsnorm_ops.rms_norm(h, layer_params['ln2'],
                              eps=config.norm_eps)
     return h + _ffn(x, layer_params, config)
+
+
+def _combine_then_project(pending, h, gain, weights, axes, chunks, eps):
+    """h_new = h + combine(pending), then rms_norm(h_new, gain) @ W for
+    each local weight block — with the combine's ring chunks feeding
+    the projections as they land.
+
+    This is the overlap kernel of the whole PR.  chunks == 1 is the
+    synchronous shape: one lax.psum then the standard rms_norm +
+    matmuls (byte-identical ops to what GSPMD emits for the megatron
+    combine).  chunks > 1 splits the (…, D) combine along D and uses
+    the rmsnorm FACTORIZATION
+
+        rms_norm(x, g) @ W == ((x * g) @ W) * rsqrt(mean(x^2) + eps)
+
+    — the per-row scalar commutes with the contraction, so each
+    combined span can start its slice of the q/k/v (or gate/up)
+    matmuls immediately, while later spans' ppermutes are still in
+    flight; the rsqrt lands once, on the small (…, F) results.  The
+    span sums use pipelined_psum's fixed mesh-rank accumulation order,
+    so the result is deterministic and chunk-count-independent.
+
+    Returns (h_new, [y_j] in h.dtype)."""
+    from skypilot_tpu.parallel import collectives as coll
+    if chunks <= 1 or not axes:
+        red = jax.lax.psum(pending, axes) if axes else pending
+        h_new = h + red
+        x = rmsnorm_ops.rms_norm(h_new, gain, eps=eps)
+        return h_new, [quant.matmul(x, w) for w in weights]
+    d_model = h.shape[-1]
+    state = {'ssq': jnp.zeros(h.shape[:-1] + (1,), jnp.float32),
+             'accs': [None] * len(weights)}
+
+    def consume(ci, lo, span):
+        hc = jax.lax.slice_in_dim(h, lo, lo + span.shape[-1],
+                                  axis=-1) + span
+        hcf = hc.astype(jnp.float32)
+        state['ssq'] = state['ssq'] + jnp.sum(hcf * hcf, axis=-1,
+                                              keepdims=True)
+        t = (hcf * gain[lo:lo + span.shape[-1]]).astype(h.dtype)
+        for j, w in enumerate(weights):
+            y = jax.lax.dot_general(
+                t, jax.lax.slice_in_dim(w, lo, lo + span.shape[-1],
+                                        axis=0),
+                dimension_numbers=(((t.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            state['accs'][j] = y if state['accs'][j] is None \
+                else state['accs'][j] + y
+        return hc
+
+    _, spans = coll.pipelined_psum(pending, axes, chunks=chunks,
+                                   on_chunk=consume)
+    h_new = jnp.concatenate(spans, axis=-1)
+    inv = jax.lax.rsqrt(state['ssq'] / d_model + eps)
+    return h_new, [(a * inv).astype(h.dtype) for a in state['accs']]
+
+
+def _pooled_layers_overlapped(params, h, config, cache, mesh, chunks,
+                              cos, sin, *, pos, blk, off, visible,
+                              tables, positions, pf=None):
+    """The pooled layer stack with the megatron combines EXPLICIT
+    inside ONE manual shard_map region — the communication/compute
+    overlap path (GeneratorConfig.overlap_collectives).
+
+    The synchronous path leaves collectives to GSPMD: two psums per
+    layer issued back-to-back after wo and w_down, each a full stall
+    (PR 10 measured collective_time_share_est = 0.997).  Here the whole
+    fori_loop runs manually per shard and every combine goes through
+    :func:`_combine_then_project`: the post-attention combine's ring
+    chunks feed the MLP gate/up matmuls as they land, and the post-MLP
+    combine rides the loop carry as an UNREDUCED partial (`pending`)
+    that the NEXT layer's qkv projections consume chunk-by-chunk — the
+    SUMMA-style block-cyclic schedule, pipelined along the ici-ordered
+    ring.  chunks == 1 degrades to in-region synchronous psums (the
+    auto-fallback for payloads too small to chunk).
+
+    Layer weights enter the region pre-sharded per INFER_TP_RULES, so
+    each shard's matmuls are the same blocks GSPMD would assign it; the
+    arena enters under POOL_ARENA_SPEC (KV heads on 'tp'); attention is
+    complete per shard (the GQA overshard keeps q heads beside their KV
+    head).  Under a 'dp' axis the slot rows split across replicas and
+    the per-layer K/V writes ring-gather over 'dp' before the scatter,
+    keeping every replica's arena copy identical.  Embed and lm_head
+    stay OUTSIDE the region (unchanged GSPMD), so their per-step
+    gathers are untouched.
+
+    pf: optional dict(h, pos, visible, table_row, start) — the fused
+    step's piggybacked prefill lane, concatenated into the projection
+    rows (replicated over 'dp', exactly like the sync fused path
+    broadcasts it) and split back out for its window attention.
+
+    Returns (h, cache) — (h_dec, h_pf, cache) when pf is given."""
+    from jax.sharding import PartitionSpec as P
+    from skypilot_tpu.parallel import collectives as coll
+    from skypilot_tpu.infer import tp as tp_lib
+
+    sizes = tp_lib.mesh_axis_sizes(mesh)
+    dp = 'dp' if sizes.get('dp', 1) > 1 else None
+    model_axes = tuple(a for a in ('tp', 'tpq') if a in mesh.axis_names)
+    tp_kv = sizes.get('tp', 1)
+    n_model = 1
+    for a in model_axes:
+        n_model *= sizes[a]
+    nkv_l = config.n_kv_heads // tp_kv
+    nh_l = config.n_heads // n_model
+    grp_l = nh_l // max(nkv_l, 1)
+    hd = config.head_dim
+    eps = config.norm_eps
+    w = 1 if pos.shape[1] == 1 else pos.shape[1]
+    attn_scale = hd ** -0.5
+    quantized = 'k_scale' in cache
+    use_kernel = (jax.default_backend() == 'tpu' and hd % 128 == 0)
+    chunks = int(chunks)
+
+    layer_specs = tp_lib.INFER_TP_RULES.tree_specs(params['layers'])
+    cache_specs = {
+        k: tp_lib.POOL_ARENA_SCALE_SPEC if k.endswith('_scale')
+        else tp_lib.POOL_ARENA_SPEC for k in cache}
+    h_spec = P(dp, None, None)
+    vis_spec = P(*((dp,) + (None,) * (visible.ndim - 1)))
+
+    def region(layers, h, cache, tables_l, pos_l, blk_f, off_f,
+               visible_l, positions_l, cos_t, sin_t, *pf_ops):
+        b_l = h.shape[0]
+        bs = cache['k'].shape[2]
+        s_len = tables_l.shape[1] * bs
+        if pf is not None:
+            pf_h, pf_pos, pf_vis, pf_row, pf_start = pf_ops
+            fuse = pf_h.shape[0]
+            hc0 = jnp.concatenate([h, pf_h])
+            pos_all = jnp.concatenate([pos_l, pf_pos])
+        else:
+            hc0 = h
+            pos_all = pos_l
+
+        def scatter_rows(x):
+            """Full-batch write rows: ring-gather the dp-local decode
+            rows (mesh-rank order == batch order), append the
+            replicated prefill lane."""
+            if pf is not None:
+                x_dec, x_pf = x[:b_l], x[b_l:]
+            else:
+                x_dec, x_pf = x, None
+            if dp is not None:
+                x_dec = coll.ring_all_gather(x_dec, dp, tiled=True)
+            if x_pf is not None:
+                return jnp.concatenate([x_dec, x_pf])
+            return x_dec
+
+        def body(i, carry):
+            hc, pending, cache_c = carry
+            pl = jax.tree.map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, i, 0,
+                                                       keepdims=False),
+                layers)
+            attn_p = pl['attn']
+            hc, (q, k, v) = _combine_then_project(
+                pending, hc, pl['ln1'],
+                [attn_p['wq'], attn_p['wk'], attn_p['wv']],
+                model_axes, chunks, eps)
+            if 'bq' in attn_p:
+                q, k, v = (q + attn_p['bq'], k + attn_p['bk'],
+                           v + attn_p['bv'])
+            rows = hc.shape[0]
+            q = q.reshape(rows, w, nh_l, hd)
+            k = k.reshape(rows, w, nkv_l, hd)
+            v = v.reshape(rows, w, nkv_l, hd)
+            q = rope_ops.apply_rope(q, cos_t, sin_t, positions=pos_all)
+            k = rope_ops.apply_rope(k, cos_t, sin_t, positions=pos_all)
+            k_write = k if w > 1 else k[:, 0]
+            v_write = v if w > 1 else v[:, 0]
+            if quantized:
+                k_row, k_s_row = _quantize_kv(k_write)
+                v_row, v_s_row = _quantize_kv(v_write)
+                cache_c = dict(
+                    cache_c,
+                    k=cache_c['k'].at[i, blk_f, off_f].set(
+                        scatter_rows(k_row)),
+                    v=cache_c['v'].at[i, blk_f, off_f].set(
+                        scatter_rows(v_row)),
+                    k_scale=cache_c['k_scale'].at[i, blk_f, off_f].set(
+                        scatter_rows(k_s_row)),
+                    v_scale=cache_c['v_scale'].at[i, blk_f, off_f].set(
+                        scatter_rows(v_s_row)))
+            else:
+                cache_c = dict(
+                    cache_c,
+                    k=cache_c['k'].at[i, blk_f, off_f].set(
+                        scatter_rows(k_write)),
+                    v=cache_c['v'].at[i, blk_f, off_f].set(
+                        scatter_rows(v_write)))
+            if use_kernel:
+                if pf is not None:
+                    q_dec = q[:b_l, 0].reshape(b_l, nkv_l, grp_l, hd)
+                    q_pf = q[b_l:, 0].reshape(fuse, nkv_l, grp_l, hd)
+                    o_dec, o_pf = \
+                        decode_attention_ops.fused_step_attention_pooled(
+                            q_dec, q_pf, cache_c['k'], cache_c['v'],
+                            tables_l, pf_row, i, positions_l,
+                            pf_start, cache_c.get('k_scale'),
+                            cache_c.get('v_scale'), mesh=None)
+                    o = jnp.concatenate([o_dec, o_pf]).reshape(
+                        rows, w, nh_l * hd)
+                elif w > 1:
+                    q_w = q.reshape(b_l, w, nkv_l, grp_l, hd)
+                    o = decode_attention_ops.decode_window_attention_pooled(
+                        q_w, cache_c['k'], cache_c['v'], tables_l, i,
+                        positions_l, cache_c.get('k_scale'),
+                        cache_c.get('v_scale'), mesh=None)
+                    o = o.reshape(b_l, w, nh_l * hd)
+                else:
+                    q_r = q[:, 0].reshape(b_l, nkv_l, grp_l, hd)
+                    o = decode_attention_ops.decode_attention_pooled(
+                        q_r, cache_c['k'], cache_c['v'], tables_l, i,
+                        positions_l, cache_c.get('k_scale'),
+                        cache_c.get('v_scale'), mesh=None)
+                    o = o.reshape(b_l, 1, nh_l * hd)
+            else:
+                k_layer = jax.lax.dynamic_index_in_dim(
+                    cache_c['k'], i, 0, False)
+                v_layer = jax.lax.dynamic_index_in_dim(
+                    cache_c['v'], i, 0, False)
+                k_eff = k_layer[tables_l].reshape(b_l, s_len, nkv_l, hd)
+                v_eff = v_layer[tables_l].reshape(b_l, s_len, nkv_l, hd)
+                if quantized:
+                    ks_layer = jax.lax.dynamic_index_in_dim(
+                        cache_c['k_scale'], i, 0, False)
+                    vs_layer = jax.lax.dynamic_index_in_dim(
+                        cache_c['v_scale'], i, 0, False)
+                    k_s = ks_layer[tables_l].reshape(b_l, s_len, nkv_l)
+                    v_s = vs_layer[tables_l].reshape(b_l, s_len, nkv_l)
+                else:
+                    k_s = v_s = None
+                q_g = q[:b_l].reshape(b_l, w, nkv_l, grp_l, hd)
+                o_dec = _token_attention(
+                    q_g, k_eff, v_eff, visible_l, attn_scale,
+                    k_scale=k_s, v_scale=v_s)
+                o_dec = o_dec.reshape(b_l, w, nh_l * hd)
+                if pf is not None:
+                    # Prefill rows keep the chunked-window lane's
+                    # dequantize-then-dot numerics (fused_step_pooled's
+                    # bit-exactness argument), on the local head shard.
+                    if quantized:
+                        k_slot = _dequantize(
+                            k_layer[pf_row].reshape(s_len, nkv_l, hd),
+                            ks_layer[pf_row].reshape(s_len, nkv_l),
+                            q.dtype)
+                        v_slot = _dequantize(
+                            v_layer[pf_row].reshape(s_len, nkv_l, hd),
+                            vs_layer[pf_row].reshape(s_len, nkv_l),
+                            q.dtype)
+                    else:
+                        k_slot = k_layer[pf_row].reshape(
+                            s_len, nkv_l, hd)
+                        v_slot = v_layer[pf_row].reshape(
+                            s_len, nkv_l, hd)
+                    q_gp = q[b_l:, 0].reshape(fuse, nkv_l, grp_l, hd)
+                    s = jnp.einsum(
+                        'wkgd,skd->kgws', q_gp, k_slot,
+                        preferred_element_type=jnp.float32) * attn_scale
+                    s = jnp.where(pf_vis[None, None, :, :], s, -1e30)
+                    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+                    o_pf = jnp.einsum('kgws,skd->wkgd', p, v_slot)
+                    o = jnp.concatenate(
+                        [o_dec, o_pf.reshape(fuse, 1, nh_l * hd)])
+                else:
+                    o = o_dec
+            part = quant.matmul(o, attn_p['wo'])
+            hc, (g_acc, u_acc) = _combine_then_project(
+                part, hc, pl['ln2'],
+                [pl['mlp']['w_gate'], pl['mlp']['w_up']],
+                model_axes, chunks, eps)
+            gate = llama.gate_activation(g_acc, config.mlp_act)
+            pending = quant.matmul(gate * u_acc, pl['mlp']['w_down'])
+            return (hc, pending, cache_c)
+
+        hc, pending, cache_out = jax.lax.fori_loop(
+            0, config.n_layers, body,
+            (hc0, jnp.zeros_like(hc0), cache))
+        red, _ = coll.pipelined_psum(pending, model_axes, chunks=chunks)
+        hc = hc + red
+        if pf is not None:
+            return hc[:b_l], hc[b_l:], cache_out
+        return hc, cache_out
+
+    in_specs = [layer_specs, h_spec, cache_specs, P(dp, None),
+                P(dp, None), P(), P(), vis_spec, P(dp),
+                P(None, None), P(None, None)]
+    args = [params['layers'], h, cache, tables.astype(jnp.int32),
+            pos, blk, off, visible, positions, cos, sin]
+    if pf is not None:
+        in_specs += [P(None, None, None), P(None, None), P(None, None),
+                     P(None), P()]
+        args += [pf['h'], pf['pos'], pf['visible'],
+                 pf['table_row'].astype(jnp.int32),
+                 jnp.asarray(pf['start'], jnp.int32)]
+        out_specs = (h_spec, P(None, None, None), cache_specs)
+    else:
+        out_specs = (h_spec, cache_specs)
+    from skypilot_tpu.parallel.collectives import shard_map
+    return shard_map(region, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=out_specs, check_vma=False)(*args)
 
 
 def get_decode_fn(impl: str):
@@ -733,7 +1051,8 @@ def decode_step_paged(params: llama.Params, token: jax.Array,
 def decode_step_pooled(params: llama.Params, token: jax.Array,
                        config: llama.LlamaConfig, cache: Cache,
                        positions: jax.Array, tables: jax.Array,
-                       mesh=None) -> Tuple[jax.Array, Cache]:
+                       mesh=None, overlap: Optional[int] = None
+                       ) -> Tuple[jax.Array, Cache]:
     """One-token step over the pooled block arena (the default data
     plane, infer/block_pool.py).
 
@@ -765,6 +1084,14 @@ def decode_step_pooled(params: llama.Params, token: jax.Array,
     (scatter write, gather fallback, megatron matmuls) is plain GSPMD
     over the sharded operands — the K/V scatter needs no collective
     because the kv-head axis is sharded but never a scatter dim.
+
+    overlap: None keeps the GSPMD path above untouched.  An int chunk
+    count (and mesh.size > 1) routes the layer stack through
+    :func:`_pooled_layers_overlapped` — the manual region that
+    pipelines the megatron combines into the next matmuls.  overlap=1
+    keeps synchronous in-region psums (determinism-identical to GSPMD's
+    combine), >1 chunks them (token-level greedy parity; the combine
+    accumulation order stays fixed across chunk counts).
     """
     batch = token.shape[0]
     bs = cache['k'].shape[2]
@@ -784,6 +1111,17 @@ def decode_step_pooled(params: llama.Params, token: jax.Array,
                   and config.head_dim % 128 == 0)
     blk = tables[b_idx, positions.astype(jnp.int32) // bs]   # (B,)
     off = positions.astype(jnp.int32) % bs                   # (B,)
+
+    if overlap is not None and mesh is not None and mesh.size > 1:
+        h, cache = _pooled_layers_overlapped(
+            params, h, config, cache, mesh, overlap, cos, sin,
+            pos=pos, blk=blk, off=off, visible=visible,
+            tables=tables, positions=positions.astype(jnp.int32))
+        h = rmsnorm_ops.rms_norm(h, params['final_norm'],
+                                 eps=config.norm_eps)
+        logits = quant.matmul(h[:, 0], params['lm_head'],
+                              out_dtype=jnp.float32)
+        return logits, cache
 
     def body(i, carry):
         h, cache = carry
@@ -857,7 +1195,8 @@ def fused_step_pooled(params: llama.Params, token: jax.Array,
                       config: llama.LlamaConfig, cache: Cache,
                       positions: jax.Array, tables: jax.Array,
                       pf_tokens: jax.Array, pf_table_row: jax.Array,
-                      pf_start: jax.Array, mesh=None
+                      pf_start: jax.Array, mesh=None,
+                      overlap: Optional[int] = None
                       ) -> Tuple[jax.Array, jax.Array, Cache]:
     """Fused prefill+decode step over the pooled arena (chunked-prefill
     piggyback): ONE forward carries the decode batch's single-token
@@ -920,6 +1259,22 @@ def fused_step_pooled(params: llama.Params, token: jax.Array,
                                                 t_width - 1)])
     blk = jnp.concatenate([dec_blk, pf_blk])                 # (B+F,)
     off = pos_full % bs                                      # (B+F,)
+
+    if overlap is not None and mesh is not None and mesh.size > 1:
+        h_dec, h_pf, cache = _pooled_layers_overlapped(
+            params, h[:batch], config, cache, mesh, overlap, cos, sin,
+            pos=positions.astype(jnp.int32)[:, None], blk=blk, off=off,
+            visible=dec_visible, tables=tables,
+            positions=positions.astype(jnp.int32),
+            pf=dict(h=h[batch:], pos=pf_pos[:, None],
+                    visible=pf_visible, table_row=pf_table_row,
+                    start=pf_start))
+        h = jnp.concatenate([h_dec, h_pf])
+        h = rmsnorm_ops.rms_norm(h, params['final_norm'],
+                                 eps=config.norm_eps)
+        logits = quant.matmul(h[:batch, 0], params['lm_head'],
+                              out_dtype=jnp.float32)
+        return logits, h[batch:, 0], cache
 
     def body(i, carry):
         h, cache = carry
@@ -1033,7 +1388,8 @@ def fused_step_pooled(params: llama.Params, token: jax.Array,
 def decode_verify_pooled(params: llama.Params, tokens: jax.Array,
                          config: llama.LlamaConfig, cache: Cache,
                          positions: jax.Array, tables: jax.Array,
-                         mesh=None) -> Tuple[jax.Array, Cache]:
+                         mesh=None, overlap: Optional[int] = None
+                         ) -> Tuple[jax.Array, Cache]:
     """Speculative VERIFY step over the pooled arena: score a window of
     W = spec_k + 1 tokens per slot in one batched forward.
 
@@ -1078,6 +1434,17 @@ def decode_verify_pooled(params: llama.Params, tokens: jax.Array,
     blk = jnp.where(blk_idx >= t_width, 0,
                     tables[b_idx, jnp.minimum(blk_idx, t_width - 1)])
     off = pos_w % bs                                     # (B, W)
+
+    if overlap is not None and mesh is not None and mesh.size > 1:
+        h, cache = _pooled_layers_overlapped(
+            params, h, config, cache, mesh, overlap, cos, sin,
+            pos=pos_w, blk=blk, off=off, visible=visible,
+            tables=tables, positions=pos0)
+        h = rmsnorm_ops.rms_norm(h, params['final_norm'],
+                                 eps=config.norm_eps)
+        logits = quant.matmul(h.reshape(batch * win, -1),
+                              params['lm_head'], out_dtype=jnp.float32)
+        return logits.reshape(batch, win, -1), cache
 
     def body(i, carry):
         h, cache = carry

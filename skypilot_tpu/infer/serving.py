@@ -127,6 +127,7 @@ class ContinuousBatcher:
         from skypilot_tpu.infer.engine import (derive_buckets,
                                                derive_cache_buckets,
                                                prepare_params,
+                                               resolve_overlap,
                                                validate_context)
         validate_context(gen_config, config)
         if gen_config.prefill_chunk is not None and \
@@ -134,6 +135,7 @@ class ContinuousBatcher:
             # Fail at construction, not inside the scheduler thread.
             raise ValueError(f'prefill_chunk must be positive, got '
                              f'{gen_config.prefill_chunk}')
+        self.overlap = resolve_overlap(params, config, gen_config, mesh)
         self.params = prepare_params(params, gen_config)
         self.config = config
         self.gen = gen_config
@@ -341,6 +343,10 @@ class ContinuousBatcher:
         self._profiler = spans_lib.StepProfiler()
         self._span_buf = span_buffer
         self._span_clock = span_clock or time.time
+        # Estimated collective share of sharded dispatch phases (set by
+        # set_collective_share from a bench_mesh measurement; None =
+        # unknown, no 'collective' phase attribution).
+        self._collective_share: Optional[float] = None
 
     # ---- tracing ---------------------------------------------------------
     def _spans_on(self) -> bool:
@@ -366,8 +372,31 @@ class ContinuousBatcher:
         with self._profiler.phase('host_fetch'):
             return engine_lib.host_fetch(*arrays)
 
+    def set_collective_share(self, share: Optional[float]) -> None:
+        """Install the measured collective-time share of sharded
+        dispatch phases (bench_mesh's collective_time_share_est, or an
+        operator estimate).  While set on a mesh-sharded batcher, each
+        step's decode/spec_verify/fused phase time is split and that
+        share re-attributed to the 'collective' StepProfiler phase —
+        host timers cannot see inside a compiled program, so the split
+        is the honest estimate, clearly labeled as one.  None turns the
+        attribution off."""
+        if share is not None and not 0.0 <= share <= 1.0:
+            raise ValueError(f'collective share must be in [0, 1], '
+                             f'got {share}')
+        self._collective_share = share
+
     def _finish_step_profile(self) -> None:
         profiler = self._profiler
+        if (self._collective_share and self.mesh is not None
+                and self.mesh.size > 1):
+            moved = sum(profiler.reattribute(
+                src, 'collective', self._collective_share)
+                for src in ('decode', 'spec_verify', 'fused'))
+            if moved > 0.0:
+                telemetry_metrics.INFER_MESH_COLLECTIVE_SECONDS.labels(
+                    mode='overlapped' if self.overlap is not None
+                    else 'sync').inc(moved)
         phases = profiler.finish()
         if not phases:
             return
@@ -486,7 +515,7 @@ class ContinuousBatcher:
             def decode_fn(params, token, config, cache, positions):
                 return llama_infer.decode_step_pooled(
                     params, token, config, cache, positions, tables,
-                    mesh=self.mesh)
+                    mesh=self.mesh, overlap=self.overlap)
         else:
             decode_fn = llama_infer.get_decode_fn(self.gen.decode_impl)
         batch = token.shape[0]
@@ -580,7 +609,8 @@ class ContinuousBatcher:
         rng, sub = jax.random.split(rng)
         logits, h_pf, cache = llama_infer.fused_step_pooled(
             params, token, self.config, cache, positions, tables,
-            pf_tokens, pf_table_row, pf_start, mesh=self.mesh)
+            pf_tokens, pf_table_row, pf_start, mesh=self.mesh,
+            overlap=self.overlap)
         token, positions, done, limit, toks = commit(
             0, sub, logits, token, positions, done, limit, toks)
 
@@ -589,7 +619,7 @@ class ContinuousBatcher:
             rng, sub = jax.random.split(rng)
             logits, cache = llama_infer.decode_step_pooled(
                 params, token, self.config, cache, positions, tables,
-                mesh=self.mesh)
+                mesh=self.mesh, overlap=self.overlap)
             token, positions, done, limit, toks = commit(
                 i, sub, logits, token, positions, done, limit, toks)
             return (token, cache, positions, done, limit, rng, toks)
@@ -620,7 +650,7 @@ class ContinuousBatcher:
         tokens_w = jnp.concatenate([token[:, None], draft], axis=1)
         logits, cache = llama_infer.decode_verify_pooled(
             params, tokens_w, self.config, cache, positions, tables,
-            mesh=self.mesh)
+            mesh=self.mesh, overlap=self.overlap)
         rng, sub = jax.random.split(rng)
         if all_greedy:
             # Greedy acceptance is BIT-EXACT: an accepted draft token

@@ -1,14 +1,24 @@
-"""ICI/DCN collective micro-benchmarks.
+"""ICI/DCN collective micro-benchmarks and ring collective primitives.
 
 The TPU-native analog of the reference's NCCL allreduce recipe
 (examples/nccl_test.yaml, which reports algbw/busbw for torch.distributed
 all_reduce) — here the collective is `jax.lax.psum` over a mesh axis and the
 transport is ICI (in-slice) or DCN (multislice), inserted by XLA.
+
+The ring primitives (`ring_all_gather`, `ring_reduce_scatter`,
+`pipelined_psum`) decompose one monolithic collective into
+`lax.ppermute` steps over the ici-ordered ring (parallel/mesh.py
+ici_order gives the mesh rank order physical-neighbor adjacency, and
+ring_attention.py is the in-repo precedent for the ppermute ring).
+Chunked ppermute exchanges are independent HLO ops, so XLA's
+latency-hiding scheduler can issue them while unrelated compute runs —
+the mechanism infer/llama_infer.py's overlapped decode path uses to
+hide the megatron combines under the next matmuls.
 """
 from __future__ import annotations
 
 import time
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +49,165 @@ except AttributeError:  # jax < 0.5
             kw['check_rep'] = check_vma
         return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, **kw)
+
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def _axis_tuple(axis_name: AxisNames) -> Tuple[str, ...]:
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def _ring_perm(n: int) -> List[Tuple[int, int]]:
+    """The forward ring permutation over mesh-rank order — rank i sends
+    to rank i+1 (mod n).  make_tp_mesh lays devices out along the ICI
+    torus (parallel/mesh.py ici_order), so each hop is one physical
+    neighbor link, the same ring ring_attention.py walks."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_all_gather(x: jax.Array, axis_name: str, *,
+                    tiled: bool = False) -> jax.Array:
+    """All-gather built from n-1 `lax.ppermute` ring hops.  Must be
+    called inside a manual (shard_map) region.
+
+    Returns the shards stacked along a new leading axis in MESH-RANK
+    order — the same order (and, since no arithmetic happens, the same
+    bits) as `lax.all_gather(x, axis_name)`.  tiled=True concatenates
+    along x's existing leading axis instead, matching all_gather's
+    tiled form.
+
+    Unlike the one-shot all_gather, the n-1 hops are independent HLO
+    collective-permutes: the scheduler can interleave them with
+    unrelated compute, and downstream consumers of early pieces need
+    not wait for the full gather.
+    """
+    n = jax.lax.psum(1, axis_name)  # static axis size
+    if n == 1:
+        stacked = x[None]
+        return stacked.reshape((-1,) + x.shape[1:]) if tiled else stacked
+    r = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    pieces = [x]
+    cur = x
+    for _ in range(1, n):
+        cur = jax.lax.ppermute(cur, axis_name, perm)
+        pieces.append(cur)
+    # Arrival order at rank r is [r, r-1, ..., r-n+1]; flip makes it the
+    # ascending run [r+1, ..., r] and a roll by r+1 rotates that to
+    # plain rank order [0, ..., n-1] — identical on every shard.
+    stacked = jnp.roll(jnp.flip(jnp.stack(pieces), 0), shift=r + 1,
+                       axis=0)
+    if tiled:
+        return stacked.reshape((-1,) + x.shape[1:])
+    return stacked
+
+
+def ring_reduce_scatter(x: jax.Array, axis_name: str) -> jax.Array:
+    """Reduce-scatter built from n-1 ring hops: x (n*c, ...) per shard;
+    rank r returns sum_p x_p[r*c:(r+1)*c] — `lax.psum_scatter`'s tiled
+    contract.  Must be called inside a manual (shard_map) region.
+
+    Accumulation order for rank r's chunk is the ring arrival order
+    r+1, r+2, ..., r (deterministic, but rotated per destination — the
+    classic ring schedule).  When the caller needs one FIXED order on
+    every shard (the bit-exactness contract of the overlapped decode
+    path), use `pipelined_psum`, which pays ~n/2x ring bandwidth for a
+    rank-0-first accumulation identical everywhere.
+    """
+    n = jax.lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    if x.shape[0] % n:
+        raise ValueError(
+            f'ring_reduce_scatter: leading axis {x.shape[0]} not '
+            f'divisible by axis size {n}')
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    r = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    # The partial destined for rank d starts at rank d+1; at step t it
+    # sits at rank d+1+t and absorbs that rank's local chunk, arriving
+    # complete at rank d after n-1 hops.
+    acc = jnp.take(xs, (r - 1) % n, axis=0)
+    for t in range(1, n):
+        acc = jax.lax.ppermute(acc, axis_name, perm)
+        acc = acc + jnp.take(xs, (r - 1 - t) % n, axis=0)
+    return acc
+
+
+def _rank_order_allreduce(x: jax.Array, axes: Tuple[str, ...]) -> jax.Array:
+    """All-reduce via ring all-gather + LOCAL sum in flat mesh-rank
+    order (axes flattened major-to-minor, e.g. ('tp','tpq') sums rank
+    (tp=0,tpq=0) first).  The order is identical on every shard and
+    independent of chunking — the deterministic-accumulation guarantee
+    pipelined_psum is built on."""
+    g = x[None]
+    for ax in reversed(axes):
+        g = ring_all_gather(g, ax)
+        g = g.reshape((-1,) + g.shape[2:])
+    acc = g[0]
+    for j in range(1, g.shape[0]):
+        acc = acc + g[j]
+    return acc
+
+
+def chunk_bounds(dim: int, chunks: int) -> List[Tuple[int, int]]:
+    """Split [0, dim) into `chunks` contiguous spans, the first dim %
+    chunks spans one element longer (numpy array_split convention), so
+    non-divisible chunk counts are legal."""
+    chunks = max(1, min(chunks, dim))
+    base, extra = divmod(dim, chunks)
+    bounds, lo = [], 0
+    for c in range(chunks):
+        hi = lo + base + (1 if c < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def pipelined_psum(x: jax.Array, axis_name: AxisNames, chunks: int = 1,
+                   on_chunk: Optional[Callable] = None):
+    """Chunked deterministic all-reduce over one or more mesh axes,
+    interleavable with caller compute.  Must be called inside a manual
+    (shard_map) region.
+
+    The last axis of x is split into `chunks` spans (uneven tails
+    allowed); each span is combined by a ring all-gather of the shard
+    partials followed by a local sum in flat mesh-rank order — the SAME
+    fixed accumulation order on every shard regardless of `chunks`, so
+    greedy decode output is bit-stable across chunk policies (the
+    overlapped-decode contract).  As each reduced span completes,
+    `on_chunk(idx, start, span)` runs with the combined values: its
+    matmuls depend only on that span's ppermutes, so the scheduler
+    overlaps span c's compute with span c+1's exchanges.
+
+    chunks <= 1 falls back to a single `lax.psum` — today's synchronous
+    combine, byte-identical lowering, which is what tiny payloads want
+    (per-chunk latency would dominate; see GeneratorConfig's chunk
+    policy).
+
+    Returns (reduced x, list of on_chunk results) — the list is None
+    when on_chunk is None.
+    """
+    axes = _axis_tuple(axis_name)
+    n = 1
+    for ax in axes:
+        n *= jax.lax.psum(1, ax)
+    if chunks <= 1 or n == 1:
+        red = x if n == 1 else jax.lax.psum(x, axes)
+        if on_chunk is None:
+            return red, None
+        return red, [on_chunk(0, 0, red)]
+    spans = chunk_bounds(x.shape[-1], chunks)
+    outs, results = [], []
+    for ci, (lo, hi) in enumerate(spans):
+        red_c = _rank_order_allreduce(
+            jax.lax.slice_in_dim(x, lo, hi, axis=-1), axes)
+        outs.append(red_c)
+        if on_chunk is not None:
+            results.append(on_chunk(ci, lo, red_c))
+    red = jnp.concatenate(outs, axis=-1)
+    return red, (results if on_chunk is not None else None)
 
 
 def psum_bench(mesh, axis_name: str = 'dp', payload_mb: float = 128.0,

@@ -312,6 +312,24 @@ INFER_MESH_COLLECTIVE_TIME_SHARE = prometheus_client.Gauge(
     'per-op trace)',
     registry=REGISTRY)
 
+INFER_MESH_OVERLAP_RATIO = prometheus_client.Gauge(
+    'skytpu_infer_mesh_overlap_ratio',
+    'Hidden-communication fraction of the overlapped sharded decode '
+    'path: 1 - overlapped collective share / sync collective share, '
+    'clamped to [0, 1] (0 = sync path or no hiding; measured by '
+    'bench_mesh from the sync-vs-overlapped step timings)',
+    registry=REGISTRY)
+
+INFER_MESH_COLLECTIVE_SECONDS = prometheus_client.Counter(
+    'skytpu_infer_mesh_collective_seconds',
+    'Cumulative estimated seconds sharded decode steps spent in '
+    'collectives, split by combine schedule (mode = sync | '
+    'overlapped); fed by the StepProfiler collective phase (the '
+    'decode/verify/fused share reattributed via the measured '
+    'collective_time_share) and by bench_mesh',
+    ['mode'],
+    registry=REGISTRY)
+
 INFER_MESH_POOL_BLOCKS_PER_SHARD = prometheus_client.Gauge(
     'skytpu_infer_mesh_pool_blocks_live_per_shard',
     'Live arena blocks each tp shard holds a KV-head slice of (block '

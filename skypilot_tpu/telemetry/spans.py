@@ -234,7 +234,7 @@ def flush() -> None:
 # ---- step-phase attribution --------------------------------------------
 
 STEP_PHASES = ('admit', 'prefill', 'fused', 'spec_draft', 'spec_verify',
-               'decode', 'host_fetch', 'upload')
+               'decode', 'host_fetch', 'upload', 'collective')
 
 
 class StepProfiler:
@@ -287,6 +287,21 @@ class StepProfiler:
             self._acc[name] = self._acc.get(name, 0.0) + (now - self._mark)
             self._stack.pop()
             self._mark = now
+
+    def reattribute(self, src: str, dst: str, fraction: float) -> float:
+        """Move `fraction` of phase `src`'s accumulated seconds to
+        phase `dst` (e.g. the estimated collective share of a
+        mesh-sharded decode chunk into the 'collective' phase).  Time
+        is MOVED, never invented, so the exclusive-accounting
+        invariant — sum(phases) <= wall — is preserved exactly.
+        Returns the seconds moved (0.0 when src never ran or the
+        fraction is non-positive)."""
+        if src not in self._acc or fraction <= 0.0:
+            return 0.0
+        moved = self._acc[src] * min(fraction, 1.0)
+        self._acc[src] -= moved
+        self._acc[dst] = self._acc.get(dst, 0.0) + moved
+        return moved
 
     def finish(self) -> Dict[str, float]:
         """End the step; returns {phase: seconds} and records

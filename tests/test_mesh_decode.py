@@ -214,3 +214,175 @@ def test_mesh_telemetry_gauges(params):
     live = REGISTRY.get_sample_value(
         'skytpu_infer_mesh_pool_blocks_live_per_shard')
     assert live is not None and live >= 0
+
+
+# -- communication/compute overlap: schedule is not a numerics change ---
+
+
+def _gen_tokens(p, cfg, gen_cfg, mesh):
+    return Generator(p, cfg, gen_cfg, mesh=mesh).generate(
+        PROMPTS, max_new_tokens=12)
+
+
+@pytest.mark.parametrize('dtype,kv_dtype', [
+    (jnp.float32, None), (jnp.float32, 'int8'),
+    (jnp.bfloat16, None), (jnp.bfloat16, 'int8'),
+], ids=['f32', 'f32-int8kv', 'bf16', 'bf16-int8kv'])
+@pytest.mark.parametrize('mesh_kw', [
+    {'tp': 4}, {'tp': 2, 'dp': 2},
+], ids=['tp4', 'dp2xtp2'])
+def test_generator_overlap_sync_bit_exact(dtype, kv_dtype, mesh_kw):
+    # Ring-pipelined combines (chunks > 1) vs the forced-sync GSPMD
+    # schedule vs the unsharded baseline: identical greedy tokens.
+    # The fixed mesh-rank accumulation order of pipelined_psum is what
+    # makes this hold for the whole dtype x KV-quant matrix.
+    cfg = dataclasses.replace(CFG, dtype=dtype)
+    p = llama.init_params(cfg, jax.random.PRNGKey(1))
+    gen_cfg = dataclasses.replace(GEN, kv_cache_dtype=kv_dtype)
+    mesh = tp_lib.make_tp_mesh(mesh_kw['tp'], n_kv_heads=cfg.n_kv_heads,
+                               dp=mesh_kw.get('dp', 1))
+    base = _gen_tokens(p, cfg, gen_cfg, None)
+    sync = _gen_tokens(p, cfg, dataclasses.replace(
+        gen_cfg, overlap_collectives=False), mesh)
+    ovl = _gen_tokens(p, cfg, dataclasses.replace(
+        gen_cfg, overlap_collectives=True, overlap_chunks=2), mesh)
+    assert sync == base
+    assert ovl == base
+
+
+@pytest.mark.parametrize('chunks', [2, 3, 4])
+def test_generator_overlap_chunk_counts(params, chunks):
+    # Non-divisible chunk counts (d_model 64 / 3) included: the
+    # array_split spans keep the schedule legal and the output fixed.
+    mesh = tp_lib.make_tp_mesh(4, n_kv_heads=CFG.n_kv_heads)
+    base = _gen_tokens(params, CFG, GEN, None)
+    ovl = _gen_tokens(params, CFG, dataclasses.replace(
+        GEN, overlap_collectives=True, overlap_chunks=chunks), mesh)
+    assert ovl == base
+
+
+def test_batcher_overlap_sync_bit_exact(params):
+    def run(gen_cfg, mesh):
+        b = ContinuousBatcher(params, CFG, gen_cfg, mesh=mesh)
+        rids = [b.submit(p, max_new_tokens=10) for p in PROMPTS]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    mesh = tp_lib.make_tp_mesh(4, n_kv_heads=CFG.n_kv_heads)
+    base = run(GEN, None)
+    sync = run(dataclasses.replace(GEN, overlap_collectives=False), mesh)
+    ovl = run(dataclasses.replace(GEN, overlap_collectives=True,
+                                  overlap_chunks=2), mesh)
+    assert sync == base
+    assert ovl == base
+
+
+def test_spec_verify_overlap_bit_exact(params):
+    # The W-token verify forward rides the same overlapped region; the
+    # accept/rollback decision must see identical logits.
+    def run(gen_cfg, mesh):
+        b = ContinuousBatcher(params, CFG, gen_cfg, mesh=mesh)
+        rids = [b.submit(p, max_new_tokens=12) for p in PROMPTS]
+        b.run_until_idle()
+        return [b.result(r) for r in rids]
+
+    spec = dataclasses.replace(GEN, spec_k=2)
+    mesh = tp_lib.make_tp_mesh(4, n_kv_heads=CFG.n_kv_heads)
+    base = run(spec, None)
+    ovl = run(dataclasses.replace(spec, overlap_collectives=True,
+                                  overlap_chunks=2), mesh)
+    assert ovl == base
+
+
+def test_fused_step_overlap_bit_exact(params):
+    # Chunked-prefill piggyback: the fused prefill+decode step routes
+    # its decode lane and prefill window through the overlap region.
+    fuse = dataclasses.replace(GEN, batch_size=4,
+                               prompt_buckets=[8, 32],
+                               prefill_chunk=8, fuse_budget=6)
+
+    def run(gen_cfg, mesh):
+        b = ContinuousBatcher(params, CFG, gen_cfg, mesh=mesh)
+        for p in PROMPTS:
+            b.submit(list(p), max_new_tokens=10)
+        long_rid = b.submit(list(range(2, 26)), max_new_tokens=6)
+        b.run_until_idle()
+        return ([b.result(r) for r in (1, 2)], b.result(long_rid),
+                b._fuse_policy.stats.steps)
+
+    base_out, base_long, _ = run(fuse, None)
+    mesh = tp_lib.make_tp_mesh(4, n_kv_heads=CFG.n_kv_heads)
+    ovl_out, ovl_long, fused_steps = run(
+        dataclasses.replace(fuse, overlap_collectives=True,
+                            overlap_chunks=2), mesh)
+    assert fused_steps > 0, 'piggyback gate never engaged — pins nothing'
+    assert ovl_out == base_out
+    assert ovl_long == base_long
+
+
+# -- overlap gating (engine.resolve_overlap) ----------------------------
+
+
+def test_resolve_overlap_gating(params):
+    from skypilot_tpu.infer.engine import resolve_overlap
+    mesh = tp_lib.make_tp_mesh(4, n_kv_heads=CFG.n_kv_heads)
+
+    # Auto (None): on exactly when supported; off without a mesh.
+    assert resolve_overlap(params, CFG, GEN, mesh) is not None
+    assert resolve_overlap(params, CFG, GEN, None) is None
+    one = tp_lib.make_tp_mesh(1, n_kv_heads=CFG.n_kv_heads)
+    assert resolve_overlap(params, CFG, GEN, one) is None
+
+    # False: forced sync even where supported.
+    off = dataclasses.replace(GEN, overlap_collectives=False)
+    assert resolve_overlap(params, CFG, off, mesh) is None
+
+    # True: never a silent fallback — unsupported raises with reasons.
+    on = dataclasses.replace(GEN, overlap_collectives=True)
+    with pytest.raises(ValueError, match='mesh.size > 1'):
+        resolve_overlap(params, CFG, on, None)
+    with pytest.raises(ValueError, match='unquantized'):
+        resolve_overlap(params, CFG, dataclasses.replace(
+            on, weights_dtype='int8'), mesh)
+    with pytest.raises(ValueError, match='MoE'):
+        resolve_overlap({'layers': {'moe': {}}}, CFG, on, mesh)
+
+    # Explicit chunk count wins; auto policy scales with d_model and
+    # caps at the model-shard count.
+    assert resolve_overlap(params, CFG, dataclasses.replace(
+        on, overlap_chunks=3), mesh) == 3
+    assert resolve_overlap(params, CFG, GEN, mesh) == max(
+        1, min(4, CFG.d_model // 256))
+    wide = dataclasses.replace(CFG, d_model=1024)
+    assert resolve_overlap(None, wide, GEN, mesh) == 4
+
+
+def test_overlap_config_validation():
+    with pytest.raises(ValueError, match='overlap_chunks'):
+        dataclasses.replace(GEN, overlap_chunks=0)
+    with pytest.raises(ValueError, match='pooled'):
+        dataclasses.replace(GEN, overlap_collectives=True,
+                            decode_impl='legacy')
+
+
+def test_overlap_fast_paths_byte_identical(params):
+    # mesh=None and overlap=None both take the exact pre-overlap code
+    # path at the function level: passing overlap on a single-device
+    # call must not change a single byte of logits.
+    from skypilot_tpu.infer import block_pool as block_pool_lib
+    from skypilot_tpu.infer import llama_infer
+    import numpy as np
+    pool = block_pool_lib.BlockPool(CFG, 9, 16)
+    arena = pool.arena
+    tok = jnp.array([3, 7], jnp.int32)
+    pos = jnp.array([0, 0], jnp.int32)
+    tables = jnp.array([[1, 0], [2, 0]], jnp.int32)
+    base_logits, base_cache = llama_infer.decode_step_pooled(
+        params, tok, CFG, arena, pos, tables, mesh=None)
+    ovl_logits, ovl_cache = llama_infer.decode_step_pooled(
+        params, tok, CFG, arena, pos, tables, mesh=None, overlap=4)
+    assert np.array_equal(np.asarray(base_logits),
+                          np.asarray(ovl_logits))
+    assert all(np.array_equal(np.asarray(base_cache[k]),
+                              np.asarray(ovl_cache[k]))
+               for k in base_cache)

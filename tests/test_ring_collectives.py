@@ -1,0 +1,226 @@
+"""Ring collective primitives (parallel/collectives.py) against the
+monolithic-collective oracles, on the hermetic 8-device CPU mesh.
+
+The contract under test is the one the overlapped decode path leans
+on: `ring_all_gather` moves the same BITS as `lax.all_gather` (rank
+order, no arithmetic), `ring_reduce_scatter` matches `psum_scatter`'s
+tiled contract, and `pipelined_psum` accumulates in flat mesh-rank
+order on every shard REGARDLESS of chunk count — that fixed order is
+what makes greedy decode bit-stable across chunk policies.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.parallel import collectives
+from skypilot_tpu.parallel.collectives import shard_map
+
+N = 4
+
+
+def _mesh1():
+    return Mesh(np.array(jax.devices()[:N]), ('x',))
+
+
+def _mesh2():
+    return Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ('tp', 'tpq'))
+
+
+def _run(mesh, f, x, in_specs, out_specs):
+    # check_vma off: the ring primitives build replicated values out of
+    # ppermutes + axis_index math the replication checker can't see
+    # through (same setting the overlapped decode region uses).
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False))(x)
+
+
+def test_ring_perm_is_forward_neighbor_ring():
+    assert collectives._ring_perm(4) == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    assert collectives._ring_perm(1) == [(0, 0)]
+
+
+def test_chunk_bounds_array_split_convention():
+    assert collectives.chunk_bounds(8, 2) == [(0, 4), (4, 8)]
+    # Non-divisible: first dim % chunks spans are one longer.
+    assert collectives.chunk_bounds(7, 3) == [(0, 3), (3, 5), (5, 7)]
+    # chunks > dim clamps to dim; chunks <= 1 is one span.
+    assert collectives.chunk_bounds(3, 10) == [(0, 1), (1, 2), (2, 3)]
+    assert collectives.chunk_bounds(5, 0) == [(0, 5)]
+    for dim, chunks in ((13, 4), (1, 1), (64, 3)):
+        bounds = collectives.chunk_bounds(dim, chunks)
+        assert bounds[0][0] == 0 and bounds[-1][1] == dim
+        assert all(a[1] == b[0] for a, b in zip(bounds, bounds[1:]))
+
+
+@pytest.mark.parametrize('tiled', [False, True])
+def test_ring_all_gather_bitwise_matches_all_gather(tiled):
+    mesh = _mesh1()
+    x = jax.random.normal(jax.random.PRNGKey(0), (N * 3, 5), jnp.float32)
+    out_specs = P(*([None] * (2 if tiled else 3)))
+    ring = _run(mesh,
+                lambda a: collectives.ring_all_gather(a, 'x', tiled=tiled),
+                x, P('x', None), out_specs)
+    oracle = _run(mesh,
+                  lambda a: jax.lax.all_gather(a, 'x', tiled=tiled),
+                  x, P('x', None), out_specs)
+    # Pure data movement: identical bits, not just identical values.
+    assert np.array_equal(np.asarray(ring), np.asarray(oracle))
+
+
+def test_ring_all_gather_single_rank_identity():
+    mesh = Mesh(np.array(jax.devices()[:1]), ('x',))
+    x = jnp.arange(6, dtype=jnp.float32).reshape(3, 2)
+    out = _run(mesh, lambda a: collectives.ring_all_gather(a, 'x'),
+               x, P('x', None), P(None, None, None))
+    assert np.array_equal(np.asarray(out), np.asarray(x)[None])
+
+
+def test_ring_reduce_scatter_matches_psum_scatter():
+    mesh = _mesh1()
+    c = 2
+    x = jax.random.normal(jax.random.PRNGKey(1), (N * N * c, 3),
+                          jnp.float32)
+    ring = _run(mesh, lambda a: collectives.ring_reduce_scatter(a, 'x'),
+                x, P('x', None), P('x', None))
+    oracle = _run(mesh,
+                  lambda a: jax.lax.psum_scatter(a, 'x', tiled=True),
+                  x, P('x', None), P('x', None))
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(oracle),
+                               rtol=1e-6)
+    # Integer payload: associativity is exact, so so is the match.
+    xi = jnp.arange(N * N * c * 3, dtype=jnp.int32).reshape(N * N * c, 3)
+    ring_i = _run(mesh, lambda a: collectives.ring_reduce_scatter(a, 'x'),
+                  xi, P('x', None), P('x', None))
+    oracle_i = _run(mesh,
+                    lambda a: jax.lax.psum_scatter(a, 'x', tiled=True),
+                    xi, P('x', None), P('x', None))
+    assert np.array_equal(np.asarray(ring_i), np.asarray(oracle_i))
+
+
+def test_ring_reduce_scatter_rejects_non_divisible():
+    mesh = _mesh1()
+    x = jnp.zeros((N * 5, 3), jnp.float32)   # per-shard leading dim 5
+    with pytest.raises(ValueError, match='not.*divisible|divisible'):
+        _run(mesh, lambda a: collectives.ring_reduce_scatter(a, 'x'),
+             x, P('x', None), P('x', None))
+
+
+@pytest.mark.parametrize('chunks', [1, 2, 3, 8, 64])
+def test_pipelined_psum_matches_psum(chunks):
+    mesh = _mesh1()
+    x = jax.random.normal(jax.random.PRNGKey(2), (N, 2, 24), jnp.float32)
+
+    def ring(a):
+        red, _ = collectives.pipelined_psum(a, 'x', chunks=chunks)
+        return red
+
+    out = _run(mesh, ring, x, P('x', None, None), P('x', None, None))
+    oracle = _run(mesh, lambda a: jax.lax.psum(a, 'x'),
+                  x, P('x', None, None), P('x', None, None))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=1e-6)
+
+
+def test_pipelined_psum_rank_order_is_chunk_invariant():
+    # The determinism contract: any chunked schedule accumulates in
+    # flat mesh-rank order, so results are BIT-identical across chunk
+    # counts and equal to a sequential rank-0-first numpy sum.
+    mesh = _mesh1()
+    x = jax.random.normal(jax.random.PRNGKey(3), (N, 33), jnp.float32)
+
+    def run(chunks):
+        def f(a):
+            red, _ = collectives.pipelined_psum(a, 'x', chunks=chunks)
+            return red
+        return np.asarray(_run(mesh, f, x, P('x', None), P('x', None)))
+
+    ref = np.asarray(x)[0]
+    for r in range(1, N):
+        ref = ref + np.asarray(x)[r]      # rank order, f32 throughout
+    for c in (2, 3, 4):
+        out = run(c)
+        assert np.array_equal(out, np.tile(ref, (N, 1))), \
+            f'chunks={c} diverged from rank-order accumulation'
+
+
+def test_pipelined_psum_multi_axis_rank_order():
+    # ('tp', 'tpq') flattens major-to-minor: (0,0), (0,1), (1,0), (1,1).
+    mesh = _mesh2()
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 20), jnp.float32)
+
+    def f(a):
+        red, _ = collectives.pipelined_psum(a, ('tp', 'tpq'), chunks=2)
+        return red
+
+    out = np.asarray(_run(mesh, f, x, P('tp', 'tpq', None),
+                          P('tp', 'tpq', None)))
+    xs = np.asarray(x)
+    ref = xs[0, 0]
+    for i, j in ((0, 1), (1, 0), (1, 1)):
+        ref = ref + xs[i, j]
+    for i in range(2):
+        for j in range(2):
+            assert np.array_equal(out[i, j], ref)
+
+
+def test_pipelined_psum_on_chunk_bounds_and_results():
+    mesh = _mesh1()
+    d = 10
+    x = jnp.ones((N, d), jnp.float32)
+    seen = []
+
+    def f(a):
+        def on_chunk(ci, lo, span):
+            seen.append((ci, lo, span.shape[-1]))
+            return span * 0 + ci
+        red, results = collectives.pipelined_psum(a, 'x', chunks=3,
+                                                  on_chunk=on_chunk)
+        return red, jnp.concatenate(results, axis=-1)
+
+    red, tagged = _run(mesh, f, x, P('x', None),
+                       (P('x', None), P('x', None)))
+    # array_split convention over d=10: spans 4, 3, 3.
+    assert seen == [(0, 0, 4), (1, 4, 3), (2, 7, 3)]
+    assert np.array_equal(np.asarray(red), np.full((N, d), float(N)))
+    expect = np.concatenate([np.full((4,), 0.0), np.full((3,), 1.0),
+                             np.full((3,), 2.0)])
+    assert np.array_equal(np.asarray(tagged),
+                          np.tile(expect, (N, 1)).astype(np.float32))
+
+
+def test_pipelined_psum_chunks_one_invokes_on_chunk_once():
+    mesh = _mesh1()
+    x = jnp.ones((N, 6), jnp.float32)
+    seen = []
+
+    def f(a):
+        def on_chunk(ci, lo, span):
+            seen.append((ci, lo, span.shape[-1]))
+            return span
+        red, results = collectives.pipelined_psum(a, 'x', chunks=1,
+                                                  on_chunk=on_chunk)
+        return red, results[0]
+
+    red, only = _run(mesh, f, x, P('x', None), (P('x', None), P('x', None)))
+    assert seen == [(0, 0, 6)]           # whole reduced vector, once
+    assert np.array_equal(np.asarray(red), np.asarray(only))
+
+
+def test_shard_map_shim_accepts_modern_kwargs():
+    # The jax<0.5 shim must accept the modern call surface (check_vma=)
+    # — every shard_map in the repo routes through it.
+    mesh = _mesh1()
+    x = jnp.arange(N, dtype=jnp.float32)
+    out = jax.jit(shard_map(lambda a: jax.lax.psum(a, 'x'), mesh=mesh,
+                            in_specs=P('x'), out_specs=P('x'),
+                            check_vma=False))(x)
+    assert np.array_equal(np.asarray(out), np.full((N,), 6.0))
+
+
+def test_shard_map_shim_mesh_none_needs_modern_jax():
+    if hasattr(jax, 'shard_map'):
+        pytest.skip('jax >= 0.5: mesh-free shard_map is native')
+    with pytest.raises(NotImplementedError):
+        shard_map(lambda a: a, in_specs=P('x'), out_specs=P('x'))
