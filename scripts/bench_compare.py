@@ -48,6 +48,14 @@ FIELDS: Tuple[Tuple[str, bool], ...] = (
     ('mesh.sharded_decode_tok_s_chip', True),
     ('mesh.collective_time_share_est', False),
     ('mesh.overlap.sharded_decode_tok_s_chip_sync', True),
+    # Tiered KV cache: warm hits must survive eviction pressure and the
+    # host copies must not slow down or start landing late.  Compared
+    # only when BOTH artifacts carry a tier block at the same working
+    # set / budget ratio with greedy parity intact (_tier_comparable).
+    ('tier.warm_hit_ratio', True),
+    ('tier.spill_gbps', True),
+    ('tier.prefetch_gbps', True),
+    ('tier.prefetch_late_rate', False),
 )
 
 
@@ -71,6 +79,27 @@ def _mesh_comparable(old: Dict[str, Any], new: Dict[str, Any]
                 f'({a.get("ideal_parallelism")} -> '
                 f'{b.get("ideal_parallelism")})')
     return None
+
+def _tier_comparable(old: Dict[str, Any], new: Dict[str, Any]
+                     ) -> Optional[str]:
+    """None when tier fields may be compared, else the skip reason."""
+    a, b = old.get('tier'), new.get('tier')
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return 'tier block missing on one side'
+    if 'error' in a or 'error' in b:
+        return 'tier bench errored on one side'
+    if not (a.get('parity_ok', False) and b.get('parity_ok', False)):
+        # A parity break is a correctness bug, not a perf delta; the
+        # bench itself asserts it, so this is belt-and-braces.
+        return 'greedy parity not ok on one side'
+    ra, rb = a.get('working_set_x_budget'), b.get('working_set_x_budget')
+    if (not isinstance(ra, (int, float))
+            or not isinstance(rb, (int, float))
+            or abs(ra - rb) > 0.5):
+        # Different eviction pressure is a different experiment.
+        return (f'working_set_x_budget changed ({ra} -> {rb})')
+    return None
+
 
 _HEADLINE_RE = re.compile(r'^BENCH_HEADLINE (\{.*\})\s*$', re.M)
 
@@ -108,9 +137,13 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     lines: List[str] = []
     regressions: List[str] = []
     mesh_skip = _mesh_comparable(old, new)
+    tier_skip = _tier_comparable(old, new)
     for dotted, higher_better in FIELDS:
         if dotted.startswith('mesh.') and mesh_skip is not None:
             lines.append(f'  {dotted}: skipped ({mesh_skip})')
+            continue
+        if dotted.startswith('tier.') and tier_skip is not None:
+            lines.append(f'  {dotted}: skipped ({tier_skip})')
             continue
         a, b = _lookup(old, dotted), _lookup(new, dotted)
         if a is None or b is None or a == 0:

@@ -1014,6 +1014,108 @@ def audit_mesh_decode() -> Dict[str, Any]:
             'ring_collective_permutes_per_layer': per_layer_cp}
 
 
+def audit_kv_tier() -> Dict[str, Any]:
+    """The host KV tier's copy contract (infer/kv_tier.py): across a
+    spill-heavy churn run plus a hinted prefetch, the gather and
+    scatter copy helpers compile ONCE each (the block-id vector is
+    traced at the FIXED ids_per_node length — a second program means
+    a copy re-keyed on shape), their traced graphs are callback-free
+    and f64-free, the pooled decode chunk stays within its usual <= 2
+    budget with the tier on (tier traffic must not re-key decode),
+    and the pool's refcount conservation balances after a spilled
+    prefix has round-tripped through host DRAM."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+
+    config = _tiny_config()
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    batcher = ContinuousBatcher(
+        params, config,
+        _tiny_gen_config(prefix_cache_mb=0.02, prefix_block=8,
+                         prompt_buckets=[32], host_tier_mb=4.0),
+        decode_chunk=8)
+    tier = batcher._tier
+    checks: List[Dict[str, str]] = []
+
+    # Churn well past the tiny device budget (every eviction spills),
+    # then hint + resubmit the first head so a host-resident prefix
+    # prefetches back and splices.
+    rng = np.random.default_rng(0)
+    head = [int(t) for t in rng.integers(1, config.vocab_size, size=24)]
+    rid = batcher.submit(head, max_new_tokens=8)
+    batcher.run_until_idle()
+    batcher.result(rid)
+    for _ in range(8):
+        p = [int(t) for t in rng.integers(1, config.vocab_size,
+                                          size=24)]
+        r = batcher.submit(p, max_new_tokens=4)
+        batcher.run_until_idle()
+        batcher.result(r)
+    batcher.tier_flush()
+    batcher.prefetch_hint(head)
+    batcher.tier_flush()
+    rid = batcher.submit(head, max_new_tokens=8)
+    batcher.run_until_idle()
+    batcher.result(rid)
+    batcher.tier_flush()
+
+    stats = tier.stats()
+    exercised = stats['spills'] > 0 and stats['prefetches'] > 0
+    checks.append(_check(
+        'tier_exercised', 'ok' if exercised else 'fail',
+        f"{stats['spills']} spills, {stats['prefetches']} prefetches "
+        f"across the churn+hint run (both must be > 0 for the copy "
+        f"budgets below to mean anything)"))
+
+    gather_compiles = tier._gather._cache_size()
+    scatter_compiles = tier._scatter._cache_size()
+    checks.append(_check(
+        'copy_compile_budget',
+        'ok' if (gather_compiles <= 1 and scatter_compiles <= 1)
+        else 'fail',
+        f'{gather_compiles} gather / {scatter_compiles} scatter '
+        f'compiles (budget 1 each: the id vector is traced at fixed '
+        f'ids_per_node length, so block identity never re-keys)'))
+
+    decode_compiles = batcher._decode._cache_size()
+    checks.append(_check(
+        'decode_compile_budget',
+        'ok' if decode_compiles <= 2 else 'fail',
+        f'{decode_compiles} pooled decode compiles with the tier on '
+        f'(budget 2: spill/prefetch traffic must not re-key decode)'))
+
+    ids = jnp.zeros((tier.ids_per_node,), jnp.int32)
+    arena = batcher.pool.arena
+    staged = {k: jnp.zeros((a.shape[0], tier.ids_per_node)
+                           + a.shape[2:], a.dtype)
+              for k, a in arena.items()}
+    for label, jaxpr in (
+            ('gather', jax.make_jaxpr(tier._gather_impl)(arena, ids)),
+            ('scatter', jax.make_jaxpr(tier._scatter_impl)(
+                arena, ids, staged))):
+        for c in _jaxpr_dtype_and_callback_checks(jaxpr):
+            c['name'] = f"{label}_{c['name']}"
+            checks.append(c)
+
+    pool = batcher.pool
+    pool.check_invariant()
+    balanced = (pool.free_blocks() + pool.live_blocks()
+                == pool.n_blocks - 1)
+    checks.append(_check(
+        'pool_refcount_invariant', 'ok' if balanced else 'fail',
+        f'free {pool.free_blocks()} + live {pool.live_blocks()} == '
+        f'total {pool.n_blocks} - garbage after a host round-trip'))
+    batcher.close()
+    return {'entry': 'kv_tier', 'checks': checks,
+            'gather_compiles': gather_compiles,
+            'scatter_compiles': scatter_compiles,
+            'decode_compiles': decode_compiles,
+            'tier': stats}
+
+
 REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'generator_decode': audit_generator_decode,
     'batcher_decode': audit_batcher_decode,
@@ -1022,6 +1124,7 @@ REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'block_pool': audit_block_pool,
     'spec_decode': audit_spec_decode,
     'fused_step': audit_fused_step,
+    'kv_tier': audit_kv_tier,
     'mesh_decode': audit_mesh_decode,
     'trainer_step': audit_trainer_step,
     'ckpt_reshard': audit_ckpt_reshard,

@@ -119,6 +119,7 @@ DATA_PLANE_MODULES = (
     'infer/block_pool.py',
     'infer/spec_decode.py',
     'infer/fuse.py',
+    'infer/kv_tier.py',
 )
 
 # SKY202's sanctioned home: the bounded-backoff helper is ALLOWED to

@@ -140,6 +140,11 @@ class BlockPool:
         self._refs = np.zeros(n_blocks, np.int32)
         self._refs[GARBAGE_BLOCK] = 1  # pinned forever
         self._reserved = 0
+        # Blocks with an async tier copy in flight (host-tier prefetch
+        # destinations): allocated and referenced like any other block,
+        # tracked so check_invariant can assert the copy engine never
+        # works on freed ids.
+        self._inflight: set = set()
         self.hwm = 0
         self.table_appends = 0
         self.prefix_shares = 0
@@ -165,10 +170,11 @@ class BlockPool:
         """Assert the pool's conservation law: every non-garbage block
         is either free or referenced (free + live == n_blocks - 1),
         refcounts are non-negative, the free list holds no duplicates
-        and no referenced ids, and reservations never exceed the free
-        list.  Cheap host math — tests call this around operations that
-        must NOT move blocks (e.g. speculative-decode rollback, which
-        is pure cursor math)."""
+        and no referenced ids, reservations never exceed the free
+        list, and every in-flight block (an async tier copy's
+        destination) is still allocated.  Cheap host math — tests call
+        this around operations that must NOT move blocks (e.g.
+        speculative-decode rollback, which is pure cursor math)."""
         free = set(self._free)
         if len(free) != len(self._free):
             raise AssertionError('free list contains duplicate ids')
@@ -189,6 +195,16 @@ class BlockPool:
             raise AssertionError(
                 f'reservation {self._reserved} exceeds free list '
                 f'{len(self._free)}')
+        for b in self._inflight:
+            if b == GARBAGE_BLOCK:
+                raise AssertionError('garbage block marked in-flight')
+            if self._refs[b] <= 0:
+                raise AssertionError(
+                    f'in-flight block {b} is unreferenced — the copy '
+                    f'engine would read/write a freed block')
+            if b in free:
+                raise AssertionError(
+                    f'in-flight block {b} is on the free list')
 
     # -- reservations (admission backpressure) ---------------------------
 
@@ -239,6 +255,29 @@ class BlockPool:
         self._publish()
         return ids
 
+    def alloc_for_prefetch(self, k: int) -> Optional[List[int]]:
+        """Claim k blocks as host-tier prefetch destinations WITHOUT
+        touching admission reservations: draws only from available()
+        (free minus reserved), so a prefetch can never consume blocks
+        an admitted request was promised — it returns None instead
+        (the caller falls back to the cold-prefill path).  Returned
+        blocks are refcount 1 and marked in-flight until the copy
+        lands (``clear_inflight``)."""
+        if k < 1 or k > self.available():
+            return None
+        ids = self.alloc(k)
+        self._inflight.update(ids)
+        return ids
+
+    def mark_inflight(self, ids: Sequence[int]) -> None:
+        self._inflight.update(ids)
+
+    def clear_inflight(self, ids: Sequence[int]) -> None:
+        self._inflight.difference_update(ids)
+
+    def inflight_blocks(self) -> frozenset:
+        return frozenset(self._inflight)
+
     def share(self, ids: Sequence[int], *, prefix: bool = False) -> None:
         """Bump refcounts — a second owner (trie node or sequence) now
         references the same physical blocks.  This IS the warm-prefix
@@ -266,6 +305,11 @@ class BlockPool:
                     f'release of already-free block {b}')
             self._refs[b] -= 1
             if self._refs[b] == 0:
+                if b in self._inflight:
+                    raise AssertionError(
+                        f'last reference to in-flight block {b} '
+                        f'released — clear_inflight must precede the '
+                        f'final release')
                 self._free.append(b)
         self._publish()
 
@@ -289,6 +333,7 @@ class BlockPool:
             'blocks_live': self.live_blocks(),
             'blocks_free': len(self._free),
             'reserved': self._reserved,
+            'inflight': len(self._inflight),
             'hwm': self.hwm,
             'block_size': self.block_size,
             'table_appends': self.table_appends,

@@ -142,6 +142,16 @@ class GeneratorConfig:
     # dominate, and tiny models degrade to 1 (synchronous in-region
     # psums, the no-op pipeline).
     overlap_chunks: Optional[int] = None
+    # Host-DRAM KV tier (infer/kv_tier.py, ContinuousBatcher only):
+    # byte budget for a host block store behind the prefix cache.
+    # Evicted trie nodes SPILL their arena blocks to host instead of
+    # freeing-and-forgetting, and host-resident prefixes PREFETCH back
+    # into surplus pool blocks with the copy overlapped into admission
+    # — a working set far larger than pool_blocks keeps warm-hit TTFT.
+    # Requires the pooled data plane and prefix_cache_mb (the trie is
+    # what the tier sits behind).  None/0 = disabled: no host buffers
+    # are allocated and no copy thread is spawned.
+    host_tier_mb: Optional[float] = None
     # Chunked-prefill piggyback (ContinuousBatcher, pooled plane):
     # total token columns of a fused step's FIRST forward — each active
     # decode slot contributes its single-token column and the in-flight
@@ -171,6 +181,22 @@ class GeneratorConfig:
                     f'chunked-prefill lane; set prefill_chunk (the '
                     f'threshold above which prompts prefill '
                     f'incrementally) to enable it')
+        if self.host_tier_mb is not None and self.host_tier_mb < 0:
+            raise ValueError(f'host_tier_mb must be >= 0, got '
+                             f'{self.host_tier_mb}')
+        if self.host_tier_mb:
+            if self.decode_impl != 'pooled':
+                raise ValueError(
+                    f"host_tier_mb={self.host_tier_mb} requires the "
+                    f"pooled data plane (decode_impl='pooled'); the "
+                    f"legacy '{self.decode_impl}' plane has no block "
+                    f'arena to spill from')
+            if not self.prefix_cache_mb:
+                raise ValueError(
+                    f'host_tier_mb={self.host_tier_mb} spills evicted '
+                    f'prefix-cache blocks; set prefix_cache_mb (the '
+                    f'device-tier budget the host tier sits behind) '
+                    f'to enable it')
         if self.overlap_chunks is not None and self.overlap_chunks < 1:
             raise ValueError(f'overlap_chunks must be >= 1, got '
                              f'{self.overlap_chunks}')

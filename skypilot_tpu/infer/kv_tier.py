@@ -1,0 +1,588 @@
+"""Host-DRAM KV tier behind the radix prefix cache.
+
+Device HBM caps the prefix cache: at serving scale the working set of
+warm system prompts and paused sessions is orders of magnitude larger
+than ``pool_blocks``, and plain eviction discards device blocks
+permanently — a re-admitted session pays full cold prefill.  This
+module adds a byte-budgeted HOST block store laid out exactly like the
+pooled arena (one numpy buffer per cache component, rows are whole
+arena blocks, both KV layouts: bf16 and int8+scales) plus an async
+double-buffered copy engine modeled on ``ckpt/writer.py`` (bounded
+queue, one dedicated thread, errors re-raised at the drain point):
+
+- **Spill**: when the trie evicts a device-resident node
+  (``PrefixCache._drop``), the tier snapshots the node's arena blocks
+  with a jitted gather dispatched BEFORE the blocks return to the free
+  list — the gather output owns its bytes, so the pool's behavior is
+  byte-for-byte identical to the no-tier path (blocks free at the same
+  instant) while the copy thread stages the bytes into host rows off
+  the critical path.
+- **Prefetch**: admission (or a load-balancer routing hint) that finds
+  a host-resident continuation allocates surplus pool blocks
+  (``BlockPool.alloc_for_prefetch`` — never from admission
+  reservations, so a prefetch cannot deadlock an admitted request),
+  parks the request, and the copy thread assembles the staging buffer
+  while the engine keeps decoding.  The device scatter happens on the
+  scheduler thread at drain time; the re-admitted request then takes
+  the ordinary warm-hit splice, which is what keeps greedy output
+  bit-exact vs the no-tier path.
+
+Threading contract: ALL device dispatch (gather at spill submit,
+scatter at drain) happens on the scheduler thread; the copy thread
+only ever runs ``jax.device_get`` on already-gathered standalone
+arrays and numpy row copies.  Copy-engine traffic therefore rides its
+own channel and never touches the step's single counted
+``engine.host_fetch`` sync.  Like the rest of the scheduler state,
+``KVTier``'s public methods (other than what the engine thread runs
+internally) must be called from the scheduler thread.
+
+Compile budget: gather and scatter each move exactly ``ids_per_node``
+blocks (one trie node), so the traced id vector has a FIXED length and
+each helper compiles ONCE per KV layout — pinned by
+``analysis/audit.py``'s ``audit_kv_tier`` entry.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.telemetry import metrics as telemetry_metrics
+
+logger = sky_logging.init_logger(__name__)
+
+
+class AsyncCopyEngine:
+    """Single background daemon thread executing queued copy closures in
+    order — the ``ckpt/writer.py`` bounded double-buffering pattern.
+
+    Differences from the checkpoint writer, both forced by the
+    scheduler-thread contract above: ``try_submit`` never blocks (a
+    full queue REJECTS the job so eviction under admission pressure
+    cannot stall the tick), and errors are collected with their unwind
+    callback instead of raised from ``wait_until_finished`` — the
+    callback must run on the scheduler thread (it mutates pool/trie
+    state), so ``KVTier.drain`` pops and re-raises there."""
+
+    def __init__(self, max_pending: int = 2,
+                 name: str = 'kv-tier-copy'):
+        if max_pending < 1:
+            raise ValueError(f'max_pending must be >= 1, '
+                             f'got {max_pending}')
+        self.max_pending = max_pending
+        self._queue: 'queue.Queue[Optional[Tuple[Callable[[], None], '\
+            'Optional[Callable[[], None]]]]]' = queue.Queue(
+                maxsize=max_pending)
+        self._errors: List[Tuple[BaseException,
+                                 Optional[Callable[[], None]]]] = []
+        self._errors_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+        self._closed = False
+
+    # -- caller (scheduler thread) side ---------------------------------
+    def try_submit(self, job: Callable[[], None],
+                   on_error: Optional[Callable[[], None]] = None
+                   ) -> bool:
+        """Enqueue a copy closure; returns False (no side effects) when
+        the bounded queue is full or the engine is closed — the caller
+        falls back to the no-tier behavior instead of blocking the
+        scheduler tick behind an in-flight copy."""
+        if self._closed:
+            return False
+        self._ensure_thread()
+        try:
+            self._queue.put_nowait((job, on_error))
+        except queue.Full:
+            return False
+        return True
+
+    def wait_until_finished(self) -> None:
+        """Drain the queue (blocking join, no polling).  Errors are NOT
+        raised here — pop them via ``pop_errors`` so their unwind
+        callbacks run on the scheduler thread (``KVTier.drain`` does
+        both and re-raises)."""
+        self._queue.join()
+
+    def pop_errors(self) -> List[Tuple[BaseException,
+                                       Optional[Callable[[], None]]]]:
+        with self._errors_lock:
+            errors, self._errors = self._errors, []
+        return errors
+
+    @property
+    def in_flight(self) -> int:
+        return self._queue.unfinished_tasks
+
+    def close(self) -> None:
+        """Drain, then stop the thread.  Errors from queued jobs are
+        logged (already done at failure time) but not re-raised."""
+        self._closed = True
+        thread = self._thread
+        if thread is None:
+            return
+        self._queue.put(None)
+        thread.join(timeout=60)
+        self._thread = None
+
+    # -- engine thread side ---------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=self._name)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            job, on_error = item
+            try:
+                job()
+            except BaseException as e:  # noqa: B036 — must survive any job failure
+                logger.warning(f'KV tier copy job failed: {e!r}')
+                with self._errors_lock:
+                    self._errors.append((e, on_error))
+            finally:
+                self._queue.task_done()
+
+
+class _HostEntry:
+    """One host-resident trie-node equivalent: the full token prefix it
+    covers (the key — one entry per node, like the trie, but flat), its
+    host arena rows, and a state machine mirroring the copy engine:
+    'spilling' (device→host in flight; not yet servable), 'host'
+    (resident, prefetchable), 'fetching' (host→device in flight)."""
+
+    __slots__ = ('key', 'host_ids', 'state', 'last_used')
+
+    def __init__(self, key: Tuple[int, ...], host_ids: List[int]):
+        self.key = key
+        self.host_ids = host_ids
+        self.state = 'spilling'
+        self.last_used = 0
+
+
+class KVTier:
+    """Byte-budgeted host block store + async spill/prefetch engine.
+
+    The host arena mirrors the device arena's per-component layout with
+    the block axis leading: ``(HNB, L, BS, KV, hd)`` for k/v (dtype
+    matches the device cache — bf16 rows stay bf16, int8 rows stay int8
+    with their ``(HNB, L, BS, KV)`` f32 scales), so a spilled block's
+    bytes round-trip EXACTLY; the parity tests assert byte equality for
+    both layouts.  Entries are whole trie nodes (``ids_per_node``
+    blocks); over-budget inserts evict LRU 'host' entries (in-flight
+    states are never victims)."""
+
+    def __init__(self, pool, *, host_bytes: int,
+                 ids_per_node: int, tokens_per_node: int,
+                 max_pending: int = 2):
+        if ids_per_node < 1:
+            raise ValueError(f'ids_per_node must be >= 1, '
+                             f'got {ids_per_node}')
+        self.pool = pool
+        self.ids_per_node = ids_per_node
+        self.tokens_per_node = tokens_per_node
+        arena = pool.arena
+        # Per-block host bytes (all components, all layers) — same
+        # arithmetic as prefix_cache's _pool_block_nbytes.
+        self.block_nbytes = (sum(a.nbytes for a in arena.values())
+                             // pool.n_blocks)
+        self.node_nbytes = ids_per_node * self.block_nbytes
+        self.host_blocks = int(host_bytes // self.block_nbytes)
+        if self.host_blocks < ids_per_node:
+            raise ValueError(
+                f'host tier budget {host_bytes} bytes holds '
+                f'{self.host_blocks} host blocks but one trie node '
+                f'needs {ids_per_node} (block {self.block_nbytes} '
+                f'bytes); raise host_tier_mb to at least '
+                f'{ids_per_node * self.block_nbytes / 1024 / 1024:.1f}')
+        # Host arena: one buffer per cache component, block axis
+        # leading so a row assignment is one contiguous memcpy.  numpy
+        # host memory (page-pinning is a runtime property the JAX CPU
+        # path cannot request; the layout is what matters for the
+        # copy pattern).
+        self._host: Dict[str, np.ndarray] = {}
+        for comp, arr in arena.items():
+            row_shape = (arr.shape[0],) + tuple(arr.shape[2:])
+            self._host[comp] = np.zeros(
+                (self.host_blocks,) + row_shape, dtype=arr.dtype)
+        self._host_free: List[int] = list(
+            range(self.host_blocks - 1, -1, -1))
+        self._entries: Dict[Tuple[int, ...], _HostEntry] = {}
+        self._clock = 0
+        # The owning PrefixCache — set by the engine right after
+        # construction (circular by design: _drop spills through the
+        # tier, a failed prefetch detaches its loading nodes here).
+        self.prefix = None
+        self._engine = AsyncCopyEngine(max_pending=max_pending)
+        # Deterministic admission gate: outstanding = submitted jobs
+        # not yet drained.  Queue fullness would depend on how fast the
+        # copy thread runs; this count depends only on the scheduler's
+        # own submit/drain sequence, which is what keeps the fleet
+        # simulator's transfer-cost model replay-deterministic.
+        self._outstanding = 0
+        self._done: List[Tuple[str, Any]] = []
+        self._done_lock = threading.Lock()
+        self._closed = False
+        # Jitted copy helpers over the whole component dict: the id
+        # vector is traced with FIXED length ids_per_node, so each
+        # compiles once per KV layout.  Per-instance wrappers (not the
+        # module functions) so the auditor's _cache_size() probes count
+        # this tier alone — same reasoning as PrefixCache._install.
+        def _gather_fn(cache, ids):
+            return {k: a[:, ids] for k, a in cache.items()}
+
+        def _scatter_fn(cache, ids, staged):
+            return {k: a.at[:, ids].set(staged[k].astype(a.dtype))
+                    for k, a in cache.items()}
+
+        self._gather = jax.jit(_gather_fn)
+        self._scatter = jax.jit(_scatter_fn, donate_argnums=(0,))
+        # Unjitted impls kept for the auditor's make_jaxpr hygiene
+        # probes (callback-free / f64-free traced graphs).
+        self._gather_impl = _gather_fn
+        self._scatter_impl = _scatter_fn
+        # Instance mirrors of the skytpu_infer_tier_* REGISTRY families
+        # (the registry is process-global; tests/bench read per-tier
+        # deltas here, the simulator charges vclock from byte deltas).
+        self.spills = 0
+        self.spill_rejects = 0
+        self.spill_bytes = 0
+        self.spill_seconds = 0.0
+        self.prefetches = 0
+        self.prefetch_bytes = 0
+        self.prefetch_seconds = 0.0
+        self.host_evictions = 0
+        self.host_hits = 0
+        self.device_hits = 0
+        self.misses = 0
+        self.prefetch_late = 0
+        self._publish()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def host_resident_blocks(self) -> int:
+        return self.host_blocks - len(self._host_free)
+
+    def can_accept(self) -> bool:
+        """True when the bounded engine has a slot for one more copy —
+        checked BEFORE allocating blocks or mutating trie state so a
+        rejected job has nothing to unwind."""
+        return (not self._closed
+                and self._outstanding < self._engine.max_pending)
+
+    def in_flight(self) -> bool:
+        """True while any copy is submitted-but-not-drained.  A
+        deterministic counter (scheduler-thread bookkeeping), NOT a
+        peek at the live queue — replay must not depend on how far the
+        copy thread happens to have run."""
+        return self._outstanding > 0
+
+    def record_lookup(self, outcome: str) -> None:
+        """Admission's per-request tier consult: 'device_hit' (served
+        from the trie), 'host_hit' (parked on a prefetch), 'miss'."""
+        if outcome == 'device_hit':
+            self.device_hits += 1
+        elif outcome == 'host_hit':
+            self.host_hits += 1
+        else:
+            self.misses += 1
+        telemetry_metrics.INFER_TIER_LOOKUPS.labels(
+            outcome=outcome).inc()
+
+    # -- spill (device -> host) ------------------------------------------
+    def accept_spill(self, key: Tuple[int, ...],
+                     ids: Sequence[int]) -> bool:
+        """Called by ``PrefixCache._drop`` BEFORE it releases the
+        victim's arena blocks.  On True the gather over those blocks is
+        already dispatched (its output owns the bytes), so the caller
+        releases the ids exactly as in the no-tier path.  False = the
+        tier passes (engine full, budget unfillable, duplicate key) and
+        the bytes are freed-and-forgotten as before."""
+        ids = list(ids)
+        if (self._closed or len(ids) != self.ids_per_node
+                or not key or key in self._entries
+                or not self.can_accept()):
+            self.spill_rejects += 1
+            return False
+        host_ids = self._take_host_rows()
+        if host_ids is None:
+            self.spill_rejects += 1
+            return False
+        # Scheduler-thread dispatch: the gather is enqueued on the
+        # device stream before any later step can donate/overwrite the
+        # arena, and its result is a standalone array.
+        gathered = self._gather(self.pool.arena,
+                                jnp.asarray(ids, jnp.int32))
+        entry = _HostEntry(key, host_ids)
+        self._entries[key] = entry
+        self._touch(entry)
+        self._outstanding += 1
+        self.spills += 1
+        t0 = time.perf_counter()
+
+        def job():
+            # Tier copy channel: this device_get runs on the copy
+            # thread against the standalone gather output — it never
+            # joins the step's single counted host_fetch sync.
+            got = jax.device_get(gathered)  # skytpu-allow: SKY105
+            for comp, buf in got.items():
+                host = self._host[comp]
+                for i, hid in enumerate(host_ids):
+                    host[hid] = buf[:, i]
+            dt = time.perf_counter() - t0
+            with self._done_lock:
+                self._done.append(('spill', (entry, dt)))
+
+        def unwind():
+            # Failed spill: forget the entry, return its host rows.
+            self._entries.pop(key, None)
+            self._host_free.extend(host_ids)
+
+        if not self._engine.try_submit(job, on_error=unwind):
+            # can_accept() raced a close(); undo the bookkeeping.
+            unwind()
+            self._outstanding -= 1
+            self.spills -= 1
+            self.spill_rejects += 1
+            return False
+        return True
+
+    # -- prefetch (host -> device) ---------------------------------------
+    def host_continuation(self, tokens: Sequence[int],
+                          from_tokens: int) -> List[_HostEntry]:
+        """The chain of host-RESIDENT entries extending a device match
+        of ``from_tokens`` tokens, capped (like ``PrefixCache.match``)
+        so at least one suffix token remains to prefill.  'spilling'/
+        'fetching' entries end the chain — their bytes are not yet
+        servable / already being fetched."""
+        toks = tuple(int(t) for t in tokens)
+        span = self.tokens_per_node
+        max_tokens = max(0, (len(toks) - 1) // span * span)
+        out: List[_HostEntry] = []
+        depth = from_tokens
+        while depth + span <= max_tokens:
+            entry = self._entries.get(toks[:depth + span])
+            if entry is None or entry.state != 'host':
+                break
+            out.append(entry)
+            depth += span
+        return out
+
+    def start_prefetch(self, entries: Sequence[_HostEntry],
+                       dev_ids: Sequence[int],
+                       nodes: Sequence[Any]) -> None:
+        """Begin the host→device copy for a chain from
+        ``host_continuation``: ``dev_ids`` are freshly allocated pool
+        blocks (``alloc_for_prefetch``, already marked in-flight) and
+        ``nodes`` the matching 'loading' trie nodes
+        (``PrefixCache.insert_pending``).  The copy thread assembles
+        the staging buffers; the device scatter waits for ``drain``
+        on the scheduler thread."""
+        if not self.can_accept():
+            raise AssertionError(
+                'start_prefetch without can_accept() — callers must '
+                'gate on it before allocating blocks')
+        dev_ids = list(dev_ids)
+        if len(dev_ids) != len(entries) * self.ids_per_node or \
+                len(nodes) != len(entries):
+            raise AssertionError(
+                f'prefetch shape mismatch: {len(entries)} entries, '
+                f'{len(nodes)} nodes, {len(dev_ids)} device ids '
+                f'(ids_per_node={self.ids_per_node})')
+        for e in entries:
+            if e.state != 'host':
+                raise AssertionError(
+                    f'prefetch of entry in state {e.state!r}')
+            e.state = 'fetching'
+            self._touch(e)
+        entries = list(entries)
+        nodes = list(nodes)
+        self._outstanding += 1
+        self.prefetches += 1
+        t0 = time.perf_counter()
+
+        def job():
+            staged = []
+            for e in entries:
+                bufs = {
+                    comp: np.stack(
+                        [self._host[comp][hid] for hid in e.host_ids],
+                        axis=1)
+                    for comp in self._host}
+                staged.append(bufs)
+            dt = time.perf_counter() - t0
+            with self._done_lock:
+                self._done.append(
+                    ('prefetch', (entries, dev_ids, nodes, staged, dt)))
+
+        def unwind():
+            # Failed prefetch: the bytes never left host — entries stay
+            # resident ('host'), the loading nodes detach (deepest
+            # first; 'failed' tells parked requests to requeue through
+            # the cold path), and the destination blocks go straight
+            # back to the pool.
+            for e in entries:
+                e.state = 'host'
+            for n in reversed(nodes):
+                n.tier = 'failed'
+                if self.prefix is not None:
+                    self.prefix.drop_pending(n)
+            self.pool.clear_inflight(dev_ids)
+            self.pool.release(dev_ids)
+
+        if not self._engine.try_submit(job, on_error=unwind):
+            self._outstanding -= 1
+            self.prefetches -= 1
+            unwind()
+            raise AssertionError(
+                'copy engine rejected a prefetch after can_accept()')
+
+    # -- drain (scheduler thread) ----------------------------------------
+    def drain(self, cache):
+        """Apply every completed copy: finalize spills (entry becomes
+        prefetchable), scatter completed prefetches into the arena
+        (donated — the caller rebinds its cache AND ``pool.arena`` to
+        the return value) and flip their trie nodes to 'device'.
+        Copy-engine errors re-raise HERE, on the scheduler thread,
+        after their unwind callbacks ran — the writer.py contract."""
+        with self._done_lock:
+            done, self._done = self._done, []
+        for kind, payload in done:
+            self._outstanding -= 1
+            if kind == 'spill':
+                entry, dt = payload
+                entry.state = 'host'
+                self._touch(entry)
+                self.spill_bytes += self.node_nbytes
+                self.spill_seconds += dt
+                telemetry_metrics.INFER_TIER_SPILL_BYTES.inc(
+                    self.node_nbytes)
+                telemetry_metrics.INFER_TIER_SPILL_SECONDS.inc(dt)
+                continue
+            entries, dev_ids, nodes, staged, dt = payload
+            for i, (entry, node, bufs) in enumerate(
+                    zip(entries, nodes, staged)):
+                chunk = dev_ids[i * self.ids_per_node:
+                                (i + 1) * self.ids_per_node]
+                cache = self._scatter(
+                    cache, jnp.asarray(chunk, jnp.int32), bufs)
+                self.pool.arena = cache
+                node.tier = 'device'
+                entry.state = 'host'
+                self._touch(entry)
+            self.pool.clear_inflight(dev_ids)
+            self.prefetch_bytes += len(entries) * self.node_nbytes
+            self.prefetch_seconds += dt
+            telemetry_metrics.INFER_TIER_PREFETCH_BYTES.inc(
+                len(entries) * self.node_nbytes)
+            telemetry_metrics.INFER_TIER_PREFETCH_SECONDS.inc(dt)
+        errors = self._engine.pop_errors()
+        for _, unwind in errors:
+            self._outstanding -= 1
+            if unwind is not None:
+                unwind()
+        self._publish()
+        if errors:
+            raise errors[0][0]
+        return cache
+
+    def wait_pending(self) -> None:
+        """Block until every submitted copy executed (completions still
+        need a ``drain`` to apply) — the batcher's parked-admission
+        stall and the simulator's determinism barrier."""
+        self._engine.wait_until_finished()
+
+    def flush(self, cache):
+        """wait_pending + drain: the deterministic barrier the fleet
+        simulator (and tests) call between ticks."""
+        self.wait_pending()
+        return self.drain(cache)
+
+    def close(self) -> None:
+        self._closed = True
+        self._engine.close()
+
+    # -- internals --------------------------------------------------------
+    def _touch(self, entry: _HostEntry) -> None:
+        self._clock += 1
+        entry.last_used = self._clock
+
+    def _take_host_rows(self) -> Optional[List[int]]:
+        """``ids_per_node`` free host rows, LRU-evicting resident
+        ('host') entries to make room; None when the budget cannot
+        cover it (everything left is in flight)."""
+        while len(self._host_free) < self.ids_per_node:
+            victim = None
+            for e in self._entries.values():
+                if e.state != 'host':
+                    continue
+                if victim is None or e.last_used < victim.last_used:
+                    victim = e
+            if victim is None:
+                return None
+            del self._entries[victim.key]
+            self._host_free.extend(victim.host_ids)
+            self.host_evictions += 1
+        return [self._host_free.pop()
+                for _ in range(self.ids_per_node)]
+
+    def _publish(self) -> None:
+        telemetry_metrics.INFER_TIER_BLOCKS.labels(tier='host').set(
+            self.host_resident_blocks())
+        telemetry_metrics.INFER_TIER_BLOCKS.labels(tier='device').set(
+            self.pool.live_blocks())
+        telemetry_metrics.INFER_TIER_BLOCKS.labels(
+            tier='inflight').set(len(self.pool.inflight_blocks()))
+
+    def stats(self) -> Dict[str, Any]:
+        lookups = self.host_hits + self.device_hits + self.misses
+        return {
+            'host_blocks': self.host_blocks,
+            'host_resident': self.host_resident_blocks(),
+            'entries': len(self._entries),
+            'spills': self.spills,
+            'spill_rejects': self.spill_rejects,
+            'spill_bytes': self.spill_bytes,
+            'spill_seconds': self.spill_seconds,
+            'prefetches': self.prefetches,
+            'prefetch_bytes': self.prefetch_bytes,
+            'prefetch_seconds': self.prefetch_seconds,
+            'host_evictions': self.host_evictions,
+            'host_hits': self.host_hits,
+            'device_hits': self.device_hits,
+            'misses': self.misses,
+            'lookups': lookups,
+            'prefetch_late': self.prefetch_late,
+        }
+
+
+def make_kv_tier(gen_config, pool) -> Optional[KVTier]:
+    """Build the host tier from a GeneratorConfig, or None when
+    disabled (``host_tier_mb`` unset/0 — satellite contract: the
+    no-tier configuration allocates NO host buffers and spawns NO copy
+    thread).  Requires the pooled plane's BlockPool and the prefix
+    cache's block granularity (both validated by
+    ``GeneratorConfig.__post_init__``)."""
+    mb = getattr(gen_config, 'host_tier_mb', None)
+    if not mb or pool is None:
+        return None
+    ids_per_node = gen_config.prefix_block // pool.block_size
+    return KVTier(
+        pool,
+        host_bytes=int(float(mb) * 1024 * 1024),
+        ids_per_node=ids_per_node,
+        tokens_per_node=gen_config.prefix_block)

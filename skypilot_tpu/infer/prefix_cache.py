@@ -97,7 +97,7 @@ class _Node:
     whose refcounts the node holds (pooled mode)."""
 
     __slots__ = ('key', 'parent', 'children', 'data', 'nbytes', 'refs',
-                 'last_used')
+                 'last_used', 'tier')
 
     def __init__(self, key: Tuple[int, ...], parent: Optional['_Node'],
                  data=None, nbytes: Optional[int] = None):
@@ -112,6 +112,12 @@ class _Node:
                            if data else 0)
         self.refs = 0
         self.last_used = 0
+        # Tier state (host KV tier, infer/kv_tier.py): 'device' (the
+        # only state without a tier — blocks resident and matchable),
+        # 'loading' (a prefetch is filling this node's blocks; hidden
+        # from match and pinned from eviction until it lands), 'failed'
+        # (the prefetch errored; detached, parked requests requeue).
+        self.tier = 'device'
 
 
 @dataclasses.dataclass
@@ -161,6 +167,10 @@ class PrefixCache:
                 // pool.n_blocks)
         self._root = _Node((), None)
         self._clock = 0
+        # Host KV tier (kv_tier.KVTier) — set by the owning engine
+        # after construction.  None (the default) keeps every code
+        # path below byte-for-byte identical to the pre-tier cache.
+        self.tier = None
         # Instance mirrors of the REGISTRY counters (the registry is
         # process-global; tests and bench read per-cache deltas here).
         self.hits = 0
@@ -200,7 +210,10 @@ class PrefixCache:
         for b in range(max_blocks):
             child = node.children.get(
                 toks[b * self.block:(b + 1) * self.block])
-            if child is None:
+            if child is None or child.tier != 'device':
+                # A 'loading' child is a prefetch in flight: its blocks
+                # are not yet readable, so the match stops here — the
+                # batcher parks on it via pending_continuation instead.
                 break
             nodes.append(child)
             node = child
@@ -332,6 +345,97 @@ class PrefixCache:
             self._evict_to_budget()
         return created
 
+    # -- host-tier hooks (infer/kv_tier.py) --------------------------------
+
+    def _node_prefix(self, node: _Node) -> Tuple[int, ...]:
+        """The full token prefix a node covers, reconstructed from the
+        parent chain — the host store's entry key."""
+        parts: List[Tuple[int, ...]] = []
+        while node.parent is not None:
+            parts.append(node.key)
+            node = node.parent
+        return tuple(t for key in reversed(parts) for t in key)
+
+    def pending_continuation(self, tokens: Sequence[int],
+                             from_tokens: int) -> List[_Node]:
+        """The chain of 'loading' children extending a device match of
+        ``from_tokens`` tokens — an already in-flight prefetch (e.g.
+        from a load-balancer hint) the batcher can park this request on
+        instead of issuing a duplicate copy.  A 'failed' child also
+        ends the chain (it is about to be detached)."""
+        toks = tuple(int(t) for t in tokens)
+        max_blocks = max(0, (len(toks) - 1) // self.block)
+        node = self._root
+        out: List[_Node] = []
+        for b in range(max_blocks):
+            child = node.children.get(
+                toks[b * self.block:(b + 1) * self.block])
+            if child is None:
+                break
+            if child.tier == 'loading':
+                out.append(child)
+            elif child.tier != 'device' or out:
+                # Chains are contiguous: device nodes past the first
+                # loading node cannot exist (insert_pending only
+                # extends device chains).
+                break
+            node = child
+        return out
+
+    def insert_pending(self, tokens: Sequence[int], from_block: int,
+                       ids: Sequence[int]) -> List[_Node]:
+        """Tier prefetch: create 'loading' nodes for ``tokens``' blocks
+        starting at ``from_block`` (the end of the device match, whose
+        chain must exist), each owning its slice of the freshly
+        allocated prefetch ids (the nodes take the refcount-1
+        reference ``BlockPool.alloc_for_prefetch`` produced).  The
+        nodes are invisible to ``match`` and pinned from eviction until
+        the tier flips them to 'device' at drain."""
+        toks = tuple(int(t) for t in tokens)
+        node = self._root
+        for b in range(from_block):
+            child = node.children.get(
+                toks[b * self.block:(b + 1) * self.block])
+            if child is None or child.tier != 'device':
+                raise AssertionError(
+                    f'insert_pending: device chain broken at block {b}')
+            node = child
+        n_nodes = len(ids) // self._ids_per_node
+        created: List[_Node] = []
+        for i in range(n_nodes):
+            b = from_block + i
+            key = toks[b * self.block:(b + 1) * self.block]
+            if key in node.children:
+                raise AssertionError(
+                    f'insert_pending: block {b} already present')
+            chunk = list(ids[i * self._ids_per_node:
+                             (i + 1) * self._ids_per_node])
+            child = _Node(key, node, chunk,
+                          nbytes=(len(chunk)
+                                  * self._pool_block_nbytes))
+            child.tier = 'loading'
+            node.children[key] = child
+            self.bytes += child.nbytes
+            self.node_count += 1
+            self._touch(child)
+            created.append(child)
+            node = child
+        telemetry_metrics.INFER_PREFIX_BYTES.set(self.bytes)
+        return created
+
+    def drop_pending(self, node: _Node) -> None:
+        """Detach a 'loading'/'failed' node after a failed prefetch —
+        trie bookkeeping only; the tier (which allocated them) owns
+        releasing the node's block ids.  Children-first: callers unwind
+        a chain deepest node first."""
+        if node.children:
+            raise AssertionError('drop_pending of an interior node')
+        if node.parent.children.get(node.key) is node:
+            del node.parent.children[node.key]
+        self.bytes -= node.nbytes
+        self.node_count -= 1
+        telemetry_metrics.INFER_PREFIX_BYTES.set(self.bytes)
+
     # -- internals --------------------------------------------------------
 
     def _touch(self, node: _Node) -> None:
@@ -354,8 +458,11 @@ class PrefixCache:
             n = stack.pop()
             if n.children:
                 stack.extend(n.children.values())
-            elif n.refs == 0 and (victim is None
-                                  or n.last_used < victim.last_used):
+            elif n.refs == 0 and n.tier == 'device' and \
+                    (victim is None
+                     or n.last_used < victim.last_used):
+                # Non-'device' nodes are never victims: a 'loading'
+                # node's blocks belong to an in-flight prefetch.
                 victim = n
         return victim
 
@@ -365,6 +472,14 @@ class PrefixCache:
         self.node_count -= 1
         self.evictions += 1
         if self.pool is not None:
+            if self.tier is not None and victim.tier == 'device':
+                # Host-tier spill: the tier dispatches a gather over
+                # the victim's blocks BEFORE they free (the gather
+                # output owns the bytes), so the release below is
+                # unchanged either way — freeing-and-forgetting is now
+                # freeing-after-snapshot when the tier accepts.
+                self.tier.accept_spill(self._node_prefix(victim),
+                                       victim.data)
             # The node's reference on its arena blocks drops; ids whose
             # refcount hits 0 (no live sequence still reading them)
             # return to the free list — NEVER while a sequence holds

@@ -39,6 +39,7 @@ Usage (the serve replica drives this from its request handler):
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import itertools
@@ -52,6 +53,7 @@ import numpy as np
 from skypilot_tpu.infer import block_pool as block_pool_lib
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import fuse as fuse_lib
+from skypilot_tpu.infer import kv_tier as kv_tier_lib
 from skypilot_tpu.infer import llama_infer, prefix_cache, sampling
 from skypilot_tpu.infer import spec_decode as spec_decode_lib
 from skypilot_tpu.infer import tp as tp_lib
@@ -303,6 +305,24 @@ class ContinuousBatcher:
         # zero install/extract device copies.
         self._prefix = prefix_cache.make_prefix_cache(
             gen_config, pool=self.pool)
+        # Host-DRAM KV tier (gen_config.host_tier_mb, pooled + prefix
+        # cache only — __post_init__ enforces the pairing): evicted
+        # trie nodes spill their arena blocks to a host block store and
+        # host-resident prefixes prefetch back into surplus pool blocks
+        # with the copy overlapped into admission (requests PARK until
+        # the blocks land, then take the ordinary warm-hit splice — the
+        # bit-exactness argument).  None when disabled: no host buffers
+        # exist, no copy thread runs, and every admission path below is
+        # byte-for-byte the pre-tier code.
+        self._tier = None
+        self._tier_parked: List[Any] = []
+        self._tier_hints: 'collections.deque' = collections.deque(
+            maxlen=256)
+        if self.pooled and self._prefix is not None:
+            self._tier = kv_tier_lib.make_kv_tier(gen_config, self.pool)
+            if self._tier is not None:
+                self._tier.prefix = self._prefix
+                self._prefix.tier = self._tier
         # Speculative decoding (gen_config.spec_k > 0, pooled only —
         # __post_init__ enforces the pairing): a host-side n-gram
         # drafter proposes k tokens per slot, ONE verify forward scores
@@ -804,6 +824,15 @@ class ContinuousBatcher:
             self._queue.remove(req)
             del self._requests[rid]
             return out
+        for i, (parked, _nodes) in enumerate(self._tier_parked):
+            if parked is req:
+                # Parked on a tier prefetch: the request just leaves;
+                # the in-flight copy completes anyway and warms the
+                # trie (the 'loading' nodes flip to 'device' and serve
+                # the next prompt sharing the head).
+                del self._tier_parked[i]
+                del self._requests[rid]
+                return out
         if self._incremental is req:
             # Mirror _advance_prefill's abort contract: clear the lane,
             # free the slot (front of the list — it is the warmest),
@@ -859,11 +888,13 @@ class ContinuousBatcher:
 
     @property
     def num_queued(self) -> int:
-        # The in-flight chunked prefill counts as queued: it is not yet
-        # decoding, and every "is there work left" check (run_until_idle,
+        # The in-flight chunked prefill counts as queued, and so does a
+        # request PARKED on a host-tier prefetch: neither is decoding
+        # yet, and every "is there work left" check (run_until_idle,
         # the serve driver's busy test, the bench's pure-decode filter)
-        # must see it.
-        return len(self._queue) + (1 if self._incremental else 0)
+        # must see them.
+        return (len(self._queue) + (1 if self._incremental else 0)
+                + len(self._tier_parked))
 
     def _bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -903,6 +934,134 @@ class ContinuousBatcher:
         target = self._cache_bucket_for(rows)
         if target > self._cache_len:
             self._migrate(target)
+
+    # ---- host KV tier (infer/kv_tier.py) ---------------------------------
+    def prefetch_hint(self, prompt: Sequence[int]) -> bool:
+        """Best-effort routing hint: the load balancer (or the fleet
+        simulator's dispatch) calls this AHEAD of the proxied request
+        so a host-resident prefix's device copy overlaps the network
+        and queue time instead of stalling admission.  Thread-safe by
+        construction: the prompt is queued (bounded deque — overflow
+        drops the oldest hint, never blocks) and the scheduler thread
+        issues the actual prefetch at its next tick, since only it may
+        touch pool/trie state.  Returns True when the hint was queued;
+        always False with the tier disabled (no-tier parity)."""
+        if self._tier is None or not prompt:
+            return False
+        self._tier_hints.append([int(t) for t in prompt])
+        return True
+
+    def tier_flush(self) -> None:
+        """Deterministic tier barrier: wait for every in-flight copy,
+        then apply completions.  The fleet simulator calls this between
+        ticks so spill/prefetch byte counters advance as a pure
+        function of the scheduling decisions, independent of how fast
+        the copy thread happens to run."""
+        if self._tier is None:
+            return
+        # A drain can ISSUE new copies (hinted prefetches), so one
+        # wait+drain pass is not a barrier — loop until no copy is
+        # outstanding.  Terminates: hints are consumed by the first
+        # pass and a hint-free drain submits nothing new.
+        while True:
+            self._tier.wait_pending()
+            self._drain_tier()
+            if not self._tier.in_flight():
+                return
+
+    def close(self) -> None:
+        """Stop background resources (the tier's copy thread).
+        Idempotent; host-side state stays readable."""
+        if self._tier is not None:
+            self._tier.close()
+
+    def _drain_tier(self) -> None:
+        """Scheduler-thread tier tick: issue hinted prefetches, apply
+        completed copies (the scatter donates the arena — rebind), and
+        requeue parked requests whose blocks landed (front of the
+        queue: they re-match as ordinary device hits and splice)."""
+        while self._tier_hints:
+            try:
+                prompt = self._tier_hints.popleft()
+            except IndexError:
+                break
+            m = self._prefix.match(prompt)
+            try:
+                if not self._prefix.pending_continuation(
+                        prompt, m.tokens):
+                    self._issue_prefetch(prompt, m)
+            finally:
+                m.release()
+        self._cache = self._tier.drain(self._cache)
+        self.pool.arena = self._cache
+        if not self._tier_parked:
+            return
+        ready: List[_Request] = []
+        still = []
+        for req, nodes in self._tier_parked:
+            landed = all(n.tier == 'device' for n in nodes)
+            failed = any(n.tier == 'failed' for n in nodes)
+            if landed or failed:
+                # Landed → warm device hit on re-admission; failed →
+                # the cold-prefill fallback (the loading nodes are
+                # already detached).
+                ready.append(req)
+            else:
+                still.append((req, nodes))
+        if ready:
+            self._queue[:0] = ready
+            self._tier_parked = still
+
+    def _issue_prefetch(self, prompt: Sequence[int],
+                        match) -> Optional[List[Any]]:
+        """Start a host→device prefetch for the host-resident chain
+        extending ``match``; returns the created 'loading' trie nodes,
+        or None when there is nothing to fetch or no capacity (engine
+        busy / no surplus pool blocks) — the caller falls back to the
+        ordinary admission path."""
+        if not self._tier.can_accept():
+            return None
+        entries = self._tier.host_continuation(prompt, match.tokens)
+        if not entries:
+            return None
+        ids = self.pool.alloc_for_prefetch(
+            len(entries) * self._prefix._ids_per_node)
+        if ids is None:
+            return None
+        nodes = self._prefix.insert_pending(
+            prompt, match.tokens // self._prefix.block, ids)
+        self._tier.start_prefetch(entries, ids, nodes)
+        return nodes
+
+    def _tier_try_park(self, idx: int, head: _Request,
+                       match) -> bool:
+        """Admission's tier consult: when the prompt continues in the
+        host tier (or a hinted prefetch is already in flight), pop the
+        request from the queue and PARK it until the blocks land —
+        the copy overlaps other slots' decode instead of stalling the
+        tick.  False = no host continuation; the ordinary admission
+        routes (device hit / chunked / cold, with their backpressure)
+        proceed unchanged."""
+        nodes = self._prefix.pending_continuation(
+            head.prompt, match.tokens)
+        if not nodes:
+            nodes = self._issue_prefetch(head.prompt, match)
+        if not nodes:
+            return False
+        match.release()
+        req = self._queue.pop(idx)
+        self._tier_parked.append((req, list(nodes)))
+        self._tier.record_lookup('host_hit')
+        # The request reached admission before its blocks did — by
+        # definition this prefetch is LATE (a hint that lands early
+        # enough turns the lookup into a plain device hit instead).
+        self._tier.prefetch_late += 1
+        telemetry_metrics.INFER_TIER_PREFETCH_LATE.inc()
+        if self._spans_on():
+            now = self._span_clock()
+            self._span('admission.tier_park', now, now, req=req,
+                       blocks=len(nodes) * self._prefix._ids_per_node)
+        return True
 
     # ---- pooled block accounting ----------------------------------------
     def _pool_cap(self, req: _Request) -> int:
@@ -1015,6 +1174,12 @@ class ContinuousBatcher:
             head = self._queue[idx]
             match = (self._prefix.match(head.prompt)
                      if self._prefix is not None else None)
+            if self._tier is not None and \
+                    self._tier_try_park(idx, head, match):
+                # Parked on a host-tier prefetch (match released, the
+                # request left the queue) — idx now points at the next
+                # candidate.
+                continue
             suffix = len(head.prompt) - (match.tokens if match else 0)
             if chunk_w and suffix > chunk_w:
                 if self._incremental is not None:
@@ -1053,6 +1218,9 @@ class ContinuousBatcher:
                     ids: List[int] = []
                     if match is not None:
                         self._prefix.commit(match)
+                        if self._tier is not None:
+                            self._tier.record_lookup(
+                                'device_hit' if match.hit else 'miss')
                         if match.hit:
                             # Matched head = host-side table splice
                             # (refcount bump), zero device copies; the
@@ -1097,11 +1265,15 @@ class ContinuousBatcher:
                                    now, now, req=head)
                     idx += 1
                     continue
+                if self._tier is not None:
+                    self._tier.record_lookup('device_hit')
                 self._admit_prefix_hit(self._queue.pop(idx), match)
                 continue
             if match is not None:
                 self._prefix.commit(match)    # counted miss
                 match.release()
+                if self._tier is not None:
+                    self._tier.record_lookup('miss')
             if self.pooled and not self._pool_reserve(head, 0):
                 # Pool backpressure: leave the request queued at its
                 # scan position — finishing requests return blocks.
@@ -1747,8 +1919,25 @@ class ContinuousBatcher:
             self._finish_step_profile()
 
     def _step_inner(self) -> None:
+        if self._tier is not None:
+            # Apply completed tier copies (and issue hinted prefetches)
+            # BEFORE admission so blocks that landed since last tick
+            # serve this tick's requests as plain device hits.
+            with self._profiler.phase('tier_wait'):
+                self._drain_tier()
         with self._profiler.phase('admit'):
             self._admit()
+        if self._tier is not None and self._tier_parked and \
+                not self._active and self._incremental is None:
+            # The tick's only remaining work is in-flight prefetches:
+            # block on the copy engine (attributed to tier_wait — this
+            # IS the parked-admission stall) so run_until_idle makes
+            # progress instead of spinning.
+            with self._profiler.phase('tier_wait'):
+                self._tier.wait_pending()
+                self._drain_tier()
+            with self._profiler.phase('admit'):
+                self._admit()
         # Fuse gate: an in-flight chunked prefill AND a live decode
         # batch to piggyback on.  With no decode batch, a dedicated
         # window is strictly better (no padded decode rows to carry);
@@ -1872,7 +2061,8 @@ class ContinuousBatcher:
     def run_until_idle(self, max_ticks: int = 10_000) -> None:
         for _ in range(max_ticks):
             if not self._queue and not self._active and \
-                    self._incremental is None:
+                    self._incremental is None and \
+                    not self._tier_parked:
                 return
             self.step()
         raise RuntimeError('run_until_idle exceeded max_ticks')

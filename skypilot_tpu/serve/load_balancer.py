@@ -50,6 +50,11 @@ LB_CONTROLLER_SYNC_INTERVAL_SECONDS = 20.0
 # up: the original pick plus failover re-picks on connection errors or
 # backpressure diverts.
 LB_MAX_ROUTE_ATTEMPTS = 3
+# Replica endpoint the tier warm-up hint posts to (the replica maps it
+# to ContinuousBatcher.prefetch_hint).  Best-effort: a replica without
+# the route 404s and the hint is simply lost.
+LB_PREFETCH_HINT_PATH = '/v1/prefetch_hint'
+LB_PREFETCH_HINT_TIMEOUT_S = 1.0
 
 
 class SkyServeLoadBalancer:
@@ -155,6 +160,46 @@ class SkyServeLoadBalancer:
                 return url
             exclude.add(url)
 
+    def _prefetch_hint_targets(self, chosen: str,
+                               context: Dict[str, Any]) -> List[str]:
+        """Replicas worth warming for this request: always the chosen
+        one; under prefix_affinity additionally the ring's divert
+        target (`ConsistentHashRing.prefetch_target`) — the replica a
+        bounded-load divert of this key would land on, so a divert
+        still finds staged blocks instead of a cold prefill."""
+        targets = [chosen]
+        ring = getattr(self.policy, 'ring', None)
+        fingerprint = getattr(self.policy, 'fingerprint', None)
+        if ring is not None and fingerprint is not None:
+            fp = fingerprint(context.get('prompt'))
+            if fp is not None:
+                divert = ring.prefetch_target(fp)
+                if divert is not None and divert != chosen:
+                    targets.append(divert)
+        return targets
+
+    async def _send_prefetch_hint(self, url: str, body: bytes,
+                                  trace_id: Optional[str]) -> None:
+        """POST the request body to the replica's prefetch-hint route.
+        Purely advisory: every failure (no route, timeout, dead
+        replica) is swallowed — the proxied request itself never
+        depends on the hint landing."""
+        import aiohttp
+        headers = {'Content-Type': 'application/json'}
+        if trace_id is not None:
+            headers[trace_lib.TRACE_HEADER] = trace_id
+        try:
+            timeout = aiohttp.ClientTimeout(
+                total=LB_PREFETCH_HINT_TIMEOUT_S)
+            async with aiohttp.ClientSession(timeout=timeout) as sess:
+                async with sess.post(url + LB_PREFETCH_HINT_PATH,
+                                     data=body,
+                                     headers=headers) as resp:
+                    await resp.read()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'Prefetch hint to {url} failed '
+                         f'(best-effort): {e}')
+
     async def _handle(self, request):
         from aiohttp import web
         with self._ts_lock:
@@ -173,6 +218,15 @@ class SkyServeLoadBalancer:
             spans_lib.record('lb.select', sel_t0, time.time(),
                              trace_id=trace_id, replica=url,
                              policy=self.policy.name)
+        if url is not None and context is not None:
+            # Fire-and-forget tier warm-up: the chosen replica starts
+            # pulling a host-spilled prefix back toward the device
+            # while this request is still in flight to it, so the
+            # prefetch overlaps proxying + admission instead of
+            # parking the request at the replica.
+            for hint_url in self._prefetch_hint_targets(url, context):
+                asyncio.ensure_future(self._send_prefetch_hint(
+                    hint_url, body, trace_id))
         if url is None:
             # Cold start / stale set: resync before failing (a replica may
             # have become READY since the last interval sync).

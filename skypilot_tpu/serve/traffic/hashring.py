@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-from typing import Iterator, List, Sequence, Union
+from typing import Iterator, List, Optional, Sequence, Union
 
 DEFAULT_VNODES = 64
 
@@ -102,6 +102,17 @@ class ConsistentHashRing:
         idx = bisect.bisect_right(self._points, key_hash) % \
             len(self._points)
         return self._owners[idx]
+
+    def prefetch_target(self, key_hash: int) -> Optional[str]:
+        """The next distinct owner after the primary — where a
+        bounded-load divert would send `key_hash`.  Routing warms this
+        member's host KV tier (a best-effort prefetch hint) so a
+        divert still lands on staged blocks instead of a cold prefill.
+        None on an empty ring or when the primary is the only member.
+        """
+        walk = self.owners(key_hash)
+        next(walk, None)
+        return next(walk, None)
 
     def owners(self, key_hash: int) -> Iterator[str]:
         """Distinct members in ring order starting at the primary —

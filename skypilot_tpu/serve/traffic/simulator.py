@@ -167,6 +167,16 @@ class SimConfig:
     prefill_chunk: Optional[int] = None
     fuse_budget: Optional[int] = None
     fused_prefill_cost_per_token_s: Optional[float] = None
+    # Host KV tier (None = off, the batcher default): evicted prefix
+    # blocks spill to per-replica host DRAM and dispatch-time hints
+    # prefetch them back ahead of admission.  Copy traffic is charged
+    # to the replica's vclock at these link bandwidths (GB/s), so a
+    # sweep can price the tier's transfer cost before committing a
+    # real host link.  Requires prefix_cache_mb (the tier spills
+    # prefix-cache evictions).
+    host_tier_mb: Optional[float] = None
+    tier_spill_gbps: float = 8.0
+    tier_prefetch_gbps: float = 8.0
     # prefix_affinity bounded-load factor (ignored by other policies).
     load_factor: float = 1.25
     model_seed: int = 0
@@ -193,6 +203,19 @@ class SimConfig:
             raise ValueError(
                 'fuse_budget requires prefill_chunk (the piggyback '
                 'rides the incremental chunked-prefill lane)')
+        if self.host_tier_mb is not None and self.host_tier_mb < 0:
+            raise ValueError(
+                f'host_tier_mb must be >= 0 (0/None disables the '
+                f'tier), got {self.host_tier_mb}')
+        if self.host_tier_mb and self.prefix_cache_mb is None:
+            raise ValueError(
+                'host_tier_mb requires prefix_cache_mb: the tier '
+                'spills prefix-cache evictions, so without a prefix '
+                'cache there is nothing to spill')
+        for field in ('tier_spill_gbps', 'tier_prefetch_gbps'):
+            if getattr(self, field) <= 0:
+                raise ValueError(f'{field} must be positive, '
+                                 f'got {getattr(self, field)}')
 
 
 @dataclasses.dataclass
@@ -304,6 +327,16 @@ class _ReplicaSim:
         fp = getattr(batcher, '_fuse_policy', None)
         pre_fused = fp.stats.prefill_tokens if fp is not None else 0
         inc_before = batcher._incremental
+        # Host-tier determinism barrier: land every outstanding copy
+        # BEFORE the step so drain timing is a pure function of the
+        # schedule, not of how fast the copy thread ran.  Byte deltas
+        # across [here, post-step] are then charged at the configured
+        # link bandwidths — the tier's transfer-cost model.
+        tier = batcher._tier
+        if tier is not None:
+            pre_spill_b = tier.spill_bytes
+            pre_fetch_b = tier.prefetch_bytes
+            batcher.tier_flush()
         batcher.step()
         saved_delta = (pc.tokens_saved - pre_saved) if pc is not None else 0
         # Fused piggyback accounting: chunk tokens a fused step carried
@@ -347,6 +380,15 @@ class _ReplicaSim:
                         + prefill_tokens * self.cfg.prefill_cost_per_token_s
                         + decode_tokens * self.cfg.decode_cost_per_token_s
                         + fused_delta * fused_cost)
+        if tier is not None:
+            # Bytes that crossed the host link this step: flush-landed
+            # spills plus hinted/parked prefetches.  Counters advance
+            # only at drain, so every byte is charged exactly once.
+            self.vclock += (
+                (tier.spill_bytes - pre_spill_b)
+                / (self.cfg.tier_spill_gbps * 1e9)
+                + (tier.prefetch_bytes - pre_fetch_b)
+                / (self.cfg.tier_prefetch_gbps * 1e9))
         for rid in self.inflight:
             if len(batcher._requests[rid].out) > pre_out[rid]:
                 deliver(self, rid, self.vclock)
@@ -409,7 +451,8 @@ class FleetSimulator:
             prefix_cache_mb=self.cfg.prefix_cache_mb,
             prefix_block=self.cfg.prefix_block,
             prefill_chunk=self.cfg.prefill_chunk,
-            fuse_budget=self.cfg.fuse_budget)
+            fuse_budget=self.cfg.fuse_budget,
+            host_tier_mb=self.cfg.host_tier_mb)
         if self.cfg.policy == 'prefix_affinity':
             self.policy: lb_policies.LoadBalancingPolicy = \
                 lb_policies.PrefixAffinityPolicy(
@@ -592,6 +635,11 @@ class FleetSimulator:
         self._span_buf.record('lb.select', arrival.t, arrival.t,
                               trace_id=_session_trace_id(sid),
                               replica=url, policy=self.policy.name)
+        # The LB's fire-and-forget tier warm-up, in-process: the hint
+        # reaches the chosen replica ahead of the request, so a host-
+        # resident prefix is staged back before admission consults the
+        # trie (the prefetch-overlapped-into-admission path).
+        rep.batcher.prefetch_hint(arrival.prompt)
         rid = rep.submit(arrival.prompt, arrival.max_new_tokens, sid,
                          now=arrival.t)
         # The journal's budget is the batcher's post-clamp budget, so
@@ -977,6 +1025,23 @@ class FleetSimulator:
             'replicas': len(self._live()),
             'scale_events': self.scale_events,
         }
+        if self.cfg.host_tier_mb:
+            # Final barrier first: copies dispatched by the last steps
+            # land now, so the aggregate is a pure function of the
+            # schedule (same determinism contract as the cost model).
+            agg = {k: 0 for k in
+                   ('spills', 'spill_bytes', 'prefetches',
+                    'prefetch_bytes', 'host_hits', 'device_hits',
+                    'misses', 'prefetch_late', 'host_resident')}
+            for rep in self.replicas + self.retired:
+                tier = rep.batcher._tier
+                if tier is None:
+                    continue
+                rep.batcher.tier_flush()
+                stats = tier.stats()
+                for k in agg:
+                    agg[k] += stats[k]
+            out['tier'] = agg
         if self.chaos is not None:
             lat = self._failover_latencies
             out['chaos'] = {

@@ -295,6 +295,57 @@ INFER_POOL_PREFIX_SHARES = prometheus_client.Counter(
     'copy of one block)',
     registry=REGISTRY)
 
+# ---- infer host KV tier (infer/kv_tier.py) -----------------------------
+
+INFER_TIER_BLOCKS = prometheus_client.Gauge(
+    'skytpu_infer_tier_blocks',
+    'KV blocks per residency tier: device = arena blocks pinned by the '
+    'prefix cache, host = DRAM-resident spilled blocks, inflight = '
+    'blocks with a spill or prefetch copy outstanding',
+    ['tier'],
+    registry=REGISTRY)
+
+INFER_TIER_SPILL_BYTES = prometheus_client.Counter(
+    'skytpu_infer_tier_spill_bytes_total',
+    'KV bytes copied device -> host DRAM by the async spill engine '
+    '(evicted prefix-cache blocks that stay warm instead of being '
+    'freed-and-forgotten)',
+    registry=REGISTRY)
+
+INFER_TIER_SPILL_SECONDS = prometheus_client.Counter(
+    'skytpu_infer_tier_spill_seconds_total',
+    'Copy-thread seconds spent executing spill copies; '
+    'rate(bytes)/rate(seconds) is the achieved spill bandwidth',
+    registry=REGISTRY)
+
+INFER_TIER_PREFETCH_BYTES = prometheus_client.Counter(
+    'skytpu_infer_tier_prefetch_bytes_total',
+    'KV bytes staged host DRAM -> device by the prefetch engine '
+    '(host-resident prefixes pulled back into arena blocks ahead of '
+    'admission)',
+    registry=REGISTRY)
+
+INFER_TIER_PREFETCH_SECONDS = prometheus_client.Counter(
+    'skytpu_infer_tier_prefetch_seconds_total',
+    'Copy-thread seconds spent executing prefetch copies; '
+    'rate(bytes)/rate(seconds) is the achieved prefetch bandwidth',
+    registry=REGISTRY)
+
+INFER_TIER_LOOKUPS = prometheus_client.Counter(
+    'skytpu_infer_tier_lookups_total',
+    'Admission tier consults by outcome: device_hit (served from the '
+    'device-resident trie), host_hit (host-resident prefix — request '
+    'parks on a prefetch), miss (cold prefill)',
+    ['outcome'],
+    registry=REGISTRY)
+
+INFER_TIER_PREFETCH_LATE = prometheus_client.Counter(
+    'skytpu_infer_tier_prefetch_late_total',
+    'Requests that parked at admission because their prefetch had not '
+    'landed yet — a high rate means routing hints fire too late (or '
+    'not at all) relative to request arrival',
+    registry=REGISTRY)
+
 # ---- infer serving mesh (infer/tp.py, ops/decode_attention.py) ---------
 
 INFER_MESH_DEVICES = prometheus_client.Gauge(
@@ -519,8 +570,8 @@ INFER_STEP_PHASE_SECONDS = prometheus_client.Histogram(
     'skytpu_infer_step_phase_seconds',
     'Host time one batcher step() spent in each exclusive phase '
     '(admit / prefill / fused / spec_draft / spec_verify / decode / '
-    'host_fetch / upload); phases sum to ~step wall time, so the '
-    'per-phase rate() ratio is the step-time breakdown',
+    'host_fetch / upload / tier_wait); phases sum to ~step wall time, '
+    'so the per-phase rate() ratio is the step-time breakdown',
     ['phase'],
     buckets=(1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1, 5),
     registry=REGISTRY)

@@ -1,0 +1,340 @@
+"""Host-DRAM KV tier (infer/kv_tier.py): async spill of evicted prefix
+blocks with prefetch overlapped into admission.
+
+Tier-1 locks on the PR-15 tentpole:
+
+- spill -> host -> prefetch round-trips are BYTE-exact for both KV
+  layouts (f32/bf16 rows, int8 rows + f32 scale planes) and leave the
+  pool's conservation law intact;
+- the host store is LRU within its byte budget and never evicts an
+  entry whose copy is in flight;
+- the bounded copy engine rejects instead of blocking when full, and a
+  failed copy job unwinds on the scheduler thread and re-raises at
+  drain — the ckpt/writer.py error contract;
+- GREEDY PARITY: the tier on, off, and under eviction-forcing budgets
+  emits IDENTICAL tokens (a cache tier must never change what the
+  model says), and a hinted prefetch after churn restores warm hits;
+- satellite regression: host_tier_mb unset/0 constructs NO tier — no
+  host buffers, no copy thread, byte-for-byte the pre-tier batcher;
+- the fleet simulator's transfer-cost model is replay-deterministic.
+
+NOT slow-marked: tiny configs; this is the tier-1 lock on the tiered
+KV cache.
+"""
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import kv_tier as kv_tier_mod
+from skypilot_tpu.infer.block_pool import BlockPool
+from skypilot_tpu.infer.engine import GeneratorConfig
+from skypilot_tpu.infer.kv_tier import AsyncCopyEngine, KVTier
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.models import llama
+
+CFG = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=128,
+                        max_seq_len=64, dtype=jnp.float32, remat=False)
+
+# Two prompts sharing a 16-token head (= 2 prefix blocks of 8) with
+# distinct tails — same shapes as the prefix-cache suite so the tier
+# rides known-good trie behavior.
+HEAD = [((5 * i) % 120) + 1 for i in range(16)]
+PROMPTS = [HEAD + [121, 122], HEAD + [123]]
+
+
+@pytest.fixture(scope='module')
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _gen_config(**kw):
+    base = dict(max_seq_len=64, batch_size=2, temperature=0.0,
+                prompt_buckets=[32])
+    base.update(kw)
+    return GeneratorConfig(**base)
+
+
+# ---- copy engine --------------------------------------------------------
+
+
+def test_engine_bounded_queue_rejects_instead_of_blocking():
+    eng = AsyncCopyEngine(max_pending=1)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def blocker():
+        started.set()
+        gate.wait(10)
+
+    assert eng.try_submit(blocker)
+    started.wait(10)                       # worker busy, queue empty
+    assert eng.try_submit(lambda: None)    # fills the 1-slot queue
+    assert not eng.try_submit(lambda: None)  # full -> reject, no block
+    gate.set()
+    eng.wait_until_finished()
+    assert eng.pop_errors() == []
+    eng.close()
+    assert not eng.try_submit(lambda: None)  # closed -> reject
+
+
+def test_engine_collects_errors_with_unwind_and_survives():
+    eng = AsyncCopyEngine(max_pending=2)
+    unwound = []
+
+    def bad():
+        raise RuntimeError('copy failed')
+
+    assert eng.try_submit(bad, on_error=lambda: unwound.append('u'))
+    eng.wait_until_finished()
+    errors = eng.pop_errors()
+    assert len(errors) == 1
+    exc, unwind = errors[0]
+    assert isinstance(exc, RuntimeError)
+    assert unwound == []                   # NOT run on the copy thread
+    unwind()
+    assert unwound == ['u']
+    # The thread survived the failure: later jobs still execute.
+    ran = threading.Event()
+    assert eng.try_submit(ran.set)
+    eng.wait_until_finished()
+    assert ran.is_set() and eng.pop_errors() == []
+    eng.close()
+
+
+# ---- KVTier unit tests (real pool, no model) ----------------------------
+
+
+def _mk_tier(kv=None, host_nodes=4, n_blocks=8, block=4,
+             max_pending=2):
+    pool = BlockPool(CFG, n_blocks, block, kv_dtype=kv)
+    block_nbytes = (sum(a.nbytes for a in pool.arena.values())
+                    // pool.n_blocks)
+    tier = KVTier(pool, host_bytes=host_nodes * block_nbytes,
+                  ids_per_node=1, tokens_per_node=block,
+                  max_pending=max_pending)
+    return pool, tier
+
+
+def _fill_block(pool, bid, seed):
+    """Write a random row into arena block `bid`; returns the numpy
+    rows per component (the expected bytes after a round-trip)."""
+    rng = np.random.default_rng(seed)
+    expect, arena = {}, {}
+    for comp, arr in pool.arena.items():
+        shape = (arr.shape[0],) + tuple(arr.shape[2:])
+        if np.issubdtype(arr.dtype, np.integer):
+            row = rng.integers(-120, 120, size=shape).astype(arr.dtype)
+        else:
+            row = rng.normal(size=shape).astype(arr.dtype)
+        expect[comp] = row
+        arena[comp] = arr.at[:, bid].set(jnp.asarray(row))
+    pool.arena = arena
+    return expect
+
+
+def _spill(pool, tier, key, seed):
+    """alloc + fill + spill + release one block under `key`."""
+    src = pool.alloc(1)
+    expect = _fill_block(pool, src[0], seed)
+    assert tier.accept_spill(key, src)
+    pool.release(src)          # exactly what PrefixCache._drop does
+    return expect
+
+
+@pytest.mark.parametrize('kv', [None, 'int8'])
+def test_spill_prefetch_roundtrip_byte_exact(kv):
+    pool, tier = _mk_tier(kv)
+    key = (1, 2, 3, 4)
+    expect = _spill(pool, tier, key, seed=7)
+    pool.arena = tier.flush(pool.arena)
+    entry = tier._entries[key]
+    assert entry.state == 'host'
+    for comp, row in expect.items():
+        np.testing.assert_array_equal(
+            tier._host[comp][entry.host_ids[0]], row)
+    assert tier.spill_bytes == tier.node_nbytes
+
+    # Prefetch back into a FRESH pool block: bytes land identical.
+    chain = tier.host_continuation([1, 2, 3, 4, 9], 0)
+    assert chain == [entry]
+    dev = pool.alloc_for_prefetch(1)
+    assert dev is not None and dev[0] in pool.inflight_blocks()
+    node = types.SimpleNamespace(tier='loading')
+    tier.start_prefetch(chain, dev, [node])
+    pool.arena = tier.flush(pool.arena)
+    assert node.tier == 'device'
+    assert not pool.inflight_blocks()
+    for comp, row in expect.items():
+        np.testing.assert_array_equal(
+            np.asarray(pool.arena[comp][:, dev[0]]), row)
+    assert tier.prefetch_bytes == tier.node_nbytes
+    pool.release(dev)
+    pool.check_invariant()
+    tier.close()
+
+
+def test_host_lru_eviction_and_inflight_never_victim():
+    pool, tier = _mk_tier(host_nodes=2)
+    _spill(pool, tier, (1,), seed=1)       # A (oldest)
+    pool.arena = tier.flush(pool.arena)
+    _spill(pool, tier, (2,), seed=2)       # B
+    pool.arena = tier.flush(pool.arena)
+    _spill(pool, tier, (3,), seed=3)       # C -> evicts LRU = A
+    pool.arena = tier.flush(pool.arena)
+    assert tier.host_evictions == 1
+    assert set(tier._entries) == {(2,), (3,)}
+
+    # A 1-node budget whose only entry is mid-spill: the in-flight
+    # entry is NOT evictable, so the second spill is REJECTED (and
+    # nothing is left half-unwound) rather than corrupting the copy.
+    pool2, tier2 = _mk_tier(host_nodes=1)
+    src = pool2.alloc(1)
+    _fill_block(pool2, src[0], seed=4)
+    assert tier2.accept_spill((1,), src)   # state 'spilling', undrained
+    pool2.release(src)
+    rejects = tier2.spill_rejects
+    src2 = pool2.alloc(1)
+    assert not tier2.accept_spill((2,), src2)
+    assert tier2.spill_rejects == rejects + 1
+    pool2.release(src2)
+    pool2.arena = tier2.flush(pool2.arena)
+    assert set(tier2._entries) == {(1,)}
+    pool2.check_invariant()
+    tier.close()
+    tier2.close()
+
+
+def test_spill_error_unwinds_and_reraises_on_drain(monkeypatch):
+    pool, tier = _mk_tier()
+
+    def boom(_):
+        raise RuntimeError('host copy died')
+
+    monkeypatch.setattr(kv_tier_mod.jax, 'device_get', boom)
+    _spill(pool, tier, (1, 2), seed=5)
+    tier.wait_pending()
+    with pytest.raises(RuntimeError, match='host copy died'):
+        pool.arena = tier.drain(pool.arena)
+    # The unwind ran on this thread: entry forgotten, host rows free,
+    # no copy outstanding, pool conservation intact.
+    assert (1, 2) not in tier._entries
+    assert tier.host_resident_blocks() == 0
+    assert not tier.in_flight()
+    pool.check_invariant()
+    tier.close()
+
+
+# ---- batcher-level: parity, prefetch, no-tier regression ----------------
+
+
+def _run_batch(b, prompts, max_new=8):
+    rids = [b.submit(p, max_new_tokens=max_new) for p in prompts]
+    b.run_until_idle()
+    return [b.result(r) for r in rids]
+
+
+def test_no_tier_is_exactly_the_old_batcher(params):
+    """Satellite regression: host_tier_mb unset/0 builds NO tier — no
+    host buffers, no copy thread — and hints are inert no-ops."""
+    for kw in ({}, {'host_tier_mb': 0},
+               {'host_tier_mb': None, 'prefix_cache_mb': 4,
+                'prefix_block': 8}):
+        b = ContinuousBatcher(params, CFG, _gen_config(**kw))
+        assert b._tier is None
+        assert not b.prefetch_hint(PROMPTS[0])
+        b.tier_flush()                     # no-op, must not raise
+        b.close()
+    assert not any(t.name == 'kv-tier-copy'
+                   for t in threading.enumerate())
+
+
+def test_gen_config_validation():
+    with pytest.raises(ValueError, match='prefix_cache_mb'):
+        _gen_config(host_tier_mb=4.0)
+    with pytest.raises(ValueError, match='pooled'):
+        _gen_config(host_tier_mb=4.0, prefix_cache_mb=4,
+                    prefix_block=8, decode_impl='inplace')
+    with pytest.raises(ValueError, match='host_tier_mb'):
+        _gen_config(host_tier_mb=-1.0)
+
+
+@pytest.mark.parametrize('kv,budget', [(None, 0.006), ('int8', 0.002)])
+def test_batcher_tier_parity_under_eviction(params, kv, budget):
+    """An eviction-forcing device budget with the tier on: every evict
+    spills and revisits prefetch, and the greedy tokens NEVER change vs
+    a no-cache reference."""
+    ref = _run_batch(
+        ContinuousBatcher(params, CFG, _gen_config(kv_cache_dtype=kv)),
+        PROMPTS)
+    b = ContinuousBatcher(params, CFG, _gen_config(
+        kv_cache_dtype=kv, prefix_cache_mb=budget, prefix_block=8,
+        host_tier_mb=2.0))
+    for _ in range(3):
+        assert _run_batch(b, PROMPTS) == ref, kv
+        b.tier_flush()
+    assert b._prefix.evictions > 0
+    assert b._tier.spills > 0
+    b.pool.check_invariant()
+    b.close()
+
+
+def test_hinted_prefetch_restores_warm_hits_after_churn(params):
+    """Populate -> churn past the device budget -> hint -> resubmit:
+    the revisit is served from the host tier (host or device hit, not
+    a miss), output identical to the first pass."""
+    b = ContinuousBatcher(params, CFG, _gen_config(
+        prefix_cache_mb=0.006, prefix_block=8, host_tier_mb=2.0))
+    first = _run_batch(b, [PROMPTS[0]])
+    b.tier_flush()
+    # Churn: disjoint prompts large enough to evict the head's blocks.
+    filler = [[((7 * i + j) % 110) + 1 for j in range(12)]
+              for i in range(4)]
+    _run_batch(b, filler)
+    b.tier_flush()
+    assert b._tier.spills > 0
+    pre_missed = b._tier.misses
+    assert b.prefetch_hint(PROMPTS[0])
+    b.tier_flush()                         # hint lands before submit
+    again = _run_batch(b, [PROMPTS[0]])
+    b.tier_flush()
+    assert again == first
+    assert b._tier.prefetches > 0
+    assert b._tier.host_hits + b._tier.device_hits > 0
+    assert b._tier.misses == pre_missed    # the revisit did NOT miss
+    stats = b._tier.stats()
+    assert stats['prefetch_bytes'] > 0
+    b.pool.check_invariant()
+    b.close()
+
+
+# ---- fleet simulator: deterministic transfer-cost model -----------------
+
+
+def _sim_summary(host_tier_mb):
+    from skypilot_tpu.serve.traffic import generator as gen
+    from skypilot_tpu.serve.traffic.simulator import (FleetSimulator,
+                                                      SimConfig)
+    sim = FleetSimulator(
+        SimConfig(policy='least_load', num_replicas=1, batch_size=2,
+                  decode_chunk=4, prefix_cache_mb=0.25, prefix_block=64,
+                  host_tier_mb=host_tier_mb, tier_spill_gbps=2.0,
+                  tier_prefetch_gbps=2.0),
+        gen.TrafficConfig(seed=11, duration_s=4.0, base_rps=4.0,
+                          num_sessions=3, num_heads=3, head_tokens=128,
+                          max_prompt_tokens=192, session_share=0.8))
+    return sim.run()
+
+
+def test_simulator_tier_cost_model_is_deterministic():
+    a = _sim_summary(host_tier_mb=4.0)
+    b = _sim_summary(host_tier_mb=4.0)
+    assert a == b                          # replayable, copy thread moot
+    assert a['tier']['spills'] > 0
+    assert a['tier']['spill_bytes'] > 0
+    off = _sim_summary(host_tier_mb=None)
+    assert 'tier' not in off
