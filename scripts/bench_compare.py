@@ -56,6 +56,17 @@ FIELDS: Tuple[Tuple[str, bool], ...] = (
     ('tier.spill_gbps', True),
     ('tier.prefetch_gbps', True),
     ('tier.prefetch_late_rate', False),
+    # SLO burn on the affinity serve arm: the error budget must not
+    # start draining faster.
+    ('serve.slo_burn_fast', False),
+    ('serve.slo_burn_slow', False),
+    # Cost attribution (two-tenant serve arm): the unattributed fleet
+    # overhead share must not grow, and the heavy tenant's device-time
+    # share must not drift away from its token (traffic) share.
+    # Compared only when BOTH artifacts carry an acct block over the
+    # same tenant set (_acct_comparable).
+    ('acct.fleet_overhead_share', False),
+    ('acct.heavy_share_gap_pct', False),
 )
 
 
@@ -101,6 +112,22 @@ def _tier_comparable(old: Dict[str, Any], new: Dict[str, Any]
     return None
 
 
+def _acct_comparable(old: Dict[str, Any], new: Dict[str, Any]
+                     ) -> Optional[str]:
+    """None when acct fields may be compared, else the skip reason."""
+    a, b = old.get('acct'), new.get('acct')
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return 'acct block missing on one side'
+    if 'error' in a or 'error' in b:
+        return 'acct arm errored on one side'
+    if a.get('tenants') != b.get('tenants'):
+        # A different tenant mix is a different experiment, not a
+        # regression in the attribution itself.
+        return (f'tenant set changed ({a.get("tenants")} -> '
+                f'{b.get("tenants")})')
+    return None
+
+
 _HEADLINE_RE = re.compile(r'^BENCH_HEADLINE (\{.*\})\s*$', re.M)
 
 
@@ -138,12 +165,16 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     regressions: List[str] = []
     mesh_skip = _mesh_comparable(old, new)
     tier_skip = _tier_comparable(old, new)
+    acct_skip = _acct_comparable(old, new)
     for dotted, higher_better in FIELDS:
         if dotted.startswith('mesh.') and mesh_skip is not None:
             lines.append(f'  {dotted}: skipped ({mesh_skip})')
             continue
         if dotted.startswith('tier.') and tier_skip is not None:
             lines.append(f'  {dotted}: skipped ({tier_skip})')
+            continue
+        if dotted.startswith('acct.') and acct_skip is not None:
+            lines.append(f'  {dotted}: skipped ({acct_skip})')
             continue
         a, b = _lookup(old, dotted), _lookup(new, dotted)
         if a is None or b is None or a == 0:

@@ -18,6 +18,10 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 python -m skypilot_tpu.analysis --json "$@"
 
+# Fleet-doctor rule table: self-validate thresholds/severities so a bad
+# rule edit fails CI here rather than silently never firing in prod.
+python -m skypilot_tpu.telemetry.doctor --list-rules --validate
+
 # Optional bench-regression gate: when the driver has left at least two
 # bench artifacts, diff the newest pair of headlines — >5% drops on
 # throughput (or rises on latency) fail the lint step.

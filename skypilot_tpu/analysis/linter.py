@@ -106,6 +106,14 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
          'must live in the shared-registry module so the metrics<->docs '
          'parity test sees every skytpu_* name and a re-import cannot '
          'collide on duplicate registration'),
+    Rule('SKY402', 'wall-clock-in-data-plane',
+         'direct time.time()/time.monotonic() call in a serving '
+         'data-plane module (serve/, telemetry/, infer/serving.py) — '
+         'these classes take injectable clocks (span_clock/'
+         'profiler_clock/clock=/now=); a direct wall-clock read '
+         'bypasses the injected clock and breaks virtual-time '
+         'determinism (simulator summaries, postmortem bundles, '
+         'frozen-clock tests)'),
 ]}
 
 # Modules whose device->host transfers must route through
@@ -148,6 +156,17 @@ _METRIC_FAMILY_NAMES = ('Counter', 'Gauge', 'Histogram', 'Summary',
 # paths: a swallowed error there turns a recoverable failure into a
 # silent hang.
 RECOVERY_PATH_PREFIXES = ('jobs/', 'serve/')
+
+# SKY402's scope: the serving data plane, where every timing consumer
+# takes an injectable clock (ContinuousBatcher span_clock/
+# profiler_clock, SkyServeLoadBalancer clock=, SLOMonitor now=,
+# SpanBuffer clock=) precisely so the virtual-time simulator can drive
+# it deterministically.  `time.sleep` and `time.perf_counter` are out
+# of scope: sleeping is SKY201/202's beat, and perf_counter deltas
+# never leak into recorded timestamps.
+WALL_CLOCK_PLANE_PREFIXES = ('serve/', 'telemetry/')
+WALL_CLOCK_PLANE_MODULES = ('infer/serving.py',)
+_WALL_CLOCK_CALLS = ('time.time', 'time.monotonic')
 
 _JIT_WRAPPERS = {'jax.jit', 'jit', 'pjit', 'jax.pmap', 'pmap'}
 _PARTIAL = {'functools.partial', 'partial'}
@@ -442,6 +461,10 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
         parts = path.split('/')[:-1]
         self.is_recovery = any(
             f'{p}/' in RECOVERY_PATH_PREFIXES for p in parts)
+        self.is_wall_clock_plane = (
+            path.endswith(WALL_CLOCK_PLANE_MODULES)
+            or any(f'{p}/' in WALL_CLOCK_PLANE_PREFIXES
+                   for p in parts))
         self._async_depth = 0
         self._loop_depth = 0
         self._in_host_fetch = False
@@ -613,6 +636,14 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
         self._check_f64_call(node, fn)
         if not self.metrics_allowed:
             self._check_metric_family(node, fn)
+        if self.is_wall_clock_plane and fn in _WALL_CLOCK_CALLS:
+            self.rep.report(
+                node, 'SKY402',
+                f'{fn}() reads the wall clock directly in the serving '
+                'data plane — read the class\'s injectable clock '
+                '(span_clock/clock=/now=) so virtual-time runs stay '
+                'deterministic, or mark a sanctioned wall-clock site  '
+                '# skytpu-allow: SKY402')
         if self.is_data_plane and not self._in_host_fetch:
             self._check_host_fetch_bypass(node, fn)
         if self._async_depth > 0:

@@ -59,6 +59,7 @@ from skypilot_tpu.infer import spec_decode as spec_decode_lib
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
 from skypilot_tpu.models import llama
+from skypilot_tpu.telemetry import accounting
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
 from skypilot_tpu.telemetry import spans as spans_lib
 from skypilot_tpu.telemetry import steplog
@@ -90,6 +91,10 @@ class _Request:
     # replica vclock under the fleet simulator) for the queue_wait span.
     trace_id: Optional[str] = None
     submitted_span_at: float = 0.0
+    # Cost attribution: the tenant tag the LB parsed from the request
+    # body (propagated alongside the trace id); 'default' when the
+    # client never said.
+    tenant: str = 'default'
 
 
 class ContinuousBatcher:
@@ -100,7 +105,9 @@ class ContinuousBatcher:
                  decode_chunk: int = 8, mesh=None,
                  max_queue: Optional[int] = None,
                  span_buffer: Optional[spans_lib.SpanBuffer] = None,
-                 span_clock=None):
+                 span_clock=None,
+                 ledger: Optional['accounting.CostLedger'] = None,
+                 profiler_clock=None):
         """mesh: optional ('tp','tpq') — or ('dp','tp','tpq') — mesh
         from tp_lib.make_tp_mesh (infer/tp.py) — params and the slot
         cache/pooled arena are megatron-sharded so serving capacity
@@ -118,7 +125,19 @@ class ContinuousBatcher:
         None (default) records into the module-wide wall-clock buffer
         gated by spans.enabled(); the fleet simulator injects a
         per-replica buffer whose clock reads the replica vclock, which
-        is what makes exported serve traces byte-deterministic."""
+        is what makes exported serve traces byte-deterministic.
+
+        ledger: optional telemetry/accounting.py CostLedger — each
+        step's exclusive phase seconds are apportioned across the
+        slots active in that phase (per-request phases to their
+        owners), building the per-tenant device-seconds / tokens /
+        block-seconds bill.  None (default) records nothing.
+
+        profiler_clock: clock for the StepProfiler's phase boundaries.
+        None (default) keeps the host timer (time.perf_counter); the
+        fleet simulator injects an event-tick counter so phase
+        attribution — and hence the cost ledger — is a pure function
+        of the schedule (byte-deterministic per seed)."""
         self.mesh = mesh
         if mesh is not None:
             tp_lib.validate_mesh(config, mesh)
@@ -360,9 +379,16 @@ class ContinuousBatcher:
                 static_argnames=('n', 'all_greedy', 'nucleus'))
         # Step-phase attribution (always on — a handful of host-timer
         # reads per tick) and lifecycle spans (gated: _spans_on()).
-        self._profiler = spans_lib.StepProfiler()
+        self._profiler = (spans_lib.StepProfiler(profiler_clock)
+                          if profiler_clock is not None
+                          else spans_lib.StepProfiler())
         self._span_buf = span_buffer
         self._span_clock = span_clock or time.time
+        # Per-tenant cost attribution (telemetry/accounting.py); the
+        # retry counter is a doctor signal (admission backpressure).
+        self._ledger = ledger
+        self.backpressure_retries = 0
+        self._ledger_tier_prev = (0.0, 0.0)
         # Estimated collective share of sharded dispatch phases (set by
         # set_collective_share from a bench_mesh measurement; None =
         # unknown, no 'collective' phase attribution).
@@ -421,6 +447,21 @@ class ContinuousBatcher:
         if not phases:
             return
         wall = profiler.last_wall
+        if self._ledger is not None:
+            if self.pooled and self._active:
+                self._ledger.note_blocks(
+                    [(r.rid, r.tenant, len(self._slot_blocks[s]))
+                     for s, r in self._active.items()])
+            if self._tier is not None:
+                stats = self._tier.stats()
+                spill = float(stats.get('spill_bytes', 0.0))
+                pref = float(stats.get('prefetch_bytes', 0.0))
+                p_spill, p_pref = self._ledger_tier_prev
+                self._ledger.add_tier_bytes(
+                    spill=max(spill - p_spill, 0.0),
+                    prefetch=max(pref - p_pref, 0.0))
+                self._ledger_tier_prev = (spill, pref)
+            self._ledger.end_step(phases, wall)
         for name, seconds in phases.items():
             telemetry_metrics.INFER_STEP_PHASE_SECONDS.labels(
                 phase=name).observe(seconds)
@@ -724,10 +765,15 @@ class ContinuousBatcher:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: int = 64,
                temperature: Optional[float] = None,
-               top_p: Optional[float] = None) -> int:
+               top_p: Optional[float] = None,
+               tenant: Optional[str] = None) -> int:
         """temperature/top_p: per-request sampling (None = the server
         defaults in GeneratorConfig) — the OpenAI API's per-request
-        fields, honored per SLOT inside the lockstep decode."""
+        fields, honored per SLOT inside the lockstep decode.
+
+        tenant: cost-attribution tag (the LB parses it from the
+        request body next to the routing fingerprint); None/'' bills
+        the 'default' tenant."""
         if not prompt:
             raise ValueError('Empty prompt')
         if temperature is not None and temperature < 0.0:
@@ -766,7 +812,8 @@ class ContinuousBatcher:
                            self.gen.max_seq_len - len(prompt)),
                        temperature=temperature, top_p=top_p,
                        submitted_at=time.perf_counter(),
-                       trace_id=trace_lib.get_trace_id())
+                       trace_id=trace_lib.get_trace_id(),
+                       tenant=tenant or 'default')
         if self._spans_on():
             req.submitted_span_at = self._span_clock()
         if self.pooled and self._pool_cap(req) > self.pool.n_blocks - 1:
@@ -1199,6 +1246,7 @@ class ContinuousBatcher:
                     # still fit.
                     if match is not None:
                         match.release()
+                    self.backpressure_retries += 1
                     if self._spans_on():
                         now = self._span_clock()
                         self._span('admission.backpressure_retry',
@@ -1254,11 +1302,15 @@ class ContinuousBatcher:
                     now = self._span_clock()
                     self._span('admit', now, now, req=request,
                                mode='chunked')
+                if self._ledger is not None:
+                    self._ledger.charge_request('admit', request.rid,
+                                                request.tenant)
                 continue
             if match is not None and match.hit:
                 if self.pooled and not self._pool_reserve(
                         head, match.tokens // self.block_size):
                     match.release()
+                    self.backpressure_retries += 1
                     if self._spans_on():
                         now = self._span_clock()
                         self._span('admission.backpressure_retry',
@@ -1277,6 +1329,7 @@ class ContinuousBatcher:
             if self.pooled and not self._pool_reserve(head, 0):
                 # Pool backpressure: leave the request queued at its
                 # scan position — finishing requests return blocks.
+                self.backpressure_retries += 1
                 if self._spans_on():
                     now = self._span_clock()
                     self._span('admission.backpressure_retry',
@@ -1425,6 +1478,14 @@ class ContinuousBatcher:
                                mode='cold', group=effective)
                     self._span('prefill_chunk', admit_t0, now, req=req,
                                start=0, end=len(req.prompt))
+            if self._ledger is not None:
+                for req in group:
+                    self._ledger.charge_request('admit', req.rid,
+                                                req.tenant)
+                    self._ledger.charge_request('prefill', req.rid,
+                                                req.tenant)
+                    self._ledger.add_tokens(req.rid, req.tenant,
+                                            prefill=len(req.prompt))
             for i, req in enumerate(group):
                 self._host_pos[req.slot] = len(req.prompt)
                 req.out.append(int(firsts[i]))
@@ -1518,6 +1579,14 @@ class ContinuousBatcher:
                 self._span('admit', hit_t0, self._span_clock(),
                            req=req, mode='prefix_hit',
                            shared_tokens=shared_tokens)
+            if self._ledger is not None:
+                self._ledger.charge_request('admit', req.rid,
+                                            req.tenant)
+                self._ledger.charge_request('prefill', req.rid,
+                                            req.tenant)
+                self._ledger.add_tokens(
+                    req.rid, req.tenant,
+                    prefill=len(prompt) - shared_tokens)
         except Exception:
             # Same contract as the other admission handlers: reclaim
             # the slot and re-queue before surfacing the error.
@@ -1585,6 +1654,9 @@ class ContinuousBatcher:
             now = self._span_clock()
             self._span('delivery', now, now, req=req,
                        tokens=len(req.out))
+        if self._ledger is not None:
+            self._ledger.finish_request(req.rid, req.tenant,
+                                        session=req.trace_id)
         if req.slot is not None and req.slot in self._active:
             del self._active[req.slot]
         if req.slot is not None:
@@ -1651,6 +1723,10 @@ class ContinuousBatcher:
         if self._spans_on():
             self._span('prefill_chunk', w0, self._span_clock(),
                        req=req, start=start, end=end)
+        if self._ledger is not None:
+            self._ledger.charge_request('prefill', req.rid, req.tenant)
+            self._ledger.add_tokens(req.rid, req.tenant,
+                                    prefill=end - start)
         if end < len(req.prompt):
             return
         try:
@@ -1709,6 +1785,14 @@ class ContinuousBatcher:
         nucleus = any(
             float(self._host_top_p[s]) < 1.0 for s in self._active)
         active_slots = len(self._active)
+        if self._ledger is not None:
+            # The fused dispatch serves every decoding slot PLUS the
+            # prefill lane's owner — all of them split the phase.
+            self._ledger.charge_batch(
+                'fused',
+                [(r.rid, r.tenant) for r in self._active.values()]
+                + [(req.rid, req.tenant)])
+            self._ledger.add_tokens(req.rid, req.tenant, prefill=chunk)
         tick_t0 = self._span_clock() if self._spans_on() else 0.0
         chunk_start = time.perf_counter()
         try:
@@ -1774,13 +1858,18 @@ class ContinuousBatcher:
         eos = self.gen.eos_token
         appended = 0
         for slot, r in list(self._active.items()):
+            absorbed = 0
             for t in host[slot]:
                 r.out.append(int(t))
                 appended += 1
+                absorbed += 1
                 if (eos is not None and r.out[-1] == eos) or \
                         len(r.out) >= r.max_new_tokens:
                     self._finish(r)
                     break
+            if self._ledger is not None and absorbed:
+                self._ledger.add_tokens(r.rid, r.tenant,
+                                        decode=absorbed)
         telemetry_metrics.INFER_GENERATED_TOKENS.inc(appended)
         telemetry_metrics.INFER_HOST_SYNCS_PER_TOKEN.set(
             1.0 / max(appended, 1))
@@ -1877,6 +1966,13 @@ class ContinuousBatcher:
         telemetry_metrics.INFER_SPEC_ACCEPTED.inc(accepted)
         telemetry_metrics.INFER_SPEC_ACCEPT_RATE.observe(
             accepted / max(proposed, 1))
+        if self._ledger is not None:
+            parties = [(self._active[s].rid, self._active[s].tenant)
+                       for s in live if s in self._active]
+            self._ledger.charge_batch('spec_draft', parties)
+            self._ledger.charge_batch('spec_verify', parties)
+            self._ledger.add_spec(parties, proposed=proposed,
+                                  accepted=accepted)
         eos = self.gen.eos_token
         appended = 0
         for slot, req in list(self._active.items()):
@@ -1884,13 +1980,18 @@ class ContinuousBatcher:
             if c > 0:
                 self._drafter.observe(
                     slot, [int(t) for t in host[slot, :c]])
+            absorbed = 0
             for t in host[slot, :c]:
                 req.out.append(int(t))
                 appended += 1
+                absorbed += 1
                 if (eos is not None and req.out[-1] == eos) or \
                         len(req.out) >= req.max_new_tokens:
                     self._finish(req)
                     break
+            if self._ledger is not None and absorbed:
+                self._ledger.add_tokens(req.rid, req.tenant,
+                                        decode=absorbed)
         if chunk_dt > 0:
             telemetry_metrics.INFER_STEADY_TOKENS_PER_SEC.set(
                 appended / chunk_dt)
@@ -1912,6 +2013,8 @@ class ContinuousBatcher:
         skytpu_infer_step_phase_seconds / _utilization even when the
         tick raises (the profiler finishes in the finally — a failed
         dispatch still accounts for the time it burned)."""
+        if self._ledger is not None:
+            self._ledger.begin_step()
         self._profiler.start()
         try:
             self._step_inner()
@@ -2000,6 +2103,10 @@ class ContinuousBatcher:
             float(self._host_top_p[s]) < 1.0 for s in self._active)
         active_slots = len(self._active)
         spans_on = self._spans_on()
+        parties = ([(r.rid, r.tenant) for r in self._active.values()]
+                   if (spans_on or self._ledger is not None) else [])
+        if self._ledger is not None:
+            self._ledger.charge_batch('decode', parties)
         c0 = self._span_clock() if spans_on else 0.0
         chunk_start = time.perf_counter()
         with self._profiler.phase('decode'):
@@ -2022,8 +2129,13 @@ class ContinuousBatcher:
         host, host_pos, _ = self._fetch(
             toks, self._positions, self._done)
         if spans_on:
+            # Batch-level span, now tagged with the request ids that
+            # shared this tick — per-request flame rows can point at
+            # the decode chunks they rode (and the ledger splits the
+            # phase across exactly these parties).
             self._span('decode_chunk', c0, self._span_clock(),
-                       n=n, slots=active_slots)
+                       n=n, slots=active_slots,
+                       rids=sorted(rid for rid, _ in parties))
         self._host_pos = host_pos.astype(np.int64)
         if prev_pos is not None:
             # Sequential ticks still feed the drafter: the emitted rows'
@@ -2045,13 +2157,18 @@ class ContinuousBatcher:
         eos = self.gen.eos_token
         appended = 0
         for slot, req in list(self._active.items()):
+            absorbed = 0
             for t in host[slot]:
                 req.out.append(int(t))
                 appended += 1
+                absorbed += 1
                 if (eos is not None and req.out[-1] == eos) or \
                         len(req.out) >= req.max_new_tokens:
                     self._finish(req)
                     break
+            if self._ledger is not None and absorbed:
+                self._ledger.add_tokens(req.rid, req.tenant,
+                                        decode=absorbed)
         telemetry_metrics.INFER_GENERATED_TOKENS.inc(appended)
         telemetry_metrics.INFER_HOST_SYNCS_PER_TOKEN.set(
             1.0 / max(appended, 1))

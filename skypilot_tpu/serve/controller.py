@@ -65,7 +65,7 @@ class ServeController:
         """One probe pass + (if due) one autoscaling pass."""
         replicas = self.manager.probe_all()
         self._refresh_service_status(replicas)
-        now = time.time()
+        now = time.time()    # control loop; skytpu-allow: SKY402
         if now - self._last_decision_time >= \
                 self.autoscaler.get_decision_interval():
             self._last_decision_time = now

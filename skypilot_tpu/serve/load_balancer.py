@@ -55,6 +55,13 @@ LB_MAX_ROUTE_ATTEMPTS = 3
 # the route 404s and the hint is simply lost.
 LB_PREFETCH_HINT_PATH = '/v1/prefetch_hint'
 LB_PREFETCH_HINT_TIMEOUT_S = 1.0
+# Cost-attribution tag, parsed from the JSON request body (`tenant`
+# key) alongside the routing fingerprint and forwarded to the replica
+# next to X-Skytpu-Trace-Id; the replica passes it to
+# ContinuousBatcher.submit(tenant=...) and the telemetry/accounting.py
+# ledger bills the request's device time to it.
+LB_TENANT_HEADER = 'X-Skytpu-Tenant'
+DEFAULT_TENANT = 'default'
 
 
 class SkyServeLoadBalancer:
@@ -62,10 +69,13 @@ class SkyServeLoadBalancer:
 
     def __init__(self, controller: 'ServeController', port: int,
                  policy_name: Optional[str] = None,
-                 sync_interval: float = LB_CONTROLLER_SYNC_INTERVAL_SECONDS
-                 ) -> None:
+                 sync_interval: float = LB_CONTROLLER_SYNC_INTERVAL_SECONDS,
+                 clock=None) -> None:
         self.controller = controller
         self.port = port
+        # Injectable wall clock (tests freeze it; SKY402 keeps direct
+        # wall-clock reads out of the serving data plane).
+        self._clock = clock or time.time
         self.policy = lb_policies.LoadBalancingPolicy.make(policy_name)
         # Per-replica health: consecutive-failure circuit breaker with
         # backoff-scheduled half-open probes (serve/failover.py).
@@ -99,7 +109,7 @@ class SkyServeLoadBalancer:
         misses = getattr(self.policy, 'affinity_misses', None)
         if hits is not None and (hits + misses) > 0:
             report['prefix_hit_ratio'] = hits / (hits + misses)
-        self.slo.export(time.time())
+        self.slo.export(self._clock())
         ready = self.controller.lb_sync(timestamps, report or None)
         # Health state for replicas that left the fleet goes with them;
         # the policy only ever sees replicas the breaker lets route
@@ -107,17 +117,18 @@ class SkyServeLoadBalancer:
         # request is the half-open trial).
         self.health.observe_members(ready)
         self.policy.set_ready_replicas(
-            self.health.routable(ready, time.time(),
+            self.health.routable(ready, self._clock(),
                                  include_probes=True))
 
     # --- proxy ---
 
     @staticmethod
     def _request_context(body: bytes) -> Optional[Dict[str, Any]]:
-        """Extract routing context from a JSON request body: the
-        `prompt` (completions) or concatenated `messages` content
-        (chat) — what `prefix_affinity` fingerprints.  Non-JSON bodies
-        route context-free (least-load path)."""
+        """Extract routing + accounting context from a JSON request
+        body: the `prompt` (completions) or concatenated `messages`
+        content (chat) — what `prefix_affinity` fingerprints — plus
+        the `tenant` cost-attribution tag.  Non-JSON bodies route
+        context-free (least-load path) and bill the default tenant."""
         if not body:
             return None
         try:
@@ -126,6 +137,10 @@ class SkyServeLoadBalancer:
             return None
         if not isinstance(payload, dict):
             return None
+        context: Dict[str, Any] = {}
+        tenant = payload.get('tenant')
+        if isinstance(tenant, str) and tenant:
+            context['tenant'] = tenant
         prompt = payload.get('prompt')
         if prompt is None and isinstance(payload.get('messages'), list):
             prompt = ''.join(
@@ -134,8 +149,8 @@ class SkyServeLoadBalancer:
         if isinstance(prompt, str) or (
                 isinstance(prompt, list) and
                 all(isinstance(t, int) for t in prompt)):
-            return {'prompt': prompt}
-        return None
+            context['prompt'] = prompt
+        return context or None
 
     @staticmethod
     def _retry_after_s(value: Optional[str]) -> float:
@@ -152,7 +167,7 @@ class SkyServeLoadBalancer:
         against the breaker at request time (circuits open mid-
         interval, after the last `set_ready_replicas`).  Vetoed picks
         join `exclude` so the policy walks to its next candidate."""
-        now = time.time()
+        now = self._clock()
         while True:
             url = self.policy.select_replica(context, exclude=exclude)
             if url is None or url in self.health.routable(
@@ -203,7 +218,7 @@ class SkyServeLoadBalancer:
     async def _handle(self, request):
         from aiohttp import web
         with self._ts_lock:
-            self.request_timestamps.append(time.time())
+            self.request_timestamps.append(self._clock())
         body = await request.read()
         # One trace id per end-to-end request: honor the caller's
         # X-Skytpu-Trace-Id or mint one; _proxy_attempt forwards it so
@@ -211,13 +226,16 @@ class SkyServeLoadBalancer:
         trace_id = (request.headers.get(trace_lib.TRACE_HEADER)
                     or trace_lib.new_trace_id())
         context = self._request_context(body)
+        # The cost-attribution tag rides the body; the header is how
+        # it reaches the replica's batcher (and the acct ledger).
+        tenant = (context or {}).get('tenant') or DEFAULT_TENANT
         exclude: Set[str] = set()
-        sel_t0 = time.time()
+        sel_t0 = self._clock()
         url = self._pick(context, exclude)
         if spans_lib.enabled():
-            spans_lib.record('lb.select', sel_t0, time.time(),
+            spans_lib.record('lb.select', sel_t0, self._clock(),
                              trace_id=trace_id, replica=url,
-                             policy=self.policy.name)
+                             policy=self.policy.name, tenant=tenant)
         if url is not None and context is not None:
             # Fire-and-forget tier warm-up: the chosen replica starts
             # pulling a host-spilled prefix back toward the device
@@ -242,7 +260,7 @@ class SkyServeLoadBalancer:
             if url is None:
                 break
             kind, value = await self._proxy_attempt(request, body, url,
-                                                    trace_id)
+                                                    trace_id, tenant)
             if kind == 'response':
                 return value
             exclude.add(url)
@@ -279,7 +297,8 @@ class SkyServeLoadBalancer:
             text='No ready replicas. Use "serve status" to check.')
 
     async def _proxy_attempt(self, request, body: bytes, url: str,
-                             trace_id: Optional[str] = None):
+                             trace_id: Optional[str] = None,
+                             tenant: str = DEFAULT_TENANT):
         """Proxy one attempt to `url`.  Returns ('response', resp) when
         the request is answered (including an honestly-truncated
         stream), ('backpressure', retry_after_s) on a 503 divert, or
@@ -288,7 +307,7 @@ class SkyServeLoadBalancer:
         elsewhere without risking duplicated output."""
         import aiohttp
         from aiohttp import web
-        now = time.time()
+        now = self._clock()
         self.policy.pre_execute_hook(url)
         out = None
         start = time.perf_counter()
@@ -298,6 +317,10 @@ class SkyServeLoadBalancer:
             # Propagate the request's trace id so the replica's
             # batcher spans correlate with this proxy span.
             headers_out[trace_lib.TRACE_HEADER] = trace_id
+        # Tenant travels next to the trace id: the replica threads it
+        # into ContinuousBatcher.submit(tenant=...) for cost
+        # attribution (default when the body named none).
+        headers_out[LB_TENANT_HEADER] = tenant
         try:
             target = url + str(request.rel_url)
             async with aiohttp.ClientSession(auto_decompress=False) as sess:
@@ -339,7 +362,7 @@ class SkyServeLoadBalancer:
                                 .observe(ttft)
                             with self._ts_lock:
                                 self.ttft_ms_samples.append(ttft * 1000.0)
-                                self.slo.observe_ttft(ttft, time.time())
+                                self.slo.observe_ttft(ttft, self._clock())
                         await out.write(chunk)
                     await out.write_eof()
                     return ('response', out)
@@ -372,7 +395,7 @@ class SkyServeLoadBalancer:
             telemetry_metrics.SERVE_REPLICA_SECONDS.labels(
                 replica=url).observe(time.perf_counter() - start)
             if spans_lib.enabled():
-                spans_lib.record('lb.proxy', now, time.time(),
+                spans_lib.record('lb.proxy', now, self._clock(),
                                  trace_id=trace_id, replica=url,
                                  status=status)
 

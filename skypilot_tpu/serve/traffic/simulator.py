@@ -66,6 +66,8 @@ from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.traffic.generator import (Arrival, TrafficConfig,
                                                   generate_trace)
+from skypilot_tpu.telemetry import accounting as accounting_lib
+from skypilot_tpu.telemetry import doctor as doctor_lib
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
 from skypilot_tpu.telemetry import spans as spans_lib
 from skypilot_tpu.telemetry import trace as trace_lib
@@ -177,6 +179,17 @@ class SimConfig:
     host_tier_mb: Optional[float] = None
     tier_spill_gbps: float = 8.0
     tier_prefetch_gbps: float = 8.0
+    # Fleet doctor (None = off): evaluate the telemetry/doctor.py rule
+    # registry every `doctor_cadence_s` VIRTUAL seconds over the
+    # plane's existing signals (SLO burn, tier churn, breaker opens,
+    # pool high-water, backpressure retries).  Incidents land in
+    # summary()['doctor']; with `postmortem_dir` set (or
+    # SKYTPU_POSTMORTEM_DIR in the env) each opened incident dumps a
+    # flight-recorder bundle built ONLY from virtual-clock sources, so
+    # bundles are byte-identical per seed.
+    doctor_cadence_s: Optional[float] = None
+    doctor_thresholds: Optional[Dict[str, float]] = None
+    postmortem_dir: Optional[str] = None
     # prefix_affinity bounded-load factor (ignored by other policies).
     load_factor: float = 1.25
     model_seed: int = 0
@@ -207,6 +220,13 @@ class SimConfig:
             raise ValueError(
                 f'host_tier_mb must be >= 0 (0/None disables the '
                 f'tier), got {self.host_tier_mb}')
+        if self.doctor_cadence_s is not None and self.doctor_cadence_s <= 0:
+            raise ValueError(f'doctor_cadence_s must be positive, '
+                             f'got {self.doctor_cadence_s}')
+        if self.postmortem_dir and self.doctor_cadence_s is None:
+            raise ValueError(
+                'postmortem_dir requires doctor_cadence_s: the flight '
+                'recorder only dumps when the doctor opens incidents')
         if self.host_tier_mb and self.prefix_cache_mb is None:
             raise ValueError(
                 'host_tier_mb requires prefix_cache_mb: the tier '
@@ -236,6 +256,9 @@ class _SessionState:
     # session.  Together with the journal's replica field it fences
     # zombies: a delivery is accepted only from (owner url, owner rid).
     rid: int
+    # Cost-attribution tag (Arrival.tenant); survives failover so the
+    # replayed work bills the same tenant.
+    tenant: str = 'default'
     fault_detect_t: Optional[float] = None
     refirst_t: Optional[float] = None
 
@@ -288,7 +311,7 @@ class _ReplicaSim:
         return now < self.partitioned_until
 
     def submit(self, prompt: List[int], max_new_tokens: int, sid: int,
-               now: float) -> int:
+               now: float, tenant: str = 'default') -> int:
         # An idle replica's clock has nothing to do before the request
         # exists; work can never be charged to the past.
         self.vclock = max(self.vclock, now)
@@ -296,7 +319,8 @@ class _ReplicaSim:
         # X-Skytpu-Trace-Id header: the batcher stamps its spans with
         # the ambient trace id at submit.
         with trace_lib.trace_scope(_session_trace_id(sid)):
-            rid = self.batcher.submit(prompt, max_new_tokens=max_new_tokens)
+            rid = self.batcher.submit(prompt, max_new_tokens=max_new_tokens,
+                                      tenant=tenant)
         self.rid_sid[rid] = sid
         self.rid_plen[rid] = len(prompt)
         self.inflight.append(rid)
@@ -470,6 +494,24 @@ class FleetSimulator:
         self.slo = slo_lib.SLOMonitor(slo_lib.SLOConfig(
             ttft_target_s=self.cfg.slo_ttft_s,
             tpot_target_s=self.cfg.slo_tpot_s))
+        # Fleet doctor + flight recorder (inert without a cadence).
+        # Every recorder source is virtual-clock/sim-state derived —
+        # the process-global SpanBuffer and REGISTRY are cumulative
+        # across in-process runs and would break byte-determinism.
+        self._doctor: Optional[doctor_lib.Doctor] = None
+        self._recorder: Optional[doctor_lib.FlightRecorder] = None
+        self._last_signals: Dict[str, float] = {}
+        if self.cfg.doctor_cadence_s is not None:
+            self._recorder = doctor_lib.FlightRecorder(
+                self.cfg.postmortem_dir,
+                spans_fn=self._doctor_spans,
+                metrics_fn=lambda: dict(self._last_signals),
+                pool_fn=self._pool_dump,
+                tier_fn=self._tier_dump,
+                ledger=self.fleet_ledger())
+            self._doctor = doctor_lib.Doctor(
+                thresholds=self.cfg.doctor_thresholds,
+                recorder=self._recorder)
         self.replicas: List[_ReplicaSim] = []
         self.retired: List[_ReplicaSim] = []
         self.dead: List[_ReplicaSim] = []
@@ -518,11 +560,25 @@ class FleetSimulator:
         # exist before the batcher, the batcher before the replica.
         span_buf = spans_lib.SpanBuffer(pid=rid + 1, tid=0)
         cell: List[_ReplicaSim] = []
+        # Per-replica cost ledger on the replica's virtual clock.
+        # export_metrics=False: the Prometheus registry is process-
+        # global and would mix the arms of a multi-run comparison.
+        ledger = accounting_lib.CostLedger(export_metrics=False)
+        # The StepProfiler's host timer is real and would make the
+        # ledger's phase split machine-dependent; an event-tick clock
+        # (every read advances one tick) keeps attribution a pure
+        # function of the deterministic step schedule.  'Seconds' in
+        # this replica's ledger are therefore profiler TICKS — the
+        # conservation invariant and tenant shares are unit-free.
+        ticks = itertools.count(1)
         batcher = ContinuousBatcher(self.params, self.model_config,
                                     self.gen,
                                     decode_chunk=self.cfg.decode_chunk,
                                     span_buffer=span_buf,
-                                    span_clock=lambda: cell[0].vclock)
+                                    span_clock=lambda: cell[0].vclock,
+                                    ledger=ledger,
+                                    profiler_clock=lambda: float(
+                                        next(ticks)))
         rep = _ReplicaSim(rid, url, batcher, self.cfg, span_buf=span_buf)
         cell.append(rep)
         rep.last_progress_t = self._now
@@ -587,6 +643,8 @@ class FleetSimulator:
             pending = list(self._pending_faults)
             next_decision = (float(autoscaler.get_decision_interval())
                              if autoscaler is not None else None)
+            next_doctor = (self.cfg.doctor_cadence_s
+                           if self._doctor is not None else None)
             for tick in range(self.cfg.max_ticks):
                 if idx >= len(arrivals) and self._settled():
                     break
@@ -609,10 +667,17 @@ class FleetSimulator:
                 if autoscaler is not None and now >= next_decision:
                     self._autoscale_tick(autoscaler, now)
                     next_decision = now + autoscaler.get_decision_interval()
+                if next_doctor is not None and now >= next_doctor:
+                    self._doctor_tick(now)
+                    next_doctor = now + self.cfg.doctor_cadence_s
             else:
                 raise RuntimeError(
                     f'Simulation exceeded max_ticks={self.cfg.max_ticks} '
                     f'(fleet cannot drain the trace)')
+            if self._doctor is not None:
+                # Closing examination: a trace that drains before the
+                # first cadence tick still gets one observation.
+                self._doctor_tick(now)
             return self.summary(makespan=now)
         finally:
             random.setstate(rng_state)
@@ -641,7 +706,7 @@ class FleetSimulator:
         # trie (the prefetch-overlapped-into-admission path).
         rep.batcher.prefetch_hint(arrival.prompt)
         rid = rep.submit(arrival.prompt, arrival.max_new_tokens, sid,
-                         now=arrival.t)
+                         now=arrival.t, tenant=arrival.tenant)
         # The journal's budget is the batcher's post-clamp budget, so
         # replay_spec() knows exactly how many tokens remain owed.
         budget = min(arrival.max_new_tokens,
@@ -650,7 +715,7 @@ class FleetSimulator:
         self._sessions[sid] = _SessionState(
             rec=_ReqRecord(arrival_t=arrival.t,
                            prompt_len=len(arrival.prompt)),
-            rid=rid)
+            rid=rid, tenant=arrival.tenant)
 
     # ---- delivery plane --------------------------------------------------
     def _owns(self, rep: _ReplicaSim, rid: int, sid: int) -> bool:
@@ -879,7 +944,7 @@ class FleetSimulator:
         self.policy.pre_execute_hook(url)
         rep = self._by_url[url]
         rid = rep.submit(spec['prompt'], spec['max_new_tokens'], sid,
-                         now=now)
+                         now=now, tenant=st.tenant)
         self.journal.reassign(sid, url)
         st.rid = rid
         replayed = len(self.journal.record(sid).committed)
@@ -937,6 +1002,103 @@ class FleetSimulator:
                 self.remove_replica(decision.target)
         self.scale_events.append(
             {'t': round(now, 3), 'replicas': len(self._live())})
+
+    # ---- fleet doctor + cost attribution ---------------------------------
+    def _all_reps(self) -> List[_ReplicaSim]:
+        """Every replica that ever ran: retired and dead replicas'
+        spend and health history are part of the story."""
+        return self.replicas + self.retired + self.dead
+
+    def close(self) -> None:
+        """Shut down every replica batcher (joins kv-tier copy
+        threads).  Summaries and ledgers stay readable; idempotent."""
+        for rep in self._all_reps():
+            rep.batcher.close()
+
+    def fleet_ledger(self) -> accounting_lib.FleetLedgerView:
+        """Merged per-tenant cost rollup across the whole fleet (the
+        ledger set is re-read per call — replicas churn)."""
+        return accounting_lib.FleetLedgerView(
+            lambda: [rep.batcher._ledger for rep in self._all_reps()])
+
+    def _gather_signals(self, now: float) -> Dict[str, float]:
+        """One doctor signal snapshot (see doctor.SIGNALS), every
+        value derived from sim state on the virtual clock."""
+        burn = self.slo.export(now)
+        tier_agg = {'spills': 0, 'prefetches': 0, 'prefetch_late': 0}
+        for rep in self._all_reps():
+            tier = rep.batcher._tier
+            if tier is None:
+                continue
+            # No tier_flush here: forcing copies to land between steps
+            # would dodge the per-step byte charge and change vclocks —
+            # the doctor must observe, never perturb.
+            stats = tier.stats()
+            for key in tier_agg:
+                tier_agg[key] += stats[key]
+        pool_total = pool_hwm = pool_free = 0
+        for rep in self.replicas:
+            if rep.batcher.pooled:
+                stats = rep.batcher.pool.stats()
+                pool_total += stats['blocks_total']
+                pool_hwm += stats['hwm']
+                pool_free += stats['blocks_free']
+        return {
+            'slo_burn_fast': float(burn['fast'] or 0.0),
+            'slo_burn_slow': float(burn['slow'] or 0.0),
+            'tier_prefetches': float(tier_agg['prefetches']),
+            'tier_prefetch_late': float(tier_agg['prefetch_late']),
+            'tier_spills': float(tier_agg['spills']),
+            'breaker_opens': (float(self._breaker.opens_total)
+                              if self._breaker is not None else 0.0),
+            'pool_blocks_total': float(pool_total),
+            'pool_hwm': float(pool_hwm),
+            'pool_free': float(pool_free),
+            'backpressure_retries': float(sum(
+                rep.batcher.backpressure_retries
+                for rep in self._all_reps())),
+        }
+
+    def _doctor_tick(self, now: float) -> None:
+        signals = self._gather_signals(now)
+        # The recorder's metrics_fn reads this snapshot (sorted so the
+        # bundle bytes are stable).
+        self._last_signals = dict(sorted(signals.items()))
+        self._doctor.observe(signals, now)
+
+    def _doctor_spans(self) -> List[Dict[str, Any]]:
+        """Virtual-clock span stream for postmortem bundles: sim plane
+        + every replica, merged in virtual-time order (stable sort
+        over a deterministic concatenation)."""
+        spans: List[Dict[str, Any]] = list(self._span_buf.snapshot())
+        for rep in self._all_reps():
+            if rep.span_buf is not None:
+                spans.extend(rep.span_buf.snapshot())
+        spans.sort(key=lambda s: (s['t0'], s['t1']))
+        return spans
+
+    def _pool_dump(self) -> Dict[str, Any]:
+        return {rep.url: rep.batcher.pool.stats()
+                for rep in self.replicas if rep.batcher.pooled}
+
+    # Deterministic subset of kv_tier stats: the *_seconds fields time
+    # real copy threads with the wall clock and would break bundle
+    # byte-determinism.
+    _TIER_DUMP_KEYS = ('spills', 'spill_rejects', 'spill_bytes',
+                       'prefetches', 'prefetch_bytes', 'prefetch_late',
+                       'host_evictions', 'host_hits', 'device_hits',
+                       'misses', 'host_blocks', 'host_resident',
+                       'entries')
+
+    def _tier_dump(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for rep in self._all_reps():
+            tier = rep.batcher._tier
+            if tier is not None:
+                stats = tier.stats()
+                out[rep.url] = {key: stats[key]
+                                for key in self._TIER_DUMP_KEYS}
+        return out
 
     # ---- metrics ---------------------------------------------------------
     def export_trace(self, path: str) -> int:
@@ -1042,6 +1204,22 @@ class FleetSimulator:
                 for k in agg:
                     agg[k] += stats[k]
             out['tier'] = agg
+        if len(self.traffic.tenants) > 1:
+            # Cost attribution only earns a summary block when there
+            # is more than one tenant to attribute between (the gate
+            # bench_compare.py mirrors, like the tier block).
+            out['acct'] = self.fleet_ledger().summary()
+        if self._doctor is not None:
+            counts: Dict[str, int] = {}
+            for inc in self._doctor.incidents:
+                counts[inc.rule] = counts.get(inc.rule, 0) + 1
+            out['doctor'] = {
+                'incidents': [inc.to_dict()
+                              for inc in self._doctor.incidents],
+                'incident_counts': dict(sorted(counts.items())),
+                'postmortems': (len(self._recorder.dumped)
+                                if self._recorder is not None else 0),
+            }
         if self.chaos is not None:
             lat = self._failover_latencies
             out['chaos'] = {

@@ -593,6 +593,64 @@ SERVE_SLO_BURN_RATE = prometheus_client.Gauge(
     ['window'],
     registry=REGISTRY)
 
+# ---- per-tenant cost attribution (telemetry/accounting.py) + doctor
+
+ACCT_DEVICE_SECONDS = prometheus_client.Counter(
+    'skytpu_acct_device_seconds_total',
+    'Exclusive StepProfiler phase wall time apportioned to tenants: '
+    'batch-wide phases (decode / fused / spec_verify) split evenly '
+    'across the slots active in that step, per-request phases '
+    '(prefill / admit) charged to the owning request — summed over a '
+    'run the per-tenant totals conserve the profiler wall within 5%',
+    ['tenant', 'phase'],
+    registry=REGISTRY)
+
+ACCT_TOKENS = prometheus_client.Counter(
+    'skytpu_acct_tokens_total',
+    'Tokens attributed per tenant, by kind: prefill (prompt tokens '
+    'prefilled, including fused/piggybacked chunks) and decode '
+    '(committed output tokens)',
+    ['tenant', 'kind'],
+    registry=REGISTRY)
+
+ACCT_BLOCK_SECONDS = prometheus_client.Counter(
+    'skytpu_acct_block_seconds_total',
+    'Pooled-KV arena occupancy per tenant: sum over steps of '
+    '(blocks held by the tenant\'s slots x step wall seconds) — the '
+    'HBM-residency component of a tenant\'s bill',
+    ['tenant'],
+    registry=REGISTRY)
+
+ACCT_TIER_BYTES = prometheus_client.Counter(
+    'skytpu_acct_tier_bytes_total',
+    'Host-tier bytes attributed per tenant by direction: spill '
+    '(device->host copies of blocks the tenant\'s eviction pressure '
+    'displaced) and prefetch (host->device staging its admissions '
+    'consumed)',
+    ['tenant', 'direction'],
+    registry=REGISTRY)
+
+ACCT_SPEC_WASTE_TOKENS = prometheus_client.Counter(
+    'skytpu_acct_spec_waste_tokens_total',
+    'Speculative-decoding waste per tenant: draft tokens proposed '
+    'minus accepted on verify chunks the tenant\'s slots took part in '
+    '(the compute the drafter burned without committing output)',
+    ['tenant'],
+    registry=REGISTRY)
+
+ACCT_REQUESTS = prometheus_client.Counter(
+    'skytpu_acct_requests_total',
+    'Requests finalized into the cost ledger per tenant',
+    ['tenant'],
+    registry=REGISTRY)
+
+DOCTOR_INCIDENTS = prometheus_client.Counter(
+    'skytpu_doctor_incidents_total',
+    'Incidents opened by the fleet doctor rules engine, per rule code '
+    '(see the incident taxonomy in docs/observability.md)',
+    ['rule'],
+    registry=REGISTRY)
+
 
 def record_autoscaler_decisions(service_name: str,
                                 decisions: List[Any]) -> None:

@@ -327,7 +327,10 @@ def _sim_summary(host_tier_mb):
         gen.TrafficConfig(seed=11, duration_s=4.0, base_rps=4.0,
                           num_sessions=3, num_heads=3, head_tokens=128,
                           max_prompt_tokens=192, session_share=0.8))
-    return sim.run()
+    try:
+        return sim.run()
+    finally:
+        sim.close()              # joins the kv-tier copy threads
 
 
 def test_simulator_tier_cost_model_is_deterministic():

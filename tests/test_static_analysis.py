@@ -373,6 +373,52 @@ def test_metric_family_rule_ignores_collections_counter():
     """, path='analysis/baseline.py')
 
 
+def test_wall_clock_in_data_plane():
+    """SKY402: direct time.time()/time.monotonic() in a serving
+    data-plane module — these classes take injectable clocks so
+    virtual-time (simulator) runs stay deterministic."""
+    bad = """
+        import time
+
+        def stamp(span):
+            span['t0'] = time.time()
+            span['mono'] = time.monotonic()
+    """
+    assert codes(bad, path='serve/load_balancer.py').count('SKY402') == 2
+    assert 'SKY402' in codes(bad, path='telemetry/spans.py')
+    assert 'SKY402' in codes(bad, path='infer/serving.py')
+    # Outside the data plane the wall clock is nobody's business.
+    assert 'SKY402' not in codes(bad, path='jobs/core.py')
+    assert 'SKY402' not in codes(bad, path='infer/engine.py')
+
+
+def test_wall_clock_sanctioned_patterns_are_clean():
+    # Injectable-clock reads and perf_counter (duration-only, never
+    # compared across processes) are the sanctioned shapes; a default
+    # expression that merely REFERENCES time.time without calling it
+    # is fine too.
+    assert 'SKY402' not in codes("""
+        import time
+
+        class LB:
+            def __init__(self, clock=None):
+                self._clock = clock or time.time
+
+            def now(self):
+                return self._clock()
+
+        def span_len(t0):
+            return time.perf_counter() - t0
+    """, path='serve/load_balancer.py')
+    # The allow marker sanctions a one-off site (e.g. a db timestamp).
+    assert 'SKY402' not in codes("""
+        import time
+
+        def stamp():
+            return time.time()  # skytpu-allow: SKY402
+    """, path='serve/serve_state.py')
+
+
 def test_inline_allow_suppresses():
     assert codes("""
         import jax
