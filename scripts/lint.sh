@@ -16,7 +16,10 @@ cd "$(dirname "$0")/.."
 # TPU (tracing and lowering are backend-independent anyway).
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-python -m skypilot_tpu.analysis --json "$@"
+# --graph-stats doubles as a self-check: the run fails if the
+# whole-program call graph is degenerate (zero functions, call edges,
+# or thread entries), i.e. the SKY5xx concurrency pass went blind.
+python -m skypilot_tpu.analysis --json --graph-stats "$@"
 
 # Fleet-doctor rule table: self-validate thresholds/severities so a bad
 # rule edit fails CI here rather than silently never firing in prod.

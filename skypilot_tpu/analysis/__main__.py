@@ -45,12 +45,31 @@ def main(argv=None) -> int:
                              'points on the local backend)')
     parser.add_argument('--list-rules', action='store_true',
                         help='print the rule catalog and exit')
+    parser.add_argument('--graph-stats', action='store_true',
+                        help='print whole-program call-graph statistics; '
+                             'exits 1 if the graph is degenerate (zero '
+                             'functions, call edges, or thread entries) — '
+                             'the CI self-check that the concurrency pass '
+                             'is actually seeing the package')
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in linter.RULES.values():
             print(f'{rule.code}  {rule.name:20s} {rule.summary}')
         return 0
+
+    graph_stats = None
+    if args.graph_stats:
+        from skypilot_tpu.analysis import graph as graph_lib
+        graph_stats = graph_lib.build_package_graph().stats()
+        if not args.as_json:
+            for key, value in sorted(graph_stats.items()):
+                print(f'graph {key}: {value}')
+        if not (graph_stats['functions'] and graph_stats['call_edges']
+                and graph_stats['thread_entries']):
+            print('graph self-check FAILED: degenerate call graph '
+                  f'({graph_stats})', file=sys.stderr)
+            return 1
 
     paths = args.paths or [_PACKAGE_ROOT]
     violations = linter.lint_paths(paths, root=_REPO_ROOT)
@@ -80,6 +99,7 @@ def main(argv=None) -> int:
             'new': [v.as_dict() for v in new],
             'suppressed': [v.as_dict() for v in suppressed],
             'stale_baseline': stale,
+            'graph': graph_stats,
             'audit': audit_report,
             'ok': not new and not audit_failed,
         }, indent=1))

@@ -1157,6 +1157,8 @@ def run_audit(entries: Optional[List[str]] = None) -> Dict[str, Any]:
 def quick_summary() -> Dict[str, Any]:
     """Compact roll-up for bench.py's AUDIT_SUMMARY line: decode compile
     counts per bucket + donation status, next to TELEMETRY_SUMMARY."""
+    from skypilot_tpu.analysis import graph as graph_lib
+    from skypilot_tpu.analysis import linter
     report = audit_generator_decode()
     by_name = {c['name']: c for c in report['checks']}
     return {
@@ -1167,4 +1169,7 @@ def quick_summary() -> Dict[str, Any]:
         'cache_donated': by_name['donation']['status'] == 'ok',
         'failures': sum(1 for c in report['checks']
                         if c['status'] == 'fail'),
+        'lint_rules': len(linter.RULES),
+        'graph_thread_entries':
+            len(graph_lib.build_package_graph().thread_entries),
     }

@@ -35,7 +35,9 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
+import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 # ---------------------------------------------------------------------------
@@ -114,6 +116,27 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
          'bypasses the injected clock and breaks virtual-time '
          'determinism (simulator summaries, postmortem bundles, '
          'frozen-clock tests)'),
+    Rule('SKY501', 'unsynced-cross-thread-state',
+         'attribute written from thread-plane code (reachable from a '
+         'Thread(target=...)/submit entry) and read or written from '
+         'main-plane code with no lock held in common at every site — '
+         'torn reads / lost updates under the race'),
+    Rule('SKY502', 'lock-order-cycle',
+         'two locks acquired in opposite orders on different code paths '
+         '(or a non-reentrant Lock re-acquired while held) — classic '
+         'ABBA deadlock risk'),
+    Rule('SKY503', 'leaked-thread-or-resource',
+         'a started thread or thread-owning resource stored on a class '
+         'none of whose methods ever join/close it, or a fire-and-'
+         'forget local thread — the PR 15/16 shutdown-leak class'),
+    Rule('SKY504', 'blocking-hot-path',
+         'unbounded blocking call (queue.get/.join()/.acquire()/.wait() '
+         'without timeout, time.sleep) reachable from the serving hot '
+         'path (ContinuousBatcher.step) — one wedged worker stalls '
+         'every in-flight request'),
+    Rule('SKY601', 'unused-suppression',
+         'a # skytpu-allow: marker that no longer suppresses any '
+         'violation — delete it so the allow-list can only shrink'),
 ]}
 
 # Modules whose device->host transfers must route through
@@ -353,12 +376,15 @@ class _Reporter:
         self._allow = allow
         self.violations: List[Violation] = []
         self._seen: Set[Tuple[int, int, str]] = set()
+        #: lines whose allow-marker actually suppressed something (SKY601).
+        self.used_allow_lines: Set[int] = set()
 
     def report(self, node: ast.AST, code: str, message: str) -> None:
         line = getattr(node, 'lineno', 0)
         col = getattr(node, 'col_offset', 0)
         allowed = self._allow.get(line, set())
         if '*' in allowed or code in allowed:
+            self.used_allow_lines.add(line)
             return
         key = (line, col, code)
         if key in self._seen:   # a def reachable via two trace edges
@@ -754,17 +780,35 @@ class _ModuleRuleVisitor(ast.NodeVisitor):
 
 
 def _allow_map(source: str) -> Dict[int, Set[str]]:
-    """lineno -> codes allowed by a `# skytpu-allow: ...` comment."""
+    """lineno -> codes allowed by a `# skytpu-allow: ...` comment.
+
+    Only real COMMENT tokens count — a docstring or string literal that
+    merely mentions the marker is neither a suppression nor (SKY601) a
+    stale one.  Falls back to a per-line text scan if the file does not
+    tokenize (it will be reported as SKY000 anyway).
+    """
+    marker = 'skytpu-allow:'
     allow: Dict[int, Set[str]] = {}
-    for i, line in enumerate(source.splitlines(), start=1):
-        marker = 'skytpu-allow:'
-        pos = line.find(marker)
-        if pos < 0 or '#' not in line[:pos]:
-            continue
+
+    def add(lineno: int, comment: str) -> None:
+        pos = comment.find(marker)
+        if pos < 0:
+            return
         codes = {c.strip() for c in
-                 line[pos + len(marker):].split(',') if c.strip()}
+                 comment[pos + len(marker):].split(',') if c.strip()}
         if codes:
-            allow[i] = codes
+            allow[lineno] = codes
+
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                add(tok.start[0], tok.string)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(source.splitlines(), start=1):
+            pos = line.find(marker)
+            if pos >= 0 and '#' in line[:pos]:
+                add(i, line[line.index('#'):])
     return allow
 
 
@@ -796,9 +840,233 @@ def lint_file(path: str, root: Optional[str] = None) -> List[Violation]:
         return lint_source(f.read(), rel)
 
 
+# ---------------------------------------------------------------------------
+# Whole-program pipeline (call-graph based)
+# ---------------------------------------------------------------------------
+
+
+def _collect_traced_fids(graph) -> Tuple[Set[str], Set[str]]:
+    """Traced functions as ``(direct, indirect)`` fid sets: *direct* ones
+    are handed to the tracer by name (decorator / jit call / HOF slot) and
+    get the full SKY101-104 walk with parameter tracking; *indirect* ones
+    are only reached through call edges and get the reduced rule set.
+
+    Compared to the legacy per-module two-pass heuristic this (a) follows
+    indirect calls — a helper called from a jitted function is traced even
+    though nothing jits it directly (fewer false negatives), and (b) when
+    a ``jit(f)`` reference resolves, marks only the resolved definition
+    instead of every same-named def in the module (fewer false positives
+    from dead code).  Unresolvable references fall back to the legacy
+    name-based marking within the module, so resolution can only improve
+    precision, never lose coverage.
+    """
+    from skypilot_tpu.analysis import graph as graph_lib
+
+    by_name: Dict[str, Dict[str, List[str]]] = {}
+    for fid, fn in graph.funcs.items():
+        if isinstance(fn.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(fn.path, {}).setdefault(
+                fn.name, []).append(fid)
+
+    roots: Set[str] = set()
+
+    def mark(fn, expr: ast.AST) -> None:
+        targets = graph.resolve_callable(fn, expr)
+        if targets:
+            roots.update(targets)
+            return
+        names, nodes = _callable_targets(expr)
+        for name in names:
+            roots.update(by_name.get(fn.path, {}).get(name, []))
+        for node in nodes:
+            for child_fid in fn.children:
+                if graph.funcs[child_fid].node is node:
+                    roots.add(child_fid)
+
+    for fid in sorted(graph.funcs):
+        fn = graph.funcs[fid]
+        node = fn.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = _dotted(target)
+                if name in _JIT_WRAPPERS:
+                    roots.add(fid)
+                elif (name in _PARTIAL and isinstance(dec, ast.Call)
+                      and dec.args
+                      and _dotted(dec.args[0]) in _JIT_WRAPPERS):
+                    roots.add(fid)
+        for call in graph_lib._iter_body_nodes(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            name = _dotted(call.func)
+            if name in _JIT_WRAPPERS and call.args:
+                mark(fn, call.args[0])
+            positions = _TRACING_HOFS.get(name or '')
+            if positions:
+                for i in positions:
+                    if i < len(call.args):
+                        mark(fn, call.args[i])
+
+    # Direct set: the roots plus everything lexically nested in them
+    # (the legacy walk recurses into nested defs with full rules).
+    direct = set(roots)
+    frontier = list(roots)
+    while frontier:
+        fid = frontier.pop()
+        for child in graph.funcs[fid].children:
+            if child not in direct:
+                direct.add(child)
+                frontier.append(child)
+    # Indirect set: everything else a traced function calls runs under
+    # the same trace, but we don't know which of its parameters carry
+    # traced values (static config args are routine), so these bodies
+    # get the reduced rule set only.
+    seen = set(direct)
+    frontier = list(direct)
+    while frontier:
+        fid = frontier.pop()
+        fn = graph.funcs[fid]
+        for nxt in list(graph.call_edges.get(fid, ())) + fn.children:
+            if nxt not in seen and nxt in graph.funcs:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return direct, seen - direct
+
+
+def _top_traced(graph, traced: Set[str]) -> List[str]:
+    """Traced fids with no traced lexical ancestor (the walk recurses
+    into nested defs itself)."""
+    out: List[str] = []
+    for fid in traced:
+        fn = graph.funcs[fid]
+        parent = fn.parent
+        is_top = True
+        while parent is not None:
+            if parent in traced:
+                is_top = False
+                break
+            parent = graph.funcs[parent].parent
+        if is_top:
+            out.append(fid)
+    return sorted(out)
+
+
+def _walk_traced_indirect(fn, rep: _Reporter) -> None:
+    """Reduced in-trace rules for functions only reached via call edges.
+
+    We know the body executes at trace time, but not which parameters are
+    traced values — helpers routinely take static config (dtypes, flags,
+    meshes) that is deliberately branched on and int()-ed at trace time.
+    So: no SKY102 and no bare int()/float()/bool() SKY101 here; only the
+    calls that are wrong in traced code regardless of operand kind.
+    """
+    from skypilot_tpu.analysis import graph as graph_lib
+
+    for node in graph_lib._iter_body_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name in _HOST_SYNC_DOTTED:
+            rep.report(node, 'SKY101',
+                       f'{name}() inside jit-traced code (reached from a '
+                       'traced caller) is a device->host transfer — route '
+                       'results through engine.host_fetch outside the '
+                       'trace')
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ('item', 'block_until_ready'):
+            rep.report(node, 'SKY101',
+                       f'.{node.func.attr}() inside jit-traced code '
+                       '(reached from a traced caller) is a host sync — '
+                       'keep the value on device')
+        if name == 'print':
+            rep.report(node, 'SKY103',
+                       'print() inside jit-traced code (reached from a '
+                       'traced caller) runs at trace time only — use '
+                       'jax.debug.print for runtime output')
+        elif name and name.startswith(_IMPURE_PREFIXES):
+            rep.report(node, 'SKY103',
+                       f'{name}() inside jit-traced code (reached from a '
+                       'traced caller) executes once at trace time and is '
+                       'baked into the compiled program')
+        if name in ('jax.random.PRNGKey', 'random.PRNGKey',
+                    'jrandom.PRNGKey', 'jax.random.key') and node.args \
+                and isinstance(node.args[0], ast.Constant):
+            rep.report(node, 'SKY104',
+                       'PRNGKey(constant) inside jit-traced code replays '
+                       'identical randomness every call — thread the key '
+                       'in as an argument')
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Violation]:
+    """Whole-program lint over ``{relative_path: source}``.
+
+    Runs the per-module rules, the call-graph-based traced-function rules
+    (SKY101-104), the SKY5xx concurrency/lifecycle rules, and the SKY601
+    unused-suppression check.
+    """
+    from skypilot_tpu.analysis import concurrency
+    from skypilot_tpu.analysis import graph as graph_lib
+
+    reporters: Dict[str, _Reporter] = {}
+    parsed: Dict[str, str] = {}
+    for path in sorted(sources):
+        norm = path.replace(os.sep, '/')
+        source = sources[path]
+        rep = _Reporter(norm, source.splitlines(), _allow_map(source))
+        reporters[norm] = rep
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            rep.violations.append(Violation(
+                norm, e.lineno or 0, e.offset or 0, 'SKY000',
+                f'file does not parse: {e.msg}', ''))
+            continue
+        parsed[norm] = source
+        _ModuleRuleVisitor(rep, norm).visit(tree)
+
+    graph = graph_lib.build_graph(parsed)
+    direct, indirect = _collect_traced_fids(graph)
+    for fid in _top_traced(graph, direct):
+        fn = graph.funcs[fid]
+        _walk_traced(fn.node, reporters[fn.path], set())
+    for fid in sorted(indirect):
+        fn = graph.funcs[fid]
+        _walk_traced_indirect(fn, reporters[fn.path])
+
+    def route(path: str, node: ast.AST, code: str, message: str) -> None:
+        rep = reporters.get(path)
+        if rep is not None:
+            rep.report(node, code, message)
+
+    concurrency.check(graph, route)
+
+    for path in sorted(reporters):
+        rep = reporters[path]
+        for line in sorted(rep._allow):
+            if line in rep.used_allow_lines:
+                continue
+            codes = ','.join(sorted(rep._allow[line]))
+            text = (rep._lines[line - 1].strip()
+                    if 0 < line <= len(rep._lines) else '')
+            rep.violations.append(Violation(
+                path, line, 0, 'SKY601',
+                f'suppression for {codes} no longer matches any '
+                f'violation on this line — delete the stale '
+                f'skytpu-allow marker', text))
+
+    out: List[Violation] = []
+    for path in sorted(reporters):
+        violations = reporters[path].violations
+        violations.sort(key=lambda v: (v.line, v.col, v.code))
+        out.extend(violations)
+    return out
+
+
 def lint_paths(paths: Iterable[str],
                root: Optional[str] = None) -> List[Violation]:
-    """Lint every .py file under the given files/directories."""
+    """Lint every .py file under the given files/directories with the
+    whole-program pipeline."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -812,7 +1080,11 @@ def lint_paths(paths: Iterable[str],
                              if f.endswith('.py'))
         elif p.endswith('.py'):
             files.append(p)
-    out: List[Violation] = []
+    sources: Dict[str, str] = {}
     for f in files:
-        out.extend(lint_file(f, root=root))
-    return out
+        rel = (os.path.relpath(f, root) if root else f).replace(os.sep, '/')
+        if rel in sources:
+            continue
+        with open(f, 'r', encoding='utf-8') as handle:
+            sources[rel] = handle.read()
+    return lint_sources(sources)

@@ -125,6 +125,12 @@ class CheckpointManager:
         self._state_provider: Optional[Callable[[], Tuple[int, Any]]] = None
         self._prev_handlers: Dict[int, Any] = {}
         self._in_emergency_save = False
+        # Guards the save-status fields below, which the writer thread
+        # mutates while the step loop reads them.  RLock, not Lock: the
+        # SIGTERM emergency-save path runs on the main thread and must
+        # not self-deadlock if the signal lands while the main thread
+        # already holds it.
+        self._status_lock = threading.RLock()
         self._last_saved_step: Optional[int] = None
         self._consecutive_failures = 0
         self._last_write_error: Optional[BaseException] = None
@@ -137,8 +143,9 @@ class CheckpointManager:
     def should_save(self, step: int) -> bool:
         if self.save_interval_steps <= 0:
             return False
-        if step == self._last_saved_step:
-            return False
+        with self._status_lock:
+            if step == self._last_saved_step:
+                return False
         return step % self.save_interval_steps == 0
 
     # -- save --------------------------------------------------------------
@@ -159,13 +166,15 @@ class CheckpointManager:
         silently eating every checkpoint of a long run, ``save`` itself
         fails once ``max_consecutive_failures`` async saves in a row
         have failed."""
-        if (not blocking and self._consecutive_failures
-                >= self.max_consecutive_failures):
+        with self._status_lock:
+            failures = self._consecutive_failures
+            last_error = self._last_write_error
+        if not blocking and failures >= self.max_consecutive_failures:
             raise RuntimeError(
-                f'{self._consecutive_failures} consecutive checkpoint '
+                f'{failures} consecutive checkpoint '
                 f'saves under {self.directory} failed; refusing to '
-                f'queue more (last error: {self._last_write_error!r})'
-            ) from self._last_write_error
+                f'queue more (last error: {last_error!r})'
+            ) from last_error
         metrics = _metrics()
         kind = kind or ('blocking' if blocking else 'interval')
         start = time.perf_counter()
@@ -173,7 +182,8 @@ class CheckpointManager:
         snapshot_s = time.perf_counter() - start
         metrics.CKPT_SAVE_SECONDS.labels(phase='snapshot').observe(
             snapshot_s)
-        self._last_saved_step = step
+        with self._status_lock:
+            self._last_saved_step = step
         if blocking:
             self._write_and_commit(step, host_tree, metadata, kind)
             metrics.CKPT_SAVE_SECONDS.labels(phase='blocking').observe(
@@ -198,14 +208,16 @@ class CheckpointManager:
         try:
             self._do_write_and_commit(step, host_tree, metadata, kind)
         except BaseException as e:
-            self._consecutive_failures += 1
-            self._last_write_error = e
-            if self._last_saved_step == step:
-                # The step was NOT durably saved; let a retry through
-                # should_save and keep latest-save bookkeeping honest.
-                self._last_saved_step = None
+            with self._status_lock:
+                self._consecutive_failures += 1
+                self._last_write_error = e
+                if self._last_saved_step == step:
+                    # The step was NOT durably saved; let a retry through
+                    # should_save and keep latest-save bookkeeping honest.
+                    self._last_saved_step = None
             raise
-        self._consecutive_failures = 0
+        with self._status_lock:
+            self._consecutive_failures = 0
 
     def _do_write_and_commit(self, step: int, host_tree,
                              metadata: Optional[Dict[str, Any]],
@@ -213,7 +225,11 @@ class CheckpointManager:
         metrics = _metrics()
         start = time.perf_counter()
         with self._save_lock:
-            self._save_lock_owner = threading.current_thread()
+            # Written only under _save_lock; the one cross-thread reader
+            # is the SIGTERM emergency-save path, which deliberately
+            # reads it lock-free (taking a lock in a signal handler
+            # could self-deadlock) and tolerates a stale value.
+            self._save_lock_owner = threading.current_thread()  # skytpu-allow: SKY501
             try:
                 # Stale-staging cleanup happens inside save_pytree, on
                 # process 0 only, before the pre-write barrier — never

@@ -74,6 +74,13 @@ class AsyncCheckpointWriter:
             return
         self._queue.put(None)
         thread.join(timeout=60)
+        if thread.is_alive():
+            # A wedged write (dead NFS/bucket mount) can outlive the join
+            # timeout; the daemon thread dies with the process, but make
+            # the leak visible instead of silently dropping the handle.
+            logger.warning(
+                'ckpt-writer thread still alive after 60s close() join; '
+                f'{self._queue.unfinished_tasks} job(s) still in flight')
         self._thread = None
 
     # -- writer side -------------------------------------------------------

@@ -108,7 +108,11 @@ class AsyncCopyEngine:
         raised here — pop them via ``pop_errors`` so their unwind
         callbacks run on the scheduler thread (``KVTier.drain`` does
         both and re-raises)."""
-        self._queue.join()
+        # Reachable from ContinuousBatcher.step via KVTier.wait_pending,
+        # but the stall is the design: the queue is bounded and drains at
+        # DMA speed, so this is backpressure parking the scheduler tick
+        # behind in-flight copies, not an unbounded block.
+        self._queue.join()  # skytpu-allow: SKY504
 
     def pop_errors(self) -> List[Tuple[BaseException,
                                        Optional[Callable[[], None]]]]:

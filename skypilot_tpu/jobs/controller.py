@@ -389,6 +389,7 @@ class Scheduler:
         self.table = table or JobsTable()
         self.poll_seconds = poll_seconds
         self._threads: Dict[int, threading.Thread] = {}
+        self._reconcile_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     def submit(self, name: Optional[str], task_config: dict,
@@ -439,23 +440,26 @@ class Scheduler:
     def run_forever(self, interval: float = 2.0,
                     pool_reconcile_every: float = 30.0) -> None:
         last_reconcile = 0.0
-        reconcile_thread: Optional[threading.Thread] = None
         while not self._stop.is_set():
             self.step()
             # Reconcile runs off-thread: worker provisioning takes minutes
             # and must not starve job scheduling.  One pass at a time.
             if (time.time() - last_reconcile > pool_reconcile_every and
-                    (reconcile_thread is None or
-                     not reconcile_thread.is_alive())):
+                    (self._reconcile_thread is None or
+                     not self._reconcile_thread.is_alive())):
                 last_reconcile = time.time()
-                reconcile_thread = threading.Thread(
+                self._reconcile_thread = threading.Thread(
                     target=self._reconcile_pools, daemon=True,
                     name='pool-reconcile')
-                reconcile_thread.start()
+                self._reconcile_thread.start()
             time.sleep(interval)
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        if self._reconcile_thread is not None:
+            self._reconcile_thread.join(timeout)
+        for thread in list(self._threads.values()):
+            thread.join(timeout)
 
     def wait_job(self, job_id: int, timeout: float = 300.0
                  ) -> ManagedJobStatus:

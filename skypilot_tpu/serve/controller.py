@@ -118,8 +118,11 @@ class ServeController:
                 logger.exception(f'Controller step failed: {e}')
             self._stop.wait(self.probe_interval)
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        # Wait for in-flight replica launch/teardown threads so a stopped
+        # controller leaves nothing provisioning behind its back.
+        self.manager.join(timeout)
 
     def update_version(self, version: int, spec: ServiceSpec,
                        task: task_lib.Task) -> None:
@@ -180,11 +183,14 @@ class ServeControllerDaemon:
         thread.start()
         return controller
 
-    def remove_controller(self, service_name: str) -> None:
+    def remove_controller(self, service_name: str,
+                          timeout: float = 5.0) -> None:
         controller = self.controllers.pop(service_name, None)
         if controller is not None:
             controller.stop()
-        self._threads.pop(service_name, None)
+        thread = self._threads.pop(service_name, None)
+        if thread is not None:
+            thread.join(timeout)
 
     def step(self) -> None:
         for record in serve_state.get_services():
@@ -205,7 +211,9 @@ class ServeControllerDaemon:
             self.step()
             self._stop.wait(interval)
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         for controller in self.controllers.values():
             controller.stop()
+        for thread in list(self._threads.values()):
+            thread.join(timeout)

@@ -412,7 +412,10 @@ class SkyServeLoadBalancer:
         from aiohttp import web
         app = web.Application()
         app.router.add_route('*', '/{tail:.*}', self._handle)
-        self._runner = web.AppRunner(app)
+        # _runner is only dereferenced from this loop's thread: stop()'s
+        # _cleanup coroutine runs here too, via run_coroutine_threadsafe,
+        # so the event loop itself orders the accesses.
+        self._runner = web.AppRunner(app)  # skytpu-allow: SKY501
         await self._runner.setup()
         site = web.TCPSite(self._runner, '0.0.0.0', self.port)
         await site.start()
@@ -421,8 +424,12 @@ class SkyServeLoadBalancer:
 
     def start(self) -> None:
         """Run the LB event loop in a background thread."""
+        # Create the loop here, on the caller's thread, so the write to
+        # _loop happens-before Thread.start and stop() can never observe
+        # a half-initialised value.
+        self._loop = asyncio.new_event_loop()
+
         def _run():
-            self._loop = asyncio.new_event_loop()
             asyncio.set_event_loop(self._loop)
             self._loop.run_until_complete(self._serve())
             self._loop.run_forever()

@@ -52,6 +52,10 @@ class ReplicaManager:
         self.spot_placer = spot_placer_lib.SpotPlacer.make(
             spec.spot_placer, task) if self._spot_requested(task, spec) \
             else None
+        # scale_up/scale_down run on the controller thread while join()
+        # may be called from the owning (main) thread; guard the thread
+        # registries with a lock.
+        self._threads_lock = threading.Lock()
         self._launch_threads: Dict[int, threading.Thread] = {}
         self._down_threads: Dict[int, threading.Thread] = {}
 
@@ -88,7 +92,8 @@ class ReplicaManager:
             target=self._launch_replica,
             args=(replica_id, cluster_name, use_spot, location),
             daemon=True, name=f'serve-launch-{cluster_name}')
-        self._launch_threads[replica_id] = thread
+        with self._threads_lock:
+            self._launch_threads[replica_id] = thread
         thread.start()
         return replica_id
 
@@ -100,7 +105,8 @@ class ReplicaManager:
             target=self._terminate_replica, args=(replica_id, purge),
             daemon=True,
             name=f'serve-down-{self.service_name}-{replica_id}')
-        self._down_threads[replica_id] = thread
+        with self._threads_lock:
+            self._down_threads[replica_id] = thread
         thread.start()
 
     def terminate_all(self) -> None:
@@ -110,8 +116,10 @@ class ReplicaManager:
         self.join()
 
     def join(self, timeout: Optional[float] = None) -> None:
-        for thread in (list(self._launch_threads.values()) +
-                       list(self._down_threads.values())):
+        with self._threads_lock:
+            threads = (list(self._launch_threads.values()) +
+                       list(self._down_threads.values()))
+        for thread in threads:
             thread.join(timeout)
 
     # --- replica lifecycle internals ---
@@ -291,7 +299,8 @@ class ReplicaManager:
             target=self._terminate_replica, args=(replica_id, True),
             daemon=True,
             name=f'serve-reap-{self.service_name}-{replica_id}')
-        self._down_threads[replica_id] = thread
+        with self._threads_lock:
+            self._down_threads[replica_id] = thread
         thread.start()
 
     def ready_urls(self) -> List[str]:

@@ -169,8 +169,12 @@ class RequestWorkerPool:
             metrics_utils.QUEUED_REQUESTS.dec()
             execute_request(request_id)
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        # Workers poll with a 0.2s timeout, so they notice the stop flag
+        # promptly; join to make shutdown deterministic.
+        for t in self._threads:
+            t.join(timeout)
 
 
 def schedule_request(name: str, payload: Dict[str, Any],

@@ -209,3 +209,7 @@ class GrpoTrainer:
                 'reward_mean': float(np.mean(rewards)),
                 'reward_std': float(np.std(rewards)),
                 'step': self.trainer.step}
+
+    def close(self) -> None:
+        """Release checkpoint writers held by the wrapped Trainer."""
+        self.trainer.close()

@@ -283,6 +283,16 @@ class Trainer:
         for manager in managers:
             manager.wait_until_finished()
 
+    def close(self) -> None:
+        """Shut down every cached CheckpointManager: drains and joins
+        each async writer thread and uninstalls signal handlers.  Safe
+        to call more than once."""
+        managers, self._ckpt_managers = list(
+            self._ckpt_managers.values()), {}
+        self._auto_ckpt = None
+        for manager in managers:
+            manager.close()
+
     def _install_restored(self, step: int, restored) -> None:
         # Host arrays from the sharded format go back to device with the
         # live tree's shardings; Orbax-fallback restores already return

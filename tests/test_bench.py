@@ -157,3 +157,24 @@ def test_8b_extrapolation_reports_check_and_convention():
     assert 'extrapolation_check_pct' in out
     assert out['mfu_pct'] <= out['mfu_all_params_pct']
     assert 'matmul params only' in out['method']
+
+
+def test_audit_summary_carries_lint_and_graph_fields(monkeypatch):
+    # The AUDIT_SUMMARY line bench.py prints is json.dumps of
+    # quick_summary(); the static-analysis roll-up fields must be there
+    # and JSON-serializable.  Stub the decode trace (it is exercised by
+    # test_static_analysis) so this stays cheap.
+    import json
+
+    from skypilot_tpu.analysis import audit as audit_lib
+    from skypilot_tpu.analysis import linter
+
+    monkeypatch.setattr(
+        audit_lib, 'audit_generator_decode',
+        lambda: {'compiles': 2, 'buckets': [128, 256],
+                 'checks': [{'name': 'compile_per_bucket', 'status': 'ok'},
+                            {'name': 'donation', 'status': 'ok'}]})
+    line = 'AUDIT_SUMMARY ' + json.dumps(audit_lib.quick_summary())
+    summary = json.loads(line.split(' ', 1)[1])
+    assert summary['lint_rules'] == len(linter.RULES)
+    assert summary['graph_thread_entries'] > 0
