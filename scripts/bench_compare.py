@@ -56,6 +56,13 @@ FIELDS: Tuple[Tuple[str, bool], ...] = (
     ('tier.spill_gbps', True),
     ('tier.prefetch_gbps', True),
     ('tier.prefetch_late_rate', False),
+    # Disaggregated prefill/decode serving: the disagg arm's p99 TTFT
+    # must not rise and the steady-session TPOT guard ratio must not
+    # drift up.  Compared only when BOTH artifacts carry a disagg
+    # block at the same pool split with greedy parity intact
+    # (_disagg_comparable) — a resized pool is a different experiment.
+    ('disagg.ttft_p99_disagg_ms', False),
+    ('disagg.decode_tpot_p99_ratio', False),
     # SLO burn on the affinity serve arm: the error budget must not
     # start draining faster.
     ('serve.slo_burn_fast', False),
@@ -109,6 +116,26 @@ def _tier_comparable(old: Dict[str, Any], new: Dict[str, Any]
             or abs(ra - rb) > 0.5):
         # Different eviction pressure is a different experiment.
         return (f'working_set_x_budget changed ({ra} -> {rb})')
+    return None
+
+
+def _disagg_comparable(old: Dict[str, Any], new: Dict[str, Any]
+                       ) -> Optional[str]:
+    """None when disagg fields may be compared, else the skip reason."""
+    a, b = old.get('disagg'), new.get('disagg')
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return 'disagg block missing on one side'
+    if 'error' in a or 'error' in b:
+        return 'disagg bench errored on one side'
+    if not (a.get('parity_ok', False) and b.get('parity_ok', False)):
+        # A parity break is a correctness bug, not a perf delta; the
+        # bench itself asserts it, so this is belt-and-braces.
+        return 'greedy parity not ok on one side'
+    split_a = (a.get('prefill_replicas'), a.get('decode_replicas'))
+    split_b = (b.get('prefill_replicas'), b.get('decode_replicas'))
+    if split_a != split_b:
+        # A resized pool is a different experiment, not a regression.
+        return f'pool split changed ({split_a} -> {split_b})'
     return None
 
 
@@ -166,6 +193,7 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
     mesh_skip = _mesh_comparable(old, new)
     tier_skip = _tier_comparable(old, new)
     acct_skip = _acct_comparable(old, new)
+    disagg_skip = _disagg_comparable(old, new)
     for dotted, higher_better in FIELDS:
         if dotted.startswith('mesh.') and mesh_skip is not None:
             lines.append(f'  {dotted}: skipped ({mesh_skip})')
@@ -175,6 +203,9 @@ def compare(old: Dict[str, Any], new: Dict[str, Any],
             continue
         if dotted.startswith('acct.') and acct_skip is not None:
             lines.append(f'  {dotted}: skipped ({acct_skip})')
+            continue
+        if dotted.startswith('disagg.') and disagg_skip is not None:
+            lines.append(f'  {dotted}: skipped ({disagg_skip})')
             continue
         a, b = _lookup(old, dotted), _lookup(new, dotted)
         if a is None or b is None or a == 0:

@@ -1116,6 +1116,140 @@ def audit_kv_tier() -> Dict[str, Any]:
             'tier': stats}
 
 
+def audit_disagg() -> Dict[str, Any]:
+    """The prefill→decode handoff's device contract (serve/disagg.py +
+    the kv_tier export/ingest path): for BOTH KV layouts (model-dtype
+    and int8+scale), a full handoff — prefill on one batcher, export
+    the prompt's blocks, frame/unframe the SHA-256 image, adopt on a
+    second batcher and decode — compiles the export gather and the
+    ingest scatter at most ONCE each (the id vector is traced at the
+    fixed ids_per_node length), the traced copy graphs are
+    callback-free and f64-free, the scatter's arena operand is donated
+    (no shadow arena per staged splice), greedy output is bit-exact
+    against a single-pool run, and BOTH pools' refcount conservation
+    balances after release-after-export."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from skypilot_tpu.infer.serving import ContinuousBatcher
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import disagg as disagg_lib
+
+    config = _tiny_config()
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    checks: List[Dict[str, str]] = []
+    per_layout: Dict[str, Dict[str, Any]] = {}
+
+    for layout, kv_dtype in (('model', None), ('int8', 'int8')):
+        def mk():
+            return ContinuousBatcher(
+                params, config,
+                _tiny_gen_config(prefix_cache_mb=0.5, prefix_block=8,
+                                 prompt_buckets=[32], host_tier_mb=4.0,
+                                 kv_cache_dtype=kv_dtype),
+                decode_chunk=8)
+        rng = np.random.default_rng(7)
+        prompt = [int(t) for t in rng.integers(1, config.vocab_size,
+                                               size=24)]
+        # Single-pool reference decode.
+        ref = mk()
+        rid = ref.submit(prompt, max_new_tokens=8)
+        ref.run_until_idle()
+        want = list(ref.result(rid))
+        ref.close()
+        # Prefill side: admit, fill blocks, export, release.
+        pre = mk()
+        rid = pre.submit(prompt, max_new_tokens=1)
+        pre.run_until_idle()
+        pre.result(rid)
+        res = pre.export_handoff(prompt)
+        exported = bool(res and res['payload'])
+        checks.append(_check(
+            f'{layout}_export_nonempty', 'ok' if exported else 'fail',
+            f"{res['tokens'] if res else 0} tokens exported of "
+            f'{len(prompt)} prompt tokens (whole trie nodes only)'))
+        pre.pool.check_invariant()
+        pre_balanced = (pre.pool.free_blocks() + pre.pool.live_blocks()
+                        == pre.pool.n_blocks - 1)
+        checks.append(_check(
+            f'{layout}_prefill_pool_released',
+            'ok' if pre_balanced else 'fail',
+            f'prefill pool free {pre.pool.free_blocks()} + live '
+            f'{pre.pool.live_blocks()} == total {pre.pool.n_blocks} - '
+            f'garbage after release-after-export'))
+        gather_compiles = pre._tier._gather._cache_size()
+        # Decode side: frame -> hash-check -> adopt -> prefetch ->
+        # splice -> decode, then diff against the reference.
+        got = []
+        dec = mk()
+        if exported:
+            data = disagg_lib.encode_kv_image(
+                prompt[:res['tokens']], 8, res['payload'])
+            img = disagg_lib.decode_kv_image(data)
+            dec.ingest_handoff(prompt, img.payload)
+            dec.tier_flush()
+            rid = dec.submit(prompt, max_new_tokens=8)
+            dec.run_until_idle()
+            got = list(dec.result(rid))
+            dec.tier_flush()
+        checks.append(_check(
+            f'{layout}_greedy_parity', 'ok' if got == want else 'fail',
+            f'handoff decode emitted {got} vs single-pool {want}'))
+        tier_stats = dec._tier.stats()
+        checks.append(_check(
+            f'{layout}_ingest_exercised',
+            'ok' if (tier_stats['adopted'] > 0
+                     and tier_stats['prefetches'] > 0) else 'fail',
+            f"{tier_stats['adopted']} nodes adopted, "
+            f"{tier_stats['prefetches']} prefetches (the image must "
+            f'ride the ordinary tier staging path)'))
+        scatter_compiles = dec._tier._scatter._cache_size()
+        checks.append(_check(
+            f'{layout}_copy_compile_budget',
+            'ok' if (gather_compiles <= 1 and scatter_compiles <= 1)
+            else 'fail',
+            f'{gather_compiles} export-gather / {scatter_compiles} '
+            f'ingest-scatter compiles (budget 1 each per layout)'))
+        dec.pool.check_invariant()
+        dec_balanced = (dec.pool.free_blocks() + dec.pool.live_blocks()
+                        == dec.pool.n_blocks - 1)
+        checks.append(_check(
+            f'{layout}_decode_pool_invariant',
+            'ok' if dec_balanced else 'fail',
+            f'decode pool free {dec.pool.free_blocks()} + live '
+            f'{dec.pool.live_blocks()} == total {dec.pool.n_blocks} - '
+            f'garbage after the spliced decode'))
+        # Graph hygiene + donation on the ingest scatter.
+        tier = dec._tier
+        ids = jnp.zeros((tier.ids_per_node,), jnp.int32)
+        arena = dec.pool.arena
+        staged = {k: jnp.zeros((a.shape[0], tier.ids_per_node)
+                               + a.shape[2:], a.dtype)
+                  for k, a in arena.items()}
+        for label, jaxpr in (
+                ('export_gather',
+                 jax.make_jaxpr(tier._gather_impl)(arena, ids)),
+                ('ingest_scatter',
+                 jax.make_jaxpr(tier._scatter_impl)(
+                     arena, ids, staged))):
+            for c in _jaxpr_dtype_and_callback_checks(jaxpr):
+                c['name'] = f"{layout}_{label}_{c['name']}"
+                checks.append(c)
+        lowered = tier._scatter.lower(arena, ids, staged).as_text()
+        dc = _donation_check(lowered, 'ingest scatter arena')
+        dc['name'] = f"{layout}_scatter_{dc['name']}"
+        checks.append(dc)
+        per_layout[layout] = {
+            'gather_compiles': gather_compiles,
+            'scatter_compiles': scatter_compiles,
+            'exported_tokens': res['tokens'] if res else 0,
+            'image_bytes': len(data) if exported else 0,
+        }
+        pre.close()
+        dec.close()
+    return {'entry': 'disagg', 'checks': checks, 'layouts': per_layout}
+
+
 REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'generator_decode': audit_generator_decode,
     'batcher_decode': audit_batcher_decode,
@@ -1125,6 +1259,7 @@ REGISTRY: Dict[str, Callable[[], Dict[str, Any]]] = {
     'spec_decode': audit_spec_decode,
     'fused_step': audit_fused_step,
     'kv_tier': audit_kv_tier,
+    'disagg': audit_disagg,
     'mesh_decode': audit_mesh_decode,
     'trainer_step': audit_trainer_step,
     'ckpt_reshard': audit_ckpt_reshard,

@@ -151,6 +151,7 @@ DATA_PLANE_MODULES = (
     'infer/spec_decode.py',
     'infer/fuse.py',
     'infer/kv_tier.py',
+    'serve/disagg.py',
 )
 
 # SKY202's sanctioned home: the bounded-backoff helper is ALLOWED to

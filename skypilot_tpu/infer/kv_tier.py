@@ -271,6 +271,7 @@ class KVTier:
         self.device_hits = 0
         self.misses = 0
         self.prefetch_late = 0
+        self.adopted = 0
         self._publish()
 
     # -- introspection ---------------------------------------------------
@@ -455,6 +456,68 @@ class KVTier:
             raise AssertionError(
                 'copy engine rejected a prefetch after can_accept()')
 
+    # -- handoff (disaggregated serving) ---------------------------------
+    def export_gather(self, ids: Sequence[int]):
+        """Gather one trie node's arena blocks for a prefill→decode
+        handoff image.  Reuses the jitted spill gather (same traced id
+        length), so the export path adds ZERO compiles on top of the
+        spill path — ``audit_disagg`` pins this.  The result is a
+        standalone device array; the caller host-fetches it through the
+        engine's counted sync (``serve/disagg.py`` frames the bytes)."""
+        ids = list(ids)
+        if len(ids) != self.ids_per_node:
+            raise ValueError(
+                f'export_gather needs exactly {self.ids_per_node} '
+                f'block ids (one trie node), got {len(ids)}')
+        return self._gather(self.pool.arena,
+                            jnp.asarray(ids, jnp.int32))
+
+    def has_entry(self, key: Tuple[int, ...]) -> bool:
+        return tuple(key) in self._entries
+
+    def adopt_node(self, key: Tuple[int, ...], bufs: Dict[str, Any]
+                   ) -> bool:
+        """Place one handed-off node's bytes straight into host rows —
+        the ingest half of a prefill→decode handoff.  ``bufs`` uses the
+        gather layout (``(dim0, ids_per_node, ...)`` per component,
+        host dtypes matching the arena).  The entry is born 'host'
+        (resident, prefetchable): device staging then rides the
+        ordinary prefetch machinery (alloc_for_prefetch → scatter →
+        splice), which is what keeps handed-off output bit-exact vs the
+        single-pool path.  False = duplicate key or no host capacity
+        (the decode replica falls back to recomputing the prefix)."""
+        key = tuple(int(t) for t in key)
+        if self._closed or not key or key in self._entries:
+            return False
+        missing = [c for c in self._host if c not in bufs]
+        if missing:
+            raise ValueError(
+                f'adopt_node missing components {missing!r}')
+        host_ids = self._take_host_rows()
+        if host_ids is None:
+            return False
+        for comp, buf in bufs.items():
+            host = self._host[comp]
+            # Host-side numpy view shaping only — the bytes already
+            # crossed device->host on the EXPORTING replica's counted
+            # fetch.
+            arr = np.ascontiguousarray(buf)
+            if arr.shape[1] != self.ids_per_node:
+                self._host_free.extend(host_ids)
+                raise ValueError(
+                    f'adopt_node component {comp!r} has '
+                    f'{arr.shape[1]} blocks, expected '
+                    f'{self.ids_per_node}')
+            for i, hid in enumerate(host_ids):
+                host[hid] = arr[:, i]
+        entry = _HostEntry(key, host_ids)
+        entry.state = 'host'
+        self._entries[key] = entry
+        self._touch(entry)
+        self.adopted += 1
+        self._publish()
+        return True
+
     # -- drain (scheduler thread) ----------------------------------------
     def drain(self, cache):
         """Apply every completed copy: finalize spills (entry becomes
@@ -571,6 +634,7 @@ class KVTier:
             'misses': self.misses,
             'lookups': lookups,
             'prefetch_late': self.prefetch_late,
+            'adopted': self.adopted,
         }
 
 

@@ -466,13 +466,43 @@ class PrefixCache:
                 victim = n
         return victim
 
-    def _drop(self, victim: _Node) -> None:
+    def forget(self, tokens: Sequence[int], *,
+               spill: bool = False) -> int:
+        """Drop the deepest droppable suffix of the node chain covering
+        ``tokens`` — the disaggregated handoff's release-after-export:
+        once a prefill replica shipped a prefix's bytes to the decode
+        pool it must NOT keep (or spill) a copy, so the nodes leave the
+        trie with ``spill=False`` and their pool blocks free
+        immediately.  Only unreferenced 'device' leaves drop (walking
+        leaf-ward, stopping at the first pinned/interior node — same
+        safety rules as LRU eviction).  Returns the node count dropped.
+        """
+        toks = tuple(int(t) for t in tokens)
+        node = self._root
+        chain: List[_Node] = []
+        for b in range(len(toks) // self.block):
+            child = node.children.get(
+                toks[b * self.block:(b + 1) * self.block])
+            if child is None:
+                break
+            chain.append(child)
+            node = child
+        dropped = 0
+        for n in reversed(chain):
+            if n.children or n.refs != 0 or n.tier != 'device':
+                break
+            self._drop(n, spill=spill)
+            dropped += 1
+        return dropped
+
+    def _drop(self, victim: _Node, spill: bool = True) -> None:
         del victim.parent.children[victim.key]
         self.bytes -= victim.nbytes
         self.node_count -= 1
         self.evictions += 1
         if self.pool is not None:
-            if self.tier is not None and victim.tier == 'device':
+            if spill and self.tier is not None \
+                    and victim.tier == 'device':
                 # Host-tier spill: the tier dispatches a gather over
                 # the victim's blocks BEFORE they free (the gather
                 # output owns the bytes), so the release below is

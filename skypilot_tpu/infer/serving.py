@@ -59,11 +59,14 @@ from skypilot_tpu.infer import spec_decode as spec_decode_lib
 from skypilot_tpu.infer import tp as tp_lib
 from skypilot_tpu.infer.engine import GeneratorConfig
 from skypilot_tpu.models import llama
+from skypilot_tpu import sky_logging
 from skypilot_tpu.telemetry import accounting
 from skypilot_tpu.telemetry import metrics as telemetry_metrics
 from skypilot_tpu.telemetry import spans as spans_lib
 from skypilot_tpu.telemetry import steplog
 from skypilot_tpu.telemetry import trace as trace_lib
+
+logger = sky_logging.init_logger(__name__)
 
 
 @dataclasses.dataclass
@@ -903,9 +906,17 @@ class ContinuousBatcher:
         another replica: re-submit `prompt + out` as the new prompt
         with `max_new_tokens - len(out)` budget and greedy decode
         continues bit-exact at the first token this replica never
-        produced."""
+        produced.
+
+        Tier state is folded in rather than dropped: a request parked
+        on an in-flight prefetch (or whose prefix is mid-spill) first
+        settles the copy engine so the exported `tier` block reports
+        the FINAL device/host token coverage — a failover during an
+        in-flight spill loses nothing, and a copy-engine fault unwinds
+        inside this barrier (logged) instead of poisoning a later
+        drain and aborting `drain_sessions` halfway through."""
         req = self._requests[rid]
-        return {
+        spec = {
             'prompt': list(req.prompt),
             'out': list(req.out),
             'max_new_tokens': req.max_new_tokens,
@@ -913,6 +924,31 @@ class ContinuousBatcher:
             'top_p': req.top_p,
             'done': req.done,
         }
+        if self._tier is not None and not req.done:
+            if self._tier.in_flight() or self._tier_hints:
+                try:
+                    self.tier_flush()
+                except Exception as e:  # noqa: BLE001 — export must survive copy faults
+                    # The failed copy already unwound (entry forgotten
+                    # or loading nodes detached + blocks released);
+                    # the spec below reflects the post-unwind truth.
+                    logger.warning(
+                        f'export_session: tier fault settled during '
+                        f'export barrier: {e!r}')
+            parked = any(p is req for p, _ in self._tier_parked)
+            m = self._prefix.match(req.prompt)
+            try:
+                host = self._tier.host_continuation(
+                    req.prompt, m.tokens)
+                spec['tier'] = {
+                    'parked': parked,
+                    'device_tokens': m.tokens,
+                    'host_tokens': (len(host)
+                                    * self._tier.tokens_per_node),
+                }
+            finally:
+                m.release()
+        return spec
 
     def drain_sessions(self) -> List[Dict[str, Any]]:
         """Preemption-notice handoff: between decode chunks, export
@@ -1109,6 +1145,91 @@ class ContinuousBatcher:
             self._span('admission.tier_park', now, now, req=req,
                        blocks=len(nodes) * self._prefix._ids_per_node)
         return True
+
+    # ---- disaggregated prefill/decode handoff (serve/disagg.py) ----------
+    def export_handoff(self, prompt: Sequence[int], *,
+                       release: bool = True,
+                       trace_id: Optional[str] = None
+                       ) -> Optional[Dict[str, Any]]:
+        """Prefill side of a prefill→decode handoff: snapshot the
+        prompt's device-resident prefix blocks as host buffers, one
+        dict of per-component arrays per trie node (the tier's gather
+        layout — ``serve/disagg.py`` frames them into the transferable
+        image).  ``release=True`` then drops the exported nodes WITHOUT
+        spilling (``PrefixCache.forget``): the bytes now live on the
+        decode replica, so keeping a copy would double the fleet's KV
+        footprint and the pool blocks free immediately for the next
+        cold prompt.  Returns None when the prompt has no whole-block
+        device prefix to ship (the scheduler falls back to single-pool
+        serving); raw host bytes otherwise — the caller owns framing,
+        hashing and transport."""
+        if self._tier is None or self._prefix is None:
+            return None
+        toks = [int(t) for t in prompt]
+        t0 = self._span_clock() if self._spans_on() else 0.0
+        m = self._prefix.match(toks)
+        try:
+            if not m.tokens or any(n.tier != 'device'
+                                   for n in m.nodes):
+                return None
+            nodes = list(m.nodes)
+            payload: List[Dict[str, Any]] = []
+            gathered = [self._tier.export_gather(n.data)
+                        for n in nodes]
+            comps = sorted(self.pool.arena)
+            # One counted sync for the whole image — same contract as
+            # a decode chunk's result fetch.
+            flat = self._fetch(*[g[c] for g in gathered
+                                 for c in comps])
+            for i in range(len(nodes)):
+                payload.append({
+                    c: flat[i * len(comps) + j]
+                    for j, c in enumerate(comps)})
+            covered = m.tokens
+        finally:
+            m.release()
+        if release:
+            self._prefix.forget(toks[:covered], spill=False)
+        if self._spans_on():
+            self._span('handoff.export', t0, self._span_clock(),
+                       trace_id=trace_id, tokens=covered,
+                       nodes=len(payload))
+        return {'tokens': covered, 'payload': payload}
+
+    def ingest_handoff(self, prompt: Sequence[int],
+                       payload: Sequence[Dict[str, Any]], *,
+                       trace_id: Optional[str] = None) -> int:
+        """Decode side of a handoff: adopt each node's bytes straight
+        into the host tier (``KVTier.adopt_node`` — no device work
+        here), then queue a prefetch hint so the ordinary tier
+        machinery stages the blocks (alloc_for_prefetch → scatter →
+        splice) exactly like a PR 15 prefetch.  Admission of the
+        request then takes the warm splice path, which is what keeps
+        greedy output bit-exact vs single-pool serving.  Returns the
+        node count adopted (already-resident nodes dedup; a full host
+        tier stops the chain — the suffix recomputes, still correct)."""
+        if self._tier is None:
+            return 0
+        toks = [int(t) for t in prompt]
+        span = self._prefix.block
+        t0 = self._span_clock() if self._spans_on() else 0.0
+        adopted = 0
+        for i, bufs in enumerate(payload):
+            key = tuple(toks[:(i + 1) * span])
+            if len(key) < (i + 1) * span:
+                break
+            if self._tier.has_entry(key):
+                adopted += 1
+                continue
+            if not self._tier.adopt_node(key, bufs):
+                break
+            adopted += 1
+        if adopted:
+            self.prefetch_hint(toks)
+        if self._spans_on():
+            self._span('handoff.ingest', t0, self._span_clock(),
+                       trace_id=trace_id, nodes=adopted)
+        return adopted
 
     # ---- pooled block accounting ----------------------------------------
     def _pool_cap(self, req: _Request) -> int:

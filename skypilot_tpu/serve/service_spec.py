@@ -49,6 +49,16 @@ class ServiceSpec:
     # depth and prefix-cache hit ratio, instead of raw QPS.
     target_p99_ttft_ms: Optional[float] = None
     target_queue_depth_per_replica: Optional[float] = None
+    # Disaggregated prefill/decode serving (serve/disagg.py): carve
+    # `prefill_replicas` replicas out of the fleet as a dedicated
+    # prefill pool; cold prompts of at least
+    # `disagg_cold_prompt_tokens` tokens route there and hand their KV
+    # blocks to the decode pool.  `target_p99_tpot_ms` is the decode
+    # pool's own SLO signal (per-token latency) for the role-aware
+    # autoscaler — TTFT burn scales prefill, TPOT/queue scales decode.
+    prefill_replicas: Optional[int] = None
+    disagg_cold_prompt_tokens: Optional[int] = None
+    target_p99_tpot_ms: Optional[float] = None
     upscale_delay_seconds: int = DEFAULT_UPSCALE_DELAY_SECONDS
     downscale_delay_seconds: int = DEFAULT_DOWNSCALE_DELAY_SECONDS
     base_ondemand_fallback_replicas: Optional[int] = None
@@ -89,6 +99,30 @@ class ServiceSpec:
             raise exceptions.InvalidServiceSpecError(
                 f'target_queue_depth_per_replica must be positive, got '
                 f'{self.target_queue_depth_per_replica}')
+        if self.prefill_replicas is not None:
+            if self.prefill_replicas < 1:
+                raise exceptions.InvalidServiceSpecError(
+                    f'prefill_replicas must be >= 1, got '
+                    f'{self.prefill_replicas}')
+            if self.prefill_replicas >= self.min_replicas:
+                raise exceptions.InvalidServiceSpecError(
+                    'prefill_replicas must leave at least one decode '
+                    f'replica: prefill={self.prefill_replicas}, '
+                    f'min_replicas={self.min_replicas}')
+        if self.disagg_cold_prompt_tokens is not None:
+            if self.prefill_replicas is None:
+                raise exceptions.InvalidServiceSpecError(
+                    'disagg_cold_prompt_tokens requires '
+                    'prefill_replicas (a prefill pool to route to)')
+            if self.disagg_cold_prompt_tokens < 1:
+                raise exceptions.InvalidServiceSpecError(
+                    f'disagg_cold_prompt_tokens must be >= 1, got '
+                    f'{self.disagg_cold_prompt_tokens}')
+        if self.target_p99_tpot_ms is not None and \
+                self.target_p99_tpot_ms <= 0:
+            raise exceptions.InvalidServiceSpecError(
+                f'target_p99_tpot_ms must be positive, got '
+                f'{self.target_p99_tpot_ms}')
         from skypilot_tpu.serve import load_balancing_policies as lb
         if self.load_balancing_policy is not None and \
                 self.load_balancing_policy not in lb.LB_POLICIES:
@@ -154,6 +188,14 @@ class ServiceSpec:
             dynamic_ondemand_fallback=policy.get(
                 'dynamic_ondemand_fallback'),
             spot_placer=policy.get('spot_placer'),
+            prefill_replicas=(int(policy['prefill_replicas'])
+                              if 'prefill_replicas' in policy else None),
+            disagg_cold_prompt_tokens=(
+                int(policy['disagg_cold_prompt_tokens'])
+                if 'disagg_cold_prompt_tokens' in policy else None),
+            target_p99_tpot_ms=(
+                float(policy['target_p99_tpot_ms'])
+                if 'target_p99_tpot_ms' in policy else None),
             load_balancing_policy=config.get('load_balancing_policy'),
             ports=int(ports) if ports is not None else None,
         )
@@ -173,7 +215,9 @@ class ServiceSpec:
                     'target_qps_per_replica', 'target_p99_ttft_ms',
                     'target_queue_depth_per_replica',
                     'base_ondemand_fallback_replicas',
-                    'dynamic_ondemand_fallback', 'spot_placer'):
+                    'dynamic_ondemand_fallback', 'spot_placer',
+                    'prefill_replicas', 'disagg_cold_prompt_tokens',
+                    'target_p99_tpot_ms'):
             val = getattr(self, key)
             if val is not None:
                 policy[key] = val

@@ -52,6 +52,13 @@ class TrafficConfig:
     num_sessions: int = 8
     num_heads: int = 4
     session_share: float = 0.75
+    # Session share INSIDE burst episodes (None = same as
+    # session_share).  A low value makes bursts singleton-heavy — the
+    # "burst of long cold prompts over steady decode sessions" regime
+    # the disaggregation bench needs.  Only the comparison threshold
+    # changes, never the draw sequence, so every existing seed keeps
+    # its exact trace when this is unset.
+    burst_session_share: Optional[float] = None
     head_tokens: int = 64
     # Heavy-tailed lengths (lognormal, clipped).
     tail_median: int = 12
@@ -78,6 +85,10 @@ class TrafficConfig:
         if not 0.0 <= self.session_share <= 1.0:
             raise ValueError(f'session_share must be in [0, 1], got '
                              f'{self.session_share}')
+        if self.burst_session_share is not None and \
+                not 0.0 <= self.burst_session_share <= 1.0:
+            raise ValueError(f'burst_session_share must be in [0, 1], '
+                             f'got {self.burst_session_share}')
         if self.head_tokens >= self.max_prompt_tokens:
             raise ValueError('head_tokens must leave room for a tail '
                              'under max_prompt_tokens')
@@ -145,6 +156,9 @@ def generate_trace(cfg: TrafficConfig) -> List[Arrival]:
 
     arrivals: List[Arrival] = []
     for start, end, rate in _burst_segments(cfg, rng):
+        share = cfg.session_share
+        if cfg.burst_session_share is not None and rate > cfg.base_rps:
+            share = cfg.burst_session_share
         t = start
         while True:
             t += float(rng.exponential(1.0 / rate))
@@ -152,7 +166,7 @@ def generate_trace(cfg: TrafficConfig) -> List[Arrival]:
                 break
             out = _lognormal_int(rng, cfg.out_median, cfg.out_sigma,
                                  cfg.min_out_tokens, cfg.max_out_tokens)
-            if rng.random_sample() < cfg.session_share:
+            if rng.random_sample() < share:
                 session = int(rng.randint(cfg.num_sessions))
                 head = session_head[session]
                 tail_len = _lognormal_int(

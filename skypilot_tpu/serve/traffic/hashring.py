@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-from typing import Iterator, List, Optional, Sequence, Union
+from typing import (Collection, Iterator, List, Optional, Sequence,
+                    Union)
 
 DEFAULT_VNODES = 64
 
@@ -103,16 +104,31 @@ class ConsistentHashRing:
             len(self._points)
         return self._owners[idx]
 
-    def prefetch_target(self, key_hash: int) -> Optional[str]:
+    def prefetch_target(self, key_hash: int,
+                        exclude: Optional[Collection[str]] = None
+                        ) -> Optional[str]:
         """The next distinct owner after the primary — where a
         bounded-load divert would send `key_hash`.  Routing warms this
         member's host KV tier (a best-effort prefetch hint) so a
         divert still lands on staged blocks instead of a cold prefill.
-        None on an empty ring or when the primary is the only member.
+
+        `exclude` removes members that must not receive the bytes —
+        disaggregated handoff passes the exporting replica itself plus
+        the whole prefill pool, so a KV image never boomerangs back to
+        its producer.  The walk terminates even when the exclusion set
+        covers the ring: `owners` yields each distinct member at most
+        once, so exhausting it returns None rather than spinning.
+
+        None on an empty ring, when the primary is the only member, or
+        when every non-primary owner is excluded.
         """
+        excluded = frozenset(exclude or ())
         walk = self.owners(key_hash)
-        next(walk, None)
-        return next(walk, None)
+        next(walk, None)  # skip the primary — it already has the key.
+        for owner in walk:
+            if owner not in excluded:
+                return owner
+        return None
 
     def owners(self, key_hash: int) -> Iterator[str]:
         """Distinct members in ring order starting at the primary —

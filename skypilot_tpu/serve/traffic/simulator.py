@@ -190,6 +190,26 @@ class SimConfig:
     doctor_cadence_s: Optional[float] = None
     doctor_thresholds: Optional[Dict[str, float]] = None
     postmortem_dir: Optional[str] = None
+    # Disaggregated prefill/decode pools (serve/disagg.py; 0 = off):
+    # the first `prefill_replicas` replicas form a dedicated prefill
+    # pool.  Cold prompts of at least `disagg_cold_prompt_tokens`
+    # tokens route there, prefill, then hand their KV blocks to the
+    # decode replica the handoff scheduler's hashring chose, as a
+    # SHA-256-verified host image; the decode replica adopts it into
+    # its host tier and stages it through the ordinary prefetch path.
+    # Transfer time is charged through the existing tier link model
+    # (export at tier_spill_gbps on the exporter's clock, transit at
+    # tier_prefetch_gbps before the image lands), so disagg runs stay
+    # replay-deterministic.  A handoff whose transfer exceeds
+    # `handoff_late_s` counts as late (the DOC203 doctor signal).
+    # Requires host_tier_mb; incompatible with chaos_cfg.
+    prefill_replicas: int = 0
+    disagg_cold_prompt_tokens: int = 64
+    handoff_late_s: float = 0.25
+    # KV cache layout for every replica (None = model dtype; 'int8' =
+    # quantized KV with per-token scales).  Handoff images ship either
+    # layout unchanged — the parity tests run both.
+    kv_cache_dtype: Optional[str] = None
     # prefix_affinity bounded-load factor (ignored by other policies).
     load_factor: float = 1.25
     model_seed: int = 0
@@ -236,6 +256,26 @@ class SimConfig:
             if getattr(self, field) <= 0:
                 raise ValueError(f'{field} must be positive, '
                                  f'got {getattr(self, field)}')
+        if self.prefill_replicas:
+            if self.prefill_replicas < 0:
+                raise ValueError(f'prefill_replicas must be >= 0, '
+                                 f'got {self.prefill_replicas}')
+            if self.prefill_replicas >= self.num_replicas:
+                raise ValueError(
+                    'prefill_replicas must leave at least one decode '
+                    f'replica: prefill={self.prefill_replicas}, '
+                    f'num_replicas={self.num_replicas}')
+            if not self.host_tier_mb:
+                raise ValueError(
+                    'disaggregation requires host_tier_mb: the KV '
+                    'handoff ships through the host tier on both ends')
+            if self.disagg_cold_prompt_tokens < 1:
+                raise ValueError(
+                    f'disagg_cold_prompt_tokens must be >= 1, got '
+                    f'{self.disagg_cold_prompt_tokens}')
+        if self.handoff_late_s <= 0:
+            raise ValueError(f'handoff_late_s must be positive, '
+                             f'got {self.handoff_late_s}')
 
 
 @dataclasses.dataclass
@@ -245,6 +285,10 @@ class _ReqRecord:
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
     out_len: int = 0
+    # Routed through the prefill pool (disaggregated serving): TPOT
+    # tail analysis excludes these — the acceptance bar is that the
+    # *steady decode* sessions stay flat while the cold burst lands.
+    cold: bool = False
 
 
 @dataclasses.dataclass
@@ -268,11 +312,20 @@ class _ReplicaSim:
 
     def __init__(self, replica_id: int, url: str, batcher,
                  cfg: SimConfig,
-                 span_buf: Optional[spans_lib.SpanBuffer] = None) -> None:
+                 span_buf: Optional[spans_lib.SpanBuffer] = None,
+                 role: str = 'decode') -> None:
         self.replica_id = replica_id
         self.url = url
         self.batcher = batcher
         self.cfg = cfg
+        # Disaggregated pool membership ('prefill' or 'decode'; every
+        # replica of a non-disagg fleet is 'decode').
+        self.role = role
+        # rids admitted for prefill-only service: their single decode
+        # token is a prefill-completion marker, never delivered; at
+        # completion the request's KV blocks export as a handoff image
+        # instead of finishing the session.
+        self.handoff_rids: Set[int] = set()
         # The batcher records its spans here on THIS replica's virtual
         # clock (fixed pid = replica_id + 1; pid 0 is the sim plane).
         self.span_buf = span_buf
@@ -476,7 +529,8 @@ class FleetSimulator:
             prefix_block=self.cfg.prefix_block,
             prefill_chunk=self.cfg.prefill_chunk,
             fuse_budget=self.cfg.fuse_budget,
-            host_tier_mb=self.cfg.host_tier_mb)
+            host_tier_mb=self.cfg.host_tier_mb,
+            kv_cache_dtype=self.cfg.kv_cache_dtype)
         if self.cfg.policy == 'prefix_affinity':
             self.policy: lb_policies.LoadBalancingPolicy = \
                 lb_policies.PrefixAffinityPolicy(
@@ -520,6 +574,11 @@ class FleetSimulator:
         self.dropped = 0
         self.scale_events: List[Any] = []
         self._report_ttfts: List[float] = []
+        # Role-split autoscaler feeds (disagg only): cold-prompt TTFTs
+        # are the prefill pool's signal, session TPOTs the decode
+        # pool's.
+        self._report_cold_ttfts: List[float] = []
+        self._report_tpots: List[float] = []
         # Session plane: the journal is the exactly-once source of
         # truth for delivered tokens; _sessions holds timing + fences.
         self.journal = failover_lib.SessionJournal()
@@ -531,6 +590,30 @@ class FleetSimulator:
         self.invariant_checks = 0
         self._failover_latencies: List[float] = []
         self.fault_log: List[Dict[str, Any]] = []
+        # Disaggregated prefill/decode pools (inert when
+        # prefill_replicas == 0).  Handoffs in transit are the sim's
+        # third plane: exported on the prefill replica's clock, they
+        # land on the decode replica once virtual time passes t_land.
+        self._disagg = self.cfg.prefill_replicas > 0
+        self._handoff_sched = None
+        self._pending_handoffs: List[Dict[str, Any]] = []
+        self._handoff_waits: List[float] = []
+        self.handoffs = 0
+        self.handoffs_late = 0
+        self.handoffs_failed = 0
+        self.handoff_export_bytes = 0
+        self.handoff_ingest_bytes = 0
+        self.cold_routed = 0
+        self.decode_routed = 0
+        if self._disagg:
+            if chaos_cfg is not None:
+                raise ValueError(
+                    'chaos_cfg with prefill_replicas is unsupported: '
+                    'handoff images in transit have no failover story '
+                    'yet (fault the single-pool config instead)')
+            from skypilot_tpu.serve import disagg as disagg_lib
+            self._disagg_lib = disagg_lib
+            self._handoff_sched = disagg_lib.HandoffScheduler()
         self._breaker: Optional[failover_lib.CircuitBreaker] = None
         self._pending_faults: List[FaultEvent] = []
         if chaos_cfg is not None:
@@ -546,11 +629,13 @@ class FleetSimulator:
                     jitter=0.0))
             self._pending_faults = sorted(chaos_cfg.events,
                                           key=lambda e: e.t)
-        for _ in range(self.cfg.num_replicas):
-            self.add_replica()
+        for i in range(self.cfg.num_replicas):
+            self.add_replica(role=('prefill'
+                                   if i < self.cfg.prefill_replicas
+                                   else 'decode'))
 
     # ---- fleet membership ------------------------------------------------
-    def add_replica(self) -> str:
+    def add_replica(self, role: str = 'decode') -> str:
         from skypilot_tpu.infer.serving import ContinuousBatcher
         rid = next(self._ids)
         url = f'replica-{rid}'
@@ -579,7 +664,8 @@ class FleetSimulator:
                                     ledger=ledger,
                                     profiler_clock=lambda: float(
                                         next(ticks)))
-        rep = _ReplicaSim(rid, url, batcher, self.cfg, span_buf=span_buf)
+        rep = _ReplicaSim(rid, url, batcher, self.cfg, span_buf=span_buf,
+                          role=role)
         cell.append(rep)
         rep.last_progress_t = self._now
         self.replicas.append(rep)
@@ -617,10 +703,20 @@ class FleetSimulator:
                 if not self._breaker.is_open(r.url)]
 
     def _sync_policy(self) -> None:
-        urls = [r.url for r in self._live()]
+        # The LB policy only ever routes the decode pool; the prefill
+        # pool is the handoff scheduler's concern (cold dispatch picks
+        # least-loaded prefill directly, landings follow the hashring).
+        urls = [r.url for r in self._live() if r.role != 'prefill']
         if self._breaker is not None:
             urls = self._breaker.routable(urls, self._now)
         self.policy.set_ready_replicas(urls)
+        if self._handoff_sched is not None:
+            self._handoff_sched.set_members(
+                {r.url: r.role for r in self._live()})
+            for role in ('prefill', 'decode'):
+                telemetry_metrics.SERVE_DISAGG_POOL_REPLICAS.labels(
+                    role=role).set(sum(1 for r in self._live()
+                                       if r.role == role))
 
     # ---- run loop --------------------------------------------------------
     def run(self, autoscaler=None) -> Dict[str, Any]:
@@ -655,6 +751,8 @@ class FleetSimulator:
                 while idx < len(arrivals) and arrivals[idx].t <= now:
                     self._dispatch(arrivals[idx], idx)
                     idx += 1
+                if self._pending_handoffs:
+                    self._land_handoffs(now)
                 for rep in list(self.replicas):
                     rep.advance(now, self._deliver, self._complete)
                 if self.chaos is not None:
@@ -683,6 +781,10 @@ class FleetSimulator:
             random.setstate(rng_state)
 
     def _settled(self) -> bool:
+        if self._pending_handoffs:
+            # A KV image in transit has an idle decode slot waiting on
+            # it: the fleet is quiet but the trace is not served.
+            return False
         if self.chaos is None:
             return not any(r.busy for r in self.replicas)
         # A partitioned zombie can stay busy after every session it
@@ -692,6 +794,14 @@ class FleetSimulator:
                    for sid in self._sessions)
 
     def _dispatch(self, arrival: Arrival, sid: int) -> None:
+        if self._disagg and \
+                len(arrival.prompt) >= self.cfg.disagg_cold_prompt_tokens:
+            rep = self._pick_prefill()
+            if rep is not None:
+                self._dispatch_prefill(rep, arrival, sid)
+                return
+        if self._disagg:
+            self.decode_routed += 1
         url = self.policy.select_replica({'prompt': arrival.prompt})
         if url is None:
             raise RuntimeError('No ready replicas to route to')
@@ -717,6 +827,168 @@ class FleetSimulator:
                            prompt_len=len(arrival.prompt)),
             rid=rid, tenant=arrival.tenant)
 
+    # ---- disaggregated prefill/decode handoff ----------------------------
+    def _pick_prefill(self) -> Optional[_ReplicaSim]:
+        """Least-loaded live prefill replica (ties break on url): the
+        prefill pool is small and uniform, so a direct least-queued
+        pick beats running a second LB policy for it."""
+        pool = [r for r in self._live() if r.role == 'prefill']
+        if not pool:
+            return None
+        return min(pool, key=lambda r: (r.batcher.num_queued
+                                        + r.batcher.num_active, r.url))
+
+    def _dispatch_prefill(self, rep: _ReplicaSim, arrival: Arrival,
+                          sid: int) -> None:
+        """Admit a cold prompt on the prefill pool.  The request runs
+        with max_new_tokens=1 — its lone decode token is a completion
+        marker, never committed — and the journal opens with the FULL
+        budget so the decode-side resubmission owes every token."""
+        self.cold_routed += 1
+        self._span_buf.record('prefill.admit', arrival.t, arrival.t,
+                              trace_id=_session_trace_id(sid),
+                              replica=rep.url,
+                              prompt_tokens=len(arrival.prompt))
+        rid = rep.submit(arrival.prompt, 1, sid, now=arrival.t,
+                         tenant=arrival.tenant)
+        rep.handoff_rids.add(rid)
+        budget = min(arrival.max_new_tokens,
+                     self.cfg.max_seq_len - len(arrival.prompt))
+        self.journal.open(sid, arrival.prompt, budget, rep.url)
+        self._sessions[sid] = _SessionState(
+            rec=_ReqRecord(arrival_t=arrival.t,
+                           prompt_len=len(arrival.prompt), cold=True),
+            rid=rid, tenant=arrival.tenant)
+
+    def _start_handoff(self, rep: _ReplicaSim, rid: int, sid: int,
+                       t: float) -> None:
+        """Prefill finished: export the request's KV blocks as a
+        framed host image, charge the export on the prefill replica's
+        clock, pick a decode target on the hashring, and put the image
+        in transit.  The prefill-side blocks are released by the
+        export (release-after-export) — the pool must come back clean."""
+        prompt = list(rep.batcher._requests[rid].prompt)
+        trace_id = _session_trace_id(sid)
+        res = rep.batcher.export_handoff(prompt, trace_id=trace_id)
+        if rep.batcher.pooled:
+            rep.batcher.pool.check_invariant()
+            self.invariant_checks += 1
+        if not res or not res['payload']:
+            # Nothing exportable (prefix evicted under pressure):
+            # recompute the prefill on the decode pool.
+            self._fallback_decode(sid, prompt, t)
+            return
+        data = self._disagg_lib.encode_kv_image(
+            prompt[:res['tokens']], self.cfg.prefix_block,
+            res['payload'])
+        # Export crosses the device->host link on the exporter's
+        # clock; transit to the decode host runs at the prefetch link
+        # rate before the image can land.  Both legs reuse the tier's
+        # bandwidth model, so disagg timing stays replay-deterministic.
+        rep.vclock += len(data) / (self.cfg.tier_spill_gbps * 1e9)
+        t_exp = rep.vclock
+        t_land = t_exp + len(data) / (self.cfg.tier_prefetch_gbps * 1e9)
+        key = ','.join(map(str, prompt[:self.cfg.prefix_block]))
+        target = self._handoff_sched.choose(key, exporter=rep.url)
+        if target is None:
+            self._fallback_decode(sid, prompt, t_exp)
+            return
+        self.handoffs += 1
+        self.handoff_export_bytes += len(data)
+        telemetry_metrics.SERVE_DISAGG_HANDOFFS.labels(
+            outcome='shipped').inc()
+        telemetry_metrics.SERVE_DISAGG_EXPORT_BYTES.inc(len(data))
+        self._span_buf.record('handoff.export', t, t_exp,
+                              trace_id=trace_id, replica=rep.url,
+                              nbytes=len(data), tokens=res['tokens'])
+        self._span_buf.record('handoff.transfer', t_exp, t_land,
+                              trace_id=trace_id, source=rep.url,
+                              target=target, nbytes=len(data))
+        self._pending_handoffs.append({
+            'sid': sid, 'target': target, 'prompt': prompt,
+            'data': data, 't_exp': t_exp, 't_land': t_land})
+
+    def _land_handoffs(self, now: float) -> None:
+        """Ingest every in-transit image whose t_land has passed, in
+        export order (deterministic)."""
+        still: List[Dict[str, Any]] = []
+        for ho in self._pending_handoffs:
+            if ho['t_land'] > now:
+                still.append(ho)
+            else:
+                self._ingest_handoff(ho, now)
+        self._pending_handoffs = still
+
+    def _ingest_handoff(self, ho: Dict[str, Any], now: float) -> None:
+        sid = ho['sid']
+        trace_id = _session_trace_id(sid)
+        rep = self._by_url.get(ho['target'])
+        if rep is None or not rep.alive or rep.draining:
+            self._fallback_decode(sid, ho['prompt'], ho['t_land'])
+            return
+        try:
+            img = self._disagg_lib.decode_kv_image(ho['data'])
+        except self._disagg_lib.HandoffImageError:
+            # Torn transfer (the SHA-256 caught it): the image is
+            # garbage, recompute the prefill instead of splicing it.
+            self._fallback_decode(sid, ho['prompt'], ho['t_land'])
+            return
+        adopted = rep.batcher.ingest_handoff(ho['prompt'], img.payload,
+                                             trace_id=trace_id)
+        if rep.batcher.pooled:
+            rep.batcher.pool.check_invariant()
+            self.invariant_checks += 1
+        wait = ho['t_land'] - ho['t_exp']
+        self._handoff_waits.append(wait)
+        self.handoff_ingest_bytes += len(ho['data'])
+        telemetry_metrics.SERVE_DISAGG_HANDOFFS.labels(
+            outcome='ingested').inc()
+        telemetry_metrics.SERVE_DISAGG_INGEST_BYTES.inc(len(ho['data']))
+        telemetry_metrics.SERVE_DISAGG_TRANSFER_SECONDS.observe(wait)
+        if wait > self.cfg.handoff_late_s:
+            self.handoffs_late += 1
+            telemetry_metrics.SERVE_DISAGG_HANDOFFS.labels(
+                outcome='late').inc()
+        # Resubmit the session on the decode replica at landing time.
+        # The adopted nodes stage back through the ordinary prefetch
+        # path (the hint lands at the next step's tier barrier), so
+        # admission splices the handed-off blocks instead of
+        # recomputing the prefill.
+        # The policy didn't choose this target (the hashring did), but
+        # its load accounting must still see the landed session — the
+        # completion-side post_execute_hook will balance this.
+        self.policy.pre_execute_hook(rep.url)
+        spec = self.journal.replay_spec(sid)
+        st = self._sessions[sid]
+        rid = rep.submit(spec['prompt'], spec['max_new_tokens'], sid,
+                         now=ho['t_land'], tenant=st.tenant)
+        self.journal.reassign(sid, rep.url)
+        st.rid = rid
+        self._span_buf.record('handoff.land', ho['t_land'], now,
+                              trace_id=trace_id, replica=rep.url,
+                              nodes=adopted)
+
+    def _fallback_decode(self, sid: int, prompt: List[int],
+                         t: float) -> None:
+        """Handoff could not complete (nothing exported, no decode
+        target, or a corrupt image): the session is still owed every
+        token, so admit it cold on the decode pool."""
+        self.handoffs_failed += 1
+        telemetry_metrics.SERVE_DISAGG_HANDOFFS.labels(
+            outcome='failed').inc()
+        url = self.policy.select_replica({'prompt': prompt})
+        if url is None:
+            raise RuntimeError('No ready decode replicas for handoff '
+                               'fallback')
+        self.policy.pre_execute_hook(url)
+        rep = self._by_url[url]
+        spec = self.journal.replay_spec(sid)
+        st = self._sessions[sid]
+        rid = rep.submit(spec['prompt'], spec['max_new_tokens'], sid,
+                         now=t, tenant=st.tenant)
+        self.journal.reassign(sid, url)
+        st.rid = rid
+
     # ---- delivery plane --------------------------------------------------
     def _owns(self, rep: _ReplicaSim, rid: int, sid: int) -> bool:
         rec = self.journal.record(sid)
@@ -724,6 +996,8 @@ class FleetSimulator:
                 and self._sessions[sid].rid == rid)
 
     def _deliver(self, rep: _ReplicaSim, rid: int, t: float) -> None:
+        if rid in rep.handoff_rids:
+            return      # prefill-stage marker token: never delivered
         sid = rep.rid_sid[rid]
         if not self._owns(rep, rid, sid):
             return      # zombie: ownership moved at failover
@@ -745,6 +1019,8 @@ class FleetSimulator:
         if st.rec.first_token_t is None:
             st.rec.first_token_t = t
             self._report_ttfts.append(t - st.rec.arrival_t)
+            if st.rec.cold:
+                self._report_cold_ttfts.append(t - st.rec.arrival_t)
             self.slo.observe_ttft(t - st.rec.arrival_t, now=t)
         if st.fault_detect_t is not None and st.refirst_t is None:
             st.refirst_t = t
@@ -760,6 +1036,12 @@ class FleetSimulator:
         parks it (finished behind a partition — the tail is undelivered
         and must survive until heal or failover)."""
         sid = rep.rid_sid[rid]
+        if rid in rep.handoff_rids:
+            # Prefill stage done: hand the KV image off instead of
+            # finishing the session.
+            rep.handoff_rids.discard(rid)
+            self._start_handoff(rep, rid, sid, t)
+            return True
         if not self._owns(rep, rid, sid):
             return True     # zombie: consume and discard
         if rep.partitioned(t):
@@ -777,6 +1059,8 @@ class FleetSimulator:
         if st.rec.first_token_t is not None and st.rec.out_len > 1:
             tpot = (t - st.rec.first_token_t) / (st.rec.out_len - 1)
             self.slo.observe_tpot(tpot, now=t)
+            if self._disagg:
+                self._report_tpots.append(tpot)
         self._span_buf.record('session.complete', t, t,
                               trace_id=_session_trace_id(sid),
                               tokens=st.rec.out_len)
@@ -971,6 +1255,10 @@ class FleetSimulator:
 
     # ---- autoscaling -----------------------------------------------------
     def _autoscale_tick(self, autoscaler, now: float) -> None:
+        if getattr(autoscaler, 'prefill', None) is not None and \
+                getattr(autoscaler, 'decode', None) is not None:
+            self._autoscale_roles(autoscaler, now)
+            return
         autoscaler.collect_request_information({
             'ttft_ms': [t * 1000.0 for t in self._report_ttfts],
             'queue_depth': sum(r.batcher.num_queued
@@ -1000,6 +1288,52 @@ class FleetSimulator:
                 self.add_replica()
             else:
                 self.remove_replica(decision.target)
+        self.scale_events.append(
+            {'t': round(now, 3), 'replicas': len(self._live())})
+
+    def _autoscale_roles(self, autoscaler, now: float) -> None:
+        """Feed a RoleAwareSLOAutoscaler (serve/disagg.py) its
+        role-split report — cold-prompt TTFT burn for the prefill
+        pool, session TPOT + queue for decode — and apply each pool's
+        decisions inside that pool."""
+        def _queue(role: str) -> int:
+            return sum(r.batcher.num_queued for r in self._live()
+                       if r.role == role)
+        autoscaler.collect_request_information({
+            'prefill': {
+                'ttft_ms': [t * 1000.0
+                            for t in self._report_cold_ttfts],
+                'queue_depth': _queue('prefill'),
+                'prefix_hit_ratio': self.prefix_hit_ratio(),
+            },
+            'decode': {
+                'tpot_ms': [t * 1000.0 for t in self._report_tpots],
+                'queue_depth': _queue('decode'),
+                'prefix_hit_ratio': self.prefix_hit_ratio(),
+            },
+        })
+        self._report_ttfts = []
+        self._report_cold_ttfts = []
+        self._report_tpots = []
+        infos: Dict[str, List[Dict[str, Any]]] = {'prefill': [],
+                                                  'decode': []}
+        for r in self.replicas:
+            infos[r.role].append({'replica_id': r.replica_id,
+                                  'status': ReplicaStatus.READY,
+                                  'launched_at': r.replica_id,
+                                  'is_spot': False,
+                                  'draining': r.draining})
+        from skypilot_tpu.serve.autoscalers import \
+            AutoscalerDecisionOperator
+        decisions = autoscaler.generate_scaling_decisions(
+            infos['prefill'], infos['decode'])
+        for role in sorted(decisions):
+            for decision in decisions[role]:
+                if decision.operator is \
+                        AutoscalerDecisionOperator.SCALE_UP:
+                    self.add_replica(role=role)
+                else:
+                    self.remove_replica(decision.target)
         self.scale_events.append(
             {'t': round(now, 3), 'replicas': len(self._live())})
 
@@ -1057,6 +1391,8 @@ class FleetSimulator:
             'backpressure_retries': float(sum(
                 rep.batcher.backpressure_retries
                 for rep in self._all_reps())),
+            'disagg_handoffs': float(self.handoffs),
+            'disagg_handoff_late': float(self.handoffs_late),
         }
 
     def _doctor_tick(self, now: float) -> None:
@@ -1177,6 +1513,8 @@ class FleetSimulator:
                 _percentile(ttfts, 0.99) * 1000 if ttfts else None),
             'tpot_ms': _round(
                 sum(tpots) / len(tpots) * 1000 if tpots else None),
+            'tpot_p99_ms': _round(
+                _percentile(tpots, 0.99) * 1000 if tpots else None),
             'goodput_rps': _round(met / span if span else 0.0),
             'slo_attainment': _round(met / len(recs) if recs else None),
             'slo_burn_fast': _round(burn['fast']),
@@ -1204,6 +1542,42 @@ class FleetSimulator:
                 for k in agg:
                     agg[k] += stats[k]
             out['tier'] = agg
+        if self._disagg:
+            # Decode-session tail health is THE disagg acceptance
+            # signal: the cold burst must not inflate the steady
+            # sessions' per-token latency (they live on a pool the
+            # burst never touches).
+            decode_tpots = [
+                (r.done_t - r.first_token_t) / (r.out_len - 1)
+                for r in recs
+                if not r.cold and r.first_token_t is not None
+                and r.out_len > 1]
+            cold_ttfts = [r.first_token_t - r.arrival_t for r in recs
+                          if r.cold and r.first_token_t is not None]
+            waits = self._handoff_waits
+            out['disagg'] = {
+                'prefill_replicas': sum(1 for r in self._live()
+                                        if r.role == 'prefill'),
+                'decode_replicas': sum(1 for r in self._live()
+                                       if r.role == 'decode'),
+                'cold_routed': self.cold_routed,
+                'decode_routed': self.decode_routed,
+                'handoffs': self.handoffs,
+                'handoffs_late': self.handoffs_late,
+                'handoffs_failed': self.handoffs_failed,
+                'export_bytes': self.handoff_export_bytes,
+                'ingest_bytes': self.handoff_ingest_bytes,
+                'transfer_p50_ms': _round(
+                    _percentile(waits, 0.50) * 1000 if waits else None),
+                'transfer_p99_ms': _round(
+                    _percentile(waits, 0.99) * 1000 if waits else None),
+                'cold_ttft_p99_ms': _round(
+                    _percentile(cold_ttfts, 0.99) * 1000
+                    if cold_ttfts else None),
+                'decode_tpot_p99_ms': _round(
+                    _percentile(decode_tpots, 0.99) * 1000
+                    if decode_tpots else None),
+            }
         if len(self.traffic.tenants) > 1:
             # Cost attribution only earns a summary block when there
             # is more than one tenant to attribute between (the gate

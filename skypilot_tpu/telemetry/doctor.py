@@ -50,6 +50,10 @@ SIGNALS = {
     'pool_hwm': 'arena live-block high-water mark (block_pool stats)',
     'pool_free': 'arena free blocks (block_pool stats)',
     'backpressure_retries': 'cumulative admission backpressure retries',
+    'disagg_handoffs': 'cumulative prefill->decode KV handoffs '
+                       '(serve/disagg.py)',
+    'disagg_handoff_late': 'cumulative handoffs whose decode slot '
+                           'waited past the handoff-late threshold',
 }
 
 
@@ -100,6 +104,8 @@ DEFAULT_THRESHOLDS = {
     'breaker_flaps': 2,
     'pool_hwm_ratio': 0.95,
     'backpressure_retries': 8,
+    'handoff_late_ratio': 0.5,
+    'handoff_late_min_events': 4,
 }
 
 
@@ -132,6 +138,19 @@ def _rule_prefetch_late(th):
             return {'prefetch_late': late, 'prefetches': total,
                     'late_ratio': round(late / total, 4),
                     'threshold': th['prefetch_late_ratio']}
+        return None
+    return pred
+
+
+def _rule_handoff_late(th):
+    def pred(ctx):
+        late = ctx.get('d_disagg_handoff_late', 0.0)
+        total = ctx.get('d_disagg_handoffs', 0.0)
+        if late >= th['handoff_late_min_events'] and total > 0 \
+                and late / total > th['handoff_late_ratio']:
+            return {'handoff_late': late, 'handoffs': total,
+                    'late_ratio': round(late / total, 4),
+                    'threshold': th['handoff_late_ratio']}
         return None
     return pred
 
@@ -197,6 +216,10 @@ _RULE_SPECS = (
     ('DOC202', 'tier_spill_thrash', 'ticket',
      'host tier spilling and prefetching the same working set '
      '(device arena too small for the route mix)', _rule_spill_thrash),
+    ('DOC203', 'handoff_late', 'ticket',
+     'disaggregated decode slots waiting past the threshold for their '
+     'prefill KV image (transfer bandwidth or prefill pool '
+     'undersized)', _rule_handoff_late),
     ('DOC301', 'breaker_flap', 'page',
      'circuit breaker opening repeatedly within one cadence interval '
      '(replica flapping, not cleanly dead)', _rule_breaker_flap),
@@ -211,7 +234,8 @@ _RULE_SPECS = (
 # Cumulative-counter signals differentiated into d_<name> per tick.
 _COUNTER_SIGNALS = ('tier_prefetches', 'tier_prefetch_late',
                     'tier_spills', 'breaker_opens',
-                    'backpressure_retries')
+                    'backpressure_retries', 'disagg_handoffs',
+                    'disagg_handoff_late')
 
 
 def build_rules(thresholds: Optional[Dict[str, float]] = None

@@ -564,6 +564,45 @@ SERVE_CHAOS_FAULTS = prometheus_client.Counter(
     ['kind'],
     registry=REGISTRY)
 
+# ---- disaggregated prefill/decode serving (serve/disagg.py)
+
+SERVE_DISAGG_HANDOFFS = prometheus_client.Counter(
+    'skytpu_serve_disagg_handoffs_total',
+    'Prefill->decode KV handoffs, by outcome: shipped (image exported '
+    'and sent), ingested (decode replica adopted the image), late '
+    '(the decode slot waited past the handoff-late threshold for its '
+    'image), failed (no decode target / corrupt image — fell back to '
+    'cold prefill)',
+    ['outcome'],
+    registry=REGISTRY)
+
+SERVE_DISAGG_EXPORT_BYTES = prometheus_client.Counter(
+    'skytpu_serve_disagg_export_bytes_total',
+    'KV image payload bytes exported by prefill replicas (charged '
+    'against the exporter\'s spill bandwidth in the cost model)',
+    registry=REGISTRY)
+
+SERVE_DISAGG_INGEST_BYTES = prometheus_client.Counter(
+    'skytpu_serve_disagg_ingest_bytes_total',
+    'KV image payload bytes adopted by decode replicas (staged to '
+    'device through the ordinary tier prefetch path)',
+    registry=REGISTRY)
+
+SERVE_DISAGG_TRANSFER_SECONDS = prometheus_client.Histogram(
+    'skytpu_serve_disagg_transfer_seconds',
+    'Handoff export-to-ingest latency per image: export gather+fetch '
+    'through transfer to adoption on the decode replica (the window '
+    'the parked request waits out)',
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5),
+    registry=REGISTRY)
+
+SERVE_DISAGG_POOL_REPLICAS = prometheus_client.Gauge(
+    'skytpu_serve_disagg_pool_replicas',
+    'Current replica count per disaggregated pool role '
+    '(prefill / decode)',
+    ['role'],
+    registry=REGISTRY)
+
 # ---- step-phase attribution + SLO burn (telemetry/spans.py, serve/slo.py)
 
 INFER_STEP_PHASE_SECONDS = prometheus_client.Histogram(

@@ -1,0 +1,499 @@
+"""Disaggregated prefill/decode serving (serve/disagg.py + the
+infer-side export/ingest path): split replica pools with KV block
+handoff.
+
+Tier-1 locks on the PR-19 tentpole:
+
+- the KV image codec round-trips BOTH layouts (model-dtype rows, int8
+  rows + f32 scales) byte-exact, and refuses truncated, bit-flipped,
+  or header-tampered images with typed errors (the torn-transfer
+  detector) — a decode replica never adopts garbage KV;
+- a full batcher-level handoff (prefill -> export -> frame ->
+  unframe -> ingest -> decode) emits greedy output BIT-identical to a
+  single-pool run for both layouts, with release-after-export leaving
+  the prefill pool balanced and the decode pool's conservation law
+  intact;
+- HandoffScheduler never targets the prefill pool or the exporter,
+  and the ring's exclusion walk terminates (returns None) even when
+  the exclusions cover every member;
+- export_session folds pending tier state: a copy-engine fault during
+  the export barrier unwinds inside export_session (logged) instead
+  of aborting drain_sessions halfway through — the mid-spill failover
+  regression;
+- the fleet simulator's disagg arm is replay-deterministic, reports
+  the pool/handoff block, and matches the single-pool run's committed
+  tokens bit for bit;
+- DOC203 (handoff_late) fires on the late-ratio delta signal with
+  hysteresis and stays quiet below the event floor;
+- RoleAwareSLOAutoscaler derives per-pool bounds from the spec and
+  maps decode TPOT samples onto its latency channel;
+- ServiceSpec round-trips the disagg knobs through YAML config and
+  bench_compare's _disagg_comparable gates the new headline fields.
+
+NOT slow-marked: tiny configs; this is the tier-1 lock on the
+disaggregation subsystem.
+"""
+import dataclasses
+import importlib.util
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.infer import kv_tier as kv_tier_mod
+from skypilot_tpu.infer.engine import GeneratorConfig
+from skypilot_tpu.infer.serving import ContinuousBatcher
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import disagg
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.serve.traffic.hashring import (ConsistentHashRing,
+                                                 stable_hash)
+from skypilot_tpu.telemetry import doctor as doctor_lib
+
+CFG = llama.LlamaConfig(vocab_size=128, d_model=64, n_layers=2,
+                        n_heads=4, n_kv_heads=2, d_ff=128,
+                        max_seq_len=64, dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope='module')
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _gen_config(**kw):
+    base = dict(max_seq_len=64, batch_size=2, temperature=0.0,
+                prompt_buckets=[32], prefix_cache_mb=0.5,
+                prefix_block=8, host_tier_mb=4.0)
+    base.update(kw)
+    return GeneratorConfig(**base)
+
+
+# ---- KV image codec -----------------------------------------------------
+
+
+def _payload(nodes=2, seed=0, dtype=np.float32, with_scale=False):
+    """Synthetic export payload: per-node component dicts in the
+    tier's gather layout (leading dims (x, ids_per_node))."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(nodes):
+        bufs = {'k': rng.normal(size=(2, 1, 4)).astype(dtype),
+                'v': rng.normal(size=(2, 1, 4)).astype(dtype)}
+        if with_scale:
+            bufs['k'] = rng.integers(-120, 120,
+                                     size=(2, 1, 4)).astype(np.int8)
+            bufs['k_scale'] = rng.normal(size=(2, 1, 1)).astype(
+                np.float32)
+        out.append(bufs)
+    return out
+
+
+@pytest.mark.parametrize('with_scale', [False, True])
+def test_image_roundtrip_byte_exact(with_scale):
+    payload = _payload(with_scale=with_scale)
+    tokens = list(range(1, 17))
+    data = disagg.encode_kv_image(tokens, 8, payload)
+    img = disagg.decode_kv_image(data)
+    assert img.tokens == tokens
+    assert img.tokens_per_node == 8
+    assert img.nodes == len(payload)
+    for got, want in zip(img.payload, payload):
+        assert sorted(got) == sorted(want)
+        for comp in want:
+            assert got[comp].dtype == want[comp].dtype
+            np.testing.assert_array_equal(got[comp], want[comp])
+
+
+def test_image_roundtrip_bfloat16():
+    ml_dtypes = pytest.importorskip('ml_dtypes')
+    payload = _payload(dtype=np.dtype(ml_dtypes.bfloat16))
+    img = disagg.decode_kv_image(
+        disagg.encode_kv_image([1, 2], 8, payload))
+    for got, want in zip(img.payload, payload):
+        for comp in want:
+            assert got[comp].dtype == want[comp].dtype
+            np.testing.assert_array_equal(
+                got[comp].view(np.uint16), want[comp].view(np.uint16))
+
+
+def test_image_truncation_and_framing_rejected():
+    data = disagg.encode_kv_image([1, 2], 8, _payload())
+    with pytest.raises(disagg.HandoffImageError, match='truncated'):
+        disagg.decode_kv_image(data[:-3])
+    with pytest.raises(disagg.HandoffImageError, match='truncated'):
+        disagg.decode_kv_image(data + b'x')
+    with pytest.raises(disagg.HandoffImageError, match='truncated'):
+        disagg.decode_kv_image(data[:8])           # mid-prologue
+    with pytest.raises(disagg.HandoffImageError, match='magic'):
+        disagg.decode_kv_image(b'NOTANIMG' + data[8:])
+
+
+def test_image_bitflip_is_corrupt_not_adopted():
+    data = bytearray(disagg.encode_kv_image([1, 2], 8, _payload()))
+    data[-1] ^= 0x40                               # payload bit-flip
+    with pytest.raises(disagg.CorruptImageError):
+        disagg.decode_kv_image(bytes(data))
+
+
+def test_image_header_tamper_is_corrupt():
+    data = bytearray(disagg.encode_kv_image([1, 2], 8, _payload()))
+    idx = bytes(data).index(b'"tokens"')           # inside JSON header
+    data[idx + 1] ^= 0x01
+    with pytest.raises(disagg.CorruptImageError):
+        disagg.decode_kv_image(bytes(data))
+    # CorruptImageError is the typed subclass the fallback path keys on.
+    assert issubclass(disagg.CorruptImageError, disagg.HandoffImageError)
+
+
+def test_encode_rejects_empty_and_inconsistent_payloads():
+    with pytest.raises(disagg.HandoffImageError, match='empty'):
+        disagg.encode_kv_image([1], 8, [])
+    bad = _payload()
+    del bad[1]['v']
+    with pytest.raises(disagg.HandoffImageError, match='components'):
+        disagg.encode_kv_image([1], 8, bad)
+
+
+def test_image_nbytes_matches_payload():
+    payload = _payload(with_scale=True)
+    assert disagg.image_nbytes(payload) == sum(
+        a.nbytes for bufs in payload for a in bufs.values())
+
+
+# ---- handoff scheduler / ring exclusion ---------------------------------
+
+
+def test_scheduler_never_targets_prefill_or_exporter():
+    sched = disagg.HandoffScheduler(vnodes=16)
+    sched.set_members({'p0': disagg.ROLE_PREFILL,
+                       'p1': disagg.ROLE_PREFILL,
+                       'd0': disagg.ROLE_DECODE,
+                       'd1': disagg.ROLE_DECODE,
+                       'd2': disagg.ROLE_DECODE})
+    for i in range(64):
+        target = sched.choose(f'prompt-{i}', exporter='p0')
+        assert target in {'d0', 'd1', 'd2'}
+    # Even a decode exporter never receives its own image back.
+    for i in range(64):
+        assert sched.choose(f'prompt-{i}', exporter='d1') != 'd1'
+
+
+def test_scheduler_none_when_no_decode_pool():
+    sched = disagg.HandoffScheduler(vnodes=8)
+    sched.set_members({'p0': disagg.ROLE_PREFILL})
+    assert sched.choose('anything', exporter='p0') is None
+    sched.add_member('d0', disagg.ROLE_DECODE)
+    assert sched.choose('anything', exporter='p0') == 'd0'
+    # The sole decode member cannot be both exporter and target.
+    assert sched.choose('anything', exporter='d0') is None
+    with pytest.raises(ValueError, match='role'):
+        sched.add_member('x', 'training')
+
+
+def test_ring_owner_walk_terminates_under_full_exclusion():
+    """Satellite lock: prefetch_target yields each distinct member at
+    most once, so an exclusion set covering the whole ring returns
+    None instead of spinning."""
+    ring = ConsistentHashRing(vnodes=8)
+    members = ['a', 'b', 'c', 'd']
+    ring.set_members(members)
+    h = stable_hash('some-prompt-head')
+    assert ring.prefetch_target(h, exclude=set(members)) is None
+    # Excluding all but the primary also exhausts the walk (the
+    # primary is skipped by definition — it already has the key).
+    primary = ring.primary(h)
+    others = set(members) - {primary}
+    assert ring.prefetch_target(h, exclude=others) is None
+    # A partial exclusion lands on a non-excluded, non-primary owner.
+    target = ring.prefetch_target(h, exclude={primary})
+    assert target is not None and target != primary
+    # No exclusion: the plain next-distinct-owner semantics hold.
+    walk = list(ring.owners(h))
+    assert ring.prefetch_target(h) == walk[1]
+
+
+# ---- batcher-level handoff: bit-exact, pools balanced -------------------
+
+
+def _pool_balanced(batcher):
+    batcher.pool.check_invariant()
+    return (batcher.pool.free_blocks() + batcher.pool.live_blocks()
+            == batcher.pool.n_blocks - 1)
+
+
+@pytest.mark.parametrize('kv', [None, 'int8'])
+def test_handoff_decode_bit_exact_both_layouts(params, kv):
+    prompt = [((7 * i) % 120) + 1 for i in range(24)]
+
+    def mk():
+        return ContinuousBatcher(params, CFG,
+                                 _gen_config(kv_cache_dtype=kv),
+                                 decode_chunk=8)
+
+    ref = mk()
+    rid = ref.submit(prompt, max_new_tokens=8)
+    ref.run_until_idle()
+    want = ref.result(rid)
+    ref.close()
+
+    pre = mk()
+    rid = pre.submit(prompt, max_new_tokens=1)
+    pre.run_until_idle()
+    pre.result(rid)
+    res = pre.export_handoff(prompt)
+    assert res is not None and res['payload']
+    # Whole trie nodes only; the insert covers (len-1)//span spans
+    # (the last prompt token's KV rides the completion logits).
+    assert res['tokens'] == ((len(prompt) - 1) // 8) * 8
+    # Release-after-export: the prefill pool holds no copy.
+    assert _pool_balanced(pre)
+    pre.close()
+
+    data = disagg.encode_kv_image(prompt[:res['tokens']], 8,
+                                  res['payload'])
+    img = disagg.decode_kv_image(data)
+    assert img.nodes == res['tokens'] // 8
+
+    dec = mk()
+    adopted = dec.ingest_handoff(prompt, img.payload)
+    assert adopted == img.nodes
+    dec.tier_flush()
+    rid = dec.submit(prompt, max_new_tokens=8)
+    dec.run_until_idle()
+    got = dec.result(rid)
+    assert got == want                     # greedy bit-exactness
+    dec.tier_flush()
+    assert dec._tier.stats()['adopted'] == img.nodes
+    assert _pool_balanced(dec)
+    dec.close()
+
+
+def test_export_handoff_unknown_prefix_returns_none(params):
+    b = ContinuousBatcher(params, CFG, _gen_config())
+    assert b.export_handoff([9, 8, 7, 6, 5, 4, 3, 2, 1]) is None
+    b.close()
+
+
+# ---- export_session mid-spill fault regression --------------------------
+
+
+def test_export_session_survives_copy_fault_mid_spill(params,
+                                                      monkeypatch):
+    """A copy-engine fault during the export barrier unwinds inside
+    export_session (the spec reflects post-unwind truth) and
+    drain_sessions completes — a failover during an in-flight spill
+    must not abort the handoff halfway through."""
+    b = ContinuousBatcher(params, CFG, _gen_config(), decode_chunk=4)
+    warm = [((5 * i) % 120) + 1 for i in range(24)]
+    rid = b.submit(warm, max_new_tokens=4)
+    b.run_until_idle()
+    b.result(rid)
+
+    live = [((11 * i) % 120) + 1 for i in range(16)]
+    rid = b.submit(live, max_new_tokens=12)
+    b.step()                               # admitted, still decoding
+    assert b.num_active == 1
+
+    def boom(_):
+        raise RuntimeError('host copy died')
+
+    monkeypatch.setattr(kv_tier_mod.jax, 'device_get', boom)
+    # Evict the warm prefix with spill=True: the gather job is now in
+    # flight on the copy thread and will fail there.
+    assert b._prefix.forget(warm, spill=True) > 0
+    specs = b.drain_sessions()             # must NOT raise
+    assert [s['rid'] for s in specs] == [rid]
+    assert specs[0]['tier']['device_tokens'] >= 0
+    # The fault settled inside the barrier: nothing left in flight.
+    assert not b._tier.in_flight()
+    assert b.num_active == 0
+    b.pool.check_invariant()
+    monkeypatch.undo()
+    b.close()
+
+
+# ---- fleet simulator: disagg arm ---------------------------------------
+
+
+def _sim_run(**sim_kwargs):
+    from skypilot_tpu.serve.traffic import generator as gen
+    from skypilot_tpu.serve.traffic.simulator import (FleetSimulator,
+                                                      SimConfig)
+    sim = FleetSimulator(
+        SimConfig(policy='least_load', num_replicas=3, slo_ttft_s=1.0,
+                  batch_size=4, decode_chunk=4, max_seq_len=256,
+                  prefix_cache_mb=2.0, prefix_block=64,
+                  prefill_chunk=16, host_tier_mb=4.0, **sim_kwargs),
+        gen.TrafficConfig(seed=13, duration_s=5.0, base_rps=4.0,
+                          session_share=0.5, num_sessions=4,
+                          num_heads=2, head_tokens=40, tail_median=6,
+                          singleton_median=96, singleton_sigma=0.2,
+                          max_prompt_tokens=128, out_median=12,
+                          out_sigma=0.3, max_out_tokens=20,
+                          min_out_tokens=4))
+    try:
+        return sim.run(), sim.session_outputs()
+    finally:
+        sim.close()
+
+
+def test_sim_disagg_deterministic_with_pool_block_and_parity():
+    disagg_kw = dict(prefill_replicas=1, disagg_cold_prompt_tokens=65)
+    out_a, toks_a = _sim_run(**disagg_kw)
+    out_b, toks_b = _sim_run(**disagg_kw)
+    assert out_a == out_b                  # replay-deterministic
+    assert toks_a == toks_b
+    block = out_a['disagg']
+    assert block['prefill_replicas'] == 1
+    assert block['decode_replicas'] == 2
+    assert block['handoffs'] > 0
+    assert block['handoffs_failed'] == 0
+    assert block['export_bytes'] > 0
+    assert block['export_bytes'] == block['ingest_bytes']
+    # Greedy parity witness: identical config minus the pool split.
+    out_single, toks_single = _sim_run()
+    assert 'disagg' not in out_single
+    assert toks_a == toks_single
+
+
+# ---- DOC203: handoff-late doctor rule ----------------------------------
+
+
+def test_doc203_fires_on_late_ratio_with_hysteresis():
+    doc = doctor_lib.Doctor()
+    opened = doc.observe({'disagg_handoffs': 10.0,
+                          'disagg_handoff_late': 6.0}, now=1.0)
+    assert [i.rule for i in opened] == ['DOC203']
+    assert opened[0].evidence['late_ratio'] == pytest.approx(0.6)
+    # Same cumulative values: zero delta clears the incident...
+    assert doc.observe({'disagg_handoffs': 10.0,
+                        'disagg_handoff_late': 6.0}, now=2.0) == []
+    # ...and a second late burst re-opens it (hysteresis, not a latch).
+    reopened = doc.observe({'disagg_handoffs': 20.0,
+                            'disagg_handoff_late': 12.0}, now=3.0)
+    assert [i.rule for i in reopened] == ['DOC203']
+
+
+def test_doc203_quiet_below_event_floor_and_ratio():
+    doc = doctor_lib.Doctor()
+    # 3 late events: below handoff_late_min_events even at ratio 1.0.
+    assert doc.observe({'disagg_handoffs': 3.0,
+                        'disagg_handoff_late': 3.0}, now=1.0) == []
+    doc = doctor_lib.Doctor()
+    # Plenty of events but the ratio stays at the threshold (not over).
+    assert doc.observe({'disagg_handoffs': 10.0,
+                        'disagg_handoff_late': 5.0}, now=1.0) == []
+
+
+def test_doctor_rule_registry_validates_clean():
+    assert doctor_lib.validate_rules() == []
+
+
+# ---- role-aware autoscaler ---------------------------------------------
+
+
+def _disagg_spec(**kw):
+    base = dict(min_replicas=3, max_replicas=6, prefill_replicas=1,
+                disagg_cold_prompt_tokens=64, target_p99_ttft_ms=500.0,
+                target_p99_tpot_ms=50.0)
+    base.update(kw)
+    return ServiceSpec(**base)
+
+
+def test_role_autoscaler_derives_per_pool_bounds():
+    ras = disagg.RoleAwareSLOAutoscaler('svc', _disagg_spec())
+    info = ras.info()
+    pre, dec = info[disagg.ROLE_PREFILL], info[disagg.ROLE_DECODE]
+    assert pre['min_replicas'] == 1
+    assert dec['min_replicas'] == 2
+    # Together the pools never exceed max_replicas.
+    assert pre['max_replicas'] + dec['min_replicas'] <= 6
+    assert dec['max_replicas'] + pre['min_replicas'] <= 6
+    assert ras.get_decision_interval() > 0
+
+
+def test_role_autoscaler_requires_disagg_and_both_slos():
+    with pytest.raises(ValueError, match='prefill_replicas'):
+        disagg.RoleAwareSLOAutoscaler(
+            'svc', ServiceSpec(min_replicas=3, max_replicas=6,
+                               target_p99_ttft_ms=500.0))
+    with pytest.raises(ValueError, match='tpot'):
+        disagg.RoleAwareSLOAutoscaler(
+            'svc', _disagg_spec(target_p99_tpot_ms=None))
+
+
+def test_role_autoscaler_routes_tpot_to_decode_latency_channel():
+    ras = disagg.RoleAwareSLOAutoscaler('svc', _disagg_spec())
+    ras.collect_request_information({
+        'prefill': {'ttft_ms': [400.0, 450.0], 'queue_depth': 0},
+        'decode': {'tpot_ms': [40.0, 45.0, 200.0], 'queue_depth': 1},
+    })
+    # The decode pool consumed the TPOT samples through its latency
+    # channel (scaling decisions run without error on both pools).
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+
+    def replicas(n):
+        return [{'replica_id': i + 1, 'status': ReplicaStatus.READY,
+                 'launched_at': 0.0, 'is_spot': False}
+                for i in range(n)]
+
+    decisions = ras.generate_scaling_decisions(replicas(1), replicas(2))
+    assert set(decisions) == {disagg.ROLE_PREFILL, disagg.ROLE_DECODE}
+
+
+# ---- spec YAML round-trip ----------------------------------------------
+
+
+def test_service_spec_roundtrips_disagg_knobs():
+    spec = _disagg_spec()
+    again = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again == spec
+    assert again.prefill_replicas == 1
+    assert again.disagg_cold_prompt_tokens == 64
+    assert again.target_p99_tpot_ms == 50.0
+
+
+def test_service_spec_disagg_validation():
+    with pytest.raises(exceptions.InvalidServiceSpecError,
+                       match='decode'):
+        ServiceSpec(min_replicas=1, prefill_replicas=1)
+    with pytest.raises(exceptions.InvalidServiceSpecError,
+                       match='prefill_replicas'):
+        ServiceSpec(min_replicas=2, disagg_cold_prompt_tokens=64)
+
+
+# ---- bench_compare gating ----------------------------------------------
+
+
+def _bench_compare():
+    path = (pathlib.Path(__file__).resolve().parents[1] / 'scripts'
+            / 'bench_compare.py')
+    spec = importlib.util.spec_from_file_location('bench_compare', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_disagg_comparable_gates_headline_fields():
+    bc = _bench_compare()
+    ok = {'disagg': {'parity_ok': True, 'prefill_replicas': 1,
+                     'decode_replicas': 2,
+                     'ttft_p99_disagg_ms': 100.0,
+                     'decode_tpot_p99_ratio': 1.0}}
+    assert bc._disagg_comparable(ok, ok) is None
+    assert 'missing' in bc._disagg_comparable({}, ok)
+    assert 'errored' in bc._disagg_comparable(
+        {'disagg': {'error': 'boom'}}, ok)
+    bad_parity = {'disagg': dict(ok['disagg'], parity_ok=False)}
+    assert 'parity' in bc._disagg_comparable(ok, bad_parity)
+    resized = {'disagg': dict(ok['disagg'], decode_replicas=3)}
+    assert 'split changed' in bc._disagg_comparable(ok, resized)
+    # The skip flows through compare(): disagg fields report skipped,
+    # never regressed.
+    lines, regressions = bc.compare(ok, resized, threshold_pct=5.0)
+    assert regressions == []
+    assert any('disagg.ttft_p99_disagg_ms: skipped' in ln
+               for ln in lines)
